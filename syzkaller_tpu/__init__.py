@@ -1,0 +1,32 @@
+"""syzkaller-tpu: a TPU-native coverage-guided kernel-fuzzing framework.
+
+The framework has the capabilities of syzkaller (reference at
+/root/reference): an unsupervised, coverage-guided OS-kernel fuzzer.
+Unlike the reference (Go + C++ executor, one-program-at-a-time
+mutation), the fuzzing hot loop here — program mutation, random
+generation distributions, comparison-hint mutation and coverage-signal
+triage — is built batch-first on JAX/XLA/Pallas: thousands of
+flattened syscall programs are mutated and triaged in parallel on a
+TPU mesh, with corpus novelty computed against a sharded coverage
+bitmap by a single collective.
+
+Package layout:
+  models/    program model: type system, args, calls, progs, targets,
+             generation/mutation semantics, serialization (the CPU
+             reference plane; mirrors reference prog/)
+  ops/       batched JAX/Pallas kernels: program-tensor mutation,
+             RNG distributions, signal bitmaps, hints
+  parallel/  device-mesh sharding, collectives, multi-host design
+  sys/       syscall description models (test OS, linux subset)
+  compiler/  syzlang description compiler (reference pkg/ast+compiler)
+  signal/    feedback-signal model (reference pkg/signal, pkg/cover)
+  ipc/       executor IPC: exec-format shuttle to executors
+  fuzzer/    guest-side fuzz loop: workqueue, triage, smash
+  manager/   host-side orchestration: corpus, RPC, VM loop
+  vm/        VM pool abstraction
+  report/    crash report parsing and symbolization
+  repro/     automatic reproducer extraction
+  utils/     rng, db, config, logging, hashing
+"""
+
+__version__ = "0.1.0"

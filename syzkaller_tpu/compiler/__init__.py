@@ -1,0 +1,2 @@
+"""Syscall-description pipeline: layout engine, syzlang parser and
+target compiler (reference: pkg/ast, pkg/compiler, sys/syz-sysgen)."""

@@ -1,0 +1,229 @@
+"""Struct/union/array layout: bitfield grouping, padding insertion,
+alignment and size computation.
+
+Follows the reference compiler's layout pass
+(reference: pkg/compiler/gen.go:76-385): bitfields of equal storage
+size pack into one unit; non-packed structs get C-like natural
+alignment padding; explicit size/align attributes override; sizes of
+recursive structures converge via a fixpoint since recursion can only
+pass through fixed-size pointers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from syzkaller_tpu.models.types import (
+    ArrayKind,
+    ArrayType,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    Syscall,
+    Type,
+    UnionType,
+    VmaType,
+)
+
+SIZE_UNASSIGNED = -1
+
+
+def gen_pad(size: int) -> ConstType:
+    return ConstType(name="pad", field_name="", type_size=size, dir=Dir.IN,
+                     is_pad=True)
+
+
+def mark_bitfields(fields: list[Type]) -> None:
+    """Group consecutive bitfields sharing a storage unit
+    (reference: pkg/compiler/gen.go:233-249)."""
+    bf_offset = 0
+    for i, f in enumerate(fields):
+        if f.bitfield_length() == 0:
+            continue
+        off, middle = bf_offset, True
+        bf_offset += f.bitfield_length()
+        last = (i == len(fields) - 1
+                or fields[i + 1].bitfield_length() == 0
+                or fields[i + 1].size() != f.size()
+                or bf_offset + fields[i + 1].bitfield_length() > f.size() * 8)
+        if last:
+            middle = False
+            bf_offset = 0
+        f.bitfield_off = off  # type: ignore[attr-defined]
+        f.bitfield_mdl = middle  # type: ignore[attr-defined]
+
+
+class LayoutAttrs:
+    """Per-struct attributes carried from the description."""
+
+    def __init__(self, packed: bool = False, align: int = 0,
+                 size: Optional[int] = None, varlen_attr: bool = False):
+        self.packed = packed
+        self.align = align
+        self.size = size
+        self.varlen_attr = varlen_attr  # unions only
+
+
+def type_align(t: Type, attrs_of) -> int:
+    """(reference: pkg/compiler/gen.go:337-374)"""
+    if isinstance(t, (IntType, ConstType, LenType, FlagsType, ProcType,
+                      CsumType, PtrType, VmaType, ResourceType)):
+        return t.type_size
+    if isinstance(t, BufferType):
+        return 1
+    if isinstance(t, ArrayType):
+        assert t.elem is not None
+        return type_align(t.elem, attrs_of)
+    if isinstance(t, StructType):
+        attrs: LayoutAttrs = attrs_of(t)
+        if attrs.align:
+            return attrs.align
+        if attrs.packed:
+            return 1
+        return max((type_align(f, attrs_of) for f in t.fields), default=0)
+    if isinstance(t, UnionType):
+        return max((type_align(f, attrs_of) for f in t.fields), default=0)
+    raise TypeError(f"unknown type {t}")
+
+
+def add_alignment(fields: list[Type], varlen: bool, packed: bool,
+                  align_attr: int, attrs_of) -> list[Type]:
+    """Insert pad fields (reference: pkg/compiler/gen.go:268-335)."""
+    if packed:
+        new_fields = list(fields)
+        if not varlen and align_attr != 0:
+            size = sum(f.size() for f in fields if not f.bitfield_middle())
+            tail = size % align_attr
+            if tail:
+                new_fields.append(gen_pad(align_attr - tail))
+        return new_fields
+    new_fields: list[Type] = []
+    align = 0
+    off = 0
+    for i, f in enumerate(fields):
+        if i == 0 or not fields[i - 1].bitfield_middle():
+            a = type_align(f, attrs_of)
+            if align < a:
+                align = a
+            if a and off % a != 0:
+                pad = a - off % a
+                off += pad
+                new_fields.append(gen_pad(pad))
+        new_fields.append(f)
+        if not f.bitfield_middle() and (i != len(fields) - 1 or not f.varlen):
+            off += f.size()
+    if align_attr != 0:
+        align = align_attr
+    if align != 0 and off % align != 0 and not varlen:
+        pad = align - off % align
+        off += pad
+        new_fields.append(gen_pad(pad))
+    return new_fields
+
+
+_DEFAULT_ATTRS = LayoutAttrs()
+
+
+class LayoutEngine:
+    """Runs the padding/size fixpoint over all types reachable from a
+    syscall list (reference: pkg/compiler/gen.go:76-205)."""
+
+    def __init__(self, attrs: dict[str, LayoutAttrs]):
+        # attrs maps struct/union name -> LayoutAttrs
+        self.attrs = attrs
+        self.padded: set[int] = set()
+
+    def attrs_of(self, t: Type) -> LayoutAttrs:
+        return self.attrs.get(t.name, _DEFAULT_ATTRS)
+
+    def _size_known(self, t: Type) -> bool:
+        return t.varlen or t.type_size != SIZE_UNASSIGNED
+
+    def run(self, syscalls: list[Syscall]) -> None:
+        while True:
+            start = len(self.padded)
+            for c in syscalls:
+                for a in c.args:
+                    self._rec(a)
+                if c.ret is not None:
+                    self._rec(c.ret)
+            if start == len(self.padded):
+                break
+
+    def _rec(self, t: Type) -> None:
+        if isinstance(t, PtrType):
+            assert t.elem is not None
+            self._rec(t.elem)
+        elif isinstance(t, ArrayType):
+            if id(t) in self.padded:
+                return
+            assert t.elem is not None
+            self._rec(t.elem)
+            if not self._size_known(t.elem):
+                return  # inner struct not padded yet
+            self.padded.add(id(t))
+            t.type_size = 0
+            if t.kind == ArrayKind.RANGE_LEN and t.range_begin == t.range_end \
+                    and not t.elem.varlen:
+                t.type_size = t.range_begin * t.elem.size()
+                t.varlen = False
+            else:
+                t.varlen = True
+        elif isinstance(t, StructType):
+            if not self._check_struct(t):
+                return
+            varlen = any(f.varlen for f in t.fields)
+            mark_bitfields(t.fields)
+            attrs = self.attrs_of(t)
+            t.fields = add_alignment(t.fields, varlen, attrs.packed,
+                                     attrs.align, self.attrs_of)
+            t.align_attr = attrs.align
+            t.varlen = varlen
+            t.type_size = 0
+            if not varlen:
+                t.type_size = sum(f.size() for f in t.fields
+                                  if not f.bitfield_middle())
+                if attrs.size is not None:
+                    assert t.type_size <= attrs.size, (
+                        f"struct {t.name} has size attr {attrs.size} < "
+                        f"computed size {t.type_size}")
+                    pad = attrs.size - t.type_size
+                    if pad:
+                        t.fields.append(gen_pad(pad))
+                    t.type_size = attrs.size
+        elif isinstance(t, UnionType):
+            if not self._check_struct(t):
+                return
+            attrs = self.attrs_of(t)
+            t.varlen = attrs.varlen_attr
+            t.type_size = 0
+            if not attrs.varlen_attr:
+                for f in t.fields:
+                    sz = f.size()
+                    if attrs.size is not None:
+                        assert sz <= attrs.size, (
+                            f"union {t.name} size attr {attrs.size} < "
+                            f"field {f.name} size {sz}")
+                    t.type_size = max(t.type_size, sz)
+                if attrs.size is not None:
+                    t.type_size = attrs.size
+
+    def _check_struct(self, t) -> bool:
+        if id(t) in self.padded:
+            return False
+        self.padded.add(id(t))
+        for f in t.fields:
+            self._rec(f)
+            if not self._size_known(f):
+                # An inner struct is not padded yet; retry next iteration.
+                self.padded.discard(id(t))
+                return False
+        return True

@@ -1,0 +1,51 @@
+"""Program model: the typed syscall-program representation.
+
+This package is the equivalent of the reference's prog/ package
+(reference: prog/prog.go, prog/types.go, prog/target.go): a pure
+in-memory model of syscall programs with no I/O, the substrate both
+for the CPU semantics engine and for the flat program-tensor codec
+consumed by the TPU kernels in syzkaller_tpu.ops.
+"""
+
+from syzkaller_tpu.models.types import (  # noqa: F401
+    Dir,
+    Type,
+    ResourceType,
+    ConstType,
+    IntType,
+    IntKind,
+    FlagsType,
+    LenType,
+    ProcType,
+    CsumType,
+    CsumKind,
+    VmaType,
+    BufferType,
+    BufferKind,
+    TextKind,
+    ArrayType,
+    ArrayKind,
+    PtrType,
+    StructType,
+    UnionType,
+    Syscall,
+    ResourceDesc,
+    ConstValue,
+    foreach_type,
+    is_pad,
+)
+from syzkaller_tpu.models.prog import (  # noqa: F401
+    Arg,
+    ConstArg,
+    PointerArg,
+    DataArg,
+    GroupArg,
+    UnionArg,
+    ResultArg,
+    Call,
+    Prog,
+    foreach_arg,
+    foreach_sub_arg,
+    ArgCtx,
+)
+from syzkaller_tpu.models.target import Target, register_target, get_target  # noqa: F401

@@ -1,0 +1,78 @@
+"""Virtual-memory allocators giving deterministic fake addresses to
+pointer args (reference: prog/alloc.go:17-164).
+
+mem_alloc: 64-byte-granule bitmap allocator with "bankruptcy" reset when
+the address space fills up.  vma_alloc: page allocator biased towards
+reusing/abutting previously used pages.
+"""
+
+from __future__ import annotations
+
+MEM_ALLOC_GRANULE = 64
+MEM_ALLOC_MAX_MEM = 16 << 20
+
+
+class MemAlloc:
+    def __init__(self, total_mem_size: int):
+        assert total_mem_size <= MEM_ALLOC_MAX_MEM
+        self.size = total_mem_size // MEM_ALLOC_GRANULE
+        # One Python int as a bitmap of granules; dense but simple.
+        self.bits = 0
+
+    def note_alloc(self, addr0: int, size0: int) -> None:
+        addr = addr0 // MEM_ALLOC_GRANULE
+        end = (addr0 + size0 + MEM_ALLOC_GRANULE - 1) // MEM_ALLOC_GRANULE
+        n = end - addr
+        self.bits |= ((1 << n) - 1) << addr
+
+    def alloc(self, rng, size0: int) -> int:
+        if size0 == 0:
+            size0 = 1
+        size = (size0 + MEM_ALLOC_GRANULE - 1) // MEM_ALLOC_GRANULE
+        mask = (1 << size) - 1
+        end = self.size - size
+        start = 0
+        bits = self.bits
+        while start < end:
+            if (bits >> start) & mask == 0:
+                start0 = start * MEM_ALLOC_GRANULE
+                self.note_alloc(start0, size0)
+                return start0
+            start += 1
+        # Address space exhausted: reset and start over
+        # (reference: prog/alloc.go:74-87).
+        self.bits = 0
+        return self.alloc(rng, size0)
+
+
+class VmaAlloc:
+    def __init__(self, total_pages: int):
+        self.num_pages = total_pages
+        self.used: list[int] = []
+        self._used_set: set[int] = set()
+
+    def note_alloc(self, page: int, size: int) -> None:
+        for i in range(page, page + size):
+            if i not in self._used_set:
+                self._used_set.add(i)
+                self.used.append(i)
+
+    def alloc(self, rng, size: int) -> int:
+        """rng is a models.rand.RandGen (reference: prog/alloc.go:136-164)."""
+        assert size <= self.num_pages
+        if not self.used or rng.one_of(5):
+            page = rng.rand(4)
+            if not rng.one_of(100):
+                page = self.num_pages - page - size
+        else:
+            page = self.used[rng.rand(len(self.used))]
+            if size > 1 and rng.bin():
+                off = rng.rand(size)
+                if off > page:
+                    off = page
+                page -= off
+            if page + size > self.num_pages:
+                page = self.num_pages - size
+        assert 0 <= page < self.num_pages and page + size <= self.num_pages
+        self.note_alloc(page, size)
+        return page

@@ -1,0 +1,110 @@
+"""Conservative program-state analysis.
+
+Rebuilds the prefix state of a program (open files, live resources,
+seen strings, mapped memory) by scanning calls, feeding generation and
+mutation decisions (reference: prog/analysis.go:15-98,158-172).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from syzkaller_tpu.models.alloc import MemAlloc, VmaAlloc
+from syzkaller_tpu.models.prog import (
+    Call,
+    ConstArg,
+    DataArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    foreach_arg,
+)
+from syzkaller_tpu.models.types import (
+    BufferKind,
+    BufferType,
+    CsumType,
+    Dir,
+    ResourceType,
+)
+
+
+class State:
+    """(reference: prog/analysis.go:15-49)"""
+
+    def __init__(self, target, ct=None):
+        self.target = target
+        self.ct = ct  # ChoiceTable
+        self.files: dict[str, bool] = {}
+        self.resources: dict[str, list[ResultArg]] = {}
+        self.strings: dict[str, bool] = {}
+        self.ma = MemAlloc(target.num_pages * target.page_size)
+        self.va = VmaAlloc(target.num_pages)
+
+    def analyze(self, c: Call) -> None:
+        self._analyze_impl(c, resources=True)
+
+    def _analyze_impl(self, c: Call, resources: bool) -> None:
+        def visit(arg, ctx) -> None:
+            if isinstance(arg, PointerArg):
+                if arg.is_null():
+                    pass
+                elif arg.vma_size != 0:
+                    self.va.note_alloc(arg.address // self.target.page_size,
+                                       arg.vma_size // self.target.page_size)
+                else:
+                    assert arg.res is not None
+                    self.ma.note_alloc(arg.address, arg.res.size())
+            t = arg.typ
+            if isinstance(t, ResourceType):
+                if resources and t.dir != Dir.IN:
+                    assert t.desc is not None
+                    self.resources.setdefault(t.desc.name, []).append(arg)
+            elif isinstance(t, BufferType):
+                if t.dir != Dir.OUT and isinstance(arg, DataArg) and len(arg.data) != 0:
+                    val = bytes(arg.data)
+                    # Strip trailing zero padding down to one terminator.
+                    while len(val) >= 2 and val[-1] == 0 and val[-2] == 0:
+                        val = val[:-1]
+                    if t.kind == BufferKind.STRING:
+                        try:
+                            self.strings[val.decode("latin-1")] = True
+                        except Exception:
+                            pass
+                    elif t.kind == BufferKind.FILENAME:
+                        if len(val) < 3:
+                            return  # special file, not one of ours
+                        s = val.decode("latin-1")
+                        if s.endswith("\x00"):
+                            s = s[:-1]
+                        self.files[s] = True
+
+        foreach_arg(c, visit)
+
+
+def analyze(ct, p: Prog, c: Optional[Call]) -> State:
+    """Analyze p up to but not including c; resources created at or
+    after c are not usable (reference: prog/analysis.go:26-36)."""
+    s = State(p.target, ct)
+    resources = True
+    for c1 in p.calls:
+        if c1 is c:
+            resources = False
+        s._analyze_impl(c1, resources)
+    return s
+
+
+def required_features(p: Prog) -> tuple[bool, bool]:
+    """(bitmasks, csums) needed by the program
+    (reference: prog/analysis.go:158-172)."""
+    bitmasks = csums = False
+    for c in p.calls:
+        def visit(arg, ctx) -> None:
+            nonlocal bitmasks, csums
+            if isinstance(arg, ConstArg):
+                if arg.typ.bitfield_offset() != 0 or arg.typ.bitfield_length() != 0:
+                    bitmasks = True
+            if isinstance(arg.typ, CsumType):
+                csums = True
+
+        foreach_arg(c, visit)
+    return bitmasks, csums

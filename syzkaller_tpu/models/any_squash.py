@@ -1,0 +1,245 @@
+"""ANY-squashing: flatten a typed pointee tree into a raw-blob union
+that preserves resource references, enabling byte-soup mutation of
+complex structures (reference: prog/any.go:7-334).
+
+The squashed form maps onto the TPU program tensor directly: data
+elements become arena spans, resource elements stay as slot refs.
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.prog import (
+    Arg,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    UnionArg,
+    foreach_arg,
+    foreach_sub_arg,
+)
+from syzkaller_tpu.models.types import (
+    ArrayType,
+    BufferType,
+    CsumType,
+    Dir,
+    IntType,
+    PtrType,
+    ResourceDesc,
+    ResourceType,
+    StructType,
+    Type,
+    UnionType,
+    is_pad,
+)
+from syzkaller_tpu.utils.ints import MASK64, swap_int
+
+
+class AnyTypes:
+    """Synthetic ANY type family, one instance per target
+    (reference: prog/any.go:18-111)."""
+
+    def __init__(self, target):
+        self.union = UnionType(name="ANYUNION", field_name="ANYUNION",
+                               varlen=True, dir=Dir.IN)
+        self.array = ArrayType(name="ANYARRAY", field_name="ANYARRAY",
+                               varlen=True, elem=self.union)
+        self.ptr_ptr = PtrType(name="ptr", field_name="ANYPTR",
+                               type_size=target.ptr_size, optional=True,
+                               elem=self.array)
+        self.ptr64 = PtrType(name="ptr64", field_name="ANYPTR64",
+                             type_size=8, optional=True, elem=self.array)
+        self.blob = BufferType(name="ANYBLOB", field_name="ANYBLOB", varlen=True)
+
+        def res(name: str, base: str, size: int) -> ResourceType:
+            return ResourceType(
+                name=name, field_name=name, dir=Dir.IN, type_size=size,
+                optional=True,
+                desc=ResourceDesc(name=name, kind=(name,),
+                                  values=(MASK64, 0),
+                                  type=IntType(name=base, type_size=size)))
+
+        self.res16 = res("ANYRES16", "int16", 2)
+        self.res32 = res("ANYRES32", "int32", 4)
+        self.res64 = res("ANYRES64", "int64", 8)
+        self.union.fields = [self.blob, self.ptr_ptr, self.ptr64,
+                             self.res16, self.res32, self.res64]
+
+
+def get_any(target) -> AnyTypes:
+    any_ = getattr(target, "_any_types", None)
+    if any_ is None:
+        any_ = AnyTypes(target)
+        target._any_types = any_
+    return any_
+
+
+def make_any_ptr_type(target, size: int, field: str) -> PtrType:
+    any_ = get_any(target)
+    base = any_.ptr_ptr if size == target.ptr_size else any_.ptr64
+    assert size in (target.ptr_size, 8), f"bad pointer size {size}"
+    t = PtrType(name=base.name, field_name=field or base.field_name,
+                type_size=size, optional=True, elem=any_.array)
+    return t
+
+
+def is_any_ptr(target, typ: Type) -> bool:
+    return isinstance(typ, PtrType) and typ.elem is get_any(target).array
+
+
+def complex_ptrs(p: Prog) -> list[PointerArg]:
+    """Pointers to squashable (structurally complex) objects
+    (reference: prog/any.go:136-146)."""
+    res: list[PointerArg] = []
+    for c in p.calls:
+        def visit(arg, ctx) -> None:
+            if isinstance(arg, PointerArg) and is_complex_ptr(p.target, arg):
+                res.append(arg)
+                ctx.stop = True
+
+        foreach_arg(c, visit)
+    return res
+
+
+def is_complex_ptr(target, arg: PointerArg) -> bool:
+    """(reference: prog/any.go:148-175)"""
+    if arg.res is None or arg.typ.dir != Dir.IN:
+        return False
+    if is_any_ptr(target, arg.typ):
+        return True
+    res = [False]
+
+    def visit(a1, ctx) -> None:
+        t = a1.typ
+        if isinstance(t, StructType):
+            if t.varlen:
+                res[0] = True
+                ctx.stop = True
+        elif isinstance(t, UnionType):
+            if t.varlen and len(t.fields) > 5:
+                res[0] = True
+                ctx.stop = True
+        elif isinstance(t, PtrType):
+            if a1 is not arg:
+                ctx.stop = True
+
+    foreach_sub_arg(arg.res, visit)
+    return res[0]
+
+
+def call_contains_any(target, c) -> bool:
+    found = [False]
+
+    def visit(arg, ctx) -> None:
+        if is_any_ptr(target, arg.typ):
+            found[0] = True
+            ctx.stop = True
+
+    foreach_arg(c, visit)
+    return found[0]
+
+
+def squash_ptr(target, p: Prog, arg: PointerArg, preserve_field: bool) -> None:
+    """(reference: prog/any.go:197-214)"""
+    assert arg.res is not None and arg.vma_size == 0, "bad ptr arg"
+    size0 = arg.res.size()
+    elems: list[Arg] = []
+    _squash_impl(target, arg.res, elems)
+    field = arg.typ.field_name if preserve_field else ""
+    arg.typ = make_any_ptr_type(target, arg.typ.size(), field)
+    arg.res = GroupArg(arg.typ.elem, elems)
+    assert arg.res.size() == size0, \
+        f"squash changed size {size0}->{arg.res.size()}"
+
+
+def _squash_impl(target, a: Arg, elems: list[Arg]) -> None:
+    """(reference: prog/any.go:216-309)"""
+    any_ = get_any(target)
+    assert a.typ.bitfield_length() == 0, "bitfield in squash"
+    pad = 0
+    if isinstance(a, ConstArg):
+        if is_pad(a.typ):
+            pad = a.size()
+        else:
+            v = _squash_const(target, a)
+            elem = _ensure_data_elem(target, elems)
+            for _ in range(a.size()):
+                elem.data.append(v & 0xFF)
+                v >>= 8
+    elif isinstance(a, ResultArg):
+        size = a.size()
+        a.typ = {2: any_.res16, 4: any_.res32, 8: any_.res64}[size]
+        elems.append(UnionArg(any_.union, a))
+    elif isinstance(a, PointerArg):
+        if a.res is not None:
+            squash_ptr(target, None, a, False)
+            elems.append(UnionArg(any_.union, a))
+        else:
+            elem = _ensure_data_elem(target, elems)
+            addr = target.physical_addr(a)
+            for _ in range(a.size()):
+                elem.data.append(addr & 0xFF)
+                addr >>= 8
+    elif isinstance(a, UnionArg):
+        if not a.typ.varlen:
+            pad = a.size() - a.option.size()
+        _squash_impl(target, a.option, elems)
+    elif isinstance(a, DataArg):
+        if a.typ.dir == Dir.OUT:
+            pad = a.size()
+        else:
+            elem = _ensure_data_elem(target, elems)
+            elem.data.extend(a.data)
+    elif isinstance(a, GroupArg):
+        t = a.typ
+        if isinstance(t, StructType) and t.varlen and t.align_attr != 0:
+            fields_size = sum(f.size() for f in a.inner
+                              if not f.typ.bitfield_middle())
+            if fields_size % t.align_attr != 0:
+                pad = t.align_attr - fields_size % t.align_attr
+        bitfield = 0
+        for fld in a.inner:
+            bf_len = fld.typ.bitfield_length()
+            if bf_len != 0:
+                bf_off = fld.typ.bitfield_offset()
+                v = _squash_const(target, fld)  # type: ignore[arg-type]
+                bitfield |= (v & ((1 << bf_len) - 1)) << bf_off
+                if not fld.typ.bitfield_middle():
+                    elem = _ensure_data_elem(target, elems)
+                    for _ in range(fld.size()):
+                        elem.data.append(bitfield & 0xFF)
+                        bitfield >>= 8
+                    bitfield = 0
+                continue
+            _squash_impl(target, fld, elems)
+    else:
+        raise TypeError("bad arg kind in squash")
+    if pad:
+        elem = _ensure_data_elem(target, elems)
+        elem.data.extend(bytes(pad))
+
+
+def _squash_const(target, arg: ConstArg) -> int:
+    if isinstance(arg.typ, CsumType):
+        # Can't compute checksums here; leave a recognizable marker
+        # (reference: prog/any.go:311-320).
+        return 0xABCDEF1234567890
+    v, stride, be = arg.value()
+    # pid 0 materialization
+    if be:
+        v = swap_int(v, arg.size())
+    return v
+
+
+def _ensure_data_elem(target, elems: list[Arg]) -> DataArg:
+    any_ = get_any(target)
+    if elems:
+        last = elems[-1]
+        assert isinstance(last, UnionArg)
+        if isinstance(last.option, DataArg):
+            return last.option
+    res = DataArg(any_.blob, b"")
+    elems.append(UnionArg(any_.union, res))
+    return res

@@ -1,0 +1,606 @@
+"""Text serialization of programs — wire-compatible with the reference
+format (`r0 = open(&(0x7f0000000000)='./file0\\x00', 0x1)`), so corpora
+and crash logs from the reference can be imported directly
+(reference: prog/encoding.go:26-869).
+
+The parser is deliberately tolerant: unknown args and excess fields are
+eaten (eat_excessive) so cross-version corpora survive description
+changes.
+"""
+
+from __future__ import annotations
+
+import binascii
+from typing import Optional
+
+from syzkaller_tpu.models.prog import (
+    Arg,
+    Call,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    UnionArg,
+    default_arg,
+    is_default_arg,
+    make_return_arg,
+)
+from syzkaller_tpu.models.types import (
+    ArrayKind,
+    ArrayType,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    Type,
+    UnionType,
+    VmaType,
+    is_pad,
+)
+
+ENCODING_ADDR_BASE = 0x7F0000000000
+MAX_LINE_LEN = 1 << 20
+
+
+def prog_string(p: Prog) -> str:
+    """Compact debug form: call names joined by '-'."""
+    return "-".join(c.meta.name for c in p.calls)
+
+
+def serialize_prog(p: Prog) -> bytes:
+    from syzkaller_tpu.models import validation
+
+    if validation.debug:
+        validation.validate_prog(p)
+    out: list[str] = []
+    vars_: dict[ResultArg, int] = {}
+    var_seq = [0]
+    for c in p.calls:
+        line: list[str] = []
+        if c.ret is not None and len(c.ret.uses) != 0:
+            line.append(f"r{var_seq[0]} = ")
+            vars_[c.ret] = var_seq[0]
+            var_seq[0] += 1
+        line.append(f"{c.meta.name}(")
+        first = True
+        for a in c.args:
+            if is_pad(a.typ):
+                continue
+            if not first:
+                line.append(", ")
+            first = False
+            line.append(_serialize_arg(p.target, a, vars_, var_seq))
+        line.append(")")
+        out.append("".join(line))
+    return ("\n".join(out) + "\n").encode("latin-1") if out else b""
+
+
+def _serialize_arg(target, arg: Optional[Arg], vars_: dict, var_seq: list[int]) -> str:
+    from syzkaller_tpu.models.any_squash import is_any_ptr
+
+    if arg is None:
+        return "nil"
+    if isinstance(arg, ConstArg):
+        return f"0x{arg.val:x}"
+    if isinstance(arg, PointerArg):
+        if arg.is_null():
+            return "0x0"
+        s = f"&{_serialize_addr(arg)}"
+        if arg.res is None or not is_default_arg(target, arg.res) \
+                or is_any_ptr(target, arg.typ):
+            s += "="
+            if is_any_ptr(target, arg.typ):
+                s += "ANY="
+            s += _serialize_arg(target, arg.res, vars_, var_seq)
+        return s
+    if isinstance(arg, DataArg):
+        if arg.typ.dir == Dir.OUT:
+            return f'""/{arg.size()}'
+        data = bytes(arg.data)
+        if not arg.typ.varlen:
+            # Statically-typed data is zero-padded on parse; strip here.
+            while len(data) >= 2 and data[-1] == 0 and data[-2] == 0:
+                data = data[:-1]
+        return _serialize_data(data)
+    if isinstance(arg, GroupArg):
+        if isinstance(arg.typ, StructType):
+            od, cd = "{", "}"
+        elif isinstance(arg.typ, ArrayType):
+            od, cd = "[", "]"
+        else:
+            raise TypeError("unknown group type")
+        last = len(arg.inner) - 1
+        if arg.fixed_inner_size():
+            while last >= 0 and is_default_arg(target, arg.inner[last]):
+                last -= 1
+        parts: list[str] = []
+        for i in range(last + 1):
+            a1 = arg.inner[i]
+            if a1 is not None and is_pad(a1.typ):
+                continue
+            if i != 0:
+                parts.append(", ")
+            parts.append(_serialize_arg(target, a1, vars_, var_seq))
+        return od + "".join(parts) + cd
+    if isinstance(arg, UnionArg):
+        s = f"@{arg.option.typ.field_name}"
+        if not is_default_arg(target, arg.option):
+            s += "=" + _serialize_arg(target, arg.option, vars_, var_seq)
+        return s
+    if isinstance(arg, ResultArg):
+        s = ""
+        if len(arg.uses) != 0:
+            s += f"<r{var_seq[0]}=>"
+            vars_[arg] = var_seq[0]
+            var_seq[0] += 1
+        if arg.res is None:
+            return s + f"0x{arg.val:x}"
+        rid = vars_.get(arg.res)
+        assert rid is not None, "no result"
+        s += f"r{rid}"
+        if arg.op_div != 0:
+            s += f"/{arg.op_div}"
+        if arg.op_add != 0:
+            s += f"+{arg.op_add}"
+        return s
+    raise TypeError(f"unknown arg kind {arg!r}")
+
+
+def _serialize_addr(arg: PointerArg) -> str:
+    ssize = f"/0x{arg.vma_size:x}" if arg.vma_size != 0 else ""
+    return f"(0x{ENCODING_ADDR_BASE + arg.address:x}{ssize})"
+
+
+def _serialize_data(data: bytes) -> str:
+    special = {0: "\\x00", 7: "\\a", 8: "\\b", 12: "\\f", 10: "\\n",
+               13: "\\r", 9: "\\t", 11: "\\v", 0x27: "\\'", 0x5C: "\\\\"}
+    readable = all(0x20 <= v < 0x7F or v in special for v in data)
+    if not readable or len(data) == 0:
+        return f'"{binascii.hexlify(data).decode()}"'
+    out = ["'"]
+    for v in data:
+        if v in special:
+            out.append(special[v])
+        else:
+            out.append(chr(v))
+    out.append("'")
+    return "".join(out)
+
+
+# -- deserialization -----------------------------------------------------
+
+
+class ParseError(Exception):
+    pass
+
+
+class _Parser:
+    """Single-line cursor with identifier/char helpers
+    (reference: prog/encoding.go:726-832)."""
+
+    def __init__(self, line: str, lineno: int):
+        self.s = line
+        self.i = 0
+        self.l = lineno
+
+    def eof(self) -> bool:
+        return self.i == len(self.s)
+
+    def char(self) -> str:
+        if self.eof():
+            raise ParseError(f"unexpected eof (line #{self.l}: {self.s})")
+        return self.s[self.i]
+
+    def parse(self, ch: str) -> None:
+        if self.eof():
+            raise ParseError(f"want {ch!r}, got EOF (line #{self.l})")
+        if self.s[self.i] != ch:
+            raise ParseError(
+                f"want {ch!r}, got {self.s[self.i]!r} (line #{self.l}: {self.s})")
+        self.i += 1
+        self.skip_ws()
+
+    def consume(self) -> str:
+        if self.eof():
+            raise ParseError(f"unexpected eof (line #{self.l})")
+        v = self.s[self.i]
+        self.i += 1
+        return v
+
+    def skip_ws(self) -> None:
+        while self.i < len(self.s) and self.s[self.i] in " \t":
+            self.i += 1
+
+    def ident(self) -> str:
+        i = self.i
+        while self.i < len(self.s) and (
+                self.s[self.i].isalnum() or self.s[self.i] in "_$"):
+            self.i += 1
+        if i == self.i:
+            raise ParseError(
+                f"failed to parse identifier at pos {i} (line #{self.l}: {self.s})")
+        s = self.s[i:self.i]
+        self.skip_ws()
+        return s
+
+
+def deserialize_prog(target, data: bytes) -> Prog:
+    """(reference: prog/encoding.go:153-226)"""
+    prog = Prog(target=target)
+    vars_: dict[str, ResultArg] = {}
+    for lineno, raw in enumerate(data.decode("latin-1").splitlines(), 1):
+        if not raw or raw.startswith("#"):
+            continue
+        p = _Parser(raw, lineno)
+        p.skip_ws()
+        if p.eof():
+            continue
+        name = p.ident()
+        r = ""
+        if not p.eof() and p.char() == "=":
+            r = name
+            p.parse("=")
+            name = p.ident()
+        meta = target.syscall_map.get(name)
+        if meta is None:
+            raise ParseError(f"unknown syscall {name} (line #{lineno})")
+        c = Call(meta=meta, ret=make_return_arg(meta.ret))
+        prog.calls.append(c)
+        p.parse("(")
+        i = 0
+        while p.char() != ")":
+            if i >= len(meta.args):
+                _eat_excessive(p, stop_at_comma=False)
+                break
+            typ = meta.args[i]
+            if is_pad(typ):
+                raise ParseError(f"padding in syscall {name} arguments")
+            arg = _parse_arg(target, typ, p, vars_)
+            c.args.append(arg)
+            if p.char() != ")":
+                p.parse(",")
+            i += 1
+        p.parse(")")
+        if not p.eof():
+            raise ParseError(f"trailing data (line #{lineno})")
+        for j in range(len(c.args), len(meta.args)):
+            c.args.append(default_arg(target, meta.args[j]))
+        if len(c.args) != len(meta.args):
+            raise ParseError(
+                f"wrong call arg count: {len(c.args)}, want {len(meta.args)}")
+        if r and c.ret is not None:
+            vars_[r] = c.ret
+    # Always validate: deserialization doesn't catch everything and we
+    # receive programs from corpus/hub (reference: prog/encoding.go:216-221).
+    from syzkaller_tpu.models.validation import validate_prog
+
+    validate_prog(prog)
+    for c in prog.calls:
+        target.sanitize_call(c)
+    return prog
+
+
+def _parse_arg(target, typ: Optional[Type], p: _Parser, vars_: dict) -> Optional[Arg]:
+    r = ""
+    if p.char() == "<":
+        p.parse("<")
+        r = p.ident()
+        p.parse("=")
+        p.parse(">")
+    arg = _parse_arg_impl(target, typ, p, vars_)
+    if arg is None:
+        if typ is not None:
+            arg = default_arg(target, typ)
+        elif r:
+            raise ParseError("named nil argument")
+    if r and isinstance(arg, ResultArg):
+        vars_[r] = arg
+    return arg
+
+
+def _parse_arg_impl(target, typ, p: _Parser, vars_):
+    ch = p.char()
+    if ch == "0":
+        return _parse_arg_int(target, typ, p)
+    if ch == "r":
+        return _parse_arg_res(target, typ, p, vars_)
+    if ch == "&":
+        return _parse_arg_addr(target, typ, p, vars_)
+    if ch in "\"'":
+        return _parse_arg_string(target, typ, p)
+    if ch == "{":
+        return _parse_arg_struct(target, typ, p, vars_)
+    if ch == "[":
+        return _parse_arg_array(target, typ, p, vars_)
+    if ch == "@":
+        return _parse_arg_union(target, typ, p, vars_)
+    if ch == "n":
+        p.parse("n")
+        p.parse("i")
+        p.parse("l")
+        return None
+    raise ParseError(f"failed to parse argument at {ch!r} "
+                     f"(line #{p.l}/{p.i}: {p.s})")
+
+
+def _parse_arg_int(target, typ, p: _Parser):
+    val = p.ident()
+    try:
+        v = int(val, 0)
+    except ValueError as e:
+        raise ParseError(f"wrong arg value {val!r}: {e}")
+    if isinstance(typ, (ConstType, IntType, FlagsType, ProcType, LenType, CsumType)):
+        return ConstArg(typ, v)
+    if isinstance(typ, ResourceType):
+        return ResultArg(typ, None, v)
+    if isinstance(typ, (PtrType, VmaType)):
+        if typ.optional:
+            return PointerArg.make_null(typ)
+        return default_arg(target, typ)
+    _eat_excessive(p, stop_at_comma=True)
+    return default_arg(target, typ)
+
+
+def _parse_arg_res(target, typ, p: _Parser, vars_):
+    id_ = p.ident()
+    div = add = 0
+    if not p.eof() and p.char() == "/":
+        p.parse("/")
+        div = int(p.ident(), 0)
+    if not p.eof() and p.char() == "+":
+        p.parse("+")
+        add = int(p.ident(), 0)
+    v = vars_.get(id_)
+    if v is None:
+        return default_arg(target, typ)
+    arg = ResultArg(typ, v, 0)
+    arg.op_div = div
+    arg.op_add = add
+    return arg
+
+
+def _parse_arg_addr(target, typ, p: _Parser, vars_):
+    from syzkaller_tpu.models.any_squash import get_any, make_any_ptr_type
+
+    if isinstance(typ, PtrType):
+        typ1 = typ.elem
+    elif isinstance(typ, VmaType):
+        typ1 = None
+    else:
+        _eat_excessive(p, stop_at_comma=True)
+        return default_arg(target, typ)
+    p.parse("&")
+    addr, vma_size = _parse_addr(target, p)
+    inner = None
+    if not p.eof() and p.char() == "=":
+        p.parse("=")
+        if p.char() == "A":
+            p.parse("A")
+            p.parse("N")
+            p.parse("Y")
+            p.parse("=")
+            typ = make_any_ptr_type(target, typ.size(), typ.field_name)
+            typ1 = get_any(target).array
+        inner = _parse_arg(target, typ1, p, vars_)
+    if typ1 is None:
+        return PointerArg.make_vma(typ, addr, vma_size)
+    if inner is None:
+        inner = default_arg(target, typ1)
+    return PointerArg(typ, addr, inner)
+
+
+def _parse_addr(target, p: _Parser) -> tuple[int, int]:
+    p.parse("(")
+    addr = int(p.ident(), 0)
+    if addr < ENCODING_ADDR_BASE:
+        raise ParseError(f"address without base offset: {addr:#x}")
+    addr -= ENCODING_ADDR_BASE
+    if not p.eof() and p.char() in "+-":
+        minus = p.char() == "-"
+        p.parse(p.char())
+        off = int(p.ident(), 0)
+        addr = addr - off if minus else addr + off
+    max_mem = target.num_pages * target.page_size
+    vma_size = 0
+    if not p.eof() and p.char() == "/":
+        p.parse("/")
+        size = int(p.ident(), 0)
+        addr &= ~(target.page_size - 1)
+        vma_size = (size + target.page_size - 1) & ~(target.page_size - 1)
+        if vma_size == 0:
+            vma_size = target.page_size
+        if vma_size > max_mem:
+            vma_size = max_mem
+        if addr > max_mem - vma_size:
+            addr = max_mem - vma_size
+    p.parse(")")
+    return addr, vma_size
+
+
+def _parse_arg_string(target, typ, p: _Parser):
+    if not isinstance(typ, BufferType):
+        _eat_excessive(p, stop_at_comma=True)
+        return default_arg(target, typ)
+    data = _deserialize_data(p)
+    size = None
+    if not p.eof() and p.char() == "/":
+        p.parse("/")
+        size = int(p.ident(), 0)
+    if not typ.varlen:
+        size = typ.size()
+    elif size is None:
+        size = len(data)
+    if typ.dir == Dir.OUT:
+        return DataArg(typ, out_size=size)
+    if size > len(data):
+        data = data + bytes(size - len(data))
+    return DataArg(typ, data[:size])
+
+
+def _parse_arg_struct(target, typ, p: _Parser, vars_):
+    p.parse("{")
+    if not isinstance(typ, StructType):
+        _eat_excessive(p, stop_at_comma=False)
+        p.parse("}")
+        return default_arg(target, typ)
+    inner: list[Arg] = []
+    i = 0
+    while p.char() != "}":
+        if i >= len(typ.fields):
+            _eat_excessive(p, stop_at_comma=False)
+            break
+        fld = typ.fields[i]
+        if is_pad(fld):
+            inner.append(ConstArg(fld, 0))
+        else:
+            arg = _parse_arg(target, fld, p, vars_)
+            inner.append(arg)
+            if p.char() != "}":
+                p.parse(",")
+        i += 1
+    p.parse("}")
+    while len(inner) < len(typ.fields):
+        inner.append(default_arg(target, typ.fields[len(inner)]))
+    return GroupArg(typ, inner)
+
+
+def _parse_arg_array(target, typ, p: _Parser, vars_):
+    p.parse("[")
+    if not isinstance(typ, ArrayType):
+        _eat_excessive(p, stop_at_comma=False)
+        p.parse("]")
+        return default_arg(target, typ)
+    inner: list[Arg] = []
+    while p.char() != "]":
+        inner.append(_parse_arg(target, typ.elem, p, vars_))
+        if p.char() != "]":
+            p.parse(",")
+    p.parse("]")
+    if typ.kind == ArrayKind.RANGE_LEN and typ.range_begin == typ.range_end:
+        while len(inner) < typ.range_begin:
+            inner.append(default_arg(target, typ.elem))
+        del inner[typ.range_begin:]
+    return GroupArg(typ, inner)
+
+
+def _parse_arg_union(target, typ, p: _Parser, vars_):
+    if not isinstance(typ, UnionType):
+        _eat_excessive(p, stop_at_comma=True)
+        return default_arg(target, typ)
+    p.parse("@")
+    name = p.ident()
+    opt_type = next((t2 for t2 in typ.fields if t2.field_name == name), None)
+    if opt_type is None:
+        _eat_excessive(p, stop_at_comma=True)
+        return default_arg(target, typ)
+    if not p.eof() and p.char() == "=":
+        p.parse("=")
+        opt = _parse_arg(target, opt_type, p, vars_)
+    else:
+        opt = default_arg(target, opt_type)
+    return UnionArg(typ, opt)
+
+
+def _eat_excessive(p: _Parser, stop_at_comma: bool) -> None:
+    """Eat excess args/fields to recover after description changes
+    (reference: prog/encoding.go:507-548)."""
+    paren = brack = brace = 0
+    while not p.eof():
+        ch = p.char()
+        if ch == "(":
+            paren += 1
+        elif ch == ")":
+            if paren == 0:
+                return
+            paren -= 1
+        elif ch == "[":
+            brack += 1
+        elif ch == "]":
+            if brack == 0:
+                return
+            brack -= 1
+        elif ch == "{":
+            brace += 1
+        elif ch == "}":
+            if brace == 0:
+                return
+            brace -= 1
+        elif ch == ",":
+            if stop_at_comma and paren == 0 and brack == 0 and brace == 0:
+                return
+        elif ch in "'\"":
+            p.parse(ch)
+            while not p.eof() and p.char() != ch:
+                p.parse(p.char())
+            if p.eof():
+                return
+        p.parse(ch)
+
+
+def _deserialize_data(p: _Parser) -> bytes:
+    data = bytearray()
+    if p.char() == '"':
+        p.parse('"')
+        val = ""
+        if p.char() != '"':
+            val = p.ident()
+        p.parse('"')
+        try:
+            data = bytearray(binascii.unhexlify(val))
+        except binascii.Error:
+            raise ParseError(f"data arg has bad value {val!r}")
+    else:
+        if p.consume() != "'":
+            raise ParseError("data arg does not start with \" nor with '")
+        unescape = {"a": 7, "b": 8, "f": 12, "n": 10, "r": 13, "t": 9,
+                    "v": 11, "'": 0x27, "\\": 0x5C}
+        while not p.eof() and p.char() != "'":
+            v = p.consume()
+            if v != "\\":
+                data.append(ord(v))
+                continue
+            v = p.consume()
+            if v == "x":
+                hi = p.consume()
+                lo = p.consume()
+                if lo != "0" or hi != "0":
+                    raise ParseError(
+                        f"invalid \\x{hi}{lo} escape sequence in data arg")
+                data.append(0)
+            elif v in unescape:
+                data.append(unescape[v])
+            else:
+                raise ParseError(f"invalid \\{v} escape sequence in data arg")
+        p.parse("'")
+    return bytes(data)
+
+
+def call_set(data: bytes) -> set[str]:
+    """Conservative call-name extraction from any serialization
+    (reference: prog/encoding.go:836-869)."""
+    calls: set[str] = set()
+    for ln in data.decode("latin-1", errors="replace").splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        bracket = ln.find("(")
+        if bracket == -1:
+            raise ParseError("line does not contain opening bracket")
+        call = ln[:bracket]
+        if "=" in call:
+            call = call.split("=", 1)[1].strip()
+        call = call.strip()
+        if not call:
+            raise ParseError("call name is empty")
+        calls.add(call)
+    if not calls:
+        raise ParseError("program does not contain any calls")
+    return calls

@@ -1,0 +1,60 @@
+"""Helper API handed to target-specific special-type generators
+(reference: prog/target.go:155-210)."""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.prog import Arg, Call
+from syzkaller_tpu.models.size import assign_sizes_array
+from syzkaller_tpu.models.types import Type
+
+
+class Gen:
+    def __init__(self, rng, state):
+        self.rng = rng
+        self.state = state
+
+    @property
+    def target(self):
+        return self.rng.target
+
+    def n_out_of(self, n: int, out_of: int) -> bool:
+        return self.rng.n_out_of(n, out_of)
+
+    def alloc(self, ptr_type: Type, data: Arg) -> tuple[Arg, list[Call]]:
+        from syzkaller_tpu.models.generation import alloc_addr
+
+        return alloc_addr(self.rng, self.state, ptr_type, data.size(), data), []
+
+    def generate_arg(self, typ: Type, pcalls: list[Call]) -> Arg:
+        return self._generate_arg(typ, pcalls, ignore_special=False)
+
+    def generate_special_arg(self, typ: Type, pcalls: list[Call]) -> Arg:
+        return self._generate_arg(typ, pcalls, ignore_special=True)
+
+    def _generate_arg(self, typ: Type, pcalls: list[Call], ignore_special: bool) -> Arg:
+        from syzkaller_tpu.models.generation import generate_arg_impl
+
+        arg, calls = generate_arg_impl(self.rng, self.state, typ, ignore_special)
+        pcalls.extend(calls)
+        assign_sizes_array([arg])
+        return arg
+
+    def mutate_arg(self, arg0: Arg) -> list[Call]:
+        """(reference: prog/target.go:191-210)"""
+        from syzkaller_tpu.models.mutation import MutationArgs, mutate_arg
+        from syzkaller_tpu.models.prog import foreach_sub_arg
+
+        calls: list[Call] = []
+        update_sizes = [True]
+        while True:
+            ma = MutationArgs(self.target, ignore_special=True)
+            foreach_sub_arg(arg0, ma.collect)
+            if not ma.args:
+                return calls
+            idx = self.rng.intn(len(ma.args))
+            arg, ctx = ma.args[idx], ma.ctxes[idx]
+            new_calls, ok = mutate_arg(self.rng, self.state, arg, ctx, update_sizes)
+            if ok:
+                calls.extend(new_calls)
+            if self.rng.one_of(3):
+                return calls

@@ -1,0 +1,322 @@
+"""Random program generation.
+
+Tree-recursive generation stays on the CPU by design: it is ~1/100 of
+the fuzz loop (the TPU engine owns high-volume mutation of existing
+corpus programs).  Semantics follow the reference generator
+(reference: prog/generation.go:12-31, prog/rand.go:389-681).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from syzkaller_tpu.models.analysis import State
+from syzkaller_tpu.models.prog import (
+    Arg,
+    Call,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    UnionArg,
+    foreach_arg,
+    make_return_arg,
+)
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.models.size import assign_sizes_call
+from syzkaller_tpu.models.types import (
+    ArrayKind,
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntKind,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    Syscall,
+    Type,
+    UnionType,
+    VmaType,
+)
+
+
+def generate_prog(target, rng: RandGen, ncalls: int, ct=None) -> Prog:
+    """Generate a random program of length ~ncalls
+    (reference: prog/generation.go:12-31)."""
+    p = Prog(target=target)
+    s = State(target, ct)
+    while len(p.calls) < ncalls:
+        calls = generate_call(rng, s, p)
+        for c in calls:
+            s.analyze(c)
+            p.calls.append(c)
+    return p
+
+
+def generate_call(rng: RandGen, s: State, p: Prog) -> list[Call]:
+    """Sample the next call from the choice table, biased by the calls
+    already present (reference: prog/rand.go:389-402)."""
+    target = rng.target
+    if s.ct is None:
+        idx = rng.intn(len(target.syscalls))
+    else:
+        call = -1
+        if p.calls:
+            call = p.calls[rng.intn(len(p.calls))].meta.id
+        idx = s.ct.choose(rng, call)
+    return generate_particular_call(rng, s, target.syscalls[idx])
+
+
+def generate_particular_call(rng: RandGen, s: State, meta: Syscall) -> list[Call]:
+    """(reference: prog/rand.go:404-416)"""
+    c = Call(meta=meta, ret=make_return_arg(meta.ret))
+    c.args, calls = generate_args(rng, s, meta.args)
+    assign_sizes_call(c)
+    calls.append(c)
+    for c1 in calls:
+        rng.target.sanitize_call(c1)
+    return calls
+
+
+def generate_args(rng: RandGen, s: State, types: list[Type]) -> tuple[list[Arg], list[Call]]:
+    calls: list[Call] = []
+    args: list[Arg] = []
+    for typ in types:
+        arg, calls1 = generate_arg(rng, s, typ)
+        assert arg is not None, f"generated arg is nil for type {typ.name}"
+        args.append(arg)
+        calls.extend(calls1)
+    return args, calls
+
+
+def generate_arg(rng: RandGen, s: State, typ: Type) -> tuple[Arg, list[Call]]:
+    return generate_arg_impl(rng, s, typ, ignore_special=False)
+
+
+def generate_arg_impl(rng: RandGen, s: State, typ: Type,
+                      ignore_special: bool) -> tuple[Arg, list[Call]]:
+    """(reference: prog/rand.go:480-525)"""
+    target = rng.target
+    if typ.dir == Dir.OUT:
+        # Output scalars need no interesting value, but must exist so
+        # later calls can reference them.
+        if isinstance(typ, (IntType, FlagsType, ConstType, ProcType,
+                            VmaType, ResourceType)):
+            return target.default_arg(typ), []
+
+    if typ.optional and rng.one_of(5):
+        return target.default_arg(typ), []
+
+    # Bound recursion for optional pointers to structured types.
+    if isinstance(typ, PtrType) and typ.optional and \
+            isinstance(typ.elem, (StructType, ArrayType, UnionType)):
+        name = typ.elem.name
+        rng.rec_depth[name] = rng.rec_depth.get(name, 0) + 1
+        try:
+            if rng.rec_depth[name] >= 3:
+                return PointerArg.make_null(typ), []
+            return _generate_by_type(rng, s, typ, ignore_special)
+        finally:
+            rng.rec_depth[name] -= 1
+            if rng.rec_depth[name] == 0:
+                del rng.rec_depth[name]
+
+    if not ignore_special and typ.dir != Dir.OUT:
+        if isinstance(typ, (StructType, UnionType)):
+            gen = target.special_types.get(typ.name)
+            if gen is not None:
+                from syzkaller_tpu.models.gen_api import Gen
+
+                return gen(Gen(rng, s), typ, None)
+
+    return _generate_by_type(rng, s, typ, ignore_special)
+
+
+def _generate_by_type(rng: RandGen, s: State, typ: Type,
+                      ignore_special: bool) -> tuple[Arg, list[Call]]:
+    """Per-type generation (reference: prog/rand.go:527-681)."""
+    target = rng.target
+
+    if isinstance(typ, ResourceType):
+        if rng.n_out_of(1000, 1011):
+            # Reuse an existing resource.
+            allres: list[ResultArg] = []
+            for name1, res1 in sorted(s.resources.items()):
+                assert typ.desc is not None
+                if target.is_compatible_resource(typ.desc.name, name1) or \
+                        (rng.one_of(20) and
+                         target.is_compatible_resource(typ.desc.kind[0], name1)):
+                    allres.extend(res1)
+            if allres:
+                return ResultArg(typ, allres[rng.intn(len(allres))], 0), []
+            return create_resource(rng, s, typ)
+        if rng.n_out_of(10, 11):
+            return create_resource(rng, s, typ)
+        special = typ.special_values()
+        return ResultArg(typ, None, special[rng.intn(len(special))]), []
+
+    if isinstance(typ, BufferType):
+        return _generate_buffer(rng, s, typ), []
+
+    if isinstance(typ, VmaType):
+        npages = rng.rand_page_count()
+        if typ.range_begin != 0 or typ.range_end != 0:
+            npages = typ.range_begin + rng.intn(typ.range_end - typ.range_begin + 1)
+        page = s.va.alloc(rng, npages)
+        return PointerArg.make_vma(typ, page * target.page_size,
+                                   npages * target.page_size), []
+
+    if isinstance(typ, FlagsType):
+        return ConstArg(typ, rng.flags(typ.vals)), []
+
+    if isinstance(typ, ConstType):
+        return ConstArg(typ, typ.val), []
+
+    if isinstance(typ, IntType):
+        v = rng.rand_int()
+        if typ.kind == IntKind.FILEOFF:
+            if rng.n_out_of(90, 101):
+                v = 0
+            elif rng.n_out_of(10, 11):
+                v = rng.rand(100)
+            else:
+                v = rng.rand_int()
+        elif typ.kind == IntKind.RANGE:
+            v = rng.rand_range_int(typ.range_begin, typ.range_end)
+        return ConstArg(typ, v), []
+
+    if isinstance(typ, ProcType):
+        return ConstArg(typ, rng.rand(typ.values_per_proc)), []
+
+    if isinstance(typ, ArrayType):
+        assert typ.elem is not None
+        if typ.kind == ArrayKind.RAND_LEN:
+            count = rng.rand_array_len()
+        else:
+            count = rng.rand_range(typ.range_begin, typ.range_end)
+        inner: list[Arg] = []
+        calls: list[Call] = []
+        for _ in range(count):
+            arg1, calls1 = generate_arg(rng, s, typ.elem)
+            inner.append(arg1)
+            calls.extend(calls1)
+        return GroupArg(typ, inner), calls
+
+    if isinstance(typ, StructType):
+        args, calls = generate_args(rng, s, typ.fields)
+        return GroupArg(typ, args), calls
+
+    if isinstance(typ, UnionType):
+        opt_type = typ.fields[rng.intn(len(typ.fields))]
+        opt, calls = generate_arg(rng, s, opt_type)
+        return UnionArg(typ, opt), calls
+
+    if isinstance(typ, PtrType):
+        assert typ.elem is not None
+        inner, calls = generate_arg(rng, s, typ.elem)
+        return alloc_addr(rng, s, typ, inner.size(), inner), calls
+
+    if isinstance(typ, LenType):
+        return ConstArg(typ, 0), []  # filled by assign_sizes_call
+
+    if isinstance(typ, CsumType):
+        return ConstArg(typ, 0), []  # computed by the executor
+
+    raise TypeError(f"unknown type {typ}")
+
+
+def _generate_buffer(rng: RandGen, s: State, typ: BufferType) -> Arg:
+    """(reference: prog/rand.go:553-598)"""
+    if typ.kind in (BufferKind.BLOB_RAND, BufferKind.BLOB_RANGE):
+        sz = rng.rand_buf_len()
+        if typ.kind == BufferKind.BLOB_RANGE:
+            sz = rng.rand_range(typ.range_begin, typ.range_end)
+        if typ.dir == Dir.OUT:
+            return DataArg(typ, out_size=sz)
+        return DataArg(typ, bytes(rng.intn(256) for _ in range(sz)))
+    if typ.kind == BufferKind.STRING:
+        data = rng.rand_string(s, typ)
+        if typ.dir == Dir.OUT:
+            return DataArg(typ, out_size=len(data))
+        return DataArg(typ, data)
+    if typ.kind == BufferKind.FILENAME:
+        if typ.dir == Dir.OUT:
+            if not typ.varlen:
+                sz = typ.size()
+            elif rng.n_out_of(1, 3):
+                sz = rng.rand(100)
+            elif rng.n_out_of(1, 2):
+                sz = 108  # UNIX_PATH_MAX
+            else:
+                sz = 4096  # PATH_MAX
+            return DataArg(typ, out_size=sz)
+        return DataArg(typ, rng.filename(s, typ).encode("latin-1"))
+    if typ.kind == BufferKind.TEXT:
+        if typ.dir == Dir.OUT:
+            return DataArg(typ, out_size=rng.intn(100))
+        return DataArg(typ, rng.generate_text(typ.text))
+    raise TypeError(f"unknown buffer kind {typ.kind}")
+
+
+def alloc_addr(rng: RandGen, s: State, typ: Type, size: int, data: Arg) -> PointerArg:
+    return PointerArg(typ, s.ma.alloc(rng, size), data)
+
+
+def alloc_vma(rng: RandGen, s: State, typ: Type, num_pages: int) -> PointerArg:
+    page = s.va.alloc(rng, num_pages)
+    return PointerArg.make_vma(typ, page * rng.target.page_size,
+                               num_pages * rng.target.page_size)
+
+
+def create_resource(rng: RandGen, s: State, res: ResourceType) -> tuple[Arg, list[Call]]:
+    """Recursively generate a constructor call producing the resource
+    (reference: prog/rand.go:248-321)."""
+    target = rng.target
+    assert res.desc is not None
+    if rng.in_create_resource:
+        special = res.special_values()
+        return ResultArg(res, None, special[rng.intn(len(special))]), []
+    rng.in_create_resource = True
+    try:
+        kind = res.desc.name
+        if rng.one_of(1000):
+            # Spoof resource subkind.
+            alls = [k for k in sorted(target.resource_map)
+                    if target.is_compatible_resource(res.desc.kind[0], k)]
+            if alls:
+                kind = alls[rng.intn(len(alls))]
+        metas = [m for m in target.resource_ctors.get(kind, [])
+                 if s.ct is None or s.ct.enabled_by_id(m.id)]
+        if not metas:
+            return ResultArg(res, None, res.default()), []
+        for _ in range(1000):
+            meta = metas[rng.intn(len(metas))]
+            calls = generate_particular_call(rng, s, meta)
+            s1 = State(target, s.ct)
+            s1.analyze(calls[-1])
+            allres: list[ResultArg] = []
+            for kind1, res1 in sorted(s1.resources.items()):
+                if target.is_compatible_resource(kind, kind1):
+                    allres.extend(res1)
+            if allres:
+                return ResultArg(res, allres[rng.intn(len(allres))], 0), calls
+            # Unsuccessful: unlink and retry.
+            for c in calls:
+                def unlink(arg, ctx):
+                    if isinstance(arg, ResultArg) and arg.res is not None:
+                        arg.res.uses.discard(arg)
+                foreach_arg(c, unlink)
+        raise RuntimeError(
+            f"failed to create a resource {res.desc.kind[0]} with "
+            f"{[m.name for m in metas]}")
+    finally:
+        rng.in_create_resource = False

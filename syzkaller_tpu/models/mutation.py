@@ -1,0 +1,469 @@
+"""Program mutation: the CPU semantics engine for hot loop #1.
+
+A weighted loop of five ops — squash-to-blob, corpus splice, call
+insertion, arg mutation, call removal — with the byte-level mutate_data
+engine underneath (reference: prog/mutation.go:14-521).  The batched
+TPU implementation of the same distributions lives in ops/mutate.py and
+is parity-tested against this module.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from syzkaller_tpu.models.analysis import analyze
+from syzkaller_tpu.models.prog import (
+    Arg,
+    ArgCtx,
+    Call,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    UnionArg,
+    foreach_arg,
+    foreach_sub_arg,
+    replace_arg,
+    remove_arg,
+)
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.models.generation import (
+    alloc_addr,
+    create_resource,
+    generate_arg,
+    generate_call,
+)
+from syzkaller_tpu.models.size import assign_sizes_call, mutate_size
+from syzkaller_tpu.models.types import (
+    ArrayKind,
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    TextKind,
+    UnionType,
+    VmaType,
+)
+from syzkaller_tpu.utils.ints import MASK64, load_int, store_int, swap_int
+
+MAX_BLOB_LEN = 100 << 10
+
+
+def mutate_prog(p: Prog, rng: RandGen, ncalls: int, ct=None,
+                corpus: Optional[list[Prog]] = None) -> None:
+    """(reference: prog/mutation.go:14-142)"""
+    corpus = corpus or []
+    target = p.target
+    stop = False
+    retry = False
+    while not stop or retry:
+        retry = False
+        if rng.one_of(5):
+            # Squash complex pointee into an ANY blob and mutate raw bytes.
+            from syzkaller_tpu.models.any_squash import complex_ptrs, squash_ptr, is_any_ptr
+
+            ptrs = complex_ptrs(p)
+            if not ptrs:
+                retry = True
+                continue
+            ptr = ptrs[rng.intn(len(ptrs))]
+            if not is_any_ptr(target, ptr.typ):
+                squash_ptr(target, p, ptr, preserve_field=True)
+            blobs: list[DataArg] = []
+            bases: list[PointerArg] = []
+
+            def collect(arg, ctx) -> None:
+                if isinstance(arg, DataArg) and arg.typ.dir != Dir.OUT:
+                    blobs.append(arg)
+                    bases.append(ctx.base)
+
+            foreach_sub_arg(ptr, collect)
+            if not blobs:
+                retry = True
+                continue
+            idx = rng.intn(len(blobs))
+            arg, base = blobs[idx], bases[idx]
+            base_size = base.res.size()
+            arg.data = bytearray(mutate_data(rng, arg.data, 0, MAX_BLOB_LEN))
+            # Update base pointer if the object grew.
+            if base_size < base.res.size():
+                s = analyze(ct, p, p.calls[0])
+                new_arg = alloc_addr(rng, s, base.typ, base.res.size(), base.res)
+                base.address = new_arg.address
+        elif rng.n_out_of(1, 100):
+            # Splice with a random corpus program.
+            if not corpus or not p.calls:
+                retry = True
+                continue
+            p0 = corpus[rng.intn(len(corpus))]
+            p0c = p0.clone()
+            idx = rng.intn(len(p.calls))
+            p.calls = p.calls[:idx] + p0c.calls + p.calls[idx:]
+            for i in range(len(p.calls) - 1, ncalls - 1, -1):
+                p.remove_call(i)
+        elif rng.n_out_of(20, 31):
+            # Insert a new call.
+            if len(p.calls) >= ncalls:
+                retry = True
+                continue
+            idx = rng.biased_rand(len(p.calls) + 1, 5)
+            c = p.calls[idx] if idx < len(p.calls) else None
+            s = analyze(ct, p, c)
+            calls = generate_call(rng, s, p)
+            p.insert_before(c, calls)
+        elif rng.n_out_of(10, 11):
+            # Mutate args of a random call.
+            if not p.calls:
+                retry = True
+                continue
+            c = p.calls[rng.intn(len(p.calls))]
+            if not c.args:
+                retry = True
+                continue
+            s = analyze(ct, p, c)
+            update_sizes = [True]
+            stop_arg = False
+            retry_arg = False
+            bailed = False
+            while not stop_arg or retry_arg:
+                retry_arg = False
+                ma = MutationArgs(target)
+                foreach_arg(c, ma.collect)
+                if not ma.args:
+                    retry = True
+                    bailed = True
+                    break
+                idx = rng.intn(len(ma.args))
+                arg, ctx = ma.args[idx], ma.ctxes[idx]
+                calls, ok = mutate_arg(rng, s, arg, ctx, update_sizes)
+                if not ok:
+                    retry_arg = True
+                    continue
+                p.insert_before(c, calls)
+                if update_sizes[0]:
+                    assign_sizes_call(c)
+                target.sanitize_call(c)
+                stop_arg = rng.one_of(3)
+            if bailed:
+                continue
+        else:
+            # Remove a random call.
+            if not p.calls:
+                retry = True
+                continue
+            p.remove_call(rng.intn(len(p.calls)))
+        stop = rng.one_of(3)
+
+    for c in p.calls:
+        target.sanitize_call(c)
+
+
+class MutationArgs:
+    """Collects mutable args of a call (reference: prog/mutation.go:345-392)."""
+
+    def __init__(self, target, ignore_special: bool = False):
+        self.target = target
+        self.args: list[Arg] = []
+        self.ctxes: list[ArgCtx] = []
+        self.ignore_special = ignore_special
+
+    def collect(self, arg: Arg, ctx: ArgCtx) -> None:
+        ignore_special = self.ignore_special
+        self.ignore_special = False
+        typ = arg.typ
+        if isinstance(typ, StructType):
+            if self.target.special_types.get(typ.name) is None or ignore_special:
+                return  # for plain structs only individual fields are mutated
+            ctx.stop = True
+        elif isinstance(typ, UnionType):
+            if (self.target.special_types.get(typ.name) is None
+                    and len(typ.fields) == 1) or ignore_special:
+                return
+            ctx.stop = True
+        elif isinstance(typ, ArrayType):
+            # Don't mutate fixed-size arrays.
+            if typ.kind == ArrayKind.RANGE_LEN and typ.range_begin == typ.range_end:
+                return
+        elif isinstance(typ, CsumType):
+            return  # updated when the checksummed data changes
+        elif isinstance(typ, ConstType):
+            return
+        elif isinstance(typ, BufferType):
+            if typ.kind == BufferKind.STRING and len(typ.values) == 1:
+                return  # string const
+        elif isinstance(typ, PtrType):
+            if isinstance(arg, PointerArg) and arg.is_null():
+                return
+        if typ is None or typ.dir == Dir.OUT or (not typ.varlen and typ.size() == 0):
+            return
+        self.args.append(arg)
+        self.ctxes.append(ctx)
+
+
+def mutate_arg(rng: RandGen, s, arg: Arg, ctx: ArgCtx,
+               update_sizes: list[bool]) -> tuple[list[Call], bool]:
+    """(reference: prog/mutation.go:144-165)"""
+    target = rng.target
+    base_size = ctx.base.res.size() if ctx.base is not None else 0
+    calls, retry, preserve = _mutate_by_type(rng, s, arg, ctx)
+    if retry:
+        return [], False
+    if preserve:
+        update_sizes[0] = False
+    if ctx.base is not None and base_size < ctx.base.res.size():
+        new_arg = alloc_addr(rng, s, ctx.base.typ, ctx.base.res.size(), ctx.base.res)
+        replace_arg(ctx.base, new_arg)
+    for c in calls:
+        target.sanitize_call(c)
+    return calls, True
+
+
+def _regenerate(rng: RandGen, s, arg: Arg) -> tuple[list[Call], bool, bool]:
+    new_arg, calls = generate_arg(rng, s, arg.typ)
+    replace_arg(arg, new_arg)
+    return calls, False, False
+
+
+def _mutate_int_value(rng: RandGen, s, arg: Arg) -> tuple[list[Call], bool, bool]:
+    """(reference: prog/mutation.go:174-188)"""
+    if rng.bin():
+        return _regenerate(rng, s, arg)
+    assert isinstance(arg, ConstArg)
+    if rng.n_out_of(1, 3):
+        arg.val = (arg.val + rng.intn(4) + 1) & MASK64
+    elif rng.n_out_of(1, 2):
+        arg.val = (arg.val - rng.intn(4) - 1) & MASK64
+    else:
+        arg.val ^= 1 << rng.intn(64)
+    return [], False, False
+
+
+def _mutate_by_type(rng: RandGen, s, arg: Arg, ctx: ArgCtx) -> tuple[list[Call], bool, bool]:
+    """Per-type mutators (reference: prog/mutation.go:190-343).
+    Returns (new_calls, retry, preserve)."""
+    typ = arg.typ
+    target = rng.target
+
+    if isinstance(typ, (IntType, FlagsType)):
+        return _mutate_int_value(rng, s, arg)
+
+    if isinstance(typ, LenType):
+        assert ctx.parent is not None
+        if not mutate_size(rng, arg, ctx.parent):
+            return [], True, False
+        return [], False, True  # preserve: don't reassign sizes
+
+    if isinstance(typ, (ResourceType, VmaType, ProcType)):
+        return _regenerate(rng, s, arg)
+
+    if isinstance(typ, BufferType):
+        assert isinstance(arg, DataArg)
+        if typ.kind in (BufferKind.BLOB_RAND, BufferKind.BLOB_RANGE):
+            min_len, max_len = 0, MAX_BLOB_LEN
+            if typ.kind == BufferKind.BLOB_RANGE:
+                min_len, max_len = typ.range_begin, typ.range_end
+            arg.data = bytearray(mutate_data(rng, bytearray(arg.data), min_len, max_len))
+        elif typ.kind == BufferKind.STRING:
+            if rng.bin():
+                min_len, max_len = 0, MAX_BLOB_LEN
+                if typ.type_size != 0:
+                    min_len = max_len = typ.type_size
+                arg.data = bytearray(mutate_data(rng, bytearray(arg.data), min_len, max_len))
+            else:
+                arg.data = bytearray(rng.rand_string(s, typ))
+        elif typ.kind == BufferKind.FILENAME:
+            arg.data = bytearray(rng.filename(s, typ).encode("latin-1"))
+        elif typ.kind == BufferKind.TEXT:
+            arg.data = bytearray(rng.mutate_text(typ.text, bytes(arg.data)))
+        else:
+            raise TypeError(f"unknown buffer kind {typ.kind}")
+        return [], False, False
+
+    if isinstance(typ, ArrayType):
+        assert isinstance(arg, GroupArg) and typ.elem is not None
+        count = len(arg.inner)
+        if typ.kind == ArrayKind.RAND_LEN:
+            while count == len(arg.inner):
+                count = rng.rand_array_len()
+        else:
+            assert typ.range_begin != typ.range_end, "mutating fixed-length array"
+            while count == len(arg.inner):
+                count = rng.rand_range(typ.range_begin, typ.range_end)
+        calls: list[Call] = []
+        if count > len(arg.inner):
+            while count > len(arg.inner):
+                new_arg, new_calls = generate_arg(rng, s, typ.elem)
+                arg.inner.append(new_arg)
+                calls.extend(new_calls)
+                for c in new_calls:
+                    s.analyze(c)
+        else:
+            for extra in arg.inner[count:]:
+                remove_arg(extra)
+            del arg.inner[count:]
+        return calls, False, False
+
+    if isinstance(typ, PtrType):
+        assert isinstance(arg, PointerArg)
+        new_arg = alloc_addr(rng, s, typ, arg.res.size(), arg.res)
+        replace_arg(arg, new_arg)
+        return [], False, False
+
+    if isinstance(typ, StructType):
+        gen = target.special_types.get(typ.name)
+        assert gen is not None, "plain struct returned by MutationArgs"
+        from syzkaller_tpu.models.gen_api import Gen
+
+        new_arg, calls = gen(Gen(rng, s), typ, arg)
+        assert isinstance(arg, GroupArg) and isinstance(new_arg, GroupArg)
+        for old, new in zip(arg.inner, new_arg.inner):
+            replace_arg(old, new)
+        return calls, False, False
+
+    if isinstance(typ, UnionType):
+        gen = target.special_types.get(typ.name)
+        if gen is not None:
+            from syzkaller_tpu.models.gen_api import Gen
+
+            new_arg, calls = gen(Gen(rng, s), typ, arg)
+            replace_arg(arg, new_arg)
+            return calls, False, False
+        assert isinstance(arg, UnionArg)
+        current = -1
+        for i, option in enumerate(typ.fields):
+            if arg.option.typ.field_name == option.field_name:
+                current = i
+                break
+        assert current >= 0, "can't find current option in union"
+        new_idx = rng.intn(len(typ.fields) - 1)
+        if new_idx >= current:
+            new_idx += 1
+        opt_type = typ.fields[new_idx]
+        remove_arg(arg.option)
+        new_opt, calls = generate_arg(rng, s, opt_type)
+        replace_arg(arg, UnionArg(typ, new_opt))
+        return calls, False, False
+
+    raise TypeError(f"type {typ} can't be mutated")
+
+
+# -- byte-level data mutation -------------------------------------------
+
+MAX_INC = 35
+
+
+def mutate_data(rng: RandGen, data: bytearray, min_len: int, max_len: int) -> bytearray:
+    """Repeatedly apply one of 7 byte-level ops until a successful op
+    lands and a 1/3 coin says stop (reference: prog/mutation.go:394-400)."""
+    stop = False
+    while not stop:
+        f = _MUTATE_DATA_FUNCS[rng.intn(len(_MUTATE_DATA_FUNCS))]
+        data, ok = f(rng, data, min_len, max_len)
+        stop = ok and rng.one_of(3)
+    return data
+
+
+def _md_flip_bit(rng, data, min_len, max_len):
+    if not data:
+        return data, False
+    byt = rng.intn(len(data))
+    bit = rng.intn(8)
+    data[byt] ^= 1 << bit
+    return data, True
+
+
+def _md_insert_bytes(rng, data, min_len, max_len):
+    if not data or len(data) >= max_len:
+        return data, False
+    n = min(rng.intn(16) + 1, max_len - len(data))
+    pos = rng.intn(len(data))
+    new = bytes(rng.int31() & 0xFF for _ in range(n))
+    orig_len = len(data)
+    data[pos:pos] = new
+    if rng.bin():
+        del data[orig_len:]  # preserve original length
+    return data, True
+
+
+def _md_remove_bytes(rng, data, min_len, max_len):
+    if len(data) <= min_len:
+        return data, False
+    n = min(rng.intn(16) + 1, len(data))
+    pos = 0
+    if n < len(data):
+        pos = rng.intn(len(data) - n)
+    del data[pos:pos + n]
+    if rng.bin():
+        data.extend(bytes(n))  # preserve original length
+    return data, True
+
+
+def _md_append_bytes(rng, data, min_len, max_len):
+    if len(data) >= max_len:
+        return data, False
+    max_append = 256
+    n = min(max_append - rng.biased_rand(max_append, 10), max_len - len(data))
+    data.extend(rng.rand(256) for _ in range(n))
+    return data, True
+
+
+def _md_replace_int(rng, data, min_len, max_len):
+    width = 1 << rng.intn(4)
+    if len(data) < width:
+        return data, False
+    i = rng.intn(len(data) - width + 1)
+    store_int(data, i, rng.uint64(), width)
+    return data, True
+
+
+def _md_add_sub_int(rng, data, min_len, max_len):
+    width = 1 << rng.intn(4)
+    if len(data) < width:
+        return data, False
+    i = rng.intn(len(data) - width + 1)
+    v = load_int(data, i, width)
+    delta = rng.rand(2 * MAX_INC + 1) - MAX_INC
+    if delta == 0:
+        delta = 1
+    if rng.one_of(10):
+        v = swap_int(v, width)
+        v = (v + delta) & MASK64
+        v = swap_int(v, width)
+    else:
+        v = (v + delta) & MASK64
+    store_int(data, i, v, width)
+    return data, True
+
+
+def _md_interesting_int(rng, data, min_len, max_len):
+    width = 1 << rng.intn(4)
+    if len(data) < width:
+        return data, False
+    i = rng.intn(len(data) - width + 1)
+    value = rng.rand_int()
+    if rng.one_of(10):
+        value = swap_int(value, 8)
+    store_int(data, i, value, width)
+    return data, True
+
+
+_MUTATE_DATA_FUNCS = (
+    _md_flip_bit,
+    _md_insert_bytes,
+    _md_remove_bytes,
+    _md_append_bytes,
+    _md_replace_int,
+    _md_add_sub_int,
+    _md_interesting_int,
+)

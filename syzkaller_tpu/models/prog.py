@@ -1,0 +1,510 @@
+"""Program representation: Arg graph, Call, Prog.
+
+Mirrors the reference data model (reference: prog/prog.go:10-503).
+Six concrete arg kinds; ResultArg carries the cross-call dataflow graph
+(res/uses edges) that drives both mutation legality and exec-format
+copyout indices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+from syzkaller_tpu.models.types import (
+    ArrayKind,
+    ArrayType,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    Syscall,
+    Type,
+    UnionType,
+    VmaType,
+    is_pad,
+)
+
+MASK64 = (1 << 64) - 1
+
+
+class Arg:
+    """Base class of argument values."""
+
+    __slots__ = ("typ",)
+
+    def __init__(self, typ: Type):
+        self.typ = typ
+
+    def size(self) -> int:
+        return self.typ.size()
+
+
+class ConstArg(Arg):
+    """Value of ConstType, IntType, FlagsType, LenType, ProcType, CsumType
+    (reference: prog/prog.go:36-92)."""
+
+    __slots__ = ("val",)
+
+    def __init__(self, typ: Type, val: int):
+        super().__init__(typ)
+        self.val = val & MASK64
+
+    def value(self) -> tuple[int, int, bool]:
+        """Returns (value, pid_stride, big_endian) for exec encoding."""
+        t = self.typ
+        if isinstance(t, CsumType):
+            # Checksums are computed dynamically in the executor.
+            return 0, 0, False
+        if isinstance(t, ProcType):
+            if self.val == t.default():
+                return 0, 0, False
+            return (t.values_start + self.val) & MASK64, t.values_per_proc, t.big_endian
+        if isinstance(t, ResourceType):
+            assert t.desc is not None and t.desc.type is not None
+            return self.val, 0, t.desc.type.big_endian  # type: ignore[attr-defined]
+        big_endian = getattr(t, "big_endian", False)
+        return self.val, 0, big_endian
+
+
+class PointerArg(Arg):
+    """Value of PtrType and VmaType (reference: prog/prog.go:95-136)."""
+
+    __slots__ = ("address", "vma_size", "res")
+
+    def __init__(self, typ: Type, address: int = 0, res: Optional[Arg] = None,
+                 vma_size: int = 0):
+        super().__init__(typ)
+        self.address = address
+        self.vma_size = vma_size  # size of referenced region for vma args
+        self.res = res  # pointee (None for vma and null pointers)
+
+    @classmethod
+    def make_null(cls, typ: Type) -> "PointerArg":
+        return cls(typ)
+
+    @classmethod
+    def make_vma(cls, typ: Type, addr: int, size: int) -> "PointerArg":
+        assert addr % 1024 == 0, "unaligned vma address"
+        return cls(typ, address=addr, vma_size=size)
+
+    def is_null(self) -> bool:
+        return self.address == 0 and self.vma_size == 0 and self.res is None
+
+
+class DataArg(Arg):
+    """Value of BufferType; holds bytes for in/inout, only a size for out
+    (reference: prog/prog.go:139-171)."""
+
+    __slots__ = ("data", "out_size")
+
+    def __init__(self, typ: Type, data: bytes = b"", out_size: int = 0):
+        super().__init__(typ)
+        if typ.dir == Dir.OUT:
+            assert not data, "non-empty output data arg"
+        self.data = bytearray(data)
+        self.out_size = out_size
+
+    def size(self) -> int:
+        if len(self.data) != 0:
+            return len(self.data)
+        return self.out_size
+
+
+class GroupArg(Arg):
+    """Value of StructType and ArrayType (reference: prog/prog.go:175-221)."""
+
+    __slots__ = ("inner",)
+
+    def __init__(self, typ: Type, inner: list[Arg]):
+        super().__init__(typ)
+        self.inner = inner
+
+    def size(self) -> int:
+        t = self.typ
+        if not t.varlen:
+            return t.size()
+        if isinstance(t, StructType):
+            sz = sum(f.size() for f in self.inner if not f.typ.bitfield_middle())
+            if t.align_attr and sz % t.align_attr:
+                sz += t.align_attr - sz % t.align_attr
+            return sz
+        if isinstance(t, ArrayType):
+            return sum(e.size() for e in self.inner)
+        raise TypeError(f"bad group arg type {t}")
+
+    def fixed_inner_size(self) -> bool:
+        t = self.typ
+        if isinstance(t, StructType):
+            return True
+        if isinstance(t, ArrayType):
+            return t.kind == ArrayKind.RANGE_LEN and t.range_begin == t.range_end
+        raise TypeError(f"bad group arg type {t}")
+
+
+class UnionArg(Arg):
+    __slots__ = ("option",)
+
+    def __init__(self, typ: Type, option: Arg):
+        super().__init__(typ)
+        self.option = option
+
+    def size(self) -> int:
+        if not self.typ.varlen:
+            return self.typ.size()
+        return self.option.size()
+
+
+class ResultArg(Arg):
+    """Value of ResourceType; the only arg usable as a syscall return.
+    Holds either a constant or a reference to the producing ResultArg,
+    maintaining the uses back-edges (reference: prog/prog.go:243-272)."""
+
+    __slots__ = ("res", "op_div", "op_add", "val", "uses")
+
+    def __init__(self, typ: Type, res: Optional["ResultArg"] = None, val: int = 0):
+        super().__init__(typ)
+        self.res = res
+        self.op_div = 0
+        self.op_add = 0
+        self.val = val & MASK64
+        self.uses: set[ResultArg] = set()
+        if res is not None:
+            res.uses.add(self)
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __eq__(self, other: object) -> bool:
+        return self is other
+
+
+def make_return_arg(typ: Optional[Type]) -> Optional[ResultArg]:
+    if typ is None:
+        return None
+    assert typ.dir == Dir.OUT, "return arg is not out"
+    return ResultArg(typ)
+
+
+@dataclass
+class Call:
+    meta: Syscall
+    args: list[Arg] = field(default_factory=list)
+    ret: Optional[ResultArg] = None
+    # Comparison operands observed for this call (hints mode), set by ipc.
+    comps: Optional[dict] = None
+
+
+@dataclass
+class Prog:
+    target: "Target"  # noqa: F821
+    calls: list[Call] = field(default_factory=list)
+
+    # -- structural edits ------------------------------------------------
+
+    def insert_before(self, c: Optional[Call], calls: list[Call]) -> None:
+        """Insert calls before c (or append if c is None/absent)
+        (reference: prog/prog.go:410-425)."""
+        idx = len(self.calls)
+        for i, cc in enumerate(self.calls):
+            if cc is c:
+                idx = i
+                break
+        self.calls[idx:idx] = calls
+
+    def remove_call(self, idx: int) -> None:
+        """Remove call idx, redirecting dangling resource uses to default
+        values (reference: prog/prog.go:492-502)."""
+        c = self.calls[idx]
+        for arg in c.args:
+            remove_arg(arg)
+        if c.ret is not None:
+            remove_arg(c.ret)
+        del self.calls[idx]
+
+    def clone(self) -> "Prog":
+        return clone_prog(self)
+
+    def __len__(self) -> int:
+        return len(self.calls)
+
+
+@dataclass
+class ArgCtx:
+    """Walk context (reference: prog/analysis.go:100-105).
+
+    parent is the list of sibling args: the enclosing struct's fields or
+    the call's top-level args (not set for arrays) — len-type mutation
+    and size assignment look up the measured buffer among these.
+    """
+
+    parent: Optional[list[Arg]] = None
+    base: Optional[PointerArg] = None  # pointer to the heap object containing arg
+    offset: int = 0  # offset of arg within the base object
+    stop: bool = False  # set by callback to stop descending
+
+
+def foreach_sub_arg(arg: Arg, fn: Callable[[Arg, ArgCtx], None]) -> None:
+    _foreach_arg_impl(arg, ArgCtx(), fn)
+
+
+def foreach_arg(c: Call, fn: Callable[[Arg, ArgCtx], None]) -> None:
+    """Visit ret (if any), then each top-level arg and its subtree
+    (reference: prog/analysis.go:111-120)."""
+    if c.ret is not None:
+        _foreach_arg_impl(c.ret, ArgCtx(), fn)
+    ctx = ArgCtx(parent=c.args)
+    for arg in c.args:
+        _foreach_arg_impl(arg, ctx, fn)
+
+
+def _foreach_arg_impl(arg: Arg, ctx: ArgCtx, fn: Callable[[Arg, ArgCtx], None]) -> None:
+    # Each node sees its own copy of the context so callbacks can't
+    # corrupt siblings; offsets accumulate within the current base
+    # object (reference: prog/analysis.go:122-156).
+    ctx = ArgCtx(parent=ctx.parent, base=ctx.base, offset=ctx.offset)
+    fn(arg, ctx)
+    if ctx.stop:
+        return
+    if isinstance(arg, GroupArg):
+        if isinstance(arg.typ, StructType):
+            ctx.parent = arg.inner
+        for f in arg.inner:
+            _foreach_arg_impl(f, ctx, fn)
+            if not f.typ.bitfield_middle():
+                ctx.offset += f.size()
+    elif isinstance(arg, PointerArg):
+        if arg.res is not None:
+            ctx.base = arg
+            ctx.offset = 0
+            _foreach_arg_impl(arg.res, ctx, fn)
+    elif isinstance(arg, UnionArg):
+        _foreach_arg_impl(arg.option, ctx, fn)
+
+
+def inner_arg(arg: Arg) -> Optional[Arg]:
+    """Chase pointers to the pointee (reference: prog/prog.go:279-293)."""
+    if isinstance(arg.typ, PtrType):
+        if isinstance(arg, PointerArg):
+            if arg.res is None:
+                assert arg.typ.optional, "non-optional pointer is nil"
+                return None
+            return inner_arg(arg.res)
+        return None
+    return arg
+
+
+# -- replace/remove maintaining the ResultArg graph ----------------------
+
+
+def replace_arg(arg: Arg, arg1: Arg) -> None:
+    """In-place overwrite of arg with arg1, fixing uses edges
+    (reference: prog/prog.go:428-470)."""
+    if isinstance(arg, ResultArg):
+        replace_result_arg(arg, arg1)  # type: ignore[arg-type]
+    elif isinstance(arg, GroupArg):
+        a1 = arg1
+        assert isinstance(a1, GroupArg)
+        assert len(arg.inner) == len(a1.inner), "group fields don't match"
+        arg.typ = a1.typ
+        for sub, sub1 in zip(arg.inner, a1.inner):
+            replace_arg(sub, sub1)
+    elif isinstance(arg, ConstArg):
+        assert isinstance(arg1, ConstArg)
+        arg.typ, arg.val = arg1.typ, arg1.val
+    elif isinstance(arg, PointerArg):
+        assert isinstance(arg1, PointerArg)
+        arg.typ, arg.address, arg.vma_size, arg.res = (
+            arg1.typ, arg1.address, arg1.vma_size, arg1.res)
+    elif isinstance(arg, UnionArg):
+        assert isinstance(arg1, UnionArg)
+        arg.typ, arg.option = arg1.typ, arg1.option
+    elif isinstance(arg, DataArg):
+        assert isinstance(arg1, DataArg)
+        arg.typ, arg.data, arg.out_size = arg1.typ, arg1.data, arg1.out_size
+    else:
+        raise TypeError(f"replace_arg: bad arg kind {arg}")
+
+
+def replace_result_arg(arg: ResultArg, arg1: ResultArg) -> None:
+    if arg.res is not None:
+        arg.res.uses.discard(arg)
+    # Copy everything except the set of users of arg itself.
+    arg.typ, arg.res, arg.op_div, arg.op_add, arg.val = (
+        arg1.typ, arg1.res, arg1.op_div, arg1.op_add, arg1.val)
+    if arg.res is not None:
+        arg.res.uses.discard(arg1)
+        arg.res.uses.add(arg)
+
+
+def remove_arg(arg0: Arg) -> None:
+    """Drop all graph references to/from arg0's subtree
+    (reference: prog/prog.go:473-489)."""
+
+    def visit(arg: Arg, ctx: ArgCtx) -> None:
+        if isinstance(arg, ResultArg):
+            if arg.res is not None:
+                assert arg in arg.res.uses, "broken ResultArg tree"
+                arg.res.uses.discard(arg)
+            for user in list(arg.uses):
+                repl = ResultArg(user.typ, None, user.typ.default())
+                replace_result_arg(user, repl)
+
+    foreach_sub_arg(arg0, visit)
+
+
+# -- deep copy -----------------------------------------------------------
+
+
+def clone_prog(p: Prog) -> Prog:
+    """Deep copy preserving the ResultArg reference graph
+    (reference: prog/clone.go:6-32)."""
+    newargs: dict[int, ResultArg] = {}
+    p1 = Prog(target=p.target)
+    for c in p.calls:
+        c1 = Call(meta=c.meta,
+                  args=[_clone_arg(a, newargs) for a in c.args],
+                  ret=_clone_arg(c.ret, newargs) if c.ret is not None else None)
+        p1.calls.append(c1)
+    _patch_res_refs(p1, newargs)
+    return p1
+
+
+def clone_call(c: Call) -> Call:
+    """Deep copy of a single call; external resource refs become local
+    constants."""
+    newargs: dict[int, ResultArg] = {}
+    c1 = Call(meta=c.meta,
+              args=[_clone_arg(a, newargs) for a in c.args],
+              ret=_clone_arg(c.ret, newargs) if c.ret is not None else None)
+    p = Prog(target=None, calls=[c1])  # type: ignore[arg-type]
+    _patch_res_refs(p, newargs)
+    return c1
+
+
+def _clone_arg(arg: Arg, newargs: dict[int, ResultArg]):
+    if isinstance(arg, ConstArg):
+        return ConstArg(arg.typ, arg.val)
+    if isinstance(arg, PointerArg):
+        res = _clone_arg(arg.res, newargs) if arg.res is not None else None
+        return PointerArg(arg.typ, arg.address, res, arg.vma_size)
+    if isinstance(arg, DataArg):
+        a = DataArg(arg.typ, out_size=arg.out_size)
+        a.data = bytearray(arg.data)
+        return a
+    if isinstance(arg, GroupArg):
+        return GroupArg(arg.typ, [_clone_arg(x, newargs) for x in arg.inner])
+    if isinstance(arg, UnionArg):
+        return UnionArg(arg.typ, _clone_arg(arg.option, newargs))
+    if isinstance(arg, ResultArg):
+        a = ResultArg(arg.typ, None, arg.val)
+        a.op_div, a.op_add = arg.op_div, arg.op_add
+        # Temporarily alias res to the old producer; fixed in _patch_res_refs.
+        a.res = arg.res  # type: ignore[assignment]
+        newargs[id(arg)] = a
+        return a
+    raise TypeError(f"clone: bad arg kind {arg}")
+
+
+def _patch_res_refs(p: Prog, newargs: dict[int, ResultArg]) -> None:
+    for a in newargs.values():
+        if a.res is not None:
+            new_res = newargs.get(id(a.res))
+            a.res = new_res
+            if new_res is not None:
+                new_res.uses.add(a)
+            else:
+                # Reference to an arg outside the cloned region: degrade
+                # to the type's default constant.
+                a.val = a.typ.default()
+
+
+def iter_args(p: Prog) -> Iterator[tuple[Call, Arg, ArgCtx]]:
+    for c in p.calls:
+        collected: list[tuple[Arg, ArgCtx]] = []
+        foreach_arg(c, lambda a, ctx: collected.append((a, ctx)))
+        for a, ctx in collected:
+            yield c, a, ctx
+
+
+# -- default args --------------------------------------------------------
+
+
+def default_arg(target: "Target", t: Type) -> Arg:  # noqa: F821
+    """The neutral value of a type (reference: prog/prog.go:295-343)."""
+    if isinstance(t, ResourceType):
+        return ResultArg(t, None, t.default())
+    if isinstance(t, (IntType, ConstType, FlagsType, LenType, ProcType, CsumType)):
+        return ConstArg(t, t.default())
+    if isinstance(t, BufferType):
+        if t.dir == Dir.OUT:
+            sz = 0 if t.varlen else t.size()
+            return DataArg(t, out_size=sz)
+        data = b"" if t.varlen else bytes(t.size())
+        return DataArg(t, data)
+    if isinstance(t, ArrayType):
+        elems: list[Arg] = []
+        if t.kind == ArrayKind.RANGE_LEN and t.range_begin == t.range_end:
+            elems = [default_arg(target, t.elem) for _ in range(t.range_begin)]
+        return GroupArg(t, elems)
+    if isinstance(t, StructType):
+        return GroupArg(t, [default_arg(target, f) for f in t.fields])
+    if isinstance(t, UnionType):
+        return UnionArg(t, default_arg(target, t.fields[0]))
+    if isinstance(t, VmaType):
+        if t.optional:
+            return PointerArg.make_null(t)
+        return PointerArg.make_vma(t, 0, target.page_size)
+    if isinstance(t, PtrType):
+        if t.optional:
+            return PointerArg.make_null(t)
+        return PointerArg(t, 0, default_arg(target, t.elem))
+    raise TypeError(f"unknown arg type: {t}")
+
+
+def is_default_arg(target: "Target", arg: Arg) -> bool:  # noqa: F821
+    """True if arg holds its type's neutral value
+    (reference: prog/prog.go:345-408)."""
+    if is_pad(arg.typ):
+        return True
+    if isinstance(arg, ConstArg):
+        return arg.val == arg.typ.default()
+    if isinstance(arg, GroupArg):
+        if not arg.fixed_inner_size() and len(arg.inner) != 0:
+            return False
+        return all(is_default_arg(target, e) for e in arg.inner)
+    if isinstance(arg, UnionArg):
+        t = arg.typ
+        assert isinstance(t, UnionType)
+        return (arg.option.typ.field_name == t.fields[0].field_name
+                and is_default_arg(target, arg.option))
+    if isinstance(arg, DataArg):
+        if arg.size() == 0:
+            return True
+        if arg.typ.varlen:
+            return False
+        if arg.typ.dir == Dir.OUT:
+            return True
+        return all(v == 0 for v in arg.data)
+    if isinstance(arg, PointerArg):
+        t = arg.typ
+        if isinstance(t, PtrType):
+            if t.optional:
+                return arg.is_null()
+            return arg.address == 0 and is_default_arg(target, arg.res)
+        if isinstance(t, VmaType):
+            if t.optional:
+                return arg.is_null()
+            return arg.address == 0 and arg.vma_size == target.page_size
+        raise TypeError(f"unknown pointer type {t}")
+    if isinstance(arg, ResultArg):
+        return (arg.res is None and arg.op_div == 0 and arg.op_add == 0
+                and len(arg.uses) == 0 and arg.val == arg.typ.default())
+    return False

@@ -1,0 +1,231 @@
+"""Fuzzing RNG distributions.
+
+The biased distributions here define the reference's carefully-tuned
+mutation statistics (reference: prog/rand.go:17-151).  The CPU engine
+uses them directly; the batched TPU engine (ops/rng.py) re-derives the
+same distributions from jax.random primitives and is parity-tested
+against this module.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Optional
+
+MASK64 = (1 << 64) - 1
+
+# Potentially interesting integers (reference: prog/rand.go:57-65).
+SPECIAL_INTS: tuple[int, ...] = (
+    0, 1, 31, 32, 63, 64, 127, 128,
+    129, 255, 256, 257, 511, 512,
+    1023, 1024, 1025, 2047, 2048, 4095, 4096,
+    (1 << 15) - 1, (1 << 15), (1 << 15) + 1,
+    (1 << 16) - 1, (1 << 16), (1 << 16) + 1,
+    (1 << 31) - 1, (1 << 31), (1 << 31) + 1,
+    (1 << 32) - 1, (1 << 32), (1 << 32) + 1,
+)
+
+SPECIAL_INTS_SET = frozenset(SPECIAL_INTS)
+
+
+class RandGen:
+    """Wraps a seeded PRNG with the fuzzing distributions
+    (reference: prog/rand.go:17-54)."""
+
+    def __init__(self, target, seed_or_rng=None):
+        self.target = target
+        if isinstance(seed_or_rng, random.Random):
+            self.r = seed_or_rng
+        else:
+            self.r = random.Random(seed_or_rng)
+        self.in_create_resource = False
+        self.rec_depth: dict[str, int] = {}
+
+    # -- primitives ------------------------------------------------------
+
+    def intn(self, n: int) -> int:
+        return self.r.randrange(n)
+
+    def rand(self, n: int) -> int:
+        return self.r.randrange(n)
+
+    def rand_range(self, begin: int, end: int) -> int:
+        return begin + self.r.randrange(end - begin + 1)
+
+    def bin(self) -> bool:
+        return self.r.randrange(2) == 0
+
+    def one_of(self, n: int) -> bool:
+        return self.r.randrange(n) == 0
+
+    def n_out_of(self, n: int, out_of: int) -> bool:
+        assert 0 < n < out_of, "bad probability"
+        return self.r.randrange(out_of) < n
+
+    def uint64(self) -> int:
+        return self.r.getrandbits(64)
+
+    def int31(self) -> int:
+        return self.r.getrandbits(31)
+
+    def rand64(self) -> int:
+        """63 random bits, top bit set half the time
+        (reference: prog/rand.go:48-54)."""
+        v = self.r.getrandbits(63)
+        if self.bin():
+            v |= 1 << 63
+        return v
+
+    # -- biased distributions --------------------------------------------
+
+    def rand_int(self) -> int:
+        """The magic integer distribution: strongly favors small values
+        and special constants, with occasional negation/shifts
+        (reference: prog/rand.go:67-91)."""
+        v = self.rand64()
+        if self.n_out_of(100, 182):
+            v %= 10
+        elif self.n_out_of(50, 82):
+            v = SPECIAL_INTS[self.intn(len(SPECIAL_INTS))]
+        elif self.n_out_of(10, 32):
+            v %= 256
+        elif self.n_out_of(10, 22):
+            v %= 4 << 10
+        elif self.n_out_of(10, 12):
+            v %= 64 << 10
+        else:
+            v %= 1 << 31
+        if self.n_out_of(100, 107):
+            pass
+        elif self.n_out_of(5, 7):
+            v = (-v) & MASK64
+        else:
+            v = (v << self.intn(63)) & MASK64
+        return v
+
+    def rand_range_int(self, begin: int, end: int) -> int:
+        """(reference: prog/rand.go:93-98)"""
+        if self.one_of(100):
+            return self.rand_int()
+        return (begin + self.uint64() % (end - begin + 1)) & MASK64
+
+    def biased_rand(self, n: int, k: int) -> int:
+        """Random int in [0, n); probability of n-1 is k times higher
+        than of 0 (reference: prog/rand.go:100-107)."""
+        nf, kf = float(n), float(k)
+        rf = nf * (kf / 2 + 1) * self.r.random()
+        bf = (-1 + math.sqrt(1 + 2 * kf * rf / nf)) * nf / kf
+        return min(int(bf), n - 1)
+
+    def rand_array_len(self) -> int:
+        """Favors short arrays, 0 least likely
+        (reference: prog/rand.go:109-114)."""
+        max_len = 10
+        return (max_len - self.biased_rand(max_len + 1, 10) + 1) % (max_len + 1)
+
+    def rand_buf_len(self) -> int:
+        """(reference: prog/rand.go:116-124)"""
+        if self.n_out_of(50, 56):
+            return self.rand(256)
+        if self.n_out_of(5, 6):
+            return 4 << 10
+        return 0
+
+    def rand_page_count(self) -> int:
+        """(reference: prog/rand.go:126-136)"""
+        if self.n_out_of(100, 106):
+            return self.rand(4) + 1
+        if self.n_out_of(5, 6):
+            return self.rand(20) + 1
+        return (self.rand(3) + 1) * 512
+
+    def flags(self, vv: tuple[int, ...]) -> int:
+        """OR a few flag values together most of the time
+        (reference: prog/rand.go:138-152)."""
+        if self.n_out_of(90, 111):
+            v = 0
+            while True:
+                v |= vv[self.rand(len(vv))]
+                if self.bin():
+                    return v
+        if self.n_out_of(10, 21):
+            return vv[self.rand(len(vv))]
+        if self.n_out_of(10, 11):
+            return 0
+        return self.rand64()
+
+    # -- strings/files ---------------------------------------------------
+
+    SPECIAL_FILES = ("", "/", ".")
+    PUNCT = b"!@#$%^&*()-+\\/:.,-'[]{}"
+
+    def filename(self, s, typ) -> str:
+        """(reference: prog/rand.go:154-169)"""
+        fn = self._filename_impl(s)
+        assert not (fn and fn[-1] == "\x00"), "zero-terminated filename"
+        if not typ.varlen:
+            size = typ.size()
+            if len(fn) < size:
+                fn += "\x00" * (size - len(fn))
+            fn = fn[:size]
+        elif not typ.no_z:
+            fn += "\x00"
+        return fn
+
+    def _filename_impl(self, s) -> str:
+        """(reference: prog/rand.go:173-202)"""
+        if self.one_of(100):
+            return self.SPECIAL_FILES[self.intn(len(self.SPECIAL_FILES))]
+        if not s.files or self.one_of(10):
+            dir_ = "."
+            if self.one_of(2) and s.files:
+                files = sorted(s.files)
+                dir_ = files[self.intn(len(files))]
+                if dir_ and dir_[-1] == "\x00":
+                    dir_ = dir_[:-1]
+            i = 0
+            while True:
+                f = f"{dir_}/file{i}"
+                if f not in s.files:
+                    return f
+                i += 1
+        files = sorted(s.files)
+        return files[self.intn(len(files))]
+
+    def rand_string(self, s, typ) -> bytes:
+        """(reference: prog/rand.go:204-237)"""
+        if typ.values:
+            return typ.values[self.intn(len(typ.values))]
+        if s.strings and self.bin():
+            strs = sorted(s.strings)
+            return strs[self.intn(len(strs))].encode("latin-1")
+        buf = bytearray()
+        while self.n_out_of(3, 4):
+            if self.n_out_of(10, 21):
+                d = self.target.string_dictionary
+                if d:
+                    buf.extend(d[self.intn(len(d))].encode("latin-1"))
+            elif self.n_out_of(10, 11):
+                buf.append(self.PUNCT[self.intn(len(self.PUNCT))])
+            else:
+                buf.append(self.intn(256))
+        if self.one_of(100) == typ.no_z:
+            buf.append(0)
+        return bytes(buf)
+
+    # -- machine text ----------------------------------------------------
+
+    def generate_text(self, kind) -> bytes:
+        """Machine-code blobs for text args; a byte-soup stand-in plus
+        structured x86 prefixes (reference: prog/rand.go:323-336 routes
+        to pkg/ifuzz; ops-level instruction modeling lives in
+        utils/ifuzz.py)."""
+        from syzkaller_tpu.utils import ifuzz
+
+        return ifuzz.generate(kind, self.r)
+
+    def mutate_text(self, kind, text: bytes) -> bytes:
+        from syzkaller_tpu.utils import ifuzz
+
+        return ifuzz.mutate(kind, self.r, text)

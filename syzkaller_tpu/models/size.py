@@ -1,0 +1,169 @@
+"""Length-field assignment.
+
+len/bytesize/bitsize args measure a sibling field, "parent", or a named
+ancestor struct; values are recomputed after every structural edit
+(reference: prog/size.go:11-117).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from syzkaller_tpu.models.prog import (
+    Arg,
+    Call,
+    ConstArg,
+    GroupArg,
+    PointerArg,
+    foreach_sub_arg,
+    inner_arg,
+)
+from syzkaller_tpu.models.types import (
+    ArrayType,
+    LenType,
+    StructType,
+    VmaType,
+    is_pad,
+)
+
+
+def generate_size(arg: Optional[Arg], len_type: LenType) -> int:
+    """Measured size of arg in len_type's units
+    (reference: prog/size.go:11-34)."""
+    if arg is None:
+        # Optional pointer: size 0.
+        return 0
+    bit_size = len_type.bit_size or 8
+    t = arg.typ
+    if isinstance(t, VmaType):
+        assert isinstance(arg, PointerArg)
+        return arg.vma_size * 8 // bit_size
+    if isinstance(t, ArrayType):
+        assert isinstance(arg, GroupArg)
+        if len_type.bit_size != 0:
+            return arg.size() * 8 // bit_size
+        return len(arg.inner)
+    return arg.size() * 8 // bit_size
+
+
+def _assign_sizes(args: list[Arg], parents: dict[int, Arg]) -> None:
+    """(reference: prog/size.go:36-92)"""
+    args_map: dict[str, Arg] = {}
+    for arg in args:
+        if is_pad(arg.typ):
+            continue
+        args_map[arg.typ.field_name] = arg
+
+    for arg0 in args:
+        arg = inner_arg(arg0)
+        if arg is None:
+            continue  # pointer to optional len field
+        t = arg.typ
+        if not isinstance(t, LenType):
+            continue
+        assert isinstance(arg, ConstArg)
+        buf = args_map.get(t.buf)
+        if buf is not None:
+            arg.val = generate_size(inner_arg(buf), t)
+            continue
+        if t.buf == "parent":
+            parent = parents.get(id(arg))
+            assert parent is not None, f"no parent for len field {t.field_name}"
+            arg.val = parent.size()
+            if t.bit_size != 0:
+                arg.val = arg.val * 8 // t.bit_size
+            continue
+        # Named ancestor struct (possibly a template instance "name[...]").
+        assigned = False
+        parent = parents.get(id(arg))
+        while parent is not None:
+            pname = parent.typ.name
+            if "[" in pname:
+                pname = pname[: pname.index("[")]
+            if t.buf == pname:
+                arg.val = parent.size()
+                if t.bit_size != 0:
+                    arg.val = arg.val * 8 // t.bit_size
+                assigned = True
+                break
+            parent = parents.get(id(parent))
+        if not assigned:
+            raise ValueError(
+                f"len field {t.field_name!r} references nonexistent field {t.buf!r}")
+
+
+def assign_sizes_array(args: list[Arg]) -> None:
+    """(reference: prog/size.go:94-113)"""
+    parents: dict[int, Arg] = {}
+    for arg in args:
+        def note(a, ctx) -> None:
+            if isinstance(a.typ, StructType):
+                assert isinstance(a, GroupArg)
+                for f in a.inner:
+                    fi = inner_arg(f)
+                    if fi is not None:
+                        parents[id(fi)] = a
+
+        foreach_sub_arg(arg, note)
+    _assign_sizes(args, parents)
+    for arg in args:
+        def fix(a, ctx) -> None:
+            if isinstance(a.typ, StructType):
+                _assign_sizes(a.inner, parents)
+
+        foreach_sub_arg(arg, fix)
+
+
+def assign_sizes_call(c: Call) -> None:
+    assign_sizes_array(c.args)
+
+
+def mutate_size(rng, arg: ConstArg, parent: list[Arg]) -> bool:
+    """Len-field mutation: small perturbations and overflow-provoking
+    values scaled by element size (reference: prog/size.go:119-175)."""
+    t = arg.typ
+    assert isinstance(t, LenType)
+    elem_size = t.bit_size // 8
+    if elem_size == 0:
+        elem_size = 1
+        for field in parent:
+            if t.buf != field.typ.field_name:
+                continue
+            inner = inner_arg(field)
+            if inner is not None:
+                it = inner.typ
+                if isinstance(it, VmaType):
+                    return False
+                if isinstance(it, ArrayType):
+                    assert it.elem is not None
+                    if it.elem.varlen:
+                        return False
+                    elem_size = it.elem.size()
+            break
+    if rng.one_of(100):
+        arg.val = rng.rand64()
+        return True
+    if rng.bin():
+        # Small adjustment to trigger missed size checks.
+        if arg.val != 0 and rng.bin():
+            arg.val = rng.rand_range_int(0, arg.val - 1)
+        else:
+            arg.val = rng.rand_range_int(arg.val + 1, arg.val + 1000)
+        arg.val &= (1 << 64) - 1
+        return True
+    # Try to provoke int overflows.
+    maxv = (1 << 64) - 1
+    if rng.one_of(3):
+        maxv = (1 << 32) - 1
+        if rng.one_of(2):
+            maxv = (1 << 16) - 1
+            if rng.one_of(2):
+                maxv = (1 << 8) - 1
+    n = maxv // elem_size
+    delta = 1000 - rng.biased_rand(1000, 10)
+    if elem_size == 1 or rng.one_of(10):
+        n -= delta
+    else:
+        n += delta
+    arg.val = n & ((1 << 64) - 1)
+    return True

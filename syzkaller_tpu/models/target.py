@@ -1,0 +1,196 @@
+"""Target: an OS/arch pair with its syscall/resource/struct tables.
+
+Mirrors the reference target registry (reference: prog/target.go:14-153)
+with lazy cross-reference wiring and resource-constructor discovery
+(reference: prog/resources.go:10-130).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Callable, Optional
+
+from syzkaller_tpu.models.types import (
+    ConstValue,
+    Dir,
+    ResourceDesc,
+    ResourceType,
+    Syscall,
+    Type,
+    foreach_type,
+)
+from syzkaller_tpu.models.prog import Call, default_arg
+
+
+@dataclass
+class Target:
+    os: str = "test"
+    arch: str = "64"
+    revision: str = ""
+    ptr_size: int = 8
+    page_size: int = 4096
+    num_pages: int = 4096
+    data_offset: int = 0x20000000
+
+    syscalls: list[Syscall] = dc_field(default_factory=list)
+    resources: list[ResourceDesc] = dc_field(default_factory=list)
+    consts: list[ConstValue] = dc_field(default_factory=list)
+
+    # Arch hooks (reference: prog/target.go:28-45).
+    make_mmap: Optional[Callable[[int, int], Call]] = None
+    sanitize_call: Callable[[Call], None] = lambda c: None
+    special_types: dict[str, Callable] = dc_field(default_factory=dict)
+    string_dictionary: list[str] = dc_field(default_factory=list)
+
+    # Filled by _init:
+    syscall_map: dict[str, Syscall] = dc_field(default_factory=dict)
+    const_map: dict[str, int] = dc_field(default_factory=dict)
+    resource_map: dict[str, ResourceDesc] = dc_field(default_factory=dict)
+    resource_ctors: dict[str, list[Syscall]] = dc_field(default_factory=dict)
+    _initialized: bool = False
+
+    def init(self) -> "Target":
+        if self._initialized:
+            return self
+        self._initialized = True
+        self.const_map = {c.name: c.value for c in self.consts}
+        self.resource_map = {r.name: r for r in self.resources}
+        for i, c in enumerate(self.syscalls):
+            c.id = i
+            self.syscall_map[c.name] = c
+            # Wire resource descriptors into resource types
+            # (reference: prog/target.go:127-145).
+            def wire(t: Type) -> None:
+                if isinstance(t, ResourceType) and t.desc is None:
+                    desc = self.resource_map.get(t.name)
+                    if desc is None:
+                        raise ValueError(f"no resource desc for {t.name}")
+                    t.desc = desc
+            foreach_type(c, wire)
+        for r in self.resources:
+            self.resource_ctors[r.name] = self.calc_resource_ctors(r.kind, False)
+        return self
+
+    # -- resources (reference: prog/resources.go) ------------------------
+
+    def calc_resource_ctors(self, kind: tuple[str, ...], precise: bool) -> list[Syscall]:
+        """Find calls with an out/inout arg (or ret) of the given resource
+        kind (reference: prog/resources.go:10-32)."""
+        metas: list[Syscall] = []
+        for meta in self.syscalls:
+            found = False
+
+            def check(t: Type) -> None:
+                nonlocal found
+                if found or not isinstance(t, ResourceType):
+                    return
+                if t.dir != Dir.IN and t.desc is not None and \
+                        is_compatible_resource_impl(kind, t.desc.kind, precise):
+                    found = True
+
+            foreach_type(meta, check)
+            if found:
+                metas.append(meta)
+        return metas
+
+    def is_compatible_resource(self, dst: str, src: str) -> bool:
+        """True if a resource of kind src can be passed where dst is
+        expected (reference: prog/resources.go:35-50)."""
+        dst_res = self.resource_map.get(dst)
+        src_res = self.resource_map.get(src)
+        if dst_res is None:
+            raise KeyError(f"unknown resource {dst!r}")
+        if src_res is None:
+            raise KeyError(f"unknown resource {src!r}")
+        return is_compatible_resource_impl(dst_res.kind, src_res.kind, False)
+
+    def input_resources(self, c: Syscall) -> list[ResourceType]:
+        """Non-optional, non-out resource args of a call
+        (reference: prog/resources.go:75-86)."""
+        out: list[ResourceType] = []
+
+        def collect(t: Type) -> None:
+            if isinstance(t, ResourceType) and t.dir != Dir.OUT and not t.optional:
+                out.append(t)
+
+        foreach_type(c, collect)
+        return out
+
+    def transitively_enabled_calls(
+        self, enabled: dict[Syscall, bool]
+    ) -> tuple[dict[Syscall, bool], dict[Syscall, str]]:
+        """Fixpoint: drop calls whose required input resources have no
+        enabled precise constructor (reference: prog/resources.go:88-153)."""
+        supported = {c for c, ok in enabled.items() if ok}
+        inputs = {c: self.input_resources(c) for c in supported}
+        ctors: dict[str, list[Syscall]] = {}
+        for c in supported:
+            for res in inputs[c]:
+                assert res.desc is not None
+                if res.desc.name not in ctors:
+                    ctors[res.desc.name] = self.calc_resource_ctors(res.desc.kind, True)
+        disabled: dict[Syscall, str] = {}
+        while True:
+            n = len(supported)
+            for c in list(supported):
+                for res in inputs[c]:
+                    assert res.desc is not None
+                    if not any(ct in supported for ct in ctors[res.desc.name]):
+                        supported.discard(c)
+                        names = [ct.name for ct in ctors[res.desc.name]]
+                        disabled[c] = (
+                            f"no syscalls can create resource {res.desc.name},"
+                            f" enable some syscalls that can create it {names}")
+                        break
+            if n == len(supported):
+                break
+        return {c: True for c in supported}, disabled
+
+    def default_arg(self, t: Type):
+        return default_arg(self, t)
+
+    def physical_addr(self, arg) -> int:
+        """Fake physical address of a pointer arg
+        (reference: prog/encodingexec.go:194-199)."""
+        if arg.is_null():
+            return 0
+        return self.data_offset + arg.address
+
+
+def is_compatible_resource_impl(dst: tuple[str, ...], src: tuple[str, ...],
+                                precise: bool) -> bool:
+    """Prefix-compare the two kind chains; when precise, a less
+    specialized src cannot stand in for a more specialized dst
+    (reference: prog/resources.go:52-73)."""
+    dst = tuple(dst)
+    src = tuple(src)
+    if len(dst) > len(src):
+        if precise:
+            return False
+        dst = dst[: len(src)]
+    if len(src) > len(dst):
+        src = src[: len(dst)]
+    return dst == src
+
+
+_targets: dict[str, Target] = {}
+
+
+def register_target(target: Target) -> None:
+    key = f"{target.os}/{target.arch}"
+    if key in _targets:
+        raise ValueError(f"duplicate target {key}")
+    _targets[key] = target
+
+
+def get_target(os: str, arch: str) -> Target:
+    key = f"{os}/{arch}"
+    t = _targets.get(key)
+    if t is None:
+        # Auto-register built-in targets on first use.
+        import syzkaller_tpu.sys  # noqa: F401
+
+        t = _targets.get(key)
+    if t is None:
+        raise KeyError(f"unknown target {key} (have: {sorted(_targets)})")
+    return t.init()

@@ -1,0 +1,302 @@
+"""Syscall type system.
+
+Semantics follow the reference type model (reference: prog/types.go:10-397):
+a Syscall has typed arguments; there are 14 type kinds (resource, const,
+int, flags, len, proc, csum, vma, buffer, array, ptr, struct, union +
+bitfields/padding expressed on int-like types).  Unlike the reference,
+types here are plain data (no generate/mutate virtuals): behaviour lives
+in models/generation.py and models/mutation.py, which keeps type objects
+directly serializable into the device-side type tables used by the
+batched TPU kernels (ops/tensor.py).
+
+All integer values are Python ints interpreted modulo 2**64; helpers in
+utils/ints.py do the masking.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+class Dir(enum.IntEnum):
+    IN = 0
+    OUT = 1
+    INOUT = 2
+
+    def __str__(self) -> str:
+        return {Dir.IN: "in", Dir.OUT: "out", Dir.INOUT: "inout"}[self]
+
+
+@dataclass(eq=False)
+class Type:
+    """Base of all syscall argument types.
+
+    type_size is the static byte size, 0 for variable-size types
+    (reference: prog/types.go:64-110).
+    """
+
+    name: str = ""
+    field_name: str = ""
+    type_size: int = 0
+    dir: Dir = Dir.IN
+    optional: bool = False
+    varlen: bool = False
+
+    def size(self) -> int:
+        if self.varlen:
+            raise ValueError(f"static size of varlen type {self.name} is unknown")
+        return self.type_size
+
+    def default(self) -> int:
+        return 0
+
+    # Bitfield accessors; only int-like types carry real values
+    # (reference: prog/types.go:100-110).
+    def bitfield_offset(self) -> int:
+        return 0
+
+    def bitfield_length(self) -> int:
+        return 0
+
+    def bitfield_middle(self) -> bool:
+        return False
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclass(eq=False)
+class IntCommon(Type):
+    """Shared by all integer-backed types: bitfields and endianness
+    (reference: prog/types.go:140-162)."""
+
+    bitfield_off: int = 0
+    bitfield_len: int = 0
+    big_endian: bool = False
+    bitfield_mdl: bool = False  # True for all but the last bitfield in a group
+
+    def bitfield_offset(self) -> int:
+        return self.bitfield_off
+
+    def bitfield_length(self) -> int:
+        return self.bitfield_len
+
+    def bitfield_middle(self) -> bool:
+        return self.bitfield_mdl
+
+
+@dataclass(eq=False)
+class ResourceDesc:
+    """A kernel-object kind (fd, sock, pid...). kind is the subtyping
+    chain, most general first; values are special fallback values
+    (reference: prog/types.go:116-121)."""
+
+    name: str = ""
+    type: Optional[Type] = None
+    kind: tuple[str, ...] = ()
+    values: tuple[int, ...] = (0,)
+
+
+@dataclass(eq=False)
+class ResourceType(IntCommon):
+    desc: Optional[ResourceDesc] = None
+
+    def default(self) -> int:
+        assert self.desc is not None
+        return self.desc.values[0]
+
+    def special_values(self) -> tuple[int, ...]:
+        assert self.desc is not None
+        return self.desc.values
+
+
+@dataclass(eq=False)
+class ConstType(IntCommon):
+    val: int = 0
+    is_pad: bool = False
+
+    def default(self) -> int:
+        return self.val
+
+    def __str__(self) -> str:
+        if self.is_pad:
+            return f"pad[{self.type_size}]"
+        return f"const[{self.val:#x}, {self.name}]"
+
+
+class IntKind(enum.IntEnum):
+    PLAIN = 0
+    FILEOFF = 1  # offset within a file
+    RANGE = 2
+
+
+@dataclass(eq=False)
+class IntType(IntCommon):
+    kind: IntKind = IntKind.PLAIN
+    range_begin: int = 0
+    range_end: int = 0
+
+
+@dataclass(eq=False)
+class FlagsType(IntCommon):
+    vals: tuple[int, ...] = ()
+
+
+@dataclass(eq=False)
+class LenType(IntCommon):
+    """Length of the field named buf (or "parent"/ancestor-struct path).
+    bit_size: 0 = element count, 8*k = size in k-byte units, 1 = bits
+    (reference: prog/types.go:197-201)."""
+
+    bit_size: int = 0
+    buf: str = ""
+
+
+@dataclass(eq=False)
+class ProcType(IntCommon):
+    """Per-process disjoint value ranges (reference: prog/types.go:203-212)."""
+
+    values_start: int = 0
+    values_per_proc: int = 0
+
+    def default(self) -> int:
+        # Special value meaning "0 for all procs".
+        return 0xFFFFFFFFFFFFFFFF
+
+
+class CsumKind(enum.IntEnum):
+    INET = 0
+    PSEUDO = 1
+
+
+@dataclass(eq=False)
+class CsumType(IntCommon):
+    kind: CsumKind = CsumKind.INET
+    buf: str = ""
+    protocol: int = 0  # for PSEUDO
+
+
+@dataclass(eq=False)
+class VmaType(Type):
+    # Page-count range; 0/0 = unconstrained.
+    range_begin: int = 0
+    range_end: int = 0
+
+
+class BufferKind(enum.IntEnum):
+    BLOB_RAND = 0
+    BLOB_RANGE = 1
+    STRING = 2
+    FILENAME = 3
+    TEXT = 4
+
+
+class TextKind(enum.IntEnum):
+    X86_REAL = 0
+    X86_16 = 1
+    X86_32 = 2
+    X86_64 = 3
+    ARM64 = 4
+
+
+@dataclass(eq=False)
+class BufferType(Type):
+    kind: BufferKind = BufferKind.BLOB_RAND
+    range_begin: int = 0  # for BLOB_RANGE
+    range_end: int = 0
+    text: TextKind = TextKind.X86_64  # for TEXT
+    sub_kind: str = ""
+    values: tuple[bytes, ...] = ()  # possible values for STRING
+    no_z: bool = False  # non-zero-terminated STRING/FILENAME
+
+
+class ArrayKind(enum.IntEnum):
+    RAND_LEN = 0
+    RANGE_LEN = 1
+
+
+@dataclass(eq=False)
+class ArrayType(Type):
+    elem: Optional[Type] = None
+    kind: ArrayKind = ArrayKind.RAND_LEN
+    range_begin: int = 0
+    range_end: int = 0
+
+    def __str__(self) -> str:
+        return f"array[{self.elem}]"
+
+
+@dataclass(eq=False)
+class PtrType(Type):
+    elem: Optional[Type] = None
+
+    def __str__(self) -> str:
+        return f"ptr[{self.dir}, {self.elem}]"
+
+
+@dataclass(eq=False)
+class StructType(Type):
+    """Struct with computed field layout.  The compiler (or builder)
+    resolves alignment/padding at target-build time by inserting
+    explicit pad fields, so layout here is final
+    (reference: prog/types.go:305-337 + pkg/compiler layout)."""
+
+    fields: list[Type] = field(default_factory=list)
+    align_attr: int = 0
+
+
+@dataclass(eq=False)
+class UnionType(Type):
+    fields: list[Type] = field(default_factory=list)
+
+
+@dataclass(frozen=True)
+class ConstValue:
+    name: str
+    value: int
+
+
+@dataclass(eq=False)
+class Syscall:
+    """Syscall metadata (reference: prog/types.go:10-17)."""
+
+    id: int = -1
+    nr: int = 0
+    name: str = ""
+    call_name: str = ""
+    args: list[Type] = field(default_factory=list)
+    ret: Optional[Type] = None
+    # attrs used by fuzzing policy
+    disabled: bool = False
+
+    def __repr__(self) -> str:
+        return f"<Syscall {self.name}>"
+
+
+def is_pad(t: Type) -> bool:
+    return isinstance(t, ConstType) and t.is_pad
+
+
+def foreach_type(meta: Syscall, fn: Callable[[Type], None]) -> None:
+    """Visit every type reachable from a syscall, pruning struct/union
+    recursion (reference: prog/types.go:358-396)."""
+    seen: set[int] = set()
+
+    def rec(t: Type) -> None:
+        fn(t)
+        if isinstance(t, (PtrType, ArrayType)):
+            assert t.elem is not None
+            rec(t.elem)
+        elif isinstance(t, (StructType, UnionType)):
+            if id(t) in seen:
+                return
+            seen.add(id(t))
+            for f in t.fields:
+                rec(f)
+
+    for t in meta.args:
+        rec(t)
+    if meta.ret is not None:
+        rec(meta.ret)

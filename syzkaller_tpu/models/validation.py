@@ -1,0 +1,172 @@
+"""Structural program validation, enabled in tests
+(reference: prog/validation.go:12-249)."""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.prog import (
+    Arg,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    UnionArg,
+)
+from syzkaller_tpu.models.types import (
+    ArrayKind,
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    UnionType,
+    VmaType,
+)
+
+# Toggled by tests to validate after every random op.
+debug = False
+
+
+class ValidationError(Exception):
+    pass
+
+
+def validate_prog(p: Prog) -> None:
+    args_seen: set[int] = set()
+    uses: dict[ResultArg, ResultArg] = {}
+
+    def validate_arg(arg: Arg) -> None:
+        if arg is None:
+            raise ValidationError("nil arg")
+        if id(arg) in args_seen:
+            raise ValidationError(f"arg referenced several times in the tree: {arg}")
+        if arg.typ is None:
+            raise ValidationError("no arg type")
+        args_seen.add(id(arg))
+        t = arg.typ
+        if isinstance(arg, ConstArg):
+            if isinstance(t, IntType):
+                if t.dir == Dir.OUT and arg.val not in (0, t.default()):
+                    raise ValidationError(f"out int arg {t.name} has value {arg.val}")
+            elif isinstance(t, ProcType):
+                if arg.val >= t.values_per_proc and arg.val != t.default():
+                    raise ValidationError(f"per-proc arg {t.name} has bad value {arg.val}")
+            elif isinstance(t, CsumType):
+                if arg.val != 0:
+                    raise ValidationError(f"csum arg {t.name} has nonzero value")
+            elif not isinstance(t, (ConstType, FlagsType, LenType)):
+                raise ValidationError(f"const arg has bad type {t.name}")
+            if t.dir == Dir.OUT and not isinstance(t, LenType):
+                if arg.val not in (0, t.default()):
+                    raise ValidationError(
+                        f"output arg {t.field_name}/{t.name} has non-default value")
+        elif isinstance(arg, ResultArg):
+            if not isinstance(t, ResourceType):
+                raise ValidationError(f"result arg has bad type {t.name}")
+            for u in arg.uses:
+                uses[u] = arg
+            if t.dir == Dir.OUT and arg.val not in (0, t.default()):
+                raise ValidationError(f"out resource arg {t.name} has value {arg.val}")
+            if arg.res is not None:
+                if id(arg.res) not in args_seen:
+                    raise ValidationError(
+                        f"result arg {t.name} references out-of-tree result")
+                if arg not in arg.res.uses:
+                    raise ValidationError(f"result arg {t.name} has broken uses link")
+        elif isinstance(arg, DataArg):
+            if not isinstance(t, BufferType):
+                raise ValidationError(f"data arg has bad type {t.name}")
+            if t.dir == Dir.OUT and len(arg.data) != 0:
+                raise ValidationError(f"output arg {t.name} has data")
+            if not t.varlen and t.size() != arg.size():
+                raise ValidationError(
+                    f"data arg {t.name} has size {arg.size()}, want {t.size()}")
+            if t.kind == BufferKind.STRING and t.type_size != 0 and \
+                    arg.size() != t.type_size:
+                raise ValidationError(
+                    f"string arg {t.name} has size {arg.size()}, want {t.type_size}")
+        elif isinstance(arg, GroupArg):
+            if isinstance(t, StructType):
+                if len(arg.inner) != len(t.fields):
+                    raise ValidationError(
+                        f"struct arg {t.name} has {len(arg.inner)} fields, "
+                        f"want {len(t.fields)}")
+            elif isinstance(t, ArrayType):
+                if t.kind == ArrayKind.RANGE_LEN and t.range_begin == t.range_end \
+                        and len(arg.inner) != t.range_begin:
+                    raise ValidationError(
+                        f"array {t.name} has {len(arg.inner)} elems, "
+                        f"want {t.range_begin}")
+            else:
+                raise ValidationError(f"group arg has bad type {t.name}")
+            for sub in arg.inner:
+                validate_arg(sub)
+        elif isinstance(arg, UnionArg):
+            if not isinstance(t, UnionType):
+                raise ValidationError(f"union arg has bad type {t.name}")
+            if not any(arg.option.typ.name == f.name for f in t.fields):
+                raise ValidationError(f"union arg {t.name} has bad option")
+            validate_arg(arg.option)
+        elif isinstance(arg, PointerArg):
+            max_mem = p.target.num_pages * p.target.page_size
+            size = arg.vma_size
+            if size == 0 and arg.res is not None:
+                size = arg.res.size()
+            if arg.address >= max_mem or arg.address + size > max_mem:
+                raise ValidationError(
+                    f"ptr {t.name} has bad address {arg.address:#x}/{size:#x}")
+            if isinstance(t, VmaType):
+                if arg.res is not None:
+                    raise ValidationError(f"vma arg {t.name} has data")
+                if arg.vma_size == 0 and t.dir != Dir.OUT and not t.optional:
+                    raise ValidationError(f"vma arg {t.name} has size 0")
+            elif isinstance(t, PtrType):
+                if arg.res is None and not t.optional:
+                    raise ValidationError(f"non-optional pointer {t.name} is nil")
+                if arg.res is not None:
+                    validate_arg(arg.res)
+                if arg.vma_size != 0:
+                    raise ValidationError(f"pointer arg {t.name} has nonzero vma size")
+                if t.dir == Dir.OUT:
+                    raise ValidationError(f"pointer arg {t.name} is output")
+            else:
+                raise ValidationError(f"ptr arg has bad type {t.name}")
+        else:
+            raise ValidationError(f"unknown arg kind {arg!r}")
+
+    for c in p.calls:
+        if c.meta is None:
+            raise ValidationError("call without meta")
+        if len(c.args) != len(c.meta.args):
+            raise ValidationError(
+                f"{c.meta.name}: want {len(c.meta.args)} args, got {len(c.args)}")
+        for arg in c.args:
+            validate_arg(arg)
+        # return value
+        if c.meta.ret is None:
+            if c.ret is not None:
+                raise ValidationError(f"{c.meta.name}: return value without type")
+        else:
+            if c.ret is None:
+                raise ValidationError(f"{c.meta.name}: return value is absent")
+            if c.ret.typ is not c.meta.ret:
+                raise ValidationError(f"{c.meta.name}: wrong return type")
+            if c.ret.typ.dir != Dir.OUT:
+                raise ValidationError(f"{c.meta.name}: return value is not output")
+            if c.ret.res is not None or c.ret.val != 0 or c.ret.op_div != 0 \
+                    or c.ret.op_add != 0:
+                raise ValidationError(f"{c.meta.name}: return value is not empty")
+            validate_arg(c.ret)
+
+    for u in uses:
+        if id(u) not in args_seen:
+            raise ValidationError("use refers to an out-of-tree arg")

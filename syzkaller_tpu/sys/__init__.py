@@ -1,0 +1,13 @@
+"""Syscall description models (the "model families" of the framework).
+
+Each OS target is described either via the Python builder API
+(sys/builder.py) or compiled from syzlang description files
+(compiler/).  Importing this package registers the built-in targets:
+
+  test/64   hermetic fake OS exercising every type-system feature
+            (the unit-test target; reference: sys/test)
+  linux/amd64  subset of the linux model (grown over time)
+"""
+
+from syzkaller_tpu.sys import testtarget  # noqa: F401  (registers test/64)
+from syzkaller_tpu.sys import linux  # noqa: F401  (registers linux/amd64)

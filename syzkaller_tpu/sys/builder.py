@@ -1,0 +1,415 @@
+"""Python builder API for syscall description models.
+
+The builder is the programmatic backend the syzlang compiler lowers
+into; it owns type instantiation (per-direction copies of named
+structs, as in the reference where StructKey = (name, dir);
+reference: prog/types.go:343-351) and drives the layout engine.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field as dc_field
+from typing import Callable, Optional, Union
+
+from syzkaller_tpu.compiler.layout import SIZE_UNASSIGNED, LayoutAttrs, LayoutEngine
+from syzkaller_tpu.models.prog import Call, ConstArg, PointerArg
+from syzkaller_tpu.models.target import Target, register_target
+from syzkaller_tpu.models.types import (
+    ArrayKind,
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumKind,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntKind,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceDesc,
+    ResourceType,
+    StructType,
+    Syscall,
+    TextKind,
+    Type,
+    UnionType,
+    VmaType,
+)
+
+# A TypeSpec is a factory: (builder, dir, field_name, memo) -> Type.
+TypeSpec = Callable[["TargetBuilder", Dir, str, dict], Type]
+
+
+def opt(spec: "TypeSpec") -> "TypeSpec":
+    """Mark the produced type optional (syzlang [opt] attribute)."""
+
+    def wrapper(b, d, fname, memo) -> Type:
+        t = spec(b, d, fname, memo)
+        t.optional = True
+        return t
+
+    return wrapper
+
+
+def int8(**kw) -> TypeSpec:
+    return _int_spec(1, **kw)
+
+
+def int16(**kw) -> TypeSpec:
+    return _int_spec(2, **kw)
+
+
+def int32(**kw) -> TypeSpec:
+    return _int_spec(4, **kw)
+
+
+def int64(**kw) -> TypeSpec:
+    return _int_spec(8, **kw)
+
+
+def intptr(**kw) -> TypeSpec:
+    return _int_spec(8, name="intptr", **kw)
+
+
+def _int_spec(size: int, name: str = "", be: bool = False, bits: int = 0,
+              range: Optional[tuple[int, int]] = None,
+              fileoff: bool = False) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        kind = IntKind.PLAIN
+        rb = re = 0
+        if range is not None:
+            kind, (rb, re) = IntKind.RANGE, range
+        elif fileoff:
+            kind = IntKind.FILEOFF
+        n = name or f"int{size * 8}{'be' if be else ''}"
+        return IntType(name=n, field_name=fname, type_size=size, dir=d,
+                       big_endian=be, bitfield_len=bits, kind=kind,
+                       range_begin=rb, range_end=re)
+
+    return spec
+
+
+def const(val: int, size: int = 8, name: str = "", be: bool = False,
+          bits: int = 0) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        return ConstType(name=name or f"const{size * 8}", field_name=fname,
+                         type_size=size, dir=d, val=val, big_endian=be,
+                         bitfield_len=bits)
+
+    return spec
+
+
+def flags(vals: Union[str, tuple[int, ...]], size: int = 8, be: bool = False,
+          bits: int = 0) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        vv = b._flag_sets[vals] if isinstance(vals, str) else tuple(vals)
+        return FlagsType(name=vals if isinstance(vals, str) else "flags",
+                         field_name=fname, type_size=size, dir=d, vals=vv,
+                         big_endian=be, bitfield_len=bits)
+
+    return spec
+
+
+def len_of(buf: str, size: int = 8, be: bool = False, bits: int = 0) -> TypeSpec:
+    return _len_spec(buf, 0, size, be, bits)
+
+
+def bytesize_of(buf: str, size: int = 8, unit: int = 1, be: bool = False) -> TypeSpec:
+    return _len_spec(buf, 8 * unit, size, be, 0)
+
+
+def bitsize_of(buf: str, size: int = 8, be: bool = False) -> TypeSpec:
+    return _len_spec(buf, 1, size, be, 0)
+
+
+def _len_spec(buf: str, bit_size: int, size: int, be: bool, bits: int) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        return LenType(name=f"len", field_name=fname, type_size=size, dir=d,
+                       bit_size=bit_size, buf=buf, big_endian=be,
+                       bitfield_len=bits)
+
+    return spec
+
+
+def proc(start: int, per_proc: int, size: int = 8, opt: bool = False) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        return ProcType(name="proc", field_name=fname, type_size=size, dir=d,
+                        optional=opt, values_start=start,
+                        values_per_proc=per_proc)
+
+    return spec
+
+
+def csum(buf: str, kind: CsumKind = CsumKind.INET, protocol: int = 0,
+         size: int = 2) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        return CsumType(name="csum", field_name=fname, type_size=size, dir=d,
+                        kind=kind, buf=buf, protocol=protocol)
+
+    return spec
+
+
+def vma(range: Optional[tuple[int, int]] = None, opt: bool = False) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        rb, re = range if range is not None else (0, 0)
+        return VmaType(name="vma", field_name=fname, type_size=b.ptr_size,
+                       dir=d, optional=opt, range_begin=rb, range_end=re)
+
+    return spec
+
+
+def ptr(dir_: Dir, elem: Union[str, TypeSpec], opt: bool = False) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        inner = b._instantiate(elem, dir_, "", memo)
+        return PtrType(name="ptr", field_name=fname, type_size=b.ptr_size,
+                       dir=d, optional=opt, elem=inner)
+
+    return spec
+
+
+def array(elem: Union[str, TypeSpec],
+          count: Optional[tuple[int, int] | int] = None) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        inner = b._instantiate(elem, d, "", memo)
+        kind, rb, re = ArrayKind.RAND_LEN, 0, 0
+        if count is not None:
+            kind = ArrayKind.RANGE_LEN
+            rb, re = (count, count) if isinstance(count, int) else count
+        return ArrayType(name="array", field_name=fname,
+                         type_size=SIZE_UNASSIGNED, varlen=False, dir=d,
+                         elem=inner, kind=kind, range_begin=rb, range_end=re)
+
+    return spec
+
+
+def buffer(opt: bool = False) -> TypeSpec:
+    """Random blob (reference BufferBlobRand)."""
+
+    def spec(b, d, fname, memo) -> Type:
+        return BufferType(name="buffer", field_name=fname, varlen=True, dir=d,
+                          optional=opt, kind=BufferKind.BLOB_RAND)
+
+    return spec
+
+
+def blob_range(begin: int, end: int) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        varlen = begin != end
+        return BufferType(name="buffer", field_name=fname, varlen=varlen,
+                          type_size=0 if varlen else begin, dir=d,
+                          kind=BufferKind.BLOB_RANGE, range_begin=begin,
+                          range_end=end)
+
+    return spec
+
+
+def string(values: Union[str, tuple[bytes, ...], None] = None,
+           size: int = 0, no_z: bool = False, sub_kind: str = "") -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        vv: tuple[bytes, ...] = ()
+        sk = sub_kind
+        if isinstance(values, str):
+            vv = b._string_sets[values]
+            sk = values
+        elif values is not None:
+            vv = tuple(v if isinstance(v, bytes) else v.encode() for v in values)
+        if vv and not no_z:
+            # Zero-terminate, then pad to the explicit size
+            # (reference: pkg/compiler/types.go:492-514).
+            vv = tuple(v + b"\x00" * max(1, size - len(v)) for v in vv)
+        return BufferType(name="string", field_name=fname, dir=d,
+                          varlen=size == 0, type_size=size,
+                          kind=BufferKind.STRING, values=vv, no_z=no_z,
+                          sub_kind=sk)
+
+    return spec
+
+
+def filename(size: int = 0, no_z: bool = False) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        return BufferType(name="filename", field_name=fname, dir=d,
+                          varlen=size == 0, type_size=size,
+                          kind=BufferKind.FILENAME, no_z=no_z)
+
+    return spec
+
+
+def text(kind: TextKind) -> TypeSpec:
+    def spec(b, d, fname, memo) -> Type:
+        return BufferType(name="text", field_name=fname, dir=d, varlen=True,
+                          kind=BufferKind.TEXT, text=kind)
+
+    return spec
+
+
+def res(name: str, opt: bool = False) -> TypeSpec:
+    """Reference to a named resource."""
+
+    def spec(b, d, fname, memo) -> Type:
+        desc = b._resources[name]
+        base = desc["base_size"]
+        return ResourceType(name=name, field_name=fname, type_size=base,
+                            dir=d, optional=opt)
+
+    return spec
+
+
+@dataclass
+class _StructDef:
+    name: str
+    fields: list[tuple[str, Union[str, TypeSpec]]]
+    is_union: bool
+    attrs: LayoutAttrs
+
+
+class TargetBuilder:
+    def __init__(self, os: str, arch: str, ptr_size: int = 8,
+                 page_size: int = 4096, num_pages: int = 4096,
+                 data_offset: int = 0x20000000):
+        self.os = os
+        self.arch = arch
+        self.ptr_size = ptr_size
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.data_offset = data_offset
+        self._structs: dict[str, _StructDef] = {}
+        self._resources: dict[str, dict] = {}
+        self._flag_sets: dict[str, tuple[int, ...]] = {}
+        self._string_sets: dict[str, tuple[bytes, ...]] = {}
+        self._syscalls: list[tuple[str, int, list, Optional[str]]] = []
+        self._layout_copies: list[tuple[Type, Type]] = []
+        self.string_dictionary: list[str] = []
+        self.special_types: dict[str, Callable] = {}
+        self.make_mmap: Optional[Callable] = None
+        self.sanitize_call: Callable[[Call], None] = lambda c: None
+
+    # -- declarations ----------------------------------------------------
+
+    def flag_set(self, name: str, *vals: int) -> None:
+        self._flag_sets[name] = tuple(vals)
+
+    def string_set(self, name: str, *vals) -> None:
+        self._string_sets[name] = tuple(
+            v if isinstance(v, bytes) else v.encode() for v in vals)
+
+    def resource(self, name: str, base_size: int, values: tuple[int, ...] = (0,),
+                 parent: Optional[str] = None) -> None:
+        kind: tuple[str, ...] = (name,)
+        if parent is not None:
+            kind = self._resources[parent]["kind"] + (name,)
+        self._resources[name] = dict(name=name, base_size=base_size,
+                                     values=tuple(values), kind=kind)
+
+    def struct(self, name: str, fields: list[tuple[str, Union[str, TypeSpec]]],
+               packed: bool = False, align: int = 0,
+               size: Optional[int] = None) -> None:
+        self._structs[name] = _StructDef(
+            name, fields, False, LayoutAttrs(packed=packed, align=align, size=size))
+
+    def union(self, name: str, fields: list[tuple[str, Union[str, TypeSpec]]],
+              varlen: bool = False, size: Optional[int] = None) -> None:
+        self._structs[name] = _StructDef(
+            name, fields, True, LayoutAttrs(size=size, varlen_attr=varlen))
+
+    def syscall(self, name: str, args: list[tuple[str, Union[str, TypeSpec]]],
+                ret: Optional[str] = None, nr: int = 0) -> None:
+        self._syscalls.append((name, nr, args, ret))
+
+    # -- instantiation ---------------------------------------------------
+
+    def _instantiate(self, spec: Union[str, TypeSpec], d: Dir, fname: str,
+                     memo: dict) -> Type:
+        if isinstance(spec, str):
+            return self._instantiate_named(spec, d, fname, memo)
+        return spec(self, d, fname, memo)
+
+    def _instantiate_named(self, name: str, d: Dir, fname: str, memo: dict) -> Type:
+        if name in self._resources:
+            return res(name)(self, d, fname, memo)
+        sd = self._structs.get(name)
+        assert sd is not None, f"unknown type name {name!r}"
+        key = (name, int(d))
+        cached = memo.get(key)
+        if cached is not None:
+            # Shared layout per (name, dir); per-use copy carries the
+            # field name (as the reference's StructType wrapper does,
+            # reference: prog/types.go:305-331).  Layout results are
+            # synced onto copies after the layout engine runs.
+            t = copy.copy(cached)
+            t.field_name = fname
+            self._layout_copies.append((cached, t))
+            return t
+        cls = UnionType if sd.is_union else StructType
+        t = cls(name=name, field_name=fname, dir=d, type_size=SIZE_UNASSIGNED)
+        memo[key] = t
+        t.fields = [self._instantiate(fs, d, fn, memo) for fn, fs in sd.fields]
+        return t
+
+    # -- build -----------------------------------------------------------
+
+    def build(self, register: bool = True) -> Target:
+        memo: dict = {}
+        syscalls: list[Syscall] = []
+        for name, nr, args, ret_name in self._syscalls:
+            call_name = name.split("$")[0]
+            arg_types = [self._instantiate(spec, Dir.IN, fname, memo)
+                         for fname, spec in args]
+            ret_t: Optional[Type] = None
+            if ret_name is not None:
+                ret_t = self._instantiate_named(ret_name, Dir.OUT, "ret", memo)
+                assert isinstance(ret_t, ResourceType), "ret must be a resource"
+            syscalls.append(Syscall(nr=nr, name=name, call_name=call_name,
+                                    args=arg_types, ret=ret_t))
+        engine = LayoutEngine({sd.name: sd.attrs for sd in self._structs.values()})
+        engine.run(syscalls)
+        for orig, cp in self._layout_copies:
+            cp.type_size = orig.type_size
+            cp.varlen = orig.varlen
+            cp.fields = orig.fields  # type: ignore[attr-defined]
+            if isinstance(orig, StructType):
+                cp.align_attr = orig.align_attr  # type: ignore[attr-defined]
+        resources = [
+            ResourceDesc(name=r["name"], kind=r["kind"], values=r["values"],
+                         type=IntType(name=f"int{r['base_size'] * 8}",
+                                      type_size=r["base_size"]))
+            for r in self._resources.values()
+        ]
+        target = Target(
+            os=self.os, arch=self.arch, ptr_size=self.ptr_size,
+            page_size=self.page_size, num_pages=self.num_pages,
+            data_offset=self.data_offset, syscalls=syscalls,
+            resources=resources,
+            string_dictionary=self.string_dictionary,
+            special_types=self.special_types,
+            sanitize_call=self.sanitize_call,
+        )
+        if self.make_mmap is not None:
+            target.make_mmap = lambda addr, size: self.make_mmap(target, addr, size)
+        else:
+            target.make_mmap = _default_make_mmap(target)
+        target.init()
+        if register:
+            register_target(target)
+        return target
+
+
+def _default_make_mmap(target: Target):
+    """Default mmap-call factory used by targets whose first syscall is
+    an mmap(addr vma, len len[addr]) shape."""
+
+    def make(addr: int, size: int) -> Call:
+        meta = target.syscalls[0]
+        vma_t, len_t = meta.args[0], meta.args[1]
+        page_size = target.page_size
+        npages = size // page_size
+        arg0 = PointerArg.make_vma(vma_t, addr, npages * page_size)
+        arg1 = ConstArg(len_t, npages * page_size)
+        from syzkaller_tpu.models.prog import make_return_arg
+
+        return Call(meta=meta, args=[arg0, arg1], ret=make_return_arg(meta.ret))
+
+    return make

@@ -1,0 +1,160 @@
+"""linux/amd64 description model (growing subset).
+
+The reference describes the full Linux interface in 60+ syzlang files
+(reference: sys/linux/*.txt).  We start from the core file/memory/net
+surface — enough to drive a real executor end-to-end — and grow the
+model over time; descriptions use real amd64 syscall numbers.
+
+Arch hooks follow the reference's linux init
+(reference: sys/linux/init.go:40-149): mmap call factory and call
+sanitization neutralizing dangerous arguments.
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.prog import Call, ConstArg, PointerArg, make_return_arg
+from syzkaller_tpu.models.types import Dir
+from syzkaller_tpu.sys.builder import (
+    TargetBuilder,
+    array,
+    buffer,
+    bytesize_of,
+    const,
+    filename,
+    flags,
+    int16,
+    int32,
+    int64,
+    intptr,
+    len_of,
+    opt,
+    proc,
+    ptr,
+    res,
+    string,
+    vma,
+)
+
+# Constants extracted from the kernel ABI (values are part of the ABI,
+# cf. the reference's .const files produced by syz-extract).
+PROT_READ, PROT_WRITE, PROT_EXEC = 1, 2, 4
+MAP_PRIVATE, MAP_ANONYMOUS, MAP_FIXED = 0x2, 0x20, 0x10
+O_RDONLY, O_WRONLY, O_RDWR, O_CREAT, O_TRUNC, O_APPEND, O_NONBLOCK = (
+    0, 1, 2, 0o100, 0o1000, 0o2000, 0o4000)
+AF_UNIX, AF_INET, AF_INET6, AF_NETLINK = 1, 2, 10, 16
+SOCK_STREAM, SOCK_DGRAM, SOCK_RAW, SOCK_SEQPACKET = 1, 2, 3, 5
+SIGKILL = 9
+
+
+def build_linux_target(register: bool = True):
+    b = TargetBuilder(os="linux", arch="amd64", ptr_size=8, page_size=4096,
+                      num_pages=4096)
+    b.string_dictionary = ["/dev/null", "/proc/self", "lo", "eth0", "sit0"]
+
+    b.flag_set("mmap_prot", PROT_READ, PROT_WRITE, PROT_EXEC)
+    b.flag_set("mmap_flags", MAP_PRIVATE, MAP_ANONYMOUS, MAP_FIXED)
+    b.flag_set("open_flags", O_RDONLY, O_WRONLY, O_RDWR, O_CREAT, O_TRUNC,
+               O_APPEND, O_NONBLOCK)
+    b.flag_set("socket_domain", AF_UNIX, AF_INET, AF_INET6, AF_NETLINK)
+    b.flag_set("socket_type", SOCK_STREAM, SOCK_DGRAM, SOCK_RAW, SOCK_SEQPACKET)
+
+    b.resource("fd", 4, values=(0xFFFFFFFFFFFFFFFF,))
+    b.resource("sock", 4, values=(0xFFFFFFFFFFFFFFFF,), parent="fd")
+    b.resource("pid", 4, values=(0,))
+
+    # mmap is syscall 0 in the table (make_mmap depends on this
+    # builder convention; the wire NR is the real one).
+    b.syscall("mmap", [
+        ("addr", vma()), ("len", len_of("addr")),
+        ("prot", flags("mmap_prot")), ("flags", flags("mmap_flags")),
+        ("fd", const(0xFFFFFFFFFFFFFFFF, 4)), ("offset", const(0, 8)),
+    ], nr=9)
+    b.syscall("open", [
+        ("file", ptr(Dir.IN, filename())), ("flags", flags("open_flags")),
+        ("mode", const(0o644, 4)),
+    ], ret="fd", nr=2)
+    b.syscall("openat", [
+        ("fd", const(0xFFFFFFFFFFFFFF9C, 4)),  # AT_FDCWD
+        ("file", ptr(Dir.IN, filename())), ("flags", flags("open_flags")),
+        ("mode", const(0o644, 4)),
+    ], ret="fd", nr=257)
+    b.syscall("close", [("fd", res("fd"))], nr=3)
+    b.syscall("read", [
+        ("fd", res("fd")), ("buf", ptr(Dir.OUT, buffer())),
+        ("count", len_of("buf")),
+    ], nr=0)
+    b.syscall("write", [
+        ("fd", res("fd")), ("buf", ptr(Dir.IN, buffer())),
+        ("count", bytesize_of("buf")),
+    ], nr=1)
+    b.syscall("lseek", [
+        ("fd", res("fd")), ("offset", intptr(fileoff=True)),
+        ("whence", flags("seek_whence", 4)),
+    ], nr=8)
+    b.flag_set("seek_whence", 0, 1, 2)
+    b.syscall("dup", [("oldfd", res("fd"))], ret="fd", nr=32)
+    b.syscall("dup2", [("oldfd", res("fd")), ("newfd", res("fd"))],
+              ret="fd", nr=33)
+    b.syscall("pipe", [("pipefd", ptr(Dir.OUT, "pipe_fds"))], nr=22)
+    b.struct("pipe_fds", [("rfd", res("fd")), ("wfd", res("fd"))])
+    b.syscall("socket", [
+        ("domain", flags("socket_domain", 4)), ("type", flags("socket_type", 4)),
+        ("proto", const(0, 4)),
+    ], ret="sock", nr=41)
+    b.struct("sockaddr_un", [
+        ("family", const(AF_UNIX, 2)),
+        ("path", filename(size=108)),
+    ], packed=True)
+    b.syscall("bind", [
+        ("fd", res("sock")), ("addr", ptr(Dir.IN, "sockaddr_un")),
+        ("addrlen", bytesize_of("addr", 4)),
+    ], nr=49)
+    b.syscall("listen", [("fd", res("sock")), ("backlog", int32())], nr=50)
+    b.syscall("getpid", [], ret="pid", nr=39)
+    b.syscall("kill", [("pid", res("pid")), ("sig", const(0, 4))], nr=62)
+    b.syscall("munmap", [("addr", vma()), ("len", len_of("addr"))], nr=11)
+    b.syscall("mprotect", [
+        ("addr", vma()), ("len", len_of("addr")), ("prot", flags("mmap_prot")),
+    ], nr=10)
+    b.syscall("ioctl", [
+        ("fd", res("fd")), ("cmd", intptr()), ("arg", opt(intptr())),
+    ], nr=16)
+    b.syscall("fcntl", [
+        ("fd", res("fd")), ("cmd", int32(range=(0, 16))), ("arg", opt(intptr())),
+    ], nr=72)
+    b.syscall("fsync", [("fd", res("fd"))], nr=74)
+    b.syscall("ftruncate", [("fd", res("fd")), ("len", intptr(fileoff=True))],
+              nr=77)
+    b.syscall("unlink", [("file", ptr(Dir.IN, filename()))], nr=87)
+    b.syscall("mkdir", [
+        ("file", ptr(Dir.IN, filename())), ("mode", const(0o755, 4)),
+    ], nr=83)
+
+    def sanitize(c: Call) -> None:
+        # Neutralize dangerous calls (reference: sys/linux/init.go:100-148):
+        # don't let the fuzzer kill arbitrary processes or mmap FIXED over
+        # the program's own mappings at address 0.
+        if c.meta.call_name == "kill" and len(c.args) >= 2:
+            sig = c.args[1]
+            if isinstance(sig, ConstArg) and sig.val == SIGKILL:
+                sig.val = 0
+
+    b.sanitize_call = sanitize
+
+    def make_mmap(target, addr: int, size: int) -> Call:
+        meta = target.syscalls[0]
+        a = [
+            PointerArg.make_vma(meta.args[0], addr, size),
+            ConstArg(meta.args[1], size),
+            ConstArg(meta.args[2], PROT_READ | PROT_WRITE),
+            ConstArg(meta.args[3], MAP_ANONYMOUS | MAP_PRIVATE | MAP_FIXED),
+            ConstArg(meta.args[4], 0xFFFFFFFFFFFFFFFF),
+            ConstArg(meta.args[5], 0),
+        ]
+        return Call(meta=meta, args=a, ret=make_return_arg(meta.ret))
+
+    b.make_mmap = make_mmap
+    return b.build(register=register)
+
+
+target = build_linux_target()

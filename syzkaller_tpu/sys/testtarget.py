@@ -1,0 +1,394 @@
+"""The hermetic "test" OS: a synthetic syscall table exercising every
+type-system feature with no kernel behind it.
+
+This is the unit-test target for the whole framework, mirroring the
+role of the reference's fake OS (reference: sys/test/test.txt,
+sys/targets/targets.go:37-46): alignment/padding, bitfields, unions
+(fixed and varlen), arrays, length fields in all units and paths,
+endianness, vma, proc, strings, checksums, resources with subtyping,
+recursion, and optional args.
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.types import CsumKind, Dir, TextKind
+from syzkaller_tpu.sys.builder import (
+    TargetBuilder,
+    array,
+    bitsize_of,
+    blob_range,
+    buffer,
+    bytesize_of,
+    const,
+    csum,
+    filename,
+    flags,
+    int8,
+    int16,
+    int32,
+    int64,
+    intptr,
+    len_of,
+    opt,
+    proc,
+    ptr,
+    res,
+    string,
+    text,
+    vma,
+)
+
+IPPROTO_TCP = 6
+IPPROTO_UDP = 17
+
+
+def build_test_target(register: bool = True):
+    b = TargetBuilder(os="test", arch="64", ptr_size=8, page_size=4096,
+                      num_pages=4096)
+    b.string_dictionary = ["kernel", "fuzz", "tpu"]
+
+    # mmap must be syscall 0 for make_mmap (see builder._default_make_mmap).
+    b.syscall("tz_mmap", [("addr", vma()), ("len", len_of("addr"))])
+    b.syscall("tz_nop", [])
+
+    # -- integers ------------------------------------------------------
+    b.syscall("tz_nop$ints", [
+        ("a0", intptr()), ("a1", int8()), ("a2", int16()),
+        ("a3", int32()), ("a4", int64()),
+    ])
+    b.syscall("tz_nop$ranges", [
+        ("lo", int32(range=(0, 10))),
+        ("hi", int64(range=(100, 1 << 40))),
+        ("off", int64(fileoff=True)),
+    ])
+    b.syscall("tz_nop$be", [
+        ("a0", int16(be=True)), ("a1", int32(be=True)), ("a2", int64(be=True)),
+    ])
+
+    # -- optional args -------------------------------------------------
+    b.syscall("tz_opt$scalar", [("a0", opt(intptr()))])
+    b.syscall("tz_opt$ptr", [("a0", ptr(Dir.IN, intptr(), opt=True))])
+    b.syscall("tz_opt$vma", [("a0", vma(opt=True))])
+    b.syscall("tz_opt$proc", [("a0", proc(100, 4, opt=True))])
+
+    # -- alignment & padding -------------------------------------------
+    b.struct("pad_natural", [
+        ("p0", int16()), ("p1", int32()), ("p2", int8()),
+        ("p3", int16()), ("p4", int64()),
+    ])
+    b.struct("pad_packed", [
+        ("p0", int16()), ("p1", int32()), ("p2", int8()),
+        ("p3", int16()), ("p4", int64()),
+    ], packed=True)
+    b.struct("pad_inner_packed", [("q0", array(int16(), 1))], packed=True)
+    b.struct("pad_inner_plain", [("q0", array(int16(), 1))])
+    b.struct("pad_mixed", [
+        ("m0", int8()), ("m1", "pad_inner_packed"), ("m2", "pad_inner_plain"),
+    ])
+    b.struct("align_one", [("a0", int8())])
+    b.struct("align_four", [("a0", int8())], align=4)
+    b.struct("align_host", [
+        ("h0", int8()), ("h1", "align_one"), ("h2", "align_four"),
+    ])
+    b.struct("packed_aligned", [("x0", int8()), ("x1", int16())],
+             packed=True, align=4)
+    b.struct("pa_host", [("y0", "packed_aligned"), ("y1", int8())])
+    b.struct("tail_varlen", [("t0", int8()), ("t1", array(int32()))])
+    b.syscall("tz_align$natural", [("a0", ptr(Dir.IN, "pad_natural"))])
+    b.syscall("tz_align$packed", [("a0", ptr(Dir.IN, "pad_packed"))])
+    b.syscall("tz_align$mixed", [("a0", ptr(Dir.IN, "pad_mixed"))])
+    b.syscall("tz_align$attr", [("a0", ptr(Dir.IN, "align_host"))])
+    b.syscall("tz_align$packed_aligned", [("a0", ptr(Dir.IN, "pa_host"))])
+    b.syscall("tz_align$tail", [("a0", ptr(Dir.IN, "tail_varlen"))])
+
+    # -- structs -------------------------------------------------------
+    b.struct("nested_inner", [("i0", int8())])
+    b.struct("nested_outer", [("o0", int64()), ("o1", "nested_inner")])
+    b.syscall("tz_struct", [("a0", ptr(Dir.IN, "nested_outer"))])
+
+    # -- unions --------------------------------------------------------
+    b.union("u_fixed", [
+        ("v0", int64()), ("v1", array(int64(), 10)), ("v2", int8()),
+    ])
+    b.struct("u_fixed_host", [("f", int64()), ("u", "u_fixed")])
+    b.union("u_varlen", [("v0", int64()), ("v1", int32())], varlen=True)
+    b.struct("u_varlen_host", [("u", "u_varlen"), ("tail", int8())], packed=True)
+    b.union("u_arg", [
+        ("w0", int8()), ("w1", int64()), ("w2", ptr(Dir.IN, int32())),
+        ("w3", res("fd")), ("w4", const(1, 8)),
+        ("w5", flags("len_flags", 4)), ("w6", proc(0, 1, 2)),
+    ])
+    b.syscall("tz_union$fixed", [("a0", ptr(Dir.IN, "u_fixed_host"))])
+    b.syscall("tz_union$varlen", [("a0", ptr(Dir.IN, "u_varlen_host"))])
+    b.syscall("tz_union$arg", [("a0", "u_arg")])
+
+    # -- arrays --------------------------------------------------------
+    b.union("arr_elem", [("e0", int16()), ("e1", int64())], varlen=True)
+    b.struct("arr_mid", [
+        ("r0", int8()), ("r1", array("arr_elem", (1, 2))), ("r2", int64()),
+    ], packed=True)
+    b.struct("arr_tail", [("r0", int8()), ("r1", array(int8(), (4, 8)))])
+    b.struct("arr_fixed", [
+        ("r0", int16()), ("r1", array(int8(), 16)), ("r2", int16()),
+    ])
+    b.syscall("tz_array$mid", [("a0", ptr(Dir.IN, "arr_mid"))])
+    b.syscall("tz_array$tail", [("a0", ptr(Dir.IN, "arr_tail"))])
+    b.syscall("tz_array$fixed", [("a0", ptr(Dir.IN, "arr_fixed"))])
+
+    # -- length fields -------------------------------------------------
+    b.flag_set("len_flags", 0, 1)
+    b.struct("len_sibling", [("f0", int16()), ("f1", len_of("f0", 2))])
+    b.struct("len_of_len", [
+        ("f0", int32()), ("f1", len_of("f0", 2)), ("f2", len_of("f1", 2)),
+    ])
+    b.struct("len_mutual", [("f0", len_of("f1", 2)), ("f1", len_of("f0", 2))])
+    b.struct("len_parent", [("f0", int16()), ("f1", len_of("parent", 2))])
+    b.struct("len_array", [
+        ("f0", array(int16(), 4)), ("f1", len_of("f0", 2)),
+        ("f2", bytesize_of("f0", 2)),
+    ])
+    b.struct("len_units", [
+        ("f0", array(int64(), 2)),
+        ("f1", len_of("f0", 1)),
+        ("f2", bytesize_of("f0", 1)),
+        ("f3", bytesize_of("f0", 1, unit=2)),
+        ("f4", bytesize_of("f0", 1, unit=4)),
+        ("f5", bytesize_of("f0", 1, unit=8)),
+    ])
+    b.struct("len_deep_inner", [
+        ("g0", int8()), ("g1", len_of("g0", 1)), ("g2", len_of("parent", 2)),
+        ("g3", array(int32(), 3)),
+    ])
+    b.struct("len_deep", [
+        ("f0", len_of("parent", 8)),
+        ("f1", "len_deep_inner"),
+        ("f2", array("len_deep_inner", 1)),
+        ("f3", len_of("f1", 4)),
+        ("f4", len_of("f2", 2)),
+        ("f5", array(int16())),
+    ])
+    b.struct("len_named_inner2", [
+        ("n1", len_of("parent", 1)),
+        ("n2", len_of("len_named_inner2", 1)),
+        ("n3", len_of("len_named_inner", 1)),
+        ("n4", len_of("len_named", 1)),
+    ])
+    b.struct("len_named_inner", [
+        ("n0", "len_named_inner2"),
+        ("n1", len_of("parent", 1)),
+        ("n2", len_of("len_named_inner", 1)),
+        ("n3", len_of("len_named", 1)),
+    ])
+    b.struct("len_named", [
+        ("n0", "len_named_inner"),
+        ("n1", len_of("parent", 1)),
+        ("n2", len_of("len_named", 1)),
+    ])
+    b.struct("len_vma", [("f0", vma()), ("f1", len_of("f0", 8))])
+    b.struct("big_struct", [
+        ("b0", int64()), ("b1", int64()), ("b2", array(int32(), 8)),
+    ])
+    b.syscall("tz_len$sibling", [("a0", ptr(Dir.IN, "len_sibling"))])
+    b.syscall("tz_len$len_of_len", [("a0", ptr(Dir.IN, "len_of_len"))])
+    b.syscall("tz_len$mutual", [("a0", ptr(Dir.IN, "len_mutual"))])
+    b.syscall("tz_len$parent", [("a0", ptr(Dir.IN, "len_parent"))])
+    b.syscall("tz_len$array", [("a0", ptr(Dir.IN, "len_array"))])
+    b.syscall("tz_len$units", [("a0", ptr(Dir.IN, "len_units"))])
+    b.syscall("tz_len$deep", [("a0", ptr(Dir.IN, "len_deep"))])
+    b.syscall("tz_len$named", [("a0", ptr(Dir.IN, "len_named"))])
+    b.syscall("tz_len$vma_struct", [("a0", ptr(Dir.IN, "len_vma"))])
+    b.syscall("tz_len$of_arg", [("a0", int16()), ("a1", len_of("a0"))])
+    b.syscall("tz_len$of_ptr", [
+        ("a0", ptr(Dir.IN, "big_struct")), ("a1", len_of("a0")),
+    ])
+    b.syscall("tz_len$of_opt_ptr", [
+        ("a0", ptr(Dir.IN, "big_struct", opt=True)), ("a1", len_of("a0")),
+    ])
+    b.syscall("tz_len$inout", [
+        ("a0", ptr(Dir.INOUT, "big_struct")),
+        ("a1", ptr(Dir.INOUT, len_of("a0", 8))),
+    ])
+    b.syscall("tz_len$vma", [
+        ("v0", vma()), ("l0", len_of("v0")),
+        ("b0", bytesize_of("v0", 8)), ("b2", bytesize_of("v0", 8, unit=2)),
+    ])
+    b.syscall("tz_len$bits", [
+        ("a0", ptr(Dir.IN, int64())), ("a1", bitsize_of("a0")),
+    ])
+    b.syscall("tz_len$bits_arr", [
+        ("a0", ptr(Dir.IN, array(int8()))), ("a1", bitsize_of("a0")),
+    ])
+    b.syscall("tz_len$arr_of_arr", [
+        ("a0", ptr(Dir.IN, array(array(int8())))), ("a1", len_of("a0")),
+    ])
+
+    # -- bitfields -----------------------------------------------------
+    b.flag_set("bf_flags", 0, 1, 2)
+    b.struct("bf_primary", [
+        ("c0", flags("bf_flags", 2, bits=10)),
+        ("c1", int64()),
+        ("c2", const(0x42, 2, bits=5)),
+        ("c3", int16(bits=6)),
+        ("c4", const(0x42, 4, bits=15)),
+        ("c5", len_of("parent", 2, bits=11)),
+        ("c6", len_of("parent", 2, be=True, bits=11)),
+        ("c7", int8()),
+    ])
+    b.struct("bf_grouped_inner", [
+        ("c0", int32(bits=10)), ("c1", int32(bits=10)), ("c2", int32(bits=10)),
+    ])
+    b.struct("bf_grouped", [("c0", "bf_grouped_inner"), ("c1", int8())])
+    b.struct("bf_aligned", [
+        ("c0", int8(bits=1)), ("c1", int8(bits=1)), ("c2", int8(bits=1)),
+        ("c3", int16(bits=1)), ("c4", int16(bits=1)), ("c5", int16(bits=1)),
+    ], packed=True, align=8)
+    b.struct("bf_host", [("c0", "bf_aligned"), ("c1", int8())])
+    b.struct("bf_len", [
+        ("c0", int32(bits=10)), ("c1", int32(bits=10)), ("c2", int32(bits=10)),
+        ("c3", int32(bits=32)), ("c4", int32(bits=16)), ("c5", int32(bits=16)),
+        ("c6", int32(bits=10)), ("c7", len_of("parent", 4, bits=16)),
+    ])
+    b.struct("bf_len_host", [
+        ("c0", "bf_len"), ("c1", len_of("c0", 1)), ("c2", bytesize_of("c0", 1)),
+        ("c3", bytesize_of("c0", 1, unit=4)),
+    ])
+    b.syscall("tz_bf$primary", [("a0", ptr(Dir.IN, "bf_primary"))])
+    b.syscall("tz_bf$grouped", [("a0", ptr(Dir.IN, "bf_grouped"))])
+    b.syscall("tz_bf$aligned", [("a0", ptr(Dir.IN, "bf_host"))])
+    b.syscall("tz_bf$len", [("a0", ptr(Dir.IN, "bf_len_host"))])
+
+    # -- big endian structs --------------------------------------------
+    b.flag_set("end_flags", 0, 1)
+    b.struct("be_ints", [
+        ("e0", int8()), ("e1", int16(be=True)), ("e2", int32(be=True)),
+        ("e3", int64(be=True)),
+    ], packed=True)
+    b.struct("be_var", [
+        ("e0", len_of("parent", 2, be=True)),
+        ("e1", const(0x42, 4, be=True)),
+        ("e2", flags("end_flags", 8, be=True)),
+    ], packed=True)
+    b.syscall("tz_be$ints", [("a0", ptr(Dir.IN, "be_ints"))])
+    b.syscall("tz_be$var", [("a0", ptr(Dir.IN, "be_var"))])
+
+    # -- vma -----------------------------------------------------------
+    b.syscall("tz_vma", [
+        ("v0", vma()), ("l0", len_of("v0")),
+        ("v1", vma(range=(5, 5))), ("l1", len_of("v1")),
+        ("v2", vma(range=(7, 9))), ("l2", len_of("v2")),
+    ])
+
+    # -- text ----------------------------------------------------------
+    b.syscall("tz_text$x86_real", [
+        ("a0", ptr(Dir.IN, text(TextKind.X86_REAL))), ("a1", len_of("a0")),
+    ])
+    b.syscall("tz_text$x86_64", [
+        ("a0", ptr(Dir.IN, text(TextKind.X86_64))), ("a1", len_of("a0")),
+    ])
+
+    # -- buffers & strings ---------------------------------------------
+    b.string_set("greet_strings", "hey", "folks")
+    b.struct("str_sized", [
+        ("s1", string("greet_strings", size=10)),
+        ("s2", string("greet_strings", size=8)),
+        ("b1", bytesize_of("s1", 1)),
+        ("b2", bytesize_of("parent", 1)),
+    ])
+    b.struct("fname_fixed", [
+        ("f1", filename(size=10)), ("f2", filename(size=20)),
+        ("b1", bytesize_of("f1", 1)), ("b2", bytesize_of("f2", 1)),
+        ("b3", bytesize_of("parent", 1)),
+    ])
+    b.syscall("tz_buf$blob", [("a0", ptr(Dir.IN, buffer()))])
+    b.syscall("tz_buf$blob_range", [("a0", ptr(Dir.IN, blob_range(16, 64)))])
+    b.syscall("tz_buf$out", [("a0", ptr(Dir.OUT, buffer())), ("a1", len_of("a0"))])
+    b.syscall("tz_buf$str", [("a0", ptr(Dir.IN, string())), ("a1", len_of("a0"))])
+    b.syscall("tz_buf$str_sized", [("a0", ptr(Dir.IN, "str_sized"))])
+    b.syscall("tz_buf$fname", [
+        ("path", ptr(Dir.IN, filename())), ("mode", flags("open_modes")),
+    ])
+    b.syscall("tz_buf$fname_fixed", [("a0", ptr(Dir.IN, "fname_fixed"))])
+    b.flag_set("open_modes", 0xABABABABABABABAB, 0xCDCDCDCDCDCDCDCD)
+
+    # -- checksums -----------------------------------------------------
+    b.struct("csum_plain", [
+        ("sum", csum("parent", CsumKind.INET, 0, 2)),
+        ("src", int32(be=True)), ("dst", int32(be=True)),
+    ], packed=True)
+    b.struct("csum_pseudo_hdr", [
+        ("sum", csum("csum_pseudo_pkt", CsumKind.PSEUDO, IPPROTO_TCP, 2)),
+    ], packed=True)
+    b.struct("csum_pseudo_pkt", [
+        ("hdr", "csum_pseudo_hdr"), ("payload", array(int8())),
+    ], packed=True)
+    b.struct("csum_pseudo_host", [
+        ("outer", "csum_plain"), ("inner", "csum_pseudo_pkt"),
+    ], packed=True)
+    b.syscall("tz_csum$inet", [("a0", ptr(Dir.IN, "csum_plain"))])
+    b.syscall("tz_csum$pseudo", [("a0", ptr(Dir.IN, "csum_pseudo_host"))])
+
+    # -- recursion -----------------------------------------------------
+    b.struct("rec_self", [("a0", ptr(Dir.IN, "rec_self", opt=True))])
+    b.struct("rec_a", [
+        ("a0", ptr(Dir.IN, "rec_a", opt=True)),
+        ("a1", ptr(Dir.IN, "rec_b", opt=True)),
+    ])
+    b.struct("rec_b", [
+        ("b0", ptr(Dir.IN, "rec_self", opt=True)),
+        ("b1", ptr(Dir.IN, "rec_a", opt=True)),
+        ("b2", ptr(Dir.IN, "rec_b", opt=True)),
+    ])
+    b.syscall("tz_recur$self", [("a0", ptr(Dir.INOUT, "rec_self"))])
+    b.syscall("tz_recur$mutual", [("a0", ptr(Dir.INOUT, "rec_b"))])
+
+    # -- resources -----------------------------------------------------
+    b.resource("fd", 4, values=(0xFFFFFFFFFFFFFFFF,))
+    b.resource("token", 4, values=(0xFFFF,))
+    b.resource("token_big", 4, values=(0xFFFF0000,), parent="token")
+    b.syscall("tz_res$make", [], ret="token")
+    b.syscall("tz_res$make_big", [], ret="token_big")
+    b.syscall("tz_res$use", [("t", res("token"))])
+    b.syscall("tz_res$use_big", [("t", res("token_big"))])
+    b.syscall("tz_res$open", [("path", ptr(Dir.IN, filename()))], ret="fd")
+    b.syscall("tz_res$close", [("f", res("fd"))])
+    b.syscall("tz_res$write", [
+        ("f", res("fd")), ("buf", ptr(Dir.IN, buffer())),
+        ("n", bytesize_of("buf")),
+    ])
+    b.syscall("tz_res$out_arg", [("t", ptr(Dir.OUT, res("token")))])
+
+    # -- proc ----------------------------------------------------------
+    b.syscall("tz_proc", [("a0", proc(100, 4, 2))])
+
+    # -- hints / mutation workhorses -----------------------------------
+    b.syscall("tz_hint$data", [("a0", ptr(Dir.IN, array(int8())))])
+    b.syscall("tz_mut$vec", [
+        ("vec", ptr(Dir.IN, array(int32(range=(0, 1))))), ("vlen", len_of("vec")),
+    ])
+    b.syscall("tz_mut$blob", [
+        ("data", ptr(Dir.IN, array(int8()))), ("size", bytesize_of("data")),
+    ])
+    b.syscall("tz_mut$fd_blob", [
+        ("f", res("fd")), ("data", ptr(Dir.IN, array(int8()))),
+        ("size", bytesize_of("data")),
+    ])
+    b.syscall("tz_mut$str", [("a0", ptr(Dir.IN, string())), ("a1", len_of("a0"))])
+    b.syscall("tz_mut$proc", [("a0", proc(100, 4, opt=True))])
+
+    # -- serialization corner cases ------------------------------------
+    b.struct("out_inner", [("f0", buffer())])
+    b.syscall("tz_ser$out_struct", [("a0", ptr(Dir.INOUT, "out_inner"))])
+    b.syscall("tz_ser$out_arr", [
+        ("a", ptr(Dir.OUT, array(int8()))), ("b", len_of("a")),
+    ])
+    b.struct("one_field", [("f1", int8())])
+    b.union("one_union", [("f1", int8())])
+    b.syscall("tz_ser$args0", [])
+    b.syscall("tz_ser$args1", [("a1", int8())])
+    b.syscall("tz_ser$fields", [("a1", ptr(Dir.IN, "one_field"))])
+    b.syscall("tz_ser$union", [("a1", ptr(Dir.IN, "one_union"))])
+
+    return b.build(register=register)
+
+
+target = build_test_target()

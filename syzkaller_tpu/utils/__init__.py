@@ -1,0 +1,2 @@
+"""Cross-cutting utilities: int helpers, hashing, append-only DB,
+strict config loading, logging, x86 text generation."""

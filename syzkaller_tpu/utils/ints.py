@@ -1,0 +1,39 @@
+"""Fixed-width integer helpers (reference: prog/mutation.go:523-611)."""
+
+from __future__ import annotations
+
+MASK64 = (1 << 64) - 1
+
+
+def mask(width: int) -> int:
+    return (1 << (8 * width)) - 1
+
+
+def swap_int(v: int, width: int) -> int:
+    """Byte-swap the low `width` bytes of v."""
+    if width == 1:
+        return v & 0xFF
+    return int.from_bytes((v & mask(width)).to_bytes(width, "little"), "big")
+
+
+def swap64(v: int) -> int:
+    return swap_int(v, 8)
+
+
+def load_int(data: bytes | bytearray, offset: int, width: int) -> int:
+    """Little-endian load (reference: prog/mutation.go:581-595)."""
+    return int.from_bytes(data[offset:offset + width], "little")
+
+
+def store_int(data: bytearray, offset: int, v: int, width: int) -> None:
+    """Little-endian store (reference: prog/mutation.go:597-611)."""
+    data[offset:offset + width] = (v & mask(width)).to_bytes(width, "little")
+
+
+def u64(v: int) -> int:
+    return v & MASK64
+
+
+def s64(v: int) -> int:
+    v &= MASK64
+    return v - (1 << 64) if v >= (1 << 63) else v

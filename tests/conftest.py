@@ -1,0 +1,52 @@
+"""Test harness configuration.
+
+Tests run on CPU with a virtual 8-device mesh so multi-chip sharding
+paths compile and execute without TPU hardware; the bench path runs on
+the real chip separately (bench.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+import syzkaller_tpu.models.validation as validation  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _debug_validation():
+    # Validate program structure after every random op in tests
+    # (reference: prog/export_test.go:15-17).
+    validation.debug = True
+    yield
+    validation.debug = False
+
+
+@pytest.fixture
+def test_target():
+    from syzkaller_tpu.models.target import get_target
+
+    return get_target("test", "64")
+
+
+@pytest.fixture
+def linux_target():
+    from syzkaller_tpu.models.target import get_target
+
+    return get_target("linux", "amd64")
+
+
+def pytest_addoption(parser):
+    parser.addoption("--iters", type=int, default=None,
+                     help="iterations for randomized tests")
+
+
+@pytest.fixture
+def iters(request):
+    n = request.config.getoption("--iters")
+    return n if n is not None else 30
