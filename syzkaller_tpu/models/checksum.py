@@ -1,0 +1,147 @@
+"""Inet/pseudo-header checksum dependency graph.
+
+Checksums are computed at runtime by the executor; here we only build
+the instruction graph describing what to checksum
+(reference: prog/checksum.go:10-167).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from syzkaller_tpu.models.prog import Arg, Call, GroupArg, foreach_arg, inner_arg
+from syzkaller_tpu.models.types import CsumKind, CsumType, StructType
+from syzkaller_tpu.utils.ints import swap_int
+
+
+class CsumChunkKind(enum.IntEnum):
+    ARG = 0
+    CONST = 1
+
+
+@dataclass
+class CsumChunk:
+    kind: CsumChunkKind
+    arg: Optional[Arg] = None  # for ARG
+    value: int = 0  # for CONST
+    size: int = 0  # for CONST
+
+
+@dataclass
+class CsumInfo:
+    kind: CsumKind
+    chunks: list[CsumChunk] = field(default_factory=list)
+
+
+def calc_checksums_call(c: Call) -> Optional[dict[int, tuple[Arg, CsumInfo]]]:
+    """Returns {id(csum_arg): (csum_arg, info)} or None
+    (reference: prog/checksum.go:29-113)."""
+    inet_fields: list[Arg] = []
+    pseudo_fields: list[Arg] = []
+
+    def find(arg, ctx) -> None:
+        t = arg.typ
+        if isinstance(t, CsumType):
+            if t.kind == CsumKind.INET:
+                inet_fields.append(arg)
+            elif t.kind == CsumKind.PSEUDO:
+                pseudo_fields.append(arg)
+            else:
+                raise ValueError(f"unknown csum kind {t.kind}")
+
+    foreach_arg(c, find)
+    if not inet_fields and not pseudo_fields:
+        return None
+
+    parents: dict[int, Arg] = {}
+
+    def note_parents(arg, ctx) -> None:
+        if isinstance(arg.typ, StructType):
+            assert isinstance(arg, GroupArg)
+            for f in arg.inner:
+                fi = inner_arg(f)
+                if fi is not None:
+                    parents[id(fi)] = arg
+
+    foreach_arg(c, note_parents)
+
+    csum_map: dict[int, tuple[Arg, CsumInfo]] = {}
+    for arg in inet_fields:
+        t = arg.typ
+        assert isinstance(t, CsumType)
+        csummed = _find_csummed_arg(arg, t, parents)
+        info = CsumInfo(kind=CsumKind.INET,
+                        chunks=[CsumChunk(CsumChunkKind.ARG, csummed)])
+        csum_map[id(arg)] = (arg, info)
+
+    if not pseudo_fields:
+        return csum_map
+
+    # Locate the enclosing ipv4/ipv6 header to source the pseudo-header
+    # address fields (reference: prog/checksum.go:79-96).  Recognized by
+    # the conventional src_ip/dst_ip field names and sizes.
+    ip_src = ip_dst = None
+
+    def find_hdr(arg, ctx) -> None:
+        nonlocal ip_src, ip_dst
+        if not isinstance(arg, GroupArg):
+            return
+        fields = {f.typ.field_name: f for f in arg.inner}
+        src, dst = fields.get("src_ip"), fields.get("dst_ip")
+        if src is None or dst is None:
+            return
+        if src.size() == dst.size() and src.size() in (4, 16):
+            ip_src, ip_dst = src, dst
+
+    foreach_arg(c, find_hdr)
+    assert ip_src is not None and ip_dst is not None, \
+        "no ipv4 nor ipv6 header found"
+
+    for arg in pseudo_fields:
+        t = arg.typ
+        assert isinstance(t, CsumType)
+        csummed = _find_csummed_arg(arg, t, parents)
+        proto = t.protocol & 0xFF
+        if ip_src.size() == 4:
+            info = _pseudo_ipv4(csummed, ip_src, ip_dst, proto)
+        else:
+            info = _pseudo_ipv6(csummed, ip_src, ip_dst, proto)
+        csum_map[id(arg)] = (arg, info)
+    return csum_map
+
+
+def _find_csummed_arg(arg: Arg, typ: CsumType, parents: dict[int, Arg]) -> Arg:
+    """(reference: prog/checksum.go:115-129)"""
+    if typ.buf == "parent":
+        p = parents.get(id(arg))
+        assert p is not None, f"parent for {typ.name} not in parents map"
+        return p
+    p = parents.get(id(arg))
+    while p is not None:
+        if typ.buf == p.typ.name:
+            return p
+        p = parents.get(id(p))
+    raise ValueError(
+        f"csum field {typ.field_name!r} references nonexistent field {typ.buf!r}")
+
+
+def _pseudo_ipv4(pkt: Arg, src: Arg, dst: Arg, proto: int) -> CsumInfo:
+    return CsumInfo(kind=CsumKind.INET, chunks=[
+        CsumChunk(CsumChunkKind.ARG, src),
+        CsumChunk(CsumChunkKind.ARG, dst),
+        CsumChunk(CsumChunkKind.CONST, None, swap_int(proto, 2), 2),
+        CsumChunk(CsumChunkKind.CONST, None, swap_int(pkt.size() & 0xFFFF, 2), 2),
+        CsumChunk(CsumChunkKind.ARG, pkt),
+    ])
+
+
+def _pseudo_ipv6(pkt: Arg, src: Arg, dst: Arg, proto: int) -> CsumInfo:
+    return CsumInfo(kind=CsumKind.INET, chunks=[
+        CsumChunk(CsumChunkKind.ARG, src),
+        CsumChunk(CsumChunkKind.ARG, dst),
+        CsumChunk(CsumChunkKind.CONST, None, swap_int(pkt.size() & 0xFFFFFFFF, 4), 4),
+        CsumChunk(CsumChunkKind.CONST, None, swap_int(proto, 4), 4),
+        CsumChunk(CsumChunkKind.ARG, pkt),
+    ])

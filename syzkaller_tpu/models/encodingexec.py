@@ -1,0 +1,229 @@
+"""Exec wire format: the binary uint64 stream consumed by executors.
+
+This is the contract with the (unchanged) in-VM executor and the
+output format the TPU engine emits for mutated batches.  Layout
+(reference: prog/encodingexec.go:7-51):
+
+  stream   := { copyin | csum-copyin | call | copyout } EOF
+  copyin   := COPYIN addr arg
+  call     := call_id copyout_idx nargs arg*
+  copyout  := COPYOUT idx addr size
+  arg      := const | result | data | csum
+  const    := ARG_CONST meta val            meta = size | be<<8 |
+              bf_off<<16 | bf_len<<24 | pid_stride<<32
+  result   := ARG_RESULT size idx op_div op_add default
+  data     := ARG_DATA len byte* (8-byte padded)
+  csum     := ARG_CSUM size CSUM_INET nchunks
+              { chunk_kind (addr|value) size }*
+"""
+
+from __future__ import annotations
+
+import struct
+
+from syzkaller_tpu.models.checksum import CsumChunkKind, calc_checksums_call
+from syzkaller_tpu.models.prog import (
+    Arg,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    UnionArg,
+    foreach_arg,
+)
+from syzkaller_tpu.models.types import CsumKind, Dir, ResourceType, is_pad
+from syzkaller_tpu.utils.ints import MASK64
+
+EXEC_INSTR_EOF = MASK64
+EXEC_INSTR_COPYIN = MASK64 - 1
+EXEC_INSTR_COPYOUT = MASK64 - 2
+
+EXEC_ARG_CONST = 0
+EXEC_ARG_RESULT = 1
+EXEC_ARG_DATA = 2
+EXEC_ARG_CSUM = 3
+
+EXEC_ARG_CSUM_INET = 0
+EXEC_ARG_CSUM_CHUNK_DATA = 0
+EXEC_ARG_CSUM_CHUNK_CONST = 1
+
+EXEC_BUFFER_SIZE = 2 << 20
+EXEC_NO_COPYOUT = MASK64
+
+
+class ExecBufferTooSmall(Exception):
+    pass
+
+
+class _Writer:
+    def __init__(self, limit: int):
+        self.words: list[int] = []
+        self.limit = limit
+        self.nbytes = 0
+
+    def write(self, v: int) -> None:
+        self.nbytes += 8
+        if self.nbytes > self.limit:
+            raise ExecBufferTooSmall()
+        self.words.append(v & MASK64)
+
+    def write_data(self, data: bytes) -> None:
+        padded = len(data) + (-len(data)) % 8
+        self.nbytes += padded
+        if self.nbytes > self.limit:
+            raise ExecBufferTooSmall()
+        buf = data + bytes(padded - len(data))
+        for i in range(0, padded, 8):
+            self.words.append(int.from_bytes(buf[i:i + 8], "little"))
+
+
+def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE) -> bytes:
+    """Serialize p for execution (reference: prog/encodingexec.go:57-192).
+    Returns the encoded byte stream (little-endian uint64 words)."""
+    from syzkaller_tpu.models import validation
+
+    if validation.debug:
+        validation.validate_prog(p)
+    target = p.target
+    w = _Writer(buffer_size)
+    copyout_seq = 0
+    # arg id -> (addr, copyout idx)
+    args_info: dict[int, dict] = {}
+
+    for c in p.calls:
+        csum_map = calc_checksums_call(c)
+        csum_uses: set[int] = set()
+        if csum_map is not None:
+            for _, (arg, info) in csum_map.items():
+                csum_uses.add(id(arg))
+                if info.kind == CsumKind.INET:
+                    for chunk in info.chunks:
+                        if chunk.kind == CsumChunkKind.ARG:
+                            csum_uses.add(id(chunk.arg))
+
+        # Copyin instructions for everything reachable through pointers.
+        def copyin(arg: Arg, ctx) -> None:
+            if ctx.base is None:
+                return
+            addr = target.physical_addr(ctx.base) + ctx.offset
+            if (isinstance(arg, ResultArg) and len(arg.uses) != 0) \
+                    or id(arg) in csum_uses:
+                args_info[id(arg)] = {"addr": addr}
+            if isinstance(arg, (GroupArg, UnionArg)):
+                return
+            t = arg.typ
+            if t.dir == Dir.OUT or is_pad(t) or arg.size() == 0:
+                return
+            w.write(EXEC_INSTR_COPYIN)
+            w.write(addr)
+            _write_arg(w, target, arg, args_info)
+
+        foreach_arg(c, copyin)
+
+        # Checksum instructions, last-to-first by address since later
+        # checksums feed earlier ones (reference: encodingexec.go:112-152).
+        if csum_map is not None:
+            entries = sorted(csum_map.values(),
+                             key=lambda e: args_info[id(e[0])]["addr"])
+            for arg, info in reversed(entries):
+                w.write(EXEC_INSTR_COPYIN)
+                w.write(args_info[id(arg)]["addr"])
+                w.write(EXEC_ARG_CSUM)
+                w.write(arg.size())
+                assert info.kind == CsumKind.INET
+                w.write(EXEC_ARG_CSUM_INET)
+                w.write(len(info.chunks))
+                for chunk in info.chunks:
+                    if chunk.kind == CsumChunkKind.ARG:
+                        w.write(EXEC_ARG_CSUM_CHUNK_DATA)
+                        w.write(args_info[id(chunk.arg)]["addr"])
+                        w.write(chunk.arg.size())
+                    else:
+                        w.write(EXEC_ARG_CSUM_CHUNK_CONST)
+                        w.write(chunk.value)
+                        w.write(chunk.size)
+
+        # The call itself.
+        w.write(c.meta.id)
+        if c.ret is not None and len(c.ret.uses) != 0:
+            assert id(c.ret) not in args_info, "arg info exists for ret"
+            args_info[id(c.ret)] = {"idx": copyout_seq, "ret": True}
+            w.write(copyout_seq)
+            copyout_seq += 1
+        else:
+            w.write(EXEC_NO_COPYOUT)
+        w.write(len(c.args))
+        for arg in c.args:
+            _write_arg(w, target, arg, args_info)
+
+        # Copyout instructions persisting referenced results.
+        def copyout(arg: Arg, ctx) -> None:
+            nonlocal copyout_seq
+            if isinstance(arg, ResultArg) and len(arg.uses) != 0:
+                info = args_info.get(id(arg), {})
+                if info.get("ret"):
+                    return  # idx already assigned above
+                info["idx"] = copyout_seq
+                copyout_seq += 1
+                args_info[id(arg)] = info
+                w.write(EXEC_INSTR_COPYOUT)
+                w.write(info["idx"])
+                w.write(info.get("addr", 0))
+                w.write(arg.size())
+
+        foreach_arg(c, copyout)
+
+    w.write(EXEC_INSTR_EOF)
+    return b"".join(struct.pack("<Q", v) for v in w.words)
+
+
+def _write_arg(w: _Writer, target, arg: Arg, args_info: dict) -> None:
+    """(reference: prog/encodingexec.go:230-272)"""
+    if isinstance(arg, ConstArg):
+        val, pid_stride, big_endian = arg.value()
+        _write_const_arg(w, arg.size(), val, arg.typ.bitfield_offset(),
+                         arg.typ.bitfield_length(), pid_stride, big_endian)
+    elif isinstance(arg, ResultArg):
+        if arg.res is None:
+            _write_const_arg(w, arg.size(), arg.val, 0, 0, 0, False)
+        else:
+            info = args_info.get(id(arg.res))
+            assert info is not None and "idx" in info, "no copyout index"
+            w.write(EXEC_ARG_RESULT)
+            w.write(arg.size())
+            w.write(info["idx"])
+            w.write(arg.op_div)
+            w.write(arg.op_add)
+            t = arg.typ
+            assert isinstance(t, ResourceType)
+            w.write(t.default())
+    elif isinstance(arg, PointerArg):
+        _write_const_arg(w, arg.size(), target.physical_addr(arg), 0, 0, 0, False)
+    elif isinstance(arg, DataArg):
+        data = bytes(arg.data)
+        w.write(EXEC_ARG_DATA)
+        w.write(len(data))
+        w.write_data(data)
+    elif isinstance(arg, UnionArg):
+        _write_arg(w, target, arg.option, args_info)
+    else:
+        raise TypeError(f"unknown arg type {arg!r}")
+
+
+def _write_const_arg(w: _Writer, size: int, val: int, bf_off: int, bf_len: int,
+                     pid_stride: int, big_endian: bool) -> None:
+    w.write(EXEC_ARG_CONST)
+    meta = size | (bf_off << 16) | (bf_len << 24) | (pid_stride << 32)
+    if big_endian:
+        meta |= 1 << 8
+    w.write(meta)
+    w.write(val)
+
+
+def words_of(stream: bytes) -> list[int]:
+    """Decode a stream back into uint64 words (test/debug helper)."""
+    assert len(stream) % 8 == 0
+    return [int.from_bytes(stream[i:i + 8], "little")
+            for i in range(0, len(stream), 8)]

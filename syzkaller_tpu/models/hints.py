@@ -1,0 +1,143 @@
+"""Comparison-hint mutation.
+
+The kernel's KCOV_TRACE_CMP feed gives us (operand, operand) pairs per
+call; shrink/expand models int truncation/sign-extension/endianness to
+match program bytes against observed operands and substitute the other
+side (reference: prog/hints.go:27-218).  The batched TPU version of
+shrink_expand lives in ops/hints.py and is parity-tested against this.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from syzkaller_tpu.models.prog import (
+    Arg,
+    Call,
+    ConstArg,
+    DataArg,
+    Prog,
+    foreach_arg,
+)
+from syzkaller_tpu.models.rand import SPECIAL_INTS_SET
+from syzkaller_tpu.models.types import CsumType, Dir, ProcType
+from syzkaller_tpu.utils.ints import MASK64, load_int, store_int, swap_int
+
+MAX_DATA_LENGTH = 100
+
+
+class CompMap:
+    """op1 -> set of operands op1 was compared against
+    (reference: prog/hints.go:27-48)."""
+
+    __slots__ = ("m",)
+
+    def __init__(self):
+        self.m: dict[int, set[int]] = {}
+
+    def add_comp(self, arg1: int, arg2: int) -> None:
+        self.m.setdefault(arg1 & MASK64, set()).add(arg2 & MASK64)
+
+    def __len__(self) -> int:
+        return len(self.m)
+
+    def __str__(self) -> str:
+        return ", ".join(
+            f"0x{v:x}: " + " ".join(f"0x{c:x}" for c in comps)
+            for v, comps in self.m.items())
+
+
+def mutate_with_hints(p: Prog, call_index: int, comps: CompMap,
+                      exec_cb: Callable[[Prog], None]) -> None:
+    """For every matchable arg byte-window of call `call_index`, execute
+    each replacement mutant (reference: prog/hints.go:66-80)."""
+    p = p.clone()
+    c = p.calls[call_index]
+
+    def exec_validate() -> None:
+        from syzkaller_tpu.models import validation
+
+        if validation.debug:
+            validation.validate_prog(p)
+        exec_cb(p)
+
+    def visit(arg: Arg, ctx) -> None:
+        generate_hints(comps, arg, exec_validate)
+
+    foreach_arg(c, visit)
+
+
+def generate_hints(comp_map: CompMap, arg: Arg, exec_cb: Callable[[], None]) -> None:
+    """(reference: prog/hints.go:82-103)"""
+    typ = arg.typ
+    if typ is None or typ.dir == Dir.OUT:
+        return
+    if isinstance(typ, ProcType):
+        return  # random proc will not pass validation
+    if isinstance(typ, CsumType):
+        return  # computed dynamically, never matches
+    if isinstance(arg, ConstArg):
+        _check_const_arg(arg, comp_map, exec_cb)
+    elif isinstance(arg, DataArg):
+        _check_data_arg(arg, comp_map, exec_cb)
+
+
+def _check_const_arg(arg: ConstArg, comp_map: CompMap,
+                     exec_cb: Callable[[], None]) -> None:
+    original = arg.val
+    for replacer in sorted(shrink_expand(original, comp_map)):
+        arg.val = replacer
+        exec_cb()
+    arg.val = original
+
+
+def _check_data_arg(arg: DataArg, comp_map: CompMap,
+                    exec_cb: Callable[[], None]) -> None:
+    data = arg.data
+    size = min(len(data), MAX_DATA_LENGTH)
+    for i in range(size):
+        window = min(8, len(data) - i)
+        original = bytes(data[i:i + 8]).ljust(8, b"\x00")
+        val = int.from_bytes(original, "little")
+        for replacer in sorted(shrink_expand(val, comp_map)):
+            store_int(data, i, replacer, window)
+            exec_cb()
+        data[i:i + window] = original[:window]
+
+
+def shrink_expand(v: int, comp_map: CompMap) -> set[int]:
+    """Model the casts the kernel may apply to the argument before
+    comparing: truncation to 1/2/4/8 bytes and sign extension from
+    1/2/4, in both endiannesses; replace the matching low bits with the
+    other comparison operand (reference: prog/hints.go:164-218)."""
+    replacers: set[int] = set()
+    for iwidth in (8, 4, 2, 1, -4, -2, -1):
+        if iwidth > 0:
+            width = iwidth
+            size = width * 8
+            mutant = v & ((1 << size) - 1)
+        else:
+            width = -iwidth
+            size = width * 8
+            mutant = (v | (MASK64 ^ ((1 << size) - 1))) & MASK64
+        for big_endian in (False, True):
+            if big_endian:
+                if width == 1:
+                    continue
+                mutant = swap_int(mutant, width)
+            for new_v in comp_map.m.get(mutant, ()):
+                mask = (1 << size) - 1
+                new_hi = new_v & ~mask & MASK64
+                new_v &= mask
+                # The other operand is wider than the cast value:
+                # no valid code does that; skip (unless sign extension).
+                if new_hi != 0 and (new_hi ^ (~mask & MASK64)) != 0:
+                    continue
+                if big_endian:
+                    new_v = swap_int(new_v, width)
+                if new_v in SPECIAL_INTS_SET:
+                    continue
+                # Replace size low bits of v with new_v.
+                replacer = ((v & ~mask) | new_v) & MASK64
+                replacers.add(replacer)
+    return replacers

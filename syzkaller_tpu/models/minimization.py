@@ -1,0 +1,199 @@
+"""Program minimization: greedy call removal then per-arg
+simplification, each step re-validated by an equivalence predicate
+(usually: re-execution keeps the signal / still crashes)
+(reference: prog/minimization.go:14-210)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from syzkaller_tpu.models.prog import (
+    Arg,
+    Call,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    UnionArg,
+    remove_arg,
+)
+from syzkaller_tpu.models.size import assign_sizes_call
+from syzkaller_tpu.models.types import (
+    ArrayKind,
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    UnionType,
+    VmaType,
+)
+
+Pred = Callable[[Prog, int], bool]
+
+
+def minimize(p0: Prog, call_index0: int, crash: bool, pred0: Pred) -> tuple[Prog, int]:
+    """(reference: prog/minimization.go:14-61)"""
+    from syzkaller_tpu.models import validation
+
+    if validation.debug:
+        def pred(p: Prog, ci: int) -> bool:
+            validation.validate_prog(p)
+            return pred0(p, ci)
+    else:
+        pred = pred0
+
+    name0 = ""
+    if call_index0 != -1:
+        assert 0 <= call_index0 < len(p0.calls), "bad call index"
+        name0 = p0.calls[call_index0].meta.name
+
+    p0, call_index0 = _remove_calls(p0, call_index0, crash, pred)
+
+    for i in range(len(p0.calls)):
+        ctx = _MinimizeArgsCtx(p0, call_index0, crash, pred)
+        while True:
+            p = ctx.p0.clone()
+            call = p.calls[i]
+            restart = False
+            for j, arg in enumerate(call.args):
+                if ctx.do(p, call, arg, str(j)):
+                    restart = True
+                    break
+            if not restart:
+                break
+        p0 = ctx.p0
+
+    if call_index0 != -1:
+        assert 0 <= call_index0 < len(p0.calls) and \
+            name0 == p0.calls[call_index0].meta.name, \
+            "bad call index after minimization"
+    return p0, call_index0
+
+
+def _remove_calls(p0: Prog, call_index0: int, crash: bool, pred: Pred) -> tuple[Prog, int]:
+    for i in range(len(p0.calls) - 1, -1, -1):
+        if i == call_index0:
+            continue
+        call_index = call_index0
+        if i < call_index:
+            call_index -= 1
+        p = p0.clone()
+        p.remove_call(i)
+        if not pred(p, call_index):
+            continue
+        p0 = p
+        call_index0 = call_index
+    return p0, call_index0
+
+
+class _MinimizeArgsCtx:
+    def __init__(self, p0: Prog, call_index0: int, crash: bool, pred: Pred):
+        self.p0 = p0
+        self.call_index0 = call_index0
+        self.crash = crash
+        self.pred = pred
+        self.tried_paths: set[str] = set()
+
+    def do(self, p: Prog, call: Call, arg: Arg, path: str) -> bool:
+        """(reference: prog/minimization.go:91-210)"""
+        path += f"-{arg.typ.field_name}"
+        t = arg.typ
+        if isinstance(t, StructType):
+            assert isinstance(arg, GroupArg)
+            return any(self.do(p, call, inner, path) for inner in arg.inner)
+        if isinstance(t, UnionType):
+            assert isinstance(arg, UnionArg)
+            return self.do(p, call, arg.option, path)
+        if isinstance(t, PtrType):
+            if not isinstance(arg, PointerArg):
+                return False
+            if arg.res is not None:
+                return self.do(p, call, arg.res, path)
+            return False
+        if isinstance(t, ArrayType):
+            assert isinstance(arg, GroupArg)
+            for i, inner in enumerate(list(arg.inner)):
+                inner_path = f"{path}-{i}"
+                if inner_path not in self.tried_paths and not self.crash:
+                    if (t.kind == ArrayKind.RANGE_LEN
+                            and len(arg.inner) > t.range_begin) \
+                            or t.kind == ArrayKind.RAND_LEN:
+                        arg.inner.pop(i)
+                        remove_arg(inner)
+                        assign_sizes_call(call)
+                        if self.pred(p, self.call_index0):
+                            self.p0 = p
+                        else:
+                            self.tried_paths.add(inner_path)
+                        return True
+                if self.do(p, call, inner, inner_path):
+                    return True
+            return False
+        if isinstance(t, (IntType, FlagsType, ProcType)):
+            if self.crash or path in self.tried_paths:
+                return False
+            self.tried_paths.add(path)
+            assert isinstance(arg, ConstArg)
+            if arg.val == t.default():
+                return False
+            v0 = arg.val
+            arg.val = t.default()
+            if self.pred(p, self.call_index0):
+                self.p0 = p
+                return True
+            arg.val = v0
+            return False
+        if isinstance(t, ResourceType):
+            if self.crash or path in self.tried_paths:
+                return False
+            self.tried_paths.add(path)
+            assert isinstance(arg, ResultArg)
+            if arg.res is None:
+                return False
+            r0 = arg.res
+            arg.res = None
+            arg.val = t.default()
+            if self.pred(p, self.call_index0):
+                self.p0 = p
+                return True
+            arg.res = r0
+            arg.val = 0
+            return False
+        if isinstance(t, BufferType):
+            if path in self.tried_paths:
+                return False
+            self.tried_paths.add(path)
+            if t.kind not in (BufferKind.BLOB_RAND, BufferKind.BLOB_RANGE) \
+                    or t.dir == Dir.OUT:
+                return False
+            assert isinstance(arg, DataArg)
+            min_len = t.range_begin
+            step = len(arg.data) - min_len
+            while len(arg.data) > min_len and step > 0:
+                if len(arg.data) - step >= min_len:
+                    saved = bytes(arg.data)
+                    arg.data = arg.data[:len(arg.data) - step]
+                    assign_sizes_call(call)
+                    if self.pred(p, self.call_index0):
+                        continue
+                    arg.data = bytearray(saved)
+                    assign_sizes_call(call)
+                step //= 2
+                if self.crash:
+                    break
+            self.p0 = p
+            return False
+        if isinstance(t, (VmaType, LenType, CsumType, ConstType)):
+            return False
+        raise TypeError(f"unknown arg type {t!r}")

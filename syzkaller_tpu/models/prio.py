@@ -1,0 +1,182 @@
+"""Call-to-call priorities and the ChoiceTable.
+
+Static component from shared argument types, dynamic component from
+corpus co-occurrence, normalized to 0.1..1 and multiplied
+(reference: prog/prio.go:27-187).  The ChoiceTable is a per-call
+prefix-sum row sampled by binary search — exactly the matrix the TPU
+engine uploads as its device-side categorical sampler
+(reference: prog/prio.go:191-245; device side: ops/choice.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Optional
+
+from syzkaller_tpu.models.prog import Prog
+from syzkaller_tpu.models.types import (
+    ArrayType,
+    BufferKind,
+    BufferType,
+    IntType,
+    PtrType,
+    ResourceType,
+    StructType,
+    Syscall,
+    UnionType,
+    VmaType,
+    foreach_type,
+)
+
+
+def calculate_priorities(target, corpus: list[Prog]) -> list[list[float]]:
+    """static x dynamic (reference: prog/prio.go:27-36)."""
+    static = calc_static_priorities(target)
+    dynamic = calc_dynamic_prio(target, corpus)
+    for i in range(len(static)):
+        row_s, row_d = static[i], dynamic[i]
+        for j in range(len(row_s)):
+            row_d[j] *= row_s[j]
+    return dynamic
+
+
+def calc_static_priorities(target) -> list[list[float]]:
+    """Shared-type usage weights (reference: prog/prio.go:38-131)."""
+    uses: dict[str, dict[int, float]] = {}
+
+    for c in target.syscalls:
+        def note_usage(weight: float, id_: str) -> None:
+            m = uses.setdefault(id_, {})
+            if weight > m.get(c.id, 0.0):
+                m[c.id] = weight
+
+        def visit(t) -> None:
+            if isinstance(t, ResourceType):
+                assert t.desc is not None
+                if t.desc.name in ("pid", "uid", "gid"):
+                    # Aux roles that appear in masses of structs.
+                    note_usage(0.1, f"res{t.desc.name}")
+                else:
+                    s = "res"
+                    for i, k in enumerate(t.desc.kind):
+                        s += "-" + k
+                        w = 1.0 if i == len(t.desc.kind) - 1 else 0.2
+                        note_usage(w, s)
+            elif isinstance(t, PtrType):
+                if isinstance(t.elem, (StructType, UnionType)):
+                    note_usage(1.0, f"ptrto-{t.elem.name}")
+                if isinstance(t.elem, ArrayType):
+                    note_usage(1.0, f"ptrto-{t.elem.elem.name}")
+            elif isinstance(t, BufferType):
+                if t.kind == BufferKind.STRING:
+                    if t.sub_kind:
+                        note_usage(0.2, f"str-{t.sub_kind}")
+                elif t.kind == BufferKind.FILENAME:
+                    note_usage(1.0, "filename")
+            elif isinstance(t, VmaType):
+                note_usage(0.5, "vma")
+
+        foreach_type(c, visit)
+
+    n = len(target.syscalls)
+    prios = [[0.0] * n for _ in range(n)]
+    for calls in uses.values():
+        for c0, w0 in calls.items():
+            for c1, w1 in calls.items():
+                if c0 == c1:
+                    continue
+                prios[c0][c1] += w0 * w1
+    # Self-priority = max priority wrt others (reference: prio.go:120-128).
+    for c0, pp in enumerate(prios):
+        pp[c0] = max(pp)
+    normalize_prio(prios)
+    return prios
+
+
+def calc_dynamic_prio(target, corpus: list[Prog]) -> list[list[float]]:
+    """Corpus co-occurrence counts (reference: prog/prio.go:133-149)."""
+    n = len(target.syscalls)
+    prios = [[0.0] * n for _ in range(n)]
+    for p in corpus:
+        for c0 in p.calls:
+            for c1 in p.calls:
+                prios[c0.meta.id][c1.meta.id] += 1.0
+    normalize_prio(prios)
+    return prios
+
+
+def normalize_prio(prios: list[list[float]]) -> None:
+    """Per-row normalize to 0.1..1, zeros get a sub-min floor
+    (reference: prog/prio.go:153-187)."""
+    for prio in prios:
+        max_p = max(prio) if prio else 0.0
+        nonzero = [p for p in prio if p != 0]
+        min_p = min(nonzero) if nonzero else 1e10
+        nzero = len(prio) - len(nonzero)
+        if nzero != 0:
+            min_p /= 2 * nzero
+        for i, p in enumerate(prio):
+            if max_p == 0:
+                prio[i] = 1.0
+                continue
+            if p == 0:
+                p = min_p
+            if max_p == min_p:
+                # Uniform nonzero row: everything is at the max
+                # (the reference would produce NaN here; clamp to 1).
+                prio[i] = 1.0
+                continue
+            p = (p - min_p) / (max_p - min_p) * 0.9 + 0.1
+            prio[i] = min(p, 1.0)
+
+
+class ChoiceTable:
+    """Weighted next-call sampler (reference: prog/prio.go:191-245)."""
+
+    def __init__(self, target, run: list[Optional[list[int]]],
+                 enabled_calls: list[Syscall]):
+        self.target = target
+        self.run = run
+        self.enabled_calls = enabled_calls
+        self.enabled_ids = {c.id for c in enabled_calls}
+
+    def enabled_by_id(self, call_id: int) -> bool:
+        return call_id in self.enabled_ids
+
+    def choose(self, rng, call: int) -> int:
+        """Sample the next syscall id biased by `call`
+        (reference: prog/prio.go:230-245)."""
+        if call < 0:
+            return self.enabled_calls[rng.intn(len(self.enabled_calls))].id
+        run = self.run[call]
+        if run is None:
+            return self.enabled_calls[rng.intn(len(self.enabled_calls))].id
+        while True:
+            x = rng.intn(run[-1]) + 1
+            i = bisect.bisect_left(run, x)
+            if i in self.enabled_ids:
+                return i
+
+
+def build_choice_table(target, prios: Optional[list[list[float]]] = None,
+                       enabled: Optional[dict[Syscall, bool]] = None) -> ChoiceTable:
+    """(reference: prog/prio.go:198-228)"""
+    if enabled is None:
+        enabled = {c: True for c in target.syscalls}
+    enabled_calls = [c for c in enabled if enabled[c]]
+    enabled_ids = {c.id for c in enabled_calls}
+    run: list[Optional[list[int]]] = [None] * len(target.syscalls)
+    for i in range(len(target.syscalls)):
+        if target.syscalls[i].id not in enabled_ids:
+            continue
+        row = [0] * len(target.syscalls)
+        total = 0
+        for j in range(len(target.syscalls)):
+            if target.syscalls[j].id in enabled_ids:
+                w = 1
+                if prios is not None:
+                    w = int(prios[i][j] * 1000)
+                total += w
+            row[j] = total
+        run[i] = row
+    return ChoiceTable(target, run, enabled_calls)

@@ -1,0 +1,4 @@
+"""Feedback-signal model (reference: pkg/signal, pkg/cover)."""
+
+from syzkaller_tpu.signal.signal import Signal, from_raw, minimize_corpus  # noqa: F401
+from syzkaller_tpu.signal.cover import Cover  # noqa: F401

@@ -1,0 +1,20 @@
+"""Raw PC coverage set — for UI/reporting, not fitness
+(reference: pkg/cover/cover.go:7-30)."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+
+class Cover(set):
+    def merge(self, raw: Iterable[int]) -> None:
+        self.update(raw)
+
+    def merge_diff(self, raw: Iterable[int]) -> list[int]:
+        """Merge and return newly-added PCs."""
+        new = [pc for pc in raw if pc not in self]
+        self.update(new)
+        return new
+
+    def serialize(self) -> list[int]:
+        return sorted(self)

@@ -1,0 +1,102 @@
+"""Prioritized coverage signal.
+
+A Signal maps edge hashes to small priorities; novelty ("is any of
+this new at >= prio?") is the test run on every executed call
+(reference: pkg/signal/signal.go:11-166).  This is the CPU reference
+for the TPU bitmap-plane implementation in ops/signal.py, which must
+make identical accept/reject decisions.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+
+class Signal:
+    """dict-backed signal; elements are uint32 edge hashes, priorities
+    int8 (reference: pkg/signal/signal.go:16)."""
+
+    __slots__ = ("m",)
+
+    def __init__(self, m: Optional[dict[int, int]] = None):
+        self.m: dict[int, int] = m if m is not None else {}
+
+    def __len__(self) -> int:
+        return len(self.m)
+
+    def empty(self) -> bool:
+        return not self.m
+
+    def __contains__(self, elem: int) -> bool:
+        return elem in self.m
+
+    def copy(self) -> "Signal":
+        return Signal(dict(self.m))
+
+    def serialize(self) -> tuple[list[int], list[int]]:
+        elems = list(self.m.keys())
+        prios = [self.m[e] for e in elems]
+        return elems, prios
+
+    @staticmethod
+    def deserialize(elems: list[int], prios: list[int]) -> "Signal":
+        assert len(elems) == len(prios), "corrupted serial signal"
+        return Signal(dict(zip(elems, prios)))
+
+    def diff(self, s1: "Signal") -> "Signal":
+        """Elements of s1 new to self at their prio
+        (reference: pkg/signal/signal.go:73-88)."""
+        res: dict[int, int] = {}
+        for e, p1 in s1.m.items():
+            p = self.m.get(e)
+            if p is not None and p >= p1:
+                continue
+            res[e] = p1
+        return Signal(res)
+
+    def diff_raw(self, raw: Iterable[int], prio: int) -> "Signal":
+        """(reference: pkg/signal/signal.go:90-102)"""
+        res: dict[int, int] = {}
+        for e in raw:
+            p = self.m.get(e)
+            if p is not None and p >= prio:
+                continue
+            res[e] = prio
+        return Signal(res)
+
+    def intersection(self, s1: "Signal") -> "Signal":
+        """Elements of self present in s1 at >= prio
+        (reference: pkg/signal/signal.go:104-115)."""
+        res: dict[int, int] = {}
+        for e, p in self.m.items():
+            p1 = s1.m.get(e)
+            if p1 is not None and p1 >= p:
+                res[e] = p
+        return Signal(res)
+
+    def merge(self, s1: "Signal") -> None:
+        """Max-merge s1 into self (reference: pkg/signal/signal.go:117-131)."""
+        for e, p1 in s1.m.items():
+            p = self.m.get(e)
+            if p is None or p < p1:
+                self.m[e] = p1
+
+
+def from_raw(raw: Iterable[int], prio: int) -> Signal:
+    return Signal({e: prio for e in raw})
+
+
+def minimize_corpus(corpus: list[tuple[Signal, object]]) -> list[object]:
+    """Greedy set cover of the corpus by signal: keep one (max-prio,
+    largest-signal-first) witness per element
+    (reference: pkg/signal/signal.go:138-166)."""
+    order = sorted(range(len(corpus)), key=lambda i: -len(corpus[i][0]))
+    covered: dict[int, tuple[int, int]] = {}  # elem -> (prio, corpus idx)
+    for i in order:
+        sig, _ = corpus[i]
+        for e, p in sig.m.items():
+            prev = covered.get(e)
+            if prev is None or p > prev[0]:
+                covered[e] = (p, i)
+    indices = {idx for _, idx in covered.values()}
+    return [corpus[i][1] for i in indices]

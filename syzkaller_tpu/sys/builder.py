@@ -179,6 +179,19 @@ def array(elem: Union[str, TypeSpec],
         if count is not None:
             kind = ArrayKind.RANGE_LEN
             rb, re = (count, count) if isinstance(count, int) else count
+        if isinstance(inner, IntType) and inner.kind == IntKind.PLAIN \
+                and inner.type_size == 1:
+            # Special case: a byte array is a buffer — better mutated by
+            # the byte-level engine (reference: pkg/compiler/types.go:157-172).
+            if kind == ArrayKind.RANGE_LEN:
+                fixed = rb == re
+                return BufferType(name="array", field_name=fname, dir=d,
+                                  kind=BufferKind.BLOB_RANGE,
+                                  varlen=not fixed,
+                                  type_size=rb if fixed else 0,
+                                  range_begin=rb, range_end=re)
+            return BufferType(name="array", field_name=fname, dir=d,
+                              kind=BufferKind.BLOB_RAND, varlen=True)
         return ArrayType(name="array", field_name=fname,
                          type_size=SIZE_UNASSIGNED, varlen=False, dir=d,
                          elem=inner, kind=kind, range_begin=rb, range_end=re)

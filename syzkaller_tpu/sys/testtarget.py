@@ -313,7 +313,7 @@ def build_test_target(register: bool = True):
     # -- checksums -----------------------------------------------------
     b.struct("csum_plain", [
         ("sum", csum("parent", CsumKind.INET, 0, 2)),
-        ("src", int32(be=True)), ("dst", int32(be=True)),
+        ("src_ip", int32(be=True)), ("dst_ip", int32(be=True)),
     ], packed=True)
     b.struct("csum_pseudo_hdr", [
         ("sum", csum("csum_pseudo_pkt", CsumKind.PSEUDO, IPPROTO_TCP, 2)),

@@ -1,0 +1,193 @@
+"""Exec wire-format and text-encoding tests (reference strategy:
+prog/encodingexec_test.go exact uint64 golden streams;
+prog/encoding_test.go round-trips)."""
+
+import pytest
+
+from syzkaller_tpu.models.encoding import deserialize_prog, serialize_prog
+from syzkaller_tpu.models.encodingexec import (
+    EXEC_ARG_CONST,
+    EXEC_ARG_DATA,
+    EXEC_ARG_RESULT,
+    EXEC_INSTR_COPYIN,
+    EXEC_INSTR_COPYOUT,
+    EXEC_INSTR_EOF,
+    EXEC_NO_COPYOUT,
+    serialize_for_exec,
+    words_of,
+)
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.rand import RandGen
+
+DATA_OFFSET = 0x20000000
+
+
+def exec_words(target, text: bytes) -> list[int]:
+    p = deserialize_prog(target, text)
+    return words_of(serialize_for_exec(p))
+
+
+def const(size, val, be=False, bf_off=0, bf_len=0, stride=0):
+    meta = size | (bf_off << 16) | (bf_len << 24) | (stride << 32)
+    if be:
+        meta |= 1 << 8
+    return [EXEC_ARG_CONST, meta, val]
+
+
+def test_exec_simple_call(test_target):
+    # tz_nop$ints(a0 intptr, a1 int8, a2 int16, a3 int32, a4 int64)
+    got = exec_words(test_target, b"tz_nop$ints(0x1, 0x2, 0x3, 0x4, 0x5)\n")
+    meta = test_target.syscall_map["tz_nop$ints"]
+    want = [meta.id, EXEC_NO_COPYOUT, 5,
+            *const(8, 1), *const(1, 2), *const(2, 3), *const(4, 4),
+            *const(8, 5), EXEC_INSTR_EOF]
+    assert got == want
+
+
+def test_exec_copyin_struct(test_target):
+    # pad_packed: i16 i32 i8 i16 i64 packed at +0,2,6,7,9
+    got = exec_words(
+        test_target,
+        b"tz_align$packed(&(0x7f0000000000)={0x1, 0x2, 0x3, 0x4, 0x5})\n")
+    meta = test_target.syscall_map["tz_align$packed"]
+    want = [
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 0, *const(2, 1),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 2, *const(4, 2),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 6, *const(1, 3),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 7, *const(2, 4),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 9, *const(8, 5),
+        meta.id, EXEC_NO_COPYOUT, 1, *const(8, DATA_OFFSET),
+        EXEC_INSTR_EOF,
+    ]
+    assert got == want
+
+
+def test_exec_natural_padding(test_target):
+    # pad_natural: i16@0 i32@4 i8@8 i16@10 i64@16 (pads skipped in stream)
+    got = exec_words(
+        test_target,
+        b"tz_align$natural(&(0x7f0000000000)={0x1, 0x2, 0x3, 0x4, 0x5})\n")
+    meta = test_target.syscall_map["tz_align$natural"]
+    want = [
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 0, *const(2, 1),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 4, *const(4, 2),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 8, *const(1, 3),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 10, *const(2, 4),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 16, *const(8, 5),
+        meta.id, EXEC_NO_COPYOUT, 1, *const(8, DATA_OFFSET),
+        EXEC_INSTR_EOF,
+    ]
+    assert got == want
+
+
+def test_exec_result_copyout(test_target):
+    got = exec_words(test_target,
+                     b"r0 = tz_res$make()\ntz_res$use(r0)\n")
+    make = test_target.syscall_map["tz_res$make"]
+    use = test_target.syscall_map["tz_res$use"]
+    want = [
+        make.id, 0, 0,
+        use.id, EXEC_NO_COPYOUT, 1,
+        EXEC_ARG_RESULT, 4, 0, 0, 0, 0xFFFF,
+        EXEC_INSTR_EOF,
+    ]
+    assert got == want
+
+
+def test_exec_data_arg(test_target):
+    got = exec_words(test_target,
+                     b'tz_buf$blob(&(0x7f0000000000)="68656c6c6f21")\n')
+    meta = test_target.syscall_map["tz_buf$blob"]
+    blob = int.from_bytes(b"hello!\x00\x00", "little")
+    want = [
+        EXEC_INSTR_COPYIN, DATA_OFFSET, EXEC_ARG_DATA, 6, blob,
+        meta.id, EXEC_NO_COPYOUT, 1, *const(8, DATA_OFFSET),
+        EXEC_INSTR_EOF,
+    ]
+    assert got == want
+
+
+def test_exec_bitfields(test_target):
+    # bf_grouped_inner: 3x int32:10 in one unit at offsets 0,10,20
+    got = exec_words(
+        test_target,
+        b"tz_bf$grouped(&(0x7f0000000000)={{0x1, 0x2, 0x3}, 0x4})\n")
+    meta = test_target.syscall_map["tz_bf$grouped"]
+    want = [
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 0, *const(4, 1, bf_off=0, bf_len=10),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 0, *const(4, 2, bf_off=10, bf_len=10),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 0, *const(4, 3, bf_off=20, bf_len=10),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 4, *const(1, 4),
+        meta.id, EXEC_NO_COPYOUT, 1, *const(8, DATA_OFFSET),
+        EXEC_INSTR_EOF,
+    ]
+    assert got == want
+
+
+def test_exec_be_and_vma(test_target):
+    got = exec_words(
+        test_target,
+        b"tz_be$ints(&(0x7f0000000000)={0x1, 0x2, 0x3, 0x4})\n")
+    meta = test_target.syscall_map["tz_be$ints"]
+    want = [
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 0, *const(1, 1),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 1, *const(2, 2, be=True),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 3, *const(4, 3, be=True),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 7, *const(8, 4, be=True),
+        meta.id, EXEC_NO_COPYOUT, 1, *const(8, DATA_OFFSET),
+        EXEC_INSTR_EOF,
+    ]
+    assert got == want
+
+
+def test_exec_csum(test_target):
+    got = exec_words(
+        test_target,
+        b"tz_csum$inet(&(0x7f0000000000)={0x0, 0x11223344, 0x55667788})\n")
+    meta = test_target.syscall_map["tz_csum$inet"]
+    # csum_plain: sum@0 (csum int16), src@2 (i32be), dst@6 (i32be), packed
+    EXEC_ARG_CSUM = 3
+    want = [
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 0, *const(2, 0),  # csum placeholder
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 2, *const(4, 0x11223344, be=True),
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 6, *const(4, 0x55667788, be=True),
+        # csum instruction: inet over parent struct (addr 0, size 10)
+        EXEC_INSTR_COPYIN, DATA_OFFSET + 0, EXEC_ARG_CSUM, 2,
+        0,  # ExecArgCsumInet
+        1,  # one chunk
+        0, DATA_OFFSET + 0, 10,  # chunk: data, addr, size
+        meta.id, EXEC_NO_COPYOUT, 1, *const(8, DATA_OFFSET),
+        EXEC_INSTR_EOF,
+    ]
+    assert got == want
+
+
+def test_exec_proc_stride(test_target):
+    got = exec_words(test_target, b"tz_proc(0x2)\n")
+    meta = test_target.syscall_map["tz_proc"]
+    # proc(100, 4): value = start + val = 102, stride = 4
+    want = [meta.id, EXEC_NO_COPYOUT, 1, *const(2, 102, stride=4),
+            EXEC_INSTR_EOF]
+    assert got == want
+
+
+def test_exec_random_progs(test_target, iters):
+    for i in range(iters):
+        rng = RandGen(test_target, 5000 + i)
+        p = generate_prog(test_target, rng, 10)
+        stream = serialize_for_exec(p)
+        words = words_of(stream)
+        assert words[-1] == EXEC_INSTR_EOF
+        assert len(stream) < (2 << 20)
+
+
+def test_text_roundtrip_random(test_target, iters):
+    for i in range(iters):
+        rng = RandGen(test_target, 6000 + i)
+        p = generate_prog(test_target, rng, 10)
+        s1 = serialize_prog(p)
+        p2 = deserialize_prog(test_target, s1)
+        s2 = serialize_prog(p2)
+        assert s1 == s2, f"seed {6000 + i}"
+        # Exec streams must match too (deeper equivalence).
+        assert serialize_for_exec(p) == serialize_for_exec(p2), f"seed {6000+i}"

@@ -1,0 +1,217 @@
+"""Signal semantics, choice-table sampling, minimization and hints
+(reference strategy: pkg/signal tests, prog/minimization_test.go,
+prog/hints_test.go golden tables)."""
+
+import random
+
+from syzkaller_tpu.models.encoding import deserialize_prog, serialize_prog
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.hints import CompMap, mutate_with_hints, shrink_expand
+from syzkaller_tpu.models.minimization import minimize
+from syzkaller_tpu.models.prio import build_choice_table, calculate_priorities
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.signal import Signal, from_raw, minimize_corpus
+
+
+def test_signal_diff_merge():
+    s = from_raw([1, 2, 3], 1)
+    d = s.diff_raw([2, 3, 4], 2)
+    assert d.m == {2: 2, 3: 2, 4: 2}
+    d2 = s.diff_raw([1, 2], 1)
+    assert d2.empty()
+    s.merge(from_raw([3, 4], 3))
+    assert s.m == {1: 1, 2: 1, 3: 3, 4: 3}
+    inter = s.intersection(from_raw([1, 3], 2))
+    assert inter.m == {1: 1}  # 3 has prio 3 > 2 in s, dropped
+
+
+def test_signal_minimize_corpus():
+    corpus = [
+        (from_raw([1, 2, 3, 4], 1), "big"),
+        (from_raw([1, 2], 1), "subset"),
+        (from_raw([5], 1), "unique"),
+    ]
+    kept = set(minimize_corpus(corpus))
+    assert kept == {"big", "unique"}
+
+
+def test_choice_table_sampling(test_target):
+    rng = RandGen(test_target, 0)
+    corpus = [generate_prog(test_target, RandGen(test_target, i), 6)
+              for i in range(5)]
+    prios = calculate_priorities(test_target, corpus)
+    n = len(test_target.syscalls)
+    assert all(len(row) == n for row in prios)
+    # static x dynamic, each normalized to [0.1, 1] -> product in [0.01, 1]
+    assert all(0.01 <= p <= 1.0 for row in prios for p in row)
+    ct = build_choice_table(test_target, prios)
+    # Sampling respects enabled set and returns valid ids.
+    for _ in range(200):
+        idx = ct.choose(rng, rng.intn(n))
+        assert 0 <= idx < n
+    # Restricted enabled set.
+    subset = {c: True for c in test_target.syscalls[:10]}
+    ct2 = build_choice_table(test_target, prios, subset)
+    for _ in range(100):
+        assert ct2.choose(rng, 3) < 10
+
+
+def test_minimize_simple(test_target):
+    # Only the call with a nonzero first arg matters.
+    p = deserialize_prog(test_target, b"\n".join([
+        b"tz_nop()",
+        b"tz_nop$ints(0x7, 0x0, 0x0, 0x0, 0x0)",
+        b"tz_nop()",
+    ]) + b"\n")
+
+    def pred(p1, ci):
+        for c in p1.calls:
+            if c.meta.name == "tz_nop$ints" and c.args[0].val == 7:
+                return True
+        return False
+
+    p1, ci = minimize(p, -1, False, pred)
+    assert len(p1.calls) == 1
+    assert p1.calls[0].meta.name == "tz_nop$ints"
+
+
+def test_minimize_keeps_call_index(test_target):
+    p = deserialize_prog(test_target, b"\n".join([
+        b"tz_nop()",
+        b"r0 = tz_res$make()",
+        b"tz_res$use(r0)",
+    ]) + b"\n")
+
+    def pred(p1, ci):
+        return ci >= 0 and p1.calls[ci].meta.name == "tz_res$use"
+
+    p1, ci = minimize(p, 2, False, pred)
+    assert p1.calls[ci].meta.name == "tz_res$use"
+    assert len(p1.calls) <= 2
+
+
+def test_minimize_data(test_target):
+    # array[int8] lowers to a byte buffer; minimization bisects its length.
+    text = b'tz_mut$blob(&(0x7f0000000000)="0101010101010101", 0x8)\n'
+    p = deserialize_prog(test_target, text)
+
+    def pred(p1, ci):
+        if not p1.calls:
+            return False
+        return len(p1.calls[0].args[0].res.data) >= 2
+
+    p1, _ = minimize(p, -1, False, pred)
+    buf = p1.calls[0].args[0].res
+    assert len(buf.data) == 2
+    # size field reassigned
+    assert p1.calls[0].args[1].val == 2
+
+
+def test_minimize_array_elems(test_target):
+    # tz_mut$vec: ptr[in, array[int32[0:1]]] stays a real array.
+    text = b'tz_mut$vec(&(0x7f0000000000)=[0x1, 0x1, 0x1, 0x1], 0x4)\n'
+    p = deserialize_prog(test_target, text)
+
+    def pred(p1, ci):
+        if not p1.calls:
+            return False
+        return len(p1.calls[0].args[0].res.inner) >= 2
+
+    p1, _ = minimize(p, -1, False, pred)
+    arr = p1.calls[0].args[0].res
+    assert len(arr.inner) == 2
+    assert p1.calls[0].args[1].val == 2
+
+
+def test_minimize_random(test_target, iters):
+    for i in range(max(4, iters // 4)):
+        rng = RandGen(test_target, 7000 + i)
+        p = generate_prog(test_target, rng, 6)
+        # pred: always true -> everything removable except nothing pinned
+        p1, _ = minimize(p.clone(), -1, False, lambda q, ci: True)
+        assert len(p1.calls) <= 1
+        # pred: always false -> program unchanged
+        p2, _ = minimize(p.clone(), -1, False, lambda q, ci: False)
+        assert serialize_prog(p2) == serialize_prog(p)
+
+
+# -- shrink/expand golden cases (reference: prog/hints_test.go:216-365) --
+
+def cm(d):
+    m = CompMap()
+    for k, vals in d.items():
+        for v in vals:
+            m.add_comp(k, v)
+    return m
+
+
+def test_shrink_16():
+    got = shrink_expand(0x1234, cm({0x34: [0xAB], 0x1234: [0xCDCD]}))
+    assert got == {0x12AB, 0xCDCD}
+
+
+def test_shrink_32():
+    got = shrink_expand(0x12345678, cm({
+        0x78: [0xAB], 0x5678: [0xCDCD], 0x12345678: [0xEFEFEFEF]}))
+    assert got == {0x123456AB, 0x1234CDCD, 0xEFEFEFEF}
+
+
+def test_shrink_64():
+    got = shrink_expand(0x1234567890ABCDEF, cm({
+        0xEF: [0xAB], 0xCDEF: [0xCDCD],
+        0x90ABCDEF: [0xEFEFEFEF],
+        0x1234567890ABCDEF: [0x0101010101010101]}))
+    assert got == {0x1234567890ABCDAB, 0x1234567890ABCDCD,
+                   0x12345678EFEFEFEF, 0x0101010101010101}
+
+
+def test_shrink_wider_replacer_rejected():
+    assert shrink_expand(0x1234, cm({0x34: [0x1BAB]})) == set()
+
+
+def test_shrink_sign_extended_replacer():
+    got = shrink_expand(0x1234, cm({0x34: [0xFFFFFFFFFFFFFFFD]}))
+    assert got == {0x12FD}
+
+
+def test_expand_8_16_32():
+    neg1 = 0xFFFFFFFFFFFFFFFF
+    neg2 = 0xFFFFFFFFFFFFFFFE
+    assert shrink_expand(0xFF, cm({neg1: [neg2]})) == {0xFE}
+    assert shrink_expand(0xFFFF, cm({neg1: [neg2]})) == {0xFFFE}
+    assert shrink_expand(0xFFFFFFFF, cm({neg1: [neg2]})) == {0xFFFFFFFE}
+
+
+def test_expand_wider_replacer_rejected():
+    assert shrink_expand(
+        0xFF, cm({0xFFFFFFFFFFFFFFFF: [0xFFFFFFFFFFFFFEFF]})) == set()
+
+
+def test_special_ints_filtered():
+    # 0x100 (=256) is a special int; replacements to it are skipped.
+    assert shrink_expand(0x1234, cm({0x1234: [0x100]})) == set()
+
+
+def test_hints_end_to_end(test_target):
+    p = deserialize_prog(
+        test_target,
+        b'tz_hint$data(&(0x7f0000000000)="11223344")\n')
+    comps = CompMap()
+    # data starts with 0x44332211 little-endian word
+    comps.add_comp(0x44332211, 0xDEADBEEF)
+    mutants = []
+    mutate_with_hints(p, 0, comps, lambda q: mutants.append(serialize_prog(q)))
+    assert any(b"efbead" in m for m in mutants), mutants
+    # original program untouched
+    assert b"11223344" in serialize_prog(p)
+
+
+def test_hints_random(test_target, iters):
+    for i in range(max(3, iters // 10)):
+        rng = RandGen(test_target, 8000 + i)
+        p = generate_prog(test_target, rng, 5)
+        for ci in range(len(p.calls)):
+            comps = CompMap()
+            for _ in range(5):
+                comps.add_comp(rng.rand_int(), rng.rand_int())
+            mutate_with_hints(p, ci, comps, lambda q: None)
