@@ -1,0 +1,133 @@
+"""Benchmark: mutations triaged/sec/chip, device pipeline vs CPU baseline.
+
+Measures the fused device fuzz step (batched mutation + coverage triage
++ plane merge) on the available accelerator against the reference-
+equivalent CPU path (single-program mutate + signal diff, the
+tools/syz-mutate analog — BASELINE.md config #1).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build(batch_size: int, edges_per_prog: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import random
+
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.rand import RandGen
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops import signal as dsig
+    from syzkaller_tpu.ops.mutate import _mutate_one
+    from syzkaller_tpu.ops.tensor import (
+        FlagTables, TensorConfig, encode_prog, stack_batch)
+
+    target = get_target("test", "64")
+    cfg = TensorConfig()
+    flags = FlagTables.empty()
+    tensors = []
+    progs = []
+    i = 0
+    while len(tensors) < batch_size:
+        p = generate_prog(target, RandGen(target, 42 + i), 8)
+        i += 1
+        try:
+            tensors.append(encode_prog(p, cfg, flags))
+            progs.append(p)
+        except Exception:
+            continue
+    batch = {k: jnp.asarray(v) for k, v in stack_batch(tensors).items()}
+    fv, fc = jnp.asarray(flags.vals), jnp.asarray(flags.counts)
+    plane = dsig.new_plane()
+
+    def step(batch, plane, key):
+        """One fused iteration: mutate all programs, synthesize their
+        coverage (stand-in for executor DMA), triage + merge."""
+        b = batch["kind"].shape[0]
+        k1, k2 = random.split(key)
+        keys = random.split(k1, b)
+        mutated = jax.vmap(
+            lambda st, k: _mutate_one(st, k, fv, fc, 4))(batch, keys)
+        edges = random.bits(k2, (b, edges_per_prog), dtype=jnp.uint32)
+        nedges = jnp.full((b,), edges_per_prog, dtype=jnp.int32)
+        prios = jnp.full((b,), 2, dtype=jnp.uint8)
+        new_mask, counts = dsig.diff_batch(plane, edges, nedges, prios)
+        plane = dsig.merge(plane, edges, nedges, prios, counts > 0)
+        mutated.pop("preserve_sizes", None)
+        return mutated, plane, counts
+
+    return jax.jit(step), batch, plane, progs, target
+
+
+def bench_device(batch_size=512, edges_per_prog=128, steps=20) -> float:
+    import jax
+    from jax import random
+
+    step, batch, plane, _, _ = build(batch_size, edges_per_prog)
+    key = random.key(0)
+    # warmup/compile
+    key, sub = random.split(key)
+    batch, plane, counts = step(batch, plane, sub)
+    jax.block_until_ready(counts)
+    t0 = time.time()
+    for _ in range(steps):
+        key, sub = random.split(key)
+        batch, plane, counts = step(batch, plane, sub)
+    jax.block_until_ready(counts)
+    dt = time.time() - t0
+    return batch_size * steps / dt
+
+
+def bench_cpu(seconds=3.0, edges_per_prog=128) -> float:
+    """Reference-equivalent CPU loop: clone + mutate + signal triage
+    per program (tools/syz-mutate analog)."""
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.mutation import mutate_prog
+    from syzkaller_tpu.models.rand import RandGen
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.signal import Signal
+
+    target = get_target("test", "64")
+    rng = RandGen(target, 7)
+    corpus = [generate_prog(target, RandGen(target, i), 8) for i in range(16)]
+    sig = Signal()
+    rs = np.random.RandomState(0)
+    n = 0
+    t0 = time.time()
+    while time.time() - t0 < seconds:
+        p = corpus[n % len(corpus)].clone()
+        mutate_prog(p, rng, 30, corpus=corpus)
+        raw = rs.randint(0, 1 << 26, size=edges_per_prog).tolist()
+        new = sig.diff_raw(raw, 2)
+        if new:
+            sig.merge(new)
+        n += 1
+    return n / (time.time() - t0)
+
+
+def main() -> None:
+    batch = int(sys.argv[sys.argv.index("--batch") + 1]) \
+        if "--batch" in sys.argv else 512
+    steps = int(sys.argv[sys.argv.index("--steps") + 1]) \
+        if "--steps" in sys.argv else 20
+    dev_rate = bench_device(batch_size=batch, steps=steps)
+    cpu_rate = bench_cpu()
+    print(json.dumps({
+        "metric": "mutations_triaged_per_sec_per_chip",
+        "value": round(dev_rate, 1),
+        "unit": "programs/sec",
+        "vs_baseline": round(dev_rate / cpu_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -176,7 +176,7 @@ def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE) -> bytes:
         foreach_arg(c, copyout)
 
     w.write(EXEC_INSTR_EOF)
-    return b"".join(struct.pack("<Q", v) for v in w.words)
+    return struct.pack(f"<{len(w.words)}Q", *w.words)
 
 
 def _write_arg(w: _Writer, target, arg: Arg, args_info: dict) -> None:

@@ -98,7 +98,7 @@ def _check_data_arg(arg: DataArg, comp_map: CompMap,
     for i in range(size):
         window = min(8, len(data) - i)
         original = bytes(data[i:i + 8]).ljust(8, b"\x00")
-        val = int.from_bytes(original, "little")
+        val = load_int(original, 0, 8)
         for replacer in sorted(shrink_expand(val, comp_map)):
             store_int(data, i, replacer, window)
             exec_cb()

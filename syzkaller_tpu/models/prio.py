@@ -18,7 +18,6 @@ from syzkaller_tpu.models.types import (
     ArrayType,
     BufferKind,
     BufferType,
-    IntType,
     PtrType,
     ResourceType,
     StructType,

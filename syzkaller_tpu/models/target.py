@@ -96,6 +96,10 @@ class Target:
     def is_compatible_resource(self, dst: str, src: str) -> bool:
         """True if a resource of kind src can be passed where dst is
         expected (reference: prog/resources.go:35-50)."""
+        if dst in ("ANYRES16", "ANYRES32", "ANYRES64"):
+            # Squashed resources accept anything
+            # (reference: prog/resources.go:36-40).
+            return True
         dst_res = self.resource_map.get(dst)
         src_res = self.resource_map.get(src)
         if dst_res is None:

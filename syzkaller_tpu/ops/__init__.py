@@ -1,0 +1,11 @@
+"""Batched JAX/Pallas kernels: the TPU hot loop.
+
+Everything here operates on flat program tensors (ops/tensor.py) with
+a leading batch dimension, jit/vmap-compiled, with static shapes and
+lax control flow only.  64-bit integer mode is required for syscall
+argument values; enable it before any tracing below.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,367 @@
+"""Batched program mutation on device.
+
+The vmap'd equivalent of the reference's per-program mutation loop
+(reference: prog/mutation.go:14-142,394-521) over program tensors.
+The device owns the high-volume ops — argument value mutation (int/
+flags/proc/len), the 7-op byte-level data engine, and call removal;
+structural tree ops (call insertion, corpus splice, ANY-squash) are
+host-side and composed by engine.Engine, which routes each program by
+a host-sampled op class so the overall op distribution matches the
+reference's weights.
+
+Everything is static-shape: spans live in a fixed arena, shifts are
+masked index arithmetic over the whole arena vector (VPU-friendly),
+values are uint64 scalars per slot.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax, random
+
+from syzkaller_tpu.ops import rng as d
+from syzkaller_tpu.ops.tensor import DATA, EMPTY, FLAGS, INT, LEN, PROC
+
+U64 = jnp.uint64
+MASK64 = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _width_mask(width):
+    """(1 << 8*width) - 1 without overflow at width 8."""
+    bits = (width.astype(jnp.uint64) * U64(8)) % U64(64)
+    full = width.astype(jnp.uint64) >= U64(8)
+    m = (U64(1) << bits) - U64(1)
+    return jnp.where(full, MASK64, m)
+
+
+def _swap_int(v, width):
+    """Byte-swap the low `width` bytes (width in {1,2,4,8})."""
+    b = [(v >> U64(8 * i)) & U64(0xFF) for i in range(8)]
+    def build(n):
+        out = U64(0)
+        for i in range(n):
+            out = out | (b[n - 1 - i] << U64(8 * i))
+        return out
+    return jnp.select([width == 1, width == 2, width == 4],
+                      [v & U64(0xFF), build(2), build(4)], build(8))
+
+
+# -- value mutation ------------------------------------------------------
+
+
+def _mutate_int_value(key, val, width, aux0, aux1, kind):
+    """mutateInt for INT slots (reference: prog/mutation.go:174-188):
+    1/2 regenerate, else +1..4 / -1..4 / xor random bit."""
+    k_bin, k_branch, k_d1, k_d2, k_bit, k_regen, k_range = random.split(key, 7)
+    # regenerate: plain ints use rand_int, range ints use rand_range_int
+    is_range = aux1 != U64(0)
+    regen = jnp.where(is_range,
+                      d.rand_range_int(k_range, aux0, jnp.maximum(aux1, aux0)),
+                      d.rand_int(k_regen))
+    branch = d._categorical(k_branch, _INT_ARITH_P)
+    plus = val + d.intn(k_d1, 4).astype(U64) + U64(1)
+    minus = val - d.intn(k_d1, 4).astype(U64) - U64(1)
+    xored = val ^ (U64(1) << d.intn(k_bit, 64).astype(U64))
+    arith = jnp.select([branch == 0, branch == 1], [plus, minus], xored)
+    return jnp.where(d.bin_(k_bin), regen, arith)
+
+
+_INT_ARITH_P = jnp.cumsum(jnp.array([1 / 3, 1 / 3, 1 / 3]))
+
+
+def _mutate_flags_value(key, val, flag_set, flag_vals, flag_counts):
+    k_bin, k_regen, k_arith = random.split(key, 3)
+    fs = jnp.maximum(flag_set, 0)
+    regen = d.flags_value(k_regen, flag_vals[fs], flag_counts[fs])
+    k_branch, k_d1, k_bit = random.split(k_arith, 3)
+    branch = d._categorical(k_branch, _INT_ARITH_P)
+    arith = jnp.select(
+        [branch == 0, branch == 1],
+        [val + d.intn(k_d1, 4).astype(U64) + U64(1),
+         val - d.intn(k_d1, 4).astype(U64) - U64(1)],
+        val ^ (U64(1) << d.intn(k_bit, 64).astype(U64)))
+    return jnp.where(d.bin_(k_bin), regen, arith)
+
+
+def _mutate_proc_value(key, aux1):
+    # regenerate: rand(values_per_proc) (reference: prog/rand.go:634-636)
+    return d.intn(key, jnp.maximum(aux1.astype(jnp.int64), 1)).astype(U64)
+
+
+def _mutate_len_value(key, val, elem_size):
+    """mutate_size (reference: prog/size.go:119-175)."""
+    ks = random.split(key, 8)
+    elem = jnp.maximum(elem_size, U64(1))
+    rand_any = d.rand64(ks[1])
+    # small adjust
+    down = d.rand_range_int(ks[2], U64(0), jnp.maximum(val, U64(1)) - U64(1))
+    up = d.rand_range_int(ks[3], val + U64(1), val + U64(1000))
+    small = jnp.where((val != U64(0)) & d.bin_(ks[4]), down, up)
+    # overflow provoking
+    maxv = jnp.select(
+        [d.one_of(ks[5], 3) & d.one_of(ks[6], 2) & d.one_of(ks[7], 2),
+         d.one_of(ks[5], 3) & d.one_of(ks[6], 2),
+         d.one_of(ks[5], 3)],
+        [U64(0xFF), U64(0xFFFF), U64(0xFFFFFFFF)], MASK64)
+    # maxv // elem without u64 division: exact shift for pow2 elem
+    # sizes (the common case), f32 approximation otherwise.
+    log2 = U64(63) - lax.clz(elem).astype(U64)
+    is_pow2 = (elem & (elem - U64(1))) == U64(0)
+    approx = (maxv.astype(jnp.float32) /
+              elem.astype(jnp.float32)).astype(U64)
+    n = jnp.where(is_pow2, maxv >> log2, approx)
+    delta = (U64(1000) - d.biased_rand(ks[0], 1000, 10).astype(U64))
+    k_dir = random.fold_in(key, 99)
+    minus = (elem == U64(1)) | d.one_of(k_dir, 10)
+    overflow = jnp.where(minus, n - delta, n + delta)
+    k_a, k_b = random.split(random.fold_in(key, 100))
+    return jnp.where(d.one_of(k_a, 100), rand_any,
+                     jnp.where(d.bin_(k_b), small, overflow))
+
+
+# -- data (arena) mutation ----------------------------------------------
+
+
+def _load_le(arena, pos, width):
+    """Little-endian load of `width` bytes at dynamic pos."""
+    idx = pos + jnp.arange(8)
+    bytes_ = arena[jnp.clip(idx, 0, arena.shape[0] - 1)].astype(U64)
+    shifts = (jnp.arange(8) * 8).astype(U64)
+    valid = jnp.arange(8) < width
+    return jnp.sum(jnp.where(valid, bytes_ << shifts, U64(0)))
+
+
+def _store_le(arena, pos, width, value):
+    idx = pos + jnp.arange(8)
+    new_bytes = ((value >> (jnp.arange(8) * 8).astype(U64)) & U64(0xFF)
+                 ).astype(jnp.uint8)
+    valid = jnp.arange(8) < width
+    safe = jnp.clip(idx, 0, arena.shape[0] - 1)
+    cur = arena[safe]
+    return arena.at[safe].set(jnp.where(valid, new_bytes, cur))
+
+
+def _mutate_data_span(key, arena, off, length, cap, min_len, max_len):
+    """One application of a random byte-level op on span [off, off+length)
+    with growth capped at cap (reference: prog/mutation.go:404-521).
+    Returns (arena, new_length, ok)."""
+    max_len = jnp.minimum(max_len, cap.astype(U64)).astype(jnp.int32)
+    min_len = min_len.astype(jnp.int32)
+    A = arena.shape[0]
+    idx = jnp.arange(A, dtype=jnp.int32)
+    rel = idx - off
+    k_op, k1, k2, k3, k4, k5, k6 = random.split(key, 7)
+    op = d.intn(k_op, 7)
+
+    # 1) flip a bit
+    def op_flip():
+        kp, kb = random.split(k1)
+        pos = off + d.intn(kp, jnp.maximum(length, 1)).astype(jnp.int32)
+        bit = d.intn(kb, 8).astype(jnp.uint8)
+        new = arena.at[pos].set(arena[pos] ^ (jnp.uint8(1) << bit))
+        ok = length > 0
+        return jnp.where(ok, new, arena), length, ok
+
+    # 2) insert random bytes at pos, maybe truncating back
+    def op_insert():
+        kn, kp, kr, kb = random.split(k2, 4)
+        n = jnp.minimum(d.intn(kn, 16).astype(jnp.int32) + 1,
+                        jnp.minimum(max_len - length, cap - length))
+        pos = d.intn(kp, jnp.maximum(length, 1)).astype(jnp.int32)
+        rnd256 = random.randint(kr, (256,), 0, 256,
+                                dtype=jnp.int32).astype(jnp.uint8)
+        rnd = rnd256[(rel - pos) & 255]
+        in_span = (rel >= 0) & (rel < cap)
+        shifted = arena[jnp.clip(idx - n, 0, A - 1)]
+        new = jnp.where(in_span & (rel >= pos) & (rel < pos + n), rnd,
+                        jnp.where(in_span & (rel >= pos + n), shifted, arena))
+        keep_len = d.bin_(kb)
+        new_len = jnp.where(keep_len, length, length + n)
+        ok = (length > 0) & (n > 0)
+        return (jnp.where(ok, new, arena),
+                jnp.where(ok, new_len, length), ok)
+
+    # 3) remove bytes at pos, maybe re-extending with zeros
+    def op_remove():
+        kn, kp, kb = random.split(k3, 3)
+        n = jnp.minimum(d.intn(kn, 16).astype(jnp.int32) + 1, length)
+        pos = jnp.where(
+            n < length,
+            d.intn(kp, jnp.maximum(length - n, 1)).astype(jnp.int32), 0)
+        in_span = (rel >= 0) & (rel < cap)
+        shifted = arena[jnp.clip(idx + n, 0, A - 1)]
+        new = jnp.where(in_span & (rel >= pos), shifted, arena)
+        pad_zeros = d.bin_(kb)
+        short = length - n
+        # re-extend with zeros to the original length
+        new = jnp.where(
+            pad_zeros & in_span & (rel >= short) & (rel < length),
+            jnp.uint8(0), new)
+        new_len = jnp.where(pad_zeros, length, short)
+        ok = length > min_len
+        return (jnp.where(ok, new, arena),
+                jnp.where(ok, new_len, length), ok)
+
+    # 4) append random bytes
+    def op_append():
+        kn, kr = random.split(k4)
+        want = 256 - d.biased_rand(kn, 256, 10).astype(jnp.int32)
+        n = jnp.minimum(want, jnp.minimum(max_len - length, cap - length))
+        rnd256 = random.randint(kr, (256,), 0, 256,
+                                dtype=jnp.int32).astype(jnp.uint8)
+        rnd = rnd256[(rel - length) & 255]
+        in_new = (rel >= length) & (rel < length + n)
+        new = jnp.where(in_new, rnd, arena)
+        ok = length < max_len
+        return (jnp.where(ok, new, arena),
+                jnp.where(ok, length + n, length), ok)
+
+    # 5) replace an int with a random value
+    def op_replace():
+        kw, kp, kv = random.split(k5, 3)
+        w = (1 << d.intn(kw, 4)).astype(jnp.int32)
+        ok = length >= w
+        pos = off + d.intn(kp, jnp.maximum(length - w + 1, 1)).astype(jnp.int32)
+        new = _store_le(arena, pos, w, d.uint64(kv))
+        return jnp.where(ok, new, arena), length, ok
+
+    # 6) add/subtract a small delta from an int
+    def op_addsub():
+        kw, kp, kd, ke = random.split(k6, 4)
+        w = (1 << d.intn(kw, 4)).astype(jnp.int32)
+        ok = length >= w
+        pos = off + d.intn(kp, jnp.maximum(length - w + 1, 1)).astype(jnp.int32)
+        v = _load_le(arena, pos, w)
+        delta = d.intn(kd, 2 * 35 + 1) - 35
+        delta = jnp.where(delta == 0, 1, delta).astype(jnp.int64)
+        dd = lax.convert_element_type(delta, jnp.uint64)
+        swapped = d.one_of(ke, 10)
+        v1 = jnp.where(swapped,
+                       _swap_int(_swap_int(v, w) + dd, w),
+                       v + dd)
+        new = _store_le(arena, pos, w, v1)
+        return jnp.where(ok, new, arena), length, ok
+
+    # 7) set an int to an interesting value
+    def op_interesting():
+        kw, kp, kv, ke = random.split(random.fold_in(key, 7), 4)
+        w = (1 << d.intn(kw, 4)).astype(jnp.int32)
+        ok = length >= w
+        pos = off + d.intn(kp, jnp.maximum(length - w + 1, 1)).astype(jnp.int32)
+        v = d.rand_int(kv)
+        v = jnp.where(d.one_of(ke, 10), _swap_int(v, 8), v)
+        new = _store_le(arena, pos, w, v)
+        return jnp.where(ok, new, arena), length, ok
+
+    return lax.switch(op, [op_flip, op_insert, op_remove, op_append,
+                           op_replace, op_addsub, op_interesting])
+
+
+# -- the per-program mutation round -------------------------------------
+
+
+def _mutate_slot(key, state, flag_vals, flag_counts):
+    """Pick one eligible slot and mutate it in place."""
+    k_pick, k_mut, k_data = random.split(key, 3)
+    kind = state["kind"]
+    alive = state["call_alive"][jnp.clip(state["call"], 0, None).astype(jnp.int32)]
+    eligible = (kind != EMPTY) & alive
+    s = d.masked_choice(k_pick, eligible)
+    s_safe = jnp.maximum(s, 0)
+    sk = kind[s_safe]
+    val = state["val"][s_safe]
+    width = state["width"][s_safe]
+    aux0 = state["aux0"][s_safe]
+    aux1 = state["aux1"][s_safe]
+    fs = state["flag_set"][s_safe]
+
+    new_int = _mutate_int_value(k_mut, val, width, aux0, aux1, sk)
+    new_flags = _mutate_flags_value(k_mut, val, fs, flag_vals, flag_counts)
+    new_proc = _mutate_proc_value(k_mut, aux1)
+    new_len = _mutate_len_value(k_mut, val, aux0)
+    new_val = jnp.select(
+        [sk == INT, sk == FLAGS, sk == PROC, sk == LEN],
+        [new_int, new_flags, new_proc, new_len], val)
+
+    # data op: loop until an op succeeds and a 1/3 coin says stop,
+    # approximated by 3 bounded attempts (reference: mutation.go:394-400)
+    def data_body(i, carry):
+        arena, length, done = carry
+        kk = random.fold_in(k_data, i)
+        a2, l2, ok = _mutate_data_span(
+            kk, arena, state["off"][s_safe], length, state["cap"][s_safe],
+            state["aux0"][s_safe], state["aux1"][s_safe])
+        stop = ok & d.one_of(random.fold_in(kk, 1), 3)
+        arena = jnp.where(done, arena, a2)
+        length = jnp.where(done, length, l2)
+        return arena, length, done | stop
+
+    arena, new_dlen, _ = lax.fori_loop(
+        0, 3, data_body, (state["arena"], state["len_"][s_safe], False))
+
+    is_data = (sk == DATA) & (s >= 0)
+    is_val = (sk != DATA) & (s >= 0)
+    state = dict(state)
+    state["val"] = state["val"].at[s_safe].set(
+        jnp.where(is_val, new_val, val))
+    state["arena"] = jnp.where(is_data, arena, state["arena"])
+    state["len_"] = state["len_"].at[s_safe].set(
+        jnp.where(is_data, new_dlen, state["len_"][s_safe]))
+    state["preserve_sizes"] = state["preserve_sizes"] | ((sk == LEN) & (s >= 0))
+    return state
+
+
+def _remove_call(key, state):
+    alive = state["call_alive"]
+    ci = d.masked_choice(key, alive)
+    ok = (ci >= 0) & (alive.sum() > 0)
+    ci_safe = jnp.maximum(ci, 0)
+    new_alive = alive.at[ci_safe].set(jnp.where(ok, False, alive[ci_safe]))
+    state = dict(state)
+    state["call_alive"] = new_alive
+    return state
+
+
+def _mutate_one(state, key, flag_vals, flag_counts, rounds):
+    """The outer weighted loop (reference: prog/mutation.go:19-132),
+    restricted to device ops: 10/11 mutate-arg, 1/11 remove-call, with
+    a 1/3 stop coin per round, bounded at `rounds`."""
+    state = dict(state)
+    state["preserve_sizes"] = jnp.bool_(False)
+
+    def body(i, carry):
+        state, active = carry
+        kk = random.fold_in(key, i)
+        k_op, k_do, k_stop = random.split(kk, 3)
+        do_remove = d.n_out_of(k_op, 1, 11)
+        mutated = _mutate_slot(k_do, state, flag_vals, flag_counts)
+        removed = _remove_call(k_do, state)
+        pick = lambda a, b, c: jnp.where(
+            active, jnp.where(do_remove, b, a), c)
+        new_state = jax.tree_util.tree_map(pick, mutated, removed, state)
+        active = active & ~d.one_of(k_stop, 3)
+        return new_state, active
+
+    state, _ = lax.fori_loop(0, rounds, body, (state, jnp.bool_(True)))
+    return state
+
+
+def make_mutator(rounds: int = 4):
+    """Build the jitted batched mutator.
+
+    mutate_batch(batch, key, flag_vals, flag_counts) -> batch
+    where batch is a dict of stacked program-tensor arrays.
+    """
+
+    @functools.partial(jax.jit, static_argnames=())
+    def mutate_batch(batch: dict, key, flag_vals, flag_counts) -> dict:
+        b = batch["kind"].shape[0]
+        keys = random.split(key, b)
+        fn = lambda state, k: _mutate_one(state, k, flag_vals, flag_counts,
+                                          rounds)
+        return jax.vmap(fn)(batch, keys)
+
+    return mutate_batch

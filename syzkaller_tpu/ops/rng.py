@@ -1,0 +1,169 @@
+"""Device-side fuzzing RNG distributions.
+
+Re-derivations of the reference's biased distributions
+(reference: prog/rand.go:57-151) from jax.random primitives, shaped so
+every function is vmap-able: all take a key and return a scalar (or
+per-key scalars under vmap).  Statistical parity with models/rand.py
+is covered by tests/test_ops_rng.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import random
+
+from syzkaller_tpu.models.rand import SPECIAL_INTS
+
+SPECIAL_INTS_ARR = jnp.array(SPECIAL_INTS, dtype=jnp.uint64)
+
+MASK64 = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def U64_M(n: int) -> jax.Array:
+    """Mask for modulo by a power of two."""
+    assert n & (n - 1) == 0
+    return jnp.uint64(n - 1)
+
+
+def intn(key, n) -> jax.Array:
+    """Uniform-ish [0, n) via u32 modulo; n may be traced, must be
+    < 2^31.  The modulo bias is negligible for fuzzing distributions
+    and u32 division compiles ~10x faster than the u64 path on XLA:CPU
+    (measured; u64 div lowers to a software routine per instance)."""
+    n32 = jnp.asarray(n).astype(jnp.uint32)
+    v = random.bits(key, dtype=jnp.uint32) % jnp.maximum(n32, jnp.uint32(1))
+    return v.astype(jnp.int64)
+
+
+def n_out_of(key, n: int, out_of: int) -> jax.Array:
+    return intn(key, out_of) < n
+
+
+def one_of(key, n: int) -> jax.Array:
+    return intn(key, n) == 0
+
+
+def bin_(key) -> jax.Array:
+    return random.bernoulli(key)
+
+
+def uint64(key) -> jax.Array:
+    return random.bits(key, dtype=jnp.uint64)
+
+
+def rand64(key) -> jax.Array:
+    """63 random bits, top bit set half the time
+    (reference: prog/rand.go:48-54)."""
+    k1, k2 = random.split(key)
+    v = random.bits(k1, dtype=jnp.uint64) >> jnp.uint64(1)
+    top = jnp.where(random.bernoulli(k2), jnp.uint64(1) << jnp.uint64(63),
+                    jnp.uint64(0))
+    return v | top
+
+
+def rand_int(key) -> jax.Array:
+    """The magic integer distribution (reference: prog/rand.go:67-91).
+
+    Branch probabilities composed into a single categorical:
+      mod 10: 100/182, special: 50/182, mod 256: 10/182,
+      mod 4K: 10/182, mod 64K: 10/182, mod 2^31: 2/182
+    then: keep 100/107, negate 5/107, shift-left 2/107.
+    """
+    k1, k2, k3, k4, k5 = random.split(key, 5)
+    v = rand64(k1)
+    bucket = _categorical(k2, _RAND_INT_P1)
+    special = SPECIAL_INTS_ARR[intn(k3, len(SPECIAL_INTS))]
+    # All moduli except 10 are powers of two -> masks; %10 runs in u32
+    # (u64 division is pathologically slow to compile on XLA:CPU).
+    mod10 = (v.astype(jnp.uint32) % jnp.uint32(10)).astype(jnp.uint64)
+    v = jnp.select(
+        [bucket == 0, bucket == 1, bucket == 2, bucket == 3, bucket == 4],
+        [mod10, special, v & U64_M(256), v & U64_M(4 << 10),
+         v & U64_M(64 << 10)],
+        v & U64_M(1 << 31))
+    post = _categorical(k4, _RAND_INT_P2)
+    shift = intn(k5, 63).astype(jnp.uint64)
+    v = jnp.select([post == 0, post == 1],
+                   [v, (-v.astype(jnp.int64)).astype(jnp.uint64)],
+                   v << shift)
+    return v
+
+
+_RAND_INT_P1 = jnp.cumsum(jnp.array([100, 50, 10, 10, 10, 2]) / 182.0)
+_RAND_INT_P2 = jnp.cumsum(jnp.array([100, 5, 2]) / 107.0)
+
+
+def _categorical(key, cum_probs) -> jax.Array:
+    u = random.uniform(key, dtype=jnp.float32)
+    return jnp.searchsorted(cum_probs.astype(jnp.float32), u)
+
+
+def mulhi64(a, b) -> jax.Array:
+    """floor(a*b / 2^64) via 32-bit limbs — no u64 division, no f64
+    (both are slow/unsupported on TPU)."""
+    m32 = jnp.uint64(0xFFFFFFFF)
+    a0, a1 = a & m32, a >> jnp.uint64(32)
+    b0, b1 = b & m32, b >> jnp.uint64(32)
+    p0 = a0 * b0
+    p1 = a0 * b1
+    p2 = a1 * b0
+    p3 = a1 * b1
+    mid = (p0 >> jnp.uint64(32)) + (p1 & m32) + (p2 & m32)
+    return p3 + (p1 >> jnp.uint64(32)) + (p2 >> jnp.uint64(32)) \
+        + (mid >> jnp.uint64(32))
+
+
+def rand_range_int(key, begin, end) -> jax.Array:
+    """(reference: prog/rand.go:93-98).  The in-range draw maps a
+    uniform u64 into [0, span) with mulhi instead of modulo (u64 div is
+    pathologically slow to compile on XLA:CPU and emulated on TPU)."""
+    k1, k2, k3 = random.split(key, 3)
+    span = jnp.maximum(end - begin + jnp.uint64(1), jnp.uint64(1))
+    in_range = begin + mulhi64(uint64(k2), span)
+    return jnp.where(one_of(k1, 100), rand_int(k3), in_range)
+
+
+def biased_rand(key, n: int, k: int) -> jax.Array:
+    """Quadratic bias towards n-1 (reference: prog/rand.go:100-107)."""
+    nf, kf = float(n), float(k)
+    rf = nf * (kf / 2 + 1) * random.uniform(key, dtype=jnp.float32)
+    bf = (-1.0 + jnp.sqrt(1 + 2 * kf * rf / nf)) * nf / kf
+    return jnp.minimum(bf.astype(jnp.int64), n - 1)
+
+
+def flags_value(key, vals, count) -> jax.Array:
+    """Flag sampling (reference: prog/rand.go:138-152).
+    vals: uint64[MAXV] padded flag values, count: number valid.
+    Branches: OR-loop 90/111, single 10/111, zero 10/111, rand64 1/111.
+    The OR-loop draws geometric(1/2) values, capped at 4.
+    """
+    k1, k2, k3, k4 = random.split(key, 4)
+    count32 = jnp.maximum(jnp.asarray(count).astype(jnp.uint32), jnp.uint32(1))
+    branch = _categorical(k1, _FLAGS_P)
+    idxs = (random.bits(k2, (4,), dtype=jnp.uint32) % count32).astype(jnp.int32)
+    picks = vals[idxs]
+    # geometric number of OR'd values: 1 + #consecutive-heads (cap 4)
+    coins = random.bernoulli(k3, shape=(3,))
+    ncoins = 1 + jnp.cumprod(~coins).sum()
+    take = jnp.arange(4) < ncoins
+    masked = jnp.where(take, picks, jnp.uint64(0))
+    or_val = masked[0] | masked[1] | masked[2] | masked[3]
+    return jnp.select(
+        [branch == 0, branch == 1, branch == 2],
+        [or_val, picks[0], jnp.uint64(0)],
+        rand64(k4))
+
+
+_FLAGS_P = jnp.cumsum(jnp.array([90, 10, 10, 1]) / 111.0)
+
+
+def masked_choice(key, mask) -> jax.Array:
+    """Uniformly choose an index where mask is True; -1 if none."""
+    n = mask.shape[0]
+    count = mask.sum()
+    pick = intn(key, jnp.maximum(count, 1))
+    # index of the pick-th True element
+    cum = jnp.cumsum(mask) - 1
+    idx = jnp.argmax((cum == pick) & mask)
+    return jnp.where(count > 0, idx, -1)

@@ -1,0 +1,101 @@
+"""Coverage-signal bitmap kernels.
+
+The reference keeps Signal as a Go map per process and merges maps
+over RPC (reference: pkg/signal/signal.go:16,73-131).  On device the
+global signal is one dense uint8 plane of 2^FOLD_BITS buckets storing
+(max seen priority + 1), 0 = unseen.  Edge hashes are 32-bit; they are
+folded into the plane the same way the executor folds its dedup table
+(reference: executor/executor.h:677-706) — xor-fold then mask.
+
+Batched ops (all jit/vmap, static shapes):
+  diff_batch   per-program novelty mask + count vs the plane
+  merge        scatter-max accepted programs' edges into the plane
+  to_signal    host-side conversion for corpus bookkeeping
+
+Novelty decisions are bit-exact with the CPU Signal on folded hashes;
+the fold itself trades a measurable false-negative rate for memory
+(2^26 buckets = 64 MB), as the survey prescribes (SURVEY.md §7 hard
+part d).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FOLD_BITS = 26
+PLANE_SIZE = 1 << FOLD_BITS
+
+
+def fold_hash(edges):
+    """xor-fold a 32-bit edge hash into FOLD_BITS."""
+    edges = edges.astype(jnp.uint32)
+    return ((edges ^ (edges >> jnp.uint32(FOLD_BITS)))
+            & jnp.uint32(PLANE_SIZE - 1)).astype(jnp.int32)
+
+
+def new_plane() -> jax.Array:
+    return jnp.zeros(PLANE_SIZE, dtype=jnp.uint8)
+
+
+@jax.jit
+def diff_batch(plane, edges, nedges, prios):
+    """Per-program novelty vs the plane.
+
+    plane: uint8[PLANE]; edges: uint32[B, E]; nedges: int32[B];
+    prios: uint8[B] (0..3).
+    Returns (new_mask: bool[B, E], new_count: int32[B]) where new_mask
+    marks edges unseen at >= prio (reference: pkg/signal/signal.go:90-102).
+    """
+    idx = fold_hash(edges)
+    seen = plane[idx]  # uint8[B, E]
+    E = edges.shape[1]
+    valid = jnp.arange(E)[None, :] < nedges[:, None]
+    new = (seen < (prios[:, None] + 1)) & valid
+    # Dedup within each program: only one occurrence of a bucket counts
+    # (a Go map write is idempotent).  Invalid lanes get unique
+    # sentinels so they never steal a bucket's "first" mark.
+    sentinel = PLANE_SIZE + jnp.arange(E, dtype=jnp.int32)[None, :]
+    didx = jnp.where(valid, idx, sentinel)
+    new = new & _unique_mask(didx)
+    return new, new.sum(axis=1).astype(jnp.int32)
+
+
+def _unique_mask(idx):
+    """bool[B, E]: one True per distinct value per row (sort-based)."""
+    order = jnp.argsort(idx, axis=1)
+    sorted_idx = jnp.take_along_axis(idx, order, axis=1)
+    first_sorted = jnp.concatenate(
+        [jnp.ones_like(sorted_idx[:, :1], dtype=bool),
+         sorted_idx[:, 1:] != sorted_idx[:, :-1]], axis=1)
+    rank = jnp.argsort(order, axis=1)
+    return jnp.take_along_axis(first_sorted, rank, axis=1)
+
+
+@jax.jit
+def merge(plane, edges, nedges, prios, accept):
+    """Scatter accepted programs' edges into the plane at max prio.
+
+    accept: bool[B] — only accepted programs contribute
+    (reference merge semantics: pkg/signal/signal.go:117-131)."""
+    idx = fold_hash(edges)
+    valid = (jnp.arange(edges.shape[1])[None, :] < nedges[:, None]) \
+        & accept[:, None]
+    val = jnp.where(valid, prios[:, None] + 1, 0).astype(jnp.uint8)
+    return plane.at[idx.reshape(-1)].max(val.reshape(-1))
+
+
+@jax.jit
+def plane_count(plane):
+    return (plane > 0).sum()
+
+
+def to_signal(plane_np: np.ndarray):
+    """Host conversion of the plane into a models Signal (folded)."""
+    from syzkaller_tpu.signal import Signal
+
+    nz = np.nonzero(plane_np)[0]
+    return Signal({int(i): int(plane_np[i]) - 1 for i in nz})

@@ -1,0 +1,333 @@
+"""Program tensor: the flat, fixed-shape device encoding of syscall
+programs.
+
+The reference mutates a pointer-rich typed tree; TPUs need dense
+tensors with static shapes.  A program becomes:
+
+  call table   call_id:int32[C], call_alive:bool[C], ncalls:int32
+  slot table   one row per *mutable scalar or data region* discovered
+               by a tree walk at encode time:
+                 kind:int8[S]       (EMPTY/INT/FLAGS/PROC/LEN/DATA)
+                 call:int8[S]       owning call index
+                 width:int8[S]      byte width of value slots
+                 aux0,aux1:uint64[S] kind-specific (ranges, proc
+                                    start/per, data min/max len)
+                 flag_set:int32[S]  index into the target flag table
+                 val:uint64[S]      current value (value slots)
+                 off,len,cap:int32[S] arena span (data slots)
+  arena        uint8[A] byte storage for all data slots
+
+The CPU-side codec keeps, per corpus program, the slot->Arg paths
+needed to decode a mutated tensor back into a typed Prog (metadata
+never ships to the device).  Encode is one tree walk; decode clones
+the template and writes mutated values/spans back, then re-runs size
+assignment — so exec serialization sees a normal typed program.
+
+This realizes the survey's design: mutation ops become vmap-able
+index/scatter ops over these arrays while tree-recursive structure
+ops (call insertion, squash, splice) stay on the host
+(reference hot loop: prog/mutation.go:14-142; format cousin:
+prog/encodingexec.go:7-18).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from syzkaller_tpu.models.mutation import MutationArgs
+from syzkaller_tpu.models.prog import (
+    Call,
+    ConstArg,
+    DataArg,
+    Prog,
+    foreach_arg,
+)
+from syzkaller_tpu.models.size import assign_sizes_call
+from syzkaller_tpu.models.types import (
+    ArrayType,
+    BufferKind,
+    BufferType,
+    Dir,
+    FlagsType,
+    IntKind,
+    IntType,
+    LenType,
+    ProcType,
+    VmaType,
+)
+
+# Slot kinds.
+EMPTY, INT, FLAGS, PROC, LEN, DATA = 0, 1, 2, 3, 4, 5
+
+MAX_BLOB_DEVICE = 4096  # per-slot growth cap on device (vs 100K on CPU)
+
+
+@dataclass
+class TensorConfig:
+    max_calls: int = 32
+    max_slots: int = 224
+    arena: int = 8192
+
+    def like(self) -> dict:
+        return dict(max_calls=self.max_calls, max_slots=self.max_slots,
+                    arena=self.arena)
+
+
+@dataclass
+class FlagTables:
+    """Global flag-set value table shared by a whole target."""
+
+    vals: np.ndarray  # uint64[NF, MAXV]
+    counts: np.ndarray  # int32[NF]
+    index: dict[tuple[int, ...], int]
+
+    @classmethod
+    def empty(cls, maxv: int = 16) -> "FlagTables":
+        return cls(np.zeros((1, maxv), dtype=np.uint64),
+                   np.zeros(1, dtype=np.int32), {})
+
+    def intern(self, vals: tuple[int, ...]) -> int:
+        key = tuple(vals)
+        idx = self.index.get(key)
+        if idx is not None:
+            return idx
+        maxv = self.vals.shape[1]
+        row = np.zeros(maxv, dtype=np.uint64)
+        n = min(len(vals), maxv)
+        row[:n] = np.array(vals[:n], dtype=np.uint64)
+        self.vals = np.vstack([self.vals, row[None]])
+        self.counts = np.append(self.counts, np.int32(n))
+        idx = len(self.counts) - 1
+        self.index[key] = idx
+        return idx
+
+
+@dataclass
+class ProgTensor:
+    """Host (numpy) form of one encoded program."""
+
+    cfg: TensorConfig
+    call_id: np.ndarray
+    call_alive: np.ndarray
+    ncalls: int
+    kind: np.ndarray
+    call: np.ndarray
+    width: np.ndarray
+    aux0: np.ndarray
+    aux1: np.ndarray
+    flag_set: np.ndarray
+    val: np.ndarray
+    off: np.ndarray
+    len_: np.ndarray
+    cap: np.ndarray
+    arena: np.ndarray
+    # CPU-only metadata: per slot, the path to the Arg in the template.
+    template: Prog = None  # type: ignore[assignment]
+    slot_args: list = field(default_factory=list)
+
+    def arrays(self) -> dict[str, np.ndarray]:
+        return dict(call_id=self.call_id, call_alive=self.call_alive,
+                    ncalls=np.int32(self.ncalls), kind=self.kind,
+                    call=self.call, width=self.width, aux0=self.aux0,
+                    aux1=self.aux1, flag_set=self.flag_set, val=self.val,
+                    off=self.off, len_=self.len_, cap=self.cap,
+                    arena=self.arena)
+
+
+class ProgramTooLarge(Exception):
+    pass
+
+
+def encode_prog(p: Prog, cfg: TensorConfig, flags: FlagTables) -> ProgTensor:
+    """Flatten a typed program into tensor form.  Walks the same arg set
+    the reference's mutationArgs collector visits
+    (reference: prog/mutation.go:345-392), so device-mutable slots
+    match what Mutate would touch."""
+    if len(p.calls) > cfg.max_calls:
+        raise ProgramTooLarge(f"{len(p.calls)} calls > {cfg.max_calls}")
+    t = ProgTensor(
+        cfg=cfg,
+        call_id=np.full(cfg.max_calls, -1, dtype=np.int32),
+        call_alive=np.zeros(cfg.max_calls, dtype=bool),
+        ncalls=len(p.calls),
+        kind=np.zeros(cfg.max_slots, dtype=np.int8),
+        call=np.zeros(cfg.max_slots, dtype=np.int8),
+        width=np.zeros(cfg.max_slots, dtype=np.int8),
+        aux0=np.zeros(cfg.max_slots, dtype=np.uint64),
+        aux1=np.zeros(cfg.max_slots, dtype=np.uint64),
+        flag_set=np.full(cfg.max_slots, -1, dtype=np.int32),
+        val=np.zeros(cfg.max_slots, dtype=np.uint64),
+        off=np.zeros(cfg.max_slots, dtype=np.int32),
+        len_=np.zeros(cfg.max_slots, dtype=np.int32),
+        cap=np.zeros(cfg.max_slots, dtype=np.int32),
+        arena=np.zeros(cfg.arena, dtype=np.uint8),
+        template=p,
+    )
+    slot = 0
+    arena_pos = 0
+
+    for ci, c in enumerate(p.calls):
+        t.call_id[ci] = c.meta.id
+        t.call_alive[ci] = True
+        # Collect device-mutable args exactly as MutationArgs does.
+        ma = MutationArgs(p.target)
+        foreach_arg(c, ma.collect)
+        for arg, ctx in zip(ma.args, ma.ctxes):
+            typ = arg.typ
+            row: Optional[dict] = None
+            if isinstance(typ, IntType) and isinstance(arg, ConstArg):
+                row = dict(kind=INT, width=typ.type_size,
+                           aux0=typ.range_begin, aux1=typ.range_end,
+                           val=arg.val)
+                if typ.kind != IntKind.RANGE:
+                    row["aux0"] = row["aux1"] = 0
+            elif isinstance(typ, FlagsType) and isinstance(arg, ConstArg):
+                row = dict(kind=FLAGS, width=typ.type_size,
+                           flag_set=flags.intern(typ.vals), val=arg.val)
+            elif isinstance(typ, ProcType) and isinstance(arg, ConstArg):
+                row = dict(kind=PROC, width=typ.type_size,
+                           aux0=typ.values_start, aux1=typ.values_per_proc,
+                           val=arg.val)
+            elif isinstance(typ, LenType) and isinstance(arg, ConstArg):
+                elem_size, ok = _len_elem_size(typ, ctx)
+                if not ok:
+                    continue
+                row = dict(kind=LEN, width=typ.type_size, aux0=elem_size,
+                           val=arg.val)
+            elif isinstance(typ, BufferType) and isinstance(arg, DataArg) \
+                    and typ.dir != Dir.OUT:
+                if typ.kind in (BufferKind.BLOB_RAND, BufferKind.BLOB_RANGE) \
+                        or (typ.kind == BufferKind.STRING and not typ.values):
+                    data = bytes(arg.data)
+                    min_len, max_len = 0, MAX_BLOB_DEVICE
+                    if typ.kind == BufferKind.BLOB_RANGE:
+                        min_len, max_len = typ.range_begin, \
+                            min(typ.range_end, MAX_BLOB_DEVICE)
+                    elif typ.kind == BufferKind.STRING and typ.type_size:
+                        min_len = max_len = typ.type_size
+                    if len(data) > MAX_BLOB_DEVICE:
+                        continue  # oversized blob: CPU-only mutation
+                    cap = min(_round_cap(max(len(data) * 2, 64)),
+                              cfg.arena - arena_pos, max_len)
+                    cap = max(cap, len(data))
+                    if arena_pos + cap > cfg.arena:
+                        continue  # arena full: slot stays CPU-only
+                    t.arena[arena_pos:arena_pos + len(data)] = \
+                        np.frombuffer(data, dtype=np.uint8)
+                    row = dict(kind=DATA, off=arena_pos, len_=len(data),
+                               cap=cap, aux0=min_len, aux1=max_len)
+                    arena_pos += cap
+            if row is None:
+                continue
+            if slot >= cfg.max_slots:
+                raise ProgramTooLarge("slot table full")
+            t.kind[slot] = row.get("kind", EMPTY)
+            t.call[slot] = ci
+            t.width[slot] = row.get("width", 0)
+            t.aux0[slot] = np.uint64(row.get("aux0", 0))
+            t.aux1[slot] = np.uint64(row.get("aux1", 0))
+            t.flag_set[slot] = row.get("flag_set", -1)
+            t.val[slot] = np.uint64(row.get("val", 0))
+            t.off[slot] = row.get("off", 0)
+            t.len_[slot] = row.get("len_", 0)
+            t.cap[slot] = row.get("cap", 0)
+            t.slot_args.append(arg)
+            slot += 1
+    # Pad slot_args so indices line up with slot table rows.
+    assert len(t.slot_args) == slot
+    return t
+
+
+def _round_cap(n: int) -> int:
+    c = 64
+    while c < n:
+        c *= 2
+    return c
+
+
+def _len_elem_size(typ: LenType, ctx) -> tuple[int, bool]:
+    """Element size for mutate_size, resolved at encode time
+    (reference: prog/size.go:119-141)."""
+    from syzkaller_tpu.models.prog import inner_arg
+
+    elem_size = typ.bit_size // 8
+    if elem_size:
+        return elem_size, True
+    elem_size = 1
+    if ctx.parent is not None:
+        for f in ctx.parent:
+            if typ.buf != f.typ.field_name:
+                continue
+            inner = inner_arg(f)
+            if inner is not None:
+                it = inner.typ
+                if isinstance(it, VmaType):
+                    return 0, False
+                if isinstance(it, ArrayType):
+                    assert it.elem is not None
+                    if it.elem.varlen:
+                        return 0, False
+                    elem_size = it.elem.size()
+            break
+    return elem_size, True
+
+
+def decode_prog(t: ProgTensor, mutated: dict[str, np.ndarray],
+                preserve_sizes: bool = False) -> Prog:
+    """Write a mutated tensor back into a clone of the template.
+
+    Only the device-mutable state (slot values, data spans, call
+    aliveness) can change; structure is the template's.  Size fields
+    are reassigned afterwards unless a LEN slot itself was mutated
+    (matching the reference's updateSizes/preserve contract,
+    reference: prog/mutation.go:100-121)."""
+    p = t.template.clone()
+    # Map template args -> cloned args by walk order.
+    tmpl_args: list = []
+    clone_args: list = []
+    for c in t.template.calls:
+        foreach_arg(c, lambda a, ctx: tmpl_args.append(a))
+    for c in p.calls:
+        foreach_arg(c, lambda a, ctx: clone_args.append(a))
+    amap = {id(a): b for a, b in zip(tmpl_args, clone_args)}
+
+    kind = mutated["kind"]
+    val = mutated["val"]
+    off = mutated["off"]
+    len_ = mutated["len_"]
+    arena = mutated["arena"]
+    call_alive = mutated["call_alive"]
+
+    for s, arg in enumerate(t.slot_args):
+        target_arg = amap[id(arg)]
+        k = int(kind[s])
+        if k in (INT, FLAGS, PROC, LEN):
+            target_arg.val = int(val[s])
+        elif k == DATA:
+            o, n = int(off[s]), int(len_[s])
+            target_arg.data = bytearray(arena[o:o + n].tobytes())
+
+    # Drop removed calls (back-to-front keeps indices stable) and fix
+    # dangling resource refs via remove_call.
+    for ci in range(t.ncalls - 1, -1, -1):
+        if not bool(call_alive[ci]):
+            p.remove_call(ci)
+
+    if not preserve_sizes:
+        for c in p.calls:
+            assign_sizes_call(c)
+    for c in p.calls:
+        p.target.sanitize_call(c)
+    return p
+
+
+def stack_batch(tensors: list[ProgTensor]) -> dict[str, np.ndarray]:
+    """Stack host tensors into batch arrays ready for device upload."""
+    keys = tensors[0].arrays().keys()
+    out = {}
+    for k in keys:
+        out[k] = np.stack([t.arrays()[k] for t in tensors])
+    return out

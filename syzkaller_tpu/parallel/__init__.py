@@ -1,0 +1,19 @@
+"""Device-mesh parallelism: sharded fuzz step and collectives.
+
+The reference scales by processes and RPC (SURVEY.md §2.10-2.11); here
+the equivalent axes are a 2D jax.sharding.Mesh:
+
+  'batch'  data parallelism over programs (the new core axis: the
+           reference mutates one program at a time, proc.go:92-95)
+  'cov'    the global coverage plane sharded across devices; novelty
+           is a single psum collective, merge a pmax — replacing the
+           reference's per-process Go signal maps merged over RPC
+           (pkg/signal/signal.go:117, syz-manager/manager.go:997).
+"""
+
+from syzkaller_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    make_sharded_fuzz_step,
+    shard_batch,
+    shard_plane,
+)

@@ -1,0 +1,108 @@
+"""2D-sharded fuzz step: batch-parallel mutation x sharded coverage.
+
+Layout over Mesh(('batch', 'cov')):
+  program tensors   sharded on 'batch', replicated on 'cov'
+  coverage plane    sharded on 'cov',   replicated on 'batch'
+  flag tables       fully replicated
+
+Per step, each device mutates its batch shard, tests its local edges
+against its cov shard of the plane, and the partial novelty masks are
+combined with a psum over 'cov' (each folded bucket lives in exactly
+one shard, so the sum is exact).  Merging accepted edges pmaxes the
+plane over 'batch' so replicas stay identical.  Collectives ride ICI;
+nothing crosses the host.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, random
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from syzkaller_tpu.ops import signal as dsig
+from syzkaller_tpu.ops.mutate import _mutate_one
+
+
+def make_mesh(devices: Optional[list] = None, cov: int = 1) -> Mesh:
+    """Mesh with ('batch', 'cov') axes over the given devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    assert n % cov == 0, f"{n} devices not divisible by cov={cov}"
+    arr = np.array(devices).reshape(n // cov, cov)
+    return Mesh(arr, ("batch", "cov"))
+
+
+def shard_batch(mesh: Mesh, batch: dict) -> dict:
+    """Place stacked program tensors batch-sharded on the mesh."""
+    sh = NamedSharding(mesh, P("batch"))
+    return {k: jax.device_put(jnp.asarray(v), sh) for k, v in batch.items()}
+
+
+def shard_plane(mesh: Mesh, plane) -> jax.Array:
+    return jax.device_put(plane, NamedSharding(mesh, P("cov")))
+
+
+def make_sharded_fuzz_step(mesh: Mesh, rounds: int = 4, plane_size: int = dsig.PLANE_SIZE):
+    """Build the jitted, mesh-sharded full fuzz step.
+
+    step(batch, plane, edges, nedges, prios, key, flag_vals, flag_counts)
+      -> (mutated_batch, new_plane, new_counts)
+
+    Semantics: triage the incoming coverage (edges come from the
+    executor fleet for the *previous* batch), merge novel programs'
+    edges into the plane, and mutate the batch for the next round —
+    the device side of one fuzz-loop iteration
+    (reference loop: syz-fuzzer/proc.go:66-98,230-247).
+    """
+    n_cov = mesh.shape["cov"]
+    shard = plane_size // n_cov
+
+    def local_step(batch, plane_l, edges, nedges, prios, key,
+                   flag_vals, flag_counts):
+        # --- triage: local novelty vs my plane shard ---
+        cov_idx = lax.axis_index("cov")
+        base = cov_idx.astype(jnp.int32) * shard
+        idx = dsig.fold_hash(edges)
+        local = (idx >= base) & (idx < base + shard)
+        seen = plane_l[jnp.clip(idx - base, 0, shard - 1)]
+        E = edges.shape[1]
+        valid = jnp.arange(E)[None, :] < nedges[:, None]
+        sentinel = plane_size + jnp.arange(E, dtype=jnp.int32)[None, :]
+        didx = jnp.where(valid, idx, sentinel)
+        uniq = dsig._unique_mask(didx)
+        new_local = (seen < (prios[:, None] + 1)) & valid & local & uniq
+        new_counts = lax.psum(new_local.sum(axis=1).astype(jnp.int32), "cov")
+
+        # --- merge: novel programs' edges into my shard, pmax 'batch' ---
+        accept = new_counts > 0
+        contrib = valid & local & accept[:, None]
+        val = jnp.where(contrib, prios[:, None] + 1, 0).astype(jnp.uint8)
+        plane_l = plane_l.at[jnp.clip(idx - base, 0, shard - 1).reshape(-1)
+                             ].max(val.reshape(-1))
+        plane_l = lax.pmax(plane_l, "batch")
+
+        # --- mutate my batch shard for the next round ---
+        b = batch["kind"].shape[0]
+        # decorrelate across batch shards
+        key = random.fold_in(key, lax.axis_index("batch"))
+        keys = random.split(key, b)
+        mutated = jax.vmap(
+            lambda st, k: _mutate_one(st, k, flag_vals, flag_counts, rounds)
+        )(batch, keys)
+        return mutated, plane_l, new_counts
+
+    batch_spec = P("batch")
+    step = jax.jit(
+        jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(batch_spec, P("cov"), batch_spec, batch_spec,
+                      batch_spec, P(), P(), P()),
+            out_specs=(batch_spec, P("cov"), batch_spec),
+            check_vma=False,
+        ))
+    return step

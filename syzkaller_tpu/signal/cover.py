@@ -11,9 +11,13 @@ class Cover(set):
         self.update(raw)
 
     def merge_diff(self, raw: Iterable[int]) -> list[int]:
-        """Merge and return newly-added PCs."""
-        new = [pc for pc in raw if pc not in self]
-        self.update(new)
+        """Merge and return newly-added PCs (each at most once even if
+        the raw trace repeats it)."""
+        new = []
+        for pc in raw:
+            if pc not in self:
+                self.add(pc)
+                new.append(pc)
         return new
 
     def serialize(self) -> list[int]:

@@ -1,0 +1,148 @@
+"""Device-kernel tests: tensor codec round-trip, batched mutation
+validity, RNG distribution parity, signal bitmap equivalence."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax import random  # noqa: E402
+
+from syzkaller_tpu.models.encoding import serialize_prog  # noqa: E402
+from syzkaller_tpu.models.generation import generate_prog  # noqa: E402
+from syzkaller_tpu.models.rand import RandGen  # noqa: E402
+from syzkaller_tpu.models.validation import validate_prog  # noqa: E402
+from syzkaller_tpu.ops import rng as drng  # noqa: E402
+from syzkaller_tpu.ops import signal as dsig  # noqa: E402
+from syzkaller_tpu.ops.mutate import make_mutator  # noqa: E402
+from syzkaller_tpu.ops.tensor import (  # noqa: E402
+    FlagTables,
+    TensorConfig,
+    decode_prog,
+    encode_prog,
+    stack_batch,
+)
+from syzkaller_tpu.signal import Signal, from_raw  # noqa: E402
+
+
+def make_corpus(target, n, seed=0, ncalls=8):
+    return [generate_prog(target, RandGen(target, seed + i), ncalls)
+            for i in range(n)]
+
+
+def test_codec_identity_roundtrip(test_target):
+    cfg = TensorConfig()
+    flags = FlagTables.empty()
+    for i, p in enumerate(make_corpus(test_target, 10, seed=100)):
+        t = encode_prog(p, cfg, flags)
+        p2 = decode_prog(t, {k: np.asarray(v) for k, v in t.arrays().items()})
+        validate_prog(p2)
+        assert serialize_prog(p2) == serialize_prog(p), f"prog {i}"
+
+
+def test_batched_mutation_produces_valid_programs(test_target):
+    cfg = TensorConfig()
+    flags = FlagTables.empty()
+    corpus = make_corpus(test_target, 16, seed=200)
+    tensors = [encode_prog(p, cfg, flags) for p in corpus]
+    batch = stack_batch(tensors)
+    mutate = make_mutator(rounds=4)
+    key = random.key(0)
+    out = mutate(
+        {k: jnp.asarray(v) for k, v in batch.items()}, key,
+        jnp.asarray(flags.vals), jnp.asarray(flags.counts))
+    out_np = {k: np.asarray(v) for k, v in out.items()}
+    changed = 0
+    for i, t in enumerate(tensors):
+        mut = {k: v[i] for k, v in out_np.items()}
+        p2 = decode_prog(t, mut, preserve_sizes=bool(mut["preserve_sizes"]))
+        validate_prog(p2)
+        if serialize_prog(p2) != serialize_prog(corpus[i]):
+            changed += 1
+    # The op mix guarantees nearly every program changes.
+    assert changed >= 12, f"only {changed}/16 changed"
+
+
+def test_mutation_repeated_rounds(test_target):
+    cfg = TensorConfig()
+    flags = FlagTables.empty()
+    corpus = make_corpus(test_target, 4, seed=300)
+    tensors = [encode_prog(p, cfg, flags) for p in corpus]
+    batch = {k: jnp.asarray(v) for k, v in stack_batch(tensors).items()}
+    mutate = make_mutator(rounds=4)
+    fv, fc = jnp.asarray(flags.vals), jnp.asarray(flags.counts)
+    key = random.key(7)
+    for step in range(5):
+        key, sub = random.split(key)
+        batch = mutate(batch, sub, fv, fc)
+    out_np = {k: np.asarray(v) for k, v in batch.items()}
+    for i, t in enumerate(tensors):
+        mut = {k: v[i] for k, v in out_np.items()}
+        p2 = decode_prog(t, mut, preserve_sizes=bool(mut["preserve_sizes"]))
+        validate_prog(p2)
+
+
+def test_rand_int_distribution_parity(test_target):
+    """Device rand_int must match the CPU distribution on key stats
+    (SURVEY.md §7 hard part b)."""
+    cpu = RandGen(test_target, 12345)
+    cpu_vals = np.array([cpu.rand_int() for _ in range(20000)],
+                        dtype=np.uint64)
+    keys = random.split(random.key(5), 20000)
+    dev_vals = np.asarray(jax.vmap(drng.rand_int)(keys)).astype(np.uint64)
+
+    def stats(v):
+        return (
+            np.mean(v < 10),             # small-value mass
+            np.mean(v == 0),             # zero mass
+            np.mean(v < 256),
+            np.mean(v > np.uint64(1) << np.uint64(63)),  # negated mass
+        )
+
+    s_cpu, s_dev = stats(cpu_vals), stats(dev_vals)
+    for a, b in zip(s_cpu, s_dev):
+        assert abs(a - b) < 0.03, (s_cpu, s_dev)
+
+
+def test_biased_rand_parity(test_target):
+    cpu = RandGen(test_target, 1)
+    cpu_vals = np.array([cpu.biased_rand(10, 5) for _ in range(20000)])
+    keys = random.split(random.key(2), 20000)
+    dev_vals = np.asarray(jax.vmap(lambda k: drng.biased_rand(k, 10, 5))(keys))
+    # Compare histograms
+    hc = np.bincount(cpu_vals, minlength=10) / len(cpu_vals)
+    hd = np.bincount(dev_vals, minlength=10) / len(dev_vals)
+    assert np.abs(hc - hd).max() < 0.02, (hc, hd)
+
+
+def test_signal_plane_matches_cpu_signal():
+    rng = np.random.RandomState(0)
+    B, E = 8, 64
+    edges = rng.randint(0, 1 << 32, size=(B, E), dtype=np.uint32)
+    nedges = rng.randint(1, E, size=B).astype(np.int32)
+    prios = rng.randint(0, 3, size=B).astype(np.uint8)
+
+    plane = dsig.new_plane()
+    cpu_sig = Signal()
+    for step in range(3):
+        new_mask, new_count = dsig.diff_batch(
+            plane, jnp.asarray(edges), jnp.asarray(nedges),
+            jnp.asarray(prios))
+        new_count = np.asarray(new_count)
+        # CPU decisions on the SAME folded hashes, against the same
+        # pre-batch snapshot the device saw.
+        folded = np.asarray(dsig.fold_hash(jnp.asarray(edges)))
+        snapshot = cpu_sig.copy()
+        for b in range(B):
+            raw = folded[b, :nedges[b]]
+            cpu_new = snapshot.diff_raw(raw.tolist(), int(prios[b]))
+            assert len(cpu_new) == int(new_count[b]), (step, b)
+            cpu_sig.merge(cpu_new)
+        plane = dsig.merge(plane, jnp.asarray(edges), jnp.asarray(nedges),
+                           jnp.asarray(prios),
+                           jnp.ones(B, dtype=bool))
+        assert int(dsig.plane_count(plane)) == len(cpu_sig)
+        # fresh batch for next round
+        edges = rng.randint(0, 1 << 32, size=(B, E), dtype=np.uint32)
+        nedges = rng.randint(1, E, size=B).astype(np.int32)
+        prios = rng.randint(0, 3, size=B).astype(np.uint8)
