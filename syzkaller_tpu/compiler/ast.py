@@ -1,0 +1,258 @@
+"""AST for the syzlang syscall-description language.
+
+Node semantics follow the reference description language (reference:
+pkg/ast/ast.go, docs/syscall_descriptions_syntax.md): top-level
+declarations are includes/incdirs/defines, resources, int/string flag
+sets, type aliases/templates, structs/unions and syscalls.  Types are a
+uniform head + bracketed argument list (`ptr[in, array[int8]]`), with
+an optional `:colon` suffix used for bitfields (`int8:3`).
+
+Unlike the reference this AST is consumed only by our compiler
+(compiler/compile.py) — there is no separate formatter tool, but every
+node knows how to print itself back to canonical source, which the
+tests use for parse round-trips.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+
+@dataclass(frozen=True)
+class Pos:
+    file: str = ""
+    line: int = 0
+    col: int = 0
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}:{self.col}"
+
+
+@dataclass
+class Node:
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class Comment(Node):
+    text: str = ""
+
+    def format(self) -> str:
+        return f"#{self.text}"
+
+
+@dataclass
+class Include(Node):
+    file: str = ""
+
+    def format(self) -> str:
+        return f"include <{self.file}>"
+
+
+@dataclass
+class Incdir(Node):
+    dir: str = ""
+
+    def format(self) -> str:
+        return f"incdir <{self.dir}>"
+
+
+@dataclass
+class Define(Node):
+    name: str = ""
+    # The value expression, kept as raw source; evaluated by
+    # compiler/consts.py with the current const environment.
+    value: str = ""
+
+    def format(self) -> str:
+        return f"define {self.name} {self.value}"
+
+
+@dataclass
+class IntValue(Node):
+    """An integer-valued token: literal, hex, char, or symbolic const.
+    After const patching, `value` is set for symbolic names too."""
+
+    raw: str = ""
+    value: Optional[int] = None
+    ident: str = ""  # non-empty if symbolic
+
+    def format(self) -> str:
+        return self.ident if self.ident else self.raw
+
+
+@dataclass
+class RangeValue(Node):
+    lo: IntValue = field(default_factory=IntValue)
+    hi: IntValue = field(default_factory=IntValue)
+
+    def format(self) -> str:
+        return f"{self.lo.format()}:{self.hi.format()}"
+
+
+@dataclass
+class StrValue(Node):
+    value: str = ""
+
+    def format(self) -> str:
+        return '"' + self.value.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+# A type argument: nested type, int, range, or string.
+TypeArg = Union["TypeExpr", IntValue, RangeValue, StrValue]
+
+
+@dataclass
+class TypeExpr(Node):
+    name: str = ""
+    args: list[TypeArg] = field(default_factory=list)
+    colon: Optional[IntValue] = None  # bitfield suffix: int8:3
+
+    def format(self) -> str:
+        s = self.name
+        if self.args:
+            s += "[" + ", ".join(a.format() for a in self.args) + "]"
+        if self.colon is not None:
+            s += ":" + self.colon.format()
+        return s
+
+    def is_bare_ident(self) -> bool:
+        return not self.args and self.colon is None
+
+
+@dataclass
+class Field(Node):
+    name: str = ""
+    type: TypeExpr = field(default_factory=TypeExpr)
+
+    def format(self) -> str:
+        return f"{self.name}\t{self.type.format()}"
+
+
+@dataclass
+class Resource(Node):
+    name: str = ""
+    base: TypeExpr = field(default_factory=TypeExpr)
+    values: list[IntValue] = field(default_factory=list)
+
+    def format(self) -> str:
+        s = f"resource {self.name}[{self.base.format()}]"
+        if self.values:
+            s += ": " + ", ".join(v.format() for v in self.values)
+        return s
+
+
+@dataclass
+class IntFlags(Node):
+    name: str = ""
+    values: list[IntValue] = field(default_factory=list)
+
+    def format(self) -> str:
+        return f"{self.name} = " + ", ".join(v.format() for v in self.values)
+
+
+@dataclass
+class StrFlags(Node):
+    name: str = ""
+    values: list[StrValue] = field(default_factory=list)
+
+    def format(self) -> str:
+        return f"{self.name} = " + ", ".join(v.format() for v in self.values)
+
+
+@dataclass
+class Struct(Node):
+    name: str = ""
+    fields: list[Field] = field(default_factory=list)
+    attrs: list[TypeExpr] = field(default_factory=list)
+    is_union: bool = False
+
+    def format(self) -> str:
+        o, c = ("[", "]") if self.is_union else ("{", "}")
+        lines = [f"{self.name} {o}"]
+        lines += ["\t" + f.format() for f in self.fields]
+        tail = c
+        if self.attrs:
+            tail += " [" + ", ".join(a.format() for a in self.attrs) + "]"
+        lines.append(tail)
+        return "\n".join(lines)
+
+
+@dataclass
+class TypeDef(Node):
+    """`type name[ARGS] <type-or-struct>` — alias when params empty,
+    template otherwise (reference: pkg/ast/ast.go TypeDef)."""
+
+    name: str = ""
+    params: list[str] = field(default_factory=list)
+    type: Optional[TypeExpr] = None
+    struct: Optional[Struct] = None
+
+    def format(self) -> str:
+        head = f"type {self.name}"
+        if self.params:
+            head += "[" + ", ".join(self.params) + "]"
+        if self.type is not None:
+            return f"{head} {self.type.format()}"
+        assert self.struct is not None
+        body = self.struct.format()
+        return f"{head} {body[body.index(' ') + 1:]}"
+
+
+@dataclass
+class Call(Node):
+    name: str = ""  # full name incl. $variant
+    args: list[Field] = field(default_factory=list)
+    ret: Optional[TypeExpr] = None
+    nr: int = -1  # syscall number; assigned by the compiler
+
+    @property
+    def call_name(self) -> str:
+        return self.name.split("$")[0]
+
+    def format(self) -> str:
+        s = f"{self.name}(" + ", ".join(
+            f"{a.name} {a.type.format()}" for a in self.args) + ")"
+        if self.ret is not None:
+            s += " " + self.ret.format()
+        return s
+
+
+Decl = Union[Include, Incdir, Define, Resource, IntFlags, StrFlags,
+             Struct, TypeDef, Call, Comment]
+
+
+@dataclass
+class Description:
+    decls: list[Decl] = field(default_factory=list)
+
+    def format(self) -> str:
+        return "\n".join(d.format() for d in self.decls) + "\n"
+
+    def walk_types(self):
+        """Yield every TypeExpr in the description (pre-order)."""
+
+        def rec(t: TypeExpr):
+            yield t
+            for a in t.args:
+                if isinstance(a, TypeExpr):
+                    yield from rec(a)
+
+        for d in self.decls:
+            if isinstance(d, Resource):
+                yield from rec(d.base)
+            elif isinstance(d, Struct):
+                for f in d.fields:
+                    yield from rec(f.type)
+            elif isinstance(d, TypeDef):
+                if d.type is not None:
+                    yield from rec(d.type)
+                elif d.struct is not None:
+                    for f in d.struct.fields:
+                        yield from rec(f.type)
+            elif isinstance(d, Call):
+                for f in d.args:
+                    yield from rec(f.type)
+                if d.ret is not None:
+                    yield from rec(d.ret)

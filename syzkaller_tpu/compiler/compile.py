@@ -1,0 +1,629 @@
+"""Description compiler: syzlang AST → Target tables.
+
+Four stages mirroring the reference compile pipeline (reference:
+pkg/compiler/compiler.go:19-33 — assignSyscallNumbers, patchConsts,
+check, gen), lowered onto the TargetBuilder backend (sys/builder.py)
+instead of generated Go source:
+
+  1. const patching (compiler/consts.py) — disables calls whose consts
+     are missing on this arch;
+  2. typedef instantiation — builtin + user aliases and templates are
+     expanded by argument substitution at each use site
+     (reference: pkg/compiler/types.go typedefs);
+  3. check — duplicate/unknown names, arg sanity, ret-type rules;
+  4. gen — builder declarations and Target build.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field as dc_field
+from typing import Optional, Union
+
+from syzkaller_tpu.compiler import ast as A
+from syzkaller_tpu.compiler.consts import patch_consts
+from syzkaller_tpu.compiler.parser import parse
+from syzkaller_tpu.models.types import CsumKind, Dir, TextKind
+from syzkaller_tpu.sys import builder as B
+
+
+class CompileError(Exception):
+    pass
+
+
+class UnresolvedConst(Exception):
+    """A symbolic constant with no value on this arch was needed in an
+    int position; the enclosing syscall gets disabled."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self.name = name
+
+
+@dataclass
+class CompileResult:
+    target: object = None
+    disabled_calls: list[str] = dc_field(default_factory=list)
+    warnings: list[str] = dc_field(default_factory=list)
+
+
+# Builtin type aliases/templates (reference:
+# docs/syscall_descriptions_syntax.md "builtin type aliases").
+_BUILTINS = """\
+type bool8 int8[0:1]
+type bool16 int16[0:1]
+type bool32 int32[0:1]
+type bool64 int64[0:1]
+type boolptr intptr[0:1]
+type buffer[DIR] ptr[DIR, array[int8]]
+type optional[T] [
+\tval\tT
+\tvoid\tvoid
+] [varlen]
+"""
+
+_INT_SIZES = {"int8": 1, "int16": 2, "int32": 4, "int64": 8}
+
+_TEXT_KINDS = {
+    "x86_real": TextKind.X86_REAL,
+    "x86_16": TextKind.X86_16,
+    "x86_32": TextKind.X86_32,
+    "x86_64": TextKind.X86_64,
+    "arm64": TextKind.ARM64,
+}
+
+_DIRS = {"in": Dir.IN, "out": Dir.OUT, "inout": Dir.INOUT}
+
+
+def _fmt(t: A.TypeExpr) -> str:
+    return t.format()
+
+
+class Compiler:
+    def __init__(self, desc: A.Description, consts: dict[str, int],
+                 os: str, arch: str, ptr_size: int = 8,
+                 page_size: int = 4096, num_pages: int = 4096,
+                 auto_nr_base: int = 0):
+        self.desc = desc
+        self.consts = dict(consts)
+        self.os = os
+        self.arch = arch
+        self.errors: list[str] = []
+        self.warnings: list[str] = []
+        self.b = B.TargetBuilder(os=os, arch=arch, ptr_size=ptr_size,
+                                 page_size=page_size, num_pages=num_pages)
+        self.typedefs: dict[str, A.TypeDef] = {}
+        self.structs: dict[str, A.Struct] = {}
+        self.resources: dict[str, A.Resource] = {}
+        self.intflags: dict[str, A.IntFlags] = {}
+        self.strflags: dict[str, A.StrFlags] = {}
+        self.calls: list[A.Call] = []
+        self.auto_nr = auto_nr_base
+        self._instantiating: set[str] = set()
+        self._declared: set[str] = set()
+        self.disabled: list[str] = []
+
+    def _error(self, pos: A.Pos, msg: str) -> None:
+        self.errors.append(f"{pos}: {msg}")
+
+    # -- stage 1+2: collect ---------------------------------------------
+
+    def collect(self) -> list[str]:
+        disabled = patch_consts(self.desc, self.consts)
+        for d in parse(_BUILTINS, "<builtin>").decls:
+            assert isinstance(d, A.TypeDef)
+            self.typedefs[d.name] = d
+        tables = [self.typedefs, self.structs, self.resources,
+                  self.intflags, self.strflags]
+        for d in self.desc.decls:
+            if isinstance(d, (A.Include, A.Incdir, A.Define, A.Comment)):
+                continue
+            if isinstance(d, A.Call):
+                self.calls.append(d)
+                continue
+            name = d.name
+            if any(name in t for t in tables):
+                self._error(d.pos, f"duplicate declaration {name!r}")
+                continue
+            if isinstance(d, A.TypeDef):
+                self.typedefs[name] = d
+            elif isinstance(d, A.Struct):
+                self.structs[name] = d
+            elif isinstance(d, A.Resource):
+                self.resources[name] = d
+            elif isinstance(d, A.IntFlags):
+                self.intflags[name] = d
+            elif isinstance(d, A.StrFlags):
+                self.strflags[name] = d
+        seen_calls = set()
+        for c in self.calls:
+            if c.name in seen_calls:
+                self._error(c.pos, f"duplicate syscall {c.name}")
+            seen_calls.add(c.name)
+        return disabled
+
+    # -- typedef substitution -------------------------------------------
+
+    def _substitute(self, t: A.TypeExpr,
+                    env: dict[str, A.TypeArg]) -> A.TypeExpr:
+        if t.is_bare_ident() and t.name in env:
+            rep = env[t.name]
+            if isinstance(rep, A.TypeExpr):
+                return copy.deepcopy(rep)
+            # An int parameter used in type position is only valid where
+            # the consumer expects an int; wrap for the lowerer to unpack.
+            out = A.TypeExpr(pos=t.pos, name="__intparam__")
+            out.args = [copy.deepcopy(rep)]
+            return out
+        out = A.TypeExpr(pos=t.pos, name=t.name, colon=copy.deepcopy(t.colon))
+        if out.name in env:
+            rep = env[out.name]
+            if isinstance(rep, A.TypeExpr) and rep.is_bare_ident():
+                out.name = rep.name
+        for a in t.args:
+            if isinstance(a, A.TypeExpr):
+                out.args.append(self._substitute(a, env))
+            elif isinstance(a, A.IntValue) and a.ident and a.ident in env:
+                rep = env[a.ident]
+                if isinstance(rep, A.IntValue):
+                    out.args.append(copy.deepcopy(rep))
+                elif isinstance(rep, A.TypeExpr) and rep.is_bare_ident():
+                    out.args.append(A.IntValue(pos=a.pos, raw=rep.name,
+                                               ident=rep.name))
+                else:
+                    self._error(a.pos, f"template arg {a.ident!r} used as "
+                                       "int but bound to a type")
+                    out.args.append(copy.deepcopy(a))
+            else:
+                out.args.append(copy.deepcopy(a))
+        if out.colon is not None and out.colon.ident and out.colon.ident in env:
+            rep = env[out.colon.ident]
+            if isinstance(rep, A.IntValue):
+                out.colon = copy.deepcopy(rep)
+        return out
+
+    def _expand_typedef(self, t: A.TypeExpr) -> Optional[Union[A.TypeExpr, str]]:
+        """If t's head is a typedef, expand it.  Returns a TypeExpr for
+        alias expansion, a struct name (str) for struct-template
+        instantiation, or None if t is not a typedef use."""
+        td = self.typedefs.get(t.name)
+        if td is None:
+            return None
+        if len(t.args) != len(td.params):
+            self._error(t.pos, f"type {td.name} expects "
+                               f"{len(td.params)} args, got {len(t.args)}")
+            return None
+        env: dict[str, A.TypeArg] = dict(zip(td.params, t.args))
+        if td.type is not None:
+            expanded = self._substitute(td.type, env)
+            if t.colon is not None:
+                expanded.colon = t.colon
+            return expanded
+        # struct/union template: instantiate under the printed name
+        inst_name = _fmt(t)
+        if inst_name not in self.structs:
+            if t.name in self._instantiating:
+                self._error(t.pos, f"recursive template {t.name}")
+                return None
+            self._instantiating.add(t.name)
+            st = A.Struct(pos=td.pos, name=inst_name,
+                          is_union=td.struct.is_union,
+                          attrs=copy.deepcopy(td.struct.attrs))
+            for f in td.struct.fields:
+                st.fields.append(A.Field(pos=f.pos, name=f.name,
+                                         type=self._substitute(f.type, env)))
+            self.structs[inst_name] = st
+            self._declare_struct(st)
+            self._instantiating.discard(t.name)
+        return inst_name
+
+    # -- int base types --------------------------------------------------
+
+    def _int_base(self, t: A.TypeExpr) -> Optional[tuple[int, bool, int]]:
+        """Parse an integer base type: (size, big_endian, bitfield_len),
+        or None if t is not an int type."""
+        name = t.name
+        be = False
+        if name.endswith("be") and name[:-2] in _INT_SIZES:
+            be = True
+            name = name[:-2]
+        if name == "intptr":
+            size = self.b.ptr_size
+        elif name in _INT_SIZES:
+            size = _INT_SIZES[name]
+        else:
+            return None
+        bits = 0
+        if t.colon is not None:
+            if t.colon.value is None:
+                self._error(t.pos, "unresolved bitfield width")
+                return None
+            bits = t.colon.value
+        return size, be, bits
+
+    def _int_arg(self, t: A.TypeExpr, a: A.TypeArg, what: str) -> int:
+        if isinstance(a, A.TypeExpr) and a.name == "__intparam__":
+            a = a.args[0]
+        if isinstance(a, A.IntValue):
+            if a.value is None:
+                raise UnresolvedConst(a.ident)
+            return a.value
+        if isinstance(a, A.TypeExpr) and a.is_bare_ident():
+            raise UnresolvedConst(a.name)
+        self._error(t.pos, f"expected {what} (int), got {a.format()!r}")
+        return 0
+
+    def _range_arg(self, a: A.TypeArg) -> Optional[tuple[int, int]]:
+        if isinstance(a, A.RangeValue):
+            return (a.lo.value or 0, a.hi.value or 0)
+        if isinstance(a, A.IntValue):
+            v = a.value or 0
+            return (v, v)
+        return None
+
+    # -- stage 4: type lowering -----------------------------------------
+
+    def _lower(self, t: A.TypeExpr, in_struct: bool) -> B.TypeSpec:
+        """Lower a TypeExpr to a builder TypeSpec."""
+        # `opt` may appear as the trailing arg of most types.
+        args = list(t.args)
+        is_opt = False
+        if args and isinstance(args[-1], A.TypeExpr) \
+                and args[-1].is_bare_ident() and args[-1].name == "opt":
+            is_opt = True
+            args = args[:-1]
+        spec = self._lower_inner(t, args, in_struct)
+        if is_opt and not isinstance(spec, str):
+            spec = B.opt(spec)
+        elif is_opt and isinstance(spec, str):
+            named = spec
+
+            def named_opt(b, d, fname, memo):
+                ty = b._instantiate(named, d, fname, memo)
+                ty.optional = True
+                return ty
+
+            spec = named_opt
+        return spec
+
+    def _lower_inner(self, t: A.TypeExpr, args: list[A.TypeArg],
+                     in_struct: bool) -> B.TypeSpec:
+        name = t.name
+        pos = t.pos
+
+        def err(msg: str) -> B.TypeSpec:
+            self._error(pos, msg)
+            return B.intptr()
+
+        # integer types (size already ptr_size-aware via _int_base)
+        base = self._int_base(t)
+        if base is not None:
+            size, be, bits = base
+            rng = self._range_arg(args[0]) if args else None
+            if args and rng is None:
+                return err(f"bad int range {args[0].format()!r}")
+            kw = dict(be=be, bits=bits)
+            if rng is not None:
+                kw["range"] = rng
+            iname = "intptr" if name.startswith("intptr") else ""
+            return B._int_spec(size, name=iname, **kw)
+
+        if name == "fileoff":
+            # fileoff[BASE] or bare fileoff (intptr-sized)
+            size = self.b.ptr_size
+            be = False
+            if args and isinstance(args[0], A.TypeExpr):
+                b2 = self._int_base(args[0])
+                if b2 is None:
+                    return err("fileoff base must be an int type")
+                size, be, _ = b2
+            return B._int_spec(size, be=be, fileoff=True)
+
+        if name == "const":
+            if not args:
+                return err("const needs a value")
+            val = self._int_arg(t, args[0], "const value")
+            size, be, bits = 8, False, 0
+            if len(args) >= 2 and isinstance(args[1], A.TypeExpr):
+                b2 = self._int_base(args[1])
+                if b2 is None:
+                    return err("const base must be an int type")
+                size, be, bits = b2
+            elif in_struct:
+                return err("const in struct needs a base type")
+            return B.const(val, size=size, be=be, bits=bits)
+
+        if name == "flags":
+            if not args or not isinstance(args[0], A.TypeExpr) \
+                    or not args[0].is_bare_ident():
+                return err("flags needs a flags-set name")
+            fname = args[0].name
+            size, be, bits = 8, False, 0
+            if len(args) >= 2 and isinstance(args[1], A.TypeExpr):
+                b2 = self._int_base(args[1])
+                if b2 is None:
+                    return err("flags base must be an int type")
+                size, be, bits = b2
+            elif in_struct:
+                return err("flags in struct needs a base type")
+            if fname in self.strflags:
+                return B.string(fname)
+            if fname not in self.intflags:
+                return err(f"unknown flags {fname!r}")
+            return B.flags(fname, size=size, be=be, bits=bits)
+
+        if name in ("len", "bytesize", "bitsize") or \
+                (name.startswith("bytesize") and name[8:].isdigit()):
+            if not args or not isinstance(args[0], A.TypeExpr) \
+                    or not args[0].is_bare_ident():
+                return err(f"{name} needs a target field name")
+            path = args[0].name
+            size, be, bits = 8, False, 0
+            if len(args) >= 2 and isinstance(args[1], A.TypeExpr):
+                b2 = self._int_base(args[1])
+                if b2 is None:
+                    return err(f"{name} base must be an int type")
+                size, be, bits = b2
+            elif in_struct:
+                return err(f"{name} in struct needs a base type")
+            if name == "len":
+                return B.len_of(path, size=size, be=be, bits=bits)
+            if name == "bitsize":
+                return B.bitsize_of(path, size=size, be=be)
+            unit = int(name[8:]) if len(name) > 8 else 1
+            return B.bytesize_of(path, size=size, unit=unit, be=be)
+
+        if name in ("ptr", "ptr64"):
+            if len(args) < 2 or not isinstance(args[0], A.TypeExpr) \
+                    or args[0].name not in _DIRS:
+                return err("ptr needs [dir, type]")
+            d = _DIRS[args[0].name]
+            elem = self._lower(args[1], in_struct=True) \
+                if isinstance(args[1], A.TypeExpr) else None
+            if elem is None:
+                return err("bad ptr element")
+            return B.ptr(d, elem)
+
+        if name == "array":
+            if not args or not isinstance(args[0], A.TypeExpr):
+                return err("array needs an element type")
+            elem = self._lower(args[0], in_struct=True)
+            count = None
+            if len(args) >= 2:
+                rng = self._range_arg(args[1])
+                if rng is None:
+                    return err("bad array count")
+                count = rng[0] if rng[0] == rng[1] else rng
+            return B.array(elem, count)
+
+        if name in ("string", "stringnoz"):
+            no_z = name == "stringnoz"
+            values = None
+            size = 0
+            sub_kind = ""
+            rest = args
+            if rest and isinstance(rest[0], A.StrValue):
+                values = (rest[0].value.encode(),)
+                rest = rest[1:]
+            elif rest and isinstance(rest[0], A.TypeExpr) \
+                    and rest[0].is_bare_ident():
+                sname = rest[0].name
+                rest = rest[1:]
+                if sname == "filename":
+                    return B.filename(no_z=no_z)
+                if sname not in self.strflags:
+                    return err(f"unknown string flags {sname!r}")
+                values = sname
+            if rest:
+                size = self._int_arg(t, rest[0], "string size")
+                rest = rest[1:]
+            return B.string(values, size=size, no_z=no_z, sub_kind=sub_kind)
+
+        if name == "filename":
+            return B.filename()
+
+        if name in ("vma", "vma64"):
+            rng = None
+            if args:
+                rng = self._range_arg(args[0])
+                if rng is None:
+                    return err("bad vma range")
+            return B.vma(range=rng)
+
+        if name == "proc":
+            if len(args) < 2:
+                return err("proc needs [start, per-proc]")
+            start = self._int_arg(t, args[0], "proc start")
+            per = self._int_arg(t, args[1], "proc per-proc count")
+            size = 8
+            if len(args) >= 3 and isinstance(args[2], A.TypeExpr):
+                b2 = self._int_base(args[2])
+                if b2 is None:
+                    return err("proc base must be an int type")
+                size = b2[0]
+            elif in_struct:
+                return err("proc in struct needs a base type")
+            return B.proc(start, per, size=size)
+
+        if name == "csum":
+            # csum[buf, inet|pseudo, (proto,)? base]
+            if len(args) < 3 or not isinstance(args[0], A.TypeExpr) \
+                    or not isinstance(args[1], A.TypeExpr):
+                return err("csum needs [buf, kind, base]")
+            buf = args[0].name
+            kind_s = args[1].name
+            if kind_s == "inet":
+                kind, proto, bi = CsumKind.INET, 0, 2
+            elif kind_s == "pseudo":
+                if len(args) < 4:
+                    return err("pseudo csum needs a protocol")
+                kind, proto, bi = CsumKind.PSEUDO, \
+                    self._int_arg(t, args[2], "protocol"), 3
+            else:
+                return err(f"unknown csum kind {kind_s!r}")
+            size = 2
+            if len(args) > bi and isinstance(args[bi], A.TypeExpr):
+                b2 = self._int_base(args[bi])
+                if b2 is not None:
+                    size = b2[0]
+            return B.csum(buf, kind=kind, protocol=proto, size=size)
+
+        if name == "text":
+            if not args or not isinstance(args[0], A.TypeExpr) \
+                    or args[0].name not in _TEXT_KINDS:
+                return err("text needs a known text kind")
+            return B.text(_TEXT_KINDS[args[0].name])
+
+        if name == "void":
+            return B.void()
+
+        if name == "__intparam__":
+            # An int template param in type position has no meaning.
+            return err("int template argument used in type position")
+
+        # typedef?
+        if name in self.typedefs:
+            expanded = self._expand_typedef(t)
+            if expanded is None:
+                return B.intptr()
+            if isinstance(expanded, str):
+                return expanded  # instantiated struct name
+            return self._lower(expanded, in_struct)
+
+        # named resource / struct / union
+        if name in self.resources:
+            return B.res(name)
+        if name in self.structs:
+            self._declare_struct(self.structs[name])
+            return name
+        return err(f"unknown type {name!r}")
+
+    # -- declarations ----------------------------------------------------
+
+    def _declare_flags(self) -> None:
+        for fl in self.intflags.values():
+            vals = tuple((v.value or 0) for v in fl.values)
+            self.b.flag_set(fl.name, *vals)
+        for sf in self.strflags.values():
+            self.b.string_set(sf.name, *(v.value for v in sf.values))
+
+    def _resource_base(self, r: A.Resource,
+                       seen: set[str]) -> tuple[int, Optional[str]]:
+        """Returns (base_size, parent_resource_or_None)."""
+        base = r.base
+        ib = self._int_base(base)
+        if ib is not None:
+            return ib[0], None
+        if base.name in self.resources:
+            if base.name in seen:
+                self._error(r.pos, f"recursive resource {r.name}")
+                return 8, None
+            parent = self.resources[base.name]
+            size, _ = self._resource_base(parent, seen | {base.name})
+            return size, base.name
+        self._error(r.pos, f"unknown resource base {base.name!r}")
+        return 8, None
+
+    def _declare_resources(self) -> None:
+        declared: set[str] = set()
+
+        def declare(r: A.Resource) -> None:
+            if r.name in declared:
+                return
+            size, parent = self._resource_base(r, {r.name})
+            if parent is not None and parent not in declared:
+                declare(self.resources[parent])
+            values = tuple((v.value or 0) for v in r.values) or (0,)
+            self.b.resource(r.name, size, values=values, parent=parent)
+            declared.add(r.name)
+
+        for r in self.resources.values():
+            declare(r)
+
+    def _declare_struct(self, st: A.Struct) -> None:
+        if st.name in self._declared:
+            return
+        self._declared.add(st.name)
+        packed = False
+        align = 0
+        size: Optional[int] = None
+        varlen = False
+        for a in st.attrs:
+            if a.name == "packed":
+                packed = True
+            elif a.name.startswith("align_"):
+                align = int(a.name[6:])
+            elif a.name == "varlen":
+                varlen = True
+            elif a.name == "size" and a.args:
+                size = self._int_arg(a, a.args[0], "size attribute")
+            else:
+                self._error(a.pos, f"unknown attribute {a.name!r} "
+                                   f"on {st.name}")
+        fields = [(f.name, self._lower(f.type, in_struct=True))
+                  for f in st.fields]
+        if st.is_union:
+            if packed or align:
+                self._error(st.pos, f"union {st.name} cannot be packed/aligned")
+            self.b.union(st.name, fields, varlen=varlen, size=size)
+        else:
+            if varlen:
+                self._error(st.pos, f"struct {st.name} cannot be varlen")
+            self.b.struct(st.name, fields, packed=packed, align=align,
+                          size=size)
+
+    def _declare_calls(self) -> None:
+        for c in self.calls:
+            nr = self.consts.get(f"__NR_{c.call_name}")
+            if nr is None:
+                nr = self.auto_nr
+                self.auto_nr += 1
+            try:
+                args = [(f.name, self._lower(f.type, in_struct=False))
+                        for f in c.args]
+            except UnresolvedConst as e:
+                self.disabled.append(c.name)
+                self.warnings.append(
+                    f"{c.pos}: {c.name} disabled: missing const {e.name!r}")
+                continue
+            ret: Optional[str] = None
+            if c.ret is not None:
+                if not c.ret.is_bare_ident() or c.ret.name not in self.resources:
+                    self._error(c.ret.pos,
+                                f"return type of {c.name} must be a resource")
+                else:
+                    ret = c.ret.name
+            self.b.syscall(c.name, args, ret=ret, nr=nr)
+
+    # -- driver ----------------------------------------------------------
+
+    def compile(self, register: bool = True) -> CompileResult:
+        self.disabled = self.collect()
+        try:
+            self._declare_flags()
+            self._declare_resources()
+            # structs are declared lazily on first use so that template
+            # instantiations land before dependents; force the rest now
+            for st in list(self.structs.values()):
+                self._declare_struct(st)
+            self._declare_calls()
+        except UnresolvedConst as e:
+            # missing const in a struct/resource: unusable by every call
+            raise CompileError(f"undefined constant {e.name!r}") from None
+        if self.errors:
+            raise CompileError("\n".join(self.errors))
+        target = self.b.build(register=register)
+        return CompileResult(target=target, disabled_calls=self.disabled,
+                             warnings=self.warnings)
+
+
+def compile_description(src: Union[str, A.Description],
+                        consts: Optional[dict[str, int]] = None,
+                        os: str = "dsl", arch: str = "64",
+                        filename: str = "<src>", register: bool = False,
+                        **target_kw) -> CompileResult:
+    """Compile syzlang source text (or a parsed Description) into a
+    registered Target (reference: pkg/compiler/compiler.go:47 Compile)."""
+    desc = parse(src, filename) if isinstance(src, str) else src
+    c = Compiler(desc, consts or {}, os, arch, **target_kw)
+    return c.compile(register=register)

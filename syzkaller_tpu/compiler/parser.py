@@ -1,0 +1,489 @@
+"""Parser for the syzlang description language.
+
+A hand-written line-oriented lexer + recursive-descent parser producing
+compiler/ast.py nodes.  Grammar follows the reference language
+(reference: pkg/ast/parser.go, docs/syscall_descriptions_syntax.md):
+
+  top       := include | incdir | define | resource | typedef |
+               flags | strflags | struct | union | call
+  include   := "include" "<" path ">"
+  resource  := "resource" ident "[" type "]" [":" intlist]
+  typedef   := "type" ident ["[" identlist "]"] (type | structbody)
+  flags     := ident "=" int ("," int)*
+  strflags  := ident "=" string ("," string)*
+  struct    := ident "{" NL (field NL)* "}" [attrs]
+  union     := ident "[" NL (field NL)* "]" [attrs]
+  call      := ident "(" [field ("," field)*] ")" [type]
+  type      := ident ["[" typearg ("," typearg)* "]"] [":" intval]
+  typearg   := type | intval | range | string
+  intval    := dec | 0xhex | 'c' | ident
+  range     := intval ":" intval
+
+Errors are collected (not raised) so a whole file reports all problems
+at once, matching the reference's ErrorHandler style.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+from syzkaller_tpu.compiler.ast import (
+    Call,
+    Comment,
+    Define,
+    Description,
+    Field,
+    Include,
+    Incdir,
+    IntFlags,
+    IntValue,
+    Pos,
+    RangeValue,
+    Resource,
+    StrFlags,
+    StrValue,
+    Struct,
+    TypeDef,
+    TypeExpr,
+)
+
+
+class ParseError(Exception):
+    pass
+
+
+_IDENT_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_$]*")
+_INT_RE = re.compile(r"-?(0x[0-9a-fA-F]+|[0-9]+)")
+_INT_TYPE_RE = re.compile(r"^(int(8|16|32|64)(be)?|intptr)$")
+
+
+@dataclass
+class _Line:
+    text: str
+    num: int
+
+
+class Parser:
+    def __init__(self, src: str, filename: str = "<src>"):
+        self.filename = filename
+        self.lines = [_Line(t, i + 1) for i, t in enumerate(src.split("\n"))]
+        self.li = 0  # current line index
+        self.text = ""
+        self.col = 0
+        self.errors: list[str] = []
+
+    # -- line/character machinery ---------------------------------------
+
+    def _pos(self) -> Pos:
+        num = self.lines[self.li].num if self.li < len(self.lines) else 0
+        return Pos(self.filename, num, self.col + 1)
+
+    def _error(self, msg: str) -> None:
+        self.errors.append(f"{self._pos()}: {msg}")
+
+    def _next_line(self) -> bool:
+        while self.li < len(self.lines):
+            line = self.lines[self.li].text
+            self.text = line
+            self.col = 0
+            return True
+        return False
+
+    def _advance_line(self) -> None:
+        self.li += 1
+
+    def _skip_ws(self) -> None:
+        while self.col < len(self.text) and self.text[self.col] in " \t":
+            self.col += 1
+
+    def _at_end(self) -> bool:
+        self._skip_ws()
+        return self.col >= len(self.text) or self.text[self.col] == "#"
+
+    def _peek(self) -> str:
+        self._skip_ws()
+        return self.text[self.col] if self.col < len(self.text) else ""
+
+    def _eat(self, ch: str) -> bool:
+        if self._peek() == ch:
+            self.col += 1
+            return True
+        return False
+
+    def _expect(self, ch: str) -> bool:
+        if not self._eat(ch):
+            self._error(f"expected {ch!r}, got {self._peek()!r}")
+            return False
+        return True
+
+    def _ident(self) -> Optional[str]:
+        self._skip_ws()
+        m = _IDENT_RE.match(self.text, self.col)
+        if not m:
+            return None
+        self.col = m.end()
+        return m.group()
+
+    def _int_value(self) -> Optional[IntValue]:
+        self._skip_ws()
+        pos = self._pos()
+        if self.col < len(self.text) and self.text[self.col] == "'":
+            # char literal 'x'
+            if self.col + 2 < len(self.text) and self.text[self.col + 2] == "'":
+                ch = self.text[self.col + 1]
+                self.col += 3
+                return IntValue(pos=pos, raw=f"'{ch}'", value=ord(ch))
+            self._error("malformed char literal")
+            return None
+        m = _INT_RE.match(self.text, self.col)
+        if m:
+            self.col = m.end()
+            raw = m.group()
+            val = int(raw, 0)
+            return IntValue(pos=pos, raw=raw, value=val & ((1 << 64) - 1))
+        name = self._ident()
+        if name is not None:
+            return IntValue(pos=pos, raw=name, ident=name)
+        return None
+
+    def _string(self) -> Optional[StrValue]:
+        self._skip_ws()
+        pos = self._pos()
+        if self._peek() != '"':
+            return None
+        self.col += 1
+        out = []
+        while self.col < len(self.text):
+            c = self.text[self.col]
+            if c == '"':
+                self.col += 1
+                return StrValue(pos=pos, value="".join(out))
+            if c == "\\" and self.col + 1 < len(self.text):
+                nxt = self.text[self.col + 1]
+                out.append({"n": "\n", "t": "\t", '"': '"',
+                            "\\": "\\", "0": "\0"}.get(nxt, nxt))
+                self.col += 2
+                continue
+            out.append(c)
+            self.col += 1
+        self._error("unterminated string")
+        return None
+
+    # -- type expressions ------------------------------------------------
+
+    def _type_expr(self) -> Optional[TypeExpr]:
+        pos = self._pos()
+        name = self._ident()
+        if name is None:
+            self._error(f"expected type, got {self._peek()!r}")
+            return None
+        t = TypeExpr(pos=pos, name=name)
+        if self._eat("["):
+            while True:
+                arg = self._type_arg()
+                if arg is None:
+                    return None
+                t.args.append(arg)
+                if self._eat(","):
+                    continue
+                break
+            if not self._expect("]"):
+                return None
+        if self._eat(":"):
+            iv = self._int_value()
+            if iv is None:
+                self._error("expected bitfield width after ':'")
+                return None
+            t.colon = iv
+        return t
+
+    def _type_arg(self):
+        self._skip_ws()
+        c = self._peek()
+        if c == '"':
+            return self._string()
+        if c == "'" or c.isdigit() or c == "-":
+            iv = self._int_value()
+            if iv is None:
+                return None
+            if self._peek() == ":":
+                self.col += 1
+                hi = self._int_value()
+                if hi is None:
+                    self._error("expected range end after ':'")
+                    return None
+                return RangeValue(pos=iv.pos, lo=iv, hi=hi)
+            return iv
+        # identifier: could be a nested type (with args), a bare name,
+        # or a symbolic range (CONST:CONST).  _type_expr consumes the
+        # ':' as a bitfield suffix; reinterpret it as a range unless the
+        # head is an int type (where `int32:4` really is a bitfield).
+        t = self._type_expr()
+        if t is None:
+            return None
+        if not t.args and t.colon is not None \
+                and not _INT_TYPE_RE.match(t.name):
+            lo = IntValue(pos=t.pos, raw=t.name, ident=t.name)
+            return RangeValue(pos=t.pos, lo=lo, hi=t.colon)
+        return t
+
+    # -- declarations ----------------------------------------------------
+
+    def _parse_include(self, kind: str):
+        pos = self._pos()
+        if not self._expect("<"):
+            return None
+        end = self.text.find(">", self.col)
+        if end < 0:
+            self._error("expected '>'")
+            return None
+        path = self.text[self.col:end]
+        self.col = end + 1
+        return Include(pos=pos, file=path) if kind == "include" else \
+            Incdir(pos=pos, dir=path)
+
+    def _parse_define(self):
+        pos = self._pos()
+        name = self._ident()
+        if name is None:
+            self._error("expected define name")
+            return None
+        self._skip_ws()
+        value = self.text[self.col:].strip()
+        if "#" in value:
+            value = value[:value.index("#")].strip()
+        self.col = len(self.text)
+        if not value:
+            self._error("expected define value")
+            return None
+        return Define(pos=pos, name=name, value=value)
+
+    def _parse_resource(self):
+        pos = self._pos()
+        name = self._ident()
+        if name is None or not self._expect("["):
+            self._error("malformed resource")
+            return None
+        base = self._type_expr()
+        if base is None or not self._expect("]"):
+            return None
+        values: list[IntValue] = []
+        if self._eat(":"):
+            while True:
+                v = self._int_value()
+                if v is None:
+                    self._error("expected resource value")
+                    return None
+                values.append(v)
+                if not self._eat(","):
+                    break
+        return Resource(pos=pos, name=name, base=base, values=values)
+
+    def _parse_typedef(self):
+        pos = self._pos()
+        name = self._ident()
+        if name is None:
+            self._error("expected type name")
+            return None
+        params: list[str] = []
+        if self._eat("["):
+            while True:
+                p = self._ident()
+                if p is None:
+                    self._error("expected template parameter")
+                    return None
+                params.append(p)
+                if not self._eat(","):
+                    break
+            if not self._expect("]"):
+                return None
+        c = self._peek()
+        if c == "{":
+            st = self._parse_struct_body(name, is_union=False)
+            if st is None:
+                return None
+            return TypeDef(pos=pos, name=name, params=params, struct=st)
+        if c == "[" and self._looks_like_union_body():
+            st = self._parse_struct_body(name, is_union=True)
+            if st is None:
+                return None
+            return TypeDef(pos=pos, name=name, params=params, struct=st)
+        t = self._type_expr()
+        if t is None:
+            return None
+        return TypeDef(pos=pos, name=name, params=params, type=t)
+
+    def _looks_like_union_body(self) -> bool:
+        # `type t [ \n` opens a union body; `type t [varlen] int32`-style
+        # cannot occur, so a '[' followed by line end means union.
+        save = self.col
+        assert self._eat("[")
+        at_end = self._at_end()
+        self.col = save
+        return at_end
+
+    def _parse_flags(self, name: str, pos: Pos):
+        # after "name ="
+        if self._peek() == '"':
+            vals_s: list[StrValue] = []
+            while True:
+                s = self._string()
+                if s is None:
+                    return None
+                vals_s.append(s)
+                if not self._eat(","):
+                    break
+            return StrFlags(pos=pos, name=name, values=vals_s)
+        vals: list[IntValue] = []
+        while True:
+            v = self._int_value()
+            if v is None:
+                self._error("expected flag value")
+                return None
+            vals.append(v)
+            if not self._eat(","):
+                break
+        return IntFlags(pos=pos, name=name, values=vals)
+
+    def _parse_struct_body(self, name: str, is_union: bool) -> Optional[Struct]:
+        pos = self._pos()
+        opener, closer = ("[", "]") if is_union else ("{", "}")
+        if not self._expect(opener):
+            return None
+        if not self._at_end():
+            self._error(f"expected end of line after {opener!r}")
+        st = Struct(pos=pos, name=name, is_union=is_union)
+        while True:
+            self._advance_line()
+            if not self._next_line():
+                self._error(f"unterminated {'union' if is_union else 'struct'}")
+                return None
+            if self._at_end():
+                continue
+            if self._peek() == closer:
+                self.col += 1
+                break
+            fpos = self._pos()
+            fname = self._ident()
+            if fname is None:
+                self._error("expected field name")
+                return None
+            ft = self._type_expr()
+            if ft is None:
+                return None
+            st.fields.append(Field(pos=fpos, name=fname, type=ft))
+            if not self._at_end():
+                self._error("unexpected trailing tokens after field")
+                return None
+        # trailing attributes
+        if self._eat("["):
+            while True:
+                a = self._type_expr()
+                if a is None:
+                    return None
+                st.attrs.append(a)
+                if not self._eat(","):
+                    break
+            if not self._expect("]"):
+                return None
+        return st
+
+    def _parse_call(self, name: str, pos: Pos) -> Optional[Call]:
+        call = Call(pos=pos, name=name)
+        if not self._expect("("):
+            return None
+        if not self._eat(")"):
+            while True:
+                apos = self._pos()
+                aname = self._ident()
+                if aname is None:
+                    self._error("expected argument name")
+                    return None
+                at = self._type_expr()
+                if at is None:
+                    return None
+                call.args.append(Field(pos=apos, name=aname, type=at))
+                if self._eat(","):
+                    continue
+                if not self._expect(")"):
+                    return None
+                break
+        if not self._at_end():
+            ret = self._type_expr()
+            if ret is None:
+                return None
+            call.ret = ret
+        return call
+
+    # -- driver ----------------------------------------------------------
+
+    def parse(self) -> Description:
+        desc = Description()
+        while self._next_line():
+            if not self._at_end():
+                d = self._parse_top()
+                if d is not None:
+                    desc.decls.append(d)
+                    if not self._at_end():
+                        self._error("unexpected trailing tokens")
+            self._advance_line()
+        return desc
+
+    def _parse_top(self):
+        pos = self._pos()
+        save = self.col
+        name = self._ident()
+        if name is None:
+            self._error(f"unexpected character {self._peek()!r}")
+            self.col = len(self.text)
+            return None
+        if name in ("include", "incdir"):
+            return self._parse_include(name)
+        if name == "define":
+            return self._parse_define()
+        if name == "resource":
+            return self._parse_resource()
+        if name == "type":
+            return self._parse_typedef()
+        c = self._peek()
+        if c == "=":
+            self.col += 1
+            return self._parse_flags(name, pos)
+        if c == "(":
+            return self._parse_call(name, pos)
+        if c == "{":
+            return self._parse_struct_body(name, is_union=False)
+        if c == "[" and self._looks_like_union_body():
+            return self._parse_struct_body(name, is_union=True)
+        self.col = save
+        self._error(f"unexpected declaration starting with {name!r}")
+        self.col = len(self.text)
+        return None
+
+
+def parse(src: str, filename: str = "<src>") -> Description:
+    """Parse a description; raises ParseError listing every error."""
+    p = Parser(src, filename)
+    desc = p.parse()
+    if p.errors:
+        raise ParseError("\n".join(p.errors))
+    return desc
+
+
+def parse_glob(paths) -> Description:
+    """Parse and concatenate several description files
+    (reference: pkg/ast ParseGlob used by sysgen.go:39)."""
+    merged = Description()
+    errors = []
+    for path in paths:
+        with open(path) as f:
+            src = f.read()
+        p = Parser(src, str(path))
+        d = p.parse()
+        errors += p.errors
+        merged.decls += d.decls
+    if errors:
+        raise ParseError("\n".join(errors))
+    return merged

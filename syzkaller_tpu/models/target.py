@@ -178,6 +178,7 @@ def is_compatible_resource_impl(dst: tuple[str, ...], src: tuple[str, ...],
 
 
 _targets: dict[str, Target] = {}
+_lazy_targets: dict[str, object] = {}
 
 
 def register_target(target: Target) -> None:
@@ -185,6 +186,17 @@ def register_target(target: Target) -> None:
     if key in _targets:
         raise ValueError(f"duplicate target {key}")
     _targets[key] = target
+
+
+def register_lazy_target(os: str, arch: str, factory) -> None:
+    """Register a target constructed on first GetTarget (used by the
+    description pipeline so importing the package doesn't compile every
+    shipped OS; reference analogue: generated sys/<os>/gen tables are
+    wired by init() but prog.Target init is lazy, prog/target.go:80)."""
+    key = f"{os}/{arch}"
+    if key in _targets:
+        raise ValueError(f"duplicate target {key}")
+    _lazy_targets[key] = factory
 
 
 def get_target(os: str, arch: str) -> Target:
@@ -195,6 +207,15 @@ def get_target(os: str, arch: str) -> Target:
         import syzkaller_tpu.sys  # noqa: F401
 
         t = _targets.get(key)
+    if t is None and key in _lazy_targets:
+        # Pop only on success: a factory that raises (e.g. description
+        # compile error) must stay registered so retries surface the
+        # real error rather than a KeyError.
+        t = _lazy_targets[key]()
+        _targets[key] = t
+        del _lazy_targets[key]
     if t is None:
-        raise KeyError(f"unknown target {key} (have: {sorted(_targets)})")
+        raise KeyError(
+            f"unknown target {key} "
+            f"(have: {sorted(set(_targets) | set(_lazy_targets))})")
     return t.init()

@@ -259,6 +259,18 @@ def text(kind: TextKind) -> TypeSpec:
     return spec
 
 
+def void() -> TypeSpec:
+    """Zero-size type for varlen unions and template padding slots
+    (syzlang `void`)."""
+
+    def spec(b, d, fname, memo) -> Type:
+        return BufferType(name="void", field_name=fname, dir=d,
+                          kind=BufferKind.BLOB_RANGE, varlen=False,
+                          type_size=0, range_begin=0, range_end=0)
+
+    return spec
+
+
 def res(name: str, opt: bool = False) -> TypeSpec:
     """Reference to a named resource."""
 

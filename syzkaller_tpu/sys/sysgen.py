@@ -1,0 +1,102 @@
+"""sysgen: the build-time driver of the description compiler.
+
+The reference renders compiled descriptions into generated Go tables
+with a revision hash and registers them at import (reference:
+sys/syz-sysgen/sysgen.go:36-80, sys/<os>/gen/<arch>.go,
+prog.RegisterTarget).  Here descriptions ship as syzlang sources under
+sys/descriptions/<os>/ with per-arch .const files; targets are
+compiled on first GetTarget and cached, and each Target carries the
+revision (sha1 of its sources) so corpus databases can detect
+description drift (reference: prog/target.go Revision field,
+syz-manager/manager.go:192-207 re-minimization policy on mismatch).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from pathlib import Path
+from typing import Optional
+
+from syzkaller_tpu.models.target import register_lazy_target
+
+DESC_ROOT = Path(__file__).parent / "descriptions"
+
+
+def list_description_oses(root: Path = DESC_ROOT) -> list[str]:
+    if not root.is_dir():
+        return []
+    return sorted(p.name for p in root.iterdir() if p.is_dir())
+
+
+def description_arches(os_name: str, root: Path = DESC_ROOT) -> list[str]:
+    """Arches are discovered from <name>_<arch>.const file suffixes."""
+    arches = set()
+    for p in (root / os_name).glob("*_*.const"):
+        arches.add(p.stem.rsplit("_", 1)[1])
+    return sorted(arches)
+
+
+def revision_hash(os_name: str, root: Path = DESC_ROOT) -> str:
+    h = hashlib.sha1()
+    for p in sorted((root / os_name).glob("*")):
+        h.update(p.name.encode())
+        h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def compile_os(os_name: str, arch: str, root: Path = DESC_ROOT,
+               register: bool = False):
+    # Deferred import: sys/__init__ imports this module, and the
+    # compiler imports sys.builder.
+    from syzkaller_tpu.compiler.compile import Compiler
+    from syzkaller_tpu.compiler.consts import load_const_files
+    from syzkaller_tpu.compiler.parser import parse_glob
+
+    src_files = sorted((root / os_name).glob("*.txt"))
+    const_files = sorted((root / os_name).glob(f"*_{arch}.const"))
+    desc = parse_glob(src_files)
+    consts = load_const_files(str(p) for p in const_files)
+    ptr_size = 4 if arch in ("32", "386", "arm") else 8
+    c = Compiler(desc, consts, os_name, arch, ptr_size=ptr_size)
+    res = c.compile(register=register)
+    res.target.revision = revision_hash(os_name, root)
+    return res
+
+
+def register_all(root: Path = DESC_ROOT) -> list[tuple[str, str]]:
+    """Register every shipped description target lazily; returns the
+    (os, arch) pairs made available."""
+    pairs = []
+    for os_name in list_description_oses(root):
+        for arch in description_arches(os_name, root):
+            register_lazy_target(
+                os_name, arch,
+                lambda o=os_name, a=arch: compile_os(o, a, root,
+                                                     register=False).target)
+            pairs.append((os_name, arch))
+    return pairs
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """CLI: report every compilable (os, arch) and its revision, the
+    moral equivalent of `make generate` (reference: Makefile:187-196)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="sysgen")
+    ap.add_argument("--root", default=str(DESC_ROOT))
+    args = ap.parse_args(argv)
+    root = Path(args.root)
+    for os_name in list_description_oses(root):
+        for arch in description_arches(os_name, root):
+            res = compile_os(os_name, arch, root)
+            t = res.target
+            print(f"{os_name}/{arch}: {len(t.syscalls)} syscalls, "
+                  f"{len(t.resources)} resources, rev {t.revision[:12]}"
+                  + (f", disabled: {len(res.disabled_calls)}"
+                     if res.disabled_calls else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

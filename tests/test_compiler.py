@@ -1,0 +1,279 @@
+"""Description-compiler tests: parse round-trips, const patching,
+template expansion, and end-to-end compile → generate → serialize.
+
+Mirrors the reference compiler test strategy (reference:
+pkg/ast parse tests, pkg/compiler/compiler_test.go) against our own
+fresh description source.
+"""
+
+import pytest
+
+from syzkaller_tpu.compiler import ast as A
+from syzkaller_tpu.compiler.compile import CompileError, compile_description
+from syzkaller_tpu.compiler.consts import (
+    ConstError,
+    eval_expr,
+    parse_const_file,
+    patch_consts,
+    serialize_const_file,
+)
+from syzkaller_tpu.compiler.parser import ParseError, parse
+
+SRC = """\
+# A fresh description exercising the type system.
+include <uapi/fake.h>
+incdir <include>
+
+define DSL_MAGIC 0x1000 | 0x24
+define DSL_NEXT DSL_MAGIC + 1
+
+resource dsl_fd[int32]: -1, DSL_MAGIC
+resource dsl_sock[dsl_fd]
+
+open_flags = 1, 2, 4, OPEN_EXTRA
+name_strs = "alpha", "beta"
+
+type pair_t[T] {
+\tfirst\tT
+\tsecond\tT
+}
+type small int8[0:15]
+
+dsl_hdr {
+\tmagic\tconst[DSL_MAGIC, int32]
+\tsz\tlen[parent, int16]
+\tkind\tint8:4
+\tpad\tint8:4
+\tbody\tarray[int8, 0:8]
+} [packed]
+
+dsl_opts [
+\tnum\tint64
+\tstr\tstring["fixed", 16]
+\tnested\tptr[in, dsl_hdr]
+] [varlen]
+
+dsl_mmap(addr vma, len len[addr])
+dsl_open(name ptr[in, string[name_strs]], flags flags[open_flags], x bool8) dsl_fd
+dsl_use(fd dsl_fd, buf buffer[in], n len[buf], p pair_t[int16be], o ptr[in, optional[int32]])
+dsl_sock$make(fd dsl_fd) dsl_sock
+dsl_range(a int32[0:100], b proc[1000, 8], c small, v vma[1:4])
+dsl_union(u ptr[inout, dsl_opts], extra ptr[out, array[int64, 4]])
+"""
+
+
+def _compile(src=SRC, consts=None, **kw):
+    base = {"OPEN_EXTRA": 8, "__NR_dsl_open": 42}
+    if consts:
+        base.update(consts)
+    return compile_description(src, base, **kw)
+
+
+def test_parse_roundtrip():
+    d1 = parse(SRC)
+    text = d1.format()
+    d2 = parse(text)
+    assert d2.format() == text
+    kinds = [type(d).__name__ for d in d1.decls]
+    assert "Resource" in kinds and "TypeDef" in kinds
+    assert "Struct" in kinds and "Call" in kinds
+
+
+def test_parse_errors_collected():
+    with pytest.raises(ParseError) as ei:
+        parse("foo(\nbar baz qux(")
+    assert "\n" in str(ei.value) or "expected" in str(ei.value)
+
+
+def test_const_file_roundtrip():
+    consts = {"A": 1, "B": 0xFFFF_FFFF_FFFF_FFFF}
+    text = serialize_const_file(consts)
+    assert parse_const_file(text) == consts
+
+
+def test_eval_expr():
+    env = {"X": 8}
+    assert eval_expr("1 << 4 | X", env) == 24
+    assert eval_expr("-1", env) == (1 << 64) - 1
+    with pytest.raises(ConstError):
+        eval_expr("UNKNOWN", env)
+    with pytest.raises(ConstError):
+        eval_expr("__import__('os')", env)
+
+
+def test_missing_const_disables_call():
+    res = compile_description("foo(a const[MISSING])\nbar(a int32)", {})
+    assert res.disabled_calls == ["foo"]
+    assert [s.name for s in res.target.syscalls] == ["bar"]
+
+
+def test_patch_consts_resolves_symbolic():
+    d = parse("foo(a const[KNOWN])")
+    patch_consts(d, {"KNOWN": 7})
+    call = next(x for x in d.decls if isinstance(x, A.Call))
+    arg = call.args[0].type.args[0]
+    assert isinstance(arg, A.IntValue) and arg.value == 7
+
+
+def test_compile_basic():
+    res = _compile()
+    t = res.target
+    names = [s.name for s in t.syscalls]
+    assert "dsl_open" in names and "dsl_sock$make" in names
+    assert not res.disabled_calls
+    opn = next(s for s in t.syscalls if s.name == "dsl_open")
+    assert opn.nr == 42  # from __NR_dsl_open
+    assert opn.ret is not None and opn.ret.name == "dsl_fd"
+    # flags patched: OPEN_EXTRA resolved to 8
+    fl = opn.args[1]
+    assert 8 in fl.vals and fl.vals[:3] == (1, 2, 4)
+
+
+def test_compile_struct_layout():
+    t = _compile().target
+    use = next(s for s in t.syscalls if s.name == "dsl_use")
+    pair = use.args[3]
+    assert pair.name == "pair_t[int16be]"
+    assert pair.type_size == 4  # two int16
+    assert all(f.big_endian for f in pair.fields)
+    opt_ptr = use.args[4]
+    un = opt_ptr.elem
+    assert un.name == "optional[int32]"
+    assert un.varlen  # varlen union
+
+
+def test_compile_bitfields_and_packed():
+    t = _compile().target
+    hdr_call = next(s for s in t.syscalls if s.name == "dsl_union")
+    union = hdr_call.args[0].elem
+    assert union.name == "dsl_opts"
+    nested_ptr = union.fields[2]
+    hdr = nested_ptr.elem
+    # packed struct: const32 + int16 + two 4-bit int8 + blob 0..8
+    assert hdr.fields[2].bitfield_length() == 4
+    assert hdr.fields[2].bitfield_middle()
+    assert not hdr.fields[3].bitfield_middle()
+
+
+def test_compile_resource_subtyping():
+    t = _compile().target
+    socks = {r.name: r for r in t.resources}
+    assert socks["dsl_sock"].kind == ("dsl_fd", "dsl_sock")
+    assert socks["dsl_fd"].values[0] == (1 << 64) - 1  # -1 masked
+
+
+def test_compile_generates_and_serializes():
+    from syzkaller_tpu.models.encoding import deserialize_prog, serialize_prog
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.rand import RandGen
+
+    t = _compile().target
+    for seed in range(20):
+        p = generate_prog(t, RandGen(t, seed), 6)
+        text = serialize_prog(p)
+        p2 = deserialize_prog(t, text)
+        assert serialize_prog(p2) == text
+
+
+def test_compile_error_unknown_type():
+    with pytest.raises(CompileError) as ei:
+        _compile("foo(a nosuchtype)")
+    assert "unknown type" in str(ei.value)
+
+
+def test_compile_error_bad_ret():
+    with pytest.raises(CompileError) as ei:
+        _compile("foo() int32")
+    assert "must be a resource" in str(ei.value)
+
+
+def test_builtin_aliases():
+    t = _compile("f(a bool8, b boolptr, c buffer[out])").target
+    f = t.syscalls[0]
+    assert f.args[0].range_end == 1 and f.args[0].type_size == 1
+    assert f.args[1].range_end == 1 and f.args[1].type_size == 8
+    # buffer[out] = ptr[out, array[int8]] → pointer to blob
+    from syzkaller_tpu.models.types import BufferType, PtrType
+
+    assert isinstance(f.args[2], PtrType)
+    assert isinstance(f.args[2].elem, BufferType)
+
+
+def test_auto_nr_assignment():
+    t = _compile("b()\na()\n").target
+    nrs = {s.name: s.nr for s in t.syscalls}
+    assert nrs["b"] != nrs["a"]
+
+
+def test_mutation_on_compiled_target():
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.mutation import mutate_prog
+    from syzkaller_tpu.models.rand import RandGen
+
+    t = _compile().target
+    rg = RandGen(t, 7)
+    p = generate_prog(t, rg, 5)
+    for _ in range(30):
+        mutate_prog(p, rg, 8, corpus=[p])
+    assert 1 <= len(p.calls) <= 8
+
+
+def test_shipped_dsl_target():
+    """The dsl OS compiles from shipped descriptions and fuzzes."""
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.rand import RandGen
+    from syzkaller_tpu.models.target import get_target
+
+    t = get_target("dsl", "64")
+    assert t.revision
+    assert len(t.syscalls) >= 14
+    nrs = {s.name: s.nr for s in t.syscalls}
+    assert nrs["dz_open"] == 2  # from dsl_64.const
+    p = generate_prog(t, RandGen(t, 3), 8)
+    assert p.calls
+
+
+def test_intptr_respects_ptr_size():
+    t = compile_description("g(a intptr)\ns {\n\tf\tintptr\n}\nh(p ptr[in, s])",
+                            {}, ptr_size=4).target
+    g = next(s for s in t.syscalls if s.name == "g")
+    assert g.args[0].type_size == 4
+    h = next(s for s in t.syscalls if s.name == "h")
+    assert h.args[0].elem.fields[0].type_size == 4
+
+
+def test_symbolic_range():
+    t = compile_description("f(a int32[C1:C2], b proc[0, 1, int16:4])",
+                            {"C1": 1, "C2": 9}).target
+    a = t.syscalls[0].args[0]
+    assert (a.range_begin, a.range_end) == (1, 9)
+
+
+def test_size_attr_const():
+    t = compile_description(
+        "s {\n\tf\tint32\n} [size[SZ]]\nh(p ptr[in, s])", {"SZ": 16}).target
+    assert t.syscalls[0].args[0].elem.type_size == 16
+
+
+def test_alias_with_args_rejected():
+    with pytest.raises(CompileError) as ei:
+        compile_description("k(a bool8[5])", {})
+    assert "expects 0 args" in str(ei.value)
+
+
+def test_lazy_target_survives_failed_factory():
+    from syzkaller_tpu.models import target as T
+
+    calls = {"n": 0}
+
+    def factory():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient")
+        return T.Target(os="lazyt", arch="x", syscalls=[], resources=[])
+
+    T.register_lazy_target("lazyt", "x", factory)
+    with pytest.raises(RuntimeError):
+        T.get_target("lazyt", "x")
+    t = T.get_target("lazyt", "x")
+    assert t.os == "lazyt"
