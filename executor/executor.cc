@@ -1,0 +1,758 @@
+// tz-executor: the native in-VM program executor.
+//
+// A fresh TPU-framework design filling the role of the reference's
+// syz-executor (reference: executor/executor.h, executor/executor.cc,
+// executor/executor_linux.cc): it speaks the exec uint64 wire format
+// emitted by the host/TPU mutation plane, runs each program's calls on
+// a pool of worker threads with a per-call timeout, computes deduped
+// edge signal, captures comparison operands, supports collide mode and
+// fault injection, and writes per-call results into an output shmem
+// region parsed by syzkaller_tpu/ipc/env.py.
+//
+// Backends:
+//   * sim (kEnvSimOS): deterministic in-process fake kernel
+//     (sim_kernel.h) — hermetic, used by all tests and local stress;
+//   * linux: raw syscall(2) execution with optional KCOV coverage —
+//     the real-kernel path, selected by the VM-side fuzzer.
+//
+// Process model: fork-server.  The host spawns this binary once per
+// proc; handshake over stdin/stdout, then one ExecuteReq/ExecuteRep
+// round per program.  Crashes of the simulated kernel print an oops to
+// stderr and kill the process — the host treats that exactly like a
+// VM console oops + lost connection.
+
+#include <errno.h>
+#include <stdarg.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <array>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <mutex>
+#include <set>
+#include <thread>
+#include <vector>
+
+#include "sim_kernel.h"
+#include "wire.h"
+
+#if defined(__linux__)
+#include <sys/ioctl.h>
+#include <sys/syscall.h>
+#endif
+
+namespace tz {
+
+// ---- globals set at handshake ---------------------------------------
+
+static uint64_t g_env_flags;
+static uint64_t g_pid;
+static bool g_debug;
+static uint64_t* g_in;      // program stream
+static uint8_t* g_out;      // results
+static uint8_t* g_arena;    // guest data region
+static uint64_t g_arena_base = 0x20000000ull;
+static uint64_t g_arena_size = 16ull << 20;
+static int g_call_timeout_ms = 25;
+
+static void debugf(const char* fmt, ...) {
+  if (!g_debug) return;
+  va_list args;
+  va_start(args, fmt);
+  vfprintf(stderr, fmt, args);
+  va_end(args);
+}
+
+[[noreturn]] static void failf(const char* fmt, ...) {
+  va_list args;
+  va_start(args, fmt);
+  vfprintf(stderr, fmt, args);
+  va_end(args);
+  fprintf(stderr, "\n");
+  _exit(kStatusFail);
+}
+
+// ---- guest memory ----------------------------------------------------
+
+static uint8_t* guest(uint64_t addr, uint64_t size) {
+  if (addr < g_arena_base || addr + size > g_arena_base + g_arena_size ||
+      addr + size < addr)
+    failf("executor: copy outside arena: addr=0x%llx size=%llu",
+          (unsigned long long)addr, (unsigned long long)size);
+  return g_arena + (addr - g_arena_base);
+}
+
+static uint64_t swap_bytes(uint64_t v, uint64_t size) {
+  uint64_t r = __builtin_bswap64(v);
+  return r >> (64 - 8 * size);
+}
+
+// copyin with bitfield read-modify-write + endianness + pid striding
+// (reference: executor/executor.h:708-749 copyin semantics)
+static void copyin_const(uint64_t addr, uint64_t val, uint64_t meta) {
+  uint64_t size = meta_size(meta);
+  uint64_t bf_off = meta_bf_off(meta);
+  uint64_t bf_len = meta_bf_len(meta);
+  val += meta_pid_stride(meta) * g_pid;
+  if (meta_be(meta)) val = swap_bytes(val, size);
+  uint8_t* p = guest(addr, size);
+  if (bf_len == 0) {
+    memcpy(p, &val, size);
+    return;
+  }
+  uint64_t cur = 0;
+  memcpy(&cur, p, size);
+  uint64_t mask = (bf_len == 64 ? ~0ull : ((1ull << bf_len) - 1)) << bf_off;
+  cur = (cur & ~mask) | ((val << bf_off) & mask);
+  memcpy(p, &cur, size);
+}
+
+static uint64_t read_guest_int(uint64_t addr, uint64_t size) {
+  uint64_t v = 0;
+  memcpy(&v, guest(addr, size), size);
+  return v;
+}
+
+// ---- inet checksum ---------------------------------------------------
+
+static uint16_t csum_fold(uint64_t sum) {
+  while (sum >> 16) sum = (sum & 0xffff) + (sum >> 16);
+  return (uint16_t)~sum;
+}
+
+static uint64_t csum_acc(const uint8_t* data, uint64_t len, uint64_t sum) {
+  for (uint64_t i = 0; i + 1 < len; i += 2)
+    sum += (uint16_t)(data[i] | (data[i + 1] << 8));
+  if (len & 1) sum += data[len - 1];
+  return sum;
+}
+
+// ---- signal (edge hash + dedup) -------------------------------------
+// signal = pc ^ hash(prev_pc), deduped in a small open-addressing
+// table per call (reference: executor/executor.h:492-528,677-706).
+
+struct SignalBuilder {
+  static constexpr int kTableBits = 13;  // 8192 entries
+  uint32_t table[1 << kTableBits];
+  int n = 0;
+
+  SignalBuilder() { memset(table, 0, sizeof(table)); }
+
+  static uint32_t hash(uint32_t pc) {
+    uint64_t h = splitmix64(pc);
+    return (uint32_t)(h ^ (h >> 32));
+  }
+
+  // returns true if sig was new
+  bool add(uint32_t sig, std::vector<uint32_t>* out) {
+    uint32_t slot = sig & ((1 << kTableBits) - 1);
+    for (int probe = 0; probe < 8; probe++) {
+      uint32_t idx = (slot + probe) & ((1 << kTableBits) - 1);
+      if (table[idx] == sig) return false;
+      if (table[idx] == 0) {
+        table[idx] = sig;
+        out->push_back(sig);
+        return true;
+      }
+    }
+    out->push_back(sig);  // table pressure: accept possible dup
+    return true;
+  }
+
+  void build(const uint32_t* cov, int len, std::vector<uint32_t>* out) {
+    uint32_t prev = 0;
+    for (int i = 0; i < len; i++) {
+      add(cov[i] ^ hash(prev), out);
+      prev = cov[i];
+    }
+  }
+};
+
+// ---- KCOV (linux real-kernel mode) ----------------------------------
+
+#if defined(__linux__)
+struct Kcov {
+  static constexpr unsigned long kInitTrace = 0x80086301;
+  static constexpr unsigned long kEnable = 0x6364;
+  static constexpr unsigned long kDisable = 0x6365;
+  static constexpr int kCoverSize = 64 << 10;
+  int fd = -1;
+  uint64_t* area = nullptr;
+
+  bool open_() {
+    fd = open("/sys/kernel/debug/kcov", O_RDWR);
+    if (fd < 0) return false;
+    if (ioctl(fd, kInitTrace, kCoverSize)) return close_();
+    area = (uint64_t*)mmap(nullptr, kCoverSize * 8, PROT_READ | PROT_WRITE,
+                           MAP_SHARED, fd, 0);
+    if (area == MAP_FAILED) return close_();
+    return true;
+  }
+  bool close_() {
+    if (fd >= 0) close(fd);
+    fd = -1;
+    return false;
+  }
+  void enable() {
+    if (area) {
+      __atomic_store_n(&area[0], 0, __ATOMIC_RELAXED);
+      ioctl(fd, kEnable, 0);
+    }
+  }
+  int disable(uint32_t* cov, int max) {
+    if (!area) return 0;
+    ioctl(fd, kDisable, 0);
+    uint64_t n = __atomic_load_n(&area[0], __ATOMIC_RELAXED);
+    int out = 0;
+    for (uint64_t i = 0; i < n && out < max; i++)
+      cov[out++] = (uint32_t)area[i + 1];
+    return out;
+  }
+};
+#endif
+
+// ---- call execution --------------------------------------------------
+
+constexpr int kMaxCov = 4 << 10;
+constexpr int kMaxCmps = 512;
+
+struct CallJob {
+  // inputs
+  uint32_t call_index;
+  uint32_t call_id;
+  uint64_t args[8];
+  int nargs;
+  bool collect_cover;
+  bool collect_comps;
+  // outputs — written by the worker only at completion, under its
+  // mutex, so the main thread may read them freely once wait()
+  // succeeded; a timed-out job is marked abandoned and then owned
+  // (and eventually freed) by the worker alone.
+  uint32_t errno_;
+  uint64_t ret;
+  uint32_t flags;
+  std::vector<uint32_t> signal;
+  std::vector<uint32_t> cover;
+  std::vector<SimCmp> comps;
+  bool crashed = false;
+  bool abandoned = false;
+};
+
+class Worker {
+ public:
+  Worker(SimKernel* sim, std::mutex* sim_mu) : sim_(sim), sim_mu_(sim_mu) {
+    th_ = std::thread([this] { loop(); });
+  }
+
+  bool busy() const { return busy_.load(); }
+
+  void submit(CallJob* job) {
+    std::unique_lock<std::mutex> lk(mu_);
+    job_ = job;
+    busy_.store(true);
+    cv_.notify_one();
+  }
+
+  // Plain wait for completion; false on timeout (job stays owned by
+  // the caller — used when waiting for pool capacity).
+  bool wait(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    return done_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                             [this] { return !busy_.load(); });
+  }
+
+  // Wait for completion; on timeout marks the job abandoned (the
+  // worker frees it at completion and the caller must not touch it
+  // again) and returns false.
+  bool wait_or_abandon(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    bool done = done_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                  [this] { return !busy_.load(); });
+    if (!done && cur_ != nullptr) cur_->abandoned = true;
+    return done;
+  }
+
+ private:
+  void loop() {
+    for (;;) {
+      CallJob* job;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return job_ != nullptr; });
+        job = job_;
+        job_ = nullptr;
+        cur_ = job;
+      }
+      Output out{};
+      run(job, &out);
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (job->abandoned) {
+          delete job;
+        } else {
+          job->errno_ = out.errno_;
+          job->ret = out.ret;
+          job->flags |= out.flags;
+          job->crashed = out.crashed;
+          job->signal = std::move(out.signal);
+          job->cover = std::move(out.cover);
+          job->comps = std::move(out.comps);
+        }
+        cur_ = nullptr;
+        busy_.store(false);
+        done_cv_.notify_all();
+      }
+    }
+  }
+
+  struct Output {
+    uint32_t errno_;
+    uint64_t ret;
+    uint32_t flags;
+    bool crashed;
+    std::vector<uint32_t> signal;
+    std::vector<uint32_t> cover;
+    std::vector<SimCmp> comps;
+  };
+
+  void run(CallJob* j, Output* o) {
+    static thread_local uint32_t cov[kMaxCov];
+    static thread_local SimCmp cmps[kMaxCmps];
+    int cov_len = 0, cmps_len = 0;
+    if (g_env_flags & kEnvSimOS) {
+      SimResult r;
+      {
+        std::lock_guard<std::mutex> lk(*sim_mu_);
+        r = sim_->exec(j->call_id, j->args, j->nargs, cov, kMaxCov, &cov_len,
+                       cmps, kMaxCmps, &cmps_len);
+      }
+      if (r.crashed) {
+        o->crashed = true;
+        return;
+      }
+      o->errno_ = r.errno_;
+      o->ret = r.ret;
+      if (r.fault_injected) o->flags |= kCallFlagFaultInjected;
+    } else {
+#if defined(__linux__)
+      static thread_local Kcov kcov;
+      static thread_local bool kcov_ok = kcov.open_();
+      if (kcov_ok) kcov.enable();
+      long res = syscall(j->call_id, j->args[0], j->args[1], j->args[2],
+                         j->args[3], j->args[4], j->args[5]);
+      o->errno_ = res == -1 ? errno : 0;
+      o->ret = res == -1 ? 0 : (uint64_t)res;
+      if (kcov_ok) {
+        cov_len = kcov.disable(cov, kMaxCov);
+      } else {
+        // no KCOV: one edge per (call, errno) so signal still flows
+        cov[0] = (uint32_t)splitmix64(j->call_id * 1000ull + o->errno_);
+        cov_len = 1;
+      }
+#else
+      o->errno_ = 38;  // ENOSYS
+#endif
+    }
+    if (g_env_flags & kEnvSignal) {
+      SignalBuilder sb;
+      sb.build(cov, cov_len, &o->signal);
+    }
+    if (j->collect_cover) o->cover.assign(cov, cov + cov_len);
+    if (j->collect_comps) {
+      std::set<std::pair<uint64_t, uint64_t>> uniq;
+      for (int i = 0; i < cmps_len; i++)
+        uniq.emplace(cmps[i].op1, cmps[i].op2);
+      for (auto& c : uniq) o->comps.push_back(SimCmp{c.first, c.second});
+    }
+    o->flags |= kCallFlagExecuted | kCallFlagFinished;
+  }
+
+  SimKernel* sim_;
+  std::mutex* sim_mu_;
+  std::thread th_;
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  std::atomic<bool> busy_{false};
+  CallJob* job_ = nullptr;  // submitted, not yet picked up
+  CallJob* cur_ = nullptr;  // being executed; abandoned under mu_
+};
+
+struct WorkerPool {
+  std::vector<Worker*> workers;
+  SimKernel* sim;
+  std::mutex sim_mu;
+
+  Worker* get() {
+    for (auto* w : workers)
+      if (!w->busy()) return w;
+    if ((int)workers.size() >= kMaxThreads) return nullptr;
+    workers.push_back(new Worker(sim, &sim_mu));
+    return workers.back();
+  }
+};
+
+// ---- program interpretation -----------------------------------------
+
+struct Interp {
+  uint64_t* words;
+  uint64_t nwords;
+  uint64_t pos = 0;
+  uint64_t copyout_vals[kMaxCopyout];
+  bool copyout_done[kMaxCopyout] = {};
+
+  uint64_t next() {
+    if (pos >= nwords) failf("executor: truncated program at word %llu",
+                             (unsigned long long)pos);
+    return words[pos++];
+  }
+
+  // Decode one arg; performs const/result resolution.  For data args
+  // writes payload at addr (0 = call-arg position: payload ignored).
+  uint64_t read_arg(uint64_t addr) {
+    uint64_t kind = next();
+    switch (kind) {
+      case kArgConst: {
+        uint64_t meta = next();
+        uint64_t val = next();
+        if (addr) {
+          copyin_const(addr, val, meta);
+          return 0;
+        }
+        val += meta_pid_stride(meta) * g_pid;
+        if (meta_be(meta)) val = swap_bytes(val, meta_size(meta));
+        return val;
+      }
+      case kArgResult: {
+        uint64_t size = next();
+        uint64_t idx = next();
+        uint64_t op_div = next();
+        uint64_t op_add = next();
+        uint64_t def = next();
+        if (size > 8) failf("executor: result arg size %llu",
+                            (unsigned long long)size);
+        if (idx >= kMaxCopyout) failf("executor: copyout idx %llu",
+                                      (unsigned long long)idx);
+        uint64_t val = copyout_done[idx] ? copyout_vals[idx] : def;
+        if (op_div) val /= op_div;
+        val += op_add;
+        if (addr) memcpy(guest(addr, size), &val, size);
+        return val;
+      }
+      case kArgData: {
+        uint64_t len = next();
+        uint64_t padded = (len + 7) / 8;
+        if (pos + padded > nwords) failf("executor: truncated data arg");
+        if (addr) memcpy(guest(addr, len), &words[pos], len);
+        pos += padded;
+        return 0;
+      }
+      case kArgCsum: {
+        uint64_t size = next();
+        uint64_t ckind = next();
+        if (ckind != kCsumInet) failf("executor: bad csum kind");
+        uint64_t nchunks = next();
+        uint64_t sum = 0;
+        for (uint64_t i = 0; i < nchunks; i++) {
+          uint64_t chunk_kind = next();
+          uint64_t v = next();
+          uint64_t csize = next();
+          if (chunk_kind == kCsumChunkData) {
+            sum = csum_acc(guest(v, csize), csize, sum);
+          } else {
+            // constant chunk, little-endian bytes of v
+            if (csize > 8) failf("executor: csum const chunk size %llu",
+                                 (unsigned long long)csize);
+            sum = csum_acc((const uint8_t*)&v, csize, sum);
+          }
+        }
+        uint16_t folded = csum_fold(sum);
+        if (addr) memcpy(guest(addr, size < 2 ? size : 2), &folded,
+                         size < 2 ? size : 2);
+        return folded;
+      }
+      default:
+        failf("executor: bad arg kind %llu at word %llu",
+              (unsigned long long)kind, (unsigned long long)(pos - 1));
+    }
+    return 0;
+  }
+};
+
+struct PendingCall {
+  CallJob* job;  // owned by main unless abandoned to the worker, in
+                 // which case it is replaced by a blocked stub
+  Worker* worker;
+  uint64_t copyout_idx;  // of ret; kNoCopyout if none
+  std::vector<std::array<uint64_t, 3>> copyouts;  // idx, addr, size
+};
+
+static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
+                            WorkerPool* pool) {
+  Interp in;
+  in.words = g_in;
+  in.nwords = req.prog_words;
+
+  bool threaded = req.exec_flags & kExecThreaded;
+  bool collide = req.exec_flags & kExecCollide;
+
+  std::vector<PendingCall> calls;
+
+  auto finish_call = [&](PendingCall& pc) {
+    if (pc.worker != nullptr) {
+      bool done = pc.worker->wait_or_abandon(g_call_timeout_ms);
+      if (!done) {
+        // the worker now owns (and will free) the original job;
+        // report the call through a stub
+        auto* stub = new CallJob{};
+        stub->call_index = pc.job->call_index;
+        stub->call_id = pc.job->call_id;
+        stub->flags = kCallFlagBlocked;
+        pc.job = stub;
+        pc.worker = nullptr;
+        return;
+      }
+      pc.worker = nullptr;
+    }
+    if (pc.job->crashed) _exit(kStatusError);
+    // persist ret + memory copyouts for later result args
+    if (pc.copyout_idx != kNoCopyout &&
+        (pc.job->flags & kCallFlagFinished) && pc.job->errno_ == 0) {
+      in.copyout_vals[pc.copyout_idx] = pc.job->ret;
+      in.copyout_done[pc.copyout_idx] = true;
+    }
+    for (auto& co : pc.copyouts) {
+      if ((pc.job->flags & kCallFlagFinished) && pc.job->errno_ == 0) {
+        in.copyout_vals[co[0]] = read_guest_int(co[1], co[2]);
+        in.copyout_done[co[0]] = true;
+      }
+    }
+    pc.copyouts.clear();
+  };
+
+  int ncommands = 0;
+  for (;;) {
+    if (++ncommands > kMaxCommands) failf("executor: too many commands");
+    uint64_t w = in.next();
+    if (w == kInstrEOF) break;
+    if (w == kInstrCopyin) {
+      uint64_t addr = in.next();
+      in.read_arg(addr);
+      continue;
+    }
+    if (w == kInstrCopyout) {
+      uint64_t idx = in.next();
+      uint64_t addr = in.next();
+      uint64_t size = in.next();
+      if (idx >= kMaxCopyout) failf("executor: copyout idx %llu",
+                                    (unsigned long long)idx);
+      if (calls.empty()) failf("executor: copyout before any call");
+      calls.back().copyouts.push_back({idx, addr, size});
+      // in sequential mode the call already completed; re-finish to
+      // pick up this copyout now (result args may need it next)
+      if (!threaded) finish_call(calls.back());
+      continue;
+    }
+    // call instruction
+    if ((int)calls.size() >= kMaxCalls) failf("executor: too many calls");
+    auto* job = new CallJob{};
+    job->call_index = (uint32_t)calls.size();
+    job->call_id = (uint32_t)w;
+    job->collect_cover = req.exec_flags & kExecCollectCover;
+    job->collect_comps = req.exec_flags & kExecCollectComps;
+    uint64_t copyout_idx = in.next();
+    uint64_t nargs = in.next();
+    if (nargs > 8) failf("executor: %llu args", (unsigned long long)nargs);
+    for (uint64_t i = 0; i < nargs; i++) job->args[i] = in.read_arg(0);
+    job->nargs = (int)nargs;
+
+    // fault injection arms the sim allocator before the chosen call
+    if ((req.exec_flags & kExecFault) && req.fault_call == calls.size())
+      pool->sim->arm_fault(req.fault_nth);
+
+    Worker* worker = pool->get();
+    if (worker == nullptr) {
+      // thread budget exhausted: wait for a worker to free up
+      worker = pool->workers[0];
+      worker->wait(10 * g_call_timeout_ms);
+      worker = pool->get();
+      if (worker == nullptr) failf("executor: no free workers");
+    }
+    worker->submit(job);
+    calls.push_back(PendingCall{job, worker, copyout_idx, {}});
+    if (!threaded) finish_call(calls.back());
+  }
+  for (auto& pc : calls) finish_call(pc);
+
+  // collide mode: re-issue adjacent pairs without waiting in between
+  // to provoke races (reference: executor/executor.h:409-453)
+  if (collide) {
+    auto reissue = [&](CallJob* src) -> std::pair<Worker*, CallJob*> {
+      Worker* w = pool->get();
+      if (w == nullptr) return {nullptr, nullptr};
+      auto* copy = new CallJob(*src);
+      w->submit(copy);
+      return {w, copy};
+    };
+    for (size_t i = 0; i + 1 < calls.size(); i += 2) {
+      auto a = reissue(calls[i].job);
+      auto b = reissue(calls[i + 1].job);
+      if (a.first && a.first->wait_or_abandon(g_call_timeout_ms))
+        delete a.second;
+      if (b.first && b.first->wait_or_abandon(g_call_timeout_ms))
+        delete b.second;
+    }
+  }
+
+  // ---- write results ----
+  uint8_t* p = g_out;
+  uint8_t* end = g_out + kOutShmemSize;
+  auto* hdr = (OutHeader*)p;
+  p += sizeof(OutHeader);
+  uint32_t written = 0;
+  bool all_finished = true;
+  for (auto& pc : calls) {
+    CallJob* job = pc.job;
+    uint64_t need = sizeof(CallResult) + 4ull * job->signal.size() +
+                    4ull * job->cover.size() + 16ull * job->comps.size();
+    if (p + need > end) {
+      all_finished = false;  // truncated: host must not trust this run
+      break;
+    }
+    auto* cr = (CallResult*)p;
+    p += sizeof(CallResult);
+    cr->call_index = job->call_index;
+    cr->call_id = job->call_id;
+    cr->errno_ = job->errno_;
+    cr->flags = job->flags;
+    cr->signal_len = (uint32_t)job->signal.size();
+    cr->cover_len = (uint32_t)job->cover.size();
+    cr->comps_len = (uint32_t)job->comps.size();
+    cr->reserved = 0;
+    memcpy(p, job->signal.data(), 4 * job->signal.size());
+    p += 4 * job->signal.size();
+    memcpy(p, job->cover.data(), 4 * job->cover.size());
+    p += 4 * job->cover.size();
+    for (auto& c : job->comps) {
+      memcpy(p, &c.op1, 8);
+      memcpy(p + 8, &c.op2, 8);
+      p += 16;
+    }
+    if (!(job->flags & kCallFlagFinished)) all_finished = false;
+    written++;
+  }
+  hdr->ncalls = written;
+  hdr->completed = all_finished ? 1 : 0;
+  rep->ncalls = written;
+  rep->status = 0;
+  for (auto& pc : calls) delete pc.job;  // stubs or completed jobs
+}
+
+// ---- sandbox ---------------------------------------------------------
+
+static void apply_sandbox() {
+  if (g_env_flags & kEnvSandboxSetuid) {
+#if defined(__linux__)
+    // drop to nobody best-effort (reference: common_linux.h:1216)
+    if (setgid(65534)) debugf("setgid failed: %d\n", errno);
+    if (setuid(65534)) debugf("setuid failed: %d\n", errno);
+#endif
+  }
+  // namespace sandbox needs CLONE_NEWUSER plumbing; the sim backend
+  // doesn't touch the host so "none" is safe there.
+}
+
+// ---- main loop -------------------------------------------------------
+
+static void read_exact(int fd, void* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = read(fd, (char*)buf + got, n - got);
+    if (r <= 0) _exit(kStatusRetry);  // host went away
+    got += (size_t)r;
+  }
+}
+
+static void write_exact(int fd, const void* buf, size_t n) {
+  size_t put = 0;
+  while (put < n) {
+    ssize_t r = write(fd, (const char*)buf + put, n - put);
+    if (r <= 0) _exit(kStatusRetry);
+    put += (size_t)r;
+  }
+}
+
+static void* map_file(const char* path, uint64_t size, bool writable) {
+  int fd = open(path, writable ? O_RDWR : O_RDONLY);
+  if (fd < 0) failf("executor: cannot open %s: %d", path, errno);
+  if (writable && ftruncate(fd, (off_t)size))
+    failf("executor: ftruncate %s: %d", path, errno);
+  void* p = mmap(nullptr, size, PROT_READ | (writable ? PROT_WRITE : 0),
+                 MAP_SHARED, fd, 0);
+  if (p == MAP_FAILED) failf("executor: mmap %s: %d", path, errno);
+  close(fd);
+  return p;
+}
+
+static int executor_main(int argc, char** argv) {
+  if (argc < 3) failf("usage: tz-executor <in-file> <out-file>");
+  g_in = (uint64_t*)map_file(argv[1], kInShmemSize, false);
+  g_out = (uint8_t*)map_file(argv[2], kOutShmemSize, true);
+
+  HandshakeReq hs;
+  read_exact(0, &hs, sizeof(hs));
+  if (hs.magic != kHandshakeReqMagic)
+    failf("executor: bad handshake magic 0x%llx",
+          (unsigned long long)hs.magic);
+  g_env_flags = hs.env_flags;
+  g_pid = hs.pid;
+  g_debug = g_env_flags & kEnvDebug;
+
+  // guest arena at the fixed data offset every target compiles
+  // pointers against
+  g_arena = (uint8_t*)mmap((void*)g_arena_base, g_arena_size,
+                           PROT_READ | PROT_WRITE,
+                           MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED, -1, 0);
+  if (g_arena == MAP_FAILED) {
+    // fixed mapping unavailable (ASLR collision): fall back to any
+    // address; guest() translates so semantics are unchanged
+    g_arena = (uint8_t*)mmap(nullptr, g_arena_size, PROT_READ | PROT_WRITE,
+                             MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (g_arena == MAP_FAILED) failf("executor: arena mmap failed");
+  }
+
+  apply_sandbox();
+
+  HandshakeRep hr{kHandshakeRepMagic};
+  write_exact(1, &hr, sizeof(hr));
+
+  SimKernel sim(g_pid);
+  WorkerPool pool;
+  pool.sim = &sim;
+
+  for (;;) {
+    ExecuteReq req;
+    read_exact(0, &req, sizeof(req));
+    if (req.magic != kExecuteReqMagic)
+      failf("executor: bad execute magic 0x%llx",
+            (unsigned long long)req.magic);
+    if (req.prog_words * 8 > kInShmemSize)
+      failf("executor: program too large");
+    memset(g_out, 0, sizeof(OutHeader));
+    ExecuteRep rep{kExecuteRepMagic, 0, 0};
+    execute_program(req, &rep, &pool);
+    write_exact(1, &rep, sizeof(rep));
+  }
+}
+
+}  // namespace tz
+
+int main(int argc, char** argv) { return tz::executor_main(argc, argv); }

@@ -1,0 +1,177 @@
+// Simulated kernel: a deterministic in-process "OS" the executor can
+// run programs against with no kernel, no VM and no risk.  It is the
+// executable counterpart of the hermetic fake `test` target — where
+// the reference validates its executor against a real kernel only
+// (reference: executor runs syscalls for real; sys/test exists only on
+// the Go side), the TPU build makes the whole execution stack testable
+// end-to-end by giving the executor a fake kernel with *real fuzzing
+// gradients*:
+//
+//   * coverage: each call deterministically yields edge PCs derived
+//     from (call_id, coarse arg buckets), so novel argument shapes
+//     discover novel edges;
+//   * dataflow: values previously returned by calls become "live
+//     handles"; passing one back yields bonus edges — rewarding
+//     resource-correct programs the way real fd reuse does;
+//   * comparisons: every arg is "compared" against per-call magic
+//     constants, emitted as CMP records; matching a magic unlocks
+//     extra edges — giving MutateWithHints a real signal to climb;
+//   * crashes: a two-stage magic sequence triggers a synthetic oops on
+//     stderr and abort — exercising crash detection, dedup and repro;
+//   * fault injection: the nth simulated allocation fails when armed.
+
+#ifndef TZ_EXECUTOR_SIM_KERNEL_H
+#define TZ_EXECUTOR_SIM_KERNEL_H
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <set>
+
+namespace tz {
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+// Coarse value bucket: collapses the argument space so coverage is a
+// function of value *shape*, not exact value (log2 magnitude + low
+// bits), mirroring how kernel branches discriminate sizes/flags.
+inline uint32_t value_bucket(uint64_t v) {
+  uint32_t log2 = 0;
+  while (log2 < 63 && (v >> (log2 + 1))) log2++;
+  return (log2 << 4) | (uint32_t)(v & 0xf);
+}
+
+struct SimCmp {
+  uint64_t op1, op2;
+};
+
+struct SimResult {
+  uint32_t errno_;
+  uint64_t ret;
+  bool fault_injected;
+  bool crashed;
+};
+
+class SimKernel {
+ public:
+  explicit SimKernel(uint64_t pid) : pid_(pid) {}
+
+  // Arm fault injection: the nth allocation from now fails.
+  void arm_fault(uint64_t nth) {
+    fault_armed_ = true;
+    fault_left_ = nth;
+  }
+  bool fault_fired() const { return fault_armed_ && fault_left_ == 0; }
+
+  // Execute one call. Appends edge PCs to cov (up to cov_max) and CMP
+  // records to cmps (up to cmps_max); returns result.
+  SimResult exec(uint32_t call_id, const uint64_t* args, int nargs,
+                 uint32_t* cov, int cov_max, int* cov_len, SimCmp* cmps,
+                 int cmps_max, int* cmps_len) {
+    SimResult res{};
+    *cov_len = 0;
+    *cmps_len = 0;
+    uint64_t h = splitmix64(call_id * 0x10001ull + 1);
+
+    auto emit = [&](uint64_t seed) {
+      if (*cov_len < cov_max) cov[(*cov_len)++] = (uint32_t)splitmix64(seed);
+    };
+
+    // entry edge: every call has one
+    emit(h);
+
+    int magic_hits = 0;
+    int handle_hits = 0;
+    for (int i = 0; i < nargs; i++) {
+      uint64_t a = args[i];
+      // branch on the coarse shape of the argument
+      emit(h ^ splitmix64((uint64_t)i << 32 | value_bucket(a)));
+      // the "kernel" compares the arg against a per-(call,arg) magic
+      uint64_t magic = splitmix64(h + 0x1111 * (i + 1)) & 0xffffffffull;
+      if (*cmps_len < cmps_max) cmps[(*cmps_len)++] = SimCmp{a, magic};
+      if (a == magic) {
+        magic_hits++;
+        // unlocked path: edges others can't reach without the magic
+        emit(h ^ splitmix64(0xabcd0000ull + i));
+        emit(h ^ splitmix64(0xabcd1000ull + i + (magic & 0xff)));
+      }
+      if (handles_.count(a)) {
+        handle_hits++;
+        emit(h ^ splitmix64(0xfeed0000ull + i));  // valid-handle path
+      }
+    }
+
+    // deeper state-dependent paths when dataflow is right
+    if (handle_hits >= 2) emit(h ^ 0x10);
+    if (handle_hits >= 1 && magic_hits >= 1) emit(h ^ 0x11);
+
+    // simulated allocations: 1-3 per call; honored fault injection
+    int allocs = 1 + (int)(h % 3);
+    for (int i = 0; i < allocs; i++) {
+      if (fault_armed_) {
+        if (fault_left_ == 0) {
+          fault_armed_ = false;
+          res.fault_injected = true;
+          res.errno_ = 12;  // ENOMEM
+          return res;
+        }
+        fault_left_--;
+      }
+    }
+
+    // two-stage crash trigger: arg0 and arg1 must both hit dedicated
+    // crash magics on a "crashy" call (1 in 8 call ids)
+    if ((h & 7) == 3 && nargs >= 2) {
+      uint64_t c0 = splitmix64(h ^ 0xc0de0000ull) & 0xffffffffull;
+      uint64_t c1 = splitmix64(h ^ 0xc0de0001ull) & 0xffffffffull;
+      if (*cmps_len < cmps_max) cmps[(*cmps_len)++] = SimCmp{args[0], c0};
+      if (args[0] == c0) {
+        emit(h ^ 0xdead0);
+        if (*cmps_len < cmps_max) cmps[(*cmps_len)++] = SimCmp{args[1], c1};
+        if (args[1] == c1) {
+          fprintf(stderr,
+                  "BUG: sim-kernel: use-after-free in sim_call_%u\n"
+                  "Call Trace:\n sim_call_%u+0x%llx\n sim_dispatch+0x11\n",
+                  call_id, call_id, (unsigned long long)(h & 0xfff));
+          fflush(stderr);
+          res.crashed = true;
+          return res;
+        }
+      }
+    }
+
+    // "ctor" calls (1 in 4) return a new live handle on success
+    if ((h & 3) == 1) {
+      uint64_t handle = 0x1000 + (handles_.size() * 4 + pid_) % 0xfffff;
+      handles_.insert(handle);
+      res.ret = handle;
+      res.errno_ = 0;
+    } else {
+      // calls that want handles fail without them (EBADF-ish)
+      bool wants_handle = (h & 3) == 2 && nargs > 0;
+      if (wants_handle && handle_hits == 0) {
+        res.errno_ = 9;  // EBADF
+      } else {
+        res.errno_ = 0;
+        res.ret = 0;
+      }
+    }
+    return res;
+  }
+
+ private:
+  uint64_t pid_;
+  std::set<uint64_t> handles_;
+  bool fault_armed_ = false;
+  uint64_t fault_left_ = 0;
+};
+
+}  // namespace tz
+
+#endif  // TZ_EXECUTOR_SIM_KERNEL_H

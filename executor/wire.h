@@ -1,0 +1,141 @@
+// Wire formats shared between the executor and the host IPC layer.
+//
+// Two protocols meet here:
+//  1. the exec program stream (uint64 words) produced by
+//     syzkaller_tpu/models/encodingexec.py (and by the TPU engine's
+//     batched emitter) — constants must match that file exactly;
+//  2. the control protocol over the command pipes + the result layout
+//     in the output shmem, parsed by syzkaller_tpu/ipc/env.py.
+//
+// Design follows the role of the reference executor protocol
+// (reference: executor/executor.h:117-144, prog/encodingexec.go:7-51)
+// but is a fresh layout: fixed little-endian structs, no gob/go types.
+
+#ifndef TZ_EXECUTOR_WIRE_H
+#define TZ_EXECUTOR_WIRE_H
+
+#include <stdint.h>
+
+namespace tz {
+
+// ---- exec program stream (encodingexec.py contract) ----------------
+
+constexpr uint64_t kMask64 = ~0ull;
+constexpr uint64_t kInstrEOF = kMask64;
+constexpr uint64_t kInstrCopyin = kMask64 - 1;
+constexpr uint64_t kInstrCopyout = kMask64 - 2;
+
+constexpr uint64_t kArgConst = 0;
+constexpr uint64_t kArgResult = 1;
+constexpr uint64_t kArgData = 2;
+constexpr uint64_t kArgCsum = 3;
+
+constexpr uint64_t kCsumInet = 0;
+constexpr uint64_t kCsumChunkData = 0;
+constexpr uint64_t kCsumChunkConst = 1;
+
+constexpr uint64_t kNoCopyout = kMask64;
+
+// const-arg meta word: size | be<<8 | bf_off<<16 | bf_len<<24 |
+// pid_stride<<32
+inline uint64_t meta_size(uint64_t m) { return m & 0xff; }
+inline bool meta_be(uint64_t m) { return (m >> 8) & 1; }
+inline uint64_t meta_bf_off(uint64_t m) { return (m >> 16) & 0xff; }
+inline uint64_t meta_bf_len(uint64_t m) { return (m >> 24) & 0xff; }
+inline uint64_t meta_pid_stride(uint64_t m) { return m >> 32; }
+
+// ---- limits (reference: executor/executor.h:25-28, ipc.go:54-55) ----
+
+constexpr uint64_t kInShmemSize = 2 << 20;    // program stream
+constexpr uint64_t kOutShmemSize = 16 << 20;  // results
+constexpr int kMaxCalls = 64;
+constexpr int kMaxThreads = 16;
+constexpr int kMaxCopyout = 256;
+constexpr int kMaxCommands = 4096;
+
+// ---- control protocol (pipes) ---------------------------------------
+
+constexpr uint64_t kHandshakeReqMagic = 0x745a6878616e6401ull;  // 'tZhxand1'
+constexpr uint64_t kHandshakeRepMagic = 0x745a6878616e6402ull;
+constexpr uint64_t kExecuteReqMagic = 0x745a65786563710aull;
+constexpr uint64_t kExecuteRepMagic = 0x745a65786563720bull;
+
+// env flags (per-process, set at handshake;
+// host side: syzkaller_tpu/ipc/env.py EnvFlags)
+constexpr uint64_t kEnvDebug = 1 << 0;
+constexpr uint64_t kEnvSignal = 1 << 1;     // collect edge signal
+constexpr uint64_t kEnvSandboxNone = 1 << 2;
+constexpr uint64_t kEnvSandboxSetuid = 1 << 3;
+constexpr uint64_t kEnvSandboxNamespace = 1 << 4;
+constexpr uint64_t kEnvSimOS = 1 << 5;      // simulated kernel backend
+constexpr uint64_t kEnvOptionalCover = 1 << 6;
+
+// exec flags (per-request)
+constexpr uint64_t kExecCollectCover = 1 << 0;
+constexpr uint64_t kExecDedupCover = 1 << 1;
+constexpr uint64_t kExecCollectComps = 1 << 2;
+constexpr uint64_t kExecThreaded = 1 << 3;
+constexpr uint64_t kExecCollide = 1 << 4;
+constexpr uint64_t kExecFault = 1 << 5;
+
+struct HandshakeReq {
+  uint64_t magic;
+  uint64_t env_flags;
+  uint64_t pid;  // proc index: drives ProcType value striding
+};
+
+struct HandshakeRep {
+  uint64_t magic;
+};
+
+struct ExecuteReq {
+  uint64_t magic;
+  uint64_t exec_flags;
+  uint64_t prog_words;  // number of uint64 words in the in-shmem
+  uint64_t fault_call;  // call index for fault injection, -1 = none
+  uint64_t fault_nth;   // fail the nth "allocation" within that call
+};
+
+struct ExecuteRep {
+  uint64_t magic;
+  uint64_t status;  // 0 ok; nonzero = executor-detected failure
+  uint64_t ncalls;  // completed calls written to out shmem
+};
+
+// magic exit statuses recognized by the host
+// (reference: pkg/ipc/ipc.go:57-59)
+constexpr int kStatusFail = 67;   // executor-level failure, retriable
+constexpr int kStatusError = 68;  // program-level error
+constexpr int kStatusRetry = 69;  // transient, respawn
+
+// ---- output shmem layout --------------------------------------------
+//
+//   OutHeader { ncalls }
+//   per call: CallResult header followed by
+//     uint32 signal[signal_len]; uint32 cover[cover_len];
+//     uint64 comps[2*comps_len]  (op1, op2 pairs)
+
+struct OutHeader {
+  uint32_t ncalls;
+  uint32_t completed;  // all calls ran (no hang/short-circuit)
+};
+
+constexpr uint32_t kCallFlagExecuted = 1 << 0;
+constexpr uint32_t kCallFlagFinished = 1 << 1;
+constexpr uint32_t kCallFlagBlocked = 1 << 2;
+constexpr uint32_t kCallFlagFaultInjected = 1 << 3;
+
+struct CallResult {
+  uint32_t call_index;  // position in the program
+  uint32_t call_id;     // syscall table id
+  uint32_t errno_;
+  uint32_t flags;
+  uint32_t signal_len;
+  uint32_t cover_len;
+  uint32_t comps_len;
+  uint32_t reserved;
+};
+
+}  // namespace tz
+
+#endif  // TZ_EXECUTOR_WIRE_H

@@ -1,0 +1,22 @@
+"""Executor IPC: spawning and driving native tz-executor processes.
+
+Mirrors the role of the reference pkg/ipc (reference: pkg/ipc/ipc.go):
+mem-mapped in/out files, fork-server handshake over pipes, per-program
+execute requests, output shmem parsing into per-call results, magic
+exit statuses, and the Gate concurrency window.
+"""
+
+from syzkaller_tpu.ipc.env import (  # noqa: F401
+    CallFlags,
+    CallInfo,
+    Env,
+    EnvFlags,
+    ExecFlags,
+    ExecOpts,
+    ExecResult,
+    ExecutorCrash,
+    ExecutorFailure,
+    build_executor,
+    make_env,
+)
+from syzkaller_tpu.ipc.gate import Gate  # noqa: F401

@@ -1,0 +1,325 @@
+"""Executor environment: process + shmem lifecycle and the exec loop.
+
+Host side of the control protocol defined in executor/wire.h.  One Env
+per proc (fork-server model): two mem-mapped files (2 MB program in,
+16 MB results out — reference: pkg/ipc/ipc.go:54-55,195-214), pipes
+for the control words, handshake carrying env flags + proc id, then
+one ExecuteReq/ExecuteRep round per program (reference:
+pkg/ipc/ipc.go:280-330,656-840).
+
+The executor's stderr is captured to a rolling "console" file; when
+the process dies mid-exec the accumulated stderr is surfaced as the
+crash log (the moral equivalent of the VM console output scanned by
+vm.MonitorExecution).
+"""
+
+from __future__ import annotations
+
+import enum
+import mmap
+import os
+import struct
+import subprocess
+import tempfile
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+EXECUTOR_DIR = REPO_ROOT / "executor"
+EXECUTOR_BIN = EXECUTOR_DIR / "tz-executor"
+
+IN_SHMEM_SIZE = 2 << 20
+OUT_SHMEM_SIZE = 16 << 20
+
+HANDSHAKE_REQ_MAGIC = 0x745A6878616E6401
+HANDSHAKE_REP_MAGIC = 0x745A6878616E6402
+EXECUTE_REQ_MAGIC = 0x745A65786563710A
+EXECUTE_REP_MAGIC = 0x745A65786563720B
+
+STATUS_FAIL = 67
+STATUS_ERROR = 68
+STATUS_RETRY = 69
+
+
+class EnvFlags(enum.IntFlag):
+    DEBUG = 1 << 0
+    SIGNAL = 1 << 1
+    SANDBOX_NONE = 1 << 2
+    SANDBOX_SETUID = 1 << 3
+    SANDBOX_NAMESPACE = 1 << 4
+    SIM_OS = 1 << 5
+    OPTIONAL_COVER = 1 << 6
+
+
+class ExecFlags(enum.IntFlag):
+    COLLECT_COVER = 1 << 0
+    DEDUP_COVER = 1 << 1
+    COLLECT_COMPS = 1 << 2
+    THREADED = 1 << 3
+    COLLIDE = 1 << 4
+    FAULT = 1 << 5
+
+
+class CallFlags(enum.IntFlag):
+    EXECUTED = 1 << 0
+    FINISHED = 1 << 1
+    BLOCKED = 1 << 2
+    FAULT_INJECTED = 1 << 3
+
+
+@dataclass
+class ExecOpts:
+    flags: ExecFlags = ExecFlags(0)
+    fault_call: int = -1
+    fault_nth: int = 0
+
+
+@dataclass
+class CallInfo:
+    call_index: int
+    call_id: int
+    errno: int
+    flags: CallFlags
+    signal: np.ndarray  # uint32
+    cover: np.ndarray  # uint32
+    comps: list[tuple[int, int]] = field(default_factory=list)
+
+
+@dataclass
+class ExecResult:
+    info: list[CallInfo]
+    completed: bool
+    hanged: bool = False
+
+
+class ExecutorFailure(Exception):
+    """Executor-level failure (status 67/68): respawn and retry."""
+
+
+class ExecutorCrash(Exception):
+    """The (simulated or real) kernel crashed under this program; the
+    console log is attached."""
+
+    def __init__(self, log: str):
+        super().__init__("kernel crash")
+        self.log = log
+
+
+_CALL_RESULT = struct.Struct("<8I")
+_EXECUTE_REQ = struct.Struct("<5Q")
+_EXECUTE_REP = struct.Struct("<3Q")
+_HANDSHAKE_REQ = struct.Struct("<3Q")
+_HANDSHAKE_REP = struct.Struct("<Q")
+
+
+def build_executor(force: bool = False) -> Path:
+    """Build the native executor if needed; returns the binary path."""
+    if EXECUTOR_BIN.exists() and not force:
+        src_mtime = max(p.stat().st_mtime for p in EXECUTOR_DIR.glob("*.cc"))
+        hdr_mtime = max(p.stat().st_mtime for p in EXECUTOR_DIR.glob("*.h"))
+        if EXECUTOR_BIN.stat().st_mtime >= max(src_mtime, hdr_mtime):
+            return EXECUTOR_BIN
+    subprocess.run(["make", "-s"], cwd=EXECUTOR_DIR, check=True,
+                   capture_output=True)
+    return EXECUTOR_BIN
+
+
+class Env:
+    """One executor process + its shmem files (reference: ipc.go MakeEnv).
+
+    Respawn-on-failure: exec() transparently restarts a dead executor
+    up to `max_restarts` times before raising (reference:
+    syz-fuzzer/proc.go:269-277 retries, ipc.go:307-313 respawn).
+    """
+
+    def __init__(self, pid: int, env_flags: EnvFlags,
+                 workdir: Optional[str] = None, executor: Optional[Path] = None,
+                 timeout_s: float = 60.0):
+        self.pid = pid
+        self.env_flags = env_flags
+        self.timeout_s = timeout_s
+        self.executor = Path(executor) if executor else build_executor()
+        self._tmp = tempfile.TemporaryDirectory(
+            prefix=f"tz-ipc-{pid}-", dir=workdir)
+        d = Path(self._tmp.name)
+        self.in_path = d / "in"
+        self.out_path = d / "out"
+        self.err_path = d / "console"
+        self.in_path.write_bytes(b"\x00" * IN_SHMEM_SIZE)
+        self.out_path.write_bytes(b"\x00" * OUT_SHMEM_SIZE)
+        self._in_file = open(self.in_path, "r+b")
+        self._out_file = open(self.out_path, "r+b")
+        self._in_mm = mmap.mmap(self._in_file.fileno(), IN_SHMEM_SIZE)
+        self._out_mm = mmap.mmap(self._out_file.fileno(), OUT_SHMEM_SIZE)
+        self._proc: Optional[subprocess.Popen] = None
+        self._err_file = None
+        self.stat_execs = 0
+        self.stat_restarts = 0
+
+    # -- process lifecycle ------------------------------------------------
+
+    def _spawn(self) -> None:
+        self.close_proc()
+        self._err_file = open(self.err_path, "wb")
+        # bufsize=0: replies are read both via the file object (during
+        # handshake) and via select+os.read on the raw fd (exec loop);
+        # buffering would strand bytes invisible to select.
+        self._proc = subprocess.Popen(
+            [str(self.executor), str(self.in_path), str(self.out_path)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=self._err_file, bufsize=0)
+        req = _HANDSHAKE_REQ.pack(HANDSHAKE_REQ_MAGIC, int(self.env_flags),
+                                  self.pid)
+        try:
+            self._proc.stdin.write(req)
+            self._proc.stdin.flush()
+            rep = self._read_exact(_HANDSHAKE_REP.size)
+        except (BrokenPipeError, ExecutorFailure):
+            raise ExecutorFailure(
+                f"executor handshake failed: {self.console_tail()}")
+        (magic,) = _HANDSHAKE_REP.unpack(rep)
+        if magic != HANDSHAKE_REP_MAGIC:
+            raise ExecutorFailure(f"bad handshake reply {magic:#x}")
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._proc.stdout.read(n - len(buf))
+            if not chunk:
+                raise ExecutorFailure("executor pipe closed")
+            buf += chunk
+        return buf
+
+    def close_proc(self) -> None:
+        if self._proc is not None:
+            self._proc.kill()
+            self._proc.wait()
+            self._proc = None
+        if self._err_file is not None:
+            self._err_file.close()
+            self._err_file = None
+
+    def close(self) -> None:
+        self.close_proc()
+        self._in_mm.close()
+        self._out_mm.close()
+        self._in_file.close()
+        self._out_file.close()
+        self._tmp.cleanup()
+
+    def console_tail(self, nbytes: int = 1 << 16) -> str:
+        try:
+            data = self.err_path.read_bytes()
+        except FileNotFoundError:
+            return ""
+        return data[-nbytes:].decode("utf-8", "replace")
+
+    # -- execution --------------------------------------------------------
+
+    def exec(self, opts: ExecOpts, prog_data: bytes,
+             max_restarts: int = 3) -> ExecResult:
+        """Execute one serialized program (exec wire format bytes)."""
+        if len(prog_data) > IN_SHMEM_SIZE:
+            raise ValueError("program exceeds exec buffer")
+        last_exc: Optional[Exception] = None
+        for _ in range(max_restarts + 1):
+            try:
+                if self._proc is None or self._proc.poll() is not None:
+                    self._spawn()
+                    self.stat_restarts += 1
+                return self._exec_once(opts, prog_data)
+            except ExecutorCrash:
+                raise
+            except ExecutorFailure as e:
+                last_exc = e
+                self.close_proc()
+        raise last_exc  # type: ignore[misc]
+
+    def _exec_once(self, opts: ExecOpts, prog_data: bytes) -> ExecResult:
+        self._in_mm.seek(0)
+        self._in_mm.write(prog_data)
+        self.stat_execs += 1
+        req = _EXECUTE_REQ.pack(
+            EXECUTE_REQ_MAGIC, int(opts.flags), len(prog_data) // 8,
+            opts.fault_call & 0xFFFFFFFFFFFFFFFF, opts.fault_nth)
+        try:
+            self._proc.stdin.write(req)
+            self._proc.stdin.flush()
+        except BrokenPipeError:
+            self._raise_dead()
+        deadline = time.monotonic() + self.timeout_s
+        rep = self._read_reply(deadline)
+        magic, status, ncalls = _EXECUTE_REP.unpack(rep)
+        if magic != EXECUTE_REP_MAGIC:
+            raise ExecutorFailure(f"bad execute reply magic {magic:#x}")
+        if status != 0:
+            raise ExecutorFailure(f"executor status {status}")
+        return self._parse_output()
+
+    def _read_reply(self, deadline: float) -> bytes:
+        # The executor enforces per-call timeouts itself, so a silent
+        # executor means death or a wedge; select() keeps the deadline
+        # enforceable either way (reference: ipc.go:760-812 hang logic).
+        import select
+
+        fd = self._proc.stdout.fileno()
+        buf = b""
+        while len(buf) < _EXECUTE_REP.size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ExecutorFailure("executor timed out")
+            ready, _, _ = select.select([fd], [], [], min(remaining, 1.0))
+            if not ready:
+                if self._proc.poll() is not None:
+                    self._raise_dead()
+                continue
+            chunk = os.read(fd, _EXECUTE_REP.size - len(buf))
+            if not chunk:
+                self._raise_dead()
+            buf += chunk
+        return buf
+
+    def _raise_dead(self):
+        code = self._proc.poll()
+        log = self.console_tail()
+        if "BUG:" in log or "WARNING:" in log or code == STATUS_ERROR:
+            raise ExecutorCrash(log)
+        raise ExecutorFailure(f"executor died (status {code}): {log[-500:]}")
+
+    def _parse_output(self) -> ExecResult:
+        mm = self._out_mm
+        ncalls, completed = struct.unpack_from("<2I", mm, 0)
+        off = 8
+        infos: list[CallInfo] = []
+        for _ in range(ncalls):
+            (ci, cid, err, flags, slen, covlen, compslen, _r) = \
+                _CALL_RESULT.unpack_from(mm, off)
+            off += _CALL_RESULT.size
+            signal = np.frombuffer(mm, np.uint32, slen, off).copy()
+            off += 4 * slen
+            cover = np.frombuffer(mm, np.uint32, covlen, off).copy()
+            off += 4 * covlen
+            comps_arr = np.frombuffer(mm, np.uint64, 2 * compslen, off)
+            off += 16 * compslen
+            comps = [(int(comps_arr[2 * i]), int(comps_arr[2 * i + 1]))
+                     for i in range(compslen)]
+            infos.append(CallInfo(call_index=ci, call_id=cid, errno=err,
+                                  flags=CallFlags(flags), signal=signal,
+                                  cover=cover, comps=comps))
+        return ExecResult(info=infos, completed=bool(completed))
+
+
+def make_env(pid: int = 0, sim: bool = True, signal: bool = True,
+             debug: bool = False, **kw) -> Env:
+    flags = EnvFlags.SANDBOX_NONE
+    if sim:
+        flags |= EnvFlags.SIM_OS
+    if signal:
+        flags |= EnvFlags.SIGNAL
+    if debug:
+        flags |= EnvFlags.DEBUG
+    return Env(pid, flags, **kw)

@@ -1,0 +1,63 @@
+"""Gate: sliding-window concurrency limiter with a periodic
+stop-the-world callback.
+
+Semantics follow the reference (reference: pkg/ipc/gate.go:23-76): at
+most `capacity` callers are inside the gate; every full window the
+gate drains and runs `stop_cb` alone (used for kmemleak-style scans
+that need the machine quiet).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class Gate:
+    def __init__(self, capacity: int,
+                 stop_cb: Optional[Callable[[], None]] = None):
+        assert capacity > 0
+        self.capacity = capacity
+        self.stop_cb = stop_cb
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._inside = 0
+        self._since_stop = 0
+        self._stopping = False
+
+    def enter(self) -> None:
+        with self._cv:
+            while self._stopping or self._inside >= self.capacity:
+                self._cv.wait()
+            self._inside += 1
+
+    def leave(self) -> None:
+        run_stop = False
+        with self._cv:
+            assert self._inside > 0
+            self._inside -= 1
+            self._since_stop += 1
+            if self.stop_cb is not None and \
+                    self._since_stop >= self.capacity and not self._stopping:
+                self._stopping = True
+                run_stop = True
+            self._cv.notify_all()
+        if run_stop:
+            with self._cv:
+                while self._inside > 0:
+                    self._cv.wait()
+            try:
+                self.stop_cb()
+            finally:
+                with self._cv:
+                    self._stopping = False
+                    self._since_stop = 0
+                    self._cv.notify_all()
+
+    def __enter__(self):
+        self.enter()
+        return self
+
+    def __exit__(self, *exc):
+        self.leave()
+        return False
