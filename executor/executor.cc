@@ -270,14 +270,25 @@ class Worker {
                              [this] { return !busy_.load(); });
   }
 
-  // Wait for completion; on timeout marks the job abandoned (the
-  // worker frees it at completion and the caller must not touch it
-  // again) and returns false.
-  bool wait_or_abandon(int timeout_ms) {
+  // Wait for completion; returns false on timeout, after which the
+  // job is no longer the caller's: if it was still queued it is
+  // dequeued and freed here, if it is running the worker frees it at
+  // completion.  The caller must not touch the job after false.
+  bool wait_or_abandon(int timeout_ms, CallJob* job) {
     std::unique_lock<std::mutex> lk(mu_);
     bool done = done_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
                                   [this] { return !busy_.load(); });
-    if (!done && cur_ != nullptr) cur_->abandoned = true;
+    if (!done) {
+      if (job_ == job) {
+        // never picked up: dequeue so the worker can't run it later
+        job_ = nullptr;
+        busy_.store(false);
+        done_cv_.notify_all();
+        delete job;
+      } else if (cur_ != nullptr) {
+        cur_->abandoned = true;
+      }
+    }
     return done;
   }
 
@@ -492,6 +503,10 @@ struct PendingCall {
                  // which case it is replaced by a blocked stub
   Worker* worker;
   uint64_t copyout_idx;  // of ret; kNoCopyout if none
+  // Copies of the job's identity: after an abandon the job pointer
+  // must not be dereferenced (the worker may free it concurrently).
+  uint32_t call_index;
+  uint32_t call_id;
   std::vector<std::array<uint64_t, 3>> copyouts;  // idx, addr, size
 };
 
@@ -508,13 +523,13 @@ static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
 
   auto finish_call = [&](PendingCall& pc) {
     if (pc.worker != nullptr) {
-      bool done = pc.worker->wait_or_abandon(g_call_timeout_ms);
+      bool done = pc.worker->wait_or_abandon(g_call_timeout_ms, pc.job);
       if (!done) {
-        // the worker now owns (and will free) the original job;
+        // the job is gone (freed by the worker or the dequeue);
         // report the call through a stub
         auto* stub = new CallJob{};
-        stub->call_index = pc.job->call_index;
-        stub->call_id = pc.job->call_id;
+        stub->call_index = pc.call_index;
+        stub->call_id = pc.call_id;
         stub->flags = kCallFlagBlocked;
         pc.job = stub;
         pc.worker = nullptr;
@@ -554,6 +569,8 @@ static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
       uint64_t size = in.next();
       if (idx >= kMaxCopyout) failf("executor: copyout idx %llu",
                                     (unsigned long long)idx);
+      if (size == 0 || size > 8) failf("executor: copyout size %llu",
+                                       (unsigned long long)size);
       if (calls.empty()) failf("executor: copyout before any call");
       calls.back().copyouts.push_back({idx, addr, size});
       // in sequential mode the call already completed; re-finish to
@@ -575,8 +592,10 @@ static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
     job->nargs = (int)nargs;
 
     // fault injection arms the sim allocator before the chosen call
-    if ((req.exec_flags & kExecFault) && req.fault_call == calls.size())
+    if ((req.exec_flags & kExecFault) && req.fault_call == calls.size()) {
+      std::lock_guard<std::mutex> lk(pool->sim_mu);
       pool->sim->arm_fault(req.fault_nth);
+    }
 
     Worker* worker = pool->get();
     if (worker == nullptr) {
@@ -587,7 +606,8 @@ static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
       if (worker == nullptr) failf("executor: no free workers");
     }
     worker->submit(job);
-    calls.push_back(PendingCall{job, worker, copyout_idx, {}});
+    calls.push_back(PendingCall{job, worker, copyout_idx,
+                                job->call_index, job->call_id, {}});
     if (!threaded) finish_call(calls.back());
   }
   for (auto& pc : calls) finish_call(pc);
@@ -605,9 +625,9 @@ static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
     for (size_t i = 0; i + 1 < calls.size(); i += 2) {
       auto a = reissue(calls[i].job);
       auto b = reissue(calls[i + 1].job);
-      if (a.first && a.first->wait_or_abandon(g_call_timeout_ms))
+      if (a.first && a.first->wait_or_abandon(g_call_timeout_ms, a.second))
         delete a.second;
-      if (b.first && b.first->wait_or_abandon(g_call_timeout_ms))
+      if (b.first && b.first->wait_or_abandon(g_call_timeout_ms, b.second))
         delete b.second;
     }
   }
@@ -654,6 +674,12 @@ static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
   rep->ncalls = written;
   rep->status = 0;
   for (auto& pc : calls) delete pc.job;  // stubs or completed jobs
+  {
+    // Don't leak an unfired fault onward; abandoned jobs may still be
+    // in sim->exec, so take the sim lock.
+    std::lock_guard<std::mutex> lk(pool->sim_mu);
+    pool->sim->disarm_fault();
+  }
 }
 
 // ---- sandbox ---------------------------------------------------------

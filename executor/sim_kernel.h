@@ -62,12 +62,17 @@ class SimKernel {
  public:
   explicit SimKernel(uint64_t pid) : pid_(pid) {}
 
-  // Arm fault injection: the nth allocation from now fails.
+  // Arm fault injection: the nth (1-based) allocation from now fails.
   void arm_fault(uint64_t nth) {
     fault_armed_ = true;
     fault_left_ = nth;
   }
-  bool fault_fired() const { return fault_armed_ && fault_left_ == 0; }
+  // Called between programs so an armed-but-unfired fault (nth beyond
+  // the call's allocation count) cannot leak into unrelated calls.
+  void disarm_fault() {
+    fault_armed_ = false;
+    fault_left_ = 0;
+  }
 
   // Execute one call. Appends edge PCs to cov (up to cov_max) and CMP
   // records to cmps (up to cmps_max); returns result.
@@ -115,13 +120,13 @@ class SimKernel {
     int allocs = 1 + (int)(h % 3);
     for (int i = 0; i < allocs; i++) {
       if (fault_armed_) {
+        fault_left_--;
         if (fault_left_ == 0) {
           fault_armed_ = false;
           res.fault_injected = true;
           res.errno_ = 12;  // ENOMEM
           return res;
         }
-        fault_left_--;
       }
     }
 

@@ -1,0 +1,22 @@
+"""Guest-side fuzzing driver (reference: syz-fuzzer/).
+
+The Fuzzer owns shared state (corpus, signal sets, choice table), Procs
+run the per-worker loop against executor Envs, and the WorkQueue
+prioritizes triage/candidate/smash work items.  The TPU twist: procs
+can draw mutants from a shared BatchMutator backed by the device
+engine instead of mutating one program at a time.
+"""
+
+from syzkaller_tpu.fuzzer.workqueue import (
+    WorkQueue,
+    WorkTriage,
+    WorkCandidate,
+    WorkSmash,
+)
+from syzkaller_tpu.fuzzer.fuzzer import Fuzzer, FuzzerConfig, signal_prio
+from syzkaller_tpu.fuzzer.proc import Proc
+
+__all__ = [
+    "WorkQueue", "WorkTriage", "WorkCandidate", "WorkSmash",
+    "Fuzzer", "FuzzerConfig", "signal_prio", "Proc",
+]

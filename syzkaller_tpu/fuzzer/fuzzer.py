@@ -1,0 +1,208 @@
+"""Shared fuzzer state: corpus, signal sets, stats, manager link.
+
+Reference: syz-fuzzer/fuzzer.go:31-95 (Fuzzer struct + stats),
+424-521 (corpus/signal bookkeeping).  The manager connection is
+optional — with conn=None the fuzzer runs standalone (the syz-stress
+form factor) and keeps everything local.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Callable, Optional
+
+from syzkaller_tpu.models.any_squash import call_contains_any
+from syzkaller_tpu.models.encoding import serialize_prog
+from syzkaller_tpu.models.prio import ChoiceTable, build_choice_table
+from syzkaller_tpu.models.prog import Prog
+from syzkaller_tpu.signal import Signal
+from syzkaller_tpu.signal.cover import Cover
+from syzkaller_tpu.utils.hashsig import hash_string
+from syzkaller_tpu.utils import log
+
+
+class Stat(IntEnum):
+    """Per-fuzzer counters synced to the manager on every poll
+    (reference: syz-fuzzer/fuzzer.go:63-86)."""
+    GENERATE = 0
+    FUZZ = 1
+    CANDIDATE = 2
+    TRIAGE = 3
+    MINIMIZE = 4
+    SMASH = 5
+    HINT = 6
+    SEED = 7
+    EXEC_TOTAL = 8
+    EXECUTOR_RESTARTS = 9
+    CRASHES = 10
+
+
+STAT_NAMES = {
+    Stat.GENERATE: "exec gen",
+    Stat.FUZZ: "exec fuzz",
+    Stat.CANDIDATE: "exec candidate",
+    Stat.TRIAGE: "exec triage",
+    Stat.MINIMIZE: "exec minimize",
+    Stat.SMASH: "exec smash",
+    Stat.HINT: "exec hints",
+    Stat.SEED: "exec seeds",
+    Stat.EXEC_TOTAL: "exec total",
+    Stat.EXECUTOR_RESTARTS: "executor restarts",
+    Stat.CRASHES: "crashes",
+}
+
+
+def signal_prio(p: Prog, errno: int, call_index: int) -> int:
+    """Priority of an edge observed for call call_index: +2 if the call
+    succeeded, +1 if the call is a plain typed call (no squashed ANY
+    blob) (reference: syz-fuzzer/fuzzer.go:513-521)."""
+    prio = 0
+    if errno == 0:
+        prio |= 1 << 1
+    if not call_contains_any(p.target, p.calls[call_index]):
+        prio |= 1 << 0
+    return prio
+
+
+@dataclass
+class FuzzerConfig:
+    """Behavioral constants of the fuzz loop; defaults match the
+    reference (syz-fuzzer/proc.go:26,116,191-228)."""
+    program_length: int = 30
+    generate_period: int = 100  # 1-in-N iterations generates from scratch
+    triage_runs: int = 3  # signal deflake re-runs
+    minimize_attempts: int = 3  # re-runs per minimize step
+    smash_mutants: int = 100
+    fault_injection: bool = True
+    fault_nth_max: int = 100
+    collect_comps: bool = True  # hints (KCOV_TRACE_CMP equivalent)
+    leak_check: bool = False
+
+
+@dataclass
+class CorpusItem:
+    p: Prog
+    serialized: bytes
+    sig: str
+    signal: Signal
+    cover: Cover = field(default_factory=Cover)
+
+
+class Fuzzer:
+    """Shared state across procs (reference: fuzzer.go:31-61)."""
+
+    def __init__(self, target, wq, cfg: Optional[FuzzerConfig] = None,
+                 ct: Optional[ChoiceTable] = None, conn=None,
+                 on_crash: Optional[Callable[[str, Optional[Prog]], None]] = None):
+        from syzkaller_tpu.fuzzer.workqueue import WorkQueue
+
+        self.target = target
+        self.cfg = cfg or FuzzerConfig()
+        self.wq = wq if wq is not None else WorkQueue()
+        self.conn = conn  # manager RPC client (optional)
+        self.on_crash = on_crash
+        self._lock = threading.Lock()
+        self.corpus: list[CorpusItem] = []
+        self.corpus_hashes: set[str] = set()
+        self.corpus_signal = Signal()  # signal of corpus inputs
+        self.max_signal = Signal()  # everything ever seen (incl. manager)
+        self.new_signal = Signal()  # delta not yet reported to manager
+        self.ct = ct or build_choice_table(target)
+        self.stats = [0] * len(Stat)
+
+    # -- stats -----------------------------------------------------------
+
+    def stat_add(self, s: Stat, v: int = 1) -> None:
+        with self._lock:
+            self.stats[s] += v
+
+    def grab_stats(self) -> dict[str, int]:
+        """Drain counters for a manager poll (fuzzer.go:323-338)."""
+        with self._lock:
+            out = {STAT_NAMES[Stat(i)]: v
+                   for i, v in enumerate(self.stats) if v}
+            self.stats = [0] * len(Stat)
+        return out
+
+    # -- signal bookkeeping ----------------------------------------------
+
+    def check_new_signal(self, p: Prog, infos) -> list[tuple[int, Signal]]:
+        """Per-call novelty test against max_signal; returns calls with
+        new signal and updates max/new signal under one lock
+        (reference: fuzzer.go:494-511)."""
+        out = []
+        with self._lock:
+            for info in infos:
+                prio = signal_prio(p, info.errno, info.call_index)
+                diff = self.max_signal.diff_raw(info.signal, prio)
+                if diff.empty():
+                    continue
+                self.max_signal.merge(diff)
+                self.new_signal.merge(diff)
+                out.append((info.call_index, diff))
+        return out
+
+    def corpus_signal_diff(self, sig: Signal) -> Signal:
+        with self._lock:
+            return self.corpus_signal.diff(sig)
+
+    def grab_new_signal(self) -> Signal:
+        """Drain the unreported delta (fuzzer.go:468-480)."""
+        with self._lock:
+            sig, self.new_signal = self.new_signal, Signal()
+        return sig
+
+    def add_max_signal(self, sig: Signal) -> None:
+        """Merge manager-distributed max signal (fuzzer.go:482-486)."""
+        with self._lock:
+            self.max_signal.merge(sig)
+
+    # -- corpus ----------------------------------------------------------
+
+    def add_input_to_corpus(self, p: Prog, sig: Signal, cover: Cover,
+                            serialized: Optional[bytes] = None) -> Optional[CorpusItem]:
+        data = serialized if serialized is not None else serialize_prog(p)
+        key = hash_string(data)
+        with self._lock:
+            if key in self.corpus_hashes:
+                return None
+            item = CorpusItem(p=p, serialized=data, sig=key, signal=sig,
+                              cover=cover)
+            self.corpus.append(item)
+            self.corpus_hashes.add(key)
+            self.corpus_signal.merge(sig)
+        return item
+
+    def corpus_snapshot(self) -> list[CorpusItem]:
+        with self._lock:
+            return list(self.corpus)
+
+    def choose_corpus_prog(self, rng) -> Optional[Prog]:
+        with self._lock:
+            if not self.corpus:
+                return None
+            return self.corpus[rng.intn(len(self.corpus))].p
+
+    # -- manager integration ---------------------------------------------
+
+    def send_input_to_manager(self, item: CorpusItem, call_index: int) -> None:
+        """Report a triaged input (fuzzer.go:423-440); no-op standalone."""
+        if self.conn is None:
+            return
+        elems, prios = item.signal.serialize()
+        self.conn.call("Manager.NewInput", {
+            "name": getattr(self.conn, "name", "fuzzer"),
+            "prog": item.serialized.decode(),
+            "call_index": call_index,
+            "signal": [elems, prios],
+            "cover": item.cover.serialize(),
+        })
+
+    def record_crash(self, console_log: str, last_prog: Optional[Prog]) -> None:
+        self.stat_add(Stat.CRASHES)
+        log.logf(0, "kernel crash detected (%d bytes of console log)",
+                 len(console_log))
+        if self.on_crash is not None:
+            self.on_crash(console_log, last_prog)

@@ -1,0 +1,62 @@
+"""Host feature/syscall support detection (reference: pkg/host/).
+
+The reference probes the live kernel (test syscalls, /proc and /dev
+paths, KCOV/fault-injection sysfs knobs — pkg/host/host_linux.go:20-216).
+Here the "host" is the executor's backend: the simulated kernel
+supports every described call, while a real-OS backend restricts by
+syscall-number presence and probe hooks registered per target.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from syzkaller_tpu.models.target import Target
+
+# Per-(os) probe hooks: name -> fn(syscall) -> reason-or-None.
+_probes: dict[str, Callable] = {}
+
+
+def register_probe(os: str, fn: Callable) -> None:
+    _probes[os] = fn
+
+
+def detect_supported_syscalls(target: Target, sandbox: str = "none",
+                              enabled: Optional[set[int]] = None
+                              ) -> tuple[list, dict]:
+    """Returns (supported syscalls, {syscall: reason} for unsupported)
+    (reference: pkg/host/host.go:12-40)."""
+    supported = []
+    unsupported = {}
+    probe = _probes.get(target.os)
+    for c in target.syscalls:
+        if enabled is not None and c.id not in enabled:
+            continue
+        if c.nr < 0:
+            unsupported[c] = "no syscall number"
+            continue
+        if probe is not None:
+            reason = probe(c, sandbox)
+            if reason is not None:
+                unsupported[c] = reason
+                continue
+        supported.append(c)
+    return supported, unsupported
+
+
+def check_fault_injection() -> bool:
+    """Whether the backend supports fail-nth fault injection.  The sim
+    kernel always does (executor/sim_kernel.h fault arm); a real-linux
+    backend would stat /sys/kernel/debug/failslab
+    (reference: pkg/host/host_linux.go:216-240)."""
+    return True
+
+
+def enabled_calls(target: Target, supported: list,
+                  sandbox: str = "none") -> tuple[dict, dict]:
+    """Transitive closure over resource constructors: a call is enabled
+    only if every input resource is transitively creatable
+    (reference: syz-fuzzer/fuzzer.go:384-421 + prog/resources.go:88)."""
+    enabled_map = {c: True for c in supported}
+    enabled, disabled = target.transitively_enabled_calls(enabled_map)
+    return enabled, disabled

@@ -1,0 +1,292 @@
+"""Per-worker fuzzing loop (reference: syz-fuzzer/proc.go).
+
+Each Proc owns one executor Env (fork-server) and runs the weighted
+loop: dequeue prioritized work, else 1-in-N generate from scratch,
+else mutate a corpus program.  Mutants come either from the CPU
+mutator (reference semantics) or from a shared BatchMutator that
+drains pre-computed device batches — the feed/drain integration of
+the TPU engine (SURVEY.md §7 step 8).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from syzkaller_tpu.fuzzer.fuzzer import Fuzzer, Stat, signal_prio
+from syzkaller_tpu.fuzzer.workqueue import (
+    ProgTypes,
+    WorkCandidate,
+    WorkSmash,
+    WorkTriage,
+)
+from syzkaller_tpu.ipc.env import (
+    CallFlags,
+    Env,
+    ExecFlags,
+    ExecOpts,
+    ExecResult,
+    ExecutorCrash,
+    ExecutorFailure,
+)
+from syzkaller_tpu.models.encoding import serialize_prog
+from syzkaller_tpu.models.encodingexec import serialize_for_exec
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.hints import CompMap, mutate_with_hints
+from syzkaller_tpu.models.minimization import minimize
+from syzkaller_tpu.models.mutation import mutate_prog
+from syzkaller_tpu.models.prog import Prog
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.signal import Signal, from_raw
+from syzkaller_tpu.signal.cover import Cover
+from syzkaller_tpu.utils import log
+
+
+class BatchMutator:
+    """Feed/drain queue between procs and the device mutation engine.
+
+    Procs call next() for a single mutant; when the buffer runs dry the
+    calling proc refills it with one engine batch over a random corpus
+    sample.  Amortizes host⇄device transfer over batch_size mutants
+    while other procs keep their executors saturated (SURVEY.md §7
+    hard part (c))."""
+
+    def __init__(self, engine, batch_size: int = 64):
+        self.engine = engine
+        self.batch_size = batch_size
+        self._buf: list[Prog] = []
+        self._lock = threading.Lock()
+
+    def next(self, fuzzer: Fuzzer, rng: RandGen) -> Optional[Prog]:
+        with self._lock:
+            if self._buf:
+                return self._buf.pop()
+        corpus_items = fuzzer.corpus_snapshot()
+        if not corpus_items:
+            return None
+        templates = []
+        for _ in range(self.batch_size):
+            item = corpus_items[rng.intn(len(corpus_items))]
+            t = self.engine.encode(item.p)
+            if t is not None:
+                templates.append(t)
+        if not templates:
+            return None
+        mutants = self.engine.mutate(
+            templates, ct=fuzzer.ct, corpus=[it.p for it in corpus_items])
+        with self._lock:
+            self._buf.extend(m for m in mutants if m is not None)
+            if not self._buf:
+                return None
+            return self._buf.pop()
+
+
+class Proc:
+    """One worker: an Env + a seeded RNG + the loop
+    (reference: syz-fuzzer/proc.go:28-64)."""
+
+    def __init__(self, fuzzer: Fuzzer, pid: int, env: Env,
+                 rng: Optional[RandGen] = None,
+                 batch_mutator: Optional[BatchMutator] = None):
+        self.fuzzer = fuzzer
+        self.pid = pid
+        self.env = env
+        self.rng = rng or RandGen(fuzzer.target, pid * 1103515245 + 12345)
+        self.batch_mutator = batch_mutator
+        self.exec_opts = ExecOpts(flags=ExecFlags(0))
+        self.exec_opts_cover = ExecOpts(flags=ExecFlags.COLLECT_COVER
+                                        | ExecFlags.DEDUP_COVER)
+        self.exec_opts_comps = ExecOpts(flags=ExecFlags.COLLECT_COMPS)
+        self.last_prog: Optional[Prog] = None
+
+    # -- main loop --------------------------------------------------------
+
+    def loop(self, iterations: int = 1 << 62,
+             stop: Optional[threading.Event] = None) -> None:
+        """(reference: proc.go:66-98)"""
+        cfg = self.fuzzer.cfg
+        for i in range(iterations):
+            if stop is not None and stop.is_set():
+                return
+            item = self.fuzzer.wq.dequeue()
+            if item is not None:
+                if isinstance(item, WorkTriage):
+                    self.triage_input(item)
+                elif isinstance(item, WorkCandidate):
+                    self.execute(self.exec_opts, item.p, Stat.CANDIDATE,
+                                 flags=item.flags)
+                elif isinstance(item, WorkSmash):
+                    self.smash_input(item)
+                continue
+            corpus_empty = not self.fuzzer.corpus_snapshot()
+            if corpus_empty or self.rng.one_of(cfg.generate_period):
+                p = generate_prog(self.fuzzer.target, self.rng,
+                                  cfg.program_length, ct=self.fuzzer.ct)
+                self.execute(self.exec_opts, p, Stat.GENERATE)
+            else:
+                p = self._next_mutant()
+                if p is None:
+                    continue
+                self.execute(self.exec_opts, p, Stat.FUZZ)
+
+    def _next_mutant(self) -> Optional[Prog]:
+        if self.batch_mutator is not None:
+            p = self.batch_mutator.next(self.fuzzer, self.rng)
+            if p is not None:
+                return p
+        base = self.fuzzer.choose_corpus_prog(self.rng)
+        if base is None:
+            return None
+        p = base.clone()
+        mutate_prog(p, self.rng, self.fuzzer.cfg.program_length,
+                    ct=self.fuzzer.ct,
+                    corpus=[it.p for it in self.fuzzer.corpus_snapshot()])
+        return p
+
+    # -- triage ----------------------------------------------------------
+
+    def triage_input(self, item: WorkTriage) -> None:
+        """Deflake + minimize a new-signal find, land it in the corpus
+        (reference: proc.go:100-181)."""
+        cfg = self.fuzzer.cfg
+        call_index = item.call_index
+        input_signal = item.signal
+        new_signal = self.fuzzer.corpus_signal_diff(input_signal)
+        if new_signal.empty():
+            return
+        call_name = item.p.calls[call_index].meta.name
+        log.logf(3, "triaging %s (new signal %d)", call_name, len(new_signal))
+
+        # Compute the flakiness-stable subset over triage_runs re-runs
+        # (flake intersection, proc.go:120-140).
+        notexecuted = 0
+        input_cover = Cover()
+        stable = new_signal
+        for _ in range(cfg.triage_runs):
+            info = self.execute_raw(self.exec_opts_cover, item.p,
+                                    Stat.TRIAGE)
+            ci = _find_call(info, call_index)
+            if ci is None:
+                notexecuted += 1
+                if notexecuted > cfg.triage_runs / 2:
+                    return  # the call does not reproduce
+                continue
+            prio = signal_prio(item.p, ci.errno, call_index)
+            this_signal = from_raw(ci.signal, prio)
+            stable = stable.intersection(this_signal)
+            if stable.empty():
+                return
+            input_cover.merge(ci.cover)
+        input_signal = stable
+
+        if not item.flags.minimized:
+            def pred(p: Prog, ci_idx: int) -> bool:
+                for _ in range(cfg.minimize_attempts):
+                    info = self.execute_raw(self.exec_opts, p, Stat.MINIMIZE)
+                    ci = _find_call(info, ci_idx)
+                    if ci is None:
+                        continue
+                    prio = signal_prio(p, ci.errno, ci_idx)
+                    this_signal = from_raw(ci.signal, prio)
+                    if len(input_signal.intersection(this_signal)) \
+                            == len(input_signal):
+                        return True
+                return False
+
+            item.p, call_index = minimize(item.p, call_index, False, pred)
+
+        data = serialize_prog(item.p)
+        corpus_item = self.fuzzer.add_input_to_corpus(
+            item.p, input_signal, input_cover, serialized=data)
+        if corpus_item is not None:
+            self.fuzzer.send_input_to_manager(corpus_item, call_index)
+        if not item.flags.smashed:
+            self.fuzzer.wq.enqueue(WorkSmash(item.p, call_index))
+
+    # -- smash -----------------------------------------------------------
+
+    def smash_input(self, item: WorkSmash) -> None:
+        """Aggressive exploration of a fresh corpus input: hints pass,
+        fault injection, extra mutants (reference: proc.go:183-228)."""
+        cfg = self.fuzzer.cfg
+        if cfg.collect_comps:
+            self.execute_hint_seed(item.p, item.call_index)
+        if cfg.fault_injection:
+            self.fail_call(item.p, item.call_index)
+        corpus = [it.p for it in self.fuzzer.corpus_snapshot()]
+        for _ in range(cfg.smash_mutants):
+            p = item.p.clone()
+            mutate_prog(p, self.rng, cfg.program_length,
+                        ct=self.fuzzer.ct, corpus=corpus)
+            self.execute(self.exec_opts, p, Stat.SMASH)
+
+    def fail_call(self, p: Prog, call_index: int) -> None:
+        """Inject a fault into each of the first fault_nth_max blocking
+        points of the call (reference: proc.go:199-211)."""
+        for nth in range(1, self.fuzzer.cfg.fault_nth_max + 1):
+            opts = ExecOpts(flags=ExecFlags.FAULT,
+                            fault_call=call_index, fault_nth=nth)
+            info = self.execute_raw(opts, p, Stat.SMASH)
+            ci = _find_call(info, call_index)
+            if ci is not None and not (ci.flags & CallFlags.FAULT_INJECTED):
+                break  # no more blocking points
+
+    def execute_hint_seed(self, p: Prog, call_index: int) -> None:
+        """Collect comparison operands for the call, then execute every
+        hint mutant (reference: proc.go:213-228)."""
+        self.fuzzer.stat_add(Stat.SEED)
+        info = self.execute_raw(self.exec_opts_comps, p, Stat.SEED)
+        ci = _find_call(info, call_index)
+        if ci is None or not ci.comps:
+            return
+        comps = CompMap()
+        for op1, op2 in ci.comps:
+            comps.add_comp(op1, op2)
+
+        def exec_cb(mutant: Prog) -> None:
+            self.execute(self.exec_opts, mutant, Stat.HINT)
+
+        mutate_with_hints(p, call_index, comps, exec_cb)
+
+    # -- execution --------------------------------------------------------
+
+    def execute(self, opts: ExecOpts, p: Prog, stat: Stat,
+                flags: Optional[ProgTypes] = None) -> Optional[ExecResult]:
+        """Execute + novelty check; new signal enqueues triage work
+        (reference: proc.go:230-247)."""
+        result = self.execute_raw(opts, p, stat)
+        if result is None:
+            return None
+        for call_index, sig in self.fuzzer.check_new_signal(p, result.info):
+            self.fuzzer.wq.enqueue(WorkTriage(
+                p=p.clone(), call_index=call_index, signal=sig,
+                flags=flags or ProgTypes(minimized=False, smashed=False),
+                from_candidate=flags is not None))
+        return result
+
+    def execute_raw(self, opts: ExecOpts, p: Prog,
+                    stat: Stat) -> Optional[ExecResult]:
+        """(reference: proc.go:249-277 incl. crash/retry handling)"""
+        self.fuzzer.stat_add(stat)
+        self.fuzzer.stat_add(Stat.EXEC_TOTAL)
+        self.last_prog = p
+        data = serialize_for_exec(p)
+        try:
+            result = self.env.exec(opts, data)
+        except ExecutorCrash as e:
+            self.fuzzer.record_crash(e.log, p)
+            return None
+        except ExecutorFailure as e:
+            log.logf(1, "proc %d: executor failure: %s", self.pid, e)
+            self.fuzzer.stat_add(Stat.EXECUTOR_RESTARTS)
+            return None
+        return result
+
+
+def _find_call(result: Optional[ExecResult], call_index: int):
+    if result is None:
+        return None
+    for ci in result.info:
+        if ci.call_index == call_index:
+            return ci
+    return None

@@ -1,0 +1,100 @@
+"""Prioritized work queue for fuzzing work items.
+
+Priorities (highest first): triage of candidates > candidates > triage
+of own finds > smash.  Rationale mirrors the reference: corpus
+candidates from the manager carry externally-proven signal, so landing
+them beats exploring locally (reference: syz-fuzzer/workqueue.go:17-125).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from syzkaller_tpu.models.prog import Prog
+
+
+@dataclass
+class ProgTypes:
+    minimized: bool = True
+    smashed: bool = True
+
+
+@dataclass
+class WorkTriage:
+    """A program that produced new signal: deflake, minimize, add to
+    corpus (reference: workqueue.go:38-48)."""
+    p: Prog
+    call_index: int
+    signal: object  # signal.Signal
+    flags: ProgTypes = field(default_factory=ProgTypes)
+    from_candidate: bool = False
+
+
+@dataclass
+class WorkCandidate:
+    """A corpus candidate from the manager that must be executed and
+    triaged before joining the local corpus (workqueue.go:50-56)."""
+    p: Prog
+    flags: ProgTypes = field(default_factory=ProgTypes)
+
+
+@dataclass
+class WorkSmash:
+    """A freshly-landed corpus input to explore aggressively: extra
+    mutants, fault injection, hints (workqueue.go:58-63)."""
+    p: Prog
+    call_index: int
+
+
+class WorkQueue:
+    """Four priority bands + a wake event; procs fall back to
+    generate/mutate when empty (reference: workqueue.go:65-125)."""
+
+    def __init__(self, procs: int = 1):
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self._triage_candidate: deque = deque()
+        self._candidate: deque = deque()
+        self._triage: deque = deque()
+        self._smash: deque = deque()
+        # Backpressure bound on locally-generated smash items, scaled by
+        # procs like the reference's wantCandidates heuristic.
+        self.procs = procs
+
+    def enqueue(self, item) -> None:
+        with self._lock:
+            if isinstance(item, WorkTriage):
+                if item.from_candidate:
+                    self._triage_candidate.append(item)
+                else:
+                    self._triage.append(item)
+            elif isinstance(item, WorkCandidate):
+                self._candidate.append(item)
+            elif isinstance(item, WorkSmash):
+                self._smash.append(item)
+            else:  # pragma: no cover - programming error
+                raise TypeError(f"unknown work item {item!r}")
+
+    def dequeue(self):
+        with self._lock:
+            # FIFO within a band: oldest finds get triaged first
+            # (reference consumes in arrival order, workqueue.go:90-99).
+            for q in (self._triage_candidate, self._candidate,
+                      self._triage, self._smash):
+                if q:
+                    return q.popleft()
+        return None
+
+    def want_candidates(self) -> bool:
+        """Ask the manager for more candidates when the local queue is
+        thin (reference: workqueue.go:101-104)."""
+        with self._lock:
+            return len(self._candidate) < self.procs
+
+    def __len__(self) -> int:
+        with self._lock:
+            return (len(self._triage_candidate) + len(self._candidate)
+                    + len(self._triage) + len(self._smash))

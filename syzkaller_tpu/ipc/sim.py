@@ -1,0 +1,42 @@
+"""Python-side model of the simulated kernel's deterministic maps.
+
+Mirrors executor/sim_kernel.h so tests and the repro pipeline can
+predict which (call_id, args) combinations unlock magic edges or the
+two-stage crash — the executable ground truth the reference only has
+against a live kernel.
+"""
+
+from __future__ import annotations
+
+MASK64 = (1 << 64) - 1
+
+
+def splitmix64(x: int) -> int:
+    x = (x + 0x9E3779B97F4A7C15) & MASK64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & MASK64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & MASK64
+    return x ^ (x >> 31)
+
+
+def call_hash(call_id: int) -> int:
+    return splitmix64((call_id * 0x10001 + 1) & MASK64)
+
+
+def is_crashy(call_id: int) -> bool:
+    """1-in-8 call ids have the two-stage crash trigger
+    (executor/sim_kernel.h crash block)."""
+    return (call_hash(call_id) & 7) == 3
+
+
+def crash_magics(call_id: int) -> tuple[int, int]:
+    """(arg0, arg1) values that crash a crashy call."""
+    h = call_hash(call_id)
+    c0 = splitmix64((h ^ 0xC0DE0000) & MASK64) & 0xFFFFFFFF
+    c1 = splitmix64((h ^ 0xC0DE0001) & MASK64) & 0xFFFFFFFF
+    return c0, c1
+
+
+def arg_magic(call_id: int, arg_index: int) -> int:
+    """Per-(call,arg) comparison magic that unlocks bonus edges."""
+    h = call_hash(call_id)
+    return splitmix64((h + 0x1111 * (arg_index + 1)) & MASK64) & 0xFFFFFFFF

@@ -1,0 +1,29 @@
+"""Content-addressed signatures for corpus keys.
+
+sha1-based Sig with the same usage shape as the reference
+(pkg/hash/hash.go:1-57): Hash(data) -> Sig, Sig.String() hex key used
+to name corpus records and crash directories.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+class Sig(bytes):
+    def string(self) -> str:
+        return self.hex()
+
+    def __str__(self) -> str:  # pragma: no cover - trivial
+        return self.hex()
+
+
+def hash_bytes(*chunks: bytes) -> Sig:
+    h = hashlib.sha1()
+    for c in chunks:
+        h.update(c)
+    return Sig(h.digest())
+
+
+def hash_string(*chunks: bytes) -> str:
+    return hash_bytes(*chunks).string()

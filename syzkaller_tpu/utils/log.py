@@ -1,0 +1,67 @@
+"""Leveled logging with an in-memory cache of recent lines.
+
+The cache exists so that crash/error reports uploaded to the dashboard
+can carry the most recent log context (reference: pkg/log/log.go:1-6,
+EnableLogCaching used at syz-manager/manager.go:124).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+_lock = threading.Lock()
+_level = 0
+_cache: Optional[deque] = None
+_cache_max_mem = 0
+_cache_mem = 0
+_prepend_time = True
+
+
+def set_level(level: int) -> None:
+    global _level
+    _level = level
+
+
+def enable_log_caching(max_lines: int, max_mem: int) -> None:
+    """Keep the last max_lines/max_mem of output for error reports
+    (reference: pkg/log EnableLogCaching)."""
+    global _cache, _cache_max_mem, _cache_mem
+    with _lock:
+        _cache = deque(maxlen=max_lines)
+        _cache_max_mem = max_mem
+        _cache_mem = 0
+
+
+def cached_log_output() -> str:
+    with _lock:
+        if _cache is None:
+            return ""
+        return "\n".join(_cache) + "\n" if _cache else ""
+
+
+def logf(v: int, msg: str, *args) -> None:
+    global _cache_mem
+    if args:
+        msg = msg % args
+    line = msg
+    if _prepend_time:
+        line = time.strftime("%Y/%m/%d %H:%M:%S ") + msg
+    with _lock:
+        if _cache is not None:
+            if _cache.maxlen is not None and len(_cache) == _cache.maxlen:
+                _cache_mem -= len(_cache[0])  # about to be evicted
+            _cache.append(line)
+            _cache_mem += len(line)
+            while _cache_mem > _cache_max_mem and len(_cache) > 1:
+                _cache_mem -= len(_cache.popleft())
+    if v <= _level:
+        print(line, file=sys.stderr, flush=True)
+
+
+def fatalf(msg: str, *args) -> None:
+    logf(0, "FATAL: " + msg, *args)
+    raise SystemExit(1)

@@ -1,0 +1,151 @@
+"""Fuzzer-layer tests: workqueue priorities, host detection, and the
+full proc loop against the native executor + simulated kernel."""
+
+from __future__ import annotations
+
+import pytest
+
+from syzkaller_tpu.fuzzer import (
+    Fuzzer,
+    FuzzerConfig,
+    Proc,
+    WorkCandidate,
+    WorkQueue,
+    WorkSmash,
+    WorkTriage,
+    signal_prio,
+)
+from syzkaller_tpu.fuzzer import host
+from syzkaller_tpu.fuzzer.fuzzer import Stat
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.models.target import get_target
+from syzkaller_tpu.signal import Signal
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("test", "64")
+
+
+def test_workqueue_priorities(target):
+    wq = WorkQueue()
+    p = generate_prog(target, RandGen(target, 1), 3)
+    smash = WorkSmash(p, 0)
+    cand = WorkCandidate(p)
+    triage = WorkTriage(p, 0, Signal())
+    tcand = WorkTriage(p, 0, Signal(), from_candidate=True)
+    for item in (smash, triage, cand, tcand):
+        wq.enqueue(item)
+    assert wq.dequeue() is tcand
+    assert wq.dequeue() is cand
+    assert wq.dequeue() is triage
+    assert wq.dequeue() is smash
+    assert wq.dequeue() is None
+
+
+def test_host_detection(target):
+    supported, unsupported = host.detect_supported_syscalls(target)
+    assert len(supported) > 0
+    enabled, disabled = host.enabled_calls(target, supported)
+    # every enabled call's resources must be constructible
+    assert len(enabled) > 0
+    for c, reason in disabled.items():
+        assert "resource" in reason
+
+
+def test_signal_prio(target):
+    p = generate_prog(target, RandGen(target, 2), 3)
+    assert signal_prio(p, 0, 0) == 3  # success + no ANY
+    assert signal_prio(p, 9, 0) == 1  # failure + no ANY
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    from syzkaller_tpu.ipc.env import make_env
+
+    e = make_env(pid=0, sim=True, signal=True,
+                 workdir=str(tmp_path_factory.mktemp("fuzzer-ipc")))
+    yield e
+    e.close()
+
+
+def test_proc_loop_end_to_end(target, env):
+    """A few hundred iterations against the sim kernel must grow the
+    corpus and accumulate signal (the syz-stress slice)."""
+    cfg = FuzzerConfig(program_length=6, generate_period=10,
+                       smash_mutants=3, fault_nth_max=3,
+                       triage_runs=3, minimize_attempts=1)
+    fuzzer = Fuzzer(target, wq=WorkQueue(), cfg=cfg)
+    proc = Proc(fuzzer, pid=0, env=env)
+    proc.loop(iterations=300)
+    assert len(fuzzer.corpus) > 0, "no inputs triaged into corpus"
+    assert len(fuzzer.max_signal) > 0
+    assert len(fuzzer.corpus_signal) > 0
+    # corpus signal must be a subset of max signal
+    assert len(fuzzer.max_signal.diff(fuzzer.corpus_signal)) == 0
+    stats = fuzzer.grab_stats()
+    assert stats.get("exec total", 0) >= 300
+
+
+def test_proc_loop_with_batch_mutator(target, env):
+    """The TPU-engine feed/drain path produces valid mutants that the
+    executor accepts."""
+    from syzkaller_tpu.engine import TpuEngine
+    from syzkaller_tpu.fuzzer.proc import BatchMutator
+
+    from syzkaller_tpu.signal import Signal
+    from syzkaller_tpu.signal.cover import Cover
+
+    cfg = FuzzerConfig(program_length=6, generate_period=5,
+                       smash_mutants=2, fault_nth_max=2,
+                       minimize_attempts=1)
+    fuzzer = Fuzzer(target, wq=WorkQueue(), cfg=cfg)
+    engine = TpuEngine(target, rounds=2, seed=3)
+    # Seed the corpus with tensor-encodable programs so the device path
+    # is exercised (non-encodable programs fall back to the CPU mutator).
+    seeded = 0
+    i = 0
+    while seeded < 8 and i < 200:
+        p = generate_prog(target, RandGen(target, 1000 + i), 4)
+        i += 1
+        if engine.encode(p) is not None:
+            fuzzer.add_input_to_corpus(p, Signal({i: 1}), Cover())
+            seeded += 1
+    assert seeded > 0, "no encodable programs generated"
+    bm = BatchMutator(engine, batch_size=8)
+    proc = Proc(fuzzer, pid=1, env=env, batch_mutator=bm)
+    proc.loop(iterations=150)
+    assert engine.stats.device_mutations + engine.stats.host_mutations > 0
+
+
+def test_sim_model_matches_executor(target, env):
+    """The Python sim model (ipc/sim.py) predicts executor behavior:
+    hitting an arg magic yields extra edges vs. not hitting it."""
+    from syzkaller_tpu.ipc import sim as simmod
+    from syzkaller_tpu.ipc.env import ExecOpts
+    from syzkaller_tpu.models.encodingexec import serialize_for_exec
+    from syzkaller_tpu.models.prog import Call, ConstArg, Prog, make_return_arg
+    from syzkaller_tpu.models.types import ConstType, IntType
+
+    # find a syscall whose first arg is a plain scalar we control
+    meta = None
+    for c in target.syscalls:
+        if c.args and isinstance(c.args[0], IntType) \
+                and not isinstance(c.args[0], ConstType):
+            meta = c
+            break
+    if meta is None:
+        pytest.skip("no scalar-arg syscall in test target")
+    magic = simmod.arg_magic(meta.id, 0)
+
+    def run(val):
+        args = [ConstArg(meta.args[0], val)]
+        for t in meta.args[1:]:
+            args.append(target.default_arg(t))
+        p = Prog(target, [Call(meta, args, make_return_arg(meta.ret))])
+        res = env.exec(ExecOpts(), serialize_for_exec(p))
+        assert res.info
+        return len(res.info[0].signal)
+
+    assert run(magic) > run((magic + 7) & 0xFFFFFFFF)

@@ -141,21 +141,13 @@ def test_crash_detection(env, target):
 
     from syzkaller_tpu.ipc.env import IN_SHMEM_SIZE
 
-    # find a crashy call id the way the sim kernel derives them
-    def splitmix64(x):
-        M = (1 << 64) - 1
-        x = (x + 0x9E3779B97F4A7C15) & M
-        x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & M
-        x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & M
-        return x ^ (x >> 31)
+    from syzkaller_tpu.ipc import sim as simmod
 
     crash_id = None
     for cid in range(len(target.syscalls)):
-        h = splitmix64(cid * 0x10001 + 1)
-        if (h & 7) == 3 and len(target.syscalls[cid].args) >= 2:
+        if simmod.is_crashy(cid) and len(target.syscalls[cid].args) >= 2:
             crash_id = cid
-            c0 = splitmix64(h ^ 0xC0DE0000) & 0xFFFFFFFF
-            c1 = splitmix64(h ^ 0xC0DE0001) & 0xFFFFFFFF
+            c0, c1 = simmod.crash_magics(cid)
             break
     if crash_id is None:
         pytest.skip("no crashy call with 2+ args in test target")
