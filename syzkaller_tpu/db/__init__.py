@@ -1,0 +1,3 @@
+from syzkaller_tpu.db.db import DB, Record, open_db
+
+__all__ = ["DB", "Record", "open_db"]

@@ -1,0 +1,152 @@
+"""Append-only compacting key-value store.
+
+Persistence layer for the corpus (`corpus.db`) and hub state.  Writes
+append compressed records to the end of the file; records with an
+existing key supersede it (or delete it when the value is empty and
+seq is the tombstone).  When the dead-byte ratio grows past 10x the
+live size the file is compacted by rewriting in place via a temp file.
+Corrupted tails (e.g. from a crash mid-append) are dropped on open.
+
+Reference: pkg/db/db.go:25-140 (Open/Save/Delete/Flush/compaction),
+record framing db.go:142-229 (flate-compressed key/seq/val records).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Optional
+
+MAGIC = 0x745A6462  # "tzdb"
+CUR_VERSION = 1
+
+_HDR = struct.Struct("<II")  # magic, version
+_REC = struct.Struct("<I")  # compressed record length
+_REC_BODY = struct.Struct("<IQ")  # key length, seq
+
+DELETE_SEQ = 0xFFFFFFFFFFFFFFFF
+
+
+@dataclass
+class Record:
+    val: bytes
+    seq: int
+
+
+class DB:
+    """Append-only compacting KV store (reference: pkg/db/db.go:25).
+
+    `version` is a user payload stamped in the header — the manager
+    uses it to decide re-minimize/re-smash policy on format upgrades
+    (reference: syz-manager/manager.go:192-207).
+    """
+
+    def __init__(self, filename: str, records: dict[str, Record],
+                 version: int, uncompacted: int):
+        self.filename = filename
+        self.version = version
+        self.records = records
+        self.pending: dict[str, Optional[Record]] = {}
+        self._uncompacted = uncompacted
+
+    def save(self, key: str, val: bytes, seq: int) -> None:
+        if seq == DELETE_SEQ:
+            raise ValueError("reserved seq")
+        self.records[key] = Record(val, seq)
+        self.pending[key] = Record(val, seq)
+
+    def delete(self, key: str) -> None:
+        self.records.pop(key, None)
+        self.pending[key] = None
+
+    def flush(self) -> None:
+        """Append pending records; compact if the file has grown past
+        10x the live record count (reference: db.go:83-104)."""
+        if self._uncompacted >= 10 * max(len(self.records), 1) + 10:
+            self._compact()
+            return
+        if not self.pending:
+            return
+        with open(self.filename, "ab") as f:
+            for key, rec in self.pending.items():
+                f.write(_serialize_record(key, rec))
+        self._uncompacted += len(self.pending)
+        self.pending.clear()
+
+    def bump_version(self, version: int) -> None:
+        """Rewrite with a new header version (reference: db.go:106-112)."""
+        self.version = version
+        self._compact()
+
+    def _compact(self) -> None:
+        tmp = self.filename + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_HDR.pack(MAGIC, self.version))
+            for key, rec in self.records.items():
+                f.write(_serialize_record(key, rec))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.filename)
+        self._uncompacted = len(self.records)
+        self.pending.clear()
+
+
+def _serialize_record(key: str, rec: Optional[Record]) -> bytes:
+    kb = key.encode()
+    if rec is None:
+        body = _REC_BODY.pack(len(kb), DELETE_SEQ) + kb
+    else:
+        body = _REC_BODY.pack(len(kb), rec.seq) + kb + rec.val
+    comp = zlib.compress(body, 6)
+    return _REC.pack(len(comp)) + comp
+
+
+def open_db(filename: str, version: int = CUR_VERSION) -> DB:
+    """Open or create; tolerates a corrupted tail by truncating to the
+    last whole record (reference: db.go:40-81 deserializeDB)."""
+    records: dict[str, Record] = {}
+    file_version = version
+    uncompacted = 0
+    if os.path.exists(filename) and os.path.getsize(filename) >= _HDR.size:
+        with open(filename, "rb") as f:
+            data = f.read()
+        magic, ver = _HDR.unpack_from(data, 0)
+        if magic == MAGIC:
+            file_version = ver
+        else:
+            # Header corrupted: records are individually checksummed by
+            # zlib, so still try to recover them, and rewrite a clean
+            # header in place rather than discarding the corpus.
+            with open(filename, "r+b") as f:
+                f.write(_HDR.pack(MAGIC, version))
+        pos = _HDR.size
+        good = pos
+        while pos + _REC.size <= len(data):
+            (clen,) = _REC.unpack_from(data, pos)
+            if pos + _REC.size + clen > len(data):
+                break
+            try:
+                body = zlib.decompress(data[pos + _REC.size:
+                                            pos + _REC.size + clen])
+                klen, seq = _REC_BODY.unpack_from(body, 0)
+                key = body[_REC_BODY.size:_REC_BODY.size + klen].decode()
+                val = body[_REC_BODY.size + klen:]
+            except Exception:
+                break
+            if seq == DELETE_SEQ:
+                records.pop(key, None)
+            else:
+                records[key] = Record(val, seq)
+            pos += _REC.size + clen
+            good = pos
+            uncompacted += 1
+        if good < len(data):
+            with open(filename, "r+b") as f:
+                f.truncate(good)
+    else:
+        with open(filename, "wb") as f:
+            f.write(_HDR.pack(MAGIC, version))
+    db = DB(filename, records, file_version, uncompacted)
+    return db

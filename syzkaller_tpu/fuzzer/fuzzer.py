@@ -175,6 +175,10 @@ class Fuzzer:
             self.corpus_signal.merge(sig)
         return item
 
+    def corpus_len(self) -> int:
+        with self._lock:
+            return len(self.corpus)
+
     def corpus_snapshot(self) -> list[CorpusItem]:
         with self._lock:
             return list(self.corpus)
@@ -194,10 +198,13 @@ class Fuzzer:
         elems, prios = item.signal.serialize()
         self.conn.call("Manager.NewInput", {
             "name": getattr(self.conn, "name", "fuzzer"),
-            "prog": item.serialized.decode(),
             "call_index": call_index,
-            "signal": [elems, prios],
-            "cover": item.cover.serialize(),
+            "input": {
+                "call": item.p.calls[call_index].meta.name,
+                "prog": item.serialized.decode(),
+                "signal": [elems, prios],
+                "cover": item.cover.serialize(),
+            },
         })
 
     def record_crash(self, console_log: str, last_prog: Optional[Prog]) -> None:

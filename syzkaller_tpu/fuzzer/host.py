@@ -13,7 +13,7 @@ from typing import Callable, Optional
 
 from syzkaller_tpu.models.target import Target
 
-# Per-(os) probe hooks: name -> fn(syscall) -> reason-or-None.
+# Per-(os) probe hooks: name -> fn(syscall, sandbox) -> reason-or-None.
 _probes: dict[str, Callable] = {}
 
 

@@ -98,6 +98,7 @@ class Proc:
                                         | ExecFlags.DEDUP_COVER)
         self.exec_opts_comps = ExecOpts(flags=ExecFlags.COLLECT_COMPS)
         self.last_prog: Optional[Prog] = None
+        self._corpus_cache: list[Prog] = []
 
     # -- main loop --------------------------------------------------------
 
@@ -118,8 +119,8 @@ class Proc:
                 elif isinstance(item, WorkSmash):
                     self.smash_input(item)
                 continue
-            corpus_empty = not self.fuzzer.corpus_snapshot()
-            if corpus_empty or self.rng.one_of(cfg.generate_period):
+            if self.fuzzer.corpus_len() == 0 \
+                    or self.rng.one_of(cfg.generate_period):
                 p = generate_prog(self.fuzzer.target, self.rng,
                                   cfg.program_length, ct=self.fuzzer.ct)
                 self.execute(self.exec_opts, p, Stat.GENERATE)
@@ -138,9 +139,13 @@ class Proc:
         if base is None:
             return None
         p = base.clone()
+        # The corpus only grows; refresh the splice-source cache only
+        # when it has (the snapshot is an O(n) copy under the lock).
+        if len(self._corpus_cache) != self.fuzzer.corpus_len():
+            self._corpus_cache = [
+                it.p for it in self.fuzzer.corpus_snapshot()]
         mutate_prog(p, self.rng, self.fuzzer.cfg.program_length,
-                    ct=self.fuzzer.ct,
-                    corpus=[it.p for it in self.fuzzer.corpus_snapshot()])
+                    ct=self.fuzzer.ct, corpus=self._corpus_cache)
         return p
 
     # -- triage ----------------------------------------------------------
@@ -234,7 +239,6 @@ class Proc:
     def execute_hint_seed(self, p: Prog, call_index: int) -> None:
         """Collect comparison operands for the call, then execute every
         hint mutant (reference: proc.go:213-228)."""
-        self.fuzzer.stat_add(Stat.SEED)
         info = self.execute_raw(self.exec_opts_comps, p, Stat.SEED)
         ci = _find_call(info, call_index)
         if ci is None or not ci.comps:

@@ -1,0 +1,113 @@
+"""Manager configuration.
+
+Strict-JSON config consumed by the manager daemon and tools
+(reference: syz-manager/mgrconfig/mgrconfig.go:21-97 Config,
+mgrconfig.go:99-178 LoadFile/validation/defaults).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional, Union
+
+from syzkaller_tpu.utils.config import ConfigError, load_data, load_file
+
+
+@dataclass
+class Config:
+    # instance identity
+    name: str = ""
+    target: str = "test/64"  # "os/arch" or "os"
+    # services
+    http: str = "127.0.0.1:0"  # web UI addr
+    rpc: str = "127.0.0.1:0"  # manager RPC addr for fuzzers
+    workdir: str = ""
+    # VM/image plumbing (qemu/isolated types)
+    image: str = ""
+    sshkey: str = ""
+    ssh_user: str = "root"
+    kernel_obj: str = ""  # vmlinux dir for symbolization/coverage
+    # fuzzing behavior
+    procs: int = 1
+    sandbox: str = "none"
+    cover: bool = True
+    leak: bool = False
+    reproduce: bool = True
+    engine: str = "cpu"  # mutation engine: "cpu" | "jax"
+    enable_syscalls: list[str] = field(default_factory=list)
+    disable_syscalls: list[str] = field(default_factory=list)
+    suppressions: list[str] = field(default_factory=list)
+    ignores: list[str] = field(default_factory=list)
+    # federation
+    hub_client: str = ""
+    hub_addr: str = ""
+    hub_key: str = ""
+    # dashboard
+    dashboard_client: str = ""
+    dashboard_addr: str = ""
+    dashboard_key: str = ""
+    # VM backend
+    type: str = "local"
+    count: int = 1  # number of VM instances
+    vm: dict = field(default_factory=dict)  # backend-specific blob
+
+    @property
+    def target_os(self) -> str:
+        return self.target.split("/")[0]
+
+    @property
+    def target_arch(self) -> str:
+        parts = self.target.split("/")
+        return parts[1] if len(parts) > 1 else "64"
+
+
+def parse_addr(addr: str) -> tuple[str, int]:
+    host, sep, port = addr.rpartition(":")
+    if not sep:
+        return addr or "127.0.0.1", 0
+    try:
+        return host or "127.0.0.1", int(port or 0)
+    except ValueError as e:
+        raise ConfigError(f"bad address {addr!r}: {e}") from e
+
+
+def load_config(path_or_data: Union[str, dict],
+                data: Optional[str] = None) -> Config:
+    if isinstance(path_or_data, dict):
+        from syzkaller_tpu.utils.config import from_dict
+
+        cfg = from_dict(path_or_data, Config)
+    elif data is not None:
+        cfg = load_data(data, Config)
+    else:
+        cfg = load_file(path_or_data, Config)
+    return validate(cfg)
+
+
+def validate(cfg: Config) -> Config:
+    """Defaults + sanity (reference: mgrconfig.go:120-178)."""
+    if not cfg.workdir:
+        raise ConfigError("config param workdir is empty")
+    cfg.workdir = os.path.abspath(os.path.expanduser(cfg.workdir))
+    if not cfg.name:
+        cfg.name = os.path.basename(cfg.workdir) or "manager"
+    if cfg.procs < 1 or cfg.procs > 32:
+        raise ConfigError("bad config param procs: must be [1, 32]")
+    if cfg.count < 1 or cfg.count > 1000:
+        raise ConfigError("bad config param count: must be [1, 1000]")
+    if cfg.sandbox not in ("none", "setuid", "namespace"):
+        raise ConfigError(f"config param sandbox must be "
+                          f"none/setuid/namespace, not {cfg.sandbox!r}")
+    if cfg.engine not in ("cpu", "jax"):
+        raise ConfigError(f"config param engine must be cpu/jax, "
+                          f"not {cfg.engine!r}")
+    if (cfg.hub_client != "") != (cfg.hub_addr != ""):
+        raise ConfigError("hub_client and hub_addr must be set together")
+    if (cfg.dashboard_client != "") != (cfg.dashboard_addr != ""):
+        raise ConfigError(
+            "dashboard_client and dashboard_addr must be set together")
+    from syzkaller_tpu.models.target import get_target
+
+    get_target(cfg.target_os, cfg.target_arch)  # raises if unknown
+    return cfg

@@ -1,0 +1,3 @@
+from syzkaller_tpu.rpc.rpc import RPCClient, RPCServer, RPCError
+
+__all__ = ["RPCClient", "RPCServer", "RPCError"]

@@ -1,0 +1,213 @@
+"""TCP RPC transport: length-prefixed JSON frames with zlib for large
+payloads, TCP keep-alive, threaded server.
+
+The control-plane protocols (manager⇄fuzzer, manager⇄hub) ride this —
+the equivalent of the reference's net/rpc + gob transport with its
+keep-alive tuning (reference: pkg/rpctype/rpc.go:20-86).  Method
+dispatch is by "Service.Method" name to a registered receiver whose
+python method `Method` takes one dict argument and returns a dict —
+mirroring net/rpc's (args, reply) convention.  Big-payload exchanges
+(corpus downloads) use short-lived connections created per call to
+avoid buffer bloat on the long-lived poll connection (reference:
+syz-fuzzer/fuzzer.go:231-238, syz-manager/manager.go:1115-1124).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import zlib
+from typing import Any, Callable, Optional
+
+_FRAME = struct.Struct("<IB")  # payload length, flags
+_FLAG_ZLIB = 1
+_COMPRESS_MIN = 4 << 10
+_MAX_FRAME = 512 << 20
+
+
+class RPCError(Exception):
+    pass
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    flags = 0
+    if len(data) >= _COMPRESS_MIN:
+        data = zlib.compress(data, 1)
+        flags |= _FLAG_ZLIB
+    sock.sendall(_FRAME.pack(len(data), flags) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, _FRAME.size)
+    length, flags = _FRAME.unpack(hdr)
+    if length > _MAX_FRAME:
+        raise RPCError(f"oversized frame ({length} bytes)")
+    data = _recv_exact(sock, length)
+    if flags & _FLAG_ZLIB:
+        data = zlib.decompress(data)
+    return json.loads(data)
+
+
+def _setup_keepalive(sock: socket.socket) -> None:
+    # Aggressive keep-alive so dead VMs are detected in ~1 min
+    # (reference: pkg/rpctype/rpc.go setupKeepAlive, 1 min period).
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPIDLE, 60)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPINTVL, 60)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPCNT, 3)
+    except OSError:
+        pass
+
+
+class RPCServer:
+    """Threaded RPC server dispatching "Service.Method" to receivers
+    (reference: pkg/rpctype/rpc.go:20-50 NewRPCServer/Serve)."""
+
+    def __init__(self, addr: tuple[str, int] = ("127.0.0.1", 0)):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(addr)
+        self._sock.listen(64)
+        self.addr = self._sock.getsockname()
+        self._services: dict[str, object] = {}
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, name: str, receiver: object) -> None:
+        self._services[name] = receiver
+
+    def serve_in_background(self) -> None:
+        self._thread = threading.Thread(target=self.serve, daemon=True)
+        self._thread.start()
+
+    def serve(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        _setup_keepalive(conn)
+        try:
+            with conn:
+                while True:
+                    req = _recv_frame(conn)
+                    resp = self._dispatch(req)
+                    _send_frame(conn, resp)
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            pass
+
+    def _dispatch(self, req: dict) -> dict:
+        rid = req.get("id")
+        method = req.get("method", "")
+        try:
+            service, _, fn_name = method.partition(".")
+            recv = self._services.get(service)
+            fn: Optional[Callable] = getattr(recv, fn_name, None) \
+                if recv is not None and not fn_name.startswith("_") else None
+            if fn is None:
+                raise RPCError(f"unknown method {method!r}")
+            result = fn(req.get("params") or {})
+            return {"id": rid, "result": result}
+        except Exception as e:  # delivered to the caller, server lives on
+            return {"id": rid, "error": f"{type(e).__name__}: {e}"}
+
+    def close(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RPCClient:
+    """Blocking single-connection client (reference: rpc.go:52-86).
+
+    One outstanding call at a time per connection, matching the
+    fuzzer's serialized poll loop; `name` tags the caller identity
+    carried inside request params by convention.
+    """
+
+    def __init__(self, addr: tuple[str, int], name: str = "",
+                 timeout_s: float = 60.0):
+        self.addr = tuple(addr)
+        self.name = name
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._next_id = 0
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self.addr, timeout=self.timeout_s)
+        _setup_keepalive(sock)
+        return sock
+
+    def call(self, method: str, params: Optional[dict] = None) -> Any:
+        with self._lock:
+            self._next_id += 1
+            req = {"id": self._next_id, "method": method,
+                   "params": params or {}}
+            for attempt in range(2):
+                reused = self._sock is not None
+                if not reused:
+                    self._sock = self._connect()
+                try:
+                    _send_frame(self._sock, req)
+                except (ConnectionError, OSError):
+                    # Send on a stale pooled connection may fail without
+                    # the server having executed anything — reconnect and
+                    # re-send once.  Failures after the send completed
+                    # must NOT retry (the RPC may have run server-side:
+                    # duplicating a Poll/NewInput corrupts state).
+                    self.close()
+                    if not reused or attempt == 1:
+                        raise
+                    continue
+                try:
+                    resp = _recv_frame(self._sock)
+                except (ConnectionError, OSError):
+                    self.close()
+                    raise
+                break
+            if resp.get("error"):
+                raise RPCError(resp["error"])
+            return resp.get("result")
+
+    def call_transient(self, method: str,
+                       params: Optional[dict] = None) -> Any:
+        """One-shot connection for big payloads (fuzzer.go:231-238)."""
+        sock = self._connect()
+        try:
+            _send_frame(sock, {"id": 0, "method": method,
+                               "params": params or {}})
+            resp = _recv_frame(sock)
+        finally:
+            sock.close()
+        if resp.get("error"):
+            raise RPCError(resp["error"])
+        return resp.get("result")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

@@ -1,0 +1,164 @@
+"""Control-plane wire types.
+
+Dataclass mirrors of the reference protocol structs
+(pkg/rpctype/rpctype.go:12-114): manager⇄fuzzer
+(Connect/Check/Poll/NewInput) and manager⇄hub (HubConnect/HubSync).
+All types round-trip through plain dicts for the JSON transport.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from typing import Optional
+
+
+@dataclass
+class RPCInput:
+    """A triaged corpus input (reference: rpctype.go:12-18)."""
+    call: str = ""
+    prog: str = ""
+    signal: tuple[list[int], list[int]] = field(default_factory=lambda: ([], []))
+    cover: list[int] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "RPCInput":
+        sig = d.get("signal") or ([], [])
+        return RPCInput(call=d.get("call", ""), prog=d.get("prog", ""),
+                        signal=(list(sig[0]), list(sig[1])),
+                        cover=list(d.get("cover") or []))
+
+
+@dataclass
+class RPCCandidate:
+    """A corpus program pending fuzzer-side triage
+    (reference: rpctype.go:20-24)."""
+    prog: str = ""
+    minimized: bool = False
+    smashed: bool = False
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "RPCCandidate":
+        return RPCCandidate(prog=d.get("prog", ""),
+                            minimized=bool(d.get("minimized")),
+                            smashed=bool(d.get("smashed")))
+
+
+@dataclass
+class ConnectArgs:
+    """(reference: rpctype.go:26-28)"""
+    name: str = ""
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class ConnectRes:
+    """Everything a fresh fuzzer needs (reference: rpctype.go:30-40)."""
+    prios: list[list[float]] = field(default_factory=list)
+    inputs: list[dict] = field(default_factory=list)  # RPCInput dicts
+    max_signal: tuple[list[int], list[int]] = \
+        field(default_factory=lambda: ([], []))
+    candidates: list[dict] = field(default_factory=list)
+    enabled_calls: list[int] = field(default_factory=list)
+    need_check: bool = True
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class CheckArgs:
+    """Fuzzer capability report (reference: rpctype.go:42-50)."""
+    name: str = ""
+    kcov: bool = False
+    leak: bool = False
+    fault: bool = False
+    comps: bool = False
+    calls: list[int] = field(default_factory=list)
+    disabled: list[tuple[str, str]] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class NewInputArgs:
+    """(reference: rpctype.go:52-55)"""
+    name: str = ""
+    call_index: int = 0
+    input: dict = field(default_factory=dict)  # RPCInput dict
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class PollArgs:
+    """(reference: rpctype.go:57-62)"""
+    name: str = ""
+    need_candidates: bool = False
+    stats: dict[str, int] = field(default_factory=dict)
+    max_signal: tuple[list[int], list[int]] = \
+        field(default_factory=lambda: ([], []))
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class PollRes:
+    """(reference: rpctype.go:64-69)"""
+    candidates: list[dict] = field(default_factory=list)
+    new_inputs: list[dict] = field(default_factory=list)
+    max_signal: tuple[list[int], list[int]] = \
+        field(default_factory=lambda: ([], []))
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class HubConnectArgs:
+    """(reference: rpctype.go:75-88)"""
+    client: str = ""
+    key: str = ""
+    manager: str = ""
+    fresh: bool = False
+    calls: list[str] = field(default_factory=list)
+    corpus: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class HubSyncArgs:
+    """(reference: rpctype.go:90-105)"""
+    client: str = ""
+    key: str = ""
+    manager: str = ""
+    need_repros: bool = False
+    repros: list[str] = field(default_factory=list)
+    add: list[str] = field(default_factory=list)
+    delete: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class HubSyncRes:
+    """(reference: rpctype.go:107-114)"""
+    progs: list[str] = field(default_factory=list)
+    repros: list[str] = field(default_factory=list)
+    more: int = 0
+
+    def to_dict(self) -> dict:
+        return asdict(self)

@@ -1,0 +1,87 @@
+"""Strict JSON config loader.
+
+Rejects unknown fields so config typos fail loudly instead of being
+silently ignored (reference: pkg/config/config.go LoadFile/LoadData —
+json decoder with DisallowUnknownFields semantics).  Targets are
+dataclasses; nested dataclass fields recurse, `dict`-typed fields
+accept arbitrary sub-objects (the VM-type blob pattern,
+syz-manager/mgrconfig/mgrconfig.go:85-87).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any, Type, TypeVar, Union, get_args, get_origin
+
+T = TypeVar("T")
+
+
+class ConfigError(Exception):
+    pass
+
+
+def load_file(path: Union[str, Path], cls: Type[T]) -> T:
+    try:
+        raw = Path(path).read_text()
+    except OSError as e:
+        raise ConfigError(f"failed to read config {path}: {e}") from e
+    return load_data(raw, cls)
+
+
+def load_data(data: str, cls: Type[T]) -> T:
+    try:
+        obj = json.loads(_strip_comments(data))
+    except json.JSONDecodeError as e:
+        raise ConfigError(f"bad config syntax: {e}") from e
+    if not isinstance(obj, dict):
+        raise ConfigError("config must be a JSON object")
+    return from_dict(obj, cls)
+
+
+def from_dict(obj: dict, cls: Type[T], path: str = "") -> T:
+    import typing
+
+    if not dataclasses.is_dataclass(cls):
+        raise ConfigError(f"{cls} is not a config dataclass")
+    hints = typing.get_type_hints(cls)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs: dict[str, Any] = {}
+    for key, val in obj.items():
+        name = key.replace("-", "_")
+        f = fields.get(name)
+        if f is None:
+            raise ConfigError(f"unknown config field {path}{key!r}")
+        kwargs[name] = _convert(val, hints.get(name, Any), f"{path}{key}.")
+    try:
+        return cls(**kwargs)  # type: ignore[return-value]
+    except TypeError as e:  # missing required (defaultless) field
+        raise ConfigError(f"bad config: {e}") from e
+
+
+def _convert(val: Any, typ: Any, path: str) -> Any:
+    origin = get_origin(typ)
+    if origin is Union:
+        args = [a for a in get_args(typ) if a is not type(None)]
+        if val is None:
+            return None
+        return _convert(val, args[0], path) if args else val
+    if dataclasses.is_dataclass(typ) and isinstance(val, dict):
+        return from_dict(val, typ, path)
+    if origin in (list, tuple) and isinstance(val, list):
+        args = get_args(typ)
+        inner = args[0] if args else Any
+        return [_convert(v, inner, path) for v in val]
+    return val
+
+
+def _strip_comments(data: str) -> str:
+    """Allow // line comments in configs for operator convenience."""
+    out = []
+    for line in data.splitlines():
+        stripped = line.lstrip()
+        if stripped.startswith("//"):
+            continue
+        out.append(line)
+    return "\n".join(out)
