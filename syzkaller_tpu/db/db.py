@@ -45,40 +45,49 @@ class DB:
 
     def __init__(self, filename: str, records: dict[str, Record],
                  version: int, uncompacted: int):
+        import threading
+
         self.filename = filename
         self.version = version
         self.records = records
         self.pending: dict[str, Optional[Record]] = {}
         self._uncompacted = uncompacted
+        # save/flush are called from concurrent RPC handler threads
+        # (manager NewInput); all mutation is serialized here.
+        self._lock = threading.RLock()
 
     def save(self, key: str, val: bytes, seq: int) -> None:
         if seq == DELETE_SEQ:
             raise ValueError("reserved seq")
-        self.records[key] = Record(val, seq)
-        self.pending[key] = Record(val, seq)
+        with self._lock:
+            self.records[key] = Record(val, seq)
+            self.pending[key] = Record(val, seq)
 
     def delete(self, key: str) -> None:
-        self.records.pop(key, None)
-        self.pending[key] = None
+        with self._lock:
+            self.records.pop(key, None)
+            self.pending[key] = None
 
     def flush(self) -> None:
         """Append pending records; compact if the file has grown past
         10x the live record count (reference: db.go:83-104)."""
-        if self._uncompacted >= 10 * max(len(self.records), 1) + 10:
-            self._compact()
-            return
-        if not self.pending:
-            return
-        with open(self.filename, "ab") as f:
-            for key, rec in self.pending.items():
-                f.write(_serialize_record(key, rec))
-        self._uncompacted += len(self.pending)
-        self.pending.clear()
+        with self._lock:
+            if self._uncompacted >= 10 * max(len(self.records), 1) + 10:
+                self._compact()
+                return
+            if not self.pending:
+                return
+            with open(self.filename, "ab") as f:
+                for key, rec in self.pending.items():
+                    f.write(_serialize_record(key, rec))
+            self._uncompacted += len(self.pending)
+            self.pending.clear()
 
     def bump_version(self, version: int) -> None:
         """Rewrite with a new header version (reference: db.go:106-112)."""
-        self.version = version
-        self._compact()
+        with self._lock:
+            self.version = version
+            self._compact()
 
     def _compact(self) -> None:
         tmp = self.filename + ".tmp"

@@ -111,12 +111,20 @@ class Fuzzer:
         self.new_signal = Signal()  # delta not yet reported to manager
         self.ct = ct or build_choice_table(target)
         self.stats = [0] * len(Stat)
+        self._exec_total = 0
 
     # -- stats -----------------------------------------------------------
 
     def stat_add(self, s: Stat, v: int = 1) -> None:
         with self._lock:
             self.stats[s] += v
+            if s == Stat.EXEC_TOTAL:
+                self._exec_total += v
+
+    def exec_count(self) -> int:
+        """Monotonic total executions (not drained by grab_stats)."""
+        with self._lock:
+            return self._exec_total
 
     def grab_stats(self) -> dict[str, int]:
         """Drain counters for a manager poll (fuzzer.go:323-338)."""

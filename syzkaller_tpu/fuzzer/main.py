@@ -1,0 +1,221 @@
+"""Guest-side fuzzer process: connect → check → fuzz + poll loop.
+
+The syz-fuzzer form factor (reference: syz-fuzzer/fuzzer.go:97-382):
+connects to the manager, downloads prios/corpus/candidates, builds the
+choice table, spawns N proc loops, and syncs stats/maxSignal/
+candidates with the manager on a poll cadence.  Also runnable
+standalone (no manager) as the syz-stress form.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import Optional
+
+from syzkaller_tpu.fuzzer.fuzzer import Fuzzer, FuzzerConfig
+from syzkaller_tpu.fuzzer.host import (check_fault_injection,
+                                       detect_supported_syscalls,
+                                       enabled_calls)
+from syzkaller_tpu.fuzzer.proc import Proc
+from syzkaller_tpu.fuzzer.workqueue import (ProgTypes, WorkCandidate,
+                                            WorkQueue)
+from syzkaller_tpu.ipc.env import make_env
+from syzkaller_tpu.models.encoding import ParseError, deserialize_prog
+from syzkaller_tpu.models.prio import build_choice_table
+from syzkaller_tpu.models.target import get_target
+from syzkaller_tpu.rpc import RPCClient
+from syzkaller_tpu.signal import Signal
+from syzkaller_tpu.utils import log
+
+POLL_PERIOD_S = 10.0  # reference: fuzzer.go:300-382 poll cadence
+
+
+class FuzzerProcess:
+    """Wires Fuzzer + N Procs + the manager poll loop."""
+
+    def __init__(self, name: str, target_name: tuple[str, str],
+                 manager_addr: Optional[tuple[str, int]] = None,
+                 procs: int = 1, sim: bool = True,
+                 cfg: Optional[FuzzerConfig] = None,
+                 engine: str = "cpu"):
+        self.name = name
+        self.target = get_target(*target_name)
+        self.procs_n = procs
+        self.sim = sim
+        self.stop = threading.Event()
+        self.conn = RPCClient(manager_addr, name=name) \
+            if manager_addr else None
+
+        supported, _unsup = detect_supported_syscalls(self.target)
+        enabled, disabled = enabled_calls(self.target, supported)
+        self.enabled = sorted(c.id for c in enabled)
+        for c, reason in disabled.items():
+            log.logf(1, "disabled %s: %s", c.name, reason)
+
+        connect_res = {}
+        if self.conn is not None:
+            connect_res = self.conn.call("Manager.Connect",
+                                         {"name": name}) or {}
+            if connect_res.get("need_check"):
+                self.conn.call("Manager.Check", {
+                    "name": name, "kcov": True, "comps": True,
+                    "fault": check_fault_injection(),
+                    "leak": False, "calls": self.enabled,
+                })
+
+        ct_calls = {c: True for c in self.target.syscalls
+                    if c.id in set(self.enabled)}
+        self.fuzzer = Fuzzer(
+            self.target, WorkQueue(), cfg=cfg,
+            ct=build_choice_table(self.target, enabled=ct_calls),
+            conn=self.conn)
+
+        # Seed from the manager's corpus + candidates
+        # (reference: fuzzer.go:167-229).
+        for inp in connect_res.get("corpus") or []:
+            self._add_corpus_input(inp)
+        ms = connect_res.get("max_signal") or [[], []]
+        self.fuzzer.add_max_signal(Signal.deserialize(ms[0], ms[1]))
+        for cand in connect_res.get("candidates") or []:
+            self._enqueue_candidate(cand)
+
+        self.batch_mutator = None
+        if engine == "jax":
+            from syzkaller_tpu.engine import TpuEngine
+            from syzkaller_tpu.fuzzer.proc import BatchMutator
+
+            self.batch_mutator = BatchMutator(TpuEngine(self.target))
+
+        self.procs = []
+        for pid in range(procs):
+            env = make_env(pid, sim=sim)
+            self.procs.append(Proc(self.fuzzer, pid, env,
+                                   batch_mutator=self.batch_mutator))
+
+    # -- corpus/candidate intake -----------------------------------------
+
+    def _add_corpus_input(self, inp: dict) -> None:
+        try:
+            p = deserialize_prog(self.target, inp["prog"].encode())
+        except (ParseError, KeyError) as e:
+            log.logf(1, "rejecting corpus input: %s", e)
+            return
+        sig = Signal.deserialize(*(inp.get("signal") or [[], []]))
+        from syzkaller_tpu.signal.cover import Cover
+
+        cover = Cover(inp.get("cover") or [])
+        self.fuzzer.add_input_to_corpus(p, sig, cover)
+
+    def _enqueue_candidate(self, cand: dict) -> None:
+        try:
+            p = deserialize_prog(self.target, cand["prog"].encode())
+        except (ParseError, KeyError) as e:
+            log.logf(1, "rejecting candidate: %s", e)
+            return
+        self.fuzzer.wq.enqueue(WorkCandidate(
+            p=p, flags=ProgTypes(minimized=bool(cand.get("minimized")),
+                                 smashed=bool(cand.get("smashed")))))
+
+    # -- loops ------------------------------------------------------------
+
+    def run(self, duration_s: Optional[float] = None,
+            iterations: int = 1 << 62) -> None:
+        threads = []
+        for proc in self.procs:
+            t = threading.Thread(target=proc.loop,
+                                 args=(iterations,), kwargs={"stop": self.stop},
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        poller = threading.Thread(target=self.poll_loop, daemon=True)
+        poller.start()
+        deadline = time.monotonic() + duration_s if duration_s else None
+        try:
+            for t in threads:
+                while t.is_alive():
+                    t.join(timeout=0.5)
+                    if deadline and time.monotonic() > deadline:
+                        self.stop.set()
+        finally:
+            self.stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            self.shutdown()
+
+    def poll_loop(self) -> None:
+        """(reference: fuzzer.go:300-382)"""
+        execs_reported = 0
+        while not self.stop.is_set():
+            self.stop.wait(POLL_PERIOD_S)
+            if self.stop.is_set():
+                return
+            # Keep-alive print doubles as the liveness marker scanned
+            # by monitor_execution (fuzzer.go:312-315) — only emitted
+            # when executions actually progressed, so a wedged fuzzer
+            # trips the not-executing watchdog.
+            execs = self.fuzzer.exec_count()
+            if execs != execs_reported:
+                execs_reported = execs
+                log.logf(0, "alive, executing program (%d total)", execs)
+            if self.conn is None:
+                continue
+            try:
+                self.poll_once()
+            except Exception as e:
+                log.logf(0, "poll failed: %s", e)
+
+    def poll_once(self, need_candidates: Optional[bool] = None) -> dict:
+        new_sig = self.fuzzer.grab_new_signal()
+        if need_candidates is None:
+            need_candidates = self.fuzzer.wq.want_candidates()
+        res = self.conn.call("Manager.Poll", {
+            "name": self.name,
+            "need_candidates": bool(need_candidates),
+            "stats": self.fuzzer.grab_stats(),
+            "max_signal": list(new_sig.serialize()),
+        }) or {}
+        ms = res.get("max_signal") or [[], []]
+        self.fuzzer.add_max_signal(Signal.deserialize(ms[0], ms[1]))
+        for inp in res.get("new_inputs") or []:
+            self._add_corpus_input(inp)
+        for cand in res.get("candidates") or []:
+            self._enqueue_candidate(cand)
+        return res
+
+    def shutdown(self) -> None:
+        for proc in self.procs:
+            try:
+                proc.env.close()
+            except Exception:
+                pass
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    ap = argparse.ArgumentParser(prog="tz-fuzzer")
+    ap.add_argument("-name", default="fuzzer")
+    ap.add_argument("-manager", default="",
+                    help="manager RPC addr host:port")
+    ap.add_argument("-os", dest="target_os", default="test")
+    ap.add_argument("-arch", default="64")
+    ap.add_argument("-procs", type=int, default=1)
+    ap.add_argument("-engine", default="cpu", choices=["cpu", "jax"])
+    ap.add_argument("-duration", type=float, default=0,
+                    help="seconds to run (0 = forever)")
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_level(args.v)
+    addr = None
+    if args.manager:
+        from syzkaller_tpu.manager.mgrconfig import parse_addr
+
+        addr = parse_addr(args.manager)
+    fp = FuzzerProcess(args.name, (args.target_os, args.arch),
+                       manager_addr=addr, procs=args.procs,
+                       engine=args.engine)
+    fp.run(duration_s=args.duration or None)
+
+
+if __name__ == "__main__":
+    main()

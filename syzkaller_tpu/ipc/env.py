@@ -233,6 +233,10 @@ class Env:
                     self.stat_restarts += 1
                 return self._exec_once(opts, prog_data)
             except ExecutorCrash:
+                # The session is dead; drop it now so the next exec
+                # respawns with a truncated console (otherwise the old
+                # BUG output is mis-attributed to the next program).
+                self.close_proc()
                 raise
             except ExecutorFailure as e:
                 last_exc = e
@@ -284,7 +288,12 @@ class Env:
         return buf
 
     def _raise_dead(self):
-        code = self._proc.poll()
+        # Stdout EOF/BrokenPipe can precede waitpid observability by a
+        # hair; reap properly so the exit status is real.
+        try:
+            code = self._proc.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            code = self._proc.poll()
         log = self.console_tail()
         if "BUG:" in log or "WARNING:" in log or code == STATUS_ERROR:
             raise ExecutorCrash(log)

@@ -1,0 +1,132 @@
+"""Manager HTTP UI.
+
+Summary, corpus, crash and stats pages rendered server-side
+(reference: syz-manager/html.go:30-41 endpoints: /, /syscalls,
+/corpus, /crash, /cover, /prio, /file, /report, /rawcover).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, body: str, ctype: str = "text/html") -> None:
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            try:
+                if url.path == "/":
+                    self._send(_summary_page(mgr))
+                elif url.path == "/stats":
+                    self._send(json.dumps(mgr.stats_snapshot()),
+                               "application/json")
+                elif url.path == "/corpus":
+                    self._send(_corpus_page(mgr))
+                elif url.path == "/crash":
+                    self._send(_crash_page(mgr, q.get("id", [""])[0]))
+                elif url.path == "/syscalls":
+                    self._send(_syscalls_page(mgr))
+                else:
+                    self.send_error(404)
+            except BrokenPipeError:
+                pass
+            except Exception as e:
+                self.send_error(500, str(e))
+
+    srv = ThreadingHTTPServer(addr, Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+_STYLE = """<style>
+body { font-family: monospace; margin: 2em; }
+table { border-collapse: collapse; }
+td, th { border: 1px solid #999; padding: 2px 8px; text-align: left; }
+</style>"""
+
+
+def _page(title: str, body: str) -> str:
+    return (f"<html><head><title>{html.escape(title)}</title>{_STYLE}"
+            f"</head><body><h2>{html.escape(title)}</h2>"
+            f"<p><a href='/'>summary</a> | <a href='/corpus'>corpus</a> | "
+            f"<a href='/syscalls'>syscalls</a> | "
+            f"<a href='/stats'>stats.json</a></p>{body}</body></html>")
+
+
+def _summary_page(mgr) -> str:
+    s = mgr.stats_snapshot()
+    rows = "".join(f"<tr><td>{html.escape(str(k))}</td>"
+                   f"<td>{html.escape(str(v))}</td></tr>"
+                   for k, v in sorted(s.items()) if not isinstance(v, dict))
+    stats = s.get("stats", {})
+    rows += "".join(f"<tr><td>{html.escape(k)}</td>"
+                    f"<td>{v}</td></tr>" for k, v in sorted(stats.items()))
+    crashes = ""
+    with mgr._lock:
+        items = sorted(mgr.crash_types.items(),
+                       key=lambda kv: -kv[1].count)
+    for title, entry in items:
+        from syzkaller_tpu.utils.hashsig import hash_string
+
+        sig = hash_string(title.encode())
+        crashes += (f"<tr><td><a href='/crash?id={sig}'>"
+                    f"{html.escape(title)}</a></td><td>{entry.count}</td>"
+                    f"<td>{'yes' if entry.repro_done else ''}</td></tr>")
+    body = (f"<table>{rows}</table><h3>Crashes</h3>"
+            f"<table><tr><th>title</th><th>count</th><th>repro</th></tr>"
+            f"{crashes}</table>")
+    return _page(f"{mgr.cfg.name} syz-manager", body)
+
+
+def _corpus_page(mgr) -> str:
+    rows = ""
+    with mgr.serv._lock:
+        for key, inp in list(mgr.serv.corpus.items())[:1000]:
+            sig_len = len(inp.get("signal", [[], []])[0])
+            rows += (f"<tr><td>{key[:16]}</td><td>{sig_len}</td>"
+                     f"<td><pre>{html.escape(inp.get('prog', ''))}"
+                     f"</pre></td></tr>")
+    return _page("corpus", f"<table><tr><th>sig</th><th>signal</th>"
+                           f"<th>program</th></tr>{rows}</table>")
+
+
+def _crash_page(mgr, crash_id: str) -> str:
+    # crash ids are hex title-hashes; reject anything else so the
+    # query param can't traverse out of crashdir.
+    if not crash_id or any(c not in "0123456789abcdef" for c in crash_id):
+        return _page("crash", "not found")
+    dirpath = os.path.join(mgr.crashdir, crash_id)
+    if not os.path.isdir(dirpath):
+        return _page("crash", "not found")
+    parts = []
+    for name in sorted(os.listdir(dirpath)):
+        with open(os.path.join(dirpath, name), "rb") as f:
+            content = f.read(64 << 10).decode("utf-8", "replace")
+        parts.append(f"<h3>{html.escape(name)}</h3>"
+                     f"<pre>{html.escape(content)}</pre>")
+    return _page("crash", "".join(parts))
+
+
+def _syscalls_page(mgr) -> str:
+    rows = "".join(
+        f"<tr><td>{html.escape(c.name)}</td><td>{c.nr}</td></tr>"
+        for c in mgr.target.syscalls)
+    return _page("syscalls",
+                 f"<table><tr><th>call</th><th>nr</th></tr>{rows}</table>")

@@ -1,0 +1,364 @@
+"""The manager daemon: host-side orchestration.
+
+Loads config + corpus DB, serves the fuzzer RPC, runs the vmLoop that
+interleaves fuzzing instances with repro jobs, saves/dedups crashes,
+minimizes the corpus, snapshots bench stats, and serves the HTTP UI
+(reference: syz-manager/manager.go:44-1305).
+
+Phase machine (manager.go:92-103): init → loaded-corpus →
+triaged-corpus → queried-hub → triaged-hub; repro is only allowed
+once the local corpus is triaged so VMs aren't stolen from triage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from syzkaller_tpu.db import open_db
+from syzkaller_tpu.manager.mgrconfig import Config, parse_addr
+from syzkaller_tpu.manager.rpcserver import ManagerRPC
+from syzkaller_tpu.models.encoding import ParseError, deserialize_prog
+from syzkaller_tpu.models.prio import calculate_priorities
+from syzkaller_tpu.models.target import get_target
+from syzkaller_tpu.report import Report, get_reporter
+from syzkaller_tpu.rpc import RPCServer
+from syzkaller_tpu.rpc.types import RPCCandidate, RPCInput
+from syzkaller_tpu.signal import Signal, minimize_corpus
+from syzkaller_tpu.utils import log
+from syzkaller_tpu.utils.hashsig import hash_string
+
+# Corpus DB format version; bumping triggers re-minimize/re-smash of
+# the whole corpus on upgrade (reference: manager.go:105,192-207).
+CURRENT_DB_VERSION = 1
+
+PHASE_INIT = 0
+PHASE_LOADED_CORPUS = 1
+PHASE_TRIAGED_CORPUS = 2
+PHASE_QUERIED_HUB = 3
+PHASE_TRIAGED_HUB = 4
+
+MAX_CRASH_LOGS = 100  # per-title artifact cap (manager.go:659-691)
+MAX_REPRO_VMS = 4  # VMs handed to one repro job (manager.go:452)
+
+
+@dataclass
+class Crash:
+    title: str
+    report: Report
+    vm_index: int
+    first: bool
+
+
+@dataclass
+class CrashEntry:
+    count: int = 0
+    repro_attempted: bool = False
+    repro_done: bool = False
+
+
+class Manager:
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.target = get_target(cfg.target_os, cfg.target_arch)
+        os.makedirs(cfg.workdir, exist_ok=True)
+        os.makedirs(self.crashdir, exist_ok=True)
+        self.start_time = time.time()
+        self.first_connect = 0.0
+        self.phase = PHASE_INIT
+        self._lock = threading.Lock()
+        self.stats_extra = {"crashes": 0, "repro": 0, "vm restarts": 0}
+        self.crash_types: dict[str, CrashEntry] = {}
+        self.reporter = get_reporter(
+            cfg.target_os, kernel_obj=cfg.kernel_obj,
+            ignores=cfg.ignores, suppressions=cfg.suppressions)
+        self.stop_ev = threading.Event()
+        self.pending_repro: list[tuple[str, bytes]] = []  # (title, log)
+
+        # RPC service + corpus
+        prios = calculate_priorities(self.target, [])
+        self.serv = ManagerRPC(
+            prios=[list(map(float, row)) for row in prios],
+            on_new_input=self._on_new_input)
+        self.corpus_db = open_db(os.path.join(cfg.workdir, "corpus.db"),
+                                 version=CURRENT_DB_VERSION)
+        self._load_corpus()
+        self.rpc_server = RPCServer(parse_addr(cfg.rpc))
+        self.rpc_server.register("Manager", self.serv)
+        self.rpc_server.serve_in_background()
+        self.rpc_addr = self.rpc_server.addr
+
+        self.http_server = None
+        if cfg.http:
+            from syzkaller_tpu.manager.html import serve_http
+
+            self.http_server = serve_http(self, parse_addr(cfg.http))
+
+        self.hub = None
+        if cfg.hub_client:
+            try:
+                from syzkaller_tpu.manager.hubsync import HubSyncer
+            except ImportError:
+                log.logf(0, "hub sync unavailable; running without hub")
+            else:
+                self.hub = HubSyncer(self)
+
+        self.bench_file = None
+        self._bench_thread = None
+
+    # -- corpus persistence ----------------------------------------------
+
+    @property
+    def crashdir(self) -> str:
+        return os.path.join(self.cfg.workdir, "crashes")
+
+    def _load_corpus(self) -> None:
+        """Deserialize every DB record; broken/disabled programs are
+        dropped (with the same upgrade policy hooks as
+        manager.go:185-243)."""
+        minimized, smashed = True, True
+        if self.corpus_db.version < CURRENT_DB_VERSION:
+            minimized = False  # re-minimize entire corpus on upgrade
+            self.corpus_db.bump_version(CURRENT_DB_VERSION)
+        candidates = []
+        broken = 0
+        for key, rec in list(self.corpus_db.records.items()):
+            try:
+                deserialize_prog(self.target, rec.val)
+            except ParseError:
+                self.corpus_db.delete(key)
+                broken += 1
+                continue
+            candidates.append(RPCCandidate(
+                prog=rec.val.decode(), minimized=minimized,
+                smashed=smashed))
+        self.corpus_db.flush()
+        if broken:
+            log.logf(0, "dropped %d broken corpus programs", broken)
+        self.serv.add_candidates(candidates)
+        log.logf(0, "loaded %d corpus programs", len(candidates))
+        self.phase = PHASE_LOADED_CORPUS
+
+    def _on_new_input(self, inp: RPCInput) -> bool:
+        data = inp.prog.encode()
+        self.corpus_db.save(hash_string(data), data, 0)
+        self.corpus_db.flush()
+        return True
+
+    # -- crash handling ---------------------------------------------------
+
+    def save_crash(self, rep: Report, vm_index: int = 0) -> Crash:
+        """Dedup by title hash, persist ≤MAX_CRASH_LOGS logs/reports
+        per title (reference: manager.go:622-694)."""
+        title = rep.title or "unknown crash"
+        with self._lock:
+            self.stats_extra["crashes"] += 1
+            entry = self.crash_types.get(title)
+            first = entry is None
+            if entry is None:
+                entry = self.crash_types[title] = CrashEntry()
+            entry.count += 1
+        sig = hash_string(title.encode())
+        dirpath = os.path.join(self.crashdir, sig)
+        os.makedirs(dirpath, exist_ok=True)
+        desc_path = os.path.join(dirpath, "description")
+        if not os.path.exists(desc_path):
+            with open(desc_path, "w") as f:
+                f.write(title + "\n")
+        # round-robin slot under the log cap
+        for i in range(MAX_CRASH_LOGS):
+            logp = os.path.join(dirpath, f"log{i}")
+            if not os.path.exists(logp):
+                with open(logp, "wb") as f:
+                    f.write(rep.output)
+                if rep.report:
+                    with open(os.path.join(dirpath, f"report{i}"),
+                              "wb") as f:
+                        f.write(rep.report)
+                break
+        log.logf(0, "crash: %s (%s)", title,
+                 "new" if first else f"seen {entry.count}x")
+        return Crash(title=title, report=rep, vm_index=vm_index,
+                     first=first)
+
+    def need_repro(self, crash: Crash) -> bool:
+        """(reference: manager.go:698-734)"""
+        if not self.cfg.reproduce or crash.report.corrupted \
+                or crash.report.suppressed:
+            return False
+        if crash.title in ("no output from test machine",
+                           "lost connection to test machine",
+                           "test machine is not executing programs"):
+            return False
+        with self._lock:
+            entry = self.crash_types[crash.title]
+            if entry.repro_attempted or entry.repro_done:
+                return False
+            entry.repro_attempted = True
+        return True
+
+    def save_repro(self, title: str, prog_text: bytes,
+                   c_src: Optional[bytes], opts_desc: str) -> None:
+        """(reference: manager.go:736-809)"""
+        sig = hash_string(title.encode())
+        dirpath = os.path.join(self.crashdir, sig)
+        os.makedirs(dirpath, exist_ok=True)
+        with open(os.path.join(dirpath, "repro.prog"), "wb") as f:
+            f.write(opts_desc.encode() + b"\n" + prog_text)
+        if c_src:
+            with open(os.path.join(dirpath, "repro.c"), "wb") as f:
+                f.write(c_src)
+        with self._lock:
+            self.stats_extra["repro"] += 1
+            self.crash_types.setdefault(title, CrashEntry()).repro_done = True
+
+    # -- corpus minimization ----------------------------------------------
+
+    def minimize_corpus(self) -> None:
+        """Signal set-cover over the in-memory corpus, dropping DB
+        records not in the cover (reference: manager.go:831-860)."""
+        with self.serv._lock:
+            items = [(Signal.deserialize(*RPCInput.from_dict(v).signal), k)
+                     for k, v in self.serv.corpus.items()]
+            keep = set(minimize_corpus(items))
+            dropped = [k for k in self.serv.corpus if k not in keep]
+            for k in dropped:
+                del self.serv.corpus[k]
+        for k in dropped:
+            self.corpus_db.delete(k)
+        self.corpus_db.flush()
+        if dropped:
+            log.logf(0, "corpus minimization: dropped %d of %d",
+                     len(dropped), len(dropped) + len(keep))
+
+    # -- stats / bench -----------------------------------------------------
+
+    def stats_snapshot(self) -> dict:
+        s = self.serv.snapshot()
+        with self._lock:
+            s.update(self.stats_extra)
+        s["uptime"] = int(time.time() - self.start_time)
+        s["fuzzing_time_s"] = int(time.time() - self.first_connect) \
+            if self.first_connect else 0
+        s["triaged"] = self.serv.triaged_candidates
+        return s
+
+    def start_bench(self, path: str, period_s: float = 60.0) -> None:
+        """Minutely JSON stat snapshots, append-only — the input to
+        the benchcmp tool (reference: manager.go:299-333)."""
+        self.bench_file = path
+
+        def loop():
+            while not self.stop_ev.wait(period_s):
+                snap = self.stats_snapshot()
+                snap["ts"] = int(time.time())
+                with open(path, "a") as f:
+                    f.write(json.dumps(snap) + "\n")
+
+        self._bench_thread = threading.Thread(target=loop, daemon=True)
+        self._bench_thread.start()
+
+    # -- vm loop -----------------------------------------------------------
+
+    def vm_loop(self, fuzzer_cmd_fn, max_iterations: int = 1 << 62,
+                instance_timeout_s: float = 3600.0) -> None:
+        """Boot instances, run the fuzzer in them, monitor consoles,
+        save crashes, schedule repros (reference: manager.go:373-534).
+
+        fuzzer_cmd_fn(inst, index) -> shell command to start the
+        fuzzer inside the instance (after binaries are copied).
+        """
+        from syzkaller_tpu.vm.vm import create_pool, monitor_execution
+        from syzkaller_tpu.vm.vmimpl import BootError
+
+        pool = create_pool(self.cfg)
+        n = pool.count()
+        iteration = 0
+
+        def run_instance(index: int) -> None:
+            nonlocal iteration
+            try:
+                inst = pool.create(index)
+            except BootError as e:
+                log.logf(0, "VM %d boot failed: %s", index, e)
+                time.sleep(10)
+                return
+            try:
+                cmd = fuzzer_cmd_fn(inst, index)
+                stop = threading.Event()
+                stream = inst.run(instance_timeout_s, stop, cmd)
+                if not self.first_connect:
+                    self.first_connect = time.time()
+                res = monitor_execution(stream, self.reporter)
+                if res.report is not None:
+                    crash = self.save_crash(res.report, vm_index=index)
+                    if self.need_repro(crash):
+                        with self._lock:
+                            self.pending_repro.append(
+                                (crash.title, res.output))
+                stop.set()
+            finally:
+                inst.close()
+                with self._lock:
+                    self.stats_extra["vm restarts"] += 1
+
+        threads: list[Optional[threading.Thread]] = [None] * n
+        while not self.stop_ev.is_set() and iteration < max_iterations:
+            for i in range(n):
+                t = threads[i]
+                if t is None or not t.is_alive():
+                    iteration += 1
+                    if iteration > max_iterations:
+                        break
+                    threads[i] = threading.Thread(
+                        target=run_instance, args=(i,), daemon=True)
+                    threads[i].start()
+            self.update_phase()
+            self._maybe_run_repro(fuzzer_cmd_fn)
+            self.stop_ev.wait(1.0)
+        for t in threads:
+            if t is not None:
+                t.join(timeout=10)
+
+    def _maybe_run_repro(self, fuzzer_cmd_fn) -> None:
+        """Kick one pending repro job (reference: manager.go:452-491;
+        runs on its own thread with a private VM budget)."""
+        with self._lock:
+            if not self.pending_repro or self.phase < PHASE_TRIAGED_CORPUS:
+                return
+            title, crash_log = self.pending_repro.pop(0)
+
+        def job():
+            try:
+                from syzkaller_tpu.repro import repro as repro_mod
+
+                result = repro_mod.run_from_manager(self, title, crash_log)
+                if result is not None:
+                    self.save_repro(title, result.prog_text,
+                                    result.c_src, result.opts_desc)
+            except Exception as e:
+                log.logf(0, "repro of %r failed: %s", title, e)
+
+        threading.Thread(target=job, daemon=True).start()
+
+    def update_phase(self) -> None:
+        """Advance the phase machine as triage drains
+        (reference: manager.go:1027-1060 Poll-side phase logic)."""
+        if self.phase == PHASE_LOADED_CORPUS \
+                and self.serv.candidate_backlog() == 0:
+            self.phase = PHASE_TRIAGED_CORPUS
+            self.minimize_corpus()
+            log.logf(0, "triaged corpus")
+        if self.phase == PHASE_TRIAGED_CORPUS and self.hub is None:
+            self.phase = PHASE_TRIAGED_HUB
+
+    def shutdown(self) -> None:
+        self.stop_ev.set()
+        self.rpc_server.close()
+        if self.http_server is not None:
+            self.http_server.shutdown()
+        self.corpus_db.flush()

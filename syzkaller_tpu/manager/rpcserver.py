@@ -1,0 +1,175 @@
+"""Manager-side RPC service: the fuzzer-facing control plane.
+
+Implements Manager.Connect/Check/NewInput/Poll over the rpc transport
+(reference: syz-manager/manager.go:862-1081).  Shared mutable state
+(corpus, signal, candidates, per-fuzzer queues) lives here under one
+lock; the Manager object wires in persistence and crash handling via
+callbacks so this service stays testable standalone.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from syzkaller_tpu.rpc.types import RPCCandidate, RPCInput
+from syzkaller_tpu.signal import Signal
+from syzkaller_tpu.utils import log
+from syzkaller_tpu.utils.hashsig import hash_string
+
+
+@dataclass
+class FuzzerState:
+    """Per-connected-fuzzer distribution queues
+    (reference: manager.go Fuzzer bookkeeping in Connect/Poll)."""
+    name: str
+    new_max_signal: Signal = field(default_factory=Signal)
+    inputs: list[dict] = field(default_factory=list)  # pending RPCInput dicts
+
+
+class ManagerRPC:
+    """The "Manager" RPC receiver."""
+
+    def __init__(self, prios: Optional[list] = None,
+                 enabled_calls: Optional[list[int]] = None,
+                 on_new_input: Optional[Callable[[RPCInput], bool]] = None,
+                 on_stats: Optional[Callable[[dict], None]] = None,
+                 candidate_source: Optional[Callable[[int],
+                                                     list[dict]]] = None):
+        self._lock = threading.Lock()
+        self.prios = prios or []
+        self.enabled_calls = enabled_calls or []
+        self.fuzzers: dict[str, FuzzerState] = {}
+        self.corpus: dict[str, dict] = {}  # sig -> RPCInput dict
+        self.corpus_signal = Signal()
+        self.max_signal = Signal()
+        self.candidates: list[dict] = []  # RPCCandidate dicts
+        self.on_new_input = on_new_input
+        self.on_stats = on_stats
+        self.candidate_source = candidate_source
+        self.check_result: Optional[dict] = None
+        self.stats_total: dict[str, int] = {}
+        self.triaged_candidates = 0
+
+    # -- candidate feeding ------------------------------------------------
+
+    def add_candidates(self, candidates: list[RPCCandidate]) -> None:
+        """Queue corpus programs for fuzzer-side triage; duplicated and
+        shuffled so inputs lost to a crashing VM get a second chance
+        (reference: manager.go:245-256)."""
+        with self._lock:
+            batch = [c.to_dict() for c in candidates]
+            self.candidates.extend(batch + batch)
+            random.shuffle(self.candidates)
+
+    def candidate_backlog(self) -> int:
+        with self._lock:
+            return len(self.candidates)
+
+    # -- RPC methods ------------------------------------------------------
+
+    def Connect(self, params: dict) -> dict:
+        """(reference: manager.go:862-918)"""
+        name = params.get("name", "fuzzer")
+        with self._lock:
+            self.fuzzers[name] = FuzzerState(name=name)
+            elems, prios = self.max_signal.serialize()
+            return {
+                "prios": self.prios,
+                "enabled_calls": self.enabled_calls,
+                "corpus": [inp for inp in self.corpus.values()],
+                "max_signal": [elems, prios],
+                "need_check": self.check_result is None,
+            }
+
+    def Check(self, params: dict) -> dict:
+        """First fuzzer reports capabilities; mismatches with the
+        config are fatal in the reference (manager.go:920-974)."""
+        with self._lock:
+            if self.check_result is None:
+                self.check_result = dict(params)
+                log.logf(0, "machine check: %d calls enabled, kcov=%s, "
+                         "comps=%s", len(params.get("calls", [])),
+                         params.get("kcov"), params.get("comps"))
+        return {}
+
+    def NewInput(self, params: dict) -> dict:
+        """A fuzzer triaged a new corpus input: dedup by signal diff,
+        persist, broadcast to other fuzzers
+        (reference: manager.go:976-1025)."""
+        name = params.get("name", "fuzzer")
+        inp = RPCInput.from_dict(params.get("input") or {})
+        sig = Signal.deserialize(*inp.signal)
+        with self._lock:
+            # Drop if it adds nothing over current corpus signal at the
+            # same prio (another fuzzer raced it in).
+            diff = self.corpus_signal.diff(sig)
+            if diff.empty():
+                return {"accepted": False}
+            key = hash_string(inp.prog.encode())
+            art = self.corpus.get(key)
+            if art is not None:
+                # Same program, possibly better signal: merge.
+                old = Signal.deserialize(*RPCInput.from_dict(art).signal)
+                old.merge(sig)
+                art["signal"] = list(old.serialize())
+            else:
+                self.corpus[key] = inp.to_dict()
+            self.corpus_signal.merge(sig)
+            self.max_signal.merge(sig)
+            for fname, f in self.fuzzers.items():
+                if fname != name:
+                    f.inputs.append(inp.to_dict())
+                    f.new_max_signal.merge(sig)
+        if self.on_new_input is not None:
+            self.on_new_input(inp)
+        return {"accepted": True}
+
+    def Poll(self, params: dict) -> dict:
+        """Periodic sync: stats up, candidates/new-inputs/max-signal
+        down (reference: manager.go:1027-1081)."""
+        name = params.get("name", "fuzzer")
+        stats = params.get("stats") or {}
+        fuzzer_max = params.get("max_signal") or [[], []]
+        with self._lock:
+            f = self.fuzzers.get(name)
+            if f is None:  # fuzzer restarted without Connect — re-add
+                f = FuzzerState(name=name)
+                self.fuzzers[name] = f
+            new_sig = Signal.deserialize(fuzzer_max[0], fuzzer_max[1])
+            diff = self.max_signal.diff(new_sig)
+            if not diff.empty():
+                self.max_signal.merge(diff)
+                for fname, other in self.fuzzers.items():
+                    if fname != name:
+                        other.new_max_signal.merge(diff)
+            for k, v in stats.items():
+                self.stats_total[k] = self.stats_total.get(k, 0) + int(v)
+            candidates = []
+            if params.get("need_candidates"):
+                n = min(len(self.candidates), 100)
+                candidates = self.candidates[:n]
+                del self.candidates[:n]
+                self.triaged_candidates += n
+            max_out = f.new_max_signal.serialize()
+            f.new_max_signal = Signal()
+            inputs, f.inputs = f.inputs[:100], f.inputs[100:]
+        if self.on_stats is not None:
+            self.on_stats(stats)
+        return {"candidates": candidates, "new_inputs": inputs,
+                "max_signal": list(max_out)}
+
+    # -- introspection ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "corpus": len(self.corpus),
+                "signal": len(self.corpus_signal),
+                "max_signal": len(self.max_signal),
+                "candidates": len(self.candidates),
+                "fuzzers": list(self.fuzzers),
+                "stats": dict(self.stats_total),
+            }

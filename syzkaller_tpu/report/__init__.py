@@ -1,0 +1,3 @@
+from syzkaller_tpu.report.report import (Report, Reporter, get_reporter)
+
+__all__ = ["Report", "Reporter", "get_reporter"]

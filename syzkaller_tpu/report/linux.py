@@ -1,0 +1,195 @@
+"""Linux kernel console-log crash recognition.
+
+The oops table covers the sanitizer and core-kernel report families
+the reference recognizes (pkg/report/linux.go:449+ oopses table):
+KASAN/KMSAN/KFENCE, kernel BUG, WARNING, general protection fault,
+page faults, RCU/soft-lockup/task-hang stalls, lockdep, panics,
+divide error, OOM and memory-leak reports.  Titles are templated so
+one bug dedups across runs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from syzkaller_tpu.report.report import (Oops, OopsFormat, Report, Reporter,
+                                         register_reporter, sanitize_title)
+
+_FUNC = rb"([a-zA-Z0-9_.]+)"
+
+
+def _fmt(pat: bytes, fmt: str, **kw) -> OopsFormat:
+    return OopsFormat(report=re.compile(pat), fmt=fmt, **kw)
+
+
+LINUX_OOPSES = [
+    Oops(b"KASAN:", [
+        _fmt(rb"KASAN: ([a-z\-]+) in " + _FUNC, "KASAN: %s in %s"),
+        _fmt(rb"KASAN: ([a-z\-]+) on address", "KASAN: %s"),
+        _fmt(rb"KASAN: (\S+)", "KASAN: %s"),
+    ]),
+    Oops(b"KMSAN:", [
+        _fmt(rb"KMSAN: ([a-z\-]+) in " + _FUNC, "KMSAN: %s in %s"),
+    ]),
+    Oops(b"BUG: KFENCE:", [
+        _fmt(rb"BUG: KFENCE: ([a-z\- ]+) in " + _FUNC, "KFENCE: %s in %s"),
+    ]),
+    Oops(b"BUG:", [
+        _fmt(rb"BUG: unable to handle kernel paging request.*\n.*?(?:IP|RIP):? "
+             rb"(?:\[<[0-9a-f]+>\] )?(?:\w+:)?" + _FUNC,
+             "BUG: unable to handle kernel paging request in %s"),
+        _fmt(rb"BUG: unable to handle kernel NULL pointer dereference"
+             rb".*\n.*?(?:IP|RIP):? (?:\[<[0-9a-f]+>\] )?(?:\w+:)?" + _FUNC,
+             "BUG: unable to handle kernel NULL pointer dereference in %s"),
+        _fmt(rb"BUG: spinlock (\w+) on CPU", "BUG: spinlock %s"),
+        _fmt(rb"BUG: soft lockup - CPU#\d+ stuck for \d+s! \[([^\]:]+)",
+             "BUG: soft lockup in %s"),
+        _fmt(rb"BUG: workqueue lockup", "BUG: workqueue lockup"),
+        _fmt(rb"BUG: sleeping function called from invalid context"
+             rb" (?:at|in) ([a-zA-Z0-9_/.\-]+)",
+             "BUG: sleeping function called from invalid context in %s"),
+        _fmt(rb"BUG: using ([a-z_]+)\(\) in preemptible",
+             "BUG: using %s() in preemptible code"),
+        _fmt(rb"BUG: sim-kernel: ([a-z\-]+) in " + _FUNC,
+             "BUG: sim-kernel: %s in %s"),
+        _fmt(rb"BUG: (.*)", "BUG: %s"),
+    ], suppressions=[re.compile(rb"DEBUG_PAGEALLOC")]),
+    Oops(b"kernel BUG", [
+        _fmt(rb"kernel BUG at ([a-zA-Z0-9_/.\-]+):\d+",
+             "kernel BUG at %s"),
+    ]),
+    Oops(b"WARNING:", [
+        _fmt(rb"WARNING: CPU: \d+ PID: \d+ at [a-zA-Z0-9_/.\-]+:?\d* "
+             + _FUNC, "WARNING in %s"),
+        _fmt(rb"WARNING: possible circular locking dependency detected",
+             "possible deadlock (circular locking)"),
+        _fmt(rb"WARNING: possible recursive locking detected",
+             "possible deadlock (recursive locking)"),
+        _fmt(rb"WARNING: inconsistent lock state",
+             "inconsistent lock state"),
+        _fmt(rb"WARNING: suspicious RCU usage",
+             "WARNING: suspicious RCU usage"),
+        _fmt(rb"WARNING: kernel stack regs .* has bad '(\w+)' value",
+             "WARNING: kernel stack regs has bad %s value",
+             corrupted=True),
+        _fmt(rb"WARNING: (.*)", "WARNING: %s"),
+    ], suppressions=[re.compile(rb"WARNING: Audit")]),
+    Oops(b"INFO:", [
+        _fmt(rb"INFO: rcu_(?:preempt|sched|bh) (?:self-)?detected"
+             rb"(?: expedited)? stalls?", "INFO: rcu detected stall"),
+        _fmt(rb"INFO: task ([^ :]+):\d+ blocked for more than \d+ seconds",
+             "INFO: task hung in %s"),
+        _fmt(rb"INFO: possible circular locking dependency detected",
+             "possible deadlock (circular locking)"),
+        _fmt(rb"INFO: trying to register non-static key",
+             "INFO: trying to register non-static key"),
+    ], suppressions=[re.compile(rb"INFO: NMI handler")]),
+    Oops(b"general protection fault", [
+        _fmt(rb"general protection fault.*\n(?:.*\n)*?.*?RIP: "
+             rb"(?:\d+:)?" + _FUNC, "general protection fault in %s"),
+        _fmt(rb"general protection fault", "general protection fault"),
+    ]),
+    Oops(b"divide error:", [
+        _fmt(rb"divide error.*\n(?:.*\n)*?.*?RIP: (?:\d+:)?" + _FUNC,
+             "divide error in %s"),
+    ]),
+    Oops(b"Unable to handle kernel", [  # arm64 phrasing
+        _fmt(rb"Unable to handle kernel ([a-z ]+) at virtual address",
+             "unable to handle kernel %s"),
+    ]),
+    Oops(b"Kernel panic", [
+        _fmt(rb"Kernel panic - not syncing: Attempted to kill init",
+             "kernel panic: Attempted to kill init", corrupted=True),
+        _fmt(rb"Kernel panic - not syncing: Out of memory",
+             "kernel panic: Out of memory"),
+        _fmt(rb"Kernel panic - not syncing: ([^\n\r]*)",
+             "kernel panic: %s"),
+    ]),
+    Oops(b"kernel stack overflow", [
+        _fmt(rb"kernel stack overflow", "kernel stack overflow"),
+    ]),
+    Oops(b"Out of memory: Kill process", [
+        _fmt(rb"Out of memory: Kill process", "OOM kill"),
+    ], suppressions=[re.compile(rb"lowmemorykiller")]),
+    Oops(b"unregister_netdevice: waiting for", [
+        _fmt(rb"unregister_netdevice: waiting for (\S+)",
+             "unregister_netdevice: waiting for %s"),
+    ]),
+    Oops(b"BUG: memory leak", [  # kmemleak
+        _fmt(rb"BUG: memory leak\n(?:.*\n)*?.*?backtrace:\s*\n\s*\[<[0-9a-fx]+>\] "
+             + _FUNC, "memory leak in %s"),
+        _fmt(rb"BUG: memory leak", "memory leak"),
+    ]),
+    Oops(b"UBSAN:", [
+        _fmt(rb"UBSAN: ([a-z\-_ ]+) in ([a-zA-Z0-9_/.\-]+):\d+",
+             "UBSAN: %s in %s"),
+        _fmt(rb"UBSAN: (.*)", "UBSAN: %s"),
+    ]),
+]
+
+
+# Frames never guilty of a crash: allocation/reporting machinery
+# (reference: linux.go:373-447 guilty-file skip lists).
+_NON_GUILTY = re.compile(
+    r"^(dump_stack|print_|report_|kasan|kmsan|check_memory_region|"
+    r"__asan|__kasan|__kmsan|__ubsan|memcpy|memset|memmove|__warn|"
+    r"warn_slowpath|panic|_raw_spin|lock_acquire|lock_release|"
+    r"debug_|should_fail|fail_dump|slab_|kmalloc|kfree|krealloc|"
+    r"__alloc|page_alloc|stack_trace|save_stack|show_stack)")
+
+_FRAME_RE = re.compile(
+    rb"^(?:\[[\s\d.]+\])?\s+(?:\[<[0-9a-fx]+>\]\s*)?\??\s*"
+    rb"([a-zA-Z0-9_.]+)\+0x[0-9a-f]+", re.M)
+
+
+def guilty_function(region: bytes) -> str:
+    """First non-infrastructure frame of the first call trace."""
+    idx = region.find(b"Call Trace:")
+    if idx < 0:
+        idx = region.find(b"Backtrace:")
+    if idx < 0:
+        idx = region.find(b"backtrace:")
+    if idx < 0:
+        return ""
+    for m in _FRAME_RE.finditer(region[idx:idx + (16 << 10)]):
+        fn = m.group(1).decode("utf-8", "replace")
+        if not _NON_GUILTY.match(fn):
+            return fn
+    return ""
+
+
+def corrupted_reason(title: str, region: bytes) -> Optional[str]:
+    """Heuristics for truncated/interleaved reports
+    (reference: linux.go:449-520 isCorrupted)."""
+    # A report whose oops line appears with no stack trace within its
+    # region is likely cut off by a reboot or log loss.
+    needs_trace = any(k in title for k in
+                      ("KASAN", "WARNING in", "general protection",
+                       "paging request", "sim-kernel"))
+    has_trace = (b"Call Trace:" in region or b"Backtrace:" in region
+                 or b"call trace:" in region.lower())
+    if needs_trace and not has_trace:
+        return "no stack trace in report"
+    if b"Code: Bad RIP value" in region:
+        return "corrupted RIP"
+    if title.endswith(("ADDR", "NUM")) and "in" not in title:
+        return "title carries no symbol"
+    return None
+
+
+def make_linux_reporter(kernel_obj: str = "", ignores=None,
+                        suppressions=None) -> Reporter:
+    symbolize_fn = None
+    if kernel_obj:
+        from syzkaller_tpu.report.symbolizer import make_report_symbolizer
+
+        symbolize_fn = make_report_symbolizer(kernel_obj)
+    return Reporter(LINUX_OOPSES, ignores=ignores,
+                    suppressions=suppressions,
+                    symbolize_fn=symbolize_fn,
+                    guilty_fn=guilty_function,
+                    corrupted_fn=corrupted_reason)
+
+
+register_reporter("linux", make_linux_reporter)

@@ -1,0 +1,195 @@
+"""Crash report extraction from console output.
+
+Per-OS parsers turn raw console logs into deduplicatable reports with
+templated titles (reference: pkg/report/report.go:18-28 Reporter
+interface, 125-161 oops scanning machinery).  The generic scanner
+works off a per-OS table of oops patterns; each pattern carries title
+formats that extract and normalize the crash identity (addresses and
+counters templated away so the same bug dedups across runs).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Pattern, Union
+
+
+@dataclass
+class Report:
+    """(reference: pkg/report/report.go:30-47)"""
+    title: str = ""
+    report: bytes = b""  # the oops region of the console output
+    output: bytes = b""  # full console output
+    start_pos: int = 0
+    end_pos: int = 0
+    corrupted: bool = False
+    corrupted_reason: str = ""
+    suppressed: bool = False
+    maintainers: list[str] = field(default_factory=list)
+    guilty_file: str = ""
+
+
+@dataclass
+class OopsFormat:
+    """One title extractor under an oops pattern
+    (reference: pkg/report/report.go oopsFormat)."""
+    report: Pattern  # matched against the oops region
+    fmt: str  # title template with %s per capture group
+    alt: Optional[Pattern] = None
+    no_stack_trace: bool = False
+    corrupted: bool = False
+
+
+@dataclass
+class Oops:
+    """(reference: pkg/report/report.go oops)"""
+    header: bytes
+    formats: list[OopsFormat]
+    suppressions: list[Pattern] = field(default_factory=list)
+
+
+# Text fragments whose presence in a line disqualifies it as an oops
+# start (log echoes, fuzzer's own prints, etc.).
+_GENERIC_IGNORES = [
+    re.compile(rb"executing program"),
+    re.compile(rb"Slab corruption reporter"),
+]
+
+
+class Reporter:
+    """Generic per-OS console parser driven by an oops table."""
+
+    def __init__(self, oopses: list[Oops],
+                 ignores: Optional[list[Union[str, Pattern]]] = None,
+                 suppressions: Optional[list[Union[str, Pattern]]] = None,
+                 symbolize_fn: Optional[Callable[[Report], None]] = None,
+                 guilty_fn: Optional[Callable[[bytes], str]] = None,
+                 corrupted_fn: Optional[
+                     Callable[[str, bytes], Optional[str]]] = None):
+        self.oopses = oopses
+        self.ignores = [re.compile(p.encode() if isinstance(p, str) else p)
+                        if isinstance(p, (str, bytes)) else p
+                        for p in (ignores or [])]
+        self.suppressions = [
+            re.compile(p.encode() if isinstance(p, str) else p)
+            if isinstance(p, (str, bytes)) else p
+            for p in (suppressions or [])]
+        self._symbolize = symbolize_fn
+        self._guilty = guilty_fn
+        self._corrupted = corrupted_fn
+
+    # -- detection --------------------------------------------------------
+
+    def contains_crash(self, output: bytes) -> bool:
+        """Fast scan used by the VM monitor on every console chunk
+        (reference: report.go:18-21, vm/vm.go MonitorExecution)."""
+        return self._find_oops(output) is not None
+
+    def _line_ignored(self, line: bytes) -> bool:
+        return any(p.search(line) for p in self.ignores + _GENERIC_IGNORES)
+
+    def _find_oops(self, output: bytes,
+                   start: int = 0) -> Optional[tuple[int, Oops]]:
+        pos = start
+        n = len(output)
+        while pos < n:
+            end = output.find(b"\n", pos)
+            if end == -1:
+                end = n
+            line = output[pos:end]
+            for oops in self.oopses:
+                if oops.header in line and not self._line_ignored(line):
+                    if not any(s.search(line) for s in oops.suppressions):
+                        return pos, oops
+            pos = end + 1
+        return None
+
+    # -- parsing ----------------------------------------------------------
+
+    def parse(self, output: bytes) -> Optional[Report]:
+        """Extract the first crash (reference: linux.go:105 Parse)."""
+        found = self._find_oops(output)
+        if found is None:
+            return None
+        start, oops = found
+        # Report region: from the oops line to EOF, capped.
+        region = output[start:start + (512 << 10)]
+        rep = Report(output=output, start_pos=start,
+                     end_pos=min(len(output), start + len(region)),
+                     report=region)
+        rep.title, corrupted_fmt = self._extract_title(region, oops)
+        if any(s.search(rep.title.encode()) for s in self.suppressions):
+            rep.suppressed = True
+        if corrupted_fmt:
+            rep.corrupted = True
+            rep.corrupted_reason = "matched corrupted-output format"
+        elif self._corrupted is not None:
+            reason = self._corrupted(rep.title, region)
+            if reason:
+                rep.corrupted = True
+                rep.corrupted_reason = reason
+        if self._guilty is not None:
+            rep.guilty_file = self._guilty(region)
+        return rep
+
+    def _extract_title(self, region: bytes, oops: Oops) -> tuple[str, bool]:
+        for f in oops.formats:
+            m = f.report.search(region)
+            if m is None and f.alt is not None:
+                m = f.alt.search(region)
+            if m is None:
+                continue
+            groups = [g.decode("utf-8", "replace") if g is not None else ""
+                      for g in m.groups()]
+            title = f.fmt
+            for g in groups:
+                title = title.replace("%s", sanitize_symbol(g), 1)
+            return title, f.corrupted
+        # Fallback: the raw first line of the oops.
+        first = region.split(b"\n", 1)[0].decode("utf-8", "replace")
+        return sanitize_title(first), False
+
+    def symbolize(self, rep: Report) -> None:
+        """(reference: report.go:26-28 + linux.go:265-371)"""
+        if self._symbolize is not None:
+            self._symbolize(rep)
+
+
+def sanitize_symbol(sym: str) -> str:
+    """Strip instantiation suffixes like .isra.5/.constprop.2 and
+    offsets so the same function dedups (reference: linux.go title
+    replacement logic)."""
+    sym = re.sub(r"\.(isra|constprop|part|cold)\.?\d*", "", sym)
+    sym = re.sub(r"\+0x[0-9a-f]+(/0x[0-9a-f]+)?", "", sym)
+    return sym
+
+
+def sanitize_title(title: str) -> str:
+    """Template away run-specific values: hex addresses → ADDR,
+    decimals → NUM (reference: report.go sanitization in oopsFormat
+    fmt usage)."""
+    title = re.sub(r"0x[0-9a-f]{4,}", "ADDR", title)
+    title = re.sub(r"\b[0-9a-f]{8,16}\b", "ADDR", title)
+    title = re.sub(r"\b\d+\b", "NUM", title)
+    return title.strip()
+
+
+_REPORTER_CTORS: dict[str, Callable[..., Reporter]] = {}
+
+
+def register_reporter(os: str, ctor: Callable[..., Reporter]) -> None:
+    _REPORTER_CTORS[os] = ctor
+
+
+def get_reporter(os: str, kernel_obj: str = "",
+                 ignores: Optional[list] = None,
+                 suppressions: Optional[list] = None) -> Reporter:
+    """(reference: pkg/report/report.go:49-76 NewReporter)"""
+    from syzkaller_tpu.report import linux, sim  # noqa: F401 (registration)
+
+    ctor = _REPORTER_CTORS.get(os)
+    if ctor is None:
+        raise ValueError(f"no crash reporter for OS {os!r}")
+    return ctor(kernel_obj=kernel_obj, ignores=ignores or [],
+                suppressions=suppressions or [])

@@ -1,0 +1,22 @@
+"""Crash reporter for the simulated-kernel executor backend.
+
+The sim kernel (executor/sim_kernel.h) emits linux-shaped oopses
+("BUG: sim-kernel: use-after-free in sim_call_N" + Call Trace), so the
+test OS reuses the linux oops table — the same pattern as the
+reference's "test" targets reusing real parsers for hermetic tests.
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.report.linux import make_linux_reporter
+from syzkaller_tpu.report.report import Reporter, register_reporter
+
+
+def make_sim_reporter(kernel_obj: str = "", ignores=None,
+                      suppressions=None) -> Reporter:
+    return make_linux_reporter(kernel_obj="", ignores=ignores,
+                               suppressions=suppressions)
+
+
+register_reporter("test", make_sim_reporter)
+register_reporter("sim", make_sim_reporter)

@@ -1,0 +1,168 @@
+"""Kernel symbolization: addr2line/nm wrappers.
+
+Inline-symbolizes raw PC values found in crash reports against a
+vmlinux with debug info (reference: pkg/symbolizer/symbolizer.go
+addr2line batch pipe + ReadSymbols via nm; consumed by
+pkg/report/linux.go:265-371 and syz-manager/cover.go).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class Frame:
+    func: str
+    file: str
+    line: int
+    inline: bool = False
+
+
+@dataclass
+class Symbol:
+    addr: int
+    size: int
+
+
+class Symbolizer:
+    """Long-lived addr2line pipe; one process per binary
+    (reference: symbolizer.go Symbolizer.Symbolize)."""
+
+    def __init__(self, addr2line: str = "addr2line"):
+        self.addr2line = addr2line
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    def _proc(self, binary: str) -> Optional[subprocess.Popen]:
+        p = self._procs.get(binary)
+        if p is not None and p.poll() is None:
+            return p
+        try:
+            p = subprocess.Popen(
+                [self.addr2line, "-afi", "-e", binary],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True)
+        except OSError:
+            return None
+        self._procs[binary] = p
+        return p
+
+    # addr2line prints no frame count, so every query is followed by a
+    # sentinel address whose -a echo line delimits the answer
+    # (reference: symbolizer.go uses the same trick with 0xffffffffffffffff).
+    SENTINEL = 0xFFFFFFFFFFFFFFFE
+
+    def symbolize(self, binary: str, *pcs: int) -> list[list[Frame]]:
+        """Per-PC inline frame stacks (innermost first)."""
+        proc = self._proc(binary)
+        if proc is None:
+            return [[] for _ in pcs]
+        out: list[list[Frame]] = []
+        for pc in pcs:
+            try:
+                proc.stdin.write(f"0x{pc:x}\n0x{self.SENTINEL:x}\n")
+                proc.stdin.flush()
+                frames = self._read_frames(proc)
+            except (OSError, ValueError):
+                frames = []
+            out.append(frames)
+        return out
+
+    def _read_frames(self, proc: subprocess.Popen) -> list[Frame]:
+        sentinel_echo = f"0x{self.SENTINEL:016x}"
+        proc.stdout.readline()  # address echo of the queried pc
+        lines: list[str] = []
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            line = line.strip()
+            if line.lower() == sentinel_echo:
+                # consume the sentinel's own (??, ??:0) answer
+                proc.stdout.readline()
+                proc.stdout.readline()
+                break
+            lines.append(line)
+        frames: list[Frame] = []
+        for i in range(0, len(lines) - 1, 2):
+            func, loc = lines[i], lines[i + 1]
+            if func == "??":
+                continue
+            m = re.match(r"(.*?):(\d+)", loc)
+            file, line_no = (m.group(1), int(m.group(2))) if m else (loc, 0)
+            frames.append(Frame(func=func, file=_clean_path(file),
+                                line=line_no, inline=bool(frames)))
+        return frames
+
+    def close(self) -> None:
+        for p in self._procs.values():
+            try:
+                p.stdin.close()
+                p.kill()
+            except OSError:
+                pass
+        self._procs.clear()
+
+
+def _clean_path(path: str) -> str:
+    # Strip build-dir prefixes: ".../linux/net/ipv4/ip_output.c" →
+    # "net/ipv4/ip_output.c" (reference: linux.go cleanPath).
+    m = re.search(r"(?:^|/)((?:arch|block|crypto|drivers|fs|include|ipc|"
+                  r"kernel|lib|mm|net|security|sound|virt)/.*)", path)
+    return m.group(1) if m else path
+
+
+def read_symbols(binary: str, nm: str = "nm") -> dict[str, list[Symbol]]:
+    """Text-section symbol table (reference: symbolizer.go ReadSymbols)."""
+    symbols: dict[str, list[Symbol]] = {}
+    try:
+        out = subprocess.run([nm, "-nS", binary], capture_output=True,
+                             text=True, check=True).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return symbols
+    for line in out.splitlines():
+        parts = line.split()
+        if len(parts) != 4 or parts[2] not in ("t", "T"):
+            continue
+        try:
+            addr, size = int(parts[0], 16), int(parts[1], 16)
+        except ValueError:
+            continue
+        symbols.setdefault(parts[3], []).append(Symbol(addr, size))
+    return symbols
+
+
+_PC_RE = re.compile(rb"\[<([0-9a-f]{8,16})>\]")
+
+
+def make_report_symbolizer(kernel_obj: str):
+    """Returns a Report post-processor appending file:line to stack
+    frames with raw PC values (reference: linux.go:265-371)."""
+    vmlinux = os.path.join(kernel_obj, "vmlinux") \
+        if os.path.isdir(kernel_obj) else kernel_obj
+
+    def symbolize_report(rep) -> None:
+        if not os.path.exists(vmlinux):
+            return
+        sym = Symbolizer()
+        try:
+            lines = []
+            for line in rep.report.splitlines(keepends=True):
+                m = _PC_RE.search(line)
+                if m:
+                    pc = int(m.group(1), 16)
+                    frames = sym.symbolize(vmlinux, pc)[0]
+                    if frames and frames[0].func != "??":
+                        f = frames[0]
+                        line = line.rstrip(b"\n") + \
+                            f" {f.file}:{f.line}\n".encode()
+                lines.append(line)
+            rep.report = b"".join(lines)
+        finally:
+            sym.close()
+
+    return symbolize_report

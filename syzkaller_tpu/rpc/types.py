@@ -62,7 +62,7 @@ class ConnectArgs:
 class ConnectRes:
     """Everything a fresh fuzzer needs (reference: rpctype.go:30-40)."""
     prios: list[list[float]] = field(default_factory=list)
-    inputs: list[dict] = field(default_factory=list)  # RPCInput dicts
+    corpus: list[dict] = field(default_factory=list)  # RPCInput dicts
     max_signal: tuple[list[int], list[int]] = \
         field(default_factory=lambda: ([], []))
     candidates: list[dict] = field(default_factory=list)

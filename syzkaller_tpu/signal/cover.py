@@ -8,13 +8,15 @@ from typing import Iterable
 
 class Cover(set):
     def merge(self, raw: Iterable[int]) -> None:
-        self.update(raw)
+        # int() coercion keeps numpy scalars out of serialization.
+        self.update(int(pc) for pc in raw)
 
     def merge_diff(self, raw: Iterable[int]) -> list[int]:
         """Merge and return newly-added PCs (each at most once even if
         the raw trace repeats it)."""
         new = []
         for pc in raw:
+            pc = int(pc)
             if pc not in self:
                 self.add(pc)
                 new.append(pc)

@@ -55,9 +55,12 @@ class Signal:
         return Signal(res)
 
     def diff_raw(self, raw: Iterable[int], prio: int) -> "Signal":
-        """(reference: pkg/signal/signal.go:90-102)"""
+        """(reference: pkg/signal/signal.go:90-102).  Elements are
+        coerced to python ints so numpy scalars from executor output
+        never leak into serialization."""
         res: dict[int, int] = {}
         for e in raw:
+            e = int(e)
             p = self.m.get(e)
             if p is not None and p >= prio:
                 continue
@@ -83,7 +86,7 @@ class Signal:
 
 
 def from_raw(raw: Iterable[int], prio: int) -> Signal:
-    return Signal({e: prio for e in raw})
+    return Signal({int(e): prio for e in raw})
 
 
 def minimize_corpus(corpus: list[tuple[Signal, object]]) -> list[object]:

@@ -1,0 +1,5 @@
+from syzkaller_tpu.vm.vm import Pool, Instance, create_pool, monitor_execution
+from syzkaller_tpu.vm.vmimpl import BootError, Env
+
+__all__ = ["Pool", "Instance", "create_pool", "monitor_execution",
+           "BootError", "Env"]

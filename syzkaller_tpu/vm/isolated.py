@@ -1,0 +1,110 @@
+"""Isolated VM backend: remote physical machines over ssh.
+
+For fuzzing hardware that can't be virtualized.  Recovery is
+reboot-based: when the connection is lost the instance waits for the
+machine to come back (reference: vm/isolated/isolated.go — targets
+list, reboot wait loop, console via ssh dmesg -w).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from typing import Optional
+
+from syzkaller_tpu.vm.vmimpl import (BootError, Env, Instance, OutputStream,
+                                     PoolImpl, pump_fd, register_vm_type,
+                                     run_ssh, ssh_args)
+
+
+class IsolatedInstance(Instance):
+    def __init__(self, workdir: str, index: int, env: Env, target: str):
+        self.workdir = workdir
+        self.index = index
+        self.env = env
+        host, _, port = target.partition(":")
+        self.host = host
+        self.port = int(port or 22)
+        self.target_dir = env.config.get("target_dir", "/tmp/tz-fuzz")
+        self._wait_alive(timeout_s=10 * 60)
+        self._ssh(f"mkdir -p {self.target_dir}")
+        self._console_proc: Optional[subprocess.Popen] = None
+
+    def _ssh_base(self) -> list[str]:
+        return ["ssh", *ssh_args(self.env.sshkey, self.env.ssh_user,
+                                 self.port),
+                f"{self.env.ssh_user}@{self.host}"]
+
+    def _ssh(self, command: str, timeout_s: float = 60.0) -> bytes:
+        return run_ssh(self._ssh_base() + [command], timeout_s=timeout_s)
+
+    def _wait_alive(self, timeout_s: float) -> None:
+        """Wait for the machine to answer ssh — also the post-crash
+        reboot wait (reference: isolated.go waitForReboot)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                self._ssh("true", timeout_s=15)
+                return
+            except (BootError, subprocess.TimeoutExpired):
+                time.sleep(10)
+        raise BootError(f"isolated machine {self.host} unreachable")
+
+    def copy(self, host_src: str) -> str:
+        import os
+
+        dst = f"{self.target_dir}/{os.path.basename(host_src)}"
+        run_ssh(["scp", *ssh_args(self.env.sshkey, self.env.ssh_user,
+                                  self.port),
+                 "-P", str(self.port), host_src,
+                 f"{self.env.ssh_user}@{self.host}:{dst}"], timeout_s=300)
+        return dst
+
+    def forward(self, port: int) -> str:
+        # Remote forward created per run() (ssh -R); guests dial this.
+        self._fwd_port = port
+        return f"127.0.0.1:{port}"
+
+    def run(self, timeout_s: float, stop: threading.Event,
+            command: str) -> OutputStream:
+        stream = OutputStream()
+        args = ["ssh", *ssh_args(self.env.sshkey, self.env.ssh_user,
+                                 self.port)]
+        fwd = getattr(self, "_fwd_port", None)
+        if fwd:
+            args += ["-R", f"{fwd}:127.0.0.1:{fwd}"]
+        args += [f"{self.env.ssh_user}@{self.host}",
+                 # dmesg -w interleaves the kernel console with the
+                 # command's own output (reference: isolated.go console)
+                 f"dmesg -wT & {command}"]
+        proc = subprocess.Popen(args, stdin=subprocess.DEVNULL,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+        self._console_proc = proc
+        pump_fd(proc.stdout, stream, proc, stop, timeout_s)
+        return stream
+
+    def close(self) -> None:
+        if self._console_proc is not None and \
+                self._console_proc.poll() is None:
+            self._console_proc.kill()
+            self._console_proc.wait()
+
+
+class IsolatedPool(PoolImpl):
+    def __init__(self, env: Env):
+        self.env = env
+        self.targets = list(env.config.get("targets", []))
+        if not self.targets:
+            raise BootError("isolated: config must list targets")
+
+    def count(self) -> int:
+        return len(self.targets)
+
+    def create(self, workdir: str, index: int) -> Instance:
+        return IsolatedInstance(workdir, index, self.env,
+                                self.targets[index])
+
+
+register_vm_type("isolated", IsolatedPool)

@@ -1,0 +1,83 @@
+"""Local VM backend: "instances" are host subprocesses.
+
+The workhorse for hermetic end-to-end tests and for fuzzing the
+simulated kernel: each instance is a private workdir, copy is a file
+copy, forward is the identity (same host), and run spawns the command
+as a subprocess whose merged stdout/stderr is the "console".  This
+plays the role the qemu backend plays in production but with zero
+boot cost — the analogue of the reference's pattern of exercising
+manager logic without kernels (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import threading
+from typing import Optional
+
+from syzkaller_tpu.vm.vmimpl import (Env, Instance, OutputStream, PoolImpl,
+                                     pump_fd, register_vm_type)
+
+
+class LocalInstance(Instance):
+    def __init__(self, workdir: str, index: int, env: Env):
+        self.workdir = workdir
+        self.index = index
+        self.env = env
+        self._proc: Optional[subprocess.Popen] = None
+
+    def copy(self, host_src: str) -> str:
+        dst = os.path.join(self.workdir, os.path.basename(host_src))
+        if os.path.abspath(host_src) != os.path.abspath(dst):
+            shutil.copy2(host_src, dst)
+            shutil.copymode(host_src, dst)
+        return dst
+
+    def forward(self, port: int) -> str:
+        return f"127.0.0.1:{port}"
+
+    def run(self, timeout_s: float, stop: threading.Event,
+            command: str) -> OutputStream:
+        stream = OutputStream()
+        proc = subprocess.Popen(
+            command, shell=True, cwd=self.workdir,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            preexec_fn=os.setsid if hasattr(os, "setsid") else None)
+        self._proc = proc
+
+        def on_exit():
+            code = proc.returncode
+            if code not in (0, None) and not stop.is_set():
+                return RuntimeError(f"command exited with status {code}")
+            return None
+
+        pump_fd(proc.stdout, stream, proc, stop, timeout_s, on_exit)
+        return stream
+
+    def close(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            try:
+                # Kill the whole process group (the command may have
+                # spawned executors).
+                os.killpg(os.getpgid(self._proc.pid), 9)
+            except (OSError, ProcessLookupError):
+                self._proc.kill()
+            self._proc.wait()
+
+
+class LocalPool(PoolImpl):
+    def __init__(self, env: Env):
+        self.env = env
+        self._count = int(env.config.get("count", 1))
+
+    def count(self) -> int:
+        return self._count
+
+    def create(self, workdir: str, index: int) -> Instance:
+        os.makedirs(workdir, exist_ok=True)
+        return LocalInstance(workdir, index, self.env)
+
+
+register_vm_type("local", LocalPool)

@@ -1,0 +1,216 @@
+"""qemu VM backend.
+
+Boots qemu-system-* with per-arch machine args, waits for ssh, copies
+binaries via scp, runs commands over ssh with the serial console
+merged into the output stream (reference: vm/qemu/qemu.go:34-99 arch
+table, 101-226 ctor/Boot, 228-420 ssh wait + Copy, 422+ Run).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import threading
+import time
+from typing import Optional
+
+from syzkaller_tpu.vm.vmimpl import (BootError, Env, Instance, OutputStream,
+                                     PoolImpl, pump_fd, register_vm_type,
+                                     run_ssh, ssh_args)
+
+# Per-arch qemu binaries and machine args
+# (reference: vm/qemu/qemu.go:34-99 archConfigs).
+ARCH_CONFIGS: dict[str, dict] = {
+    "amd64": {
+        "qemu": "qemu-system-x86_64",
+        "args": ["-enable-kvm", "-cpu", "host,migratable=off"],
+        "net": "e1000",
+    },
+    "386": {
+        "qemu": "qemu-system-i386",
+        "args": [],
+        "net": "e1000",
+    },
+    "arm64": {
+        "qemu": "qemu-system-aarch64",
+        "args": ["-machine", "virt,virtualization=on", "-cpu", "cortex-a57"],
+        "net": "virtio-net-pci",
+    },
+    "arm": {
+        "qemu": "qemu-system-arm",
+        "args": ["-machine", "vexpress-a15"],
+        "net": "virtio-net-device",
+    },
+    "ppc64le": {
+        "qemu": "qemu-system-ppc64",
+        "args": ["-machine", "pseries"],
+        "net": "virtio-net-pci",
+    },
+    "riscv64": {
+        "qemu": "qemu-system-riscv64",
+        "args": ["-machine", "virt"],
+        "net": "virtio-net-pci",
+    },
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class QemuInstance(Instance):
+    def __init__(self, workdir: str, index: int, env: Env):
+        self.workdir = workdir
+        self.index = index
+        self.env = env
+        cfg = env.config
+        self.arch_cfg = ARCH_CONFIGS.get(env.arch)
+        if self.arch_cfg is None:
+            raise BootError(f"qemu: unsupported arch {env.arch!r}")
+        self.mem_mb = int(cfg.get("mem", 2048))
+        self.cpus = int(cfg.get("cpu", 2))
+        self.kernel = cfg.get("kernel", "")
+        self.cmdline = cfg.get("cmdline", "")
+        self.qemu_args = cfg.get("qemu_args", "")
+        self.ssh_port = _free_port()
+        self._fwd_ports: list[tuple[int, int]] = []
+        self._proc: Optional[subprocess.Popen] = None
+        self._console = OutputStream()
+        self._boot(timeout_s=float(cfg.get("boot_timeout", 10 * 60)))
+
+    # -- boot -------------------------------------------------------------
+
+    def _boot(self, timeout_s: float) -> None:
+        a = self.arch_cfg
+        netdev = (f"user,id=net0,restrict=on,"
+                  f"hostfwd=tcp:127.0.0.1:{self.ssh_port}-:22")
+        args = [a["qemu"], "-m", str(self.mem_mb), "-smp", str(self.cpus),
+                "-display", "none", "-serial", "stdio", "-no-reboot",
+                "-device", f"{a['net']},netdev=net0", "-netdev", netdev,
+                *a["args"]]
+        if self.env.image == "9p":
+            args += ["-fsdev",
+                     f"local,id=fsdev0,path=/,security_model=none",
+                     "-device",
+                     "virtio-9p-pci,fsdev=fsdev0,mount_tag=/dev/root"]
+        elif self.env.image:
+            args += ["-drive", f"file={self.env.image},index=0,media=disk"]
+        if self.kernel:
+            cmdline = ("root=/dev/sda console=ttyS0 earlyprintk=serial "
+                       "oops=panic panic_on_warn=1 panic=86400 "
+                       + self.cmdline)
+            args += ["-kernel", self.kernel, "-append", cmdline]
+        if self.qemu_args:
+            args += self.qemu_args.split()
+        try:
+            self._proc = subprocess.Popen(
+                args, stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, cwd=self.workdir)
+        except OSError as e:
+            raise BootError(f"failed to start {a['qemu']}: {e}") from e
+        self._console_stop = threading.Event()
+        self._console_buf = bytearray()
+        threading.Thread(target=self._pump_console, daemon=True).start()
+        self._wait_ssh(timeout_s)
+
+    def _pump_console(self) -> None:
+        try:
+            while not self._console_stop.is_set():
+                chunk = self._proc.stdout.read1(1 << 14)
+                if not chunk:
+                    break
+                self._console_buf += chunk
+                self._console.put(chunk)
+        except (OSError, ValueError):
+            pass
+        self._console.finish()
+
+    def _wait_ssh(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise BootError(
+                    "qemu exited during boot: "
+                    + bytes(self._console_buf[-2048:]).decode("utf-8",
+                                                              "replace"))
+            try:
+                run_ssh(["ssh", *ssh_args(self.env.sshkey,
+                                          self.env.ssh_user, self.ssh_port),
+                         f"{self.env.ssh_user}@127.0.0.1", "true"],
+                        timeout_s=15)
+                return
+            except (BootError, subprocess.TimeoutExpired):
+                time.sleep(5)
+        raise BootError("ssh did not come up during boot")
+
+    # -- instance interface ----------------------------------------------
+
+    def copy(self, host_src: str) -> str:
+        dst = "/" + os.path.basename(host_src)
+        run_ssh(["scp", *ssh_args(self.env.sshkey, self.env.ssh_user,
+                                  self.ssh_port),
+                 "-P", str(self.ssh_port), host_src,
+                 f"{self.env.ssh_user}@127.0.0.1:{dst}"], timeout_s=180)
+        return dst
+
+    def forward(self, port: int) -> str:
+        # Reverse-forward a guest port to the host port over ssh -R.
+        guest_port = _free_port()
+        self._fwd_ports.append((guest_port, port))
+        return f"127.0.0.1:{guest_port}"
+
+    def run(self, timeout_s: float, stop: threading.Event,
+            command: str) -> OutputStream:
+        stream = OutputStream()
+        args = ["ssh", *ssh_args(self.env.sshkey, self.env.ssh_user,
+                                 self.ssh_port)]
+        for guest_port, host_port in self._fwd_ports:
+            args += ["-R", f"{guest_port}:127.0.0.1:{host_port}"]
+        args += [f"{self.env.ssh_user}@127.0.0.1", command]
+        proc = subprocess.Popen(args, stdin=subprocess.DEVNULL,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+
+        # Merge the ssh channel and the serial console into one stream
+        # (reference: vmimpl merger) — console carries the oopses.
+        def pump_console():
+            while not stop.is_set() and proc.poll() is None:
+                chunk = self._console.get(timeout=0.5)
+                if chunk is None:
+                    if self._console.finished:
+                        break
+                    continue
+                stream.put(chunk)
+
+        threading.Thread(target=pump_console, daemon=True).start()
+        pump_fd(proc.stdout, stream, proc, stop, timeout_s)
+        return stream
+
+    def diagnose(self) -> bytes:
+        return bytes(self._console_buf[-(128 << 10):])
+
+    def close(self) -> None:
+        self._console_stop.set()
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait()
+
+
+class QemuPool(PoolImpl):
+    def __init__(self, env: Env):
+        self.env = env
+        self._count = int(env.config.get("count", 1))
+
+    def count(self) -> int:
+        return self._count
+
+    def create(self, workdir: str, index: int) -> Instance:
+        return QemuInstance(workdir, index, self.env)
+
+
+register_vm_type("qemu", QemuPool)

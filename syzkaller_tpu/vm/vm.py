@@ -1,0 +1,144 @@
+"""VM facade + execution monitor.
+
+Wraps backend pools/instances with workdir management and implements
+monitor_execution: the console-scanning loop that turns raw output
+into crash reports and detects silent deaths (reference: vm/vm.go:30-110
+Pool/Instance wrappers, vm.go:110+ MonitorExecution with its
+no-output [3 min] and not-executing [3 min] timeouts).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from syzkaller_tpu.report import Report, Reporter
+from syzkaller_tpu.vm.vmimpl import (BootError, Env, Instance, OutputStream,
+                                     create_pool_impl)
+
+NO_OUTPUT_TIMEOUT = 3 * 60.0  # reference: vm/vm.go noOutputTimeout
+NOT_EXECUTING_TIMEOUT = 3 * 60.0
+MAX_CRASH_TAIL_WAIT = 10.0  # drain window after an oops appears
+EXECUTING_MARKER = b"executing program"
+
+_ = Instance  # re-exported
+
+
+class Pool:
+    """(reference: vm/vm.go:30-64)"""
+
+    def __init__(self, impl, workdir: str):
+        self._impl = impl
+        self.workdir = workdir
+
+    def count(self) -> int:
+        return self._impl.count()
+
+    def create(self, index: int) -> Instance:
+        if not 0 <= index < self.count():
+            raise ValueError(f"invalid VM index {index}/{self.count()}")
+        wd = os.path.join(self.workdir, f"instance-{index}")
+        shutil.rmtree(wd, ignore_errors=True)
+        os.makedirs(wd, exist_ok=True)
+        return self._impl.create(wd, index)
+
+
+def create_pool(cfg) -> Pool:
+    """Build a Pool from a manager Config (reference: vm/vm.go:52)."""
+    workdir = os.path.join(cfg.workdir, "instances")
+    os.makedirs(workdir, exist_ok=True)
+    env = Env(name=cfg.name, os=cfg.target_os, arch=cfg.target_arch,
+              workdir=workdir, image=cfg.image, sshkey=cfg.sshkey,
+              ssh_user=cfg.ssh_user, config=dict(cfg.vm))
+    if "count" not in env.config:
+        env.config["count"] = cfg.count
+    return Pool(create_pool_impl(cfg.type, env), workdir)
+
+
+@dataclass
+class MonitorResult:
+    report: Optional[Report]  # crash found (None = clean finish)
+    output: bytes
+    timed_out: bool = False
+    lost_connection: bool = False
+
+
+def monitor_execution(stream: OutputStream, reporter: Reporter,
+                      need_executing: bool = True,
+                      no_output_timeout: float = NO_OUTPUT_TIMEOUT,
+                      not_executing_timeout: float = NOT_EXECUTING_TIMEOUT,
+                      exit_ok: bool = False) -> MonitorResult:
+    """Consume an instance's output stream until it crashes, goes
+    silent, stops executing programs, or finishes
+    (reference: vm/vm.go:110-207 MonitorExecution)."""
+    output = bytearray()
+    last_output = time.monotonic()
+    last_executing = time.monotonic()
+    scanned_pos = 0
+
+    def synthetic(title: str, **kw) -> MonitorResult:
+        rep = Report(title=title, output=bytes(output),
+                     report=bytes(output[-(16 << 10):]))
+        return MonitorResult(report=rep, output=bytes(output), **kw)
+
+    while True:
+        now = time.monotonic()
+        chunk = stream.get(timeout=5.0)
+        if chunk is None:
+            if stream.finished:
+                # Stream over: crashed executor/lost machine vs clean end.
+                rep = reporter.parse(bytes(output))
+                if rep is not None:
+                    return MonitorResult(report=rep, output=bytes(output))
+                if stream.error is not None:
+                    return synthetic("lost connection to test machine",
+                                     lost_connection=True)
+                if exit_ok:
+                    return MonitorResult(report=None, output=bytes(output))
+                return synthetic("lost connection to test machine",
+                                 lost_connection=True)
+            if now - last_output > no_output_timeout:
+                return synthetic("no output from test machine",
+                                 timed_out=True)
+            if need_executing and now - last_executing > not_executing_timeout:
+                return synthetic("test machine is not executing programs",
+                                 timed_out=True)
+            continue
+        output += chunk
+        last_output = now
+        if EXECUTING_MARKER in chunk or \
+                EXECUTING_MARKER in output[max(0, len(output)
+                                               - len(chunk) - 64):]:
+            last_executing = now
+        # Scan only fresh data (minus an overlap for split lines).
+        scan_from = max(0, scanned_pos - 512)
+        if reporter.contains_crash(bytes(output[scan_from:])):
+            _drain_tail(stream, output)
+            rep = reporter.parse(bytes(output))
+            if rep is None:  # raced with an ignore rule; keep watching
+                scanned_pos = len(output)
+                continue
+            return MonitorResult(report=rep, output=bytes(output))
+        scanned_pos = len(output)
+
+
+def _drain_tail(stream: OutputStream, output: bytearray,
+                wait_s: float = MAX_CRASH_TAIL_WAIT) -> None:
+    """After an oops, keep collecting for a bounded window so the
+    report includes the full stack trace (vm.go waitForOutput)."""
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        chunk = stream.get(timeout=0.2)
+        if chunk is None:
+            if stream.finished:
+                return
+            continue
+        output += chunk
+
+
+__all__ = ["Pool", "Instance", "create_pool", "monitor_execution",
+           "MonitorResult", "BootError"]

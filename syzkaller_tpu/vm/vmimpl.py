@@ -1,0 +1,183 @@
+"""VM backend plugin interface.
+
+Backends register a Pool constructor by type name; a Pool boots
+Instances which expose copy/forward/run/close (reference:
+vm/vmimpl/vmimpl.go:21-78 — Pool/Instance interfaces, ctor registry,
+BootError).  Console/command output streams through an OutputStream:
+a queue of byte chunks plus a terminal error slot, the Python shape of
+the reference's (outc <-chan []byte, errc <-chan error) pair.
+"""
+
+from __future__ import annotations
+
+import queue
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+class BootError(Exception):
+    """Infrastructure (not kernel-bug) boot failure; the caller retries
+    with a fresh instance (reference: vmimpl.go:58-66)."""
+
+
+@dataclass
+class Env:
+    """Backend-independent creation params
+    (reference: vmimpl.go:30-44)."""
+    name: str = ""
+    os: str = "test"
+    arch: str = "64"
+    workdir: str = ""
+    image: str = ""
+    sshkey: str = ""
+    ssh_user: str = "root"
+    debug: bool = False
+    timeouts_scale: float = 1.0
+    config: dict = field(default_factory=dict)  # vm-type blob
+
+
+class OutputStream:
+    """Console/command output: chunks via get(), terminal status via
+    .error / .finished."""
+
+    _EOF = object()
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self.error: Optional[Exception] = None
+        self.finished = False
+
+    def put(self, chunk: bytes) -> None:
+        self._q.put(chunk)
+
+    def finish(self, error: Optional[Exception] = None) -> None:
+        self.error = error
+        self._q.put(self._EOF)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        """Next chunk, or None on EOF/timeout (check .finished)."""
+        if self.finished:
+            return None
+        try:
+            chunk = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if chunk is self._EOF:
+            self.finished = True
+            return None
+        return chunk
+
+
+class Instance:
+    """One VM (reference: vmimpl.go:46-56)."""
+
+    def copy(self, host_src: str) -> str:
+        """Copy a host file into the instance; returns the VM path."""
+        raise NotImplementedError
+
+    def forward(self, port: int) -> str:
+        """Set up VM→host forwarding for the host port; returns the
+        address to use inside the VM."""
+        raise NotImplementedError
+
+    def run(self, timeout_s: float, stop: threading.Event,
+            command: str) -> OutputStream:
+        """Run command in the VM; the stream carries merged console +
+        command output (reference: vmimpl.go:52-55)."""
+        raise NotImplementedError
+
+    def diagnose(self) -> bytes:
+        """Extra debugging info on hang (e.g. sysrq dumps)."""
+        return b""
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class PoolImpl:
+    """(reference: vmimpl.go:21-28)"""
+
+    def count(self) -> int:
+        raise NotImplementedError
+
+    def create(self, workdir: str, index: int) -> Instance:
+        raise NotImplementedError
+
+
+_CTORS: dict[str, Callable[[Env], PoolImpl]] = {}
+
+
+def register_vm_type(name: str, ctor: Callable[[Env], PoolImpl]) -> None:
+    _CTORS[name] = ctor
+
+
+def create_pool_impl(typ: str, env: Env) -> PoolImpl:
+    from syzkaller_tpu.vm import isolated, local, qemu  # noqa: F401
+
+    ctor = _CTORS.get(typ)
+    if ctor is None:
+        raise ValueError(f"unknown VM type {typ!r} "
+                         f"(known: {sorted(_CTORS)})")
+    return ctor(env)
+
+
+# -- shared helpers (reference: vmimpl.go ssh/scp/merger utils) ----------
+
+
+def pump_fd(fd_file, stream: OutputStream, proc: subprocess.Popen,
+            stop: threading.Event, timeout_s: float,
+            on_exit: Optional[Callable[[], Optional[Exception]]] = None
+            ) -> threading.Thread:
+    """Pump a file object into an OutputStream until EOF/stop/timeout;
+    kills proc on stop/timeout (the vmimpl merger+timeout pattern)."""
+
+    def loop():
+        deadline = time.monotonic() + timeout_s
+        try:
+            while True:
+                if stop.is_set() or time.monotonic() > deadline:
+                    proc.kill()
+                    break
+                chunk = fd_file.read1(1 << 14) \
+                    if hasattr(fd_file, "read1") else fd_file.read(1 << 14)
+                if not chunk:
+                    break
+                stream.put(chunk)
+        except (OSError, ValueError):
+            pass
+        proc.wait()
+        err = on_exit() if on_exit is not None else None
+        if err is None and stop.is_set():
+            err = None  # requested stop is a clean finish
+        elif err is None and time.monotonic() > deadline:
+            err = TimeoutError("command timed out")
+        stream.finish(err)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+def run_ssh(args: list[str], timeout_s: float = 60.0) -> bytes:
+    """One-shot helper for scp/ssh control commands."""
+    res = subprocess.run(args, capture_output=True, timeout=timeout_s)
+    if res.returncode != 0:
+        raise BootError(
+            f"{' '.join(args[:2])} failed: {res.stderr.decode()[-512:]}")
+    return res.stdout
+
+
+def ssh_args(sshkey: str, user: str, port: int = 22) -> list[str]:
+    """(reference: vmimpl.go SSHArgs)"""
+    args = ["-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null",
+            "-o", "BatchMode=yes",
+            "-o", "IdentitiesOnly=yes",
+            "-o", "ConnectTimeout=10",
+            "-p", str(port)]
+    if sshkey:
+        args += ["-i", sshkey]
+    return args

@@ -1,0 +1,235 @@
+"""Crash report parsing + VM layer tests."""
+
+import threading
+import time
+
+import pytest
+
+from syzkaller_tpu.report import get_reporter
+from syzkaller_tpu.vm.vm import monitor_execution
+from syzkaller_tpu.vm.vmimpl import Env, OutputStream, create_pool_impl
+
+
+# -- report parsing ------------------------------------------------------
+
+KASAN_LOG = b"""\
+[  123.456789] ==================================================================
+[  123.456790] BUG: KASAN: use-after-free in ip6_send_skb+0x2f5/0x330
+[  123.456791] Read of size 8 at addr ffff8800398b4e00 by task syz-executor/1234
+[  123.456792] Call Trace:
+[  123.456793]  dump_stack+0x1b2/0x281
+[  123.456794]  print_address_description+0x6f/0x22b
+[  123.456795]  kasan_report+0x23f/0x350
+[  123.456796]  ip6_send_skb+0x2f5/0x330
+[  123.456797]  udpv6_sendmsg+0x2c1a/0x3420
+"""
+
+WARNING_LOG = b"""\
+[   45.1] WARNING: CPU: 1 PID: 4321 at net/core/dev.c:2345 skb_warn_bad_offload+0x2bc/0x2d0
+[   45.2] Call Trace:
+[   45.3]  __warn+0x1b2/0x281
+[   45.4]  skb_warn_bad_offload+0x2bc/0x2d0
+"""
+
+HUNG_LOG = b"""\
+INFO: task syz-executor7:11249 blocked for more than 120 seconds.
+      Not tainted 4.14.0+ #35
+"""
+
+DEADLOCK_LOG = b"""\
+======================================================
+WARNING: possible circular locking dependency detected
+4.14.0-rc5+ #62 Not tainted
+------------------------------------------------------
+"""
+
+GPF_LOG = b"""\
+kasan: GPF could be caused by NULL-ptr deref or user memory access
+general protection fault: 0000 [#1] SMP KASAN
+Modules linked in:
+CPU: 1 PID: 22753 Comm: syz-executor3 Not tainted 4.14.0+
+task: ffff8801cc1a45c0 task.stack: ffff8801c08a8000
+RIP: 0010:sctp_stream_free+0xb1/0x120
+Call Trace:
+ sctp_association_free+0x1f0/0x740
+"""
+
+SIM_LOG = b"""\
+spawning child 1234
+BUG: sim-kernel: use-after-free in sim_call_17
+Call Trace:
+ sim_call_17+0x3fc
+ sim_dispatch+0x11
+"""
+
+PANIC_LOG = b"Kernel panic - not syncing: Fatal exception in interrupt\n"
+
+
+@pytest.fixture(scope="module")
+def linux_reporter():
+    return get_reporter("linux")
+
+
+@pytest.mark.parametrize("log,title", [
+    (KASAN_LOG, "KASAN: use-after-free in ip6_send_skb"),
+    (WARNING_LOG, "WARNING in skb_warn_bad_offload"),
+    (HUNG_LOG, "INFO: task hung in syz-executor7"),
+    (DEADLOCK_LOG, "possible deadlock (circular locking)"),
+    (GPF_LOG, "general protection fault in sctp_stream_free"),
+    (SIM_LOG, "BUG: sim-kernel: use-after-free in sim_call_17"),
+    (PANIC_LOG, "kernel panic: Fatal exception in interrupt"),
+])
+def test_parse_titles(linux_reporter, log, title):
+    assert linux_reporter.contains_crash(log)
+    rep = linux_reporter.parse(log)
+    assert rep is not None
+    assert rep.title == title
+
+
+def test_no_crash(linux_reporter):
+    clean = b"booting...\nexecuting program 0:\nr0 = open(...)\nall good\n"
+    assert not linux_reporter.contains_crash(clean)
+    assert linux_reporter.parse(clean) is None
+
+
+def test_title_dedup_across_addresses(linux_reporter):
+    log2 = KASAN_LOG.replace(b"ffff8800398b4e00", b"ffff88003deadbee") \
+                    .replace(b"0x2f5/0x330", b"0x111/0x330")
+    assert linux_reporter.parse(KASAN_LOG).title == \
+        linux_reporter.parse(log2).title
+
+
+def test_guilty_function_skips_infrastructure(linux_reporter):
+    rep = linux_reporter.parse(KASAN_LOG)
+    # dump_stack/print_address_description/kasan_report are never guilty
+    assert rep.guilty_file == "ip6_send_skb"
+
+
+def test_corrupted_without_stack(linux_reporter):
+    log = b"BUG: KASAN: use-after-free in foo_bar+0x11/0x20\n(cut)\n"
+    rep = linux_reporter.parse(log)
+    assert rep.corrupted
+
+
+def test_suppressions():
+    r = get_reporter("linux", suppressions=["KASAN: use-after-free in ip6"])
+    rep = r.parse(KASAN_LOG)
+    assert rep.suppressed
+    rep2 = r.parse(WARNING_LOG)
+    assert not rep2.suppressed
+
+
+def test_ignores_line():
+    r = get_reporter("linux", ignores=[rb"WARNING: CPU: \d+ PID"])
+    assert not r.contains_crash(WARNING_LOG)
+    assert r.contains_crash(KASAN_LOG)
+
+
+def test_sim_reporter_registered():
+    r = get_reporter("test")
+    assert r.parse(SIM_LOG).title == \
+        "BUG: sim-kernel: use-after-free in sim_call_17"
+
+
+# -- vm monitor ----------------------------------------------------------
+
+
+def _feed(stream, chunks, finish_error=None, delay=0.0):
+    def run():
+        for c in chunks:
+            if delay:
+                time.sleep(delay)
+            stream.put(c)
+        stream.finish(finish_error)
+
+    threading.Thread(target=run, daemon=True).start()
+
+
+def test_monitor_detects_crash(linux_reporter):
+    stream = OutputStream()
+    _feed(stream, [b"executing program 1\n", KASAN_LOG, b"tail\n"])
+    res = monitor_execution(stream, linux_reporter)
+    assert res.report is not None
+    assert res.report.title == "KASAN: use-after-free in ip6_send_skb"
+
+
+def test_monitor_clean_exit(linux_reporter):
+    stream = OutputStream()
+    _feed(stream, [b"executing program 1\ndone\n"])
+    res = monitor_execution(stream, linux_reporter, exit_ok=True)
+    assert res.report is None
+
+
+def test_monitor_lost_connection(linux_reporter):
+    stream = OutputStream()
+    _feed(stream, [b"executing program 1\n"],
+          finish_error=RuntimeError("ssh died"))
+    res = monitor_execution(stream, linux_reporter)
+    assert res.report.title == "lost connection to test machine"
+    assert res.lost_connection
+
+
+def test_monitor_no_output_timeout(linux_reporter):
+    stream = OutputStream()
+    # nothing ever arrives; use a tiny timeout
+    res = monitor_execution(stream, linux_reporter,
+                            no_output_timeout=0.1,
+                            not_executing_timeout=0.1)
+    assert res.timed_out
+    assert "not executing programs" in res.report.title or \
+        "no output" in res.report.title
+
+
+def test_monitor_not_executing(linux_reporter):
+    stream = OutputStream()
+
+    def chatter():
+        for _ in range(8):
+            stream.put(b"chatter but no exec marker\n")
+            time.sleep(0.05)
+        stream.finish()
+
+    threading.Thread(target=chatter, daemon=True).start()
+    res = monitor_execution(stream, linux_reporter,
+                            not_executing_timeout=0.2,
+                            no_output_timeout=60)
+    assert res.report.title in ("test machine is not executing programs",
+                                "lost connection to test machine")
+
+
+# -- local pool ----------------------------------------------------------
+
+
+def test_local_pool_run_and_crash_detection(tmp_path, linux_reporter):
+    env = Env(name="t", os="test", workdir=str(tmp_path),
+              config={"count": 2})
+    pool = create_pool_impl("local", env)
+    assert pool.count() == 2
+    inst = pool.create(str(tmp_path / "inst0"), 0)
+    # copy
+    src = tmp_path / "payload.txt"
+    src.write_text("hello")
+    dst = inst.copy(str(src))
+    assert open(dst).read() == "hello"
+    # run a command that prints an exec marker then a crash
+    stop = threading.Event()
+    stream = inst.run(
+        30.0, stop,
+        "echo 'executing program 0'; "
+        "echo 'BUG: sim-kernel: use-after-free in sim_call_3'; "
+        "printf 'Call Trace:\\n sim_call_3+0x1f\\n sim_dispatch+0x11\\n'")
+    res = monitor_execution(stream, linux_reporter, exit_ok=True)
+    assert res.report is not None
+    assert res.report.title == "BUG: sim-kernel: use-after-free in sim_call_3"
+    inst.close()
+
+
+def test_local_pool_clean_run(tmp_path, linux_reporter):
+    env = Env(name="t", os="test", workdir=str(tmp_path), config={})
+    pool = create_pool_impl("local", env)
+    inst = pool.create(str(tmp_path / "inst0"), 0)
+    stop = threading.Event()
+    stream = inst.run(30.0, stop, "echo 'executing program 0'; sleep 0.1")
+    res = monitor_execution(stream, linux_reporter, exit_ok=True)
+    assert res.report is None
+    inst.close()
