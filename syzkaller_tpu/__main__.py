@@ -1,0 +1,49 @@
+"""Unified CLI dispatcher: `python -m syzkaller_tpu <tool> [args...]`.
+
+Mirrors the reference's bin/syz-* binaries (Makefile:3-28 build
+matrix) as subcommands of one entry point.
+"""
+
+from __future__ import annotations
+
+import sys
+
+_TOOLS = {
+    "manager": ("syzkaller_tpu.tools.manager_tool", "the manager daemon"),
+    "fuzzer": ("syzkaller_tpu.fuzzer.main", "guest-side fuzzer process"),
+    "hub": ("syzkaller_tpu.hub.hub", "corpus-exchange hub server"),
+    "execprog": ("syzkaller_tpu.tools.execprog", "execute programs"),
+    "stress": ("syzkaller_tpu.tools.stress", "local stress fuzzing"),
+    "mutate": ("syzkaller_tpu.tools.mutate", "mutate a single program"),
+    "prog2c": ("syzkaller_tpu.tools.prog2c", "program → C translator"),
+    "repro": ("syzkaller_tpu.tools.repro_tool",
+              "extract reproducer from crash log"),
+    "crush": ("syzkaller_tpu.tools.crush", "replay crash log"),
+    "db": ("syzkaller_tpu.tools.db_tool", "corpus.db pack/unpack/merge"),
+    "benchcmp": ("syzkaller_tpu.tools.benchcmp",
+                 "render bench JSON to HTML charts"),
+    "symbolize": ("syzkaller_tpu.tools.symbolize",
+                  "symbolize a crash report"),
+}
+
+
+def main() -> int:
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help", "help"):
+        print("usage: python -m syzkaller_tpu <tool> [args...]\n\ntools:")
+        for name, (_, desc) in sorted(_TOOLS.items()):
+            print(f"  {name:<10} {desc}")
+        return 0
+    tool = sys.argv[1]
+    entry = _TOOLS.get(tool)
+    if entry is None:
+        print(f"unknown tool {tool!r} (try: help)", file=sys.stderr)
+        return 1
+    import importlib
+
+    mod = importlib.import_module(entry[0])
+    ret = mod.main(sys.argv[2:])
+    return ret if isinstance(ret, int) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,4 @@
+from syzkaller_tpu.csource.csource import Options, write_csource
+from syzkaller_tpu.csource.build import build_csource
+
+__all__ = ["Options", "write_csource", "build_csource"]

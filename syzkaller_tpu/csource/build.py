@@ -1,0 +1,37 @@
+"""Compile generated C reproducers (reference: pkg/csource/build.go)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+
+class BuildError(Exception):
+    pass
+
+
+def build_csource(src: bytes, out_path: Optional[str] = None,
+                  cc: str = "gcc", extra_flags: Optional[list[str]] = None
+                  ) -> str:
+    """Compile to a binary; returns its path (caller owns the file)."""
+    fd, src_path = tempfile.mkstemp(suffix=".c", prefix="tz-repro-")
+    with os.fdopen(fd, "wb") as f:
+        f.write(src)
+    if out_path is None:
+        fd2, out_path = tempfile.mkstemp(prefix="tz-repro-bin-")
+        os.close(fd2)
+    args = [cc, "-o", out_path, src_path, "-O1", "-static-pie", "-pthread",
+            *(extra_flags or [])]
+    res = subprocess.run(args, capture_output=True)
+    if res.returncode != 0:
+        # -static-pie unsupported on some toolchains: retry dynamic
+        args = [cc, "-o", out_path, src_path, "-O1", "-pthread",
+                *(extra_flags or [])]
+        res = subprocess.run(args, capture_output=True)
+    os.unlink(src_path)
+    if res.returncode != 0:
+        raise BuildError(f"failed to build reproducer:\n"
+                         f"{res.stderr.decode()[-2048:]}")
+    return out_path

@@ -1,0 +1,378 @@
+"""Standalone C reproducer generation.
+
+Renders a typed Prog into a self-contained C program that replays it:
+arena mmap, copyins (including bitfields, result back-references and
+runtime inet checksums), the call sequence with result tracking, and
+an option matrix for repetition / multi-process / threaded execution /
+fault injection / sandboxing (reference: pkg/csource/csource.go:17
+Write, 299 generateCalls; options matrix pkg/csource/options.go:15-39).
+
+Linux targets emit raw syscall(NR, ...) invocations; the hermetic
+"test" target emits calls through a stub sim_call() so generated
+sources always compile.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from syzkaller_tpu.models.checksum import (CsumChunkKind, CsumKind,
+                                           calc_checksums_call)
+from syzkaller_tpu.models.prog import (Arg, ConstArg, DataArg, GroupArg,
+                                       PointerArg, Prog, ResultArg, UnionArg,
+                                       foreach_arg)
+from syzkaller_tpu.models.types import (CsumType, Dir, ProcType, is_pad)
+
+
+@dataclass
+class Options:
+    """(reference: pkg/csource/options.go:15-39)"""
+    threaded: bool = False
+    collide: bool = False
+    repeat: bool = False
+    procs: int = 1
+    sandbox: str = "none"
+    fault: bool = False
+    fault_call: int = -1
+    fault_nth: int = 0
+    use_tmp_dir: bool = True
+
+    def serialize(self) -> str:
+        """One-line option descriptor stored with repro artifacts
+        (reference: options.go Serialize)."""
+        return ("{" + f"threaded:{self.threaded} collide:{self.collide} "
+                f"repeat:{self.repeat} procs:{self.procs} "
+                f"sandbox:{self.sandbox} fault:{self.fault} "
+                f"fault_call:{self.fault_call} fault_nth:{self.fault_nth}"
+                + "}")
+
+    @staticmethod
+    def deserialize(s: str) -> "Options":
+        opts = Options()
+        for tok in s.strip("{}\n ").split():
+            k, _, v = tok.partition(":")
+            if not hasattr(opts, k):
+                continue
+            cur = getattr(opts, k)
+            if isinstance(cur, bool):
+                setattr(opts, k, v == "True" or v == "true")
+            elif isinstance(cur, int):
+                setattr(opts, k, int(v))
+            else:
+                setattr(opts, k, v)
+        return opts
+
+
+def write_csource(p: Prog, opts: Options | None = None) -> bytes:
+    opts = opts or Options()
+    return _Renderer(p, opts).render().encode()
+
+
+class _Renderer:
+    def __init__(self, p: Prog, opts: Options):
+        self.p = p
+        self.opts = opts
+        self.target = p.target
+        self.lines: list[str] = []
+        self.res_index: dict[int, int] = {}  # id(ResultArg) -> r[] slot
+        self._assign_results()
+
+    def _assign_results(self) -> None:
+        n = 0
+        for c in self.p.calls:
+            if c.ret is not None and len(c.ret.uses) != 0:
+                self.res_index[id(c.ret)] = n
+                n += 1
+
+            def visit(arg: Arg, ctx) -> None:
+                nonlocal n
+                if isinstance(arg, ResultArg) and len(arg.uses) != 0 \
+                        and id(arg) not in self.res_index:
+                    self.res_index[id(arg)] = n
+                    n += 1
+
+            foreach_arg(c, visit)
+        self.nres = n
+
+    # -- rendering --------------------------------------------------------
+
+    def render(self) -> str:
+        header = _HEADER
+        if self.target.os == "linux":
+            backend = _LINUX_BACKEND
+        else:
+            backend = _SIM_BACKEND
+        body = self._render_body()
+        main = self._render_main()
+        return "\n".join([header, backend, body, main, ""])
+
+    def _render_body(self) -> str:
+        out = []
+        if self.nres:
+            out.append(f"static intptr_t r[{self.nres}];")
+        out.append("static void execute_one(void)\n{")
+        if self.nres:
+            out.append(f"  for (int i = 0; i < {self.nres}; i++) "
+                       "r[i] = -1;")
+        for ci, c in enumerate(self.p.calls):
+            out.append(f"  // {c.meta.name}")
+            out.extend(self._render_copyins(c))
+            if self.opts.fault and self.opts.fault_call == ci:
+                out.append(f"  inject_fault({self.opts.fault_nth});")
+            out.append("  " + self._render_call(ci, c))
+        out.append("}")
+        return "\n".join(out)
+
+    def _render_copyins(self, c) -> list[str]:
+        target = self.target
+        out: list[str] = []
+        csum_map = calc_checksums_call(c)
+        csum_args: dict[int, int] = {}  # id(arg) -> addr, for csum pass
+
+        def copyin(arg: Arg, ctx) -> None:
+            if ctx.base is None:
+                return
+            addr = target.physical_addr(ctx.base) + ctx.offset
+            if isinstance(arg, (GroupArg, UnionArg)):
+                return
+            csum_args[id(arg)] = addr
+            t = arg.typ
+            if t.dir == Dir.OUT or is_pad(t) or arg.size() == 0:
+                return
+            if isinstance(arg, DataArg):
+                if not arg.data:
+                    return
+                lit = "".join(f"\\x{b:02x}" for b in arg.data)
+                out.append(f'  memcpy((void*)0x{addr:x}, "{lit}", '
+                           f"{len(arg.data)});")
+            elif isinstance(arg, ResultArg):
+                expr = self._result_expr(arg)
+                out.append(self._store(addr, arg.size(), expr, t))
+            elif isinstance(arg, ConstArg):
+                if isinstance(t, CsumType):
+                    return  # filled by the csum pass below
+                val, pid_stride, big_endian = arg.value()
+                expr = f"0x{val:x}"
+                if pid_stride:
+                    expr += f" + procid*{pid_stride}"
+                if big_endian:
+                    expr = f"htobe{t.size * 8}({expr})" if t.size > 1 \
+                        else expr
+                out.append(self._store(addr, arg.size(), expr, t))
+
+        foreach_arg(c, copyin)
+
+        if csum_map is not None:
+            entries = sorted(csum_map.values(),
+                             key=lambda e: csum_args[id(e[0])])
+            for arg, info in reversed(entries):
+                addr = csum_args[id(arg)]
+                assert info.kind == CsumKind.INET
+                out.append("  {\n    struct csum_inet csum;\n"
+                           "    csum_inet_init(&csum);")
+                for chunk in info.chunks:
+                    if chunk.kind == CsumChunkKind.ARG:
+                        caddr = csum_args[id(chunk.arg)]
+                        out.append(f"    csum_inet_update(&csum, "
+                                   f"(const uint8_t*)0x{caddr:x}, "
+                                   f"{chunk.arg.size()});")
+                    else:
+                        out.append(f"    uint64_t w{addr:x} = "
+                                   f"0x{chunk.value:x};\n"
+                                   f"    csum_inet_update(&csum, "
+                                   f"(const uint8_t*)&w{addr:x}, "
+                                   f"{chunk.size});")
+                out.append(f"    *(uint16_t*)0x{addr:x} = "
+                           "csum_inet_digest(&csum);\n  }")
+        return out
+
+    def _store(self, addr: int, size: int, expr: str, t) -> str:
+        bf_off = getattr(t, "bitfield_off", 0)
+        bf_len = getattr(t, "bitfield_len", 0)
+        if bf_len:
+            return (f"  STORE_BY_BITMASK(uint{t.size * 8}_t, "
+                    f"0x{addr:x}, {expr}, {bf_off}, {bf_len});")
+        ctype = {1: "uint8_t", 2: "uint16_t", 4: "uint32_t",
+                 8: "uint64_t"}.get(size, "uint64_t")
+        return f"  *({ctype}*)0x{addr:x} = {expr};"
+
+    def _result_expr(self, arg: ResultArg) -> str:
+        if arg.res is None:
+            return f"0x{arg.val:x}"
+        idx = self.res_index.get(id(arg.res))
+        if idx is None:
+            return f"0x{arg.typ.default():x}" \
+                if hasattr(arg.typ, "default") else "-1"
+        expr = f"r[{idx}]"
+        if getattr(arg, "op_div", 0):
+            expr = f"({expr}/{arg.op_div})"
+        if getattr(arg, "op_add", 0):
+            expr = f"({expr}+{arg.op_add})"
+        return expr
+
+    def _render_call(self, ci: int, c) -> str:
+        args = []
+        for arg in c.args:
+            args.append(self._scalar(arg))
+        ret = ""
+        if c.ret is not None and id(c.ret) in self.res_index:
+            ret = f"r[{self.res_index[id(c.ret)]}] = "
+        if self.target.os == "linux":
+            call = f"syscall({c.meta.nr}"
+            if args:
+                call += ", " + ", ".join(args)
+            call += ")"
+        else:
+            call = f"sim_call({c.meta.nr}"
+            for a in args:
+                call += f", (intptr_t)({a})"
+            call += ")"
+        return f"{ret}{call};"
+
+    def _scalar(self, arg: Arg) -> str:
+        if isinstance(arg, PointerArg):
+            if arg.is_null():
+                return "0"
+            return f"0x{self.target.physical_addr(arg):x}"
+        if isinstance(arg, ResultArg):
+            return self._result_expr(arg)
+        if isinstance(arg, ConstArg):
+            val, pid_stride, _ = arg.value()
+            expr = f"0x{val:x}"
+            if pid_stride:
+                expr += f" + procid*{pid_stride}"
+            return expr
+        if isinstance(arg, UnionArg):
+            return self._scalar(arg.option)
+        return "0"
+
+    def _render_main(self) -> str:
+        o = self.opts
+        out = ["int main(void)\n{"]
+        base = self.target.data_offset
+        size = self.target.num_pages * self.target.page_size
+        out.append(f"  mmap((void*)0x{base:x}, 0x{size:x}, "
+                   "PROT_READ|PROT_WRITE, "
+                   "MAP_ANONYMOUS|MAP_PRIVATE|MAP_FIXED, -1, 0);")
+        if o.use_tmp_dir:
+            out.append("  use_temporary_dir();")
+        out.append(f"  install_segv_handler();")
+        if o.sandbox == "setuid":
+            out.append("  sandbox_setuid();")
+        loop_body = "execute_one();"
+        if o.repeat:
+            loop_body = "for (;;) { execute_one(); }"
+        if o.procs > 1:
+            out.append(f"  for (procid = 0; procid < {o.procs}; "
+                       "procid++) {")
+            out.append("    if (fork() == 0) {")
+            out.append(f"      {loop_body}")
+            out.append("      exit(0);")
+            out.append("    }")
+            out.append("  }")
+            out.append("  sleep(1000000);")
+        else:
+            out.append(f"  {loop_body}")
+        out.append("  return 0;\n}")
+        return "\n".join(out)
+
+
+_HEADER = r"""// autogenerated C reproducer
+#define _GNU_SOURCE
+#include <endian.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <setjmp.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static int procid;
+
+#define STORE_BY_BITMASK(type, addr, val, bf_off, bf_len)             \
+  do {                                                                \
+    type __v = *(type*)(addr);                                        \
+    __v &= ~(((((type)1 << (bf_len)) - 1)) << (bf_off));              \
+    __v |= ((type)(val) & (((type)1 << (bf_len)) - 1)) << (bf_off);   \
+    *(type*)(addr) = __v;                                             \
+  } while (0)
+
+// tolerate wild stores into unmapped corners of the arena
+static __thread sigjmp_buf segv_env;
+static void segv_handler(int sig)
+{
+  (void)sig;
+  siglongjmp(segv_env, 1);
+}
+static void install_segv_handler(void)
+{
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = segv_handler;
+  sigaction(SIGSEGV, &sa, NULL);
+  sigaction(SIGBUS, &sa, NULL);
+}
+
+static void use_temporary_dir(void)
+{
+  char tmpdir_template[] = "./syzkaller.XXXXXX";
+  char* tmpdir = mkdtemp(tmpdir_template);
+  if (!tmpdir) return;
+  if (chmod(tmpdir, 0777)) {}
+  if (chdir(tmpdir)) {}
+}
+
+static void sandbox_setuid(void)
+{
+  if (setgid(65534)) {}
+  if (setuid(65534)) {}
+}
+
+struct csum_inet {
+  uint32_t acc;
+};
+static void csum_inet_init(struct csum_inet* csum) { csum->acc = 0; }
+static void csum_inet_update(struct csum_inet* csum, const uint8_t* data,
+                             size_t length)
+{
+  if (length == 0) return;
+  size_t i;
+  for (i = 0; i < length - 1; i += 2)
+    csum->acc += *(uint16_t*)&data[i];
+  if (length & 1) csum->acc += (uint16_t)data[length - 1];
+  while (csum->acc > 0xffff)
+    csum->acc = (csum->acc & 0xffff) + (csum->acc >> 16);
+}
+static uint16_t csum_inet_digest(struct csum_inet* csum)
+{
+  return ~csum->acc;
+}
+
+static void inject_fault(int nth)
+{
+  // fail-nth via procfs when available (reference:
+  // executor/common_linux.h fault injection setup)
+  int fd = open("/proc/thread-self/fail-nth", O_RDWR);
+  if (fd < 0) return;
+  char buf[16];
+  snprintf(buf, sizeof(buf), "%d", nth + 1);
+  if (write(fd, buf, strlen(buf))) {}
+  close(fd);
+}"""
+
+_LINUX_BACKEND = r"""// direct syscall backend"""
+
+_SIM_BACKEND = r"""// hermetic test-target backend: calls are logged no-ops so the
+// reproducer structure (copyins, dataflow, options) stays verifiable
+static intptr_t sim_call(intptr_t nr, ...)
+{
+  return nr >= 0 ? 0 : -1;
+}"""

@@ -134,6 +134,17 @@ class Fuzzer:
             self.stats = [0] * len(Stat)
         return out
 
+    def restore_poll_data(self, sig: Signal, stats: dict[str, int]) -> None:
+        """Re-queue drained poll payload after a failed RPC so the
+        delta is retransmitted next time."""
+        by_name = {name: s for s, name in STAT_NAMES.items()}
+        with self._lock:
+            self.new_signal.merge(sig)
+            for name, v in stats.items():
+                s = by_name.get(name)
+                if s is not None:
+                    self.stats[s] += v
+
     # -- signal bookkeeping ----------------------------------------------
 
     def check_new_signal(self, p: Prog, infos) -> list[tuple[int, Signal]]:

@@ -168,14 +168,21 @@ class FuzzerProcess:
 
     def poll_once(self, need_candidates: Optional[bool] = None) -> dict:
         new_sig = self.fuzzer.grab_new_signal()
+        stats = self.fuzzer.grab_stats()
         if need_candidates is None:
             need_candidates = self.fuzzer.wq.want_candidates()
-        res = self.conn.call("Manager.Poll", {
-            "name": self.name,
-            "need_candidates": bool(need_candidates),
-            "stats": self.fuzzer.grab_stats(),
-            "max_signal": list(new_sig.serialize()),
-        }) or {}
+        try:
+            res = self.conn.call("Manager.Poll", {
+                "name": self.name,
+                "need_candidates": bool(need_candidates),
+                "stats": stats,
+                "max_signal": list(new_sig.serialize()),
+            }) or {}
+        except Exception:
+            # The drained delta must not be lost on a transient RPC
+            # failure — put it back for the next poll.
+            self.fuzzer.restore_poll_data(new_sig, stats)
+            raise
         ms = res.get("max_signal") or [[], []]
         self.fuzzer.add_max_signal(Signal.deserialize(ms[0], ms[1]))
         for inp in res.get("new_inputs") or []:

@@ -99,6 +99,9 @@ class Proc:
         self.exec_opts_comps = ExecOpts(flags=ExecFlags.COLLECT_COMPS)
         self.last_prog: Optional[Prog] = None
         self._corpus_cache: list[Prog] = []
+        # Console program logging: on under a manager/VM (enables
+        # crash→repro), off standalone to keep the hot loop lean.
+        self.log_programs = fuzzer.conn is not None
 
     # -- main loop --------------------------------------------------------
 
@@ -274,6 +277,19 @@ class Proc:
         self.fuzzer.stat_add(stat)
         self.fuzzer.stat_add(Stat.EXEC_TOTAL)
         self.last_prog = p
+        # Log every executed program to the console: this is both the
+        # liveness marker scanned by monitor_execution and the data
+        # source for reproducer extraction via parse_log
+        # (reference: proc.go:249-262 logProgram).
+        if self.log_programs:
+            marker = f"executing program {self.pid}"
+            if opts.fault_call >= 0:
+                marker += (f" (fault-call:{opts.fault_call}"
+                           f" fault-nth:{opts.fault_nth})")
+            from syzkaller_tpu.models.encoding import serialize_prog
+
+            log.logf(0, "%s:\n%s", marker,
+                     serialize_prog(p).decode())
         data = serialize_for_exec(p)
         try:
             result = self.env.exec(opts, data)

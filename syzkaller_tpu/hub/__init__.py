@@ -1,0 +1,4 @@
+from syzkaller_tpu.hub.state import HubState
+from syzkaller_tpu.hub.hub import Hub, serve_hub
+
+__all__ = ["HubState", "Hub", "serve_hub"]

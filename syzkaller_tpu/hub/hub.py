@@ -1,0 +1,88 @@
+"""Hub RPC service: corpus exchange between managers.
+
+Serves Hub.Connect/Hub.Sync with client/key auth over the shared RPC
+transport (reference: syz-hub/hub.go:22-60 + pkg/rpctype Hub protocol
+rpctype.go:75-114).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from syzkaller_tpu.hub.state import HubState
+from syzkaller_tpu.rpc import RPCServer
+
+
+class Hub:
+    """RPC receiver.  clients maps client name -> key."""
+
+    def __init__(self, state: HubState, clients: Optional[dict] = None):
+        self.state = state
+        self.clients = clients or {}
+
+    def _auth(self, params: dict) -> str:
+        """Returns the canonical manager name "client-manager"
+        (reference: hub.go auth + name mangling)."""
+        client = params.get("client", "")
+        key = params.get("key", "")
+        if self.clients and self.clients.get(client) != key:
+            raise PermissionError(f"unauthorized client {client!r}")
+        manager = params.get("manager", "") or client
+        return f"{client}-{manager}" if client else manager
+
+    def Connect(self, params: dict) -> dict:
+        name = self._auth(params)
+        corpus = [p.encode() for p in params.get("corpus") or []]
+        self.state.connect(name, bool(params.get("fresh")), corpus)
+        return {}
+
+    def Sync(self, params: dict) -> dict:
+        name = self._auth(params)
+        progs, repros, more = self.state.sync(
+            name,
+            add=[p.encode() for p in params.get("add") or []],
+            delete=list(params.get("delete") or []),
+            repros=[p.encode() for p in params.get("repros") or []],
+            need_repros=bool(params.get("need_repros")),
+        )
+        return {"progs": [p.decode() for p in progs],
+                "repros": [p.decode() for p in repros],
+                "more": more}
+
+
+def serve_hub(workdir: str, addr: tuple[str, int] = ("127.0.0.1", 0),
+              clients: Optional[dict] = None, target=None
+              ) -> tuple[RPCServer, Hub]:
+    state = HubState(workdir, target=target)
+    hub = Hub(state, clients)
+    srv = RPCServer(addr)
+    srv.register("Hub", hub)
+    srv.serve_in_background()
+    return srv, hub
+
+
+def main(argv=None) -> None:
+    import argparse
+    import time
+
+    ap = argparse.ArgumentParser(prog="tz-hub")
+    ap.add_argument("-workdir", required=True)
+    ap.add_argument("-addr", default="127.0.0.1:0")
+    ap.add_argument("-clients", default="",
+                    help="comma-separated client:key pairs")
+    args = ap.parse_args(argv)
+    from syzkaller_tpu.manager.mgrconfig import parse_addr
+
+    clients = {}
+    for pair in args.clients.split(","):
+        if ":" in pair:
+            c, _, k = pair.partition(":")
+            clients[c] = k
+    srv, _hub = serve_hub(args.workdir, parse_addr(args.addr), clients)
+    print(f"hub serving on {srv.addr[0]}:{srv.addr[1]}")
+    while True:
+        time.sleep(60)
+
+
+if __name__ == "__main__":
+    main()

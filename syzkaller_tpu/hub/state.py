@@ -1,0 +1,193 @@
+"""Hub corpus-exchange state.
+
+The hub federates corpora across managers: every synced program gets a
+monotonic sequence number in a global corpus; each manager tracks the
+last sequence it has consumed, so a sync streams it everything new
+from *other* managers (its own programs are filtered by hash).  Repro
+requests fan out to every other connected manager's pending queue.
+All state is durable: global corpus + per-manager metadata live in
+append-only DBs under the workdir (reference: syz-hub/state/state.go:54
+Make, 144 Connect, 178 Sync, 200/228 repro queues, 341 purgeCorpus).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from syzkaller_tpu.db import open_db
+from syzkaller_tpu.models.encoding import ParseError, deserialize_prog
+from syzkaller_tpu.utils import log
+from syzkaller_tpu.utils.hashsig import hash_string
+
+SYNC_BATCH = 1000  # progs per Sync response (state.go pendingBatch)
+
+
+@dataclass
+class ManagerState:
+    name: str
+    last_seq: int = 0  # highest global seq already delivered
+    own_hashes: set[str] = field(default_factory=set)
+    pending_repros: list[bytes] = field(default_factory=list)
+    seen_repros: set[str] = field(default_factory=set)
+    connected: bool = False
+
+
+class HubState:
+    def __init__(self, workdir: str, target=None):
+        os.makedirs(workdir, exist_ok=True)
+        self.workdir = workdir
+        self.target = target  # optional: validates incoming programs
+        self._lock = threading.Lock()
+        self.corpus_db = open_db(os.path.join(workdir, "corpus.db"))
+        self.managers: dict[str, ManagerState] = {}
+        self.next_seq = 1
+        for key, rec in self.corpus_db.records.items():
+            self.next_seq = max(self.next_seq, rec.seq + 1)
+        self._load_managers()
+
+    def _manager_dir(self, name: str) -> str:
+        safe = hash_string(name.encode())[:16]
+        d = os.path.join(self.workdir, "manager-" + safe)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _load_managers(self) -> None:
+        for entry in os.listdir(self.workdir):
+            if not entry.startswith("manager-"):
+                continue
+            d = os.path.join(self.workdir, entry)
+            try:
+                name = open(os.path.join(d, "name")).read().strip()
+                seq = int(open(os.path.join(d, "seq")).read().strip() or 0)
+            except (OSError, ValueError):
+                continue
+            mgr = ManagerState(name=name, last_seq=seq)
+            own = open_db(os.path.join(d, "corpus.db"))
+            mgr.own_hashes = set(own.records)
+            self.managers[name] = mgr
+
+    def _persist_manager(self, mgr: ManagerState) -> None:
+        d = self._manager_dir(mgr.name)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "name"), "w") as f:
+            f.write(mgr.name)
+        with open(os.path.join(d, "seq"), "w") as f:
+            f.write(str(mgr.last_seq))
+
+    # -- protocol ---------------------------------------------------------
+
+    def connect(self, name: str, fresh: bool,
+                corpus: list[bytes]) -> None:
+        """(reference: state.go:144-176)"""
+        with self._lock:
+            mgr = self.managers.get(name)
+            if mgr is None or fresh:
+                mgr = ManagerState(name=name)
+                self.managers[name] = mgr
+            mgr.connected = True
+            own_db = open_db(os.path.join(self._manager_dir(name),
+                                          "corpus.db"))
+            if fresh:
+                for key in list(own_db.records):
+                    own_db.delete(key)
+                mgr.last_seq = 0
+            for prog in corpus:
+                key = self._add_prog(name, mgr, prog, own_db)
+            own_db.flush()
+            mgr.own_hashes = set(own_db.records)
+            self._persist_manager(mgr)
+            log.logf(0, "hub: manager %s connected (%d corpus, fresh=%s)",
+                     name, len(corpus), fresh)
+
+    def sync(self, name: str, add: list[bytes], delete: list[str],
+             repros: list[bytes], need_repros: bool
+             ) -> tuple[list[bytes], list[bytes], int]:
+        """Returns (progs, repros, more) (reference: state.go:178-339)."""
+        with self._lock:
+            mgr = self.managers.get(name)
+            if mgr is None:
+                raise KeyError(f"manager {name!r} never connected")
+            own_db = open_db(os.path.join(self._manager_dir(name),
+                                          "corpus.db"))
+            for prog in add:
+                self._add_prog(name, mgr, prog, own_db)
+            for h in delete:
+                own_db.delete(h)
+                mgr.own_hashes.discard(h)
+                self.corpus_db.delete(h)
+            own_db.flush()
+            self.corpus_db.flush()
+
+            # repro fan-out to every other manager
+            for rp in repros:
+                h = hash_string(rp)
+                for other in self.managers.values():
+                    if other.name == name or h in other.seen_repros:
+                        continue
+                    other.seen_repros.add(h)
+                    other.pending_repros.append(rp)
+
+            # stream new progs from other managers
+            progs: list[bytes] = []
+            max_seq = mgr.last_seq
+            records = sorted(self.corpus_db.records.items(),
+                             key=lambda kv: kv[1].seq)
+            remaining = 0
+            for key, rec in records:
+                if rec.seq <= mgr.last_seq or key in mgr.own_hashes:
+                    continue
+                if len(progs) >= SYNC_BATCH:
+                    remaining += 1
+                    continue
+                progs.append(rec.val)
+                max_seq = max(max_seq, rec.seq)
+            mgr.last_seq = max_seq
+            self._persist_manager(mgr)
+
+            out_repros: list[bytes] = []
+            if need_repros:
+                out_repros = mgr.pending_repros[:100]
+                del mgr.pending_repros[:100]
+            return progs, out_repros, remaining
+
+    def _add_prog(self, name: str, mgr: ManagerState, prog: bytes,
+                  own_db) -> Optional[str]:
+        if self.target is not None:
+            try:
+                deserialize_prog(self.target, prog)
+            except ParseError:
+                return None  # refuse broken programs into the corpus
+        key = hash_string(prog)
+        mgr.own_hashes.add(key)
+        own_db.save(key, b"", 0)
+        if key not in self.corpus_db.records:
+            self.corpus_db.save(key, prog, self.next_seq)
+            self.next_seq += 1
+        return key
+
+    def purge_corpus(self) -> None:
+        """Drop global progs no connected manager still owns
+        (reference: state.go:341-365)."""
+        with self._lock:
+            owned: set[str] = set()
+            for mgr in self.managers.values():
+                owned |= mgr.own_hashes
+            for key in list(self.corpus_db.records):
+                if key not in owned:
+                    self.corpus_db.delete(key)
+            self.corpus_db.flush()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "corpus": len(self.corpus_db.records),
+                "managers": {
+                    n: {"connected": m.connected, "seq": m.last_seq,
+                        "own": len(m.own_hashes),
+                        "pending_repros": len(m.pending_repros)}
+                    for n, m in self.managers.items()
+                },
+            }

@@ -96,13 +96,16 @@ def _summary_page(mgr) -> str:
 
 
 def _corpus_page(mgr) -> str:
-    rows = ""
+    # copy under the lock, render outside it — the render escapes full
+    # program texts and must not stall fuzzer RPCs
     with mgr.serv._lock:
-        for key, inp in list(mgr.serv.corpus.items())[:1000]:
-            sig_len = len(inp.get("signal", [[], []])[0])
-            rows += (f"<tr><td>{key[:16]}</td><td>{sig_len}</td>"
-                     f"<td><pre>{html.escape(inp.get('prog', ''))}"
-                     f"</pre></td></tr>")
+        items = list(mgr.serv.corpus.items())[:1000]
+    rows = ""
+    for key, inp in items:
+        sig_len = len(inp.get("signal", [[], []])[0])
+        rows += (f"<tr><td>{key[:16]}</td><td>{sig_len}</td>"
+                 f"<td><pre>{html.escape(inp.get('prog', ''))}"
+                 f"</pre></td></tr>")
     return _page("corpus", f"<table><tr><th>sig</th><th>signal</th>"
                            f"<th>program</th></tr>{rows}</table>")
 

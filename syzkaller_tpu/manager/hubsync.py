@@ -1,0 +1,107 @@
+"""Manager-side hub synchronization.
+
+Periodically exchanges corpus programs and repros with a hub:
+uploads locally-triaged minimized inputs, downloads other managers'
+programs as candidates, and forwards crash repro programs both ways
+(reference: syz-manager/manager.go:1083-1227 hubSync; gated on the
+phase machine so hub inputs only arrive after the local corpus is
+triaged, manager.go:92-103).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from syzkaller_tpu.manager.mgrconfig import parse_addr
+from syzkaller_tpu.rpc import RPCClient
+from syzkaller_tpu.rpc.types import RPCCandidate
+from syzkaller_tpu.utils import log
+
+SYNC_PERIOD_S = 60.0
+
+
+class HubSyncer:
+    def __init__(self, mgr, period_s: float = SYNC_PERIOD_S,
+                 fresh: bool = False):
+        self.mgr = mgr
+        self.period_s = period_s
+        self.fresh = fresh
+        self.client = RPCClient(parse_addr(mgr.cfg.hub_addr),
+                                name=mgr.cfg.hub_client)
+        self._connected = False
+        self._uploaded: set[str] = set()
+        self._sent_repros: set[str] = set()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        from syzkaller_tpu.manager.manager import PHASE_TRIAGED_CORPUS
+
+        while not self.mgr.stop_ev.wait(self.period_s):
+            if self.mgr.phase < PHASE_TRIAGED_CORPUS:
+                continue
+            try:
+                self.sync_once()
+            except Exception as e:
+                log.logf(0, "hub sync failed: %s", e)
+                self._connected = False
+
+    def _ident(self) -> dict:
+        return {"client": self.mgr.cfg.hub_client,
+                "key": self.mgr.cfg.hub_key,
+                "manager": self.mgr.cfg.name}
+
+    def sync_once(self) -> dict:
+        from syzkaller_tpu.manager.manager import (PHASE_QUERIED_HUB,
+                                                   PHASE_TRIAGED_HUB)
+
+        if not self._connected:
+            corpus = [inp["prog"] for inp in self.mgr.serv.corpus.values()]
+            self.client.call_transient("Hub.Connect", {
+                **self._ident(), "fresh": self.fresh, "corpus": corpus,
+            })
+            self._uploaded = {h for h in self.mgr.serv.corpus}
+            self._connected = True
+
+        # new local inputs since the last sync
+        with self.mgr.serv._lock:
+            items = dict(self.mgr.serv.corpus)
+        add = [inp["prog"] for h, inp in items.items()
+               if h not in self._uploaded]
+        self._uploaded |= set(items)
+
+        # pending crash repro programs (send each once)
+        repros = []
+        for title, log_ in list(getattr(self.mgr, "hub_repros", [])):
+            if title in self._sent_repros:
+                continue
+            self._sent_repros.add(title)
+            repros.append(log_)
+
+        res = self.client.call_transient("Hub.Sync", {
+            **self._ident(), "need_repros": True,
+            "repros": repros, "add": add, "delete": [],
+        }) or {}
+
+        progs = res.get("progs") or []
+        if progs:
+            self.mgr.serv.add_candidates(
+                [RPCCandidate(prog=p, minimized=False) for p in progs])
+        for rp in res.get("repros") or []:
+            self.mgr.serv.add_candidates(
+                [RPCCandidate(prog=rp, minimized=False)])
+        log.logf(0, "hub sync: sent %d progs %d repros, recv %d progs "
+                 "%d repros (more %d)", len(add), len(repros),
+                 len(progs), len(res.get("repros") or []),
+                 res.get("more", 0))
+        if self.mgr.phase < PHASE_QUERIED_HUB:
+            self.mgr.phase = PHASE_QUERIED_HUB
+        if not progs and self.mgr.phase < PHASE_TRIAGED_HUB \
+                and self.mgr.serv.candidate_backlog() == 0:
+            self.mgr.phase = PHASE_TRIAGED_HUB
+        return {"sent": len(add), "received": len(progs)}

@@ -106,6 +106,7 @@ class Manager:
                 log.logf(0, "hub sync unavailable; running without hub")
             else:
                 self.hub = HubSyncer(self)
+                self.hub.start()
 
         self.bench_file = None
         self._bench_thread = None
@@ -280,7 +281,6 @@ class Manager:
         iteration = 0
 
         def run_instance(index: int) -> None:
-            nonlocal iteration
             try:
                 inst = pool.create(index)
             except BootError as e:
@@ -310,10 +310,9 @@ class Manager:
         while not self.stop_ev.is_set() and iteration < max_iterations:
             for i in range(n):
                 t = threads[i]
-                if t is None or not t.is_alive():
+                if (t is None or not t.is_alive()) \
+                        and iteration < max_iterations:
                     iteration += 1
-                    if iteration > max_iterations:
-                        break
                     threads[i] = threading.Thread(
                         target=run_instance, args=(i,), daemon=True)
                     threads[i].start()

@@ -1,0 +1,91 @@
+"""Fuzzer console-log parsing: recover executed programs from output.
+
+Splits a fuzzer/VM console log into entries at "executing program"
+markers and deserializes the program text that follows each — the
+input to reproducer extraction (reference: prog/parse.go:22 ParseLog,
+markers logged by syz-fuzzer/proc.go:255-262).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+from syzkaller_tpu.models.encoding import ParseError, deserialize_prog
+from syzkaller_tpu.models.prog import Prog
+
+# "executing program 3:" / "executing program 3 (fault-call:2 fault-nth:5):"
+_MARKER_RE = re.compile(
+    rb"executing program (\d+)"
+    rb"(?: \(fault-call:(\d+) fault-nth:(\d+)\))?:?")
+
+
+@dataclass
+class LogEntry:
+    """(reference: prog/parse.go LogEntry)"""
+    p: Prog
+    proc: int = 0
+    start: int = 0
+    end: int = 0
+    fault_call: int = -1
+    fault_nth: int = 0
+
+
+def parse_log(target, data: bytes) -> list[LogEntry]:
+    """(reference: prog/parse.go:22-86)"""
+    entries: list[LogEntry] = []
+    pos = 0
+    cur: Optional[tuple[int, int, int, int]] = None  # start,proc,fc,fn
+    lines: list[tuple[int, bytes]] = []
+    for m in re.finditer(rb"[^\n]*\n?", data):
+        lines.append((m.start(), m.group(0)))
+
+    def flush(end: int) -> None:
+        nonlocal cur
+        if cur is None:
+            return
+        start, proc, fc, fn = cur
+        body = data[start:end]
+        # program text starts after the marker line
+        nl = body.find(b"\n")
+        text = body[nl + 1:] if nl >= 0 else b""
+        text = _strip_log_prefixes(text)
+        if text.strip():
+            try:
+                p = deserialize_prog(target, text)
+                if len(p.calls):
+                    entries.append(LogEntry(p=p, proc=proc, start=start,
+                                            end=end, fault_call=fc,
+                                            fault_nth=fn))
+            except ParseError:
+                pass
+        cur = None
+
+    for off, line in lines:
+        m = _MARKER_RE.search(line)
+        if m is not None:
+            flush(off)
+            cur = (off, int(m.group(1)),
+                   int(m.group(2)) if m.group(2) else -1,
+                   int(m.group(3)) if m.group(3) else 0)
+    flush(len(data))
+    return entries
+
+
+def _strip_log_prefixes(text: bytes) -> bytes:
+    """Drop console noise lines; keep only plausible program lines.
+    The deserializer additionally tolerates unknown calls/args."""
+    out = []
+    for line in text.splitlines():
+        s = line.strip()
+        if not s:
+            break  # blank line ends the program block
+        # program lines look like "r0 = call(...)" or "call(...)" or
+        # continuation of a long line
+        if re.match(rb"^(r\d+ = )?[a-zA-Z_][a-zA-Z0-9_$]*\(", s) \
+                or s.startswith(b"#"):
+            out.append(line)
+        else:
+            break
+    return b"\n".join(out) + b"\n" if out else b""

@@ -1,0 +1,5 @@
+from syzkaller_tpu.repro.repro import (Reproducer, Result, Stats,
+                                       bisect_progs, run_from_manager)
+
+__all__ = ["Reproducer", "Result", "Stats", "bisect_progs",
+           "run_from_manager"]

@@ -1,0 +1,237 @@
+"""Automatic reproducer extraction from crash logs.
+
+Pipeline (reference: pkg/repro/repro.go:60-516): parse the console
+log into executed programs → try the last program alone with
+escalating durations → else bisect the suffix of programs down to a
+minimal crashing set → minimize the program crash-mode → simplify
+execution options → render to C and simplify that too.
+
+Testing a candidate is abstracted behind a `tester` callable so the
+bisection/minimization logic is hermetic (the reference tests
+pkg/repro the same way); production testers execute candidates in a
+fresh executor Env (local/sim) or a booted VM instance.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from syzkaller_tpu.csource import Options, write_csource
+from syzkaller_tpu.models.minimization import minimize
+from syzkaller_tpu.models.encoding import serialize_prog
+from syzkaller_tpu.models.parse import parse_log
+from syzkaller_tpu.models.prog import Prog
+from syzkaller_tpu.utils import log
+
+
+@dataclass
+class Stats:
+    """(reference: repro.go:23-41 Stats)"""
+    log_entries: int = 0
+    extract_prog_time: float = 0.0
+    minimize_prog_time: float = 0.0
+    simplify_prog_time: float = 0.0
+    extract_c_time: float = 0.0
+    test_runs: int = 0
+
+
+@dataclass
+class Result:
+    """(reference: repro.go:32-41)"""
+    prog: Prog
+    opts: Options
+    prog_text: bytes = b""
+    opts_desc: str = ""
+    c_src: Optional[bytes] = None
+    stats: Stats = field(default_factory=Stats)
+
+
+# tester(progs, opts, duration_s) -> bool  (did it crash?)
+Tester = Callable[[list[Prog], Options, float], bool]
+
+
+def bisect_progs(progs: list[Prog], pred: Callable[[list[Prog]], bool]
+                 ) -> Optional[list[Prog]]:
+    """ddmin-style reduction of a crashing program set: repeatedly try
+    dropping chunks while the remainder still crashes
+    (reference: repro.go:639-700 bisectProgs)."""
+    if not pred(progs):
+        return None
+    n_chunks = 2
+    while len(progs) > 1:
+        chunk = max(1, len(progs) // n_chunks)
+        reduced = False
+        i = 0
+        while i < len(progs):
+            cand = progs[:i] + progs[i + chunk:]
+            if cand and pred(cand):
+                progs = cand
+                reduced = True
+            else:
+                i += chunk
+        if not reduced:
+            if chunk == 1:
+                break
+            n_chunks *= 2
+    return progs
+
+
+class Reproducer:
+    def __init__(self, target, tester: Tester,
+                 base_duration_s: float = 10.0,
+                 extract_c: bool = True):
+        self.target = target
+        self.tester = tester
+        self.base_duration_s = base_duration_s
+        self.extract_c = extract_c
+        self.stats = Stats()
+
+    def _test(self, progs: list[Prog], opts: Options,
+              duration: float) -> bool:
+        self.stats.test_runs += 1
+        return self.tester(progs, opts, duration)
+
+    def run(self, crash_log: bytes) -> Optional[Result]:
+        """(reference: repro.go:60-175 Run + reproduce)"""
+        entries = parse_log(self.target, crash_log)
+        self.stats.log_entries = len(entries)
+        if not entries:
+            log.logf(1, "repro: no programs in crash log")
+            return None
+        opts = Options(repeat=True, procs=1)
+
+        t0 = time.time()
+        res = self._extract_prog(entries, opts)
+        self.stats.extract_prog_time = time.time() - t0
+        if res is None:
+            return None
+        p, opts = res
+
+        t0 = time.time()
+        p = self._minimize(p, opts)
+        self.stats.minimize_prog_time = time.time() - t0
+
+        t0 = time.time()
+        opts = self._simplify_opts(p, opts)
+        self.stats.simplify_prog_time = time.time() - t0
+
+        result = Result(prog=p, opts=opts, prog_text=serialize_prog(p),
+                        opts_desc=opts.serialize(), stats=self.stats)
+        if self.extract_c:
+            t0 = time.time()
+            result.c_src = write_csource(p, opts)
+            self.stats.extract_c_time = time.time() - t0
+        return result
+
+    # -- stages -----------------------------------------------------------
+
+    def _extract_prog(self, entries, opts: Options
+                      ) -> Optional[tuple[Prog, Options]]:
+        """Last-single-prog with escalating durations, then multi-prog
+        bisection over the log suffix (reference: repro.go:233-420)."""
+        # Single-program attempts: last few entries, newest first.
+        for duration_mult in (1, 3):
+            duration = self.base_duration_s * duration_mult
+            for entry in reversed(entries[-5:]):
+                if self._test([entry.p], opts, duration):
+                    log.logf(1, "repro: single-program reproducer found")
+                    return entry.p, opts
+        # Multi-program: bisect the suffix (state built up by earlier
+        # programs may be needed).
+        suffix = [e.p for e in entries[-20:]]
+        subset = bisect_progs(
+            suffix, lambda ps: self._test(ps, opts,
+                                          self.base_duration_s * 3))
+        if subset:
+            # Concatenate the surviving programs into one.
+            combined = subset[0].clone()
+            for extra in subset[1:]:
+                c = extra.clone()
+                combined.calls.extend(c.calls)
+            if self._test([combined], opts, self.base_duration_s * 3):
+                return combined, opts
+            # fall back to the first surviving program alone
+            if len(subset) == 1:
+                return subset[0], opts
+        return None
+
+    def _minimize(self, p: Prog, opts: Options) -> Prog:
+        """Crash-mode minimization: every step re-validated by
+        execution (reference: repro.go:423-446 → prog.Minimize)."""
+        def pred(cand: Prog, _call_index: int) -> bool:
+            return self._test([cand], opts, self.base_duration_s)
+
+        p2, _ = minimize(p, -1, crash=True, pred0=pred)
+        return p2
+
+    def _simplify_opts(self, p: Prog, opts: Options) -> Options:
+        """Drop execution options one at a time while it still crashes
+        (reference: repro.go:448-478 simplifyProg)."""
+        simplifications = [
+            ("repeat", False),
+            ("procs", 1),
+            ("sandbox", "none"),
+            ("threaded", False),
+            ("collide", False),
+        ]
+        for attr, plain in simplifications:
+            if getattr(opts, attr) == plain:
+                continue
+            trial = Options(**{**opts.__dict__, attr: plain})
+            if self._test([p], trial, self.base_duration_s):
+                opts = trial
+        return opts
+
+
+# -- production testers ---------------------------------------------------
+
+
+def make_env_tester(target, title_filter: Optional[str] = None,
+                    runs_per_test: int = 3) -> Tester:
+    """Executes candidates against a fresh local executor (sim kernel)
+    and reports whether any run crashed (with a matching title when
+    title_filter is set).  The local/sim analogue of booting a VM per
+    test (reference: repro.go:518-626 testProgs)."""
+    from syzkaller_tpu.ipc.env import (ExecOpts, ExecutorCrash,
+                                       ExecutorFailure, make_env)
+    from syzkaller_tpu.models.encodingexec import serialize_for_exec
+    from syzkaller_tpu.report import get_reporter
+
+    reporter = get_reporter(target.os)
+
+    def tester(progs: list[Prog], opts: Options, duration: float) -> bool:
+        env = make_env(0)
+        try:
+            deadline = time.monotonic() + min(duration, 30.0)
+            runs = 0
+            while time.monotonic() < deadline and runs < runs_per_test:
+                runs += 1
+                for p in progs:
+                    try:
+                        env.exec(ExecOpts(), serialize_for_exec(p))
+                    except ExecutorCrash as e:
+                        if title_filter is None:
+                            return True
+                        rep = reporter.parse(e.log.encode())
+                        if rep is not None and rep.title == title_filter:
+                            return True
+                        return False  # crashed differently
+                    except ExecutorFailure:
+                        pass
+                if not opts.repeat:
+                    break
+            return False
+        finally:
+            env.close()
+
+    return tester
+
+
+def run_from_manager(mgr, title: str, crash_log: bytes
+                     ) -> Optional[Result]:
+    """Entry point used by the manager's repro scheduler."""
+    tester = make_env_tester(mgr.target, title_filter=title)
+    r = Reproducer(mgr.target, tester)
+    return r.run(crash_log)

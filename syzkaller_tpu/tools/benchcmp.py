@@ -1,0 +1,73 @@
+"""tz-benchcmp: render manager -bench JSON series into an HTML chart
+(reference: tools/syz-benchcmp/benchcmp.go:1-36)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+_METRICS = ("corpus", "signal", "max_signal", "crashes", "triaged")
+
+
+def load_series(path: str) -> list[dict]:
+    out = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if line:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def render_html(series: dict[str, list[dict]]) -> str:
+    """One self-contained HTML page, an inline-SVG line chart per
+    metric, no external dependencies."""
+    parts = ["<html><head><title>bench comparison</title>",
+             "<style>body{font-family:monospace} svg{border:1px solid "
+             "#ccc;margin:8px}</style></head><body>"]
+    colors = ["#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#8c564b"]
+    for metric in _METRICS:
+        has = any(any(metric in rec for rec in recs)
+                  for recs in series.values())
+        if not has:
+            continue
+        parts.append(f"<h3>{metric}</h3><svg width='640' height='240' "
+                     f"viewBox='0 0 640 240'>")
+        maxv = max((rec.get(metric, 0) for recs in series.values()
+                    for rec in recs), default=1) or 1
+        maxn = max((len(recs) for recs in series.values()), default=1)
+        for si, (name, recs) in enumerate(series.items()):
+            pts = []
+            for i, rec in enumerate(recs):
+                x = 20 + 600 * i / max(maxn - 1, 1)
+                y = 220 - 200 * rec.get(metric, 0) / maxv
+                pts.append(f"{x:.1f},{y:.1f}")
+            color = colors[si % len(colors)]
+            if pts:
+                parts.append(f"<polyline fill='none' stroke='{color}' "
+                             f"points='{' '.join(pts)}'/>")
+                parts.append(f"<text x='25' y='{20 + 14 * si}' "
+                             f"fill='{color}'>{name}</text>")
+        parts.append(f"<text x='560' y='16'>{maxv}</text></svg>")
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-benchcmp")
+    ap.add_argument("benches", nargs="+", help="bench JSON files")
+    ap.add_argument("-o", "--out", default="benchcmp.html")
+    args = ap.parse_args(argv)
+    series = {Path(b).name: load_series(b) for b in args.benches}
+    html = render_html(series)
+    Path(args.out).write_text(html)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

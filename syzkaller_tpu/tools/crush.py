@@ -1,0 +1,51 @@
+"""tz-crush: replay a crash log's programs over and over to re-trigger
+the crash (reference: tools/syz-crush/crush.go)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from syzkaller_tpu.ipc.env import ExecOpts, ExecutorCrash, make_env
+from syzkaller_tpu.models.encodingexec import serialize_for_exec
+from syzkaller_tpu.models.parse import parse_log
+from syzkaller_tpu.models.target import get_target
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-crush")
+    ap.add_argument("log")
+    ap.add_argument("-os", dest="target_os", default="test")
+    ap.add_argument("-arch", default="64")
+    ap.add_argument("-duration", type=float, default=30.0)
+    ap.add_argument("-procs", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    target = get_target(args.target_os, args.arch)
+    entries = parse_log(target, Path(args.log).read_bytes())
+    if not entries:
+        print("no programs in log", file=sys.stderr)
+        return 1
+    print(f"replaying {len(entries)} programs for {args.duration}s")
+    env = make_env(0)
+    deadline = time.time() + args.duration
+    runs = 0
+    try:
+        while time.time() < deadline:
+            for e in entries:
+                runs += 1
+                try:
+                    env.exec(ExecOpts(), serialize_for_exec(e.p))
+                except ExecutorCrash as ex:
+                    print(f"crash reproduced after {runs} runs:\n{ex.log}")
+                    return 0
+        print(f"no crash after {runs} runs")
+        return 3
+    finally:
+        env.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""tz-db: corpus.db pack/unpack/merge
+(reference: tools/syz-db/syz-db.go)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+from syzkaller_tpu.db import open_db
+from syzkaller_tpu.utils.hashsig import hash_string
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-db")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_pack = sub.add_parser("pack", help="directory of programs → db")
+    p_pack.add_argument("dir")
+    p_pack.add_argument("db")
+    p_unpack = sub.add_parser("unpack", help="db → directory of programs")
+    p_unpack.add_argument("db")
+    p_unpack.add_argument("dir")
+    p_merge = sub.add_parser("merge", help="merge dbs into the first")
+    p_merge.add_argument("dst")
+    p_merge.add_argument("srcs", nargs="+")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "pack":
+        db = open_db(args.db)
+        n = 0
+        for path in sorted(Path(args.dir).iterdir()):
+            if path.is_file():
+                data = path.read_bytes()
+                db.save(hash_string(data), data, 0)
+                n += 1
+        db.flush()
+        print(f"packed {n} programs")
+    elif args.cmd == "unpack":
+        db = open_db(args.db)
+        os.makedirs(args.dir, exist_ok=True)
+        for key, rec in db.records.items():
+            Path(args.dir, key).write_bytes(rec.val)
+        print(f"unpacked {len(db.records)} programs")
+    elif args.cmd == "merge":
+        dst = open_db(args.dst)
+        added = 0
+        for src_path in args.srcs:
+            src = open_db(src_path)
+            for key, rec in src.records.items():
+                if key not in dst.records:
+                    dst.save(key, rec.val, rec.seq)
+                    added += 1
+        dst.flush()
+        print(f"merged {added} new programs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

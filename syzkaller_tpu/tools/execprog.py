@@ -1,0 +1,122 @@
+"""tz-execprog: execute programs from files/corpus against an executor.
+
+The repro & bench driver (reference: tools/syz-execprog/execprog.go:26-36
+— flags -repeat, -procs, -cover, -hints, -fault_call/-fault_nth,
+-coverfile).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from syzkaller_tpu.ipc.env import ExecFlags, ExecOpts, ExecutorCrash, make_env
+from syzkaller_tpu.models.encoding import ParseError, deserialize_prog
+from syzkaller_tpu.models.encodingexec import serialize_for_exec
+from syzkaller_tpu.models.target import get_target
+from syzkaller_tpu.utils import log
+
+
+def load_programs(target, paths: list[str]) -> list:
+    progs = []
+    for path in paths:
+        data = Path(path).read_bytes()
+        # a file may contain many programs separated by blank lines
+        for chunk in data.split(b"\n\n"):
+            if not chunk.strip():
+                continue
+            try:
+                progs.append(deserialize_prog(target, chunk))
+            except ParseError as e:
+                log.logf(0, "skipping bad program in %s: %s", path, e)
+    return progs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-execprog")
+    ap.add_argument("files", nargs="+")
+    ap.add_argument("-os", dest="target_os", default="test")
+    ap.add_argument("-arch", default="64")
+    ap.add_argument("-repeat", type=int, default=1,
+                    help="0 = infinite")
+    ap.add_argument("-procs", type=int, default=1)
+    ap.add_argument("-cover", action="store_true")
+    ap.add_argument("-coverfile", default="")
+    ap.add_argument("-hints", action="store_true",
+                    help="collect comparisons and run hint mutants")
+    ap.add_argument("-fault_call", type=int, default=-1)
+    ap.add_argument("-fault_nth", type=int, default=0)
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_level(args.v)
+
+    target = get_target(args.target_os, args.arch)
+    progs = load_programs(target, args.files)
+    if not progs:
+        print("no programs to execute", file=sys.stderr)
+        return 1
+
+    flags = ExecFlags(0)
+    if args.cover or args.coverfile:
+        flags |= ExecFlags.COLLECT_COVER | ExecFlags.DEDUP_COVER
+    if args.hints:
+        flags |= ExecFlags.COLLECT_COMPS
+    if args.fault_call >= 0:
+        flags |= ExecFlags.FAULT
+    opts = ExecOpts(flags=flags, fault_call=args.fault_call,
+                    fault_nth=args.fault_nth)
+
+    env = make_env(0)
+    executed = 0
+    try:
+        rep = 0
+        while args.repeat == 0 or rep < args.repeat:
+            rep += 1
+            for i, p in enumerate(progs):
+                try:
+                    res = env.exec(opts, serialize_for_exec(p))
+                except ExecutorCrash as e:
+                    print(f"program {i} crashed the kernel:\n{e.log}")
+                    return 2
+                executed += 1
+                if args.cover:
+                    for ci in res.info:
+                        print(f"call #{ci.call_index}: errno={ci.errno} "
+                              f"signal={len(ci.signal)} "
+                              f"cover={len(ci.cover)}")
+                if args.coverfile:
+                    with open(args.coverfile, "a") as f:
+                        for ci in res.info:
+                            for pc in ci.cover:
+                                f.write(f"0x{int(pc):x}\n")
+                if args.hints:
+                    _run_hints(env, p, res)
+        print(f"executed {executed} programs")
+        return 0
+    finally:
+        env.close()
+
+
+def _run_hints(env, p, res) -> None:
+    from syzkaller_tpu.models.hints import CompMap, mutate_with_hints
+
+    for ci in res.info:
+        if not ci.comps:
+            continue
+        comps = CompMap()
+        for op1, op2 in ci.comps:
+            comps.add_comp(op1, op2)
+        count = 0
+
+        def exec_cb(mutant) -> None:
+            nonlocal count
+            count += 1
+            env.exec(ExecOpts(), serialize_for_exec(mutant))
+
+        mutate_with_hints(p, ci.call_index, comps, exec_cb)
+        log.logf(1, "call %d: %d hint mutants", ci.call_index, count)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

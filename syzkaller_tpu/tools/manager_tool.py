@@ -1,0 +1,53 @@
+"""tz-manager: the manager daemon CLI
+(reference: syz-manager/manager.go:119 main)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from syzkaller_tpu.manager.manager import Manager
+from syzkaller_tpu.manager.mgrconfig import load_config
+from syzkaller_tpu.utils import log
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-manager")
+    ap.add_argument("-config", required=True)
+    ap.add_argument("-bench", default="",
+                    help="write periodic stat snapshots to this file")
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_level(args.v)
+
+    cfg = load_config(args.config)
+    mgr = Manager(cfg)
+    if args.bench:
+        mgr.start_bench(args.bench)
+    host, port = mgr.rpc_addr
+    print(f"manager RPC on {host}:{port}", flush=True)
+    if mgr.http_server is not None:
+        h, p = mgr.http_server.server_address
+        print(f"HTTP UI on http://{h}:{p}/", flush=True)
+
+    import sys as _sys
+
+    def fuzzer_cmd(inst, index):
+        fwd = inst.forward(port)
+        return (f"cd {_sys.path[0] or '.'} && "
+                f"exec {_sys.executable} -m syzkaller_tpu.fuzzer.main "
+                f"-name fuzzer-{index} -manager {fwd} "
+                f"-os {cfg.target_os} -arch {cfg.target_arch} "
+                f"-procs {cfg.procs} -engine {cfg.engine}")
+
+    try:
+        mgr.vm_loop(fuzzer_cmd)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        mgr.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+"""tz-mutate: mutate a single program and print the result.
+
+Baseline config #1 (reference: tools/syz-mutate/mutate.go:30-77 —
+flags -seed, -len, -enable; reads a program, applies one Mutate,
+writes it out).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from syzkaller_tpu.models.encoding import deserialize_prog, serialize_prog
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.mutation import mutate_prog
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.models.target import get_target
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-mutate")
+    ap.add_argument("file", nargs="?", default="",
+                    help="program to mutate (empty: generate one)")
+    ap.add_argument("-os", dest="target_os", default="test")
+    ap.add_argument("-arch", default="64")
+    ap.add_argument("-seed", type=int, default=-1)
+    ap.add_argument("-len", dest="length", type=int, default=30)
+    ap.add_argument("-n", type=int, default=1,
+                    help="number of mutations to apply")
+    args = ap.parse_args(argv)
+
+    target = get_target(args.target_os, args.arch)
+    import random as pyrandom
+
+    seed = args.seed if args.seed >= 0 \
+        else pyrandom.randrange(1 << 30)
+    rng = RandGen(target, seed)
+    if args.file:
+        p = deserialize_prog(target, Path(args.file).read_bytes())
+    else:
+        p = generate_prog(target, rng, args.length)
+    for _ in range(args.n):
+        mutate_prog(p, rng, args.length, corpus=[p.clone()])
+    sys.stdout.write(serialize_prog(p).decode())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

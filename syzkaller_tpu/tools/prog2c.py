@@ -1,0 +1,42 @@
+"""tz-prog2c: program → C translator
+(reference: tools/syz-prog2c/prog2c.go)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from syzkaller_tpu.csource import Options, write_csource
+from syzkaller_tpu.models.encoding import deserialize_prog
+from syzkaller_tpu.models.target import get_target
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-prog2c")
+    ap.add_argument("file")
+    ap.add_argument("-os", dest="target_os", default="test")
+    ap.add_argument("-arch", default="64")
+    ap.add_argument("-threaded", action="store_true")
+    ap.add_argument("-repeat", action="store_true")
+    ap.add_argument("-procs", type=int, default=1)
+    ap.add_argument("-sandbox", default="none")
+    ap.add_argument("-build", action="store_true",
+                    help="also compile (prints binary path)")
+    args = ap.parse_args(argv)
+
+    target = get_target(args.target_os, args.arch)
+    p = deserialize_prog(target, Path(args.file).read_bytes())
+    opts = Options(threaded=args.threaded, repeat=args.repeat,
+                   procs=args.procs, sandbox=args.sandbox)
+    src = write_csource(p, opts)
+    sys.stdout.write(src.decode())
+    if args.build:
+        from syzkaller_tpu.csource import build_csource
+
+        print(f"\n// built: {build_csource(src)}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""tz-repro: extract a reproducer from a crash log
+(reference: tools/syz-repro/repro.go)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from syzkaller_tpu.models.target import get_target
+from syzkaller_tpu.repro.repro import Reproducer, make_env_tester
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-repro")
+    ap.add_argument("log")
+    ap.add_argument("-os", dest="target_os", default="test")
+    ap.add_argument("-arch", default="64")
+    ap.add_argument("-title", default="", help="match this crash title")
+    ap.add_argument("-no-c", action="store_true")
+    args = ap.parse_args(argv)
+
+    target = get_target(args.target_os, args.arch)
+    tester = make_env_tester(target, title_filter=args.title or None)
+    r = Reproducer(target, tester, extract_c=not args.no_c)
+    result = r.run(Path(args.log).read_bytes())
+    if result is None:
+        print("reproduction failed", file=sys.stderr)
+        return 1
+    print("# " + result.opts_desc)
+    sys.stdout.write(result.prog_text.decode())
+    if result.c_src:
+        print("\n// ---- C reproducer ----")
+        sys.stdout.write(result.c_src.decode())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""tz-stress: local stress fuzzing without a manager.
+
+Generate/mutate + execute in a loop, printing exec and signal stats
+(reference: tools/syz-stress/stress.go:24-50).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from syzkaller_tpu.fuzzer.fuzzer import Fuzzer, FuzzerConfig
+from syzkaller_tpu.fuzzer.proc import Proc
+from syzkaller_tpu.fuzzer.workqueue import WorkQueue
+from syzkaller_tpu.ipc.env import make_env
+from syzkaller_tpu.models.target import get_target
+from syzkaller_tpu.utils import log
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-stress")
+    ap.add_argument("-os", dest="target_os", default="test")
+    ap.add_argument("-arch", default="64")
+    ap.add_argument("-procs", type=int, default=1)
+    ap.add_argument("-duration", type=float, default=10.0,
+                    help="seconds")
+    ap.add_argument("-engine", default="cpu", choices=["cpu", "jax"])
+    ap.add_argument("-v", type=int, default=0)
+    args = ap.parse_args(argv)
+    log.set_level(args.v)
+
+    target = get_target(args.target_os, args.arch)
+    fuzzer = Fuzzer(target, WorkQueue(), cfg=FuzzerConfig())
+    batch_mutator = None
+    if args.engine == "jax":
+        from syzkaller_tpu.engine import TpuEngine
+        from syzkaller_tpu.fuzzer.proc import BatchMutator
+
+        batch_mutator = BatchMutator(TpuEngine(target))
+
+    import threading
+
+    stop = threading.Event()
+    procs = []
+    threads = []
+    for pid in range(args.procs):
+        proc = Proc(fuzzer, pid, make_env(pid),
+                    batch_mutator=batch_mutator)
+        procs.append(proc)
+        t = threading.Thread(target=proc.loop, args=(1 << 62,),
+                             kwargs={"stop": stop}, daemon=True)
+        threads.append(t)
+        t.start()
+
+    t0 = time.time()
+    last = 0
+    try:
+        while time.time() - t0 < args.duration:
+            time.sleep(min(5.0, args.duration))
+            execs = fuzzer.exec_count()
+            print(f"executed {execs} programs (+{execs - last}), "
+                  f"corpus {fuzzer.corpus_len()}, "
+                  f"signal {len(fuzzer.max_signal)}")
+            last = execs
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        for proc in procs:
+            proc.env.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

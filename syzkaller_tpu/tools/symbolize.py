@@ -1,0 +1,36 @@
+"""tz-symbolize: symbolize a crash report against a vmlinux
+(reference: tools/syz-symbolize)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from syzkaller_tpu.report import get_reporter
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-symbolize")
+    ap.add_argument("log")
+    ap.add_argument("-os", dest="target_os", default="linux")
+    ap.add_argument("-kernel_obj", default="")
+    args = ap.parse_args(argv)
+
+    reporter = get_reporter(args.target_os, kernel_obj=args.kernel_obj)
+    rep = reporter.parse(Path(args.log).read_bytes())
+    if rep is None:
+        print("no crash found in log", file=sys.stderr)
+        return 1
+    reporter.symbolize(rep)
+    print(f"TITLE: {rep.title}")
+    if rep.corrupted:
+        print(f"CORRUPTED: {rep.corrupted_reason}")
+    if rep.guilty_file:
+        print(f"GUILTY: {rep.guilty_file}")
+    sys.stdout.buffer.write(rep.report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

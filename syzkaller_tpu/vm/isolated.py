@@ -56,8 +56,8 @@ class IsolatedInstance(Instance):
 
         dst = f"{self.target_dir}/{os.path.basename(host_src)}"
         run_ssh(["scp", *ssh_args(self.env.sshkey, self.env.ssh_user,
-                                  self.port),
-                 "-P", str(self.port), host_src,
+                                  self.port, scp=True),
+                 host_src,
                  f"{self.env.ssh_user}@{self.host}:{dst}"], timeout_s=300)
         return dst
 

@@ -153,8 +153,8 @@ class QemuInstance(Instance):
     def copy(self, host_src: str) -> str:
         dst = "/" + os.path.basename(host_src)
         run_ssh(["scp", *ssh_args(self.env.sshkey, self.env.ssh_user,
-                                  self.ssh_port),
-                 "-P", str(self.ssh_port), host_src,
+                                  self.ssh_port, scp=True),
+                 host_src,
                  f"{self.env.ssh_user}@127.0.0.1:{dst}"], timeout_s=180)
         return dst
 
@@ -177,18 +177,31 @@ class QemuInstance(Instance):
                                 stderr=subprocess.STDOUT)
 
         # Merge the ssh channel and the serial console into one stream
-        # (reference: vmimpl merger) — console carries the oopses.
+        # (reference: vmimpl merger) — console carries the oopses.  The
+        # console pump keeps draining for a grace window after the ssh
+        # channel dies: a guest panic kills sshd first while the oops
+        # is still flushing over serial.
+        ssh_pump = pump_fd(proc.stdout, stream, proc, stop, timeout_s,
+                           finish_stream=False)
+
         def pump_console():
-            while not stop.is_set() and proc.poll() is None:
+            grace_deadline = None
+            while not stop.is_set():
+                if proc.poll() is not None and grace_deadline is None:
+                    grace_deadline = time.monotonic() + 10.0
+                if grace_deadline is not None \
+                        and time.monotonic() > grace_deadline:
+                    break
                 chunk = self._console.get(timeout=0.5)
                 if chunk is None:
                     if self._console.finished:
                         break
                     continue
                 stream.put(chunk)
+            ssh_pump.join()
+            stream.finish(stream.error)
 
         threading.Thread(target=pump_console, daemon=True).start()
-        pump_fd(proc.stdout, stream, proc, stop, timeout_s)
         return stream
 
     def diagnose(self) -> bytes:

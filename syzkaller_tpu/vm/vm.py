@@ -94,6 +94,11 @@ def monitor_execution(stream: OutputStream, reporter: Reporter,
                 rep = reporter.parse(bytes(output))
                 if rep is not None:
                     return MonitorResult(report=rep, output=bytes(output))
+                if isinstance(stream.error, TimeoutError):
+                    # Run-duration rotation is a clean finish, not a
+                    # crash (reference: vm.go timeout handling).
+                    return MonitorResult(report=None, output=bytes(output),
+                                         timed_out=True)
                 if stream.error is not None:
                     return synthetic("lost connection to test machine",
                                      lost_connection=True)

@@ -129,16 +129,25 @@ def create_pool_impl(typ: str, env: Env) -> PoolImpl:
 
 def pump_fd(fd_file, stream: OutputStream, proc: subprocess.Popen,
             stop: threading.Event, timeout_s: float,
-            on_exit: Optional[Callable[[], Optional[Exception]]] = None
+            on_exit: Optional[Callable[[], Optional[Exception]]] = None,
+            finish_stream: bool = True
             ) -> threading.Thread:
     """Pump a file object into an OutputStream until EOF/stop/timeout;
-    kills proc on stop/timeout (the vmimpl merger+timeout pattern)."""
+    kills proc on stop/timeout (the vmimpl merger+timeout pattern).
+
+    Requested stops and run-duration timeouts are clean finishes
+    (error=None / TimeoutError) — only unexpected process death is an
+    error.  With finish_stream=False the caller owns stream.finish()
+    (used when a console merger must drain after process death).
+    """
 
     def loop():
         deadline = time.monotonic() + timeout_s
+        timed_out = False
         try:
             while True:
                 if stop.is_set() or time.monotonic() > deadline:
+                    timed_out = not stop.is_set()
                     proc.kill()
                     break
                 chunk = fd_file.read1(1 << 14) \
@@ -149,12 +158,16 @@ def pump_fd(fd_file, stream: OutputStream, proc: subprocess.Popen,
         except (OSError, ValueError):
             pass
         proc.wait()
-        err = on_exit() if on_exit is not None else None
-        if err is None and stop.is_set():
-            err = None  # requested stop is a clean finish
-        elif err is None and time.monotonic() > deadline:
-            err = TimeoutError("command timed out")
-        stream.finish(err)
+        if stop.is_set():
+            err: Optional[Exception] = None
+        elif timed_out or time.monotonic() > deadline:
+            err = TimeoutError("run duration elapsed")
+        else:
+            err = on_exit() if on_exit is not None else None
+        if finish_stream:
+            stream.finish(err)
+        else:
+            stream.error = err
 
     t = threading.Thread(target=loop, daemon=True)
     t.start()
@@ -163,21 +176,29 @@ def pump_fd(fd_file, stream: OutputStream, proc: subprocess.Popen,
 
 def run_ssh(args: list[str], timeout_s: float = 60.0) -> bytes:
     """One-shot helper for scp/ssh control commands."""
-    res = subprocess.run(args, capture_output=True, timeout=timeout_s)
+    try:
+        res = subprocess.run(args, capture_output=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        raise BootError(f"{' '.join(args[:2])} timed out") from e
     if res.returncode != 0:
         raise BootError(
             f"{' '.join(args[:2])} failed: {res.stderr.decode()[-512:]}")
     return res.stdout
 
 
-def ssh_args(sshkey: str, user: str, port: int = 22) -> list[str]:
-    """(reference: vmimpl.go SSHArgs)"""
+def ssh_args(sshkey: str, user: str, port: int = 0,
+             scp: bool = False) -> list[str]:
+    """Common ssh/scp options (reference: vmimpl.go SSHArgs).  The
+    port flag differs between the tools (ssh -p vs scp -P), so it is
+    emitted per-tool here — passing ssh's -p to scp would be parsed
+    as scp's preserve-times flag."""
     args = ["-o", "StrictHostKeyChecking=no",
             "-o", "UserKnownHostsFile=/dev/null",
             "-o", "BatchMode=yes",
             "-o", "IdentitiesOnly=yes",
-            "-o", "ConnectTimeout=10",
-            "-p", str(port)]
+            "-o", "ConnectTimeout=10"]
+    if port:
+        args += ["-P" if scp else "-p", str(port)]
     if sshkey:
         args += ["-i", sshkey]
     return args
