@@ -1,0 +1,90 @@
+"""Kernel commit bisection.
+
+Drives `git bisect` over a kernel tree with an injectable test
+predicate (build + boot + run repro), finding the commit that
+introduced — or fixed — a crash (reference: pkg/bisect/bisect.go:19-30
+Run; pkg/git git ops).
+"""
+
+from __future__ import annotations
+
+import subprocess
+from dataclasses import dataclass
+from enum import Enum
+from typing import Callable, Optional
+
+from syzkaller_tpu.utils import log
+
+
+class TestResult(Enum):
+    __test__ = False  # not a pytest class despite the name
+    GOOD = "good"  # does not crash
+    BAD = "bad"  # crashes
+    SKIP = "skip"  # build/boot failure — cannot test
+
+
+# predicate(commit_hash) -> TestResult
+Pred = Callable[[str], TestResult]
+
+
+@dataclass
+class BisectResult:
+    commit: str  # culprit (cause- or fix-) commit
+    log: str
+    tested: int = 0
+
+
+class GitError(Exception):
+    pass
+
+
+def _git(repo: str, *args: str, check: bool = True) -> str:
+    res = subprocess.run(["git", "-C", repo, *args],
+                         capture_output=True, text=True)
+    if check and res.returncode != 0:
+        raise GitError(f"git {' '.join(args)}: {res.stderr[-512:]}")
+    return res.stdout.strip()
+
+
+def bisect(repo: str, good: str, bad: str, pred: Pred,
+           max_tests: int = 64) -> Optional[BisectResult]:
+    """Standard cause-bisection: `good` doesn't crash, `bad` does;
+    returns the first crashing commit (reference: bisect.go Run)."""
+    _git(repo, "bisect", "reset", check=False)
+    _git(repo, "bisect", "start")
+    out_log = []
+    tested = 0
+    try:
+        _git(repo, "bisect", "bad", bad)
+        out = _git(repo, "bisect", "good", good)
+        while tested < max_tests:
+            if "is the first bad commit" in out:
+                commit = out.split()[0]
+                return BisectResult(commit=commit,
+                                    log="\n".join(out_log),
+                                    tested=tested)
+            head = _git(repo, "rev-parse", "HEAD")
+            tested += 1
+            verdict = pred(head)
+            out_log.append(f"{head[:12]}: {verdict.value}")
+            log.logf(1, "bisect: %s -> %s", head[:12], verdict.value)
+            out = _git(repo, "bisect", verdict.value)
+        return None
+    finally:
+        _git(repo, "bisect", "reset", check=False)
+
+
+def bisect_fix(repo: str, bad: str, good: str, pred: Pred,
+               max_tests: int = 64) -> Optional[BisectResult]:
+    """Fix-bisection: find the commit that made the crash stop.  Runs
+    cause-bisection with the predicate inverted
+    (reference: bisect.go fix mode)."""
+
+    def inverted(commit: str) -> TestResult:
+        v = pred(commit)
+        if v == TestResult.SKIP:
+            return v
+        return TestResult.BAD if v == TestResult.GOOD else TestResult.GOOD
+
+    return bisect(repo, good=bad, bad=good, pred=inverted,
+                  max_tests=max_tests)

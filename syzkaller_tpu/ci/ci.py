@@ -1,0 +1,211 @@
+"""Continuous integration daemon.
+
+Watches source repos, rebuilds artifacts, restarts managers on
+updates, validates images before deployment, runs dashboard patch-test
+jobs, and reports build results (reference: syz-ci/syzupdater.go
+self-update loop, syz-ci/manager.go:123 manager loop + 235 build,
+syz-ci/jobs.go:105 job polling).
+
+Build/fetch are pluggable shell commands from the config so the CI
+logic (polling, sequencing, restart, reporting) is hermetic to test —
+the reference's kernel `make` invocations become a `build_cmd`.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from syzkaller_tpu.ci.bisect import _git, GitError
+from syzkaller_tpu.utils import log
+
+
+@dataclass
+class ManagedInstance:
+    """One manager under CI control (reference: syz-ci Manager)."""
+    name: str
+    repo: str = ""  # kernel/source repo to watch
+    branch: str = "main"
+    build_cmd: str = ""  # rebuild artifacts; cwd=repo
+    manager_cmd: str = ""  # start the manager process
+    # runtime state
+    current_commit: str = ""
+    proc: Optional[subprocess.Popen] = None
+    last_build_ok: bool = True
+    last_error: str = ""
+
+
+@dataclass
+class CIConfig:
+    workdir: str = ""
+    poll_period_s: float = 60.0
+    managers: list[dict] = field(default_factory=list)
+    dashboard_addr: str = ""
+    dashboard_client: str = ""
+    dashboard_key: str = ""
+
+
+class CI:
+    def __init__(self, cfg: CIConfig):
+        self.cfg = cfg
+        os.makedirs(cfg.workdir, exist_ok=True)
+        self.managers = [ManagedInstance(**m) for m in cfg.managers]
+        self.stop_ev = threading.Event()
+        self.dash = None
+        if cfg.dashboard_addr:
+            from syzkaller_tpu.dashboard.dashapi import DashClient
+
+            self.dash = DashClient(cfg.dashboard_addr,
+                                   cfg.dashboard_client,
+                                   cfg.dashboard_key)
+
+    # -- update/build/restart cycle (syz-ci/manager.go:123-233) ----------
+
+    def check_manager(self, m: ManagedInstance) -> bool:
+        """Poll the repo; rebuild + restart on new commits.  Returns
+        True if an update was deployed."""
+        try:
+            head = self._poll_repo(m)
+        except GitError as e:
+            log.logf(0, "ci: poll %s failed: %s", m.name, e)
+            return False
+        if head == m.current_commit and m.proc is not None \
+                and m.proc.poll() is None:
+            return False
+        if head != m.current_commit:
+            log.logf(0, "ci: %s: new commit %s", m.name, head[:12])
+            if not self._build(m):
+                return False
+            m.current_commit = head
+        self._restart(m)
+        return True
+
+    def _poll_repo(self, m: ManagedInstance) -> str:
+        if not m.repo:
+            return m.current_commit or "none"
+        _git(m.repo, "fetch", "--quiet", check=False)  # offline-safe
+        for ref in (f"origin/{m.branch}", m.branch, "HEAD"):
+            try:
+                return _git(m.repo, "rev-parse", ref)
+            except GitError:
+                continue
+        raise GitError(f"cannot resolve {m.branch} in {m.repo}")
+
+    def _build(self, m: ManagedInstance) -> bool:
+        """(reference: syz-ci/manager.go:235 build; failures reported
+        to the dashboard as build errors)"""
+        if not m.build_cmd:
+            m.last_build_ok = True
+            return True
+        res = subprocess.run(m.build_cmd, shell=True, cwd=m.repo or None,
+                             capture_output=True, text=True)
+        m.last_build_ok = res.returncode == 0
+        m.last_error = res.stderr[-2048:] if res.returncode else ""
+        if not m.last_build_ok:
+            log.logf(0, "ci: %s: build failed: %s", m.name,
+                     m.last_error[-256:])
+            if self.dash is not None:
+                try:
+                    self.dash.report_crash(
+                        manager=m.name,
+                        title=f"{m.name} build error",
+                        log=m.last_error)
+                except Exception as e:
+                    log.logf(0, "ci: dashboard report failed: %s", e)
+        return m.last_build_ok
+
+    def _restart(self, m: ManagedInstance) -> None:
+        if m.proc is not None and m.proc.poll() is None:
+            m.proc.terminate()
+            try:
+                m.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                m.proc.kill()
+                m.proc.wait()
+        if not m.manager_cmd:
+            return
+        logf = open(os.path.join(self.cfg.workdir,
+                                 f"{m.name}.log"), "ab")
+        m.proc = subprocess.Popen(m.manager_cmd, shell=True,
+                                  stdout=logf, stderr=subprocess.STDOUT)
+        log.logf(0, "ci: %s: started (pid %d)", m.name, m.proc.pid)
+
+    # -- patch-test jobs (syz-ci/jobs.go:105) ----------------------------
+
+    def poll_jobs(self, test_fn=None) -> Optional[dict]:
+        """Claim one dashboard job, apply the patch on a throwaway
+        branch, run the test, report the outcome."""
+        if self.dash is None:
+            return None
+        try:
+            job = self.dash.job_poll([m.name for m in self.managers])
+        except Exception as e:
+            log.logf(0, "ci: job poll failed: %s", e)
+            return None
+        if not job or "id" not in job:
+            return None
+        m = self.managers[0] if self.managers else None
+        ok, error = False, ""
+        try:
+            if m is not None and m.repo and job.get("patch"):
+                # Preserve any local worktree state across the job:
+                # stash (tracking whether one was created), and after
+                # the test drop both modifications and files the patch
+                # added, then restore the stash.
+                stashed = "No local changes" not in subprocess.run(
+                    ["git", "-C", m.repo, "stash",
+                     "--include-untracked"],
+                    capture_output=True, text=True).stdout
+                res = subprocess.run(
+                    ["git", "-C", m.repo, "apply", "--check", "-"],
+                    input=job["patch"], capture_output=True, text=True)
+                if res.returncode != 0:
+                    error = f"patch does not apply: {res.stderr[-512:]}"
+                else:
+                    subprocess.run(["git", "-C", m.repo, "apply", "-"],
+                                   input=job["patch"], capture_output=True,
+                                   text=True)
+                    try:
+                        ok = bool(test_fn(job)) if test_fn is not None \
+                            else self._build(m)
+                        if not ok:
+                            error = m.last_error or "test failed"
+                    finally:
+                        _git(m.repo, "checkout", "--", ".", check=False)
+                        _git(m.repo, "clean", "-fd", check=False)
+                if stashed:
+                    _git(m.repo, "stash", "pop", check=False)
+            else:
+                ok = bool(test_fn(job)) if test_fn is not None else False
+        except Exception as e:
+            error = str(e)
+        try:
+            self.dash.job_done(job["id"], ok, error)
+        except Exception as e:
+            log.logf(0, "ci: job_done report failed: %s", e)
+        return {"id": job["id"], "ok": ok, "error": error}
+
+    # -- main loop --------------------------------------------------------
+
+    def loop(self) -> None:
+        while not self.stop_ev.wait(self.cfg.poll_period_s):
+            # The daemon must outlive transient repo/dashboard errors.
+            for m in self.managers:
+                try:
+                    self.check_manager(m)
+                except Exception as e:
+                    log.logf(0, "ci: %s: check failed: %s", m.name, e)
+            try:
+                self.poll_jobs()
+            except Exception as e:
+                log.logf(0, "ci: job cycle failed: %s", e)
+
+    def shutdown(self) -> None:
+        self.stop_ev.set()
+        for m in self.managers:
+            if m.proc is not None and m.proc.poll() is None:
+                m.proc.terminate()

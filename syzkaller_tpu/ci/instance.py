@@ -1,0 +1,92 @@
+"""Boot-test instances: run the fuzzer inside a fresh VM to validate
+an image/build, or replay a repro for bisection
+(reference: pkg/instance/instance.go — TestImage, testInstance,
+used by syz-ci for build validation and pkg/bisect for testing).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from syzkaller_tpu.report import get_reporter
+from syzkaller_tpu.utils import log
+from syzkaller_tpu.vm.vm import create_pool, monitor_execution
+
+
+@dataclass
+class TestError(Exception):
+    """Image/build test failure with context."""
+    title: str
+    output: bytes = b""
+
+    def __str__(self) -> str:
+        return self.title
+
+
+def framework_cmd(module: str, *args: str) -> str:
+    """Shell command running a framework module with the package
+    importable regardless of the instance's cwd."""
+    import sys
+    from pathlib import Path
+
+    import syzkaller_tpu
+
+    root = Path(syzkaller_tpu.__file__).resolve().parents[1]
+    argstr = " ".join(args)
+    return (f"exec env PYTHONPATH={root} {sys.executable} "
+            f"-m {module} {argstr}")
+
+
+def test_image(cfg, duration_s: float = 30.0) -> None:
+    """Boot one instance and fuzz briefly; raises TestError on boot
+    failure or crash (reference: instance.go TestImage)."""
+    pool = create_pool(cfg)
+    reporter = get_reporter(cfg.target_os, ignores=cfg.ignores,
+                            suppressions=cfg.suppressions)
+    inst = pool.create(0)
+    try:
+        stop = threading.Event()
+        cmd = framework_cmd(
+            "syzkaller_tpu.fuzzer.main", "-name", "image-test",
+            "-os", cfg.target_os, "-arch", cfg.target_arch,
+            "-procs", "1", "-duration", str(duration_s))
+        stream = inst.run(duration_s + 60, stop, cmd)
+        res = monitor_execution(stream, reporter, exit_ok=True,
+                                no_output_timeout=60.0,
+                                not_executing_timeout=60.0)
+        if res.report is not None:
+            raise TestError(title=res.report.title, output=res.output)
+        log.logf(0, "image test passed")
+    finally:
+        inst.close()
+
+
+def test_repro(cfg, prog_text: bytes, duration_s: float = 30.0
+               ) -> Optional[str]:
+    """Run one program repeatedly in a fresh instance; returns the
+    crash title or None (the bisection predicate's workhorse,
+    reference: instance.go testRepro)."""
+    import os
+
+    pool = create_pool(cfg)
+    reporter = get_reporter(cfg.target_os, ignores=cfg.ignores,
+                            suppressions=cfg.suppressions)
+    inst = pool.create(0)
+    try:
+        prog_file = os.path.join(cfg.workdir, "repro.prog")
+        with open(prog_file, "wb") as f:
+            f.write(prog_text)
+        vm_path = inst.copy(prog_file)
+        stop = threading.Event()
+        cmd = framework_cmd(
+            "syzkaller_tpu", "execprog", "-os", cfg.target_os,
+            "-arch", cfg.target_arch, "-repeat", "0", vm_path)
+        stream = inst.run(duration_s, stop, cmd)
+        res = monitor_execution(stream, reporter, exit_ok=True,
+                                need_executing=False,
+                                no_output_timeout=duration_s)
+        return res.report.title if res.report is not None else None
+    finally:
+        inst.close()

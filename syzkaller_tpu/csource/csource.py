@@ -143,8 +143,8 @@ class _Renderer:
                 if not arg.data:
                     return
                 lit = "".join(f"\\x{b:02x}" for b in arg.data)
-                out.append(f'  memcpy((void*)0x{addr:x}, "{lit}", '
-                           f"{len(arg.data)});")
+                out.append(f'  NONFAILING(memcpy((void*)0x{addr:x}, '
+                           f'"{lit}", {len(arg.data)}));')
             elif isinstance(arg, ResultArg):
                 expr = self._result_expr(arg)
                 out.append(self._store(addr, arg.size(), expr, t))
@@ -182,19 +182,19 @@ class _Renderer:
                                    f"    csum_inet_update(&csum, "
                                    f"(const uint8_t*)&w{addr:x}, "
                                    f"{chunk.size});")
-                out.append(f"    *(uint16_t*)0x{addr:x} = "
-                           "csum_inet_digest(&csum);\n  }")
+                out.append(f"    NONFAILING(*(uint16_t*)0x{addr:x} = "
+                           "csum_inet_digest(&csum));\n  }")
         return out
 
     def _store(self, addr: int, size: int, expr: str, t) -> str:
         bf_off = getattr(t, "bitfield_off", 0)
         bf_len = getattr(t, "bitfield_len", 0)
         if bf_len:
-            return (f"  STORE_BY_BITMASK(uint{t.size * 8}_t, "
-                    f"0x{addr:x}, {expr}, {bf_off}, {bf_len});")
+            return (f"  NONFAILING(STORE_BY_BITMASK(uint{t.size * 8}_t, "
+                    f"0x{addr:x}, {expr}, {bf_off}, {bf_len}));")
         ctype = {1: "uint8_t", 2: "uint16_t", 4: "uint32_t",
                  8: "uint64_t"}.get(size, "uint64_t")
-        return f"  *({ctype}*)0x{addr:x} = {expr};"
+        return f"  NONFAILING(*({ctype}*)0x{addr:x} = {expr});"
 
     def _result_expr(self, arg: ResultArg) -> str:
         if arg.res is None:
@@ -305,12 +305,24 @@ static int procid;
     *(type*)(addr) = __v;                                             \
   } while (0)
 
-// tolerate wild stores into unmapped corners of the arena
+// tolerate wild stores into unmapped corners of the arena: every
+// copyin runs under NONFAILING, which arms the jump buffer before the
+// handler can fire (reference: executor/common.h NONFAILING)
 static __thread sigjmp_buf segv_env;
+static __thread int segv_armed;
+#define NONFAILING(...)                         \
+  do {                                          \
+    segv_armed = 1;                             \
+    if (sigsetjmp(segv_env, 1) == 0) {          \
+      __VA_ARGS__;                              \
+    }                                           \
+    segv_armed = 0;                             \
+  } while (0)
 static void segv_handler(int sig)
 {
   (void)sig;
-  siglongjmp(segv_env, 1);
+  if (segv_armed) siglongjmp(segv_env, 1);
+  _exit(sig);
 }
 static void install_segv_handler(void)
 {

@@ -1,0 +1,371 @@
+"""Dashboard service: fleet-wide bug tracking ("syzbot").
+
+Aggregates crashes from many managers into deduplicated bugs, tracks
+their reporting lifecycle, accepts build info, and hands out patch-test
+jobs to CI — a filesystem-backed reimplementation of the reference's
+App Engine service (reference: dashboard/app/main.go handlers,
+api.go API entry points, reporting.go state machine; entities
+dashboard/app/entities.go: Build/Bug/Crash/Job).
+
+Bug lifecycle: new → (reporting due) reported → open until a fix
+commit is attached or it is invalidated; dup-marking folds a bug into
+another.  Crash dedup is by (normalized title); per-bug crash logs are
+capped like the manager's (max_crashes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+from syzkaller_tpu.utils.hashsig import hash_string
+
+MAX_CRASHES_PER_BUG = 20
+
+STATUS_NEW = "new"
+STATUS_REPORTED = "reported"
+STATUS_FIXED = "fixed"
+STATUS_INVALID = "invalid"
+STATUS_DUP = "dup"
+
+
+@dataclass
+class Build:
+    """(reference: dashboard/app entities Build)"""
+    id: str = ""
+    manager: str = ""
+    os: str = ""
+    arch: str = ""
+    kernel_repo: str = ""
+    kernel_branch: str = ""
+    kernel_commit: str = ""
+    compiler: str = ""
+    time: float = 0.0
+
+
+@dataclass
+class Crash:
+    manager: str = ""
+    build_id: str = ""
+    log: str = ""  # stored file name
+    report: str = ""
+    repro_prog: str = ""
+    repro_c: str = ""
+    time: float = 0.0
+
+
+@dataclass
+class Bug:
+    id: str = ""
+    title: str = ""
+    status: str = STATUS_NEW
+    first_time: float = 0.0
+    last_time: float = 0.0
+    num_crashes: int = 0
+    reporting_due: float = 0.0
+    reported_time: float = 0.0
+    fix_commit: str = ""
+    dup_of: str = ""
+    crashes: list[Crash] = field(default_factory=list)
+
+
+@dataclass
+class Job:
+    """Patch-test job (reference: dashboard/app/jobs.go)."""
+    id: str = ""
+    bug_id: str = ""
+    manager: str = ""
+    patch: str = ""
+    kernel_repo: str = ""
+    kernel_branch: str = ""
+    status: str = "pending"  # pending → claimed → done
+    claimed_by: str = ""
+    result_ok: bool = False
+    result_error: str = ""
+
+
+class Dashboard:
+    def __init__(self, workdir: str, clients: Optional[dict] = None,
+                 reporting_delay_s: float = 0.0):
+        os.makedirs(workdir, exist_ok=True)
+        self.workdir = workdir
+        self.clients = clients or {}
+        self.reporting_delay_s = reporting_delay_s
+        self._lock = threading.Lock()
+        self.bugs: dict[str, Bug] = {}
+        self.builds: dict[str, Build] = {}
+        self.jobs: dict[str, Job] = {}
+        self._load()
+
+    # -- persistence ------------------------------------------------------
+
+    def _state_path(self) -> str:
+        return os.path.join(self.workdir, "state.json")
+
+    def _load(self) -> None:
+        try:
+            raw = json.load(open(self._state_path()))
+        except (OSError, json.JSONDecodeError):
+            return
+        for b in raw.get("bugs", []):
+            crashes = [Crash(**c) for c in b.pop("crashes", [])]
+            bug = Bug(**b)
+            bug.crashes = crashes
+            self.bugs[bug.id] = bug
+        for b in raw.get("builds", []):
+            build = Build(**b)
+            self.builds[build.id] = build
+        for j in raw.get("jobs", []):
+            job = Job(**j)
+            self.jobs[job.id] = job
+
+    def _save(self) -> None:
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "bugs": [asdict(b) for b in self.bugs.values()],
+                "builds": [asdict(b) for b in self.builds.values()],
+                "jobs": [asdict(j) for j in self.jobs.values()],
+            }, f)
+        os.replace(tmp, self._state_path())
+
+    # -- API (reference: dashboard/app/api.go) ---------------------------
+
+    def _auth(self, params: dict) -> str:
+        client = params.get("client", "")
+        if self.clients and self.clients.get(client) != params.get("key"):
+            raise PermissionError(f"unauthorized client {client!r}")
+        return client
+
+    def upload_build(self, params: dict) -> dict:
+        self._auth(params)
+        b = Build(id=params.get("id") or hash_string(
+            json.dumps(params, sort_keys=True).encode())[:16],
+            manager=params.get("manager", ""),
+            os=params.get("os", ""), arch=params.get("arch", ""),
+            kernel_repo=params.get("kernel_repo", ""),
+            kernel_branch=params.get("kernel_branch", ""),
+            kernel_commit=params.get("kernel_commit", ""),
+            compiler=params.get("compiler", ""), time=time.time())
+        with self._lock:
+            self.builds[b.id] = b
+            self._save()
+        return {"id": b.id}
+
+    def report_crash(self, params: dict) -> dict:
+        """Dedup by title into a Bug; returns whether a repro is
+        wanted (reference: api.go apiReportCrash + needRepro logic)."""
+        self._auth(params)
+        title = params.get("title", "unknown")
+        bug_id = hash_string(title.encode())[:16]
+        now = time.time()
+        crash = Crash(manager=params.get("manager", ""),
+                      build_id=params.get("build_id", ""),
+                      repro_prog=params.get("repro_prog", ""),
+                      repro_c=params.get("repro_c", ""), time=now)
+        with self._lock:
+            bug = self.bugs.get(bug_id)
+            if bug is None:
+                bug = Bug(id=bug_id, title=title, first_time=now,
+                          reporting_due=now + self.reporting_delay_s)
+                self.bugs[bug_id] = bug
+            bug.last_time = now
+            bug.num_crashes += 1
+            # Store under the cap; a crash carrying a repro always
+            # lands, evicting a repro-less one if the bug is full —
+            # otherwise need_repro would stay true forever.
+            stored = False
+            if len(bug.crashes) < MAX_CRASHES_PER_BUG:
+                bug.crashes.append(crash)
+                stored = True
+            elif crash.repro_prog:
+                for i, old in enumerate(bug.crashes):
+                    if not old.repro_prog:
+                        bug.crashes[i] = crash
+                        stored = True
+                        break
+            has_repro = any(c.repro_prog for c in bug.crashes)
+        # blob files only for crashes actually kept, outside the lock
+        if stored:
+            for attr, key in (("log", "log"), ("report", "report")):
+                data = params.get(key) or ""
+                if data:
+                    d = os.path.join(self.workdir, "bug-" + bug_id)
+                    os.makedirs(d, exist_ok=True)
+                    fname = os.path.join(d, f"{key}-{int(now)}")
+                    with open(fname, "w") as f:
+                        f.write(data)
+                    setattr(crash, attr, fname)
+        with self._lock:
+            self._save()
+        return {"bug_id": bug_id, "need_repro": not has_repro
+                and bug.status not in (STATUS_INVALID, STATUS_DUP)}
+
+    def need_repro(self, params: dict) -> dict:
+        self._auth(params)
+        title = params.get("title", "")
+        bug_id = hash_string(title.encode())[:16]
+        with self._lock:
+            bug = self.bugs.get(bug_id)
+            if bug is None:
+                return {"need_repro": False}
+            return {"need_repro": not any(c.repro_prog
+                                          for c in bug.crashes)}
+
+    def manager_stats(self, params: dict) -> dict:
+        self._auth(params)
+        name = params.get("manager", "")
+        path = os.path.join(self.workdir, f"stats-{name}.jsonl")
+        rec = {k: v for k, v in params.items()
+               if k not in ("client", "key")}
+        rec["ts"] = time.time()
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return {}
+
+    # -- reporting state machine (reference: reporting.go) ---------------
+
+    def poll_reports(self) -> list[dict]:
+        """Bugs due for (email-style) reporting; transitions them to
+        reported."""
+        now = time.time()
+        out = []
+        with self._lock:
+            for bug in self.bugs.values():
+                if bug.status == STATUS_NEW and bug.reporting_due <= now:
+                    bug.status = STATUS_REPORTED
+                    bug.reported_time = now
+                    out.append({"id": bug.id, "title": bug.title,
+                                "num_crashes": bug.num_crashes})
+            if out:
+                self._save()
+        return out
+
+    def update_bug(self, bug_id: str, status: Optional[str] = None,
+                   fix_commit: str = "", dup_of: str = "") -> None:
+        """Operator/email commands: fix/invalid/dup
+        (reference: reporting.go incomingCommand)."""
+        with self._lock:
+            bug = self.bugs[bug_id]
+            if fix_commit:
+                bug.fix_commit = fix_commit
+                bug.status = STATUS_FIXED
+            elif dup_of:
+                bug.dup_of = dup_of
+                bug.status = STATUS_DUP
+            elif status:
+                bug.status = status
+            self._save()
+
+    # -- jobs (reference: dashboard/app/jobs.go:105) ---------------------
+
+    def add_job(self, bug_id: str, patch: str, kernel_repo: str = "",
+                kernel_branch: str = "", manager: str = "") -> str:
+        jid = hash_string(f"{bug_id}{patch}{time.time()}".encode())[:16]
+        with self._lock:
+            self.jobs[jid] = Job(id=jid, bug_id=bug_id, patch=patch,
+                                 kernel_repo=kernel_repo,
+                                 kernel_branch=kernel_branch,
+                                 manager=manager)
+            self._save()
+        return jid
+
+    def job_poll(self, params: dict) -> dict:
+        self._auth(params)
+        managers = params.get("managers") or []
+        with self._lock:
+            for job in self.jobs.values():
+                if job.status == "pending" and \
+                        (not job.manager or job.manager in managers):
+                    job.status = "claimed"
+                    job.claimed_by = params.get("client", "")
+                    self._save()
+                    return {"id": job.id, "bug_id": job.bug_id,
+                            "patch": job.patch,
+                            "kernel_repo": job.kernel_repo,
+                            "kernel_branch": job.kernel_branch}
+        return {}
+
+    def job_done(self, params: dict) -> dict:
+        self._auth(params)
+        with self._lock:
+            job = self.jobs.get(params.get("id", ""))
+            if job is None:
+                return {}
+            job.status = "done"
+            job.result_ok = bool(params.get("ok"))
+            job.result_error = params.get("error", "")
+            self._save()
+        return {}
+
+
+def serve_dashboard(workdir: str, addr: tuple[str, int] = ("127.0.0.1", 0),
+                    clients: Optional[dict] = None):
+    """HTTP JSON API + minimal HTML UI for a Dashboard."""
+    import html as html_mod
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    dash = Dashboard(workdir, clients)
+    api = {
+        "upload_build": dash.upload_build,
+        "report_crash": dash.report_crash,
+        "need_repro": dash.need_repro,
+        "manager_stats": dash.manager_stats,
+        "job_poll": dash.job_poll,
+        "job_done": dash.job_done,
+    }
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, code: int, body: bytes,
+                   ctype: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # noqa: N802
+            method = self.path.strip("/").removeprefix("api/")
+            fn = api.get(method)
+            if fn is None:
+                return self._reply(404, b'{"error": "no such method"}')
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                params = json.loads(self.rfile.read(length) or b"{}")
+                res = fn(params)
+                self._reply(200, json.dumps(res).encode())
+            except PermissionError as e:
+                self._reply(403, json.dumps({"error": str(e)}).encode())
+            except Exception as e:
+                self._reply(500, json.dumps({"error": str(e)}).encode())
+
+        def do_GET(self):  # noqa: N802
+            if self.path != "/":
+                return self._reply(404, b"not found", "text/plain")
+            # snapshot under the lock, render outside it so API POSTs
+            # from the fleet aren't blocked by UI traffic
+            with dash._lock:
+                snap = [(b.title, b.status, b.num_crashes,
+                         any(c.repro_prog for c in b.crashes))
+                        for b in dash.bugs.values()]
+            snap.sort(key=lambda r: -r[2])
+            rows = "".join(
+                f"<tr><td>{html_mod.escape(title)}</td>"
+                f"<td>{status}</td><td>{n}</td>"
+                f"<td>{'yes' if has_repro else ''}</td></tr>"
+                for title, status, n, has_repro in snap)
+            page = ("<html><body><h2>bugs</h2><table border=1>"
+                    "<tr><th>title</th><th>status</th><th>crashes</th>"
+                    f"<th>repro</th></tr>{rows}</table></body></html>")
+            self._reply(200, page.encode(), "text/html")
+
+    srv = ThreadingHTTPServer(addr, Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, dash

@@ -1,0 +1,76 @@
+"""Dashboard client API used by managers and CI
+(reference: dashboard/dashapi/dashapi.go:22-240 — UploadBuild,
+ReportCrash, NeedRepro, JobPoll/JobDone, ManagerStats over HTTPS)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+class DashboardError(Exception):
+    pass
+
+
+class DashClient:
+    def __init__(self, addr: str, client: str = "", key: str = "",
+                 timeout_s: float = 30.0):
+        # addr: "host:port" or full http(s) URL
+        if not addr.startswith("http"):
+            addr = "http://" + addr
+        self.base = addr.rstrip("/")
+        self.client = client
+        self.key = key
+        self.timeout_s = timeout_s
+
+    def _call(self, method: str, params: Optional[dict] = None) -> dict:
+        payload = dict(params or {})
+        payload.setdefault("client", self.client)
+        payload.setdefault("key", self.key)
+        req = urllib.request.Request(
+            f"{self.base}/api/{method}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            raise DashboardError(
+                f"{method}: HTTP {e.code}: {e.read().decode()[:256]}") \
+                from e
+        except (urllib.error.URLError, OSError) as e:
+            raise DashboardError(f"{method}: {e}") from e
+
+    # -- API surface (dashapi.go) ----------------------------------------
+
+    def upload_build(self, manager: str, os: str, arch: str,
+                     kernel_commit: str = "", kernel_repo: str = "",
+                     kernel_branch: str = "", compiler: str = "") -> str:
+        res = self._call("upload_build", {
+            "manager": manager, "os": os, "arch": arch,
+            "kernel_commit": kernel_commit, "kernel_repo": kernel_repo,
+            "kernel_branch": kernel_branch, "compiler": compiler})
+        return res.get("id", "")
+
+    def report_crash(self, manager: str, title: str, log: str = "",
+                     report: str = "", build_id: str = "",
+                     repro_prog: str = "", repro_c: str = "") -> dict:
+        return self._call("report_crash", {
+            "manager": manager, "title": title, "log": log,
+            "report": report, "build_id": build_id,
+            "repro_prog": repro_prog, "repro_c": repro_c})
+
+    def need_repro(self, title: str) -> bool:
+        return bool(self._call("need_repro",
+                               {"title": title}).get("need_repro"))
+
+    def manager_stats(self, manager: str, **stats) -> None:
+        self._call("manager_stats", {"manager": manager, **stats})
+
+    def job_poll(self, managers: list[str]) -> dict:
+        return self._call("job_poll", {"managers": managers})
+
+    def job_done(self, job_id: str, ok: bool, error: str = "") -> None:
+        self._call("job_done", {"id": job_id, "ok": ok, "error": error})

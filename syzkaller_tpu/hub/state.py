@@ -33,6 +33,7 @@ class ManagerState:
     pending_repros: list[bytes] = field(default_factory=list)
     seen_repros: set[str] = field(default_factory=set)
     connected: bool = False
+    own_db: object = None  # cached open DB handle
 
 
 class HubState:
@@ -44,8 +45,14 @@ class HubState:
         self.corpus_db = open_db(os.path.join(workdir, "corpus.db"))
         self.managers: dict[str, ManagerState] = {}
         self.next_seq = 1
+        # seq-ordered (seq, key) index so Sync streams deltas without
+        # re-sorting the whole corpus every call; stale entries
+        # (deleted/superseded) are skipped at read time.
+        self._seq_order: list[tuple[int, str]] = []
         for key, rec in self.corpus_db.records.items():
             self.next_seq = max(self.next_seq, rec.seq + 1)
+            self._seq_order.append((rec.seq, key))
+        self._seq_order.sort()
         self._load_managers()
 
     def _manager_dir(self, name: str) -> str:
@@ -79,17 +86,27 @@ class HubState:
 
     # -- protocol ---------------------------------------------------------
 
+    def _own_db(self, mgr: ManagerState):
+        """Cached per-manager DB handle — Sync runs every minute per
+        manager and must not re-parse the whole file each time."""
+        if mgr.own_db is None:
+            mgr.own_db = open_db(os.path.join(
+                self._manager_dir(mgr.name), "corpus.db"))
+        return mgr.own_db
+
     def connect(self, name: str, fresh: bool,
                 corpus: list[bytes]) -> None:
         """(reference: state.go:144-176)"""
         with self._lock:
             mgr = self.managers.get(name)
             if mgr is None or fresh:
+                prev = mgr
                 mgr = ManagerState(name=name)
+                if prev is not None:
+                    mgr.own_db = prev.own_db
                 self.managers[name] = mgr
             mgr.connected = True
-            own_db = open_db(os.path.join(self._manager_dir(name),
-                                          "corpus.db"))
+            own_db = self._own_db(mgr)
             if fresh:
                 for key in list(own_db.records):
                     own_db.delete(key)
@@ -110,8 +127,7 @@ class HubState:
             mgr = self.managers.get(name)
             if mgr is None:
                 raise KeyError(f"manager {name!r} never connected")
-            own_db = open_db(os.path.join(self._manager_dir(name),
-                                          "corpus.db"))
+            own_db = self._own_db(mgr)
             for prog in add:
                 self._add_prog(name, mgr, prog, own_db)
             for h in delete:
@@ -130,20 +146,25 @@ class HubState:
                     other.seen_repros.add(h)
                     other.pending_repros.append(rp)
 
-            # stream new progs from other managers
+            # stream new progs from other managers (seq index walk;
+            # bisect to the cursor instead of scanning from 0)
+            import bisect as _bisect
+
             progs: list[bytes] = []
             max_seq = mgr.last_seq
-            records = sorted(self.corpus_db.records.items(),
-                             key=lambda kv: kv[1].seq)
             remaining = 0
-            for key, rec in records:
-                if rec.seq <= mgr.last_seq or key in mgr.own_hashes:
+            start = _bisect.bisect_right(self._seq_order,
+                                         (mgr.last_seq, "\xff"))
+            for seq, key in self._seq_order[start:]:
+                rec = self.corpus_db.records.get(key)
+                if rec is None or rec.seq != seq \
+                        or key in mgr.own_hashes:
                     continue
                 if len(progs) >= SYNC_BATCH:
                     remaining += 1
                     continue
                 progs.append(rec.val)
-                max_seq = max(max_seq, rec.seq)
+                max_seq = max(max_seq, seq)
             mgr.last_seq = max_seq
             self._persist_manager(mgr)
 
@@ -165,6 +186,7 @@ class HubState:
         own_db.save(key, b"", 0)
         if key not in self.corpus_db.records:
             self.corpus_db.save(key, prog, self.next_seq)
+            self._seq_order.append((self.next_seq, key))
             self.next_seq += 1
         return key
 

@@ -32,7 +32,6 @@ class HubSyncer:
                                 name=mgr.cfg.hub_client)
         self._connected = False
         self._uploaded: set[str] = set()
-        self._sent_repros: set[str] = set()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
@@ -61,11 +60,13 @@ class HubSyncer:
                                                    PHASE_TRIAGED_HUB)
 
         if not self._connected:
-            corpus = [inp["prog"] for inp in self.mgr.serv.corpus.values()]
+            with self.mgr.serv._lock:
+                items = dict(self.mgr.serv.corpus)
             self.client.call_transient("Hub.Connect", {
-                **self._ident(), "fresh": self.fresh, "corpus": corpus,
+                **self._ident(), "fresh": self.fresh,
+                "corpus": [inp["prog"] for inp in items.values()],
             })
-            self._uploaded = {h for h in self.mgr.serv.corpus}
+            self._uploaded = set(items)
             self._connected = True
 
         # new local inputs since the last sync
@@ -73,20 +74,18 @@ class HubSyncer:
             items = dict(self.mgr.serv.corpus)
         add = [inp["prog"] for h, inp in items.items()
                if h not in self._uploaded]
-        self._uploaded |= set(items)
 
-        # pending crash repro programs (send each once)
-        repros = []
-        for title, log_ in list(getattr(self.mgr, "hub_repros", [])):
-            if title in self._sent_repros:
-                continue
-            self._sent_repros.add(title)
-            repros.append(log_)
+        # pending crash repro programs from the manager's repro
+        # pipeline; acked only after a successful RPC so a failed
+        # sync retransmits them
+        repros = self.mgr.peek_hub_repros()
 
         res = self.client.call_transient("Hub.Sync", {
             **self._ident(), "need_repros": True,
             "repros": repros, "add": add, "delete": [],
         }) or {}
+        self._uploaded |= set(items)
+        self.mgr.ack_hub_repros(len(repros))
 
         progs = res.get("progs") or []
         if progs:

@@ -78,6 +78,7 @@ class Manager:
             ignores=cfg.ignores, suppressions=cfg.suppressions)
         self.stop_ev = threading.Event()
         self.pending_repro: list[tuple[str, bytes]] = []  # (title, log)
+        self.hub_repros: list[str] = []  # repro prog texts for the hub
 
         # RPC service + corpus
         prios = calculate_priorities(self.target, [])
@@ -107,6 +108,14 @@ class Manager:
             else:
                 self.hub = HubSyncer(self)
                 self.hub.start()
+
+        self.dash = None
+        if cfg.dashboard_client:
+            from syzkaller_tpu.dashboard.dashapi import DashClient
+
+            self.dash = DashClient(cfg.dashboard_addr,
+                                   cfg.dashboard_client,
+                                   cfg.dashboard_key)
 
         self.bench_file = None
         self._bench_thread = None
@@ -183,6 +192,14 @@ class Manager:
                 break
         log.logf(0, "crash: %s (%s)", title,
                  "new" if first else f"seen {entry.count}x")
+        if self.dash is not None:
+            try:
+                self.dash.report_crash(
+                    manager=self.cfg.name, title=title,
+                    log=rep.output.decode("utf-8", "replace")[-65536:],
+                    report=rep.report.decode("utf-8", "replace")[-65536:])
+            except Exception as e:
+                log.logf(0, "dashboard crash report failed: %s", e)
         return Crash(title=title, report=rep, vm_index=vm_index,
                      first=first)
 
@@ -216,6 +233,17 @@ class Manager:
         with self._lock:
             self.stats_extra["repro"] += 1
             self.crash_types.setdefault(title, CrashEntry()).repro_done = True
+            # queue the repro program for hub fan-out (hubsync drains
+            # with ack-after-send semantics)
+            self.hub_repros.append(prog_text.decode("utf-8", "replace"))
+
+    def peek_hub_repros(self, limit: int = 100) -> list[str]:
+        with self._lock:
+            return self.hub_repros[:limit]
+
+    def ack_hub_repros(self, n: int) -> None:
+        with self._lock:
+            del self.hub_repros[:n]
 
     # -- corpus minimization ----------------------------------------------
 

@@ -30,15 +30,15 @@ def main(argv=None) -> int:
         h, p = mgr.http_server.server_address
         print(f"HTTP UI on http://{h}:{p}/", flush=True)
 
-    import sys as _sys
+    from syzkaller_tpu.ci.instance import framework_cmd
 
     def fuzzer_cmd(inst, index):
         fwd = inst.forward(port)
-        return (f"cd {_sys.path[0] or '.'} && "
-                f"exec {_sys.executable} -m syzkaller_tpu.fuzzer.main "
-                f"-name fuzzer-{index} -manager {fwd} "
-                f"-os {cfg.target_os} -arch {cfg.target_arch} "
-                f"-procs {cfg.procs} -engine {cfg.engine}")
+        return framework_cmd(
+            "syzkaller_tpu.fuzzer.main", "-name", f"fuzzer-{index}",
+            "-manager", fwd, "-os", cfg.target_os,
+            "-arch", cfg.target_arch, "-procs", str(cfg.procs),
+            "-engine", cfg.engine)
 
     try:
         mgr.vm_loop(fuzzer_cmd)

@@ -1,0 +1,273 @@
+"""CI, dashboard, dashapi, bisect, and instance tests."""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+from syzkaller_tpu.ci.bisect import TestResult, bisect, bisect_fix
+from syzkaller_tpu.ci.ci import CI, CIConfig
+from syzkaller_tpu.dashboard.app import (Dashboard, STATUS_DUP,
+                                         STATUS_FIXED, STATUS_REPORTED,
+                                         serve_dashboard)
+from syzkaller_tpu.dashboard.dashapi import DashClient, DashboardError
+
+
+# -- dashboard state machine ---------------------------------------------
+
+
+def test_dashboard_bug_dedup(tmp_path):
+    d = Dashboard(str(tmp_path))
+    r1 = d.report_crash({"manager": "m1", "title": "KASAN: uaf in foo",
+                         "log": "log1"})
+    r2 = d.report_crash({"manager": "m2", "title": "KASAN: uaf in foo",
+                         "log": "log2"})
+    assert r1["bug_id"] == r2["bug_id"]
+    bug = d.bugs[r1["bug_id"]]
+    assert bug.num_crashes == 2
+    assert len(bug.crashes) == 2
+    # crash from a second manager landed in the same bug
+    assert {c.manager for c in bug.crashes} == {"m1", "m2"}
+
+
+def test_dashboard_need_repro_flow(tmp_path):
+    d = Dashboard(str(tmp_path))
+    r = d.report_crash({"title": "BUG: x"})
+    assert r["need_repro"]
+    d.report_crash({"title": "BUG: x", "repro_prog": "prog()"})
+    r3 = d.report_crash({"title": "BUG: x"})
+    assert not r3["need_repro"]  # repro exists now
+    assert not d.need_repro({"title": "BUG: x"})["need_repro"]
+
+
+def test_dashboard_reporting_lifecycle(tmp_path):
+    d = Dashboard(str(tmp_path), reporting_delay_s=0.0)
+    r = d.report_crash({"title": "WARNING in bar"})
+    reports = d.poll_reports()
+    assert [x["title"] for x in reports] == ["WARNING in bar"]
+    assert d.bugs[r["bug_id"]].status == STATUS_REPORTED
+    assert d.poll_reports() == []  # reported once
+    d.update_bug(r["bug_id"], fix_commit="deadbeef")
+    assert d.bugs[r["bug_id"]].status == STATUS_FIXED
+    # dup-marking
+    r2 = d.report_crash({"title": "WARNING in baz"})
+    d.update_bug(r2["bug_id"], dup_of=r["bug_id"])
+    assert d.bugs[r2["bug_id"]].status == STATUS_DUP
+
+
+def test_dashboard_persistence(tmp_path):
+    d = Dashboard(str(tmp_path))
+    d.report_crash({"title": "BUG: persists"})
+    d2 = Dashboard(str(tmp_path))
+    assert any(b.title == "BUG: persists" for b in d2.bugs.values())
+
+
+def test_dashboard_jobs(tmp_path):
+    d = Dashboard(str(tmp_path))
+    jid = d.add_job("bug1", patch="--- a/f\n+++ b/f\n", manager="m1")
+    job = d.job_poll({"client": "ci", "managers": ["m1"]})
+    assert job["id"] == jid
+    # claimed: not handed out twice
+    assert d.job_poll({"client": "ci", "managers": ["m1"]}) == {}
+    d.job_done({"id": jid, "ok": True})
+    assert d.jobs[jid].status == "done"
+    assert d.jobs[jid].result_ok
+
+
+def test_dashboard_auth(tmp_path):
+    d = Dashboard(str(tmp_path), clients={"ci": "key1"})
+    with pytest.raises(PermissionError):
+        d.report_crash({"client": "ci", "key": "bad", "title": "x"})
+    d.report_crash({"client": "ci", "key": "key1", "title": "x"})
+
+
+# -- HTTP API + client ---------------------------------------------------
+
+
+def test_dashapi_over_http(tmp_path):
+    srv, dash = serve_dashboard(str(tmp_path),
+                                clients={"mgr": "secret"})
+    try:
+        host, port = srv.server_address
+        c = DashClient(f"{host}:{port}", client="mgr", key="secret")
+        build_id = c.upload_build("m1", "linux", "amd64",
+                                  kernel_commit="abc123")
+        assert build_id
+        res = c.report_crash("m1", "KASAN: uaf in net",
+                             log="console log", build_id=build_id)
+        assert res["need_repro"]
+        c.manager_stats("m1", corpus=100, execs=5000)
+        assert not c.job_poll(["m1"])  # no jobs queued
+        bad = DashClient(f"{host}:{port}", client="mgr", key="wrong")
+        with pytest.raises(DashboardError, match="403"):
+            bad.report_crash("m1", "x")
+        # stats landed on disk
+        assert os.path.exists(os.path.join(str(tmp_path),
+                                           "stats-m1.jsonl"))
+    finally:
+        srv.shutdown()
+
+
+def test_manager_reports_crashes_to_dashboard(tmp_path):
+    from syzkaller_tpu.manager.manager import Manager
+    from syzkaller_tpu.manager.mgrconfig import load_config
+    from syzkaller_tpu.report import Report
+
+    srv, dash = serve_dashboard(str(tmp_path / "dash"))
+    try:
+        host, port = srv.server_address
+        cfg = load_config({"workdir": str(tmp_path / "w"),
+                           "target": "test/64", "http": "",
+                           "dashboard_client": "m",
+                           "dashboard_addr": f"{host}:{port}"})
+        m = Manager(cfg)
+        m.save_crash(Report(title="BUG: dashboard test",
+                            output=b"out", report=b"rep"))
+        m.shutdown()
+        assert any(b.title == "BUG: dashboard test"
+                   for b in dash.bugs.values())
+    finally:
+        srv.shutdown()
+
+
+# -- bisect --------------------------------------------------------------
+
+
+@pytest.fixture
+def git_repo(tmp_path):
+    repo = str(tmp_path / "repo")
+    os.makedirs(repo)
+
+    def git(*args, **kw):
+        subprocess.run(["git", "-C", repo, *args], check=True,
+                       capture_output=True, **kw)
+
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    commits = []
+    for i in range(10):
+        with open(os.path.join(repo, "f.txt"), "w") as f:
+            f.write(f"version {i}\n")
+        git("add", "f.txt")
+        git("commit", "-q", "-m", f"commit {i}")
+        out = subprocess.run(["git", "-C", repo, "rev-parse", "HEAD"],
+                             capture_output=True, text=True, check=True)
+        commits.append(out.stdout.strip())
+    return repo, commits
+
+
+def test_bisect_finds_culprit(git_repo):
+    repo, commits = git_repo
+    culprit_idx = 6
+
+    def pred(commit):
+        idx = commits.index(commit)
+        return TestResult.BAD if idx >= culprit_idx else TestResult.GOOD
+
+    res = bisect(repo, good=commits[0], bad=commits[-1], pred=pred)
+    assert res is not None
+    assert res.commit == commits[culprit_idx]
+    assert res.tested <= 5  # log2(10) + slack
+
+
+def test_bisect_fix_finds_fixing_commit(git_repo):
+    repo, commits = git_repo
+    fix_idx = 4  # crashes before, fixed from here on
+
+    def pred(commit):
+        idx = commits.index(commit)
+        return TestResult.GOOD if idx >= fix_idx else TestResult.BAD
+
+    res = bisect_fix(repo, bad=commits[0], good=commits[-1], pred=pred)
+    assert res is not None
+    assert res.commit == commits[fix_idx]
+
+
+# -- instance ------------------------------------------------------------
+
+
+def test_instance_image_test(tmp_path):
+    from syzkaller_tpu.ci.instance import test_image
+    from syzkaller_tpu.manager.mgrconfig import load_config
+
+    cfg = load_config({"workdir": str(tmp_path / "w"),
+                       "target": "test/64", "http": "", "type": "local"})
+    os.makedirs(cfg.workdir, exist_ok=True)
+    test_image(cfg, duration_s=6.0)  # raises on failure
+
+
+# -- CI loop -------------------------------------------------------------
+
+
+def test_ci_build_and_restart_cycle(tmp_path, git_repo):
+    repo, commits = git_repo
+    marker = str(tmp_path / "built")
+    cfg = CIConfig(workdir=str(tmp_path / "ci"), managers=[{
+        "name": "mgr-a", "repo": repo, "branch": "main",
+        "build_cmd": f"touch {marker}",
+        "manager_cmd": "sleep 30",
+    }])
+    ci = CI(cfg)
+    try:
+        m = ci.managers[0]
+        assert ci.check_manager(m)  # first deploy
+        assert os.path.exists(marker)
+        assert m.proc is not None and m.proc.poll() is None
+        first_pid = m.proc.pid
+        assert not ci.check_manager(m)  # no new commit: no restart
+        assert m.proc.pid == first_pid
+        # new commit appears → rebuild + restart
+        with open(os.path.join(repo, "f.txt"), "w") as f:
+            f.write("new\n")
+        subprocess.run(["git", "-C", repo, "commit", "-aqm", "more"],
+                       check=True, capture_output=True)
+        assert ci.check_manager(m)
+        assert m.proc.pid != first_pid
+    finally:
+        ci.shutdown()
+
+
+def test_ci_build_failure_reported(tmp_path, git_repo):
+    repo, _ = git_repo
+    srv, dash = serve_dashboard(str(tmp_path / "dash"))
+    try:
+        host, port = srv.server_address
+        cfg = CIConfig(workdir=str(tmp_path / "ci"),
+                       dashboard_addr=f"{host}:{port}",
+                       dashboard_client="ci",
+                       managers=[{
+                           "name": "mgr-a", "repo": repo,
+                           "build_cmd": "false",
+                       }])
+        ci = CI(cfg)
+        assert not ci.check_manager(ci.managers[0])
+        assert any("build error" in b.title for b in dash.bugs.values())
+    finally:
+        srv.shutdown()
+
+
+def test_ci_patch_test_job(tmp_path, git_repo):
+    repo, _ = git_repo
+    srv, dash = serve_dashboard(str(tmp_path / "dash"))
+    try:
+        host, port = srv.server_address
+        patch = subprocess.run(
+            ["git", "-C", repo, "format-patch", "--stdout", "HEAD~1"],
+            capture_output=True, text=True, check=True).stdout
+        # revert the file so the patch applies
+        subprocess.run(["git", "-C", repo, "checkout", "-q", "HEAD~1"],
+                       check=True, capture_output=True)
+        jid = dash.add_job("bug1", patch=patch, manager="mgr-a")
+        cfg = CIConfig(workdir=str(tmp_path / "ci"),
+                       dashboard_addr=f"{host}:{port}",
+                       dashboard_client="ci",
+                       managers=[{"name": "mgr-a", "repo": repo}])
+        ci = CI(cfg)
+        res = ci.poll_jobs(test_fn=lambda job: True)
+        assert res is not None and res["ok"]
+        assert dash.jobs[jid].status == "done"
+        assert dash.jobs[jid].result_ok
+    finally:
+        srv.shutdown()
