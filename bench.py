@@ -68,7 +68,7 @@ def build(batch_size: int, edges_per_prog: int):
     return jax.jit(step), batch, plane, progs, target
 
 
-def bench_device(batch_size=512, edges_per_prog=128, steps=20) -> float:
+def bench_device(batch_size=1024, edges_per_prog=128, steps=20) -> float:
     import jax
     from jax import random
 
@@ -116,7 +116,7 @@ def bench_cpu(seconds=3.0, edges_per_prog=128) -> float:
 
 def main() -> None:
     batch = int(sys.argv[sys.argv.index("--batch") + 1]) \
-        if "--batch" in sys.argv else 512
+        if "--batch" in sys.argv else 1024
     steps = int(sys.argv[sys.argv.index("--steps") + 1]) \
         if "--steps" in sys.argv else 20
     dev_rate = bench_device(batch_size=batch, steps=steps)
