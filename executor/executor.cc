@@ -228,7 +228,8 @@ constexpr int kMaxCmps = 512;
 struct CallJob {
   // inputs
   uint32_t call_index;
-  uint32_t call_id;
+  uint32_t call_id;  // table id: sim dispatch + result attribution
+  uint32_t nr;       // kernel syscall number (real-OS backend)
   uint64_t args[8];
   int nargs;
   bool collect_cover;
@@ -358,7 +359,7 @@ class Worker {
       static thread_local Kcov kcov;
       static thread_local bool kcov_ok = kcov.open_();
       if (kcov_ok) kcov.enable();
-      long res = syscall(j->call_id, j->args[0], j->args[1], j->args[2],
+      long res = syscall(j->nr, j->args[0], j->args[1], j->args[2],
                          j->args[3], j->args[4], j->args[5]);
       o->errno_ = res == -1 ? errno : 0;
       o->ret = res == -1 ? 0 : (uint64_t)res;
@@ -366,7 +367,7 @@ class Worker {
         cov_len = kcov.disable(cov, kMaxCov);
       } else {
         // no KCOV: one edge per (call, errno) so signal still flows
-        cov[0] = (uint32_t)splitmix64(j->call_id * 1000ull + o->errno_);
+        cov[0] = (uint32_t)splitmix64(j->nr * 1000ull + o->errno_);
         cov_len = 1;
       }
 #else
@@ -583,6 +584,7 @@ static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
     auto* job = new CallJob{};
     job->call_index = (uint32_t)calls.size();
     job->call_id = (uint32_t)w;
+    job->nr = (uint32_t)(w >> 32);
     job->collect_cover = req.exec_flags & kExecCollectCover;
     job->collect_comps = req.exec_flags & kExecCollectComps;
     uint64_t copyout_idx = in.next();

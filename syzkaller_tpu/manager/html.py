@@ -43,6 +43,13 @@ def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
                     self._send(_crash_page(mgr, q.get("id", [""])[0]))
                 elif url.path == "/syscalls":
                     self._send(_syscalls_page(mgr))
+                elif url.path == "/cover":
+                    self._send(_cover_page(mgr))
+                elif url.path == "/rawcover":
+                    with mgr.serv._lock:
+                        pcs = sorted(mgr.serv.cover)
+                    self._send("\n".join(f"0x{pc:x}" for pc in pcs),
+                               "text/plain")
                 else:
                     self.send_error(404)
             except BrokenPipeError:
@@ -125,6 +132,14 @@ def _crash_page(mgr, crash_id: str) -> str:
         parts.append(f"<h3>{html.escape(name)}</h3>"
                      f"<pre>{html.escape(content)}</pre>")
     return _page("crash", "".join(parts))
+
+
+def _cover_page(mgr) -> str:
+    from syzkaller_tpu.manager.cover import CoverReporter
+
+    with mgr.serv._lock:
+        pcs = list(mgr.serv.cover)
+    return CoverReporter(mgr.cfg.kernel_obj).render_html(pcs)
 
 
 def _syscalls_page(mgr) -> str:

@@ -45,6 +45,7 @@ class ManagerRPC:
         self.corpus: dict[str, dict] = {}  # sig -> RPCInput dict
         self.corpus_signal = Signal()
         self.max_signal = Signal()
+        self.cover: set[int] = set()  # raw PCs for /cover reporting
         self.candidates: list[dict] = []  # RPCCandidate dicts
         self.on_new_input = on_new_input
         self.on_stats = on_stats
@@ -119,6 +120,7 @@ class ManagerRPC:
                 self.corpus[key] = inp.to_dict()
             self.corpus_signal.merge(sig)
             self.max_signal.merge(sig)
+            self.cover.update(int(pc) for pc in inp.cover)
             for fname, f in self.fuzzers.items():
                 if fname != name:
                     f.inputs.append(inp.to_dict())

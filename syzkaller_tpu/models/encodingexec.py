@@ -6,7 +6,9 @@ output format the TPU engine emits for mutated batches.  Layout
 
   stream   := { copyin | csum-copyin | call | copyout } EOF
   copyin   := COPYIN addr arg
-  call     := call_id copyout_idx nargs arg*
+  call     := call_word copyout_idx nargs arg*
+              call_word = table_id | kernel_nr << 32 (the executor
+              dispatches real syscalls by nr; ids key results/sim)
   copyout  := COPYOUT idx addr size
   arg      := const | result | data | csum
   const    := ARG_CONST meta val            meta = size | be<<8 |
@@ -145,8 +147,10 @@ def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE) -> bytes:
                         w.write(chunk.value)
                         w.write(chunk.size)
 
-        # The call itself.
-        w.write(c.meta.id)
+        # The call itself: table id in the low word keys sim dispatch
+        # and result attribution; the kernel NR in the high word is
+        # what the real-OS executor backend passes to syscall(2).
+        w.write(c.meta.id | (max(c.meta.nr, 0) << 32))
         if c.ret is not None and len(c.ret.uses) != 0:
             assert id(c.ret) not in args_info, "arg info exists for ret"
             args_info[id(c.ret)] = {"idx": copyout_seq, "ret": True}

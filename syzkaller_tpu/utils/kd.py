@@ -1,0 +1,59 @@
+"""Windows KD serial-protocol decoder.
+
+Windows kernels talk the KD debugger protocol over the serial port;
+to scan a Windows VM console for crashes the raw KD framing has to be
+stripped down to the embedded DbgPrint text (reference: pkg/kd/kd.go:4-8
+— packet leader scan, type/length/checksum parse, DbgPrint payload
+extraction).
+"""
+
+from __future__ import annotations
+
+import struct
+
+PACKET_LEADER = b"\x30\x30\x30\x30"  # "0000"
+CONTROL_LEADER = b"\x69\x69\x69\x69"  # "iiii"
+BREAKIN = 0x62  # 'b'
+
+PACKET_TYPE_KD_DEBUG_IO = 3
+DBGKD_PRINT_STRING = 0x3230
+
+
+def decode(data: bytes) -> tuple[bytes, bytes]:
+    """Extract printable DbgPrint text from a KD byte stream.
+
+    Returns (text, remainder) where remainder holds trailing bytes of
+    an incomplete packet to be prepended to the next chunk
+    (reference: kd.go Decode).
+    """
+    out = bytearray()
+    pos = 0
+    n = len(data)
+    while pos < n:
+        lead = data.find(PACKET_LEADER, pos)
+        ctrl = data.find(CONTROL_LEADER, pos)
+        if lead == -1 and ctrl == -1:
+            # no framing: pass through printable bytes (boot messages
+            # are often raw text before KD engages)
+            out += bytes(b for b in data[pos:] if b == 0x0A or 32 <= b < 127)
+            return bytes(out), b""
+        start = min(x for x in (lead, ctrl) if x != -1)
+        out += bytes(b for b in data[pos:start]
+                     if b == 0x0A or 32 <= b < 127)
+        if start + 16 > n:
+            return bytes(out), data[start:]
+        (ptype, length, _pid, _csum) = struct.unpack_from(
+            "<HHII", data, start + 4)
+        body_at = start + 16
+        if body_at + length + 1 > n:  # +1 trailing 0xAA
+            return bytes(out), data[start:]
+        body = data[body_at:body_at + length]
+        if ptype == PACKET_TYPE_KD_DEBUG_IO and len(body) >= 0x10:
+            (api,) = struct.unpack_from("<I", body, 0)
+            if api == DBGKD_PRINT_STRING and len(body) >= 0x10:
+                (text_len,) = struct.unpack_from("<I", body, 0x0C)
+                text = body[0x10:0x10 + text_len]
+                out += bytes(b for b in text
+                             if b == 0x0A or 32 <= b < 127)
+        pos = body_at + length + 1
+    return bytes(out), b""

@@ -1,0 +1,119 @@
+"""adb VM backend: Android devices over adb.
+
+Console comes from a USB-serial adapter when configured, else from
+adb logcat/dmesg; recovery is reboot-based (reference: vm/adb/adb.go —
+device list, adb ssh-less copy/run, console tty detection, battery
+check hooks).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Optional
+
+from syzkaller_tpu.vm.vmimpl import (BootError, Env, Instance, OutputStream,
+                                     PoolImpl, pump_fd, register_vm_type)
+
+
+class AdbInstance(Instance):
+    def __init__(self, workdir: str, index: int, env: Env, device: str):
+        self.workdir = workdir
+        self.env = env
+        self.device = device
+        self.console_tty = env.config.get("console", "")
+        self._procs: list[subprocess.Popen] = []
+        self._adb("wait-for-device", timeout_s=10 * 60)
+        self._adb("shell", "echo ok", timeout_s=60)
+        # the fuzzer needs a writable exec dir (reference: adb.go /data)
+        self.target_dir = env.config.get("target_dir", "/data/local/tmp")
+        self._adb("shell", f"mkdir -p {self.target_dir}", timeout_s=60)
+
+    def _adb(self, *args: str, timeout_s: float = 60.0) -> bytes:
+        cmd = ["adb", "-s", self.device, *args]
+        try:
+            res = subprocess.run(cmd, capture_output=True,
+                                 timeout=timeout_s)
+        except (subprocess.TimeoutExpired, OSError) as e:
+            raise BootError(f"adb {args[0]} failed: {e}") from e
+        if res.returncode != 0:
+            raise BootError(f"adb {args[0]} failed: "
+                            f"{res.stderr.decode()[-512:]}")
+        return res.stdout
+
+    def copy(self, host_src: str) -> str:
+        import os
+
+        dst = f"{self.target_dir}/{os.path.basename(host_src)}"
+        self._adb("push", host_src, dst, timeout_s=300)
+        self._adb("shell", f"chmod 755 {dst}")
+        return dst
+
+    def forward(self, port: int) -> str:
+        # adb reverse: device-side connections to this port reach the
+        # host (reference: adb.go Forward).
+        self._adb("reverse", f"tcp:{port}", f"tcp:{port}")
+        return f"127.0.0.1:{port}"
+
+    def run(self, timeout_s: float, stop: threading.Event,
+            command: str) -> OutputStream:
+        stream = OutputStream()
+        # console: serial tty if configured, else dmesg -w on-device
+        if self.console_tty:
+            con = subprocess.Popen(
+                ["cat", self.console_tty], stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL)
+        else:
+            con = subprocess.Popen(
+                ["adb", "-s", self.device, "shell", "dmesg -w"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        self._procs.append(con)
+        proc = subprocess.Popen(
+            ["adb", "-s", self.device, "shell", command],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        self._procs.append(proc)
+
+        def pump_console():
+            while not stop.is_set() and con.poll() is None:
+                chunk = con.stdout.read1(1 << 14)
+                if not chunk:
+                    break
+                stream.put(chunk)
+
+        threading.Thread(target=pump_console, daemon=True).start()
+        pump_fd(proc.stdout, stream, proc, stop, timeout_s)
+        return stream
+
+    def diagnose(self) -> bytes:
+        try:
+            return self._adb("shell", "dmesg", timeout_s=30)
+        except BootError:
+            return b""
+
+    def close(self) -> None:
+        for p in self._procs:
+            if p.poll() is None:
+                p.kill()
+        # reboot to a clean state (reference: adb.go reboot recovery)
+        if self.env.config.get("reboot_on_close", False):
+            try:
+                self._adb("reboot", timeout_s=30)
+            except BootError:
+                pass
+
+
+class AdbPool(PoolImpl):
+    def __init__(self, env: Env):
+        self.env = env
+        self.devices = list(env.config.get("devices", []))
+        if not self.devices:
+            raise BootError("adb: config must list devices")
+
+    def count(self) -> int:
+        return len(self.devices)
+
+    def create(self, workdir: str, index: int) -> Instance:
+        return AdbInstance(workdir, index, self.env, self.devices[index])
+
+
+register_vm_type("adb", AdbPool)

@@ -1,0 +1,136 @@
+"""GCE VM backend: Google Compute Engine instances.
+
+Instances are created from an image via the gcloud CLI, reached over
+ssh, with the serial console streamed through `gcloud compute
+connect-to-serial-port` (reference: vm/gce/gce.go — instance create/
+delete via the GCE API, serial console reader, ssh/scp plumbing via
+pkg/gce).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from typing import Optional
+
+from syzkaller_tpu.vm.vmimpl import (BootError, Env, Instance, OutputStream,
+                                     PoolImpl, pump_fd, register_vm_type,
+                                     run_ssh, ssh_args)
+
+
+def _gcloud(args: list[str], timeout_s: float = 300.0) -> bytes:
+    try:
+        res = subprocess.run(["gcloud", "compute", *args],
+                             capture_output=True, timeout=timeout_s)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        raise BootError(f"gcloud {args[0]}: {e}") from e
+    if res.returncode != 0:
+        raise BootError(f"gcloud {args[0]}: {res.stderr.decode()[-512:]}")
+    return res.stdout
+
+
+class GCEInstance(Instance):
+    def __init__(self, workdir: str, index: int, env: Env):
+        self.workdir = workdir
+        self.env = env
+        cfg = env.config
+        self.zone = cfg.get("zone", "us-central1-b")
+        self.machine_type = cfg.get("machine_type", "e2-standard-2")
+        self.image = cfg.get("gce_image", "")
+        self.name = f"{env.name or 'tz'}-{index}"
+        self.preemptible = bool(cfg.get("preemptible", True))
+        args = ["instances", "create", self.name,
+                "--zone", self.zone,
+                "--machine-type", self.machine_type]
+        if self.image:
+            args += ["--image", self.image]
+        if self.preemptible:
+            args.append("--preemptible")
+        _gcloud(args, timeout_s=600)
+        self.ip = _gcloud(
+            ["instances", "describe", self.name, "--zone", self.zone,
+             "--format=value(networkInterfaces[0].accessConfigs[0].natIP)"],
+        ).decode().strip()
+        self._wait_ssh(10 * 60)
+        self._console: Optional[subprocess.Popen] = None
+
+    def _wait_ssh(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                run_ssh(["ssh", *ssh_args(self.env.sshkey,
+                                          self.env.ssh_user, 22),
+                         f"{self.env.ssh_user}@{self.ip}", "true"],
+                        timeout_s=15)
+                return
+            except BootError:
+                time.sleep(10)
+        raise BootError(f"GCE instance {self.name}: ssh never came up")
+
+    def copy(self, host_src: str) -> str:
+        import os
+
+        dst = "/" + os.path.basename(host_src)
+        run_ssh(["scp", *ssh_args(self.env.sshkey, self.env.ssh_user,
+                                  22, scp=True), host_src,
+                 f"{self.env.ssh_user}@{self.ip}:{dst}"], timeout_s=600)
+        return dst
+
+    def forward(self, port: int) -> str:
+        self._fwd_port = port
+        return f"127.0.0.1:{port}"
+
+    def run(self, timeout_s: float, stop: threading.Event,
+            command: str) -> OutputStream:
+        stream = OutputStream()
+        # serial console carries the oopses (reference: gce.go console)
+        self._console = subprocess.Popen(
+            ["gcloud", "compute", "connect-to-serial-port", self.name,
+             "--zone", self.zone],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            stdin=subprocess.DEVNULL)
+        args = ["ssh", *ssh_args(self.env.sshkey, self.env.ssh_user, 22)]
+        fwd = getattr(self, "_fwd_port", None)
+        if fwd:
+            args += ["-R", f"{fwd}:127.0.0.1:{fwd}"]
+        args += [f"{self.env.ssh_user}@{self.ip}", command]
+        proc = subprocess.Popen(args, stdin=subprocess.DEVNULL,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+        con = self._console
+
+        def pump_console():
+            while not stop.is_set() and con.poll() is None:
+                chunk = con.stdout.read1(1 << 14)
+                if not chunk:
+                    break
+                stream.put(chunk)
+
+        threading.Thread(target=pump_console, daemon=True).start()
+        pump_fd(proc.stdout, stream, proc, stop, timeout_s)
+        return stream
+
+    def close(self) -> None:
+        if self._console is not None and self._console.poll() is None:
+            self._console.kill()
+        try:
+            _gcloud(["instances", "delete", self.name, "--zone",
+                     self.zone, "--quiet"], timeout_s=600)
+        except BootError:
+            pass
+
+
+class GCEPool(PoolImpl):
+    def __init__(self, env: Env):
+        self.env = env
+        self._count = int(env.config.get("count", 1))
+
+    def count(self) -> int:
+        return self._count
+
+    def create(self, workdir: str, index: int) -> Instance:
+        return GCEInstance(workdir, index, self.env)
+
+
+register_vm_type("gce", GCEPool)

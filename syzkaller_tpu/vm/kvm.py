@@ -1,0 +1,92 @@
+"""kvm VM backend: lightweight lkvm (kvmtool) sandboxes.
+
+Boots a kernel directly with lkvm sandbox mode — no disk image, the
+host filesystem is shared read-only; much faster churn than qemu for
+crash-heavy fuzzing (reference: vm/kvm/kvm.go — lkvm setup/sandbox
+scripts, console via lkvm stdout).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import threading
+
+from syzkaller_tpu.vm.vmimpl import (BootError, Env, Instance, OutputStream,
+                                     PoolImpl, pump_fd, register_vm_type)
+
+
+class KvmInstance(Instance):
+    def __init__(self, workdir: str, index: int, env: Env):
+        self.workdir = workdir
+        self.index = index
+        self.env = env
+        cfg = env.config
+        self.lkvm = cfg.get("lkvm", "lkvm")
+        self.kernel = cfg.get("kernel", "")
+        self.cmdline = cfg.get("cmdline", "")
+        self.cpus = int(cfg.get("cpu", 1))
+        self.mem_mb = int(cfg.get("mem", 1024))
+        self.sandbox_name = f"tz-kvm-{index}"
+        if not self.kernel:
+            raise BootError("kvm: config must set kernel")
+        self._proc = None
+        self.shared_dir = os.path.join(workdir, "shared")
+        os.makedirs(self.shared_dir, exist_ok=True)
+
+    def copy(self, host_src: str) -> str:
+        dst = os.path.join(self.shared_dir, os.path.basename(host_src))
+        shutil.copy2(host_src, dst)
+        # visible inside the sandbox under /host (lkvm 9p share)
+        return f"/host/{os.path.basename(host_src)}"
+
+    def forward(self, port: int) -> str:
+        return f"127.0.0.1:{port}"  # lkvm user-net reaches the host
+
+    def run(self, timeout_s: float, stop: threading.Event,
+            command: str) -> OutputStream:
+        stream = OutputStream()
+        script = os.path.join(self.workdir, "run.sh")
+        with open(script, "w") as f:
+            f.write("#!/bin/sh\n" + command + "\n")
+        os.chmod(script, 0o755)
+        args = [self.lkvm, "sandbox", "--disk", self.sandbox_name,
+                "--kernel", self.kernel,
+                "--params", f"slub_debug=UZ {self.cmdline}".strip(),
+                "--mem", str(self.mem_mb), "--cpus", str(self.cpus),
+                "--network", "mode=user",
+                "--sandbox", script,
+                "--9p", f"{self.shared_dir},host"]
+        try:
+            proc = subprocess.Popen(args, stdin=subprocess.DEVNULL,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT,
+                                    cwd=self.workdir)
+        except OSError as e:
+            raise BootError(f"failed to start lkvm: {e}") from e
+        self._proc = proc
+        pump_fd(proc.stdout, stream, proc, stop, timeout_s)
+        return stream
+
+    def close(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait()
+        subprocess.run([self.lkvm, "rm", "-n", self.sandbox_name],
+                       capture_output=True)
+
+
+class KvmPool(PoolImpl):
+    def __init__(self, env: Env):
+        self.env = env
+        self._count = int(env.config.get("count", 1))
+
+    def count(self) -> int:
+        return self._count
+
+    def create(self, workdir: str, index: int) -> Instance:
+        return KvmInstance(workdir, index, self.env)
+
+
+register_vm_type("kvm", KvmPool)

@@ -1,0 +1,70 @@
+"""Odroid board backend: physical ARM boards with hard power-cycle
+recovery.
+
+Like `isolated` (ssh to a physical machine) but with out-of-band
+recovery: when the board stops answering, it is power-cycled through a
+controllable USB hub port before waiting for reboot (reference:
+vm/odroid/odroid.go — ssh plumbing + USB-hub port power control).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import time
+
+from syzkaller_tpu.vm.isolated import IsolatedInstance
+from syzkaller_tpu.vm.vmimpl import (BootError, Env, Instance, PoolImpl,
+                                     register_vm_type)
+from syzkaller_tpu.utils import log
+
+
+class OdroidInstance(IsolatedInstance):
+    def __init__(self, workdir: str, index: int, env: Env, target: str):
+        cfg = env.config
+        # command template that toggles the hub port, e.g.
+        # "uhubctl -l {hub} -p {port} -a {action}"
+        self.power_cmd = cfg.get("power_cmd", "")
+        self.hub = cfg.get("hub", "")
+        self.power_port = str(cfg.get("power_port", "1"))
+        try:
+            super().__init__(workdir, index, env, target)
+        except BootError:
+            # dead on arrival: hard power-cycle once, then retry
+            self.power_cycle()
+            super().__init__(workdir, index, env, target)
+
+    def power_cycle(self) -> None:
+        """(reference: odroid.go power-cycle via USB hub)"""
+        if not self.power_cmd:
+            return
+        for action in ("off", "on"):
+            cmd = self.power_cmd.format(hub=self.hub,
+                                        port=self.power_port,
+                                        action=action)
+            subprocess.run(cmd, shell=True, capture_output=True)
+            if action == "off":
+                time.sleep(3)
+        log.logf(0, "odroid: power-cycled %s", self.host)
+        time.sleep(10)  # board boot starts
+
+    def close(self) -> None:
+        super().close()
+        # leave the board powered; the next create() deals with hangs
+
+
+class OdroidPool(PoolImpl):
+    def __init__(self, env: Env):
+        self.env = env
+        self.targets = list(env.config.get("targets", []))
+        if not self.targets:
+            raise BootError("odroid: config must list targets")
+
+    def count(self) -> int:
+        return len(self.targets)
+
+    def create(self, workdir: str, index: int) -> Instance:
+        return OdroidInstance(workdir, index, self.env,
+                              self.targets[index])
+
+
+register_vm_type("odroid", OdroidPool)

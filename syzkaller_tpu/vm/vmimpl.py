@@ -115,7 +115,8 @@ def register_vm_type(name: str, ctor: Callable[[Env], PoolImpl]) -> None:
 
 
 def create_pool_impl(typ: str, env: Env) -> PoolImpl:
-    from syzkaller_tpu.vm import isolated, local, qemu  # noqa: F401
+    from syzkaller_tpu.vm import (adb, gce, isolated, kvm,  # noqa: F401
+                                  local, odroid, qemu)
 
     ctor = _CTORS.get(typ)
     if ctor is None:

@@ -1,0 +1,97 @@
+"""Real-OS executor backend: benign handcrafted programs issue actual
+syscalls on the build host (no VM needed — the same pattern as the
+reference's host-side ipc tests, pkg/ipc/ipc_test.go).
+
+Programs here are hand-built from known-safe calls only; random
+generated programs are never executed against the host kernel.
+"""
+
+import os
+
+import pytest
+
+from syzkaller_tpu.ipc.env import ExecOpts, make_env
+from syzkaller_tpu.models.encodingexec import serialize_for_exec
+from syzkaller_tpu.models.prog import (Call, ConstArg, DataArg, PointerArg,
+                                       Prog, make_return_arg)
+from syzkaller_tpu.models.target import get_target
+
+
+@pytest.fixture(scope="module")
+def linux_target():
+    return get_target("linux", "amd64")
+
+
+def _call(target, name, args):
+    meta = next(c for c in target.syscalls if c.name == name)
+    return Call(meta=meta, args=args, ret=make_return_arg(meta.ret))
+
+
+def _getpid_prog(target):
+    return Prog(target=target, calls=[_call(target, "getpid", [])])
+
+
+def test_real_getpid(linux_target):
+    env = make_env(0, sim=False)
+    try:
+        res = env.exec(ExecOpts(), serialize_for_exec(
+            _getpid_prog(linux_target)))
+        assert res.completed
+        info = res.info[0]
+        assert info.errno == 0
+        # the executor forked per-program? no — same process pool, so
+        # the pid must be the executor's own (a real, positive pid)
+        assert len(info.signal) > 0  # synthetic or kcov edges flow
+    finally:
+        env.close()
+
+
+def test_real_open_read_devnull(linux_target):
+    target = linux_target
+    meta_open = next(c for c in target.syscalls if c.name == "openat")
+    # openat(AT_FDCWD, "/dev/null", O_RDONLY, 0o644)
+    fname = DataArg(meta_open.args[1].elem, b"/dev/null\x00")
+    open_call = _call(target, "openat", [
+        ConstArg(meta_open.args[0], 0xFFFFFFFFFFFFFF9C),
+        PointerArg(meta_open.args[1], address=0x1000, res=fname),
+        ConstArg(meta_open.args[2], 0),  # O_RDONLY
+        ConstArg(meta_open.args[3], 0o644),
+    ])
+    meta_read = next(c for c in target.syscalls if c.name == "read")
+    from syzkaller_tpu.models.prog import ResultArg
+
+    fd_arg = ResultArg(meta_read.args[0], res=open_call.ret)
+    open_call.ret.uses.add(fd_arg)
+    buf = DataArg(meta_read.args[1].elem, b"", out_size=16)
+    read_call = _call(target, "read", [
+        fd_arg,
+        PointerArg(meta_read.args[1], address=0x2000, res=buf),
+        ConstArg(meta_read.args[2], 16),
+    ])
+    p = Prog(target=target, calls=[open_call, read_call])
+    env = make_env(0, sim=False)
+    try:
+        res = env.exec(ExecOpts(), serialize_for_exec(p))
+        assert res.completed
+        assert res.info[0].errno == 0, "openat(/dev/null) failed"
+        assert res.info[1].errno == 0, "read(fd) failed — result arg " \
+            "did not thread the real fd"
+    finally:
+        env.close()
+
+
+def test_real_bad_call_errno(linux_target):
+    """A call with an invalid argument must report the real errno."""
+    target = linux_target
+    from syzkaller_tpu.models.prog import ResultArg
+
+    meta = next(c for c in target.syscalls if c.name == "close")
+    p = Prog(target=target, calls=[
+        Call(meta=meta, args=[ResultArg(meta.args[0], val=0xFFFFFFFF)],
+             ret=make_return_arg(meta.ret))])
+    env = make_env(0, sim=False)
+    try:
+        res = env.exec(ExecOpts(), serialize_for_exec(p))
+        assert res.info[0].errno == 9  # EBADF
+    finally:
+        env.close()

@@ -1,0 +1,114 @@
+"""Long-tail components: KD decoder, const extraction, cover report,
+VM backend registry."""
+
+import struct
+
+import pytest
+
+from syzkaller_tpu.manager.cover import CoverReporter
+from syzkaller_tpu.sys.extract import extract_consts, write_const_file
+from syzkaller_tpu.utils import kd
+
+
+# -- kd ------------------------------------------------------------------
+
+
+def _kd_print_packet(text: bytes) -> bytes:
+    body = struct.pack("<I", kd.DBGKD_PRINT_STRING) + b"\x00" * 8 \
+        + struct.pack("<I", len(text)) + text
+    hdr = kd.PACKET_LEADER + struct.pack(
+        "<HHII", kd.PACKET_TYPE_KD_DEBUG_IO, len(body), 1, 0)
+    return hdr + body + b"\xaa"
+
+
+def test_kd_decode_print():
+    pkt = _kd_print_packet(b"Assertion failed: foo.c:42\n")
+    text, rest = kd.decode(b"boot text\n" + pkt + b"tail")
+    assert b"boot text" in text
+    assert b"Assertion failed: foo.c:42" in text
+    assert rest == b""
+
+
+def test_kd_incomplete_packet_buffered():
+    pkt = _kd_print_packet(b"hello from the kernel")
+    text1, rest = kd.decode(pkt[:20])
+    assert rest  # incomplete: buffered for the next chunk
+    text2, rest2 = kd.decode(rest + pkt[20:])
+    assert b"hello from the kernel" in text2
+    assert rest2 == b""
+
+
+def test_kd_raw_passthrough():
+    text, rest = kd.decode(b"plain console line\x00\x01\xff ok\n")
+    assert b"plain console line ok\n" == text
+
+
+# -- extract -------------------------------------------------------------
+
+
+def test_extract_consts(tmp_path):
+    vals = extract_consts(["O_RDONLY", "O_CREAT", "PROT_READ",
+                           "MAP_PRIVATE", "NOT_A_REAL_CONST_XYZ"])
+    assert vals["O_RDONLY"] == 0
+    assert vals["PROT_READ"] == 1
+    assert vals["MAP_PRIVATE"] == 2
+    assert vals["NOT_A_REAL_CONST_XYZ"] is None
+    out = tmp_path / "test.const"
+    write_const_file(str(out), vals)
+    content = out.read_text()
+    assert "PROT_READ = 1" in content
+    assert "# NOT_A_REAL_CONST_XYZ is not defined" in content
+
+
+def test_extract_syscall_numbers():
+    vals = extract_consts(["__NR_openat", "__NR_read"])
+    assert vals["__NR_openat"] == 257  # amd64 ABI
+    assert vals["__NR_read"] == 0
+
+
+# -- cover reporter ------------------------------------------------------
+
+
+def test_cover_report_without_vmlinux():
+    r = CoverReporter("")
+    html = r.render_html([0xFFFF800012345678, 0xFFFF800012345679])
+    assert "2 PCs covered" in html
+    assert "0xffff800012345678" in html
+
+
+def test_cover_report_with_real_binary():
+    """Use the executor binary itself as the 'kernel' — nm+addr2line
+    work on any ELF."""
+    from syzkaller_tpu.ipc.env import build_executor
+
+    binpath = str(build_executor())
+    r = CoverReporter(binpath)
+    r._load_symbols()
+    if not r._addr_index:
+        pytest.skip("no symbols in executor binary")
+    addr, end, name = r._addr_index[len(r._addr_index) // 2]
+    assert r.func_of(addr) == name
+    per_fn = r.per_function([addr, addr + 1 if addr + 1 < end else addr])
+    assert name in per_fn
+
+
+# -- VM registry ---------------------------------------------------------
+
+
+def test_all_vm_types_registered():
+    from syzkaller_tpu.vm.vmimpl import _CTORS, create_pool_impl, Env
+
+    with pytest.raises(ValueError):
+        create_pool_impl("definitely-not-a-backend", Env())
+    for typ in ("local", "qemu", "isolated", "adb", "gce", "kvm",
+                "odroid"):
+        assert typ in _CTORS, f"backend {typ} not registered"
+
+
+def test_kcovtrace_compiles(tmp_path):
+    import subprocess
+
+    out = str(tmp_path / "kcovtrace")
+    res = subprocess.run(["gcc", "-O2", "-o", out,
+                          "executor/kcovtrace.c"], capture_output=True)
+    assert res.returncode == 0, res.stderr.decode()
