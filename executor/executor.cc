@@ -460,8 +460,14 @@ struct Interp {
         return val;
       }
       case kArgData: {
-        uint64_t len = next();
-        uint64_t padded = (len + 7) / 8;
+        // Low 32 bits: payload length.  High 32 bits: region capacity
+        // (0 = len) — the device engine emits cap-padded regions so
+        // mutated lengths never reshape the stream.
+        uint64_t lenword = next();
+        uint64_t len = lenword & 0xFFFFFFFFull;
+        uint64_t cap = lenword >> 32;
+        if (cap < len) cap = len;
+        uint64_t padded = (cap + 7) / 8;
         if (pos + padded > nwords) failf("executor: truncated data arg");
         if (addr) memcpy(guest(addr, len), &words[pos], len);
         pos += padded;

@@ -14,14 +14,26 @@ output format the TPU engine emits for mutated batches.  Layout
   const    := ARG_CONST meta val            meta = size | be<<8 |
               bf_off<<16 | bf_len<<24 | pid_stride<<32
   result   := ARG_RESULT size idx op_div op_add default
-  data     := ARG_DATA len byte* (8-byte padded)
+  data     := ARG_DATA (len | cap<<32) byte* (8-byte padded to
+              max(cap, len); cap=0 means cap=len).  The capacity
+              field is a TPU-first extension: the device mutation
+              engine emits data regions at a fixed per-template
+              capacity so mutated lengths never reshape the stream
+              (the executor copies len bytes and advances by cap).
   csum     := ARG_CSUM size CSUM_INET nchunks
               { chunk_kind (addr|value) size }*
+
+The serializer can also record an ExecRecord of patch positions —
+per-arg word indices of value/meta/data words plus per-call word
+ranges — which ops/emit.py uses to re-emit mutated program tensors
+as exec bytes with a memcpy + scatter instead of a tree walk
+(SURVEY.md §7: "serialize-to-exec is a gather").
 """
 
 from __future__ import annotations
 
 import struct
+from typing import Optional
 
 from syzkaller_tpu.models.checksum import CsumChunkKind, calc_checksums_call
 from syzkaller_tpu.models.prog import (
@@ -59,6 +71,25 @@ class ExecBufferTooSmall(Exception):
     pass
 
 
+class ExecRecord:
+    """Patch positions collected during serialization (all are word
+    indices into the emitted uint64 stream):
+
+      val_word[id(arg)]   index of a ConstArg's value word
+      meta_word[id(arg)]  index of the same arg's meta word
+      data_word[id(arg)]  (len_word_idx, payload_word_idx, cap)
+      call_bounds         per-call [start, end) word ranges covering
+                          the call's copyins, csums, call instr and
+                          copyouts (the EOF word is outside all)
+    """
+
+    def __init__(self):
+        self.val_word: dict[int, int] = {}
+        self.meta_word: dict[int, int] = {}
+        self.data_word: dict[int, tuple[int, int, int]] = {}
+        self.call_bounds: list[tuple[int, int]] = []
+
+
 class _Writer:
     def __init__(self, limit: int):
         self.words: list[int] = []
@@ -71,8 +102,9 @@ class _Writer:
             raise ExecBufferTooSmall()
         self.words.append(v & MASK64)
 
-    def write_data(self, data: bytes) -> None:
-        padded = len(data) + (-len(data)) % 8
+    def write_data(self, data: bytes, cap: int = 0) -> None:
+        region = max(len(data), cap)
+        padded = region + (-region) % 8
         self.nbytes += padded
         if self.nbytes > self.limit:
             raise ExecBufferTooSmall()
@@ -81,9 +113,15 @@ class _Writer:
             self.words.append(int.from_bytes(buf[i:i + 8], "little"))
 
 
-def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE) -> bytes:
+def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE,
+                       data_caps: Optional[dict[int, int]] = None,
+                       record: Optional[ExecRecord] = None) -> bytes:
     """Serialize p for execution (reference: prog/encodingexec.go:57-192).
-    Returns the encoded byte stream (little-endian uint64 words)."""
+    Returns the encoded byte stream (little-endian uint64 words).
+
+    data_caps maps id(DataArg) -> fixed region capacity (bytes); such
+    args are emitted cap-padded so the device engine can grow them in
+    place.  record, if given, collects patch positions (ExecRecord)."""
     from syzkaller_tpu.models import validation
 
     if validation.debug:
@@ -95,6 +133,7 @@ def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE) -> bytes:
     args_info: dict[int, dict] = {}
 
     for c in p.calls:
+        call_start = len(w.words)
         csum_map = calc_checksums_call(c)
         csum_uses: set[int] = set()
         if csum_map is not None:
@@ -120,7 +159,7 @@ def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE) -> bytes:
                 return
             w.write(EXEC_INSTR_COPYIN)
             w.write(addr)
-            _write_arg(w, target, arg, args_info)
+            _write_arg(w, target, arg, args_info, data_caps, record)
 
         foreach_arg(c, copyin)
 
@@ -160,7 +199,7 @@ def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE) -> bytes:
             w.write(EXEC_NO_COPYOUT)
         w.write(len(c.args))
         for arg in c.args:
-            _write_arg(w, target, arg, args_info)
+            _write_arg(w, target, arg, args_info, data_caps, record)
 
         # Copyout instructions persisting referenced results.
         def copyout(arg: Arg, ctx) -> None:
@@ -178,19 +217,29 @@ def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE) -> bytes:
                 w.write(arg.size())
 
         foreach_arg(c, copyout)
+        if record is not None:
+            record.call_bounds.append((call_start, len(w.words)))
 
     w.write(EXEC_INSTR_EOF)
     return struct.pack(f"<{len(w.words)}Q", *w.words)
 
 
-def _write_arg(w: _Writer, target, arg: Arg, args_info: dict) -> None:
+def _write_arg(w: _Writer, target, arg: Arg, args_info: dict,
+               data_caps: Optional[dict] = None,
+               record: Optional[ExecRecord] = None) -> None:
     """(reference: prog/encodingexec.go:230-272)"""
     if isinstance(arg, ConstArg):
         val, pid_stride, big_endian = arg.value()
+        if record is not None:
+            record.meta_word[id(arg)] = len(w.words) + 1
+            record.val_word[id(arg)] = len(w.words) + 2
         _write_const_arg(w, arg.size(), val, arg.typ.bitfield_offset(),
                          arg.typ.bitfield_length(), pid_stride, big_endian)
     elif isinstance(arg, ResultArg):
         if arg.res is None:
+            if record is not None:
+                record.meta_word[id(arg)] = len(w.words) + 1
+                record.val_word[id(arg)] = len(w.words) + 2
             _write_const_arg(w, arg.size(), arg.val, 0, 0, 0, False)
         else:
             info = args_info.get(id(arg.res))
@@ -207,11 +256,17 @@ def _write_arg(w: _Writer, target, arg: Arg, args_info: dict) -> None:
         _write_const_arg(w, arg.size(), target.physical_addr(arg), 0, 0, 0, False)
     elif isinstance(arg, DataArg):
         data = bytes(arg.data)
+        cap = 0
+        if data_caps is not None:
+            cap = data_caps.get(id(arg), 0)
+        if record is not None:
+            record.data_word[id(arg)] = (len(w.words) + 1, len(w.words) + 2,
+                                         max(cap, len(data)))
         w.write(EXEC_ARG_DATA)
-        w.write(len(data))
-        w.write_data(data)
+        w.write(len(data) | (cap << 32))
+        w.write_data(data, cap)
     elif isinstance(arg, UnionArg):
-        _write_arg(w, target, arg.option, args_info)
+        _write_arg(w, target, arg.option, args_info, data_caps, record)
     else:
         raise TypeError(f"unknown arg type {arg!r}")
 

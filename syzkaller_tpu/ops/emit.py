@@ -1,0 +1,251 @@
+"""Exec-bytes emission from mutated program tensors.
+
+The reference re-serializes every mutant with a typed tree walk
+(reference: prog/encodingexec.go:57-192).  The device pipeline instead
+serializes each corpus template ONCE (with fixed-capacity data regions
+and an ExecRecord of patch positions) and turns every mutant into
+
+    memcpy(template words) + vectorized value/meta patches
+    + data-region splices + alive-segment slicing
+
+— the "serialize-to-exec is a gather" contract from SURVEY.md §7.
+Call removal is a pure post-patch slice of per-call word ranges; a
+dangling RESULT reference to a removed call's copyout degrades to the
+arg's default value inside the executor, which is exactly the
+reference's remove-call semantics for broken resource edges
+(reference: prog/prog.go:428-503).
+
+Known deliberate approximations vs the typed path (both converge on
+triage, where accepted inputs are decoded and re-encoded typed):
+  - only directly-linked (buf, len) pairs are kept consistent after
+    data mutation (see ops/mutate._fixup_lens); struct-spanning size
+    fields keep their template values,
+  - data regions grown on device reuse the template's guest address
+    (no reallocation on growth).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from syzkaller_tpu.models.encodingexec import (
+    EXEC_BUFFER_SIZE,
+    ExecRecord,
+    serialize_for_exec,
+)
+from syzkaller_tpu.models.any_squash import call_contains_any
+from syzkaller_tpu.ops.tensor import DATA, FLAGS, INT, LEN, PROC, ProgTensor
+
+MASK64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+@dataclass
+class ExecTemplate:
+    """Per-corpus-program assembly metadata (host side)."""
+
+    words: np.ndarray  # uint64[W] template stream incl. trailing EOF
+    call_bounds: np.ndarray  # int32[ncalls, 2] word ranges
+    ncalls: int
+    # Slot-aligned patch arrays (length = cfg.max_slots):
+    val_word: np.ndarray  # int32[S], -1 = slot has no value word
+    meta_word: np.ndarray  # int32[S]
+    len_word: np.ndarray  # int32[S], DATA slots only
+    data_word: np.ndarray  # int32[S] payload start word
+    data_cap: np.ndarray  # int32[S]
+    data_off: np.ndarray  # int32[S] arena offset (static on device)
+    aux0: np.ndarray  # uint64[S]
+    # PROC slots encode conditionally (reference: prog/prog.go:66-74
+    # Value()): default (= 0xFF..F) serializes as plain 0 without
+    # stride, concrete values as start+v with the per-proc stride and
+    # the type's endianness in the meta word.  Both metas are derived
+    # from the TYPE at build time — the template's meta reflects only
+    # the template's value.
+    proc_meta_default: np.ndarray  # uint64[S]
+    proc_meta_concrete: np.ndarray  # uint64[S]
+    # Pre-split masks/indices for the assembly fast path:
+    value_slots: np.ndarray  # int32[k] slots patched via val_word
+    proc_slots: np.ndarray  # int32[k2] PROC slots (conditional stride)
+    data_slots: np.ndarray  # int32[k3] DATA slots
+    calls_any: np.ndarray  # bool[ncalls]: call contains a squashed ANY
+    # (consumed by the pipeline's signal_prio for undecoded mutants)
+
+
+def build_exec_template(t: ProgTensor,
+                        buffer_size: int = EXEC_BUFFER_SIZE) -> ExecTemplate:
+    """Serialize t.template once, recording patch positions for every
+    device-mutable slot."""
+    rec = ExecRecord()
+    caps = {id(t.slot_args[s]): int(t.cap[s])
+            for s in range(len(t.slot_args)) if t.kind[s] == DATA}
+    stream = serialize_for_exec(t.template, buffer_size, data_caps=caps,
+                                record=rec)
+    words = np.frombuffer(stream, dtype="<u8").copy()
+
+    S = t.kind.shape[0]
+    val_word = np.full(S, -1, dtype=np.int32)
+    meta_word = np.full(S, -1, dtype=np.int32)
+    len_word = np.full(S, -1, dtype=np.int32)
+    data_word = np.full(S, -1, dtype=np.int32)
+    data_cap = np.zeros(S, dtype=np.int32)
+    proc_meta_default = np.zeros(S, dtype=np.uint64)
+    proc_meta_concrete = np.zeros(S, dtype=np.uint64)
+
+    for s, arg in enumerate(t.slot_args):
+        k = int(t.kind[s])
+        if k in (INT, FLAGS, PROC, LEN):
+            vw = rec.val_word.get(id(arg))
+            if vw is not None:
+                val_word[s] = vw
+                meta_word[s] = rec.meta_word[id(arg)]
+            if k == PROC:
+                typ = arg.typ
+                base = (arg.size()
+                        | (typ.bitfield_offset() << 16)
+                        | (typ.bitfield_length() << 24))
+                proc_meta_default[s] = base
+                proc_meta_concrete[s] = (
+                    base
+                    | (int(bool(getattr(typ, "big_endian", False))) << 8)
+                    | (typ.values_per_proc << 32))
+        elif k == DATA:
+            dw = rec.data_word.get(id(arg))
+            if dw is not None:
+                len_word[s], data_word[s], data_cap[s] = dw
+
+    kinds = np.asarray(t.kind)
+    value_slots = np.nonzero((val_word >= 0) & (kinds != PROC))[0] \
+        .astype(np.int32)
+    proc_slots = np.nonzero((val_word >= 0) & (kinds == PROC))[0] \
+        .astype(np.int32)
+    data_slots = np.nonzero(len_word >= 0)[0].astype(np.int32)
+
+    target = t.template.target
+    calls_any = np.array(
+        [call_contains_any(target, c) for c in t.template.calls], dtype=bool)
+
+    return ExecTemplate(
+        words=words,
+        call_bounds=np.array(rec.call_bounds or np.empty((0, 2)),
+                             dtype=np.int32).reshape(-1, 2),
+        ncalls=t.ncalls,
+        val_word=val_word, meta_word=meta_word,
+        len_word=len_word, data_word=data_word, data_cap=data_cap,
+        data_off=np.asarray(t.off, dtype=np.int32).copy(),
+        aux0=np.asarray(t.aux0).copy(),
+        proc_meta_default=proc_meta_default,
+        proc_meta_concrete=proc_meta_concrete,
+        value_slots=value_slots, proc_slots=proc_slots,
+        data_slots=data_slots,
+        calls_any=calls_any,
+    )
+
+
+def assemble(et: ExecTemplate, val: np.ndarray, len_: np.ndarray,
+             arena: np.ndarray, call_alive: np.ndarray) -> bytes:
+    """Assemble exec wire bytes for one mutant.
+
+    val/len_/arena/call_alive are the mutated tensor rows (numpy, host).
+    Patches are applied on the full template first; call removal is
+    then a slice of per-call ranges, so no patch index ever shifts."""
+    w = et.words.copy()
+
+    vs = et.value_slots
+    if vs.size:
+        w[et.val_word[vs]] = val[vs]
+
+    ps = et.proc_slots
+    if ps.size:
+        pv = val[ps]
+        is_default = pv == MASK64
+        w[et.val_word[ps]] = np.where(is_default, np.uint64(0),
+                                      et.aux0[ps] + pv)
+        w[et.meta_word[ps]] = np.where(is_default, et.proc_meta_default[ps],
+                                       et.proc_meta_concrete[ps])
+
+    u8 = w.view(np.uint8)
+    for s in et.data_slots:
+        ln = int(len_[s])
+        cap = int(et.data_cap[s])
+        ln = min(ln, cap)
+        w[et.len_word[s]] = np.uint64(ln | (cap << 32))
+        start = int(et.data_word[s]) * 8
+        off = int(et.data_off[s])
+        u8[start:start + ln] = arena[off:off + ln]
+        # Zero the region tail: bit-exact with the typed serializer's
+        # zero padding, and no stale template bytes on the wire.
+        u8[start + ln:start + cap + (-cap) % 8] = 0
+
+    nc = et.ncalls
+    if bool(call_alive[:nc].all()):
+        return w.tobytes()
+    parts = [w[a:b] for (a, b), alive
+             in zip(et.call_bounds, call_alive[:nc]) if alive]
+    parts.append(w[-1:])  # EOF
+    return np.concatenate(parts).tobytes() if parts else w[-1:].tobytes()
+
+
+def mutant_call_ids(et: ExecTemplate, call_alive: np.ndarray) -> list[int]:
+    """Template call indices surviving in the mutant, in order — maps
+    the executor's call_index back to template calls."""
+    return [i for i in range(et.ncalls) if call_alive[i]]
+
+
+def parse_stream(stream: bytes) -> list[int]:
+    """Well-formedness walk of an exec stream; returns the call table
+    ids in order.  Raises ValueError on malformed input.  Mirrors the
+    executor's interpreter skeleton (executor/executor.cc Interp) —
+    used by tests and pipeline debugging, not the hot path."""
+    from syzkaller_tpu.models.encodingexec import (
+        EXEC_ARG_CONST, EXEC_ARG_CSUM, EXEC_ARG_DATA, EXEC_ARG_RESULT,
+        EXEC_INSTR_COPYIN, EXEC_INSTR_COPYOUT, EXEC_INSTR_EOF, words_of)
+
+    words = words_of(stream)
+    pos = 0
+    calls: list[int] = []
+
+    def next_word() -> int:
+        nonlocal pos
+        if pos >= len(words):
+            raise ValueError("truncated stream")
+        pos += 1
+        return words[pos - 1]
+
+    def parse_arg() -> None:
+        nonlocal pos
+        kind = next_word()
+        if kind == EXEC_ARG_CONST:
+            pos += 2
+        elif kind == EXEC_ARG_RESULT:
+            pos += 5
+        elif kind == EXEC_ARG_DATA:
+            lenword = next_word()
+            ln, cap = lenword & 0xFFFFFFFF, lenword >> 32
+            region = max(ln, cap)
+            pos += (region + 7) // 8
+        elif kind == EXEC_ARG_CSUM:
+            pos += 2  # size, csum kind
+            nchunks = next_word()
+            pos += 3 * nchunks
+        else:
+            raise ValueError(f"bad arg kind {kind}")
+        if pos > len(words):
+            raise ValueError("truncated arg")
+
+    while True:
+        w = next_word()
+        if w == EXEC_INSTR_EOF:
+            break
+        if w == EXEC_INSTR_COPYIN:
+            next_word()  # addr
+            parse_arg()
+        elif w == EXEC_INSTR_COPYOUT:
+            pos += 3
+        else:
+            calls.append(w & 0xFFFFFFFF)
+            next_word()  # copyout idx
+            nargs = next_word()
+            for _ in range(nargs):
+                parse_arg()
+    return calls

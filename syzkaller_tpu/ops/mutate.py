@@ -360,6 +360,33 @@ def _remove_call(key, state):
     return state
 
 
+def _fixup_lens(state):
+    """Recompute LEN slots that measure a device DATA slot after data
+    mutation (the device analogue of assignSizesCall for the direct
+    (buf, len) pairs the tensor encoding links; reference:
+    prog/size.go:40-117).  Skipped when a LEN slot was itself mutated,
+    matching the reference's preserve contract."""
+    lt = state["len_target"]
+    is_link = (state["kind"] == LEN) & (lt >= 0)
+    tgt = jnp.maximum(lt, 0)
+    # val = bytes * 8 / bit_size (aux1; 1 = bit-length fields, 8 =
+    # byte lengths), matching generate_size for buffer targets
+    # (reference: prog/size.go:11-34).
+    bits = state["len_"][tgt].astype(U64) << U64(3)
+    gran = jnp.maximum(state["aux1"], U64(1))
+    # Arena-bounded lengths (< 2^24) divide exactly: shift for pow2
+    # granularity (the only kind the DSL emits), f32 otherwise
+    # (no u64 div on TPU).
+    log2 = U64(63) - lax.clz(gran).astype(U64)
+    is_pow2 = (gran & (gran - U64(1))) == U64(0)
+    approx = (bits.astype(jnp.float32) / gran.astype(jnp.float32)).astype(U64)
+    fix = jnp.where(is_pow2, bits >> log2, approx)
+    take = is_link & ~state["preserve_sizes"]
+    state = dict(state)
+    state["val"] = jnp.where(take, fix, state["val"])
+    return state
+
+
 def _mutate_one(state, key, flag_vals, flag_counts, rounds):
     """The outer weighted loop (reference: prog/mutation.go:19-132),
     restricted to device ops: 10/11 mutate-arg, 1/11 remove-call, with
@@ -381,7 +408,7 @@ def _mutate_one(state, key, flag_vals, flag_counts, rounds):
         return new_state, active
 
     state, _ = lax.fori_loop(0, rounds, body, (state, jnp.bool_(True)))
-    return state
+    return _fixup_lens(state)
 
 
 def make_mutator(rounds: int = 4):

@@ -37,6 +37,7 @@ from typing import Optional
 
 import numpy as np
 
+from syzkaller_tpu.models.checksum import calc_checksums_call
 from syzkaller_tpu.models.mutation import MutationArgs
 from syzkaller_tpu.models.prog import (
     Call,
@@ -123,6 +124,9 @@ class ProgTensor:
     off: np.ndarray
     len_: np.ndarray
     cap: np.ndarray
+    len_target: np.ndarray  # int32[S]: for LEN slots, the DATA slot they
+    # measure (-1 if none) — lets the device recompute length fields
+    # after data mutation without a host size-assignment pass.
     arena: np.ndarray
     # CPU-only metadata: per slot, the path to the Arg in the template.
     template: Prog = None  # type: ignore[assignment]
@@ -134,7 +138,7 @@ class ProgTensor:
                     call=self.call, width=self.width, aux0=self.aux0,
                     aux1=self.aux1, flag_set=self.flag_set, val=self.val,
                     off=self.off, len_=self.len_, cap=self.cap,
-                    arena=self.arena)
+                    len_target=self.len_target, arena=self.arena)
 
 
 class ProgramTooLarge(Exception):
@@ -163,15 +167,22 @@ def encode_prog(p: Prog, cfg: TensorConfig, flags: FlagTables) -> ProgTensor:
         off=np.zeros(cfg.max_slots, dtype=np.int32),
         len_=np.zeros(cfg.max_slots, dtype=np.int32),
         cap=np.zeros(cfg.max_slots, dtype=np.int32),
+        len_target=np.full(cfg.max_slots, -1, dtype=np.int32),
         arena=np.zeros(cfg.arena, dtype=np.uint8),
         template=p,
     )
     slot = 0
     arena_pos = 0
+    len_measures: dict[int, int] = {}  # slot -> id(measured inner arg)
 
     for ci, c in enumerate(p.calls):
         t.call_id[ci] = c.meta.id
         t.call_alive[ci] = True
+        # Calls carrying inet checksums bake chunk sizes into their
+        # exec csum instructions; device data-length mutation would
+        # leave those stale, so their data stays host-mutated
+        # (value slots are still fine: they never change sizes).
+        has_csum = calc_checksums_call(c) is not None
         # Collect device-mutable args exactly as MutationArgs does.
         ma = MutationArgs(p.target)
         foreach_arg(c, ma.collect)
@@ -192,13 +203,19 @@ def encode_prog(p: Prog, cfg: TensorConfig, flags: FlagTables) -> ProgTensor:
                            aux0=typ.values_start, aux1=typ.values_per_proc,
                            val=arg.val)
             elif isinstance(typ, LenType) and isinstance(arg, ConstArg):
-                elem_size, ok = _len_elem_size(typ, ctx)
+                elem_size, measured, ok = _len_elem_size(typ, ctx)
                 if not ok:
                     continue
+                # aux0: element scale for mutate_size; aux1: the
+                # LenType bit granularity for the device length fixup
+                # (val = bytes * 8 / aux1, matching generate_size;
+                # reference: prog/size.go:11-34).
                 row = dict(kind=LEN, width=typ.type_size, aux0=elem_size,
-                           val=arg.val)
+                           aux1=(typ.bit_size or 8), val=arg.val)
+                if measured is not None:
+                    len_measures[slot] = id(measured)
             elif isinstance(typ, BufferType) and isinstance(arg, DataArg) \
-                    and typ.dir != Dir.OUT:
+                    and typ.dir != Dir.OUT and not has_csum:
                 if typ.kind in (BufferKind.BLOB_RAND, BufferKind.BLOB_RANGE) \
                         or (typ.kind == BufferKind.STRING and not typ.values):
                     data = bytes(arg.data)
@@ -238,6 +255,15 @@ def encode_prog(p: Prog, cfg: TensorConfig, flags: FlagTables) -> ProgTensor:
             slot += 1
     # Pad slot_args so indices line up with slot table rows.
     assert len(t.slot_args) == slot
+    # Wire LEN slots to the DATA slot they measure (when both are
+    # device-resident) so the device can keep length fields consistent
+    # after data mutation (the host decode path re-runs full size
+    # assignment; the device exec path patches only these links).
+    slot_of_arg = {id(a): i for i, a in enumerate(t.slot_args)}
+    for len_slot, measured_id in len_measures.items():
+        tgt = slot_of_arg.get(measured_id)
+        if tgt is not None and t.kind[tgt] == DATA:
+            t.len_target[len_slot] = tgt
     return t
 
 
@@ -248,31 +274,31 @@ def _round_cap(n: int) -> int:
     return c
 
 
-def _len_elem_size(typ: LenType, ctx) -> tuple[int, bool]:
-    """Element size for mutate_size, resolved at encode time
-    (reference: prog/size.go:119-141)."""
+def _len_elem_size(typ: LenType, ctx) -> tuple[int, Optional[object], bool]:
+    """Element size for mutate_size plus the measured sibling arg,
+    resolved at encode time (reference: prog/size.go:119-141)."""
     from syzkaller_tpu.models.prog import inner_arg
 
-    elem_size = typ.bit_size // 8
-    if elem_size:
-        return elem_size, True
-    elem_size = 1
+    measured = None
     if ctx.parent is not None:
         for f in ctx.parent:
-            if typ.buf != f.typ.field_name:
-                continue
-            inner = inner_arg(f)
-            if inner is not None:
-                it = inner.typ
-                if isinstance(it, VmaType):
-                    return 0, False
-                if isinstance(it, ArrayType):
-                    assert it.elem is not None
-                    if it.elem.varlen:
-                        return 0, False
-                    elem_size = it.elem.size()
-            break
-    return elem_size, True
+            if typ.buf == f.typ.field_name:
+                measured = inner_arg(f)
+                break
+    elem_size = typ.bit_size // 8
+    if elem_size:
+        return elem_size, measured, True
+    elem_size = 1
+    if measured is not None:
+        it = measured.typ
+        if isinstance(it, VmaType):
+            return 0, None, False
+        if isinstance(it, ArrayType):
+            assert it.elem is not None
+            if it.elem.varlen:
+                return 0, None, False
+            elem_size = it.elem.size()
+    return elem_size, measured, True
 
 
 def decode_prog(t: ProgTensor, mutated: dict[str, np.ndarray],
