@@ -68,6 +68,7 @@ class ExecTemplate:
     value_slots: np.ndarray  # int32[k] slots patched via val_word
     proc_slots: np.ndarray  # int32[k2] PROC slots (conditional stride)
     data_slots: np.ndarray  # int32[k3] DATA slots
+    is_proc: np.ndarray  # bool[S]
     calls_any: np.ndarray  # bool[ncalls]: call contains a squashed ANY
     # (consumed by the pipeline's signal_prio for undecoded mutants)
 
@@ -138,6 +139,7 @@ def build_exec_template(t: ProgTensor,
         proc_meta_concrete=proc_meta_concrete,
         value_slots=value_slots, proc_slots=proc_slots,
         data_slots=data_slots,
+        is_proc=(kinds == PROC) & (val_word >= 0),
         calls_any=calls_any,
     )
 
@@ -177,13 +179,64 @@ def assemble(et: ExecTemplate, val: np.ndarray, len_: np.ndarray,
         # zero padding, and no stale template bytes on the wire.
         u8[start + ln:start + cap + (-cap) % 8] = 0
 
+    return _slice_alive(et, w, call_alive)
+
+
+def _slice_alive(et: ExecTemplate, w: np.ndarray,
+                 call_alive: np.ndarray) -> bytes:
+    """Drop dead calls' segments (patches were applied to the full
+    template, so indices never shift) and keep the EOF word."""
     nc = et.ncalls
     if bool(call_alive[:nc].all()):
         return w.tobytes()
     parts = [w[a:b] for (a, b), alive
              in zip(et.call_bounds, call_alive[:nc]) if alive]
     parts.append(w[-1:])  # EOF
-    return np.concatenate(parts).tobytes() if parts else w[-1:].tobytes()
+    return np.concatenate(parts).tobytes()
+
+
+def assemble_delta(et: ExecTemplate, batch, j: int) -> bytes:
+    """Assemble exec bytes for mutant j of a DeltaBatch
+    (ops/delta.DeltaBatch): same patch rules as assemble(), applied
+    only to the changed slots the delta carries.  ~O(changes) per
+    mutant instead of O(slots)."""
+    w = et.words.copy()
+    u8 = None
+
+    for i in range(int(batch.nvals[j])):
+        s = int(batch.val_idx[j, i])
+        if s < 0:
+            continue
+        vw = int(et.val_word[s])
+        if vw < 0:
+            continue
+        v = batch.vals[j, i]
+        if et.is_proc[s]:
+            if v == MASK64:
+                w[vw] = 0
+                w[int(et.meta_word[s])] = et.proc_meta_default[s]
+            else:
+                w[vw] = et.aux0[s] + v
+                w[int(et.meta_word[s])] = et.proc_meta_concrete[s]
+        else:
+            w[vw] = v
+
+    for i in range(int(batch.ndata[j])):
+        s = int(batch.data_slot[j, i])
+        if s < 0 or int(et.len_word[s]) < 0:
+            continue
+        cap = int(et.data_cap[s])
+        ln = min(int(batch.data_len[j, i]), cap)
+        w[int(et.len_word[s])] = np.uint64(ln | (cap << 32))
+        if u8 is None:
+            u8 = w.view(np.uint8)
+        start = int(et.data_word[s]) * 8
+        po = int(batch.data_off[j, i])
+        u8[start:start + ln] = batch.payload[j, po:po + ln]
+        u8[start + ln:start + cap + (-cap) % 8] = 0
+
+    alive = batch.call_alive(j, max(et.ncalls, 1))
+    return _slice_alive(et, w, alive)
 
 
 def mutant_call_ids(et: ExecTemplate, call_alive: np.ndarray) -> list[int]:

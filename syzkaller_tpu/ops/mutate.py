@@ -346,6 +346,8 @@ def _mutate_slot(key, state, flag_vals, flag_counts):
     state["len_"] = state["len_"].at[s_safe].set(
         jnp.where(is_data, new_dlen, state["len_"][s_safe]))
     state["preserve_sizes"] = state["preserve_sizes"] | ((sk == LEN) & (s >= 0))
+    state["touched"] = state["touched"].at[s_safe].set(
+        state["touched"][s_safe] | (s >= 0))
     return state
 
 
@@ -384,6 +386,9 @@ def _fixup_lens(state):
     take = is_link & ~state["preserve_sizes"]
     state = dict(state)
     state["val"] = jnp.where(take, fix, state["val"])
+    # A fixed-up LEN only counts as changed when its measured data
+    # actually changed (otherwise fix == the template value).
+    state["touched"] = state["touched"] | (take & state["touched"][tgt])
     return state
 
 
@@ -393,6 +398,9 @@ def _mutate_one(state, key, flag_vals, flag_counts, rounds):
     a 1/3 stop coin per round, bounded at `rounds`."""
     state = dict(state)
     state["preserve_sizes"] = jnp.bool_(False)
+    # Per-slot change journal: lets the pipeline ship sparse deltas
+    # instead of full rows over the (slow) host link (ops/delta.py).
+    state["touched"] = jnp.zeros(state["kind"].shape[0], dtype=jnp.bool_)
 
     def body(i, carry):
         state, active = carry

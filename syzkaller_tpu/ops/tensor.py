@@ -71,10 +71,14 @@ class TensorConfig:
     max_calls: int = 32
     max_slots: int = 224
     arena: int = 8192
+    # Per-slot blob ceiling: larger buffers stay host-mutated.  Kept
+    # well under the arena so several data slots fit, and bounded so
+    # a single mutant's changed spans fit a delta-transfer payload.
+    max_blob: int = MAX_BLOB_DEVICE
 
     def like(self) -> dict:
         return dict(max_calls=self.max_calls, max_slots=self.max_slots,
-                    arena=self.arena)
+                    arena=self.arena, max_blob=self.max_blob)
 
 
 @dataclass
@@ -219,13 +223,13 @@ def encode_prog(p: Prog, cfg: TensorConfig, flags: FlagTables) -> ProgTensor:
                 if typ.kind in (BufferKind.BLOB_RAND, BufferKind.BLOB_RANGE) \
                         or (typ.kind == BufferKind.STRING and not typ.values):
                     data = bytes(arg.data)
-                    min_len, max_len = 0, MAX_BLOB_DEVICE
+                    min_len, max_len = 0, cfg.max_blob
                     if typ.kind == BufferKind.BLOB_RANGE:
                         min_len, max_len = typ.range_begin, \
-                            min(typ.range_end, MAX_BLOB_DEVICE)
+                            min(typ.range_end, cfg.max_blob)
                     elif typ.kind == BufferKind.STRING and typ.type_size:
                         min_len = max_len = typ.type_size
-                    if len(data) > MAX_BLOB_DEVICE:
+                    if len(data) > cfg.max_blob:
                         continue  # oversized blob: CPU-only mutation
                     cap = min(_round_cap(max(len(data) * 2, 64)),
                               cfg.arena - arena_pos, max_len)

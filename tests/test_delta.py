@@ -1,0 +1,108 @@
+"""Delta-transfer oracle: the sparse path (touched journal ->
+make_packer -> assemble_delta) must be BIT-IDENTICAL to the dense path
+(full mutated rows -> assemble) for the same device mutation, and
+rebuild_row must reconstruct the full row exactly."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax import random  # noqa: E402
+
+from syzkaller_tpu.models.generation import generate_prog  # noqa: E402
+from syzkaller_tpu.models.rand import RandGen  # noqa: E402
+from syzkaller_tpu.ops.delta import DeltaBatch, DeltaSpec, make_packer  # noqa: E402
+from syzkaller_tpu.ops.emit import (  # noqa: E402
+    assemble,
+    assemble_delta,
+    build_exec_template,
+)
+from syzkaller_tpu.ops.mutate import _mutate_one  # noqa: E402
+from syzkaller_tpu.ops.tensor import (  # noqa: E402
+    FlagTables,
+    TensorConfig,
+    encode_prog,
+)
+
+
+def _encode_some(target, n, cfg, flags, seed0=500):
+    tensors = []
+    i = 0
+    while len(tensors) < n and i < n * 8:
+        p = generate_prog(target, RandGen(target, seed0 + i), 6)
+        i += 1
+        try:
+            tensors.append(encode_prog(p, cfg, flags))
+        except Exception:
+            continue
+    assert tensors
+    return tensors
+
+
+def test_delta_matches_dense_assembly(test_target, iters):
+    cfg = TensorConfig(max_slots=128, arena=2048, max_blob=768)
+    flags = FlagTables.empty()
+    spec = DeltaSpec()
+    tensors = _encode_some(test_target, 8, cfg, flags)
+    pack = make_packer(spec)
+
+    def both(state, key, tidx):
+        mutated = _mutate_one(state, key, fv, fc, 4)
+        return mutated, pack(mutated, tidx)
+
+    fv, fc = jnp.asarray(flags.vals), jnp.asarray(flags.counts)
+    fn = jax.jit(lambda st, k, i: both(st, k, i))
+    key = random.key(11)
+    checked = 0
+    for it in range(iters * 3):
+        t = tensors[it % len(tensors)]
+        et = build_exec_template(t)
+        state = {k: jnp.asarray(v) for k, v in t.arrays().items()}
+        key, sub = random.split(key)
+        mutated, row_bytes = fn(state, sub, jnp.int32(it % len(tensors)))
+        buf = np.asarray(row_bytes)[None]
+        batch = DeltaBatch(buf, spec)
+        if batch.overflowed(0):
+            continue
+        mut = {k: np.asarray(v) for k, v in mutated.items()}
+        dense = assemble(et, mut["val"], mut["len_"], mut["arena"],
+                         mut["call_alive"])
+        sparse = assemble_delta(et, batch, 0)
+        assert sparse == dense, f"delta/dense mismatch at iteration {it}"
+
+        # rebuild_row reconstructs the full mutated row exactly for
+        # every field the decode path reads.
+        rebuilt = batch.rebuild_row(0, t)
+        assert bool(rebuilt["preserve_sizes"]) == bool(mut["preserve_sizes"])
+        np.testing.assert_array_equal(rebuilt["val"], mut["val"])
+        np.testing.assert_array_equal(rebuilt["len_"], mut["len_"])
+        np.testing.assert_array_equal(
+            rebuilt["call_alive"][:t.ncalls], mut["call_alive"][:t.ncalls])
+        # Arena: only changed spans are shipped; compare the spans the
+        # decode path reads (each DATA slot's [off, off+len)).
+        for s in range(len(t.slot_args)):
+            if t.len_target is not None and et.len_word[s] >= 0:
+                off = int(t.off[s])
+                ln = int(rebuilt["len_"][s])
+                np.testing.assert_array_equal(
+                    rebuilt["arena"][off:off + ln],
+                    mut["arena"][off:off + ln])
+        checked += 1
+    assert checked >= iters
+
+
+def test_delta_template_index_roundtrip(test_target):
+    cfg = TensorConfig(max_slots=128, arena=2048, max_blob=768)
+    flags = FlagTables.empty()
+    spec = DeltaSpec()
+    t = _encode_some(test_target, 1, cfg, flags)[0]
+    pack = make_packer(spec)
+    fv, fc = jnp.asarray(flags.vals), jnp.asarray(flags.counts)
+    state = {k: jnp.asarray(v) for k, v in t.arrays().items()}
+    fn = jax.jit(lambda st, k, i: pack(
+        _mutate_one(st, k, fv, fc, 2), i))
+    for tidx in (0, 7, 2047):
+        row = fn(state, random.key(tidx), jnp.int32(tidx))
+        batch = DeltaBatch(np.asarray(row)[None], spec)
+        assert int(batch.template_idx[0]) == tidx

@@ -38,13 +38,14 @@ from syzkaller_tpu.ops.tensor import (  # noqa: E402
 def _encode_some(target, n, cfg, flags, seed0=100):
     tensors = []
     i = 0
-    while len(tensors) < n:
+    while len(tensors) < n and i < n * 8:
         p = generate_prog(target, RandGen(target, seed0 + i), 6)
         i += 1
         try:
             tensors.append(encode_prog(p, cfg, flags))
         except Exception:
             continue
+    assert len(tensors) >= max(1, n // 2), "generated programs stopped tensorizing"
     return tensors
 
 
