@@ -3,8 +3,9 @@
 The Fuzzer owns shared state (corpus, signal sets, choice table), Procs
 run the per-worker loop against executor Envs, and the WorkQueue
 prioritizes triage/candidate/smash work items.  The TPU twist: procs
-can draw mutants from a shared BatchMutator backed by the device
-engine instead of mutating one program at a time.
+can draw exec-ready mutants from a shared PipelineMutator draining the
+device-resident corpus pipeline instead of mutating one program at a
+time.
 """
 
 from syzkaller_tpu.fuzzer.workqueue import (

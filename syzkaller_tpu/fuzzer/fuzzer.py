@@ -151,10 +151,19 @@ class Fuzzer:
         """Per-call novelty test against max_signal; returns calls with
         new signal and updates max/new signal under one lock
         (reference: fuzzer.go:494-511)."""
+        return self.check_new_signal_fn(
+            lambda errno, idx: signal_prio(p, errno, idx), infos)
+
+    def check_new_signal_fn(self, prio_fn,
+                            infos) -> list[tuple[int, Signal]]:
+        """check_new_signal with a caller-supplied prio_fn(errno,
+        call_index) — lets undecoded device mutants compute edge
+        priority from their exec-template flags without a typed
+        decode (ops/pipeline.ExecMutant.signal_prio)."""
         out = []
         with self._lock:
             for info in infos:
-                prio = signal_prio(p, info.errno, info.call_index)
+                prio = prio_fn(info.errno, info.call_index)
                 diff = self.max_signal.diff_raw(info.signal, prio)
                 if diff.empty():
                     continue

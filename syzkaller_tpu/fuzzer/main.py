@@ -81,18 +81,19 @@ class FuzzerProcess:
         for cand in connect_res.get("candidates") or []:
             self._enqueue_candidate(cand)
 
-        self.batch_mutator = None
+        self.mutator = None
         if engine == "jax":
-            from syzkaller_tpu.engine import TpuEngine
-            from syzkaller_tpu.fuzzer.proc import BatchMutator
+            from syzkaller_tpu.fuzzer.proc import PipelineMutator
+            from syzkaller_tpu.ops.pipeline import DevicePipeline
 
-            self.batch_mutator = BatchMutator(TpuEngine(self.target))
+            self.mutator = PipelineMutator(DevicePipeline(self.target))
 
         self.procs = []
         for pid in range(procs):
             env = make_env(pid, sim=sim)
             self.procs.append(Proc(self.fuzzer, pid, env,
-                                   batch_mutator=self.batch_mutator))
+                                   mutator=self.mutator,
+                                   device_hints=engine == "jax"))
 
     # -- corpus/candidate intake -----------------------------------------
 
@@ -140,6 +141,9 @@ class FuzzerProcess:
                         self.stop.set()
         finally:
             self.stop.set()
+            if self.mutator is not None:
+                # Wake procs blocked in pipeline.next() before joining.
+                self.mutator.pipeline.stop()
             for t in threads:
                 t.join(timeout=5)
             self.shutdown()
@@ -192,6 +196,8 @@ class FuzzerProcess:
         return res
 
     def shutdown(self) -> None:
+        if self.mutator is not None:
+            self.mutator.pipeline.stop()  # no-op if already stopped
         for proc in self.procs:
             try:
                 proc.env.close()

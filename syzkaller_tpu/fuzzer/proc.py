@@ -3,15 +3,16 @@
 Each Proc owns one executor Env (fork-server) and runs the weighted
 loop: dequeue prioritized work, else 1-in-N generate from scratch,
 else mutate a corpus program.  Mutants come either from the CPU
-mutator (reference semantics) or from a shared BatchMutator that
-drains pre-computed device batches — the feed/drain integration of
-the TPU engine (SURVEY.md §7 step 8).
+mutator (reference semantics) or from a shared PipelineMutator that
+drains exec-ready mutant batches off the device-resident corpus
+pipeline — the feed/drain integration of the TPU engine (SURVEY.md §7
+step 8; reference shape: syz-fuzzer/proc.go:66-98).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import Optional, Union
 
 from syzkaller_tpu.fuzzer.fuzzer import Fuzzer, Stat, signal_prio
 from syzkaller_tpu.fuzzer.workqueue import (
@@ -42,43 +43,103 @@ from syzkaller_tpu.signal.cover import Cover
 from syzkaller_tpu.utils import log
 
 
-class BatchMutator:
-    """Feed/drain queue between procs and the device mutation engine.
+class PipelineMutator:
+    """Integrated mutation source over a DevicePipeline
+    (ops/pipeline.py): each draw runs the REFERENCE op ladder
+    (reference: prog/mutation.go:19-131).  The device classes
+    (arg-mutate 10/11, remove 1/11 — together ~28% of iterations)
+    route to the device ring, which produces an exec-ready mutant;
+    the structural classes (squash/splice/insert) run the CPU op on a
+    cloned base, and a failed op redraws the full ladder — exactly
+    the reference's retry shape, so the landed-op distribution is
+    success-conditioned the same way the reference's is.
 
-    Procs call next() for a single mutant; when the buffer runs dry the
-    calling proc refills it with one engine batch over a random corpus
-    sample.  Amortizes host⇄device transfer over batch_size mutants
-    while other procs keep their executors saturated (SURVEY.md §7
-    hard part (c))."""
+    next() returns either an exec-ready ExecMutant or a typed Prog;
+    Proc.execute handles both.  Corpus growth is fed to the device
+    ring on every draw (one scatter per pipeline step)."""
 
-    def __init__(self, engine, batch_size: int = 64):
-        self.engine = engine
-        self.batch_size = batch_size
-        self._buf: list[Prog] = []
+    def __init__(self, pipeline, drain_timeout: float = 60.0):
+        self.pipeline = pipeline
+        self.drain_timeout = drain_timeout
         self._lock = threading.Lock()
+        self._fed = 0
+        self._corpus_cache: list[Prog] = []
+        # Tests set this to a list to observe the op-class stream.
+        self.ops_journal: Optional[list[str]] = None
 
-    def next(self, fuzzer: Fuzzer, rng: RandGen) -> Optional[Prog]:
+    def _sync_corpus(self, fuzzer: Fuzzer) -> list[Prog]:
+        """Feed new corpus items to the device ring; returns the
+        splice-source snapshot."""
+        if fuzzer.corpus_len() == self._fed:
+            return self._corpus_cache
         with self._lock:
-            if self._buf:
-                return self._buf.pop()
-        corpus_items = fuzzer.corpus_snapshot()
-        if not corpus_items:
+            items = fuzzer.corpus_snapshot()
+            new = items[self._fed:]
+            self._fed = len(items)
+            self._corpus_cache = [it.p for it in items]
+            cache = self._corpus_cache
+        for it in new:
+            self.pipeline.add(it.p)
+        return cache
+
+    def next(self, fuzzer: Fuzzer,
+             rng: RandGen) -> Optional[Union[Prog, "object"]]:
+        from syzkaller_tpu.models.mutation import (
+            _op_insert,
+            _op_splice,
+            _op_squash,
+            mutate_prog,
+        )
+
+        corpus = self._sync_corpus(fuzzer)
+        if len(self.pipeline) == 0:
             return None
-        templates = []
-        for _ in range(self.batch_size):
-            item = corpus_items[rng.intn(len(corpus_items))]
-            t = self.engine.encode(item.p)
-            if t is not None:
-                templates.append(t)
-        if not templates:
+        base = fuzzer.choose_corpus_prog(rng)
+        if base is None:
             return None
-        mutants = self.engine.mutate(
-            templates, ct=fuzzer.ct, corpus=[it.p for it in corpus_items])
-        with self._lock:
-            self._buf.extend(m for m in mutants if m is not None)
-            if not self._buf:
-                return None
-            return self._buf.pop()
+        ncalls = fuzzer.cfg.program_length
+        ct = fuzzer.ct
+        p: Optional[Prog] = None
+        while True:
+            # The reference op ladder (prog/mutation.go:19-131); the
+            # arg-mutate/remove tail is one "device" outcome here —
+            # the kernel draws 10/11-vs-1/11 per round on device
+            # (ops/mutate._mutate_one).
+            if rng.one_of(5):
+                op = "squash"
+            elif rng.n_out_of(1, 100):
+                op = "splice"
+            elif rng.n_out_of(20, 31):
+                op = "insert"
+            else:
+                op = "device"
+            if op == "device":
+                m = self.pipeline.next(timeout=self.drain_timeout)
+                if m is not None and self.ops_journal is not None:
+                    self.ops_journal.append("device")
+                return m
+            if p is None:
+                p = base.clone()
+            if op == "squash":
+                ok = _op_squash(p, rng, ct)
+            elif op == "splice":
+                ok = _op_splice(p, rng, ncalls, corpus)
+            else:
+                ok = _op_insert(p, rng, ncalls, ct)
+            if not ok:
+                continue  # reference retry: redraw the full ladder
+            if self.ops_journal is not None:
+                self.ops_journal.append(op)
+            if not rng.one_of(3):
+                # Continue coin: further iterations run the full CPU
+                # reference loop (may mix in arg-mutate/remove, as the
+                # reference would).
+                mutate_prog(p, rng, ncalls, ct=ct, corpus=corpus,
+                            ops_out=self.ops_journal)
+            else:
+                for c in p.calls:
+                    fuzzer.target.sanitize_call(c)
+            return p
 
 
 class Proc:
@@ -87,12 +148,16 @@ class Proc:
 
     def __init__(self, fuzzer: Fuzzer, pid: int, env: Env,
                  rng: Optional[RandGen] = None,
-                 batch_mutator: Optional[BatchMutator] = None):
+                 mutator: Optional[PipelineMutator] = None,
+                 device_hints: bool = False):
         self.fuzzer = fuzzer
         self.pid = pid
         self.env = env
         self.rng = rng or RandGen(fuzzer.target, pid * 1103515245 + 12345)
-        self.batch_mutator = batch_mutator
+        self.mutator = mutator
+        # Smash's hint pass runs the batched shrinkExpand kernel
+        # (ops/hints.py) instead of the per-window CPU walk.
+        self.device_hints = device_hints
         self.exec_opts = ExecOpts(flags=ExecFlags(0))
         self.exec_opts_cover = ExecOpts(flags=ExecFlags.COLLECT_COVER
                                         | ExecFlags.DEDUP_COVER)
@@ -133,9 +198,9 @@ class Proc:
                     continue
                 self.execute(self.exec_opts, p, Stat.FUZZ)
 
-    def _next_mutant(self) -> Optional[Prog]:
-        if self.batch_mutator is not None:
-            p = self.batch_mutator.next(self.fuzzer, self.rng)
+    def _next_mutant(self):
+        if self.mutator is not None:
+            p = self.mutator.next(self.fuzzer, self.rng)
             if p is not None:
                 return p
         base = self.fuzzer.choose_corpus_prog(self.rng)
@@ -253,25 +318,44 @@ class Proc:
         def exec_cb(mutant: Prog) -> None:
             self.execute(self.exec_opts, mutant, Stat.HINT)
 
-        mutate_with_hints(p, call_index, comps, exec_cb)
+        if self.device_hints:
+            from syzkaller_tpu.ops.hints import mutate_with_hints_device
+
+            mutate_with_hints_device(p, call_index, comps, exec_cb)
+        else:
+            mutate_with_hints(p, call_index, comps, exec_cb)
 
     # -- execution --------------------------------------------------------
 
-    def execute(self, opts: ExecOpts, p: Prog, stat: Stat,
+    def execute(self, opts: ExecOpts, p, stat: Stat,
                 flags: Optional[ProgTypes] = None) -> Optional[ExecResult]:
         """Execute + novelty check; new signal enqueues triage work
-        (reference: proc.go:230-247)."""
+        (reference: proc.go:230-247).
+
+        p is a typed Prog or an exec-ready device mutant (anything with
+        .exec_bytes / .signal_prio / .prog()); mutants are decoded to a
+        typed program only when they produce new signal — the ~1/1000
+        triage path (syz-fuzzer/proc.go:100)."""
         result = self.execute_raw(opts, p, stat)
         if result is None:
             return None
-        for call_index, sig in self.fuzzer.check_new_signal(p, result.info):
+        if _is_exec_mutant(p):
+            news = self.fuzzer.check_new_signal_fn(p.signal_prio,
+                                                   result.info)
+            if not news:
+                return result
+            decoded = p.prog()  # lazy typed decode for triage
+        else:
+            news = self.fuzzer.check_new_signal(p, result.info)
+            decoded = p
+        for call_index, sig in news:
             self.fuzzer.wq.enqueue(WorkTriage(
-                p=p.clone(), call_index=call_index, signal=sig,
+                p=decoded.clone(), call_index=call_index, signal=sig,
                 flags=flags or ProgTypes(minimized=False, smashed=False),
                 from_candidate=flags is not None))
         return result
 
-    def execute_raw(self, opts: ExecOpts, p: Prog,
+    def execute_raw(self, opts: ExecOpts, p,
                     stat: Stat) -> Optional[ExecResult]:
         """(reference: proc.go:249-277 incl. crash/retry handling)"""
         self.fuzzer.stat_add(stat)
@@ -288,19 +372,30 @@ class Proc:
                            f" fault-nth:{opts.fault_nth})")
             from syzkaller_tpu.models.encoding import serialize_prog
 
+            typed = p.prog() if _is_exec_mutant(p) else p
             log.logf(0, "%s:\n%s", marker,
-                     serialize_prog(p).decode())
-        data = serialize_for_exec(p)
+                     serialize_prog(typed).decode())
+        if _is_exec_mutant(p):
+            data = p.exec_bytes
+        else:
+            data = serialize_for_exec(p)
         try:
             result = self.env.exec(opts, data)
         except ExecutorCrash as e:
-            self.fuzzer.record_crash(e.log, p)
+            self.fuzzer.record_crash(
+                e.log, p.prog() if _is_exec_mutant(p) else p)
             return None
         except ExecutorFailure as e:
             log.logf(1, "proc %d: executor failure: %s", self.pid, e)
             self.fuzzer.stat_add(Stat.EXECUTOR_RESTARTS)
             return None
         return result
+
+
+def _is_exec_mutant(p) -> bool:
+    """Duck-typed: keeps proc.py importable without jax (ExecMutant
+    lives in ops/pipeline, which pulls in the device stack)."""
+    return hasattr(p, "exec_bytes")
 
 
 def _find_call(result: Optional[ExecResult], call_index: int):

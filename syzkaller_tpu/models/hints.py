@@ -3,8 +3,7 @@
 The kernel's KCOV_TRACE_CMP feed gives us (operand, operand) pairs per
 call; shrink/expand models int truncation/sign-extension/endianness to
 match program bytes against observed operands and substitute the other
-side (reference: prog/hints.go:27-218).  The batched TPU version of
-shrink_expand lives in ops/hints.py and is parity-tested against this.
+side (reference: prog/hints.go:27-218).
 """
 
 from __future__ import annotations

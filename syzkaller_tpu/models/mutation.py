@@ -61,8 +61,12 @@ MAX_BLOB_LEN = 100 << 10
 
 
 def mutate_prog(p: Prog, rng: RandGen, ncalls: int, ct=None,
-                corpus: Optional[list[Prog]] = None) -> None:
-    """(reference: prog/mutation.go:14-142)"""
+                corpus: Optional[list[Prog]] = None,
+                ops_out: Optional[list[str]] = None) -> None:
+    """(reference: prog/mutation.go:14-142)
+
+    ops_out, when given, records the name of every op that landed —
+    the observable for distribution-parity tests."""
     corpus = corpus or []
     target = p.target
     stop = False
@@ -70,103 +74,128 @@ def mutate_prog(p: Prog, rng: RandGen, ncalls: int, ct=None,
     while not stop or retry:
         retry = False
         if rng.one_of(5):
-            # Squash complex pointee into an ANY blob and mutate raw bytes.
-            from syzkaller_tpu.models.any_squash import complex_ptrs, squash_ptr, is_any_ptr
-
-            ptrs = complex_ptrs(p)
-            if not ptrs:
-                retry = True
-                continue
-            ptr = ptrs[rng.intn(len(ptrs))]
-            if not is_any_ptr(target, ptr.typ):
-                squash_ptr(target, p, ptr, preserve_field=True)
-            blobs: list[DataArg] = []
-            bases: list[PointerArg] = []
-
-            def collect(arg, ctx) -> None:
-                if isinstance(arg, DataArg) and arg.typ.dir != Dir.OUT:
-                    blobs.append(arg)
-                    bases.append(ctx.base)
-
-            foreach_sub_arg(ptr, collect)
-            if not blobs:
-                retry = True
-                continue
-            idx = rng.intn(len(blobs))
-            arg, base = blobs[idx], bases[idx]
-            base_size = base.res.size()
-            arg.data = bytearray(mutate_data(rng, arg.data, 0, MAX_BLOB_LEN))
-            # Update base pointer if the object grew.
-            if base_size < base.res.size():
-                s = analyze(ct, p, p.calls[0])
-                new_arg = alloc_addr(rng, s, base.typ, base.res.size(), base.res)
-                base.address = new_arg.address
+            op, ok = "squash", _op_squash(p, rng, ct)
         elif rng.n_out_of(1, 100):
-            # Splice with a random corpus program.
-            if not corpus or not p.calls:
-                retry = True
-                continue
-            p0 = corpus[rng.intn(len(corpus))]
-            p0c = p0.clone()
-            idx = rng.intn(len(p.calls))
-            p.calls = p.calls[:idx] + p0c.calls + p.calls[idx:]
-            for i in range(len(p.calls) - 1, ncalls - 1, -1):
-                p.remove_call(i)
+            op, ok = "splice", _op_splice(p, rng, ncalls, corpus)
         elif rng.n_out_of(20, 31):
-            # Insert a new call.
-            if len(p.calls) >= ncalls:
-                retry = True
-                continue
-            idx = rng.biased_rand(len(p.calls) + 1, 5)
-            c = p.calls[idx] if idx < len(p.calls) else None
-            s = analyze(ct, p, c)
-            calls = generate_call(rng, s, p)
-            p.insert_before(c, calls)
+            op, ok = "insert", _op_insert(p, rng, ncalls, ct)
         elif rng.n_out_of(10, 11):
-            # Mutate args of a random call.
-            if not p.calls:
-                retry = True
-                continue
-            c = p.calls[rng.intn(len(p.calls))]
-            if not c.args:
-                retry = True
-                continue
-            s = analyze(ct, p, c)
-            update_sizes = [True]
-            stop_arg = False
-            retry_arg = False
-            bailed = False
-            while not stop_arg or retry_arg:
-                retry_arg = False
-                ma = MutationArgs(target)
-                foreach_arg(c, ma.collect)
-                if not ma.args:
-                    retry = True
-                    bailed = True
-                    break
-                idx = rng.intn(len(ma.args))
-                arg, ctx = ma.args[idx], ma.ctxes[idx]
-                calls, ok = mutate_arg(rng, s, arg, ctx, update_sizes)
-                if not ok:
-                    retry_arg = True
-                    continue
-                p.insert_before(c, calls)
-                if update_sizes[0]:
-                    assign_sizes_call(c)
-                target.sanitize_call(c)
-                stop_arg = rng.one_of(3)
-            if bailed:
-                continue
+            op, ok = "mutate_arg", _op_mutate_arg(p, rng, ct)
         else:
-            # Remove a random call.
-            if not p.calls:
-                retry = True
-                continue
-            p.remove_call(rng.intn(len(p.calls)))
+            op, ok = "remove", _op_remove(p, rng)
+        if not ok:
+            retry = True
+            continue
+        if ops_out is not None:
+            ops_out.append(op)
         stop = rng.one_of(3)
 
     for c in p.calls:
         target.sanitize_call(c)
+
+
+def _op_squash(p: Prog, rng: RandGen, ct) -> bool:
+    """Squash a complex pointee into an ANY blob and mutate raw bytes
+    (reference: prog/mutation.go:23-59)."""
+    from syzkaller_tpu.models.any_squash import complex_ptrs, squash_ptr, is_any_ptr
+
+    target = p.target
+    ptrs = complex_ptrs(p)
+    if not ptrs:
+        return False
+    ptr = ptrs[rng.intn(len(ptrs))]
+    if not is_any_ptr(target, ptr.typ):
+        squash_ptr(target, p, ptr, preserve_field=True)
+    blobs: list[DataArg] = []
+    bases: list[PointerArg] = []
+
+    def collect(arg, ctx) -> None:
+        if isinstance(arg, DataArg) and arg.typ.dir != Dir.OUT:
+            blobs.append(arg)
+            bases.append(ctx.base)
+
+    foreach_sub_arg(ptr, collect)
+    if not blobs:
+        return False
+    idx = rng.intn(len(blobs))
+    arg, base = blobs[idx], bases[idx]
+    base_size = base.res.size()
+    arg.data = bytearray(mutate_data(rng, arg.data, 0, MAX_BLOB_LEN))
+    # Update base pointer if the object grew.
+    if base_size < base.res.size():
+        s = analyze(ct, p, p.calls[0])
+        new_arg = alloc_addr(rng, s, base.typ, base.res.size(), base.res)
+        base.address = new_arg.address
+    return True
+
+
+def _op_splice(p: Prog, rng: RandGen, ncalls: int,
+               corpus: list[Prog]) -> bool:
+    """Splice a random corpus program in at a random position
+    (reference: prog/mutation.go:61-71)."""
+    if not corpus or not p.calls:
+        return False
+    p0 = corpus[rng.intn(len(corpus))]
+    p0c = p0.clone()
+    idx = rng.intn(len(p.calls))
+    p.calls = p.calls[:idx] + p0c.calls + p.calls[idx:]
+    for i in range(len(p.calls) - 1, ncalls - 1, -1):
+        p.remove_call(i)
+    return True
+
+
+def _op_insert(p: Prog, rng: RandGen, ncalls: int, ct) -> bool:
+    """Insert a generated call at a biased-random position
+    (reference: prog/mutation.go:73-95)."""
+    if len(p.calls) >= ncalls:
+        return False
+    idx = rng.biased_rand(len(p.calls) + 1, 5)
+    c = p.calls[idx] if idx < len(p.calls) else None
+    s = analyze(ct, p, c)
+    calls = generate_call(rng, s, p)
+    p.insert_before(c, calls)
+    return True
+
+
+def _op_mutate_arg(p: Prog, rng: RandGen, ct) -> bool:
+    """Mutate args of a random call, repeating until a 1/3 stop coin
+    (reference: prog/mutation.go:97-124)."""
+    target = p.target
+    if not p.calls:
+        return False
+    c = p.calls[rng.intn(len(p.calls))]
+    if not c.args:
+        return False
+    s = analyze(ct, p, c)
+    update_sizes = [True]
+    stop_arg = False
+    retry_arg = False
+    while not stop_arg or retry_arg:
+        retry_arg = False
+        ma = MutationArgs(target)
+        foreach_arg(c, ma.collect)
+        if not ma.args:
+            return False
+        idx = rng.intn(len(ma.args))
+        arg, ctx = ma.args[idx], ma.ctxes[idx]
+        calls, ok = mutate_arg(rng, s, arg, ctx, update_sizes)
+        if not ok:
+            retry_arg = True
+            continue
+        p.insert_before(c, calls)
+        if update_sizes[0]:
+            assign_sizes_call(c)
+        target.sanitize_call(c)
+        stop_arg = rng.one_of(3)
+    return True
+
+
+def _op_remove(p: Prog, rng: RandGen) -> bool:
+    """Remove a random call (reference: prog/mutation.go:126-131)."""
+    if not p.calls:
+        return False
+    p.remove_call(rng.intn(len(p.calls)))
+    return True
 
 
 class MutationArgs:

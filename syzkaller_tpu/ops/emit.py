@@ -239,6 +239,112 @@ def assemble_delta(et: ExecTemplate, batch, j: int) -> bytes:
     return _slice_alive(et, w, alive)
 
 
+def assemble_batch(ets: list, batch, js: np.ndarray) -> list:
+    """Assemble exec bytes for mutants `js` of a DeltaBatch in one
+    vectorized numpy pass per template group (the host-side hot path:
+    a Python-per-mutant loop here was 4x slower than the device kernel,
+    so value patches scatter across the whole group at once).
+
+    ets is the exec-template snapshot indexable by batch.template_idx.
+    Returns a list aligned with js; entries are bytes or None (missing
+    template / assembly failure)."""
+    out: list = [None] * len(js)
+    if len(js) == 0:
+        return out
+    js = np.asarray(js, dtype=np.int64)
+    tidx = batch.template_idx[js]
+    order = np.argsort(tidx, kind="stable")
+    bounds = np.flatnonzero(np.diff(tidx[order])) + 1
+    for grp in np.split(order, bounds):
+        ti = int(tidx[grp[0]])
+        et = ets[ti] if 0 <= ti < len(ets) else None
+        if et is None:
+            continue
+        rows = js[grp]
+        try:
+            datas = _assemble_group(et, batch, rows)
+        except Exception:
+            # Degrade to the per-mutant path so one bad row cannot
+            # sink its whole template group.
+            datas = []
+            for j in rows:
+                try:
+                    datas.append(assemble_delta(et, batch, int(j)))
+                except Exception:
+                    datas.append(None)
+        for pos, data in zip(grp, datas):
+            out[int(pos)] = data
+    return out
+
+
+def _assemble_group(et: ExecTemplate, batch, rows: np.ndarray) -> list:
+    """Vectorized assemble_delta over mutants `rows` sharing one
+    template: one (m, W) patch pass + per-row byte extraction."""
+    m = len(rows)
+    w = np.broadcast_to(et.words, (m, et.words.shape[0])).copy()
+
+    # -- value patches (vectorized scatter) --
+    slots = batch.val_idx[rows]  # (m, K) int16, -1 padded
+    valid = slots >= 0
+    s = np.where(valid, slots, 0).astype(np.int64)
+    vw = et.val_word[s]  # (m, K)
+    valid &= vw >= 0
+    vals = batch.vals[rows]  # (m, K) uint64
+    isp = et.is_proc[s]
+
+    r, c = np.nonzero(valid & ~isp)
+    if r.size:
+        w[r, vw[r, c]] = vals[r, c]
+
+    r, c = np.nonzero(valid & isp)
+    if r.size:
+        sv = s[r, c]
+        v = vals[r, c]
+        dflt = v == MASK64
+        with np.errstate(over="ignore"):
+            w[r, vw[r, c]] = np.where(dflt, np.uint64(0), et.aux0[sv] + v)
+        w[r, et.meta_word[sv]] = np.where(
+            dflt, et.proc_meta_default[sv], et.proc_meta_concrete[sv])
+
+    # -- data patches (len words vectorized; payload spans looped — a
+    # few variable-length memcpys per batch) --
+    dslots = batch.data_slot[rows]  # (m, D)
+    dvalid = dslots >= 0
+    if dvalid.any():
+        ds = np.where(dvalid, dslots, 0).astype(np.int64)
+        lw = et.len_word[ds]
+        dvalid &= lw >= 0
+        caps = et.data_cap[ds].astype(np.int64)
+        lens = np.minimum(batch.data_len[rows].astype(np.int64), caps)
+        r, c = np.nonzero(dvalid)
+        if r.size:
+            w[r, lw[r, c]] = (lens[r, c] | (caps[r, c] << 32)) \
+                .astype(np.uint64)
+            u8 = w.view(np.uint8).reshape(m, -1)
+            for i, j in zip(r, c):
+                sl = int(ds[i, j])
+                ln = int(lens[i, j])
+                cap = int(caps[i, j])
+                start = int(et.data_word[sl]) * 8
+                po = int(batch.data_off[rows[i], j])
+                u8[i, start:start + ln] = batch.payload[rows[i], po:po + ln]
+                u8[i, start + ln:start + cap + (-cap) % 8] = 0
+
+    # -- alive slicing --
+    nc = et.ncalls
+    full = np.uint64((1 << nc) - 1) if nc < 64 else np.uint64(2**64 - 1)
+    alive_bits = batch.alive_bits[rows] & full
+    datas: list = []
+    for i in range(m):
+        if alive_bits[i] == full:
+            datas.append(w[i].tobytes())
+        else:
+            alive = ((alive_bits[i] >> np.arange(
+                max(nc, 1), dtype=np.uint64)) & 1).astype(bool)
+            datas.append(_slice_alive(et, w[i], alive))
+    return datas
+
+
 def mutant_call_ids(et: ExecTemplate, call_alive: np.ndarray) -> list[int]:
     """Template call indices surviving in the mutant, in order — maps
     the executor's call_index back to template calls."""

@@ -1,0 +1,236 @@
+"""Batched comparison-hint engine on device (SURVEY.md §7.7).
+
+shrinkExpand (reference: prog/hints.go:164-218) is branchy but
+fixed-structure: 13 cast variants (widths 8/4/2/1 truncated, 4/2/1
+sign-extended, each little/big endian, minus the no-op 1-byte swap)
+per candidate value.  The CPU path walks them per arg byte-window; on
+device the whole call's candidate windows run as ONE vmap over a
+[B] value vector against the CompMap lowered to a sorted key array +
+padded value matrix (binary search via jnp.searchsorted).
+
+Parity contract: for every value, the (deduped, sorted) replacer set
+equals models.hints.shrink_expand exactly — tests/test_hints_device.py
+drives both on random CompMaps, and mutate_with_hints_device must
+yield byte-identical mutant programs in the same order as the CPU
+mutate_with_hints.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from syzkaller_tpu.models.hints import MAX_DATA_LENGTH, CompMap
+from syzkaller_tpu.models.rand import SPECIAL_INTS_SET
+from syzkaller_tpu.models.prog import Arg, ConstArg, DataArg, Prog, foreach_arg
+from syzkaller_tpu.models.types import CsumType, Dir, ProcType
+from syzkaller_tpu.utils.ints import MASK64 as MASK64_INT
+from syzkaller_tpu.utils.ints import load_int, store_int
+
+# Cast variants (width_bytes, sign_extend, big_endian), mirroring the
+# reference iteration order (prog/hints.go:173-186): positive widths
+# truncate, negative (here sign_extend=True) OR-in the high bits.
+VARIANTS: tuple[tuple[int, bool, bool], ...] = tuple(
+    (abs(w), w < 0, be)
+    for w in (8, 4, 2, 1, -4, -2, -1)
+    for be in (False, True)
+    if not (abs(w) == 1 and be))
+
+_SPECIAL_SORTED = np.array(sorted(SPECIAL_INTS_SET), dtype=np.uint64)
+
+
+class DeviceCompMap:
+    """A CompMap lowered to device arrays: sorted uint64 keys + a
+    [n, vmax] padded operand matrix (CSR with fixed row width; rows
+    overflowing vmax drop the tail — counted so callers can fall back
+    to the CPU path for exactness)."""
+
+    def __init__(self, keys: np.ndarray, vals: np.ndarray,
+                 nvals: np.ndarray, dropped: int):
+        self.keys = keys
+        self.vals = vals
+        self.nvals = nvals
+        self.dropped = dropped
+
+    @classmethod
+    def from_comp_map(cls, cm: CompMap, vmax: int = 16) -> "DeviceCompMap":
+        keys = np.array(sorted(cm.m.keys()), dtype=np.uint64)
+        n = len(keys)
+        vals = np.zeros((max(n, 1), vmax), dtype=np.uint64)
+        nvals = np.zeros(max(n, 1), dtype=np.int32)
+        dropped = 0
+        for i, k in enumerate(keys):
+            vs = sorted(cm.m[int(k)])
+            if len(vs) > vmax:
+                dropped += len(vs) - vmax
+                vs = vs[:vmax]
+            vals[i, :len(vs)] = vs
+            nvals[i] = len(vs)
+        return cls(keys, vals, nvals, dropped)
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+
+def _swap_const(v, width: int):
+    """Byte-swap the low `width` (static) bytes of a uint64."""
+    import jax.numpy as jnp
+
+    U64 = jnp.uint64
+    if width == 1:
+        return v & U64(0xFF)
+    out = U64(0)
+    for i in range(width):
+        byte = (v >> U64(8 * (width - 1 - i))) & U64(0xFF)
+        out = out | (byte << U64(8 * i))
+    return out
+
+
+def make_shrink_expand(dmap: DeviceCompMap):
+    """Build the jitted batched kernel:
+    vals[B] -> (replacers[B, NV, vmax], valid[B, NV, vmax])
+    where NV = len(VARIANTS)."""
+    import jax
+    import jax.numpy as jnp
+
+    U64 = jnp.uint64
+    MASK64 = U64(0xFFFFFFFFFFFFFFFF)
+    keys = jnp.asarray(dmap.keys)
+    vmat = jnp.asarray(dmap.vals)
+    nvals = jnp.asarray(dmap.nvals)
+    special = jnp.asarray(_SPECIAL_SORTED)
+    n = len(dmap.keys)
+    vmax = dmap.vals.shape[1]
+
+    def is_special(x):
+        i = jnp.searchsorted(special, x)
+        i = jnp.minimum(i, len(_SPECIAL_SORTED) - 1)
+        return special[i] == x
+
+    def one(v):
+        reps = []
+        oks = []
+        for width, sext, be in VARIANTS:
+            size = width * 8
+            mask = U64((1 << size) - 1) if size < 64 else MASK64
+            inv = (~mask) & MASK64
+            if sext:
+                mutant = (v | inv) & MASK64
+            else:
+                mutant = v & mask
+            if be:
+                mutant = _swap_const(mutant, width)
+            if n == 0:
+                reps.append(jnp.zeros(vmax, U64))
+                oks.append(jnp.zeros(vmax, jnp.bool_))
+                continue
+            i = jnp.minimum(jnp.searchsorted(keys, mutant), n - 1)
+            found = keys[i] == mutant
+            row = vmat[i]
+            row_ok = (jnp.arange(vmax) < nvals[i]) & found
+            new_hi = row & inv
+            # The other operand wider than the cast value is dead code
+            # unless it is the sign extension (hints.go:199-204).
+            ok_hi = (new_hi == U64(0)) | (new_hi == inv)
+            nv = row & mask
+            if be:
+                nv = jax.vmap(lambda x: _swap_const(x, width))(nv)
+            ok = row_ok & ok_hi & ~jax.vmap(is_special)(nv)
+            reps.append(((v & inv) | nv) & MASK64)
+            oks.append(ok)
+        return jnp.stack(reps), jnp.stack(oks)
+
+    return jax.jit(jax.vmap(one))
+
+
+def shrink_expand_batch(vals: np.ndarray,
+                        dmap: DeviceCompMap) -> list[list[int]]:
+    """Batched shrink_expand: one device call for all candidate
+    values; returns per-value sorted deduped replacer lists (the same
+    sets models.hints.shrink_expand yields)."""
+    if len(vals) == 0:
+        return []
+    kernel = make_shrink_expand(dmap)
+    import jax.numpy as jnp
+
+    reps, oks = kernel(jnp.asarray(vals.astype(np.uint64)))
+    reps = np.asarray(reps).reshape(len(vals), -1)
+    oks = np.asarray(oks).reshape(len(vals), -1)
+    out = []
+    for j in range(len(vals)):
+        out.append(sorted(set(reps[j][oks[j]].tolist())))
+    return out
+
+
+def mutate_with_hints_device(p: Prog, call_index: int, comps: CompMap,
+                             exec_cb: Callable[[Prog], None],
+                             vmax: int = 16) -> None:
+    """Device-batched equivalent of models.hints.mutate_with_hints:
+    collect every candidate window of the call into one value vector,
+    run shrink_expand as one vmap'd kernel, then apply replacements in
+    the CPU path's exact order (reference: prog/hints.go:66-132).
+
+    Falls back to exact CPU semantics when the CompMap overflows the
+    per-key operand budget (dropped > 0)."""
+    dmap = DeviceCompMap.from_comp_map(comps, vmax=vmax)
+    if dmap.dropped > 0:
+        from syzkaller_tpu.models.hints import mutate_with_hints
+
+        mutate_with_hints(p, call_index, comps, exec_cb)
+        return
+
+    p = p.clone()
+    c = p.calls[call_index]
+
+    # Pass 1: collect candidate windows in traversal order.
+    jobs: list[tuple[Arg, int, int]] = []  # (arg, window_off, window)
+    vals: list[int] = []
+
+    def collect(arg: Arg, ctx) -> None:
+        typ = arg.typ
+        if typ is None or typ.dir == Dir.OUT:
+            return
+        if isinstance(typ, (ProcType, CsumType)):
+            return
+        if isinstance(arg, ConstArg):
+            jobs.append((arg, -1, 0))
+            vals.append(arg.val & MASK64_INT)
+        elif isinstance(arg, DataArg):
+            data = arg.data
+            size = min(len(data), MAX_DATA_LENGTH)
+            for i in range(size):
+                window = min(8, len(data) - i)
+                buf = bytes(data[i:i + 8]).ljust(8, b"\x00")
+                jobs.append((arg, i, window))
+                vals.append(load_int(buf, 0, 8))
+
+    foreach_arg(c, collect)
+    if not jobs:
+        return
+
+    replacer_lists = shrink_expand_batch(np.array(vals, dtype=np.uint64),
+                                         dmap)
+
+    # Pass 2: apply mutants in CPU order (one exec per replacer).
+    from syzkaller_tpu.models import validation
+
+    def run() -> None:
+        if validation.debug:
+            validation.validate_prog(p)
+        exec_cb(p)
+
+    for (arg, off, window), replacers in zip(jobs, replacer_lists):
+        if isinstance(arg, ConstArg):
+            original = arg.val
+            for r in replacers:
+                arg.val = r
+                run()
+            arg.val = original
+        else:
+            data = arg.data
+            original = bytes(data[off:off + 8]).ljust(8, b"\x00")
+            for r in replacers:
+                store_int(data, off, r, window)
+                run()
+            data[off:off + window] = original[:window]

@@ -5,7 +5,7 @@ The vmap'd equivalent of the reference's per-program mutation loop
 The device owns the high-volume ops — argument value mutation (int/
 flags/proc/len), the 7-op byte-level data engine, and call removal;
 structural tree ops (call insertion, corpus splice, ANY-squash) are
-host-side and composed by engine.Engine, which routes each program by
+host-side and routed by fuzzer.proc.PipelineMutator, which draws
 a host-sampled op class so the overall op distribution matches the
 reference's weights.
 
@@ -402,20 +402,32 @@ def _mutate_one(state, key, flag_vals, flag_counts, rounds):
     # instead of full rows over the (slow) host link (ops/delta.py).
     state["touched"] = jnp.zeros(state["kind"].shape[0], dtype=jnp.bool_)
 
+    # The loop carries ONLY the mutable leaves (~3.7 KB: val, arena,
+    # len_, call_alive, journals) — carrying the full state dict would
+    # stream the immutable ~8 KB (kind/aux/off/cap/...) through HBM
+    # every round and select over it for nothing.
+    mut_keys = ("val", "arena", "len_", "call_alive",
+                "preserve_sizes", "touched")
+
     def body(i, carry):
-        state, active = carry
+        st = dict(state)
+        st.update(zip(mut_keys, carry[0]))
+        active = carry[1]
         kk = random.fold_in(key, i)
         k_op, k_do, k_stop = random.split(kk, 3)
         do_remove = d.n_out_of(k_op, 1, 11)
-        mutated = _mutate_slot(k_do, state, flag_vals, flag_counts)
-        removed = _remove_call(k_do, state)
+        mutated = _mutate_slot(k_do, st, flag_vals, flag_counts)
+        removed = _remove_call(k_do, st)
         pick = lambda a, b, c: jnp.where(
             active, jnp.where(do_remove, b, a), c)
-        new_state = jax.tree_util.tree_map(pick, mutated, removed, state)
+        new_mut = tuple(pick(mutated[k], removed[k], st[k])
+                        for k in mut_keys)
         active = active & ~d.one_of(k_stop, 3)
-        return new_state, active
+        return new_mut, active
 
-    state, _ = lax.fori_loop(0, rounds, body, (state, jnp.bool_(True)))
+    carry0 = tuple(state[k] for k in mut_keys)
+    carry, _ = lax.fori_loop(0, rounds, body, (carry0, jnp.bool_(True)))
+    state.update(zip(mut_keys, carry))
     return _fixup_lens(state)
 
 

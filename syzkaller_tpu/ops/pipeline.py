@@ -18,25 +18,32 @@ on varying shapes, and decoded every mutant back to a typed tree
     SURVEY.md §7 hard part (c)).
 
 Structural ops the device cannot express (squash/splice/insert) stay
-host-side; callers route a host_fraction of mutations through the CPU
-mutator to keep the reference op distribution
-(reference: prog/mutation.go:19-131).
+host-side: fuzzer.proc.PipelineMutator draws the reference op ladder
+per mutant and routes the device classes (~28% of iterations:
+arg-mutate + remove) here, so the integrated op distribution matches
+the reference weighted loop (reference: prog/mutation.go:19-131).
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
 
 from syzkaller_tpu.models.prog import Prog
-from syzkaller_tpu.ops.delta import DeltaBatch, DeltaSpec, make_packer
+from syzkaller_tpu.ops.delta import (
+    FLAG_OVERFLOW,
+    DeltaBatch,
+    DeltaSpec,
+    make_packer,
+)
 from syzkaller_tpu.ops.emit import (
     ExecTemplate,
-    assemble_delta,
+    assemble_batch,
     build_exec_template,
     mutant_call_ids,
 )
@@ -50,8 +57,9 @@ from syzkaller_tpu.ops.tensor import (
 
 # Fraction of reference mutation iterations whose op class the device
 # kernels cannot express (squash 1/5, splice 1/100 of the rest, insert
-# 20/31 of the rest): callers send this fraction through the host
-# structural mutator (reference weights: prog/mutation.go:19-131).
+# 20/31 of the rest); the complement routes to the device.  Used by
+# tests/bench to reason about the integrated throughput mix
+# (reference weights: prog/mutation.go:19-131).
 P_HOST_STRUCTURAL = 0.2 + 0.8 * (1 / 100) + 0.8 * (99 / 100) * (20 / 31)
 
 
@@ -98,6 +106,16 @@ class ExecMutant:
             return False
         return bool(self.et.calls_any[cm[call_index]])
 
+    def signal_prio(self, errno: int, call_index: int) -> int:
+        """Edge priority for an executed mutant call, computed without
+        typed decode (reference: syz-fuzzer/fuzzer.go:513-521)."""
+        prio = 0
+        if errno == 0:
+            prio |= 1 << 1
+        if not self.contains_any_call(call_index):
+            prio |= 1 << 0
+        return prio
+
     def prog(self) -> Prog:
         """Decode to a typed program (cached; reference semantics:
         ops/tensor.decode_prog)."""
@@ -132,8 +150,7 @@ class DevicePipeline:
     def __init__(self, target, cfg: Optional[TensorConfig] = None,
                  capacity: int = 2048, batch_size: int = 512,
                  rounds: int = 4, seed: int = 0, prefetch: int = 2,
-                 spec: Optional[DeltaSpec] = None,
-                 host_fraction: float = P_HOST_STRUCTURAL):
+                 spec: Optional[DeltaSpec] = None):
         import jax
         import jax.numpy as jnp
         from jax import random
@@ -149,7 +166,6 @@ class DevicePipeline:
         self.flags = FlagTables.empty()
         self.capacity = capacity
         self.batch_size = batch_size
-        self.host_fraction = host_fraction
         self.stats = PipelineStats()
 
         self._lock = threading.Lock()
@@ -273,29 +289,34 @@ class DevicePipeline:
         self._key, sub = self._random.split(self._key)
         fv, fc = self._flags_dev
         rows_dev = self._step(corpus, n, sub, fv, fc)
+        # Start the device->host copy now: the tunneled link has a
+        # ~70 ms per-sync fixed cost that fully hides behind the next
+        # batch's compute (the worker dispatches N+1 before draining N).
+        try:
+            rows_dev.copy_to_host_async()
+        except Exception:
+            pass  # CPU arrays in tests have no async path
         return rows_dev, tmpl, ets
 
     def _drain(self, launched) -> list[ExecMutant]:
         rows_dev, tmpl, ets = launched
         buf = np.asarray(rows_dev)  # the one device->host transfer
         batch = DeltaBatch(buf, self.spec)
+        ok = (batch.flags & FLAG_OVERFLOW) == 0
+        self.stats.overflows += int(np.count_nonzero(~ok))
+        ok &= (batch.template_idx >= 0) & (batch.template_idx < len(tmpl))
+        js = np.flatnonzero(ok)
+        datas = assemble_batch(ets, batch, js)
         out: list[ExecMutant] = []
-        for j in range(len(batch)):
-            if batch.overflowed(j):
-                self.stats.overflows += 1
-                continue
-            i = int(batch.template_idx[j])
-            if not (0 <= i < len(tmpl)):
-                continue
-            t, et = tmpl[i], ets[i]
-            if t is None or et is None:
-                continue
-            try:
-                data = assemble_delta(et, batch, j)
-            except Exception:
+        for j, data in zip(js, datas):
+            if data is None:
                 self.stats.assemble_errors += 1
                 continue
-            out.append(ExecMutant(data, t, et, batch, j))
+            i = int(batch.template_idx[j])
+            t = tmpl[i]
+            if t is None:
+                continue
+            out.append(ExecMutant(data, t, ets[i], batch, int(j)))
         self.stats.batches += 1
         self.stats.mutants += len(out)
         return out
@@ -327,7 +348,9 @@ class DevicePipeline:
 
     def stop(self) -> None:
         """Stop the worker and join it: a daemon thread killed inside
-        an XLA dispatch aborts the process at interpreter exit."""
+        an XLA dispatch aborts the process at interpreter exit.
+        Consumers blocked in next()/next_batch() wake within their
+        poll interval and see queue.Empty/None."""
         self._stop.set()
         if self._started:
             # Unblock a worker stuck on a full queue.
@@ -339,9 +362,23 @@ class DevicePipeline:
             self._worker.join(timeout=30)
 
     def next_batch(self, timeout: Optional[float] = None) -> list[ExecMutant]:
-        """One assembled batch (blocks until the worker produces one)."""
+        """One assembled batch (blocks until the worker produces one,
+        the timeout expires, or the pipeline is stopped — the last two
+        raise queue.Empty)."""
         self.start()
-        return self._queue.get(timeout=timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._stop.is_set():
+                raise queue.Empty
+            wait = 0.2
+            if deadline is not None:
+                wait = min(wait, deadline - time.monotonic())
+                if wait <= 0:
+                    raise queue.Empty
+            try:
+                return self._queue.get(timeout=wait)
+            except queue.Empty:
+                continue
 
     def next(self, timeout: float = 10.0) -> Optional[ExecMutant]:
         """Single-mutant convenience used by proc loops."""

@@ -32,12 +32,12 @@ def main(argv=None) -> int:
 
     target = get_target(args.target_os, args.arch)
     fuzzer = Fuzzer(target, WorkQueue(), cfg=FuzzerConfig())
-    batch_mutator = None
+    mutator = None
     if args.engine == "jax":
-        from syzkaller_tpu.engine import TpuEngine
-        from syzkaller_tpu.fuzzer.proc import BatchMutator
+        from syzkaller_tpu.fuzzer.proc import PipelineMutator
+        from syzkaller_tpu.ops.pipeline import DevicePipeline
 
-        batch_mutator = BatchMutator(TpuEngine(target))
+        mutator = PipelineMutator(DevicePipeline(target))
 
     import threading
 
@@ -46,7 +46,8 @@ def main(argv=None) -> int:
     threads = []
     for pid in range(args.procs):
         proc = Proc(fuzzer, pid, make_env(pid),
-                    batch_mutator=batch_mutator)
+                    mutator=mutator,
+                    device_hints=args.engine == "jax")
         procs.append(proc)
         t = threading.Thread(target=proc.loop, args=(1 << 62,),
                              kwargs={"stop": stop}, daemon=True)
@@ -65,6 +66,8 @@ def main(argv=None) -> int:
             last = execs
     finally:
         stop.set()
+        if mutator is not None:
+            mutator.pipeline.stop()
         for t in threads:
             t.join(timeout=5)
         for proc in procs:

@@ -126,3 +126,32 @@ def test_assembly_call_removal(test_target):
 def test_parse_stream_rejects_garbage():
     with pytest.raises(ValueError):
         parse_stream(b"\x07\x00\x00\x00\x00\x00\x00\x00" * 3)
+
+
+def test_assemble_batch_matches_assemble_delta(test_target):
+    """The vectorized group assembler is bit-identical to the
+    per-mutant delta assembler over a full device batch."""
+    from syzkaller_tpu.ops.delta import FLAG_OVERFLOW, DeltaBatch
+    from syzkaller_tpu.ops.emit import assemble_batch, assemble_delta
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
+
+    pl = DevicePipeline(test_target, capacity=32, batch_size=64, seed=11)
+    added, i = 0, 0
+    while added < 10 and i < 60:
+        p = generate_prog(test_target, RandGen(test_target, 4000 + i), 6)
+        i += 1
+        if pl.add(p):
+            added += 1
+    assert added >= 5
+    rows_dev, tmpl, ets = pl._launch()
+    buf = np.asarray(rows_dev)
+    batch = DeltaBatch(buf, pl.spec)
+    ok = (batch.flags & FLAG_OVERFLOW) == 0
+    ok &= (batch.template_idx >= 0) & (batch.template_idx < len(tmpl))
+    js = np.flatnonzero(ok)
+    assert js.size >= 32
+    datas = assemble_batch(ets, batch, js)
+    for j, got in zip(js, datas):
+        et = ets[int(batch.template_idx[j])]
+        want = assemble_delta(et, batch, int(j))
+        assert got == want, f"mutant {j} diverged from the delta oracle"

@@ -1,0 +1,151 @@
+"""Device hints engine parity (SURVEY.md §7.7).
+
+The batched shrinkExpand kernel must agree EXACTLY with the CPU
+semantics engine (models/hints.py) — same replacer sets per value,
+and byte-identical mutant programs in the same order when driving a
+whole call (the reference golden strategy: prog/hints_test.go:216+).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from syzkaller_tpu.models.encoding import serialize_prog  # noqa: E402
+from syzkaller_tpu.models.generation import generate_prog  # noqa: E402
+from syzkaller_tpu.models.hints import (  # noqa: E402
+    CompMap,
+    mutate_with_hints,
+    shrink_expand,
+)
+from syzkaller_tpu.models.rand import SPECIAL_INTS  # noqa: E402
+from syzkaller_tpu.models.rand import RandGen  # noqa: E402
+from syzkaller_tpu.ops.hints import (  # noqa: E402
+    DeviceCompMap,
+    mutate_with_hints_device,
+    shrink_expand_batch,
+)
+
+
+def _random_comp_map(rs: np.random.RandomState, nkeys: int,
+                     vals_per_key: int = 4) -> CompMap:
+    cm = CompMap()
+    pool = [int(rs.randint(0, 1 << 62)), 0, 1, 0xFF, 0xFFFF,
+            0xFFFFFFFF, (1 << 64) - 1, 0x8000000000000000,
+            int(SPECIAL_INTS[rs.randint(len(SPECIAL_INTS))])]
+    for _ in range(nkeys):
+        k = int(pool[rs.randint(len(pool))]) if rs.rand() < 0.3 \
+            else int(rs.randint(0, 1 << 62))
+        for _ in range(rs.randint(1, vals_per_key + 1)):
+            v = int(pool[rs.randint(len(pool))]) if rs.rand() < 0.4 \
+                else int(rs.randint(0, 1 << 62))
+            cm.add_comp(k, v)
+    return cm
+
+
+def test_shrink_expand_parity_random():
+    rs = np.random.RandomState(7)
+    for it in range(30):
+        cm = _random_comp_map(rs, nkeys=rs.randint(1, 12))
+        dmap = DeviceCompMap.from_comp_map(cm)
+        assert dmap.dropped == 0
+        # Values: random, plus exact keys (hit path), plus truncations.
+        vals = [int(rs.randint(0, 1 << 62)) for _ in range(6)]
+        vals += [int(k) for k in list(cm.m.keys())[:6]]
+        vals += [v | (0xDEAD << 48) for v in vals[:4]]
+        got = shrink_expand_batch(np.array(vals, dtype=np.uint64), dmap)
+        for v, g in zip(vals, got):
+            want = sorted(shrink_expand(v & ((1 << 64) - 1), cm))
+            assert g == want, (
+                f"iter {it}: value 0x{v:x}: device {g} != cpu {want}")
+
+
+def test_shrink_expand_parity_sign_extension():
+    """The sign-extension variants (negative widths) and the wide-hi
+    filter (hints.go:199-204) must agree on crafted cases."""
+    cm = CompMap()
+    # Key = sign-extended 0xFF (8-bit -1): matches iwidth=-1 path.
+    cm.add_comp((1 << 64) - 1, 0x1234)
+    # Key = 16-bit truncation.
+    cm.add_comp(0xBEEF, 0xC0DE)
+    # Wide operand vs narrow cast: must be filtered unless signext.
+    cm.add_comp(0x42, 0xFFFF_FFFF_FFFF_FF80)
+    dmap = DeviceCompMap.from_comp_map(cm)
+    vals = np.array([0xFF, 0xABCD_BEEF, 0x42, 0xFFFF_FFFF_FFFF_FFFF],
+                    dtype=np.uint64)
+    got = shrink_expand_batch(vals, dmap)
+    for v, g in zip(vals.tolist(), got):
+        assert g == sorted(shrink_expand(v, cm))
+
+
+def test_mutate_with_hints_device_matches_cpu(test_target):
+    """Whole-call parity: identical mutant sequence from both engines."""
+    rs = np.random.RandomState(3)
+    checked = 0
+    for seed in range(40):
+        p = generate_prog(test_target, RandGen(test_target, 500 + seed), 3)
+        cm = _random_comp_map(rs, nkeys=6)
+        # Make hits likely: compare some actual arg values.
+        from syzkaller_tpu.models.prog import ConstArg, foreach_arg
+
+        def harvest(arg, ctx):
+            if isinstance(arg, ConstArg) and arg.typ is not None:
+                cm.add_comp(arg.val, int(rs.randint(1, 1 << 32)))
+
+        for c in p.calls:
+            foreach_arg(c, harvest)
+
+        for ci in range(len(p.calls)):
+            cpu_out: list[bytes] = []
+            dev_out: list[bytes] = []
+            mutate_with_hints(p, ci, cm,
+                              lambda m: cpu_out.append(serialize_prog(m)))
+            mutate_with_hints_device(
+                p, ci, cm, lambda m: dev_out.append(serialize_prog(m)))
+            assert dev_out == cpu_out, f"seed {seed} call {ci}"
+            checked += len(cpu_out)
+    assert checked > 50, "parity never exercised a real mutant"
+
+
+def test_device_comp_map_overflow_falls_back(test_target):
+    """A CompMap overflowing the per-key budget must still produce the
+    exact CPU mutant sequence (fallback path)."""
+    cm = CompMap()
+    for i in range(40):  # one key, 40 operands > vmax=16
+        cm.add_comp(0x1234, 0x1000 + i)
+    dmap = DeviceCompMap.from_comp_map(cm)
+    assert dmap.dropped > 0
+    p = generate_prog(test_target, RandGen(test_target, 9), 2)
+    cpu_out: list[bytes] = []
+    dev_out: list[bytes] = []
+    mutate_with_hints(p, 0, cm, lambda m: cpu_out.append(serialize_prog(m)))
+    mutate_with_hints_device(p, 0, cm,
+                             lambda m: dev_out.append(serialize_prog(m)))
+    assert dev_out == cpu_out
+
+
+def test_smash_hint_pass_drains_device_batch(test_target):
+    """End-to-end: a Proc with device_hints collects comps from the
+    sim executor and executes device-produced hint mutants."""
+    from syzkaller_tpu.fuzzer import Fuzzer, FuzzerConfig, WorkQueue
+    from syzkaller_tpu.fuzzer.fuzzer import Stat
+    from syzkaller_tpu.fuzzer.proc import Proc
+    from syzkaller_tpu.ipc.env import make_env
+
+    env = make_env(pid=0, sim=True, signal=True)
+    try:
+        fuzzer = Fuzzer(test_target, wq=WorkQueue(),
+                        cfg=FuzzerConfig(minimize_attempts=1))
+        proc = Proc(fuzzer, pid=0, env=env, device_hints=True)
+        ran = 0
+        for seed in range(30):
+            p = generate_prog(test_target, RandGen(test_target, seed), 4)
+            for ci in range(len(p.calls)):
+                proc.execute_hint_seed(p, ci)
+            hints = fuzzer.stats[Stat.HINT]
+            if hints > 0:
+                ran = hints
+                break
+        assert ran > 0, "no hint mutants executed via the device engine"
+    finally:
+        env.close()

@@ -52,21 +52,26 @@ def test_sharded_step_matches_single_device(built, cov):
     assert changed
 
 
-def test_engine_mutates_mixed(test_target):
-    from syzkaller_tpu.engine import TpuEngine
+def test_pipeline_mutants_decode_valid(test_target):
+    """Device pipeline mutants decode into structurally valid typed
+    programs (the triage-path decode)."""
     from syzkaller_tpu.models.generation import generate_prog
     from syzkaller_tpu.models.rand import RandGen
     from syzkaller_tpu.models.validation import validate_prog
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
 
-    eng = TpuEngine(test_target, seed=3)
-    corpus = [generate_prog(test_target, RandGen(test_target, i), 8)
-              for i in range(12)]
-    templates = [t for t in (eng.encode(p) for p in corpus) if t is not None]
-    assert len(templates) >= 10
-    out = eng.mutate(templates, corpus=corpus)
-    assert len(out) == len(templates)
-    for p in out:
-        validate_prog(p)
-    assert eng.stats.device_mutations > 0
-    assert eng.stats.host_mutations > 0
-    assert eng.stats.decode_failures == 0
+    pl = DevicePipeline(test_target, capacity=32, batch_size=16, seed=3)
+    added, i = 0, 0
+    while added < 10 and i < 60:
+        p = generate_prog(test_target, RandGen(test_target, i), 8)
+        i += 1
+        if pl.add(p):
+            added += 1
+    assert added >= 8
+    try:
+        batch = pl.next_batch(timeout=120)
+        assert len(batch) >= 8
+        for m in batch:
+            validate_prog(m.prog())
+    finally:
+        pl.stop()
