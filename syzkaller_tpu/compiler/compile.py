@@ -350,6 +350,10 @@ class Compiler:
                 return B.string(fname)
             if fname not in self.intflags:
                 return err(f"unknown flags {fname!r}")
+            if not self.intflags[fname].values:
+                # Every member const was undefined on this arch
+                # (dropped by patch_consts): disable dependent calls.
+                raise UnresolvedConst(f"flags {fname} (no defined values)")
             return B.flags(fname, size=size, be=be, bits=bits)
 
         if name in ("len", "bytesize", "bitsize") or \

@@ -199,6 +199,11 @@ def register_lazy_target(os: str, arch: str, factory) -> None:
     _lazy_targets[key] = factory
 
 
+def is_registered(os: str, arch: str) -> bool:
+    key = f"{os}/{arch}"
+    return key in _targets or key in _lazy_targets
+
+
 def get_target(os: str, arch: str) -> Target:
     key = f"{os}/{arch}"
     t = _targets.get(key)

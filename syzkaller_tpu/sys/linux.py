@@ -1,160 +1,102 @@
-"""linux/amd64 description model (growing subset).
+"""linux/amd64 target: syzlang descriptions + arch hooks.
 
-The reference describes the full Linux interface in 60+ syzlang files
-(reference: sys/linux/*.txt).  We start from the core file/memory/net
-surface — enough to drive a real executor end-to-end — and grow the
-model over time; descriptions use real amd64 syscall numbers.
-
-Arch hooks follow the reference's linux init
-(reference: sys/linux/init.go:40-149): mmap call factory and call
-sanitization neutralizing dangerous arguments.
+The syscall surface is compiled from sys/descriptions/linux/*.txt
+with values from linux_amd64.const (produced by sys/extract against
+host headers — the `make extract` step).  This module is the arch-hook
+layer the reference keeps in sys/linux/init.go:40-149: the mmap call
+factory, call sanitization that neutralizes dangerous arguments, and
+the string dictionary for buffer generation.
 """
 
 from __future__ import annotations
 
-from syzkaller_tpu.models.prog import Call, ConstArg, PointerArg, make_return_arg
-from syzkaller_tpu.models.types import Dir
-from syzkaller_tpu.sys.builder import (
-    TargetBuilder,
-    array,
-    buffer,
-    bytesize_of,
-    const,
-    filename,
-    flags,
-    int16,
-    int32,
-    int64,
-    intptr,
-    len_of,
-    opt,
-    proc,
-    ptr,
-    res,
-    string,
-    vma,
+from pathlib import Path
+
+from syzkaller_tpu.models.prog import (
+    Call,
+    ConstArg,
+    PointerArg,
+    make_return_arg,
 )
-
-# Constants extracted from the kernel ABI (values are part of the ABI,
-# cf. the reference's .const files produced by syz-extract).
-PROT_READ, PROT_WRITE, PROT_EXEC = 1, 2, 4
-MAP_PRIVATE, MAP_ANONYMOUS, MAP_FIXED = 0x2, 0x20, 0x10
-O_RDONLY, O_WRONLY, O_RDWR, O_CREAT, O_TRUNC, O_APPEND, O_NONBLOCK = (
-    0, 1, 2, 0o100, 0o1000, 0o2000, 0o4000)
-AF_UNIX, AF_INET, AF_INET6, AF_NETLINK = 1, 2, 10, 16
-SOCK_STREAM, SOCK_DGRAM, SOCK_RAW, SOCK_SEQPACKET = 1, 2, 3, 5
-SIGKILL = 9
+from syzkaller_tpu.models.target import Target, register_lazy_target
 
 
-def build_linux_target(register: bool = True):
-    b = TargetBuilder(os="linux", arch="amd64", ptr_size=8, page_size=4096,
-                      num_pages=4096)
-    b.string_dictionary = ["/dev/null", "/proc/self", "lo", "eth0", "sit0"]
+def _load_consts() -> dict[str, int]:
+    from syzkaller_tpu.compiler.consts import load_const_files
+    from syzkaller_tpu.sys.sysgen import DESC_ROOT
 
-    b.flag_set("mmap_prot", PROT_READ, PROT_WRITE, PROT_EXEC)
-    b.flag_set("mmap_flags", MAP_PRIVATE, MAP_ANONYMOUS, MAP_FIXED)
-    b.flag_set("open_flags", O_RDONLY, O_WRONLY, O_RDWR, O_CREAT, O_TRUNC,
-               O_APPEND, O_NONBLOCK)
-    b.flag_set("socket_domain", AF_UNIX, AF_INET, AF_INET6, AF_NETLINK)
-    b.flag_set("socket_type", SOCK_STREAM, SOCK_DGRAM, SOCK_RAW, SOCK_SEQPACKET)
+    return load_const_files(
+        str(p) for p in sorted((DESC_ROOT / "linux").glob("*_amd64.const")))
 
-    b.resource("fd", 4, values=(0xFFFFFFFFFFFFFFFF,))
-    b.resource("sock", 4, values=(0xFFFFFFFFFFFFFFFF,), parent="fd")
-    b.resource("pid", 4, values=(0,))
 
-    # mmap is syscall 0 in the table (make_mmap depends on this
-    # builder convention; the wire NR is the real one).
-    b.syscall("mmap", [
-        ("addr", vma()), ("len", len_of("addr")),
-        ("prot", flags("mmap_prot")), ("flags", flags("mmap_flags")),
-        ("fd", const(0xFFFFFFFFFFFFFFFF, 4)), ("offset", const(0, 8)),
-    ], nr=9)
-    b.syscall("open", [
-        ("file", ptr(Dir.IN, filename())), ("flags", flags("open_flags")),
-        ("mode", const(0o644, 4)),
-    ], ret="fd", nr=2)
-    b.syscall("openat", [
-        ("fd", const(0xFFFFFFFFFFFFFF9C, 4)),  # AT_FDCWD
-        ("file", ptr(Dir.IN, filename())), ("flags", flags("open_flags")),
-        ("mode", const(0o644, 4)),
-    ], ret="fd", nr=257)
-    b.syscall("close", [("fd", res("fd"))], nr=3)
-    b.syscall("read", [
-        ("fd", res("fd")), ("buf", ptr(Dir.OUT, buffer())),
-        ("count", len_of("buf")),
-    ], nr=0)
-    b.syscall("write", [
-        ("fd", res("fd")), ("buf", ptr(Dir.IN, buffer())),
-        ("count", bytesize_of("buf")),
-    ], nr=1)
-    b.syscall("lseek", [
-        ("fd", res("fd")), ("offset", intptr(fileoff=True)),
-        ("whence", flags("seek_whence", 4)),
-    ], nr=8)
-    b.flag_set("seek_whence", 0, 1, 2)
-    b.syscall("dup", [("oldfd", res("fd"))], ret="fd", nr=32)
-    b.syscall("dup2", [("oldfd", res("fd")), ("newfd", res("fd"))],
-              ret="fd", nr=33)
-    b.syscall("pipe", [("pipefd", ptr(Dir.OUT, "pipe_fds"))], nr=22)
-    b.struct("pipe_fds", [("rfd", res("fd")), ("wfd", res("fd"))])
-    b.syscall("socket", [
-        ("domain", flags("socket_domain", 4)), ("type", flags("socket_type", 4)),
-        ("proto", const(0, 4)),
-    ], ret="sock", nr=41)
-    b.struct("sockaddr_un", [
-        ("family", const(AF_UNIX, 2)),
-        ("path", filename(size=108)),
-    ], packed=True)
-    b.syscall("bind", [
-        ("fd", res("sock")), ("addr", ptr(Dir.IN, "sockaddr_un")),
-        ("addrlen", bytesize_of("addr", 4)),
-    ], nr=49)
-    b.syscall("listen", [("fd", res("sock")), ("backlog", int32())], nr=50)
-    b.syscall("getpid", [], ret="pid", nr=39)
-    b.syscall("kill", [("pid", res("pid")), ("sig", const(0, 4))], nr=62)
-    b.syscall("munmap", [("addr", vma()), ("len", len_of("addr"))], nr=11)
-    b.syscall("mprotect", [
-        ("addr", vma()), ("len", len_of("addr")), ("prot", flags("mmap_prot")),
-    ], nr=10)
-    b.syscall("ioctl", [
-        ("fd", res("fd")), ("cmd", intptr()), ("arg", opt(intptr())),
-    ], nr=16)
-    b.syscall("fcntl", [
-        ("fd", res("fd")), ("cmd", int32(range=(0, 16))), ("arg", opt(intptr())),
-    ], nr=72)
-    b.syscall("fsync", [("fd", res("fd"))], nr=74)
-    b.syscall("ftruncate", [("fd", res("fd")), ("len", intptr(fileoff=True))],
-              nr=77)
-    b.syscall("unlink", [("file", ptr(Dir.IN, filename()))], nr=87)
-    b.syscall("mkdir", [
-        ("file", ptr(Dir.IN, filename())), ("mode", const(0o755, 4)),
-    ], nr=83)
+def build_linux_target(register: bool = False) -> Target:
+    from syzkaller_tpu.models.target import register_target
+    from syzkaller_tpu.sys.sysgen import compile_os
+
+    res = compile_os("linux", "amd64", register=False)
+    t = res.target
+    _attach_arch_hooks(t, _load_consts())
+    if register:
+        register_target(t)
+    return t
+
+
+def _attach_arch_hooks(t: Target, k: dict[str, int]) -> None:
+    t.string_dictionary = [
+        "/dev/null", "/dev/zero", "/dev/full", "/proc/self/exe",
+        "/proc/self/fd", "lo", "eth0", "sit0", "syz_tun", "./file0",
+        "./file1", "cgroup",
+    ]
+
+    mmap_meta = next(c for c in t.syscalls if c.name == "mmap")
+    prot = k.get("PROT_READ", 1) | k.get("PROT_WRITE", 2)
+    mflags = (k.get("MAP_ANONYMOUS", 0x20) | k.get("MAP_PRIVATE", 2)
+              | k.get("MAP_FIXED", 0x10))
+
+    def make_mmap(addr: int, size: int) -> Call:
+        a = [
+            PointerArg.make_vma(mmap_meta.args[0], addr, size),
+            ConstArg(mmap_meta.args[1], size),
+            ConstArg(mmap_meta.args[2], prot),
+            ConstArg(mmap_meta.args[3], mflags),
+            ConstArg(mmap_meta.args[4], 0xFFFFFFFFFFFFFFFF),
+            ConstArg(mmap_meta.args[5], 0),
+        ]
+        return Call(meta=mmap_meta, args=a,
+                    ret=make_return_arg(mmap_meta.ret))
+
+    t.make_mmap = make_mmap
+
+    sigkill = k.get("SIGKILL", 9)
+    sigstop = k.get("SIGSTOP", 19)
+    s_ifmt = k.get("S_IFMT", 0o170000)
+    s_ifchr = k.get("S_IFCHR", 0o020000)
+    s_ifblk = k.get("S_IFBLK", 0o060000)
+    harmless_dev = 0x700  # LOOP_MAJOR << 8
 
     def sanitize(c: Call) -> None:
-        # Neutralize dangerous calls (reference: sys/linux/init.go:100-148):
-        # don't let the fuzzer kill arbitrary processes or mmap FIXED over
-        # the program's own mappings at address 0.
-        if c.meta.call_name == "kill" and len(c.args) >= 2:
-            sig = c.args[1]
-            if isinstance(sig, ConstArg) and sig.val == SIGKILL:
+        """Neutralize calls that would kill/wedge the fuzzer itself
+        (reference: sys/linux/init.go sanitizeCall, :100-148)."""
+        name = c.meta.call_name
+        if name in ("kill", "tkill", "tgkill"):
+            sig = c.args[-1]  # sig is the last arg of all three
+            if isinstance(sig, ConstArg) and sig.val in (sigkill, sigstop):
                 sig.val = 0
+        elif name in ("mknod", "mknodat"):
+            mode_i, dev_i = (1, 2) if name == "mknod" else (2, 3)
+            if len(c.args) > dev_i:
+                mode = c.args[mode_i]
+                dev = c.args[dev_i]
+                if isinstance(mode, ConstArg) and isinstance(dev, ConstArg) \
+                        and (mode.val & s_ifmt) in (s_ifchr, s_ifblk):
+                    dev.val = harmless_dev
+        elif name == "exit" or name == "exit_group":
+            # Keep exit codes in the executor's reserved-safe range.
+            code = c.args[0] if c.args else None
+            if isinstance(code, ConstArg) and code.val in (67, 68, 69):
+                code.val = 1
 
-    b.sanitize_call = sanitize
-
-    def make_mmap(target, addr: int, size: int) -> Call:
-        meta = target.syscalls[0]
-        a = [
-            PointerArg.make_vma(meta.args[0], addr, size),
-            ConstArg(meta.args[1], size),
-            ConstArg(meta.args[2], PROT_READ | PROT_WRITE),
-            ConstArg(meta.args[3], MAP_ANONYMOUS | MAP_PRIVATE | MAP_FIXED),
-            ConstArg(meta.args[4], 0xFFFFFFFFFFFFFFFF),
-            ConstArg(meta.args[5], 0),
-        ]
-        return Call(meta=meta, args=a, ret=make_return_arg(meta.ret))
-
-    b.make_mmap = make_mmap
-    return b.build(register=register)
+    t.sanitize_call = sanitize
 
 
-target = build_linux_target()
+register_lazy_target("linux", "amd64", build_linux_target)

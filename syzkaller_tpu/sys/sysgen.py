@@ -66,10 +66,16 @@ def compile_os(os_name: str, arch: str, root: Path = DESC_ROOT,
 
 def register_all(root: Path = DESC_ROOT) -> list[tuple[str, str]]:
     """Register every shipped description target lazily; returns the
-    (os, arch) pairs made available."""
+    (os, arch) pairs made available.  OSes whose arch-hook module
+    already registered them (e.g. linux via sys/linux.py) are
+    skipped."""
+    from syzkaller_tpu.models.target import is_registered
+
     pairs = []
     for os_name in list_description_oses(root):
         for arch in description_arches(os_name, root):
+            if is_registered(os_name, arch):
+                continue
             register_lazy_target(
                 os_name, arch,
                 lambda o=os_name, a=arch: compile_os(o, a, root,

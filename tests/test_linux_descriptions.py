@@ -1,0 +1,116 @@
+"""Gates on the syzlang-compiled linux/amd64 model (VERDICT r2 #2).
+
+The description corpus (sys/descriptions/linux/*.txt + extracted
+.const) must compile to hundreds of enabled syscalls and interoperate
+with every downstream layer: generation under debug validation, text
+and exec serialization, the choice table, and the device tensor codec
+(the reference's equivalent sanity layer: sys/linux decl tests,
+prog/decl_test.go:51).
+"""
+
+import pytest
+
+from syzkaller_tpu.models.encoding import deserialize_prog, serialize_prog
+from syzkaller_tpu.models.encodingexec import serialize_for_exec
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.mutation import mutate_prog
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.models.target import get_target
+
+
+@pytest.fixture(scope="module")
+def linux():
+    return get_target("linux", "amd64")
+
+
+def test_scale_and_shape(linux):
+    assert len(linux.syscalls) >= 300, "description corpus shrank"
+    assert len(linux.resources) >= 20
+    # Real amd64 syscall numbers flow from the extracted consts.
+    nrs = {c.call_name: c.nr for c in linux.syscalls}
+    assert nrs["read"] == 0 and nrs["write"] == 1
+    assert nrs["openat"] == 257 and nrs["mmap"] == 9
+    # Variants share the wire NR of their call_name.
+    fcntls = [c for c in linux.syscalls if c.call_name == "fcntl"]
+    assert len(fcntls) >= 10
+    assert len({c.nr for c in fcntls}) == 1 == len({72} & {fcntls[0].nr})
+
+
+def test_compile_disables_nothing(linux):
+    from syzkaller_tpu.sys.sysgen import compile_os
+
+    res = compile_os("linux", "amd64")
+    assert res.disabled_calls == []
+    assert res.warnings == []
+
+
+def test_transitively_enabled_all(linux):
+    enabled, disabled = linux.transitively_enabled_calls(
+        {c: True for c in linux.syscalls})
+    assert not disabled, f"resource ctor gaps: {disabled}"
+    assert len(enabled) == len(linux.syscalls)
+
+
+def test_generate_roundtrip_exec(linux, iters):
+    import syzkaller_tpu.models.validation as validation
+
+    assert validation.debug
+    corpus = []
+    for seed in range(max(iters, 30)):
+        p = generate_prog(linux, RandGen(linux, seed), 8)
+        s = serialize_prog(p)
+        assert serialize_prog(deserialize_prog(linux, s)) == s, seed
+        assert serialize_for_exec(p)
+        corpus.append(p)
+    for seed in range(max(iters // 2, 15)):
+        p = corpus[seed % len(corpus)].clone()
+        mutate_prog(p, RandGen(linux, 10_000 + seed), 20, corpus=corpus)
+        s = serialize_prog(p)
+        assert serialize_prog(deserialize_prog(linux, s)) == s, seed
+        serialize_for_exec(p)
+
+
+def test_choice_table_builds(linux):
+    from syzkaller_tpu.models.prio import build_choice_table
+
+    ct = build_choice_table(linux)
+    rng = RandGen(linux, 3)
+    seen = {ct.choose(rng, -1) for _ in range(100)}
+    base = linux.syscalls[0].id
+    seen |= {ct.choose(rng, base) for _ in range(100)}
+    assert len(seen) > 30, "choice table collapsed"
+
+
+def test_device_tensor_codec_covers_linux(linux):
+    """The pipeline's tensor codec must encode a healthy share of
+    generated linux programs (non-encodable ones fall back to host
+    mutation, but the device path needs real coverage)."""
+    pytest.importorskip("jax")
+    from syzkaller_tpu.ops.pipeline import PIPELINE_TENSOR_CONFIG
+    from syzkaller_tpu.ops.tensor import FlagTables, encode_prog
+
+    flags = FlagTables.empty()
+    ok = 0
+    n = 40
+    for seed in range(n):
+        p = generate_prog(linux, RandGen(linux, 500 + seed), 6)
+        try:
+            encode_prog(p, PIPELINE_TENSOR_CONFIG, flags)
+            ok += 1
+        except Exception:
+            pass
+    assert ok >= n // 2, f"only {ok}/{n} linux programs tensorize"
+
+
+def test_sanitize_neutralizes_kill(linux):
+    text = b"kill(0x0, 0x9)\n"
+    p = deserialize_prog(linux, text)
+    # deserialize runs sanitize_call: SIGKILL must be neutralized.
+    assert p.calls[0].args[1].val != 9
+
+
+def test_revision_tracks_descriptions(linux):
+    from syzkaller_tpu.sys.sysgen import revision_hash
+
+    assert linux.revision == revision_hash("linux")
+    assert len(linux.revision) == 40

@@ -47,28 +47,19 @@ def test_real_getpid(linux_target):
 
 
 def test_real_open_read_devnull(linux_target):
-    target = linux_target
-    meta_open = next(c for c in target.syscalls if c.name == "openat")
-    # openat(AT_FDCWD, "/dev/null", O_RDONLY, 0o644)
-    fname = DataArg(meta_open.args[1].elem, b"/dev/null\x00")
-    open_call = _call(target, "openat", [
-        ConstArg(meta_open.args[0], 0xFFFFFFFFFFFFFF9C),
-        PointerArg(meta_open.args[1], address=0x1000, res=fname),
-        ConstArg(meta_open.args[2], 0),  # O_RDONLY
-        ConstArg(meta_open.args[3], 0o644),
-    ])
-    meta_read = next(c for c in target.syscalls if c.name == "read")
-    from syzkaller_tpu.models.prog import ResultArg
+    """A description-compiled program (text -> typed -> exec bytes)
+    issues real syscalls and threads the fd result through — the
+    end-to-end gate on the compiled linux model."""
+    from syzkaller_tpu.models.encoding import deserialize_prog
 
-    fd_arg = ResultArg(meta_read.args[0], res=open_call.ret)
-    open_call.ret.uses.add(fd_arg)
-    buf = DataArg(meta_read.args[1].elem, b"", out_size=16)
-    read_call = _call(target, "read", [
-        fd_arg,
-        PointerArg(meta_read.args[1], address=0x2000, res=buf),
-        ConstArg(meta_read.args[2], 16),
-    ])
-    p = Prog(target=target, calls=[open_call, read_call])
+    text = (
+        b"r0 = openat(0xffffffffffffff9c, "
+        b"&(0x7f0000000000)='/dev/null\\x00', 0x0, 0x0)\n"
+        b"read(r0, &(0x7f0000001000)=\"\"/16, 0x10)\n"
+    )
+    p = deserialize_prog(linux_target, text)
+    assert p.calls[1].args[0].res is p.calls[0].ret, \
+        "fd result edge not threaded by the parser"
     env = make_env(0, sim=False)
     try:
         res = env.exec(ExecOpts(), serialize_for_exec(p))
