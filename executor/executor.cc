@@ -22,6 +22,7 @@
 // VM console oops + lost connection.
 
 #include <errno.h>
+#include <signal.h>
 #include <stdarg.h>
 #include <fcntl.h>
 #include <stdio.h>
@@ -29,6 +30,7 @@
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -184,7 +186,10 @@ struct Kcov {
   static constexpr unsigned long kInitTrace = 0x80086301;
   static constexpr unsigned long kEnable = 0x6364;
   static constexpr unsigned long kDisable = 0x6365;
-  static constexpr int kCoverSize = 64 << 10;
+  static constexpr unsigned long kTracePc = 0;
+  static constexpr unsigned long kTraceCmp = 1;
+  // 256K entries per thread (reference: executor/executor.h:25).
+  static constexpr int kCoverSize = 256 << 10;
   int fd = -1;
   uint64_t* area = nullptr;
 
@@ -202,10 +207,10 @@ struct Kcov {
     fd = -1;
     return false;
   }
-  void enable() {
+  void enable(bool cmps) {
     if (area) {
       __atomic_store_n(&area[0], 0, __ATOMIC_RELAXED);
-      ioctl(fd, kEnable, 0);
+      ioctl(fd, kEnable, cmps ? kTraceCmp : kTracePc);
     }
   }
   int disable(uint32_t* cov, int max) {
@@ -216,6 +221,29 @@ struct Kcov {
     for (uint64_t i = 0; i < n && out < max; i++)
       cov[out++] = (uint32_t)area[i + 1];
     return out;
+  }
+  // KCOV_TRACE_CMP records: 4 words each (type, arg1, arg2, ip);
+  // operands are masked to the compare width and emitted in both
+  // orders since the kernel side doesn't know which operand came
+  // from the program (reference: executor_linux.cc:221-253).
+  int disable_cmps(SimCmp* out, int max) {
+    if (!area) return 0;
+    ioctl(fd, kDisable, 0);
+    uint64_t n = __atomic_load_n(&area[0], __ATOMIC_RELAXED);
+    int cnt = 0;
+    for (uint64_t i = 0; i < n && cnt + 1 < max; i++) {
+      uint64_t type = area[1 + 4 * i];
+      uint64_t a1 = area[2 + 4 * i];
+      uint64_t a2 = area[3 + 4 * i];
+      int size = 1 << ((type >> 1) & 3);
+      uint64_t mask = size == 8 ? ~0ull : ((1ull << (8 * size)) - 1);
+      a1 &= mask;
+      a2 &= mask;
+      if (a1 == a2) continue;  // useless as a hint
+      out[cnt++] = SimCmp{a1, a2};
+      out[cnt++] = SimCmp{a2, a1};
+    }
+    return cnt;
   }
 };
 #endif
@@ -234,6 +262,7 @@ struct CallJob {
   int nargs;
   bool collect_cover;
   bool collect_comps;
+  bool collide_reissue = false;  // concurrent re-issue (collide mode)
   // outputs — written by the worker only at completion, under its
   // mutex, so the main thread may read them freely once wait()
   // succeeded; a timed-out job is marked abandoned and then owned
@@ -342,7 +371,12 @@ class Worker {
     int cov_len = 0, cmps_len = 0;
     if (g_env_flags & kEnvSimOS) {
       SimResult r;
-      {
+      if (SimKernel::lockless(j->call_id)) {
+        // Race-window calls run WITHOUT the sim lock so collide mode
+        // can actually interleave them (sim_kernel.h race families).
+        r = sim_->exec_lockless(j->call_id, j->args, j->nargs, cov,
+                                kMaxCov, &cov_len, j->collide_reissue);
+      } else {
         std::lock_guard<std::mutex> lk(*sim_mu_);
         r = sim_->exec(j->call_id, j->args, j->nargs, cov, kMaxCov, &cov_len,
                        cmps, kMaxCmps, &cmps_len);
@@ -358,15 +392,21 @@ class Worker {
 #if defined(__linux__)
       static thread_local Kcov kcov;
       static thread_local bool kcov_ok = kcov.open_();
-      if (kcov_ok) kcov.enable();
+      bool want_cmps = j->collect_comps;
+      if (kcov_ok) kcov.enable(want_cmps);
       long res = syscall(j->nr, j->args[0], j->args[1], j->args[2],
                          j->args[3], j->args[4], j->args[5]);
       o->errno_ = res == -1 ? errno : 0;
       o->ret = res == -1 ? 0 : (uint64_t)res;
       if (kcov_ok) {
-        cov_len = kcov.disable(cov, kMaxCov);
-      } else {
-        // no KCOV: one edge per (call, errno) so signal still flows
+        if (want_cmps)
+          cmps_len = kcov.disable_cmps(cmps, kMaxCmps);
+        else
+          cov_len = kcov.disable(cov, kMaxCov);
+      }
+      if (cov_len == 0) {
+        // no KCOV (or a comps run): one synthetic edge per
+        // (call, errno) so signal still flows
         cov[0] = (uint32_t)splitmix64(j->nr * 1000ull + o->errno_);
         cov_len = 1;
       }
@@ -627,16 +667,25 @@ static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
       Worker* w = pool->get();
       if (w == nullptr) return {nullptr, nullptr};
       auto* copy = new CallJob(*src);
+      copy->collide_reissue = true;
       w->submit(copy);
       return {w, copy};
     };
     for (size_t i = 0; i + 1 < calls.size(); i += 2) {
       auto a = reissue(calls[i].job);
       auto b = reissue(calls[i + 1].job);
-      if (a.first && a.first->wait_or_abandon(g_call_timeout_ms, a.second))
+      bool crashed = false;
+      if (a.first && a.first->wait_or_abandon(g_call_timeout_ms, a.second)) {
+        crashed |= a.second->crashed;
         delete a.second;
-      if (b.first && b.first->wait_or_abandon(g_call_timeout_ms, b.second))
+      }
+      if (b.first && b.first->wait_or_abandon(g_call_timeout_ms, b.second)) {
+        crashed |= b.second->crashed;
         delete b.second;
+      }
+      // A race provoked during collide is a kernel crash like any
+      // other (the oops is already on stderr).
+      if (crashed) _exit(kStatusError);
     }
   }
 
@@ -768,9 +817,17 @@ static int executor_main(int argc, char** argv) {
   HandshakeRep hr{kHandshakeRepMagic};
   write_exact(1, &hr, sizeof(hr));
 
-  SimKernel sim(g_pid);
-  WorkerPool pool;
-  pool.sim = &sim;
+  bool fork_prog = g_env_flags & kEnvForkProg;
+  // In fork mode the parent stays single-threaded and pool-less:
+  // every program gets a fresh child with its own pool + sim state
+  // (reference process model: common_linux.h:1931-2040).
+  SimKernel* sim = nullptr;
+  WorkerPool* pool = nullptr;
+  if (!fork_prog) {
+    sim = new SimKernel(g_pid);
+    pool = new WorkerPool;
+    pool->sim = sim;
+  }
 
   for (;;) {
     ExecuteReq req;
@@ -782,7 +839,52 @@ static int executor_main(int argc, char** argv) {
       failf("executor: program too large");
     memset(g_out, 0, sizeof(OutHeader));
     ExecuteRep rep{kExecuteRepMagic, 0, 0};
-    execute_program(req, &rep, &pool);
+    if (!fork_prog) {
+      execute_program(req, &rep, pool);
+      write_exact(1, &rep, sizeof(rep));
+      continue;
+    }
+
+    pid_t child = fork();
+    if (child < 0) failf("executor: fork: %d", errno);
+    if (child == 0) {
+      // Child: fresh kernel state + worker pool; results land in the
+      // MAP_SHARED out region; sim crashes exit kStatusError which
+      // the parent propagates (host contract: crash = dead executor
+      // + oops on the console).
+      SimKernel csim(g_pid);
+      WorkerPool cpool;
+      cpool.sim = &csim;
+      ExecuteRep crep{kExecuteRepMagic, 0, 0};
+      execute_program(req, &crep, &cpool);
+      _exit(0);
+    }
+    // Parent: bounded wait, then reap; a child that _exits mid-run
+    // (or is killed by a stray program syscall) must not take the
+    // fork-server down.
+    int prog_timeout_ms = g_call_timeout_ms * (kMaxCalls + 8);
+    int waited = 0;
+    int status = 0;
+    pid_t got = 0;
+    while (waited < prog_timeout_ms) {
+      got = waitpid(child, &status, WNOHANG);
+      if (got == child) break;
+      usleep(1000);
+      waited += 1;
+    }
+    if (got != child) {
+      kill(child, SIGKILL);
+      waitpid(child, &status, 0);
+    }
+    if (WIFEXITED(status) && WEXITSTATUS(status) == kStatusError)
+      _exit(kStatusError);  // sim oops: preserve crash semantics
+    if (WIFEXITED(status) && WEXITSTATUS(status) == kStatusFail)
+      _exit(kStatusFail);  // executor-level failure must stay loud
+    auto* hdr = (OutHeader*)g_out;
+    if (got != child || !WIFEXITED(status) || WEXITSTATUS(status) != 0)
+      hdr->completed = 0;  // partial or killed: host must not trust
+    rep.ncalls = hdr->ncalls;
+    rep.status = 0;
     write_exact(1, &rep, sizeof(rep));
   }
 }

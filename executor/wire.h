@@ -69,6 +69,10 @@ constexpr uint64_t kEnvSandboxSetuid = 1 << 3;
 constexpr uint64_t kEnvSandboxNamespace = 1 << 4;
 constexpr uint64_t kEnvSimOS = 1 << 5;      // simulated kernel backend
 constexpr uint64_t kEnvOptionalCover = 1 << 6;
+// fork a fresh child per program: a program that _exits/crashes its
+// process cannot take the fork-server down (reference process model:
+// executor/common_linux.h:1931-2040 loop()/fork per program)
+constexpr uint64_t kEnvForkProg = 1 << 7;
 
 // exec flags (per-request)
 constexpr uint64_t kExecCollectCover = 1 << 0;

@@ -86,7 +86,10 @@ class FuzzerProcess:
             from syzkaller_tpu.fuzzer.proc import PipelineMutator
             from syzkaller_tpu.ops.pipeline import DevicePipeline
 
-            self.mutator = PipelineMutator(DevicePipeline(self.target))
+            # Share the enabled-filtered choice table so the donor
+            # bank cannot splice manager-disabled syscalls.
+            self.mutator = PipelineMutator(
+                DevicePipeline(self.target, ct=self.fuzzer.ct))
 
         self.procs = []
         for pid in range(procs):

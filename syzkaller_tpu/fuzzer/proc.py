@@ -46,10 +46,12 @@ from syzkaller_tpu.utils import log
 class PipelineMutator:
     """Integrated mutation source over a DevicePipeline
     (ops/pipeline.py): each draw runs the REFERENCE op ladder
-    (reference: prog/mutation.go:19-131).  The device classes
-    (arg-mutate 10/11, remove 1/11 — together ~28% of iterations)
-    route to the device ring, which produces an exec-ready mutant;
-    the structural classes (squash/splice/insert) run the CPU op on a
+    (reference: prog/mutation.go:19-131).  The device classes —
+    insert (donor-bank splice with ChoiceTable sampling, ~51% of
+    iterations), arg-mutate and remove, together ~79% of iteration
+    weight — route to the device ring, which produces exec-ready
+    mutants with the same conditional class split on device; the
+    remaining structural classes (squash, splice) run the CPU op on a
     cloned base, and a failed op redraws the full ladder — exactly
     the reference's retry shape, so the landed-op distribution is
     success-conditioned the same way the reference's is.
@@ -85,7 +87,6 @@ class PipelineMutator:
     def next(self, fuzzer: Fuzzer,
              rng: RandGen) -> Optional[Union[Prog, "object"]]:
         from syzkaller_tpu.models.mutation import (
-            _op_insert,
             _op_splice,
             _op_squash,
             mutate_prog,
@@ -102,15 +103,14 @@ class PipelineMutator:
         p: Optional[Prog] = None
         while True:
             # The reference op ladder (prog/mutation.go:19-131); the
-            # arg-mutate/remove tail is one "device" outcome here —
-            # the kernel draws 10/11-vs-1/11 per round on device
-            # (ops/mutate._mutate_one).
+            # insert/arg-mutate/remove tail is one "device" outcome —
+            # the kernel draws insert-vs-mutate per mutant on device
+            # (ops/pipeline step: P_INSERT_GIVEN_DEVICE; arg/remove at
+            # 10/11-vs-1/11 per round in ops/mutate._mutate_one).
             if rng.one_of(5):
                 op = "squash"
             elif rng.n_out_of(1, 100):
                 op = "splice"
-            elif rng.n_out_of(20, 31):
-                op = "insert"
             else:
                 op = "device"
             if op == "device":
@@ -122,17 +122,15 @@ class PipelineMutator:
                 p = base.clone()
             if op == "squash":
                 ok = _op_squash(p, rng, ct)
-            elif op == "splice":
-                ok = _op_splice(p, rng, ncalls, corpus)
             else:
-                ok = _op_insert(p, rng, ncalls, ct)
+                ok = _op_splice(p, rng, ncalls, corpus)
             if not ok:
                 continue  # reference retry: redraw the full ladder
             if self.ops_journal is not None:
                 self.ops_journal.append(op)
             if not rng.one_of(3):
                 # Continue coin: further iterations run the full CPU
-                # reference loop (may mix in arg-mutate/remove, as the
+                # reference loop (may mix in any op class, as the
                 # reference would).
                 mutate_prog(p, rng, ncalls, ct=ct, corpus=corpus,
                             ops_out=self.ops_journal)

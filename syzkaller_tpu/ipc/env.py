@@ -53,6 +53,9 @@ class EnvFlags(enum.IntFlag):
     SANDBOX_NAMESPACE = 1 << 4
     SIM_OS = 1 << 5
     OPTIONAL_COVER = 1 << 6
+    # Fork a fresh child per program (program exits/crashes are
+    # contained; reference: common_linux.h:1931-2040).
+    FORK_PROG = 1 << 7
 
 
 class ExecFlags(enum.IntFlag):
@@ -323,7 +326,8 @@ class Env:
 
 
 def make_env(pid: int = 0, sim: bool = True, signal: bool = True,
-             debug: bool = False, **kw) -> Env:
+             debug: bool = False, fork_prog: Optional[bool] = None,
+             **kw) -> Env:
     flags = EnvFlags.SANDBOX_NONE
     if sim:
         flags |= EnvFlags.SIM_OS
@@ -331,4 +335,11 @@ def make_env(pid: int = 0, sim: bool = True, signal: bool = True,
         flags |= EnvFlags.SIGNAL
     if debug:
         flags |= EnvFlags.DEBUG
+    # Real-OS programs mutate process state (fds, maps, signal
+    # dispositions) and may plain _exit: isolate each in a fork by
+    # default.  The sim backend keeps the faster in-process model.
+    if fork_prog is None:
+        fork_prog = not sim
+    if fork_prog:
+        flags |= EnvFlags.FORK_PROG
     return Env(pid, flags, **kw)

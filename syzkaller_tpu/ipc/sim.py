@@ -40,3 +40,20 @@ def arg_magic(call_id: int, arg_index: int) -> int:
     """Per-(call,arg) comparison magic that unlocks bonus edges."""
     h = call_hash(call_id)
     return splitmix64((h + 0x1111 * (arg_index + 1)) & MASK64) & 0xFFFFFFFF
+
+
+RACE_PREPARE_TAG = 5
+RACE_TRIGGER_TAG = 9
+
+
+def race_tag(call_id: int) -> int:
+    """Race-window family tag (executor/sim_kernel.h race families)."""
+    return call_hash(call_id) & 31
+
+
+def is_race_prepare(call_id: int) -> bool:
+    return race_tag(call_id) == RACE_PREPARE_TAG
+
+
+def is_race_trigger(call_id: int) -> bool:
+    return race_tag(call_id) == RACE_TRIGGER_TAG

@@ -81,6 +81,11 @@ class ExecRecord:
       call_bounds         per-call [start, end) word ranges covering
                           the call's copyins, csums, call instr and
                           copyouts (the EOF word is outside all)
+      copyout_words       word indices whose VALUE is a copyout index
+                          (call ret slot, COPYOUT instrs, RESULT arg
+                          refs) — the set to rebase when splicing one
+                          program's segment into another
+      ncopyouts           copyout indices consumed by the program
     """
 
     def __init__(self):
@@ -88,6 +93,8 @@ class ExecRecord:
         self.meta_word: dict[int, int] = {}
         self.data_word: dict[int, tuple[int, int, int]] = {}
         self.call_bounds: list[tuple[int, int]] = []
+        self.copyout_words: list[int] = []
+        self.ncopyouts: int = 0
 
 
 class _Writer:
@@ -193,6 +200,8 @@ def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE,
         if c.ret is not None and len(c.ret.uses) != 0:
             assert id(c.ret) not in args_info, "arg info exists for ret"
             args_info[id(c.ret)] = {"idx": copyout_seq, "ret": True}
+            if record is not None:
+                record.copyout_words.append(len(w.words))
             w.write(copyout_seq)
             copyout_seq += 1
         else:
@@ -212,6 +221,8 @@ def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE,
                 copyout_seq += 1
                 args_info[id(arg)] = info
                 w.write(EXEC_INSTR_COPYOUT)
+                if record is not None:
+                    record.copyout_words.append(len(w.words))
                 w.write(info["idx"])
                 w.write(info.get("addr", 0))
                 w.write(arg.size())
@@ -220,6 +231,8 @@ def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE,
         if record is not None:
             record.call_bounds.append((call_start, len(w.words)))
 
+    if record is not None:
+        record.ncopyouts = copyout_seq
     w.write(EXEC_INSTR_EOF)
     return struct.pack(f"<{len(w.words)}Q", *w.words)
 
@@ -246,6 +259,8 @@ def _write_arg(w: _Writer, target, arg: Arg, args_info: dict,
             assert info is not None and "idx" in info, "no copyout index"
             w.write(EXEC_ARG_RESULT)
             w.write(arg.size())
+            if record is not None:
+                record.copyout_words.append(len(w.words))
             w.write(info["idx"])
             w.write(arg.op_div)
             w.write(arg.op_add)

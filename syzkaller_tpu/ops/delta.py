@@ -6,11 +6,16 @@ latency (measured), which caps full-row draining at ~3k mutants/s.
 But one mutation round touches at most `rounds` slots, so each mutant
 is shipped as ONE fixed-layout byte row holding only:
 
-  header    template index, change counts, flags, call-alive bitmap
+  header    template index, change counts, flags, op class, donor
+            bank index + insert position, call-alive bitmap
   values    up to K (slot, value) pairs (touched value slots,
             including device-recomputed LEN fixups)
   data      up to D (slot, new_len, payload_off) entries
   payload   the changed data spans' bytes, 8-aligned, capped at P
+
+Op classes: OP_MUTATE (value/data/remove mutation of the template) and
+OP_INSERT (donor, pos valid: splice the donor block's exec segment at
+alive-call boundary pos — ops/insert.py).
 
 The whole batch is a single uint8[B, ROW] array — one transfer per
 batch.  The host reconstructs exec bytes by patching the template
@@ -32,6 +37,11 @@ import numpy as np
 FLAG_OVERFLOW = 1
 FLAG_PRESERVE = 2
 
+OP_MUTATE = 0
+OP_INSERT = 1
+
+HDR_BYTES = 24  # nvals ndata flags op | template_idx | alive_bits | donor pos pad3
+
 
 @dataclass(frozen=True)
 class DeltaSpec:
@@ -43,22 +53,22 @@ class DeltaSpec:
 
     @property
     def row_bytes(self) -> int:
-        # hdr(16) + val_idx(2K) + vals(8K) + data_slot(2D) +
+        # hdr + val_idx(2K) + vals(8K) + data_slot(2D) +
         # data_len(4D) + data_off(4D) + payload(P)
-        return 16 + 10 * self.K + 10 * self.D + self.P
+        return HDR_BYTES + 10 * self.K + 10 * self.D + self.P
 
     # Field offsets within a row.
     @property
     def o_val_idx(self) -> int:
-        return 16
+        return HDR_BYTES
 
     @property
     def o_vals(self) -> int:
-        return 16 + 2 * self.K
+        return HDR_BYTES + 2 * self.K
 
     @property
     def o_data_slot(self) -> int:
-        return 16 + 10 * self.K
+        return HDR_BYTES + 10 * self.K
 
     @property
     def o_data_len(self) -> int:
@@ -98,11 +108,19 @@ def make_packer(spec: DeltaSpec):
             jnp.arange(S, dtype=jnp.int32), mode="drop")
         return idx, mask.sum()
 
-    def pack(state, template_idx):
+    def pack(state, template_idx, op=None, donor=None, pos=None):
         kind = state["kind"]
         touched = state["touched"]
-        val_changed = touched & (kind != DATA) & (kind != EMPTY)
-        data_changed = touched & (kind == DATA)
+        if op is None:
+            op = jnp.uint8(0)
+        if donor is None:
+            donor = jnp.int32(-1)
+        if pos is None:
+            pos = jnp.uint8(0)
+        # Insert rows carry no state changes: mask the journals.
+        is_ins = op != 0
+        val_changed = touched & (kind != DATA) & (kind != EMPTY) & ~is_ins
+        data_changed = touched & (kind == DATA) & ~is_ins
 
         val_idx, nvals = compact(val_changed, K)
         vals = state["val"][jnp.maximum(val_idx, 0)]
@@ -143,9 +161,12 @@ def make_packer(spec: DeltaSpec):
         hdr = jnp.concatenate([
             jnp.stack([jnp.minimum(nvals, 255).astype(jnp.uint8),
                        jnp.minimum(ndata, 255).astype(jnp.uint8),
-                       flags, jnp.uint8(0)]),
+                       flags, jnp.asarray(op, jnp.uint8)]),
             u8cast(template_idx.astype(jnp.int32)),
             u8cast(alive_bits),
+            u8cast(jnp.asarray(donor, jnp.int32)),
+            jnp.stack([jnp.asarray(pos, jnp.uint8),
+                       jnp.uint8(0), jnp.uint8(0), jnp.uint8(0)]),
         ])
         row = jnp.concatenate([
             hdr,
@@ -172,8 +193,11 @@ class DeltaBatch:
         self.nvals = buf[:, 0]
         self.ndata = buf[:, 1]
         self.flags = buf[:, 2]
+        self.op = buf[:, 3]
         self.template_idx = buf[:, 4:8].copy().view("<i4")[:, 0]
         self.alive_bits = buf[:, 8:16].copy().view("<u8")[:, 0]
+        self.donor = buf[:, 16:20].copy().view("<i4")[:, 0]
+        self.pos = buf[:, 20]
         o = spec.o_val_idx
         self.val_idx = buf[:, o:o + 2 * spec.K].copy().view("<i2")
         o = spec.o_vals

@@ -27,6 +27,7 @@ triage, where accepted inputs are decoded and re-encoded typed):
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import numpy as np
 
@@ -39,6 +40,7 @@ from syzkaller_tpu.models.any_squash import call_contains_any
 from syzkaller_tpu.ops.tensor import DATA, FLAGS, INT, LEN, PROC, ProgTensor
 
 MASK64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+MAX_COPYOUT = 256  # executor copyout table size (executor/wire.h:53)
 
 
 @dataclass
@@ -48,6 +50,8 @@ class ExecTemplate:
     words: np.ndarray  # uint64[W] template stream incl. trailing EOF
     call_bounds: np.ndarray  # int32[ncalls, 2] word ranges
     ncalls: int
+    ncopyouts: int  # copyout indices the template consumes (donor
+    # splices rebase past these; budget: executor/wire.h kMaxCopyout)
     # Slot-aligned patch arrays (length = cfg.max_slots):
     val_word: np.ndarray  # int32[S], -1 = slot has no value word
     meta_word: np.ndarray  # int32[S]
@@ -131,6 +135,7 @@ def build_exec_template(t: ProgTensor,
         call_bounds=np.array(rec.call_bounds or np.empty((0, 2)),
                              dtype=np.int32).reshape(-1, 2),
         ncalls=t.ncalls,
+        ncopyouts=rec.ncopyouts,
         val_word=val_word, meta_word=meta_word,
         len_word=len_word, data_word=data_word, data_cap=data_cap,
         data_off=np.asarray(t.off, dtype=np.int32).copy(),
@@ -349,6 +354,24 @@ def mutant_call_ids(et: ExecTemplate, call_alive: np.ndarray) -> list[int]:
     """Template call indices surviving in the mutant, in order — maps
     the executor's call_index back to template calls."""
     return [i for i in range(et.ncalls) if call_alive[i]]
+
+
+def splice_insert(et: ExecTemplate, call_alive: np.ndarray, block,
+                  pos: int) -> Optional[bytes]:
+    """Exec bytes for an insert-class mutant: the template's alive-call
+    segments with the donor block's words spliced in after `pos` alive
+    calls, donor copyout indices rebased past the template's
+    (ops/insert.DonorBlock).  Returns None when the combined copyout
+    budget would overflow the executor table."""
+    if et.ncopyouts + block.ncopyouts > MAX_COPYOUT:
+        return None
+    w = et.words
+    segs = [w[a:b] for (a, b), alive
+            in zip(et.call_bounds, call_alive[:et.ncalls]) if alive]
+    pos = min(int(pos), len(segs))
+    dw = block.rebased_words(et.ncopyouts)
+    parts = segs[:pos] + [dw] + segs[pos:] + [w[-1:]]  # EOF
+    return np.concatenate(parts).tobytes()
 
 
 def parse_stream(stream: bytes) -> list[int]:

@@ -1,0 +1,147 @@
+"""Device-side call insertion: donor bank + ChoiceTable sampling.
+
+Call insertion is ~51% of the reference's mutation iterations
+(reference: prog/mutation.go:73-95) and was host-only until now.  The
+TPU formulation (SURVEY.md §7.5):
+
+  * HOST, once per target: pre-generate a standalone "donor block"
+    per enabled syscall — the call plus any resource-constructor
+    calls createResource recursion emits (reference:
+    prog/rand.go:248-321) — RELOCATED into the upper half of the
+    data area so donor pointer addresses can never collide with a
+    template's (templates allocate bottom-up).  Each block is
+    serialized once to exec words with an ExecRecord.
+  * DEVICE, per mutant: sample a context call from the template's
+    alive calls, draw the donor syscall from the ChoiceTable's
+    prefix-sum prio row for that context (binary search — the
+    categorical sampler of prog/prio.go:198-245), and a
+    biased-toward-end insert position.
+  * HOST, per batch: assembly splices the donor block's words into
+    the template's alive-call stream at the chosen boundary,
+    rebasing the donor's copyout-index words by the template's
+    copyout count so result references stay disjoint (kMaxCopyout
+    budget: executor/wire.h:53).
+
+The typed decode (triage path) re-inserts the donor's cloned typed
+calls at the same boundary, so minimized/corpus programs are fully
+structural again.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from syzkaller_tpu.models.analysis import analyze
+from syzkaller_tpu.models.encodingexec import ExecRecord, serialize_for_exec
+from syzkaller_tpu.models.generation import generate_particular_call
+from syzkaller_tpu.models.any_squash import call_contains_any
+from syzkaller_tpu.models.prog import (
+    Call,
+    PointerArg,
+    Prog,
+    foreach_arg,
+)
+from syzkaller_tpu.models.rand import RandGen
+
+
+@dataclass
+class DonorBlock:
+    """One pre-generated, relocated, pre-serialized insertion unit."""
+
+    syscall_id: int
+    calls: list[Call]  # typed form (relocated); cloned on use
+    words: np.ndarray  # uint64 exec words of the block, NO EOF
+    copyout_words: np.ndarray  # int32 word idxs holding copyout indices
+    ncopyouts: int
+    call_ids: list[int]  # meta ids, in order
+    calls_any: list[bool]  # squashed-ANY flag per call
+
+    def rebased_words(self, base_copyouts: int) -> np.ndarray:
+        w = self.words.copy()
+        if self.copyout_words.size and base_copyouts:
+            w[self.copyout_words] += np.uint64(base_copyouts)
+        return w
+
+
+def _relocate(calls: list[Call], offset: int) -> None:
+    """Shift every pointer/vma address into the donor half of the data
+    area (addresses are data-area offsets; target.physical_addr adds
+    the base)."""
+    for c in calls:
+        def shift(arg, ctx) -> None:
+            if isinstance(arg, PointerArg) and not arg.is_null():
+                arg.address += offset
+
+        foreach_arg(c, shift)
+
+
+class DonorBank:
+    """Per-target bank of donor blocks, one per constructible syscall,
+    plus the device-side sampling tables."""
+
+    def __init__(self, target, ct=None, seed: int = 0,
+                 max_block_calls: int = 3):
+        self.target = target
+        self.blocks: list[DonorBlock] = []
+        # syscall id -> bank index (-1: not constructible standalone)
+        nid = max((c.id for c in target.syscalls), default=0) + 1
+        self.by_syscall = np.full(nid, -1, dtype=np.int32)
+        rng = RandGen(target, seed ^ 0xD0)
+        half = (target.num_pages // 2) * target.page_size
+        metas = ct.enabled_calls if ct is not None else target.syscalls
+        for meta in metas:
+            try:
+                s = analyze(ct, Prog(target=target, calls=[]), None)
+                calls = generate_particular_call(rng, s, meta)
+            except Exception:
+                continue
+            if not calls or len(calls) > max_block_calls:
+                continue
+            _relocate(calls, half)
+            block = Prog(target=target, calls=calls)
+            rec = ExecRecord()
+            try:
+                stream = serialize_for_exec(block, record=rec)
+            except Exception:
+                continue
+            words = np.frombuffer(stream, dtype="<u8")[:-1].copy()  # no EOF
+            self.by_syscall[meta.id] = len(self.blocks)
+            self.blocks.append(DonorBlock(
+                syscall_id=meta.id,
+                calls=calls,
+                words=words,
+                copyout_words=np.array(rec.copyout_words, dtype=np.int32),
+                ncopyouts=rec.ncopyouts,
+                call_ids=[c.meta.id for c in calls],
+                calls_any=[call_contains_any(target, c) for c in calls],
+            ))
+
+    def __len__(self) -> int:
+        return len(self.blocks)
+
+
+def choice_table_rows(target, ct) -> tuple[np.ndarray, np.ndarray]:
+    """Lower the ChoiceTable to device arrays:
+
+      runs[nid, nid]  prefix-sum priority row per context call id
+                      (uniform ramp where the table has no row)
+      bank_ok         passthrough convenience (filled by caller)
+
+    Sampling = binary search of a uniform draw in runs[ctx]
+    (reference: prog/prio.go:230-245)."""
+    nid = max((c.id for c in target.syscalls), default=0) + 1
+    runs = np.zeros((nid, nid), dtype=np.uint32)
+    uniform = np.cumsum(np.ones(nid, dtype=np.uint32))
+    for cid in range(nid):
+        row = ct.run[cid] if ct is not None and cid < len(ct.run) else None
+        if row is None:
+            runs[cid] = uniform
+        else:
+            r = np.asarray(row, dtype=np.uint32)
+            if r.shape[0] < nid:
+                r = np.pad(r, (0, nid - r.shape[0]), mode="edge")
+            runs[cid] = r if r[-1] > 0 else uniform
+    return runs, uniform

@@ -55,56 +55,77 @@ from syzkaller_tpu.ops.tensor import (
     encode_prog,
 )
 
-# Fraction of reference mutation iterations whose op class the device
-# kernels cannot express (squash 1/5, splice 1/100 of the rest, insert
-# 20/31 of the rest); the complement routes to the device.  Used by
-# tests/bench to reason about the integrated throughput mix
-# (reference weights: prog/mutation.go:19-131).
-P_HOST_STRUCTURAL = 0.2 + 0.8 * (1 / 100) + 0.8 * (99 / 100) * (20 / 31)
+# Reference per-iteration op-class marginals
+# (reference: prog/mutation.go:19-131).
+P_SQUASH = 1 / 5
+P_SPLICE = (1 - P_SQUASH) * (1 / 100)
+P_INSERT = (1 - P_SQUASH) * (99 / 100) * (20 / 31)
+P_ARG_MUTATE = (1 - P_SQUASH) * (99 / 100) * (11 / 31) * (10 / 11)
+P_REMOVE = (1 - P_SQUASH) * (99 / 100) * (11 / 31) * (1 / 11)
+
+# Device classes: insert (donor-bank splice, ops/insert.py) + the
+# arg-mutate/remove kernel loop.  Squash/splice stay host-side
+# (fuzzer.proc.PipelineMutator routes the ladder).
+P_DEVICE = P_INSERT + P_ARG_MUTATE + P_REMOVE
+P_HOST_STRUCTURAL = P_SQUASH + P_SPLICE
+# Conditional insert share among device classes.
+P_INSERT_GIVEN_DEVICE = P_INSERT / P_DEVICE
 
 
 class ExecMutant:
     """A device-produced mutant: exec bytes now, typed program on
     demand (only triage/logging ever needs the tree).  Holds a view
     into its DeltaBatch; the full tensor row is rebuilt from template
-    + delta only when prog() is called."""
+    + delta only when prog() is called.
+
+    Insert-class mutants additionally carry the donor block and the
+    alive-call boundary it was spliced at (ops/insert.py)."""
 
     __slots__ = ("exec_bytes", "template", "et", "batch", "j",
-                 "_calls", "_prog")
+                 "donor", "donor_pos", "_anys", "_prog")
 
     def __init__(self, exec_bytes: bytes, template: ProgTensor,
-                 et: ExecTemplate, batch: DeltaBatch, j: int):
+                 et: ExecTemplate, batch: DeltaBatch, j: int,
+                 donor=None, donor_pos: int = 0):
         self.exec_bytes = exec_bytes
         self.template = template
         self.et = et
         self.batch = batch
         self.j = j
-        self._calls: Optional[list[int]] = None
+        self.donor = donor
+        self.donor_pos = donor_pos
+        self._anys: Optional[list[bool]] = None
         self._prog: Optional[Prog] = None
 
     @property
     def target(self):
         return self.template.template.target
 
-    def call_map(self) -> list[int]:
-        """Mutant call position -> template call index."""
-        if self._calls is None:
+    def _any_flags(self) -> list[bool]:
+        """Per-mutant-call squashed-ANY flags, in executor call order
+        (template alive calls with the donor block spliced in)."""
+        if self._anys is None:
             alive = self.batch.call_alive(
                 self.j, self.template.call_alive.shape[0])
-            self._calls = mutant_call_ids(self.et, alive)
-        return self._calls
+            anys = [bool(self.et.calls_any[i])
+                    for i in mutant_call_ids(self.et, alive)]
+            if self.donor is not None:
+                pos = min(self.donor_pos, len(anys))
+                anys[pos:pos] = list(self.donor.calls_any)
+            self._anys = anys
+        return self._anys
 
     def num_calls(self) -> int:
-        return len(self.call_map())
+        return len(self._any_flags())
 
     def contains_any_call(self, call_index: int) -> bool:
         """Whether the mutant call is a squashed-ANY form, without
-        decoding (device ops never introduce ANY; the template's
-        per-call flags are exact)."""
-        cm = self.call_map()
-        if call_index >= len(cm):
+        decoding (device ops never introduce ANY; the template's and
+        donor's per-call flags are exact)."""
+        anys = self._any_flags()
+        if call_index >= len(anys):
             return False
-        return bool(self.et.calls_any[cm[call_index]])
+        return anys[call_index]
 
     def signal_prio(self, errno: int, call_index: int) -> int:
         """Edge priority for an executed mutant call, computed without
@@ -118,12 +139,19 @@ class ExecMutant:
 
     def prog(self) -> Prog:
         """Decode to a typed program (cached; reference semantics:
-        ops/tensor.decode_prog)."""
+        ops/tensor.decode_prog).  Insert mutants re-insert the donor's
+        cloned typed calls at the spliced boundary."""
         if self._prog is None:
             row = self.batch.rebuild_row(self.j, self.template)
-            self._prog = decode_prog(
+            p = decode_prog(
                 self.template, row,
                 preserve_sizes=bool(row["preserve_sizes"]))
+            if self.donor is not None:
+                dclone = Prog(target=p.target,
+                              calls=self.donor.calls).clone()
+                pos = min(self.donor_pos, len(p.calls))
+                p.calls[pos:pos] = dclone.calls
+            self._prog = p
         return self._prog
 
 
@@ -135,6 +163,7 @@ class PipelineStats:
     evictions: int = 0
     assemble_errors: int = 0
     overflows: int = 0  # delta rows exceeding the K/D/P budget
+    inserts: int = 0  # insert-class mutants produced
 
 
 # Lean device shapes for the pipeline: mutation cost is dominated by
@@ -150,11 +179,14 @@ class DevicePipeline:
     def __init__(self, target, cfg: Optional[TensorConfig] = None,
                  capacity: int = 2048, batch_size: int = 512,
                  rounds: int = 4, seed: int = 0, prefetch: int = 2,
-                 spec: Optional[DeltaSpec] = None):
+                 spec: Optional[DeltaSpec] = None, ct=None,
+                 max_insert_calls: int = 30):
         import jax
         import jax.numpy as jnp
         from jax import random
 
+        from syzkaller_tpu.ops import rng as d
+        from syzkaller_tpu.ops.insert import DonorBank, choice_table_rows
         from syzkaller_tpu.ops.mutate import _mutate_one
 
         self._jax = jax
@@ -179,8 +211,51 @@ class DevicePipeline:
         self._flags_len = 0
         self._key = random.key(seed)
 
+        # Donor bank + ChoiceTable sampling tables for device-side
+        # call insertion (ops/insert.py; reference weights give insert
+        # ~64% of the device's op draws).
+        if ct is None:
+            from syzkaller_tpu.models.prio import build_choice_table
+
+            ct = build_choice_table(target)
+        self.bank = DonorBank(target, ct, seed=seed)
+        runs_np, _ = choice_table_rows(target, ct)
+        self._runs_dev = jnp.asarray(runs_np)
+        self._by_syscall_dev = jnp.asarray(self.bank.by_syscall)
+        n_blocks = len(self.bank)
+
         B, R = batch_size, rounds
         pack = make_packer(self.spec)
+        p_insert = P_INSERT_GIVEN_DEVICE if n_blocks > 0 else 0.0
+        runs = self._runs_dev
+        by_syscall = self._by_syscall_dev
+        nid = runs_np.shape[0]
+
+        def sample_insert(st, k):
+            """Donor + position for an insert mutant: ChoiceTable
+            categorical over the context call's prefix-sum prio row
+            (reference: prog/prio.go:230-245) + biased-to-end insert
+            position (reference: prog/mutation.go:79)."""
+            k_ctx, k_x, k_fb, k_pos = random.split(k, 4)
+            alive = st["call_alive"]
+            ctx_slot = d.masked_choice(k_ctx, alive)
+            ctx_id = st["call_id"][jnp.maximum(ctx_slot, 0)]
+            row = runs[jnp.clip(ctx_id, 0, nid - 1)]
+            x = (d.intn(k_x, jnp.maximum(row[-1], 1).astype(jnp.int64))
+                 .astype(jnp.uint32) + 1)
+            sid = jnp.searchsorted(row, x)
+            donor = by_syscall[jnp.clip(sid, 0, nid - 1)]
+            donor = jnp.where(
+                donor < 0,
+                d.intn(k_fb, max(n_blocks, 1)).astype(jnp.int32), donor)
+            n_alive = alive.sum().astype(jnp.int32)
+            pos = d.biased_rand(k_pos, st["call_alive"].shape[0] + 1, 5) \
+                .astype(jnp.int32)
+            pos = jnp.minimum(pos, n_alive)
+            # Respect the program-length budget: a full template
+            # falls back to the mutate class.
+            ok = n_alive < max_insert_calls
+            return donor, pos.astype(jnp.uint8), ok
 
         def step(corpus: dict, n: int, key, flag_vals, flag_counts):
             k_idx, k_mut = random.split(key)
@@ -190,8 +265,20 @@ class DevicePipeline:
             keys = random.split(k_mut, B)
 
             def one(st, k, i):
-                mutated = _mutate_one(st, k, flag_vals, flag_counts, R)
-                return pack(mutated, i)
+                k_class, k_ins, k_mut1 = random.split(k, 3)
+                is_insert = d.intn(k_class, 1 << 20) < int(
+                    p_insert * (1 << 20))
+                donor, pos, ins_ok = sample_insert(st, k_ins)
+                is_insert = is_insert & ins_ok
+                mutated = _mutate_one(st, k_mut1, flag_vals, flag_counts, R)
+                # Insert mutants keep the TEMPLATE structure: the
+                # packer masks the value/data journals by op, and the
+                # alive bitmap must be the unmutated one.
+                mutated["call_alive"] = jnp.where(
+                    is_insert, st["call_alive"], mutated["call_alive"])
+                op = jnp.where(is_insert, jnp.uint8(1), jnp.uint8(0))
+                donor = jnp.where(is_insert, donor, jnp.int32(-1))
+                return pack(mutated, i, op=op, donor=donor, pos=pos)
 
             return jax.vmap(one)(batch, keys, idx)
 
@@ -299,13 +386,17 @@ class DevicePipeline:
         return rows_dev, tmpl, ets
 
     def _drain(self, launched) -> list[ExecMutant]:
+        from syzkaller_tpu.ops.delta import OP_INSERT
+        from syzkaller_tpu.ops.emit import splice_insert
+
         rows_dev, tmpl, ets = launched
         buf = np.asarray(rows_dev)  # the one device->host transfer
         batch = DeltaBatch(buf, self.spec)
         ok = (batch.flags & FLAG_OVERFLOW) == 0
         self.stats.overflows += int(np.count_nonzero(~ok))
         ok &= (batch.template_idx >= 0) & (batch.template_idx < len(tmpl))
-        js = np.flatnonzero(ok)
+        is_ins = batch.op == OP_INSERT
+        js = np.flatnonzero(ok & ~is_ins)
         datas = assemble_batch(ets, batch, js)
         out: list[ExecMutant] = []
         for j, data in zip(js, datas):
@@ -317,6 +408,25 @@ class DevicePipeline:
             if t is None:
                 continue
             out.append(ExecMutant(data, t, ets[i], batch, int(j)))
+        # Insert mutants: pristine template segments + donor splice
+        # (no patches to apply — zero-copy concat per mutant).
+        for j in np.flatnonzero(ok & is_ins):
+            i = int(batch.template_idx[j])
+            t = tmpl[i]
+            et = ets[i]
+            d_idx = int(batch.donor[j])
+            if t is None or et is None \
+                    or not (0 <= d_idx < len(self.bank.blocks)):
+                continue
+            block = self.bank.blocks[d_idx]
+            alive = batch.call_alive(j, max(et.ncalls, 1))
+            data = splice_insert(et, alive, block, int(batch.pos[j]))
+            if data is None:
+                self.stats.assemble_errors += 1
+                continue
+            out.append(ExecMutant(data, t, et, batch, int(j),
+                                  donor=block, donor_pos=int(batch.pos[j])))
+            self.stats.inserts += 1
         self.stats.batches += 1
         self.stats.mutants += len(out)
         return out

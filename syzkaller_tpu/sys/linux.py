@@ -91,9 +91,10 @@ def _attach_arch_hooks(t: Target, k: dict[str, int]) -> None:
                         and (mode.val & s_ifmt) in (s_ifchr, s_ifblk):
                     dev.val = harmless_dev
         elif name == "exit" or name == "exit_group":
-            # Keep exit codes in the executor's reserved-safe range.
+            # Keep exit codes off the executor's reserved statuses;
+            # the kernel truncates to 8 bits, so mask before checking.
             code = c.args[0] if c.args else None
-            if isinstance(code, ConstArg) and code.val in (67, 68, 69):
+            if isinstance(code, ConstArg) and (code.val & 0xFF) in (67, 68, 69):
                 code.val = 1
 
     t.sanitize_call = sanitize

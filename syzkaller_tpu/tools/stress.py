@@ -37,7 +37,7 @@ def main(argv=None) -> int:
         from syzkaller_tpu.fuzzer.proc import PipelineMutator
         from syzkaller_tpu.ops.pipeline import DevicePipeline
 
-        mutator = PipelineMutator(DevicePipeline(target))
+        mutator = PipelineMutator(DevicePipeline(target, ct=fuzzer.ct))
 
     import threading
 

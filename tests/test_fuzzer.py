@@ -141,8 +141,9 @@ def test_pipeline_mutator_op_distribution(target, env):
     """Integrated op-class distribution parity vs models/mutation.py:
     the first landed op of each PipelineMutator draw must be
     distributed like the first landed op of the CPU reference loop
-    over the same corpus (arg-mutate/remove count as 'device' there).
-    Two-sample chi-square, df=3, crit p=.001 -> 16.27."""
+    over the same corpus (insert/arg-mutate/remove are device classes
+    there — ~79% of iteration weight, VERDICT r2 #4).
+    Two-sample chi-square, df=2, crit p=.001 -> 13.82."""
     pytest.importorskip("jax")
     from syzkaller_tpu.fuzzer.proc import PipelineMutator
     from syzkaller_tpu.models.mutation import mutate_prog
@@ -155,7 +156,7 @@ def test_pipeline_mutator_op_distribution(target, env):
         p = generate_prog(target, RandGen(target, 3000 + i), 4)
         fuzzer.add_input_to_corpus(p, Signal({i: 1}), Cover())
     corpus = [it.p for it in fuzzer.corpus_snapshot()]
-    classes = ("squash", "splice", "insert", "device")
+    classes = ("squash", "splice", "device")
 
     # Reference sample: CPU mutate_prog over the same corpus.
     ref_rng = RandGen(target, 4242)
@@ -167,7 +168,7 @@ def test_pipeline_mutator_op_distribution(target, env):
         mutate_prog(p, ref_rng, fuzzer.cfg.program_length,
                     ct=fuzzer.ct, corpus=corpus, ops_out=journal)
         first = journal[0]
-        if first in ("mutate_arg", "remove"):
+        if first in ("insert", "mutate_arg", "remove"):
             first = "device"
         ref_counts[first] += 1
 
@@ -192,7 +193,7 @@ def test_pipeline_mutator_op_distribution(target, env):
             continue
         e = tot / 2  # equal sample sizes
         chi2 += (ref_counts[k] - e) ** 2 / e + (got_counts[k] - e) ** 2 / e
-    assert chi2 < 16.27, (
+    assert chi2 < 13.82, (
         f"op distribution skewed: ref={ref_counts} got={got_counts}")
 
 
