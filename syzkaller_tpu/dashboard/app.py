@@ -70,6 +70,9 @@ class Bug:
     reported_time: float = 0.0
     fix_commit: str = ""
     dup_of: str = ""
+    # Message-ID of the report mail; threads replies back to the bug
+    # across restarts (reference: reporting.go Reporting.ID).
+    report_msg_id: str = ""
     crashes: list[Crash] = field(default_factory=list)
 
 
@@ -244,6 +247,38 @@ class Dashboard:
             if out:
                 self._save()
         return out
+
+    def set_report_msg_id(self, bug_id: str, msg_id: str) -> None:
+        """Persist the report-mail threading id on the bug."""
+        with self._lock:
+            self.bugs[bug_id].report_msg_id = msg_id
+            self._save()
+
+    def report_threads(self) -> dict[str, str]:
+        """msg_id -> bug_id map rebuilt from persisted bugs (restart
+        recovery for the email reporting loop)."""
+        with self._lock:
+            return {b.report_msg_id: b.id for b in self.bugs.values()
+                    if b.report_msg_id}
+
+    def bug_report_payload(self, bug_id: str) -> dict:
+        """Report-mail payload for a bug: title, counts, best repro
+        (used by email.reporting; reference: reporting.go
+        createBugReport)."""
+        with self._lock:
+            bug = self.bugs[bug_id]
+            best = None
+            for c in bug.crashes:
+                if c.repro_prog:
+                    best = c
+                    break
+            if best is None and bug.crashes:
+                best = bug.crashes[0]
+            out = {"id": bug.id, "title": bug.title,
+                   "num_crashes": bug.num_crashes}
+            if best is not None and best.repro_prog:
+                out["repro_prog"] = best.repro_prog
+            return out
 
     def update_bug(self, bug_id: str, status: Optional[str] = None,
                    fix_commit: str = "", dup_of: str = "") -> None:

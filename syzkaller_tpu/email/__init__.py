@@ -1,0 +1,15 @@
+"""Email reporting workflow (reference: pkg/email +
+dashboard/app/reporting.go).
+
+parse.py turns inbound mail into commands + patches, render.py
+produces the syzbot-style bug report mails, reporting.py binds both to
+the Dashboard's bug lifecycle (new -> reported -> fixed/invalid/dup,
+plus '#syz test' patch jobs).
+"""
+
+from syzkaller_tpu.email.parse import Email, parse_email
+from syzkaller_tpu.email.render import render_report
+from syzkaller_tpu.email.reporting import EmailReporting, Mailbox
+
+__all__ = ["Email", "parse_email", "render_report", "EmailReporting",
+           "Mailbox"]

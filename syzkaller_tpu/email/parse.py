@@ -1,0 +1,123 @@
+"""Inbound mail parsing: commands, patches, quoting
+(reference: pkg/email/parser.go + patch.go).
+
+Recognized commands (lines beginning '#syz', anywhere in the
+unquoted body; reference command grammar: pkg/email/parser.go
+extractCommand):
+
+  #syz fix: <commit title>      mark fixed by commit
+  #syz dup: <bug title>         mark duplicate of another bug
+  #syz invalid                  close as invalid
+  #syz undup                    undo a dup
+  #syz test: <repo> <branch>    patch-test job (patch from the body)
+  #syz upstream                 escalate reporting (recorded only)
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from email import message_from_bytes
+from email.utils import getaddresses, parseaddr
+from typing import Optional
+
+
+@dataclass
+class Command:
+    name: str  # fix | dup | invalid | undup | test | upstream
+    args: str = ""
+
+
+@dataclass
+class Email:
+    msg_id: str = ""
+    in_reply_to: str = ""
+    subject: str = ""
+    from_addr: str = ""
+    to: list[str] = field(default_factory=list)
+    cc: list[str] = field(default_factory=list)
+    body: str = ""  # text/plain, quoting stripped
+    raw_body: str = ""
+    patch: str = ""  # unified diff found in the body, if any
+    commands: list[Command] = field(default_factory=list)
+
+
+_CMD_RE = re.compile(r"^#syz\s+([a-z-]+):?\s*(.*)$")
+# A unified diff starts at 'diff --git' or a '--- ' header followed by
+# '+++ ' (reference: pkg/email/patch.go ParsePatch).
+_DIFF_START = re.compile(r"^(diff --git |Index: |--- )")
+
+
+def _text_body(msg) -> str:
+    if msg.is_multipart():
+        for part in msg.walk():
+            if part.get_content_type() == "text/plain":
+                payload = part.get_payload(decode=True)
+                if payload is not None:
+                    return payload.decode("utf-8", "replace")
+        return ""
+    payload = msg.get_payload(decode=True)
+    if payload is None:
+        return str(msg.get_payload())
+    return payload.decode("utf-8", "replace")
+
+
+def _strip_quoting(body: str) -> str:
+    out = []
+    for line in body.splitlines():
+        if line.startswith(">"):
+            continue
+        if line.startswith("On ") and line.rstrip().endswith("wrote:"):
+            continue
+        out.append(line)
+    return "\n".join(out)
+
+
+def _extract_patch(body: str) -> str:
+    """First unified diff in the body through its last hunk line
+    (reference: pkg/email/patch.go)."""
+    lines = body.splitlines()
+    start = None
+    for i, line in enumerate(lines):
+        if _DIFF_START.match(line):
+            if line.startswith("--- ") and \
+                    (i + 1 >= len(lines)
+                     or not lines[i + 1].startswith("+++ ")):
+                continue
+            start = i
+            break
+    if start is None:
+        return ""
+    end = start
+    for j in range(start, len(lines)):
+        line = lines[j]
+        if line.startswith(("diff ", "Index: ", "--- ", "+++ ", "@@ ",
+                            "+", "-", " ")) or not line:
+            end = j
+        else:
+            break
+    return "\n".join(lines[start:end + 1]).strip("\n")
+
+
+def parse_email(raw: bytes) -> Email:
+    msg = message_from_bytes(raw)
+    body = _text_body(msg)
+    unquoted = _strip_quoting(body)
+    commands = []
+    for line in unquoted.splitlines():
+        m = _CMD_RE.match(line.strip())
+        if m:
+            commands.append(Command(name=m.group(1),
+                                    args=m.group(2).strip()))
+    return Email(
+        msg_id=(msg.get("Message-ID") or "").strip(),
+        in_reply_to=(msg.get("In-Reply-To") or "").strip(),
+        subject=msg.get("Subject", ""),
+        from_addr=parseaddr(msg.get("From", ""))[1],
+        to=[a for _, a in getaddresses(msg.get_all("To", []))],
+        cc=[a for _, a in getaddresses(msg.get_all("Cc", []))],
+        body=unquoted,
+        raw_body=body,
+        patch=_extract_patch(unquoted),
+        commands=commands,
+    )

@@ -1,0 +1,45 @@
+"""Outbound report mail rendering (reference: dashboard/app
+mail_bug.txt template + pkg/email formatting)."""
+
+from __future__ import annotations
+
+from email.message import EmailMessage
+
+REPORT_FOOTER = """\
+---
+This bug report was generated automatically.
+Reply to this email to communicate with the bot:
+
+#syz fix: exact-commit-title         when the bug is fixed
+#syz dup: exact-subject-of-another-report   to mark a duplicate
+#syz invalid                          to close an invalid report
+#syz test: git://repo/address.git branch    to test a patch
+(attach the patch inline to the reply)
+"""
+
+
+def render_report(bug: dict, from_addr: str, to: list[str],
+                  msg_id: str) -> bytes:
+    """One bug report mail; msg_id threads all future replies back to
+    the bug (reference: reporting.go sendMailReport)."""
+    m = EmailMessage()
+    m["Subject"] = bug["title"]
+    m["From"] = from_addr
+    m["To"] = ", ".join(to)
+    m["Message-ID"] = msg_id
+    body = [
+        "Hello,",
+        "",
+        f"The fuzzer hit the following crash ({bug.get('num_crashes', 1)}"
+        f" occurrences):",
+        "",
+        f"    {bug['title']}",
+        "",
+    ]
+    if bug.get("repro_prog"):
+        body += ["Reproducer program:", "", bug["repro_prog"], ""]
+    if bug.get("report"):
+        body += ["Console report:", "", bug["report"], ""]
+    body.append(REPORT_FOOTER)
+    m.set_content("\n".join(body))
+    return bytes(m)

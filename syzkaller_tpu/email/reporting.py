@@ -1,0 +1,138 @@
+"""The email reporting loop: Dashboard bugs out, commands in
+(reference: dashboard/app/reporting.go state machine +
+pkg/email round-trip).
+
+Transport is a Mailbox interface (send/receive of raw RFC822 bytes):
+production would bind SMTP/IMAP; tests bind an in-memory pair and
+drive the full new -> reported -> fixed/invalid/dup lifecycle.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from syzkaller_tpu.email.parse import Email, parse_email
+from syzkaller_tpu.email.render import render_report
+from syzkaller_tpu.utils import log
+
+
+class Mailbox:
+    """In-memory transport double (production: SMTP out, IMAP in)."""
+
+    def __init__(self):
+        self.outgoing: list[bytes] = []
+        self.incoming: list[bytes] = []
+
+    def send(self, raw: bytes) -> None:
+        self.outgoing.append(raw)
+
+    def deliver(self, raw: bytes) -> None:
+        self.incoming.append(raw)
+
+    def receive(self) -> Optional[bytes]:
+        if self.incoming:
+            return self.incoming.pop(0)
+        return None
+
+
+class EmailReporting:
+    """(reference: reporting.go reportingPoll + incomingMail)"""
+
+    def __init__(self, dashboard, mailbox: Mailbox,
+                 from_addr: str = "tz-bot@localhost",
+                 to: Optional[list[str]] = None):
+        self.dash = dashboard
+        self.mailbox = mailbox
+        self.from_addr = from_addr
+        self.to = to or ["kernel-dev@localhost"]
+        # msg-id <-> bug threading, persisted on the bug records so
+        # replies survive a reporting-process restart.
+        self.msg_to_bug: dict[str, str] = dashboard.report_threads()
+
+    # -- outbound --------------------------------------------------------
+
+    def poll_and_send(self) -> int:
+        """Send a report mail for every bug due for reporting;
+        returns how many were sent."""
+        sent = 0
+        for rep in self.dash.poll_reports():
+            bug_id = rep["id"]
+            msg_id = f"<tz-bug-{bug_id}@localhost>"
+            payload = self.dash.bug_report_payload(bug_id)
+            self.mailbox.send(render_report(payload, self.from_addr,
+                                            self.to, msg_id))
+            self.msg_to_bug[msg_id] = bug_id
+            self.dash.set_report_msg_id(bug_id, msg_id)
+            sent += 1
+        return sent
+
+    # -- inbound ---------------------------------------------------------
+
+    def process_incoming(self) -> int:
+        """Drain the inbox, applying '#syz' commands to their bugs;
+        returns how many commands were applied."""
+        applied = 0
+        while True:
+            raw = self.mailbox.receive()
+            if raw is None:
+                return applied
+            em = parse_email(raw)
+            bug_id = self.msg_to_bug.get(em.in_reply_to)
+            if bug_id is None:
+                log.logf(1, "email: reply to unknown thread %r",
+                         em.in_reply_to)
+                continue
+            applied += self._apply(bug_id, em)
+
+    def _apply(self, bug_id: str, em: Email) -> int:
+        n = 0
+        for cmd in em.commands:
+            if cmd.name == "fix":
+                if not cmd.args:
+                    self._nack(em, "fix command needs a commit title")
+                    continue
+                self.dash.update_bug(bug_id, fix_commit=cmd.args)
+            elif cmd.name == "dup":
+                if not cmd.args:
+                    self._nack(em, "dup command needs a bug title")
+                    continue
+                self.dash.update_bug(bug_id, dup_of=cmd.args)
+            elif cmd.name == "invalid":
+                self.dash.update_bug(bug_id, status="invalid")
+            elif cmd.name == "undup":
+                self.dash.update_bug(bug_id, status="reported", dup_of="")
+            elif cmd.name == "test":
+                parts = cmd.args.split()
+                if not em.patch:
+                    self._nack(em, "test command needs a patch in the body")
+                    continue
+                repo = parts[0] if parts else ""
+                branch = parts[1] if len(parts) > 1 else ""
+                self.dash.add_job(bug_id, em.patch, kernel_repo=repo,
+                                  kernel_branch=branch)
+            elif cmd.name == "upstream":
+                pass  # recorded implicitly; single-reporting setup
+            else:
+                self._nack(em, f"unknown command {cmd.name!r}")
+                continue
+            n += 1
+        return n
+
+    def _nack(self, em: Email, why: str) -> None:
+        """Error reply back to the sender (reference: reporting.go
+        replyTo with the error text)."""
+        from email.message import EmailMessage
+
+        m = EmailMessage()
+        m["Subject"] = "Re: " + em.subject
+        m["From"] = self.from_addr
+        m["To"] = em.from_addr
+        m["In-Reply-To"] = em.msg_id
+        m.set_content(f"Your command could not be processed: {why}\n")
+        self.mailbox.send(bytes(m))
+        log.logf(1, "email: bad command from %s: %s", em.from_addr, why)
+
+
+def _now() -> float:
+    return time.time()

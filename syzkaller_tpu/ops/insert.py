@@ -123,15 +123,11 @@ class DonorBank:
         return len(self.blocks)
 
 
-def choice_table_rows(target, ct) -> tuple[np.ndarray, np.ndarray]:
-    """Lower the ChoiceTable to device arrays:
-
-      runs[nid, nid]  prefix-sum priority row per context call id
-                      (uniform ramp where the table has no row)
-      bank_ok         passthrough convenience (filled by caller)
-
-    Sampling = binary search of a uniform draw in runs[ctx]
-    (reference: prog/prio.go:230-245)."""
+def choice_table_rows(target, ct) -> np.ndarray:
+    """Lower the ChoiceTable to a device array: runs[nid, nid] is the
+    prefix-sum priority row per context call id (uniform ramp where
+    the table has no row).  Sampling = binary search of a uniform draw
+    in runs[ctx] (reference: prog/prio.go:230-245)."""
     nid = max((c.id for c in target.syscalls), default=0) + 1
     runs = np.zeros((nid, nid), dtype=np.uint32)
     uniform = np.cumsum(np.ones(nid, dtype=np.uint32))
@@ -144,4 +140,4 @@ def choice_table_rows(target, ct) -> tuple[np.ndarray, np.ndarray]:
             if r.shape[0] < nid:
                 r = np.pad(r, (0, nid - r.shape[0]), mode="edge")
             runs[cid] = r if r[-1] > 0 else uniform
-    return runs, uniform
+    return runs

@@ -17,11 +17,12 @@ on varying shapes, and decoded every mutant back to a typed tree
     while executors drain the previous one (double buffering,
     SURVEY.md §7 hard part (c)).
 
-Structural ops the device cannot express (squash/splice/insert) stay
-host-side: fuzzer.proc.PipelineMutator draws the reference op ladder
-per mutant and routes the device classes (~28% of iterations:
-arg-mutate + remove) here, so the integrated op distribution matches
-the reference weighted loop (reference: prog/mutation.go:19-131).
+fuzzer.proc.PipelineMutator draws the reference op ladder per mutant
+and routes the device classes here — insert (donor-bank splice with
+ChoiceTable sampling, ops/insert.py), arg-mutate and remove, together
+~79% of iteration weight — while squash/splice stay host-side, so the
+integrated op distribution matches the reference weighted loop
+(reference: prog/mutation.go:19-131).
 """
 
 from __future__ import annotations
@@ -219,7 +220,7 @@ class DevicePipeline:
 
             ct = build_choice_table(target)
         self.bank = DonorBank(target, ct, seed=seed)
-        runs_np, _ = choice_table_rows(target, ct)
+        runs_np = choice_table_rows(target, ct)
         self._runs_dev = jnp.asarray(runs_np)
         self._by_syscall_dev = jnp.asarray(self.bank.by_syscall)
         n_blocks = len(self.bank)

@@ -73,15 +73,30 @@ class AdbInstance(Instance):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         self._procs.append(proc)
 
+        # Console keeps draining for a grace window after the shell
+        # channel dies: a device panic kills the shell first while the
+        # oops is still flushing over dmesg/serial.
+        shell_pump = pump_fd(proc.stdout, stream, proc, stop, timeout_s,
+                             finish_stream=False)
+
         def pump_console():
+            import time as _time
+
+            grace_deadline = None
             while not stop.is_set() and con.poll() is None:
+                if proc.poll() is not None and grace_deadline is None:
+                    grace_deadline = _time.monotonic() + 10.0
+                if grace_deadline is not None \
+                        and _time.monotonic() > grace_deadline:
+                    break
                 chunk = con.stdout.read1(1 << 14)
                 if not chunk:
                     break
                 stream.put(chunk)
+            shell_pump.join()
+            stream.finish(stream.error)
 
         threading.Thread(target=pump_console, daemon=True).start()
-        pump_fd(proc.stdout, stream, proc, stop, timeout_s)
         return stream
 
     def diagnose(self) -> bytes:

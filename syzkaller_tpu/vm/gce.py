@@ -100,15 +100,29 @@ class GCEInstance(Instance):
                                 stderr=subprocess.STDOUT)
         con = self._console
 
+        # Merge ssh + serial console; the console keeps draining for a
+        # grace window after the ssh channel dies — a guest panic kills
+        # sshd first while the oops is still flushing over serial (same
+        # merger shape as the qemu backend).
+        ssh_pump = pump_fd(proc.stdout, stream, proc, stop, timeout_s,
+                           finish_stream=False)
+
         def pump_console():
+            grace_deadline = None
             while not stop.is_set() and con.poll() is None:
+                if proc.poll() is not None and grace_deadline is None:
+                    grace_deadline = time.monotonic() + 10.0
+                if grace_deadline is not None \
+                        and time.monotonic() > grace_deadline:
+                    break
                 chunk = con.stdout.read1(1 << 14)
                 if not chunk:
                     break
                 stream.put(chunk)
+            ssh_pump.join()
+            stream.finish(stream.error)
 
         threading.Thread(target=pump_console, daemon=True).start()
-        pump_fd(proc.stdout, stream, proc, stop, timeout_s)
         return stream
 
     def close(self) -> None:

@@ -115,7 +115,9 @@ class QemuInstance(Instance):
             raise BootError(f"failed to start {a['qemu']}: {e}") from e
         self._console_stop = threading.Event()
         self._console_buf = bytearray()
-        threading.Thread(target=self._pump_console, daemon=True).start()
+        self._console_thread = threading.Thread(target=self._pump_console,
+                                                daemon=True)
+        self._console_thread.start()
         self._wait_ssh(timeout_s)
 
     def _pump_console(self) -> None:
@@ -134,6 +136,9 @@ class QemuInstance(Instance):
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             if self._proc.poll() is not None:
+                # Let the console pump drain the death message before
+                # reporting (it exits once the pipe hits EOF).
+                self._console_thread.join(timeout=2.0)
                 raise BootError(
                     "qemu exited during boot: "
                     + bytes(self._console_buf[-2048:]).decode("utf-8",

@@ -1,0 +1,197 @@
+"""Email reporting workflow (VERDICT r2 #10; reference: pkg/email +
+dashboard/app/reporting.go).
+
+The lifecycle gate: a crash flows new -> reported (mail out) ->
+fixed/invalid/dup (command replies in), plus '#syz test' patch jobs,
+all via simulated mail round-trips.
+"""
+
+from email.message import EmailMessage
+
+import pytest
+
+from syzkaller_tpu.dashboard.app import (
+    STATUS_DUP,
+    STATUS_FIXED,
+    STATUS_INVALID,
+    STATUS_REPORTED,
+    Dashboard,
+)
+from syzkaller_tpu.email import EmailReporting, Mailbox, parse_email
+
+
+@pytest.fixture
+def dash(tmp_path):
+    return Dashboard(str(tmp_path), clients={"mgr": "key"},
+                     reporting_delay_s=0.0)
+
+
+def _crash(dash, title="BUG: unable to handle kernel NULL pointer "
+                       "dereference in foo", repro=""):
+    return dash.report_crash({
+        "client": "mgr", "key": "key", "manager": "mgr",
+        "title": title, "repro_prog": repro, "log": "log!",
+        "report": "BUG: ...\nCall Trace:\n foo+0x1/0x2",
+    })["bug_id"]
+
+
+def _reply(reporting, commands: str, subject="Re: bug",
+           patch: str = "", report_raw: bytes = None) -> None:
+    if report_raw is None:
+        report_raw = reporting.mailbox.outgoing[-1]
+    rep = parse_email(report_raw)
+    m = EmailMessage()
+    m["Subject"] = subject
+    m["From"] = "dev@kernel.org"
+    m["To"] = rep.from_addr
+    m["In-Reply-To"] = rep.msg_id
+    m["Message-ID"] = "<reply-1@kernel.org>"
+    body = f"Thanks.\n\n{commands}\n"
+    if patch:
+        body += "\n" + patch + "\n"
+    body += "\n> quoted original\n"
+    m.set_content(body)
+    reporting.mailbox.deliver(bytes(m))
+
+
+def test_lifecycle_new_reported_fixed(dash):
+    bug_id = _crash(dash, repro="r0 = dz_open(...)")
+    mbox = Mailbox()
+    reporting = EmailReporting(dash, mbox)
+
+    assert dash.bugs[bug_id].status == "new"
+    assert reporting.poll_and_send() == 1
+    assert dash.bugs[bug_id].status == STATUS_REPORTED
+
+    # The outbound mail is a well-formed report with the repro and
+    # the command footer.
+    rep = parse_email(mbox.outgoing[0])
+    assert dash.bugs[bug_id].title in rep.subject
+    assert "dz_open" in rep.raw_body
+    assert "#syz fix:" in rep.raw_body
+
+    _reply(reporting, "#syz fix: kernel: fix null deref in foo")
+    assert reporting.process_incoming() == 1
+    bug = dash.bugs[bug_id]
+    assert bug.status == STATUS_FIXED
+    assert bug.fix_commit == "kernel: fix null deref in foo"
+
+
+def test_lifecycle_invalid_and_dup(dash):
+    b1 = _crash(dash, title="WARNING in bar")
+    mbox = Mailbox()
+    reporting = EmailReporting(dash, mbox)
+    reporting.poll_and_send()
+    _reply(reporting, "#syz invalid")
+    reporting.process_incoming()
+    assert dash.bugs[b1].status == STATUS_INVALID
+
+    b2 = _crash(dash, title="KASAN: use-after-free in baz")
+    reporting.poll_and_send()
+    _reply(reporting, "#syz dup: WARNING in bar")
+    reporting.process_incoming()
+    assert dash.bugs[b2].status == STATUS_DUP
+    assert dash.bugs[b2].dup_of == "WARNING in bar"
+
+    # undup restores the reported state.
+    _reply(reporting, "#syz undup")
+    reporting.process_incoming()
+    assert dash.bugs[b2].status == STATUS_REPORTED
+
+
+def test_patch_test_command_creates_job(dash):
+    bug_id = _crash(dash, title="BUG: soft lockup in qux")
+    mbox = Mailbox()
+    reporting = EmailReporting(dash, mbox)
+    reporting.poll_and_send()
+    patch = (
+        "diff --git a/fs/foo.c b/fs/foo.c\n"
+        "--- a/fs/foo.c\n"
+        "+++ b/fs/foo.c\n"
+        "@@ -1,2 +1,3 @@\n"
+        " int foo(void) {\n"
+        "+  if (!p) return -EINVAL;\n"
+        " }\n")
+    _reply(reporting,
+           "#syz test: git://git.kernel.org/torvalds/linux.git master",
+           patch=patch)
+    assert reporting.process_incoming() == 1
+    jobs = [j for j in dash.jobs.values() if j.bug_id == bug_id]
+    assert len(jobs) == 1
+    assert "return -EINVAL" in jobs[0].patch
+    assert jobs[0].kernel_repo.endswith("linux.git")
+    assert jobs[0].kernel_branch == "master"
+
+
+def test_bad_commands_get_error_replies(dash):
+    _crash(dash, title="BUG: sleeping in atomic in quux")
+    mbox = Mailbox()
+    reporting = EmailReporting(dash, mbox)
+    reporting.poll_and_send()
+    report_raw = mbox.outgoing[-1]
+    n_out = len(mbox.outgoing)
+    _reply(reporting, "#syz fix:")  # missing commit title
+    assert reporting.process_incoming() == 0
+    assert len(mbox.outgoing) == n_out + 1
+    nack = parse_email(mbox.outgoing[-1])
+    assert "could not be processed" in nack.raw_body
+
+    _reply(reporting, "#syz frobnicate",  # unknown command
+           report_raw=report_raw)
+    reporting.process_incoming()
+    assert "unknown command" in parse_email(mbox.outgoing[-1]).raw_body
+
+
+def test_threading_survives_restart(dash, tmp_path):
+    """Report threading is persisted on the bug: a reply arriving
+    after the reporting process restarts still lands."""
+    bug_id = _crash(dash, title="BUG: restart survivor")
+    mbox = Mailbox()
+    reporting = EmailReporting(dash, mbox)
+    reporting.poll_and_send()
+    report_raw = mbox.outgoing[-1]
+
+    # Fresh dashboard + reporting instances from persisted state.
+    dash2 = Dashboard(str(tmp_path), clients={"mgr": "key"},
+                      reporting_delay_s=0.0)
+    mbox2 = Mailbox()
+    reporting2 = EmailReporting(dash2, mbox2)
+    _reply(reporting2, "#syz fix: the fix", report_raw=report_raw)
+    assert reporting2.process_incoming() == 1
+    assert dash2.bugs[bug_id].status == STATUS_FIXED
+
+
+def test_reply_to_unknown_thread_ignored(dash):
+    mbox = Mailbox()
+    reporting = EmailReporting(dash, mbox)
+    m = EmailMessage()
+    m["Subject"] = "stray"
+    m["From"] = "rando@example.com"
+    m["In-Reply-To"] = "<not-a-bug@localhost>"
+    m.set_content("#syz invalid\n")
+    mbox.deliver(bytes(m))
+    assert reporting.process_incoming() == 0
+
+
+def test_parse_quoting_and_patch_extraction():
+    m = EmailMessage()
+    m["Subject"] = "Re: something"
+    m["From"] = "Dev Name <dev@example.com>"
+    m["Message-ID"] = "<x@y>"
+    m.set_content(
+        "On Mon, Someone wrote:\n"
+        "> #syz invalid\n"
+        "Real text.\n"
+        "#syz test: repo branch\n"
+        "diff --git a/a.c b/a.c\n"
+        "--- a/a.c\n"
+        "+++ b/a.c\n"
+        "@@ -1 +1 @@\n"
+        "-old\n"
+        "+new\n")
+    em = parse_email(bytes(m))
+    # Quoted '#syz invalid' must NOT be picked up.
+    assert [c.name for c in em.commands] == ["test"]
+    assert em.patch.startswith("diff --git")
+    assert "+new" in em.patch
+    assert em.from_addr == "dev@example.com"

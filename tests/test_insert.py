@@ -51,7 +51,7 @@ def test_donor_bank_builds(test_target):
         assert block.words.size > 0
         assert parse_stream(block.words.tobytes()
                             + b"\xff" * 8) == block.call_ids
-    runs, _ = choice_table_rows(test_target, ct)
+    runs = choice_table_rows(test_target, ct)
     assert runs.shape[0] == runs.shape[1]
     assert (runs[:, -1] > 0).all()
 

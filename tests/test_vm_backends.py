@@ -1,0 +1,257 @@
+"""VM backends against PATH-shimmed fake CLIs (VERDICT r2 #9).
+
+Every cloud/device backend is exercised through its real subprocess
+surface — fake qemu-system/ssh/scp/adb/gcloud/lkvm binaries driven by
+a control directory — covering construct, boot-failure, recovery, run,
+and crash detection via monitor_execution (the reference exercises
+these only in production; here the CLI seam is the test boundary,
+reference shape: vm/qemu/qemu.go:228 Boot, vm/vm.go MonitorExecution).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from syzkaller_tpu.report import get_reporter
+from syzkaller_tpu.vm.vm import monitor_execution
+from syzkaller_tpu.vm.vmimpl import BootError, Env, create_pool_impl
+
+
+@pytest.fixture
+def fakecli(tmp_path, monkeypatch):
+    ctl = tmp_path / "ctl"
+    ctl.mkdir()
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    class Fake:
+        def __init__(self):
+            self.ctl = ctl
+            self.bindir = bindir
+
+        def shim(self, name: str, body: str) -> None:
+            p = bindir / name
+            p.write_text(f"#!/bin/bash\nCTL={ctl}\n{body}\n")
+            p.chmod(0o755)
+
+        def set(self, flag: str) -> None:
+            (ctl / flag).write_text("1")
+
+        def clear(self, flag: str) -> None:
+            try:
+                (ctl / flag).unlink()
+            except FileNotFoundError:
+                pass
+
+    f = Fake()
+    # Shared ssh/scp fakes: `ssh ... user@host cmd...` succeeds once
+    # $CTL/booted exists; the "true" probe is the boot gate; any other
+    # command streams guest output until the oops flag kills sshd.
+    f.shim("ssh", r"""
+for last; do :; done
+if [ ! -f "$CTL/booted" ]; then echo "Connection refused" >&2; exit 255; fi
+if [ "$last" = "true" ]; then exit 0; fi
+for i in $(seq 1 100); do
+  echo "executing program 0:"
+  sleep 0.1
+  if [ -f "$CTL/oops" ]; then exit 255; fi
+done
+""")
+    f.shim("scp", r"""
+if [ ! -f "$CTL/booted" ]; then echo "Connection refused" >&2; exit 255; fi
+exit 0
+""")
+    return f
+
+
+def _drive_crash(inst, f) -> None:
+    """Run the instance, inject an oops mid-run, expect a parsed
+    report from monitor_execution."""
+    stop = threading.Event()
+    stream = inst.run(60.0, stop, "fuzz-forever")
+    threading.Timer(1.0, lambda: f.set("oops")).start()
+    res = monitor_execution(stream, get_reporter("linux"),
+                            need_executing=False)
+    stop.set()
+    assert res.report is not None, \
+        f"no crash detected; output tail: {res.output[-400:]!r}"
+    assert b"NULL pointer" in res.report.title.encode() \
+        or "BUG" in res.report.title
+
+
+def test_qemu_boot_fail_recover_run_crash(fakecli, tmp_path):
+    f = fakecli
+    f.shim("qemu-system-x86_64", r"""
+if [ -f "$CTL/qemu_fail" ]; then echo "qemu: could not load kernel"; exit 1; fi
+echo "[    0.000000] Linux version 4.19.0-fake"
+touch "$CTL/booted"
+for i in $(seq 1 600); do
+  sleep 0.1
+  if [ -f "$CTL/oops" ]; then
+    echo "BUG: unable to handle kernel NULL pointer dereference at 00000000000000a8"
+    echo "IP: fake_poke+0x12/0x40"
+    echo "Call Trace:"
+    echo " fake_syscall+0x1/0x2"
+    echo "---[ end trace ]---"
+    rm -f "$CTL/oops"
+  fi
+done
+""")
+    env = Env(name="t", os="linux", arch="amd64",
+              workdir=str(tmp_path), image="",
+              config={"count": 1, "boot_timeout": 30})
+    pool = create_pool_impl("qemu", env)
+    assert pool.count() == 1
+
+    # Boot failure surfaces as BootError with the console tail...
+    f.set("qemu_fail")
+    os.makedirs(tmp_path / "i0", exist_ok=True)
+    with pytest.raises(BootError, match="could not load kernel"):
+        pool.create(str(tmp_path / "i0"), 0)
+    # ...and the next create (the manager's recovery loop) succeeds.
+    f.clear("qemu_fail")
+    os.makedirs(tmp_path / "i0", exist_ok=True)
+    inst = pool.create(str(tmp_path / "i0"), 0)
+    try:
+        dst = inst.copy(__file__)
+        assert dst.startswith("/")
+        _drive_crash(inst, f)
+        assert b"Linux version" in inst.diagnose()
+    finally:
+        inst.close()
+
+
+def test_adb_device_flow(fakecli, tmp_path):
+    f = fakecli
+    f.shim("adb", r"""
+shift 2  # -s <device>
+case "$1" in
+  wait-for-device) [ -f "$CTL/booted" ] || exit 1; exit 0;;
+  push|reverse|reboot) exit 0;;
+  shell)
+    shift
+    case "$*" in
+      "echo ok") echo ok;;
+      "dmesg -w")
+        for i in $(seq 1 300); do
+          sleep 0.1
+          if [ -f "$CTL/oops" ]; then
+            echo "BUG: unable to handle kernel NULL pointer dereference at 00000000deadbeef"
+            echo "Call Trace:"
+            rm -f "$CTL/oops"
+          fi
+        done;;
+      dmesg) echo "fake dmesg";;
+      *) for i in $(seq 1 100); do echo "executing program 0:"; sleep 0.1;
+           [ -f "$CTL/oops.stop" ] && exit 1; done;;
+    esac; exit 0;;
+  *) exit 0;;
+esac
+""")
+    env = Env(name="t", os="linux", arch="arm64", workdir=str(tmp_path),
+              config={"devices": ["FAKESERIAL"]})
+    pool = create_pool_impl("adb", env)
+    # Device not up: construct fails (recovery = retry after boot).
+    with pytest.raises(BootError):
+        pool.create(str(tmp_path / "a0"), 0)
+    f.set("booted")
+    inst = pool.create(str(tmp_path / "a0"), 0)
+    try:
+        assert inst.copy(__file__).startswith("/data/local/tmp/")
+        _drive_crash(inst, f)
+    finally:
+        inst.close()
+
+
+def test_gce_instance_flow(fakecli, tmp_path):
+    f = fakecli
+    f.shim("gcloud", r"""
+shift  # compute
+case "$1" in
+  instances)
+    case "$2" in
+      create) [ -f "$CTL/gce_fail" ] && { echo "quota" >&2; exit 1; }
+              touch "$CTL/booted"; exit 0;;
+      describe) echo "203.0.113.7"; exit 0;;
+      delete) exit 0;;
+    esac;;
+  connect-to-serial-port)
+    for i in $(seq 1 300); do
+      sleep 0.1
+      if [ -f "$CTL/oops" ]; then
+        echo "BUG: unable to handle kernel NULL pointer dereference at 0000000000000000"
+        echo "Call Trace:"
+        rm -f "$CTL/oops"
+      fi
+    done; exit 0;;
+esac
+exit 0
+""")
+    env = Env(name="tz", os="linux", arch="amd64", workdir=str(tmp_path),
+              config={"count": 1})
+    pool = create_pool_impl("gce", env)
+    f.set("gce_fail")
+    with pytest.raises(BootError, match="quota"):
+        pool.create(str(tmp_path / "g0"), 0)
+    f.clear("gce_fail")
+    inst = pool.create(str(tmp_path / "g0"), 0)
+    try:
+        assert inst.copy(__file__).startswith("/")
+        _drive_crash(inst, f)
+    finally:
+        inst.close()
+
+
+def test_isolated_machine_flow(fakecli, tmp_path):
+    f = fakecli
+    f.set("booted")
+    env = Env(name="t", os="linux", arch="amd64", workdir=str(tmp_path),
+              config={"targets": ["203.0.113.9"]})
+    pool = create_pool_impl("isolated", env)
+    inst = pool.create(str(tmp_path / "iso0"), 0)
+    try:
+        assert inst.copy(__file__)
+        stop = threading.Event()
+        stream = inst.run(5.0, stop, "runme")
+        got = bytearray()
+        while True:
+            chunk = stream.get(timeout=1.0)
+            if chunk is None:
+                break
+            got += chunk
+            if b"executing program" in got:
+                break
+        stop.set()
+        assert b"executing program" in got
+    finally:
+        inst.close()
+
+
+def test_kvm_lkvm_flow(fakecli, tmp_path):
+    f = fakecli
+    f.shim("lkvm", r"""
+case "$1" in
+  run)
+    echo "  # lkvm run -k bzImage"
+    touch "$CTL/booted"
+    for i in $(seq 1 200); do sleep 0.1; done;;
+  *) exit 0;;
+esac
+""")
+    f.set("booted")
+    env = Env(name="t", os="linux", arch="amd64", workdir=str(tmp_path),
+              config={"count": 1, "kernel": "bzImage"})
+    pool = create_pool_impl("kvm", env)
+    inst = pool.create(str(tmp_path / "k0"), 0)
+    try:
+        stop = threading.Event()
+        stream = inst.run(5.0, stop, "true")
+        while stream.get(timeout=0.5) is not None:
+            pass
+        stop.set()
+    finally:
+        inst.close()
