@@ -124,6 +124,14 @@ static uint64_t read_guest_int(uint64_t addr, uint64_t size) {
   return v;
 }
 
+}  // namespace tz
+
+// Environment features + syz_* pseudo-syscalls for the real-OS
+// backend (needs guest()/debugf() above).
+#include "pseudo_linux.h"
+
+namespace tz {
+
 // ---- inet checksum ---------------------------------------------------
 
 static uint16_t csum_fold(uint64_t sum) {
@@ -401,10 +409,23 @@ class Worker {
       static thread_local bool kcov_ok = kcov.open_();
       bool want_cmps = j->collect_comps;
       if (kcov_ok) kcov.enable(want_cmps);
-      long res = syscall(j->nr, j->args[0], j->args[1], j->args[2],
-                         j->args[3], j->args[4], j->args[5]);
-      o->errno_ = res == -1 ? errno : 0;
-      o->ret = res == -1 ? 0 : (uint64_t)res;
+      long res;
+      if (j->nr >= kPseudoNrBase) {
+        // executor-implemented syz_* helper; returns -errno on failure
+        res = execute_pseudo(j->nr, j->args, j->nargs);
+        if (res < 0) {
+          o->errno_ = (uint32_t)-res;
+          o->ret = 0;
+        } else {
+          o->errno_ = 0;
+          o->ret = (uint64_t)res;
+        }
+      } else {
+        res = syscall(j->nr, j->args[0], j->args[1], j->args[2],
+                      j->args[3], j->args[4], j->args[5]);
+        o->errno_ = res == -1 ? errno : 0;
+        o->ret = res == -1 ? 0 : (uint64_t)res;
+      }
       if (kcov_ok) {
         if (want_cmps)
           cmps_len = kcov.disable_cmps(cmps, kMaxCmps);
@@ -738,6 +759,9 @@ static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
   rep->ncalls = written;
   rep->status = 0;
   for (auto& pc : calls) delete pc.job;  // stubs or completed jobs
+#if defined(__linux__)
+  pseudo_cleanup();  // unmount syz_mount_image mounts of this program
+#endif
   {
     // Don't leak an unfired fault onward; abandoned jobs may still be
     // in sim->exec, so take the sim lock.
@@ -748,16 +772,25 @@ static void execute_program(const ExecuteReq& req, ExecuteRep* rep,
 
 // ---- sandbox ---------------------------------------------------------
 
-static void apply_sandbox() {
-  if (g_env_flags & kEnvSandboxSetuid) {
+// Ordering contract (reference: common_linux.h does the same dance):
+// namespace unshare FIRST (so the tap device lives in the sandbox
+// netns), then privileged env setup (TUN needs CAP_NET_ADMIN, cgroups
+// need write access), then the setuid privilege drop LAST.
+static void apply_sandbox_and_env() {
 #if defined(__linux__)
+  if (g_env_flags & kEnvSandboxNamespace)
+    sandbox_namespace();  // fresh user/mount/net/ipc/uts ns, uid 0 in
+  if (!(g_env_flags & kEnvSimOS)) {
+    if (g_env_flags & kEnvEnableTun) setup_tun(g_pid);
+    if (g_env_flags & kEnvEnableCgroups) setup_cgroups(g_pid);
+  }
+  if (g_env_flags & kEnvSandboxSetuid) {
     // drop to nobody best-effort (reference: common_linux.h:1216)
     if (setgid(65534)) debugf("setgid failed: %d\n", errno);
     if (setuid(65534)) debugf("setuid failed: %d\n", errno);
-#endif
   }
-  // namespace sandbox needs CLONE_NEWUSER plumbing; the sim backend
-  // doesn't touch the host so "none" is safe there.
+#endif
+  // the sim backend doesn't touch the host, so "none" is safe there.
 }
 
 // ---- main loop -------------------------------------------------------
@@ -819,7 +852,7 @@ static int executor_main(int argc, char** argv) {
     if (g_arena == MAP_FAILED) failf("executor: arena mmap failed");
   }
 
-  apply_sandbox();
+  apply_sandbox_and_env();
 
   HandshakeRep hr{kHandshakeRepMagic};
   write_exact(1, &hr, sizeof(hr));

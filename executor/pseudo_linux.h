@@ -1,0 +1,601 @@
+// Linux-backend environment setup + syz_* pseudo-syscalls.
+//
+// Fills the role of the reference's common_linux.h environment layer:
+// namespace sandbox (reference: common_linux.h:1375 sandbox_namespace),
+// TUN-based packet injection (common_linux.h:332-560), cgroup setup
+// (common_linux.h:1075-1170), loop-device images (syz_mount_image /
+// syz_read_part_table), and the executor-implemented syz_* pseudo
+// syscalls (common_linux.h:1041+), including a compact
+// syz_kvm_setup_cpu (common_kvm_amd64.h).  Everything is best-effort:
+// a kernel facility that is absent (no /dev/net/tun, no /dev/kvm, ro
+// cgroupfs, no CAP_SYS_ADMIN) degrades to a debug note and ENOSYS/
+// ENODEV for the calls that need it, never an executor failure —
+// containers and CI hosts stay usable.
+//
+// This header is linux-only and included from executor.cc.
+
+#ifndef TZ_EXECUTOR_PSEUDO_LINUX_H
+#define TZ_EXECUTOR_PSEUDO_LINUX_H
+
+#if defined(__linux__)
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <linux/if.h>
+#include <linux/if_tun.h>
+#include <linux/loop.h>
+#include <net/if_arp.h>
+#include <sched.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/ioctl.h>
+#include <sys/mount.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace tz {
+
+// Included from executor.cc after its guest()/debugf() definitions;
+// both are visible here.
+
+// ---- namespace sandbox ----------------------------------------------
+
+static bool write_file_str(const char* path, const char* data) {
+  int fd = open(path, O_WRONLY);
+  if (fd < 0) return false;
+  ssize_t len = (ssize_t)strlen(data);
+  bool ok = write(fd, data, len) == len;
+  close(fd);
+  return ok;
+}
+
+// unshare into fresh user/mount/net/ipc/uts namespaces and map the
+// current uid to root inside.  Each step is best-effort: partial
+// isolation is still isolation (reference: common_linux.h:1375-1460
+// does this with clone flags at process creation; we sandbox the
+// already-running fork-server, which the fork-per-program children
+// then inherit).
+static void sandbox_namespace() {
+  uid_t uid = geteuid();
+  gid_t gid = getegid();
+  if (unshare(CLONE_NEWUSER | CLONE_NEWNS | CLONE_NEWNET |
+              CLONE_NEWIPC | CLONE_NEWUTS)) {
+    // no user namespaces (kernel.unprivileged_userns_clone=0 or
+    // seccomp): try without NEWUSER (works when already root)
+    if (unshare(CLONE_NEWNS | CLONE_NEWNET | CLONE_NEWIPC |
+                CLONE_NEWUTS)) {
+      debugf("sandbox: unshare failed: %d\n", errno);
+      return;
+    }
+  } else {
+    char buf[64];
+    write_file_str("/proc/self/setgroups", "deny");
+    snprintf(buf, sizeof(buf), "0 %d 1", (int)uid);
+    if (!write_file_str("/proc/self/uid_map", buf))
+      debugf("sandbox: uid_map write failed: %d\n", errno);
+    snprintf(buf, sizeof(buf), "0 %d 1", (int)gid);
+    if (!write_file_str("/proc/self/gid_map", buf))
+      debugf("sandbox: gid_map write failed: %d\n", errno);
+  }
+  // private mount propagation + a scratch tmpfs workdir
+  if (mount(nullptr, "/", nullptr, MS_REC | MS_PRIVATE, nullptr))
+    debugf("sandbox: MS_PRIVATE remount failed: %d\n", errno);
+  if (mount("none", "/tmp", "tmpfs", 0, nullptr) == 0)
+    (void)chdir("/tmp");
+  // bring up loopback in the fresh netns so sockets work
+  int sock = socket(AF_INET, SOCK_DGRAM, 0);
+  if (sock >= 0) {
+    struct ifreq ifr;
+    memset(&ifr, 0, sizeof(ifr));
+    strncpy(ifr.ifr_name, "lo", IFNAMSIZ - 1);
+    if (ioctl(sock, SIOCGIFFLAGS, &ifr) == 0) {
+      ifr.ifr_flags |= IFF_UP | IFF_RUNNING;
+      ioctl(sock, SIOCSIFFLAGS, &ifr);
+    }
+    close(sock);
+  }
+}
+
+// ---- TUN packet injection -------------------------------------------
+// A tap device gives programs an L2 injection point:
+// syz_emit_ethernet writes raw frames, syz_extract_tcp_res reads the
+// kernel's reply to learn live seq/ack numbers
+// (reference: common_linux.h:332-560, sys/linux/vnet.txt).
+
+static int g_tun_fd = -1;
+
+static void setup_tun(uint64_t pid) {
+  g_tun_fd = open("/dev/net/tun", O_RDWR | O_NONBLOCK);
+  if (g_tun_fd < 0) {
+    debugf("tun: /dev/net/tun unavailable: %d\n", errno);
+    return;
+  }
+  struct ifreq ifr;
+  memset(&ifr, 0, sizeof(ifr));
+  snprintf(ifr.ifr_name, IFNAMSIZ, "tz_tun%d", (int)pid);
+  ifr.ifr_flags = IFF_TAP | IFF_NO_PI;
+  if (ioctl(g_tun_fd, TUNSETIFF, &ifr)) {
+    debugf("tun: TUNSETIFF failed: %d\n", errno);
+    close(g_tun_fd);
+    g_tun_fd = -1;
+    return;
+  }
+  int sock = socket(AF_INET, SOCK_DGRAM, 0);
+  if (sock >= 0) {
+    // deterministic MAC (aa:aa:aa:aa:aa:pid) + 172.20.<pid>.1/24, up
+    struct ifreq ifr2;
+    memset(&ifr2, 0, sizeof(ifr2));
+    memcpy(ifr2.ifr_name, ifr.ifr_name, IFNAMSIZ);
+    ifr2.ifr_hwaddr.sa_family = ARPHRD_ETHER;
+    memset(ifr2.ifr_hwaddr.sa_data, 0xaa, 6);
+    ifr2.ifr_hwaddr.sa_data[5] = (char)pid;
+    ioctl(sock, SIOCSIFHWADDR, &ifr2);
+    auto* sin = (struct sockaddr_in*)&ifr2.ifr_addr;
+    sin->sin_family = AF_INET;
+    sin->sin_addr.s_addr = htonl(0xAC140001 | ((uint32_t)pid << 8));
+    ioctl(sock, SIOCSIFADDR, &ifr2);
+    ioctl(sock, SIOCGIFFLAGS, &ifr2);
+    ifr2.ifr_flags |= IFF_UP | IFF_RUNNING;
+    ioctl(sock, SIOCSIFFLAGS, &ifr2);
+    close(sock);
+  }
+  debugf("tun: %s ready fd=%d\n", ifr.ifr_name, g_tun_fd);
+}
+
+// ---- cgroups --------------------------------------------------------
+
+static void setup_cgroups(uint64_t pid) {
+  // one subtree per proc under whichever cgroup fs is writable
+  // (reference: common_linux.h:1075-1170 creates /syzcgroup/{unified,
+  // cpu,net}; we reuse the host mount which is what containers allow)
+  const char* roots[] = {"/sys/fs/cgroup", "/sys/fs/cgroup/unified"};
+  for (const char* root : roots) {
+    char dir[128];
+    snprintf(dir, sizeof(dir), "%s/tz%d", root, (int)pid);
+    if (mkdir(dir, 0777) == 0 || errno == EEXIST) {
+      char procs[160];
+      snprintf(procs, sizeof(procs), "%s/cgroup.procs", dir);
+      char self[32];
+      snprintf(self, sizeof(self), "%d", (int)getpid());
+      if (write_file_str(procs, self)) {
+        debugf("cgroups: joined %s\n", dir);
+        return;
+      }
+    }
+  }
+  debugf("cgroups: no writable cgroup fs (ok)\n");
+}
+
+// ---- guest strings --------------------------------------------------
+
+static void read_guest_str(uint64_t addr, char* out, size_t cap) {
+  size_t i = 0;
+  for (; addr != 0 && i < cap - 1; i++) {
+    char c = ((const char*)guest(addr + i, 1))[0];
+    if (c == 0) break;
+    out[i] = c;
+  }
+  out[i] = 0;
+}
+
+// ---- loop devices ---------------------------------------------------
+
+static int loop_attach(int img_fd) {
+  int ctl = open("/dev/loop-control", O_RDWR);
+  if (ctl < 0) return -1;
+  int idx = ioctl(ctl, LOOP_CTL_GET_FREE);
+  close(ctl);
+  if (idx < 0) return -1;
+  char path[32];
+  snprintf(path, sizeof(path), "/dev/loop%d", idx);
+  int lfd = open(path, O_RDWR);
+  if (lfd < 0) return -1;
+  if (ioctl(lfd, LOOP_SET_FD, img_fd)) {
+    close(lfd);
+    return -1;
+  }
+  return lfd;
+}
+
+static void loop_detach(int lfd) {
+  if (lfd >= 0) {
+    ioctl(lfd, LOOP_CLR_FD, 0);
+    close(lfd);
+  }
+}
+
+// build a temp image file from (offset, size, data-ptr) segments
+struct ImgSegment {   // guest layout used by syz_mount_image/
+  uint64_t addr;      // read_part_table: {data ptr, size, offset}
+  uint64_t size;
+  uint64_t offset;
+};
+
+static int build_image(uint64_t size, uint64_t nsegs, uint64_t segs_addr) {
+  char tmpl[] = "/tmp/tz_img_XXXXXX";
+  int fd = mkstemp(tmpl);
+  if (fd < 0) return -1;
+  unlink(tmpl);
+  if (size > (64ull << 20)) size = 64ull << 20;
+  if (ftruncate(fd, (off_t)size)) {
+    close(fd);
+    return -1;
+  }
+  if (nsegs > 64) nsegs = 64;
+  for (uint64_t i = 0; i < nsegs; i++) {
+    ImgSegment seg;
+    memcpy(&seg, guest(segs_addr + i * sizeof(seg), sizeof(seg)),
+           sizeof(seg));
+    if (seg.size > (1 << 20) || seg.offset > size) continue;
+    if (seg.offset + seg.size > size) seg.size = size - seg.offset;
+    if (pwrite(fd, guest(seg.addr, seg.size), seg.size,
+               (off_t)seg.offset) < 0)
+      debugf("image: segment write failed: %d\n", errno);
+  }
+  return fd;
+}
+
+// ---- KVM ------------------------------------------------------------
+// Compact syz_kvm_setup_cpu: map the program-provided user memory into
+// the VM, install a minimal real-mode or long-mode register state, and
+// copy the text blob to the entry point (reference:
+// executor/common_kvm_amd64.h + kvm.S do a far more elaborate staging;
+// the ioctl-level contract — vmfd/cpufd resources set up so a
+// following ioctl$KVM_RUN executes the text — is the same).
+
+#if defined(__has_include)
+#if __has_include(<linux/kvm.h>)
+#include <linux/kvm.h>
+#define TZ_HAVE_KVM 1
+#endif
+#endif
+
+#ifdef TZ_HAVE_KVM
+
+struct KvmTextSeg {  // guest layout of the text array arg
+  uint64_t typ;      // 0 = real16, 1 = prot32, 2 = long64
+  uint64_t text_addr;
+  uint64_t text_len;
+};
+
+static constexpr uint64_t kKvmGuestMemSize = 24 << 12;  // 24 pages
+
+static long kvm_setup_cpu(int vmfd, int cpufd, uint64_t usermem,
+                          uint64_t text_addr, uint64_t ntext,
+                          uint64_t flags) {
+  (void)flags;
+  if (ntext == 0) return -EINVAL;
+  KvmTextSeg seg;
+  memcpy(&seg, guest(text_addr, sizeof(seg)), sizeof(seg));
+  if (seg.text_len > 0x1000) seg.text_len = 0x1000;
+
+  struct kvm_userspace_memory_region mem;
+  memset(&mem, 0, sizeof(mem));
+  mem.slot = 0;
+  mem.guest_phys_addr = 0;
+  mem.memory_size = kKvmGuestMemSize;
+  mem.userspace_addr = (uint64_t)(uintptr_t)guest(usermem,
+                                                  kKvmGuestMemSize);
+  if (ioctl(vmfd, KVM_SET_USER_MEMORY_REGION, &mem))
+    return -errno;
+
+  // text at guest phys 0x1000
+  uint8_t* host_mem = guest(usermem, kKvmGuestMemSize);
+  memset(host_mem, 0xf4, 0x2000);  // hlt-fill the first pages
+  memcpy(host_mem + 0x1000, guest(seg.text_addr, seg.text_len),
+         seg.text_len);
+
+  struct kvm_sregs sregs;
+  if (ioctl(cpufd, KVM_GET_SREGS, &sregs))
+    return -errno;
+  struct kvm_regs regs;
+  memset(&regs, 0, sizeof(regs));
+  regs.rflags = 2;
+  if (seg.typ == 2) {
+    // long mode: identity-map the low 2MB with a 3-level table placed
+    // in the guest pages above the text
+    uint64_t pml4_gpa = 0x3000, pdpt_gpa = 0x4000, pd_gpa = 0x5000;
+    auto w64 = [&](uint64_t gpa, uint64_t val) {
+      memcpy(host_mem + gpa, &val, 8);
+    };
+    w64(pml4_gpa, pdpt_gpa | 3);
+    w64(pdpt_gpa, pd_gpa | 3);
+    w64(pd_gpa, 0x83);  // 2MB page, present|rw|ps
+    sregs.cr3 = pml4_gpa;
+    sregs.cr4 |= 0x20;               // PAE
+    sregs.cr0 |= 0x80000001u;        // PG | PE
+    sregs.efer |= 0x500;             // LME | LMA
+    struct kvm_segment cs;
+    memset(&cs, 0, sizeof(cs));
+    cs.base = 0;
+    cs.limit = 0xffffffff;
+    cs.selector = 0x8;
+    cs.type = 11;
+    cs.present = 1;
+    cs.s = 1;
+    cs.l = 1;
+    cs.g = 1;
+    sregs.cs = cs;
+    struct kvm_segment ds = cs;
+    ds.type = 3;
+    ds.selector = 0x10;
+    ds.l = 0;
+    ds.db = 1;
+    sregs.ds = sregs.es = sregs.ss = ds;
+    regs.rip = 0x1000;
+    regs.rsp = 0x2000;
+  } else if (seg.typ == 1) {
+    // protected 32-bit, flat segments, no paging
+    sregs.cr0 |= 1;  // PE
+    struct kvm_segment cs;
+    memset(&cs, 0, sizeof(cs));
+    cs.base = 0;
+    cs.limit = 0xffffffff;
+    cs.selector = 0x8;
+    cs.type = 11;
+    cs.present = 1;
+    cs.s = 1;
+    cs.db = 1;
+    cs.g = 1;
+    sregs.cs = cs;
+    struct kvm_segment ds = cs;
+    ds.type = 3;
+    ds.selector = 0x10;
+    sregs.ds = sregs.es = sregs.ss = ds;
+    regs.rip = 0x1000;
+    regs.rsp = 0x2000;
+  } else {
+    // real mode: run text at 0100:0000 (= phys 0x1000)
+    sregs.cs.base = 0x1000;
+    sregs.cs.selector = 0x100;
+    regs.rip = 0;
+    regs.rsp = 0xf000;
+  }
+  if (ioctl(cpufd, KVM_SET_SREGS, &sregs))
+    return -errno;
+  if (ioctl(cpufd, KVM_SET_REGS, &regs))
+    return -errno;
+  return 0;
+}
+#else
+static long kvm_setup_cpu(int, int, uint64_t, uint64_t, uint64_t,
+                          uint64_t) {
+  return -ENOSYS;  // no <linux/kvm.h> on this build host
+}
+#endif  // TZ_HAVE_KVM
+
+// ---- pseudo-syscall dispatch ----------------------------------------
+
+static long pseudo_open_dev(uint64_t name_addr, uint64_t id,
+                            uint64_t flags) {
+  // '#' in the path is replaced by the id (reference semantics:
+  // common_linux.h syz_open_dev)
+  char path[256];
+  read_guest_str(name_addr, path, sizeof(path) - 16);
+  char final_path[272];
+  char* hash = strchr(path, '#');
+  if (hash != nullptr) {
+    *hash = 0;
+    snprintf(final_path, sizeof(final_path), "%s%d%s", path, (int)id,
+             hash + 1);
+  } else {
+    snprintf(final_path, sizeof(final_path), "%s", path);
+  }
+  long fd = open(final_path, (int)flags, 0666);
+  return fd < 0 ? -errno : fd;
+}
+
+static long pseudo_open_procfs(uint64_t pid, uint64_t file_addr) {
+  char file[128];
+  read_guest_str(file_addr, file, sizeof(file));
+  char path[160];
+  if (pid == 0)
+    snprintf(path, sizeof(path), "/proc/self/%s", file);
+  else
+    snprintf(path, sizeof(path), "/proc/%d/%s", (int)pid, file);
+  long fd = open(path, O_RDWR);
+  if (fd < 0) fd = open(path, O_RDONLY);
+  return fd < 0 ? -errno : fd;
+}
+
+static long pseudo_open_pts(uint64_t master_fd, uint64_t flags) {
+  int ptyno = 0;
+  if (ioctl((int)master_fd, TIOCGPTN, &ptyno))
+    return -errno;
+  char path[32];
+  snprintf(path, sizeof(path), "/dev/pts/%d", ptyno);
+  long fd = open(path, (int)flags);
+  return fd < 0 ? -errno : fd;
+}
+
+static long pseudo_emit_ethernet(uint64_t len, uint64_t packet_addr) {
+  if (g_tun_fd < 0) return -ENODEV;
+  if (len > (1 << 16)) return -EINVAL;
+  ssize_t w = write(g_tun_fd, guest(packet_addr, len), len);
+  return w < 0 ? -errno : w;
+}
+
+struct TcpResults {  // guest layout of syz_extract_tcp_res result
+  uint32_t seq;
+  uint32_t ack;
+};
+
+static long pseudo_extract_tcp_res(uint64_t res_addr, uint64_t seq_inc,
+                                   uint64_t ack_inc) {
+  if (g_tun_fd < 0) return -ENODEV;
+  uint8_t pkt[2048];
+  ssize_t n = read(g_tun_fd, pkt, sizeof(pkt));
+  if (n < 0) return -errno;
+  // eth(14) + ipv4(ihl) + tcp: pull seq/ack out of the reply
+  if (n < 14 + 20 + 20) return -EBADMSG;
+  uint16_t ethertype = (uint16_t)((pkt[12] << 8) | pkt[13]);
+  int ip_off = 14;
+  if (ethertype != 0x0800) return -EBADMSG;
+  int ihl = (pkt[ip_off] & 0xf) * 4;
+  if (pkt[ip_off + 9] != 6 /*TCP*/ || n < ip_off + ihl + 20)
+    return -EBADMSG;
+  int tcp = ip_off + ihl;
+  TcpResults res;
+  memcpy(&res.seq, pkt + tcp + 4, 4);
+  memcpy(&res.ack, pkt + tcp + 8, 4);
+  res.seq = htonl(ntohl(res.seq) + (uint32_t)seq_inc);
+  res.ack = htonl(ntohl(res.ack) + (uint32_t)ack_inc);
+  memcpy(guest(res_addr, sizeof(res)), &res, sizeof(res));
+  return 0;
+}
+
+static long pseudo_genetlink_family(uint64_t name_addr) {
+  // generic-netlink CTRL_CMD_GETFAMILY by name
+  int sock = socket(AF_NETLINK, SOCK_RAW, 16 /*NETLINK_GENERIC*/);
+  if (sock < 0) return -errno;
+  char name[64];
+  read_guest_str(name_addr, name, sizeof(name));
+  struct {
+    uint32_t len;
+    uint16_t type, flags;
+    uint32_t seq, pid;
+    uint8_t cmd, version;
+    uint16_t reserved;
+    uint16_t attr_len, attr_type;
+    char attr[64];
+  } __attribute__((packed)) req;
+  memset(&req, 0, sizeof(req));
+  req.type = 0x10;  // GENL_ID_CTRL
+  req.flags = 1;    // NLM_F_REQUEST
+  req.cmd = 3;      // CTRL_CMD_GETFAMILY
+  req.version = 1;
+  req.attr_type = 2;  // CTRL_ATTR_FAMILY_NAME
+  size_t name_len = strlen(name) + 1;
+  memcpy(req.attr, name, name_len);
+  req.attr_len = (uint16_t)(4 + name_len);
+  req.len = (uint32_t)(20 + ((req.attr_len + 3) & ~3u));
+  long ret = -1;
+  if (send(sock, &req, req.len, 0) >= 0) {
+    uint8_t buf[4096];
+    ssize_t got = recv(sock, buf, sizeof(buf), 0);
+    // walk attrs of the reply genlmsg for CTRL_ATTR_FAMILY_ID (1)
+    if (got >= 24) {
+      size_t off = 20;
+      while (off + 4 <= (size_t)got) {
+        uint16_t alen, atype;
+        memcpy(&alen, buf + off, 2);
+        memcpy(&atype, buf + off + 2, 2);
+        if (alen < 4) break;
+        if (atype == 1 && alen >= 6) {
+          uint16_t id;
+          memcpy(&id, buf + off + 4, 2);
+          ret = id;
+          break;
+        }
+        off += (alen + 3) & ~3u;
+      }
+    }
+  }
+  int saved = errno;
+  close(sock);
+  return ret >= 0 ? ret : -(saved ? saved : ENOENT);
+}
+
+// Mounts made by syz_mount_image within the current program; torn
+// down by pseudo_cleanup() at end-of-program (the reference unmounts
+// between programs via its per-program namespace teardown,
+// common_linux.h remove_dir; we unmount explicitly because the
+// fork-server shares one mount namespace with its children).
+static constexpr int kMaxMounts = 8;
+static char g_mounts[kMaxMounts][128];
+static int g_nmounts = 0;
+
+static long pseudo_mount_image(uint64_t fs_addr, uint64_t dir_addr,
+                               uint64_t size, uint64_t nsegs,
+                               uint64_t segs_addr, uint64_t flags,
+                               uint64_t opts_addr) {
+  if (g_nmounts >= kMaxMounts) return -EMFILE;
+  char fs[64], dir[128], opts[256];
+  read_guest_str(fs_addr, fs, sizeof(fs));
+  read_guest_str(dir_addr, dir, sizeof(dir));
+  read_guest_str(opts_addr, opts, sizeof(opts));
+  int img = build_image(size, nsegs, segs_addr);
+  if (img < 0) return -errno;
+  int lfd = loop_attach(img);
+  close(img);
+  if (lfd < 0) return -ENODEV;
+  // AUTOCLEAR: the kernel releases the loop device when its last user
+  // (the mount, or our fd below) goes away — no leak on any path.
+  struct loop_info64 info;
+  memset(&info, 0, sizeof(info));
+  long res = -EINVAL;
+  if (ioctl(lfd, LOOP_GET_STATUS64, &info) == 0) {
+    info.lo_flags |= LO_FLAGS_AUTOCLEAR;
+    ioctl(lfd, LOOP_SET_STATUS64, &info);
+    mkdir(dir, 0777);
+    char ldev[32];
+    snprintf(ldev, sizeof(ldev), "/dev/loop%d", (int)info.lo_number);
+    res = mount(ldev, dir, fs, flags, opts[0] ? opts : nullptr);
+    if (res < 0) res = -errno;
+  } else {
+    res = -errno;
+  }
+  close(lfd);  // mount (if any) holds the loop device from here
+  if (res < 0) return res;
+  // register for end-of-program unmount; hand back an fd to the root
+  // so the program can operate on the mounted fs
+  snprintf(g_mounts[g_nmounts++], sizeof(g_mounts[0]), "%s", dir);
+  long dfd = open(dir, O_RDONLY | O_DIRECTORY);
+  return dfd < 0 ? -errno : dfd;
+}
+
+// end-of-program teardown (called from execute_program)
+static void pseudo_cleanup() {
+  for (int i = g_nmounts - 1; i >= 0; i--)
+    if (umount2(g_mounts[i], MNT_DETACH))
+      debugf("umount %s failed: %d\n", g_mounts[i], errno);
+  g_nmounts = 0;
+}
+
+static long pseudo_read_part_table(uint64_t size, uint64_t nsegs,
+                                   uint64_t segs_addr) {
+  int img = build_image(size, nsegs, segs_addr);
+  if (img < 0) return -errno;
+  int lfd = loop_attach(img);
+  close(img);
+  if (lfd < 0) return -ENODEV;
+  long res = ioctl(lfd, BLKRRPART, 0);
+  if (res < 0) res = -errno;
+  loop_detach(lfd);
+  return res;
+}
+
+// Returns the pseudo-syscall result following the raw-syscall
+// convention (negative errno on failure).
+static long execute_pseudo(uint32_t nr, const uint64_t* a, int nargs) {
+  (void)nargs;
+  switch (nr) {
+    case kPseudoOpenDev:
+      return pseudo_open_dev(a[0], a[1], a[2]);
+    case kPseudoOpenProcfs:
+      return pseudo_open_procfs(a[0], a[1]);
+    case kPseudoOpenPts:
+      return pseudo_open_pts(a[0], a[1]);
+    case kPseudoEmitEthernet:
+      return pseudo_emit_ethernet(a[0], a[1]);
+    case kPseudoExtractTcpRes:
+      return pseudo_extract_tcp_res(a[0], a[1], a[2]);
+    case kPseudoGenetlinkFamily:
+      return pseudo_genetlink_family(a[0]);
+    case kPseudoMountImage:
+      return pseudo_mount_image(a[0], a[1], a[2], a[3], a[4], a[5], a[6]);
+    case kPseudoReadPartTable:
+      return pseudo_read_part_table(a[0], a[1], a[2]);
+    case kPseudoKvmSetupCpu:
+      return kvm_setup_cpu((int)a[0], (int)a[1], a[2], a[3], a[4], a[5]);
+    default:
+      return -ENOSYS;
+  }
+}
+
+}  // namespace tz
+
+#endif  // __linux__
+#endif  // TZ_EXECUTOR_PSEUDO_LINUX_H

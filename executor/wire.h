@@ -73,6 +73,29 @@ constexpr uint64_t kEnvOptionalCover = 1 << 6;
 // process cannot take the fork-server down (reference process model:
 // executor/common_linux.h:1931-2040 loop()/fork per program)
 constexpr uint64_t kEnvForkProg = 1 << 7;
+// real-OS environment features (reference: common_linux.h:332 TUN,
+// 1075 cgroups); each is best-effort — missing kernel facilities
+// degrade to a debug note, not a failure
+constexpr uint64_t kEnvEnableTun = 1 << 8;
+constexpr uint64_t kEnvEnableCgroups = 1 << 9;
+
+// ---- pseudo-syscalls -------------------------------------------------
+// syz_* calls are executor-implemented helpers, not kernel syscalls
+// (reference: executor/common_linux.h:1041+ syz_open_dev & friends).
+// They occupy a reserved NR range; the same values appear in
+// sys/descriptions/linux/pseudo_amd64.const so the compiler pins them.
+
+constexpr uint32_t kPseudoNrBase = 0x81000000u;
+constexpr uint32_t kPseudoOpenDev = kPseudoNrBase + 0;
+constexpr uint32_t kPseudoOpenProcfs = kPseudoNrBase + 1;
+constexpr uint32_t kPseudoOpenPts = kPseudoNrBase + 2;
+constexpr uint32_t kPseudoEmitEthernet = kPseudoNrBase + 3;
+constexpr uint32_t kPseudoExtractTcpRes = kPseudoNrBase + 4;
+constexpr uint32_t kPseudoGenetlinkFamily = kPseudoNrBase + 5;
+constexpr uint32_t kPseudoMountImage = kPseudoNrBase + 6;
+constexpr uint32_t kPseudoReadPartTable = kPseudoNrBase + 7;
+constexpr uint32_t kPseudoKvmSetupCpu = kPseudoNrBase + 8;
+constexpr uint32_t kPseudoNrLast = kPseudoKvmSetupCpu;
 
 // exec flags (per-request)
 constexpr uint64_t kExecCollectCover = 1 << 0;

@@ -56,6 +56,10 @@ class EnvFlags(enum.IntFlag):
     # Fork a fresh child per program (program exits/crashes are
     # contained; reference: common_linux.h:1931-2040).
     FORK_PROG = 1 << 7
+    # Real-OS environment features (best-effort in the executor;
+    # reference: common_linux.h:332 TUN, 1075 cgroups).
+    ENABLE_TUN = 1 << 8
+    ENABLE_CGROUPS = 1 << 9
 
 
 class ExecFlags(enum.IntFlag):
@@ -327,14 +331,23 @@ class Env:
 
 def make_env(pid: int = 0, sim: bool = True, signal: bool = True,
              debug: bool = False, fork_prog: Optional[bool] = None,
-             **kw) -> Env:
-    flags = EnvFlags.SANDBOX_NONE
+             sandbox: str = "none", tun: bool = False,
+             cgroups: bool = False, **kw) -> Env:
+    flags = {
+        "none": EnvFlags.SANDBOX_NONE,
+        "setuid": EnvFlags.SANDBOX_SETUID,
+        "namespace": EnvFlags.SANDBOX_NAMESPACE,
+    }[sandbox]
     if sim:
         flags |= EnvFlags.SIM_OS
     if signal:
         flags |= EnvFlags.SIGNAL
     if debug:
         flags |= EnvFlags.DEBUG
+    if tun:
+        flags |= EnvFlags.ENABLE_TUN
+    if cgroups:
+        flags |= EnvFlags.ENABLE_CGROUPS
     # Real-OS programs mutate process state (fds, maps, signal
     # dispositions) and may plain _exit: isolate each in a fork by
     # default.  The sim backend keeps the faster in-process model.

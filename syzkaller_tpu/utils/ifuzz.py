@@ -1,13 +1,13 @@
-"""x86 machine-code generation/mutation for `text` buffer args.
+"""Machine-code generation/mutation for `text` buffer args.
 
-The reference ships a ~100k-line generated x86 instruction table
-consumed by pkg/ifuzz (reference: pkg/ifuzz/ifuzz.go:14-40) to fuzz KVM
-guests.  We model the same interface with a compact generative encoder:
-instructions are built from legal prefix/opcode/modrm/imm structure
-plus interesting system instructions, rather than a full ISA table.
-This keeps text-arg fuzzing structured (decodable prefixes, plausible
-modrm forms) without a generated table; a full table-driven encoder is
-a later milestone.
+x86 is table-driven: utils/x86.py holds a declarative opcode-map table
+(one-byte map, 0F/0F38/0F3A maps, VEX, VMX/SVM), a structural
+generator, an instruction-length decoder, and pseudo system sequences
+— the same capability set as the reference's pkg/ifuzz (reference:
+pkg/ifuzz/ifuzz.go:14-40 Insn model, generated/insns.go table,
+pseudo.go sequences, decode via x86 length rules).  ARM64 stays a raw
+byte generator, matching the reference's arm64 treatment
+(reference: prog/rand.go:323-330).
 """
 
 from __future__ import annotations
@@ -15,112 +15,40 @@ from __future__ import annotations
 import random
 
 from syzkaller_tpu.models.types import TextKind
+from syzkaller_tpu.utils import x86
 
-PREFIXES = [0x66, 0x67, 0xF0, 0xF2, 0xF3, 0x2E, 0x36, 0x3E, 0x26, 0x64, 0x65]
-
-# A few "interesting" privileged/system instruction encodings that
-# exercise VM exits and CPU state: hlt, cpuid, rdtsc, rdmsr, wrmsr,
-# in/out, mov cr/dr, lgdt/lidt, invlpg, wbinvd, clts, sti/cli, iret,
-# int3, int imm, sysenter/sysexit, vmcall-like.
-SYSTEM_INSNS = [
-    b"\xf4",              # hlt
-    b"\x0f\xa2",          # cpuid
-    b"\x0f\x31",          # rdtsc
-    b"\x0f\x32",          # rdmsr
-    b"\x0f\x30",          # wrmsr
-    b"\xec",              # in al, dx
-    b"\xee",              # out dx, al
-    b"\x0f\x20\xc0",      # mov eax, cr0
-    b"\x0f\x22\xc0",      # mov cr0, eax
-    b"\x0f\x01\x10",      # lgdt [eax]
-    b"\x0f\x01\x18",      # lidt [eax]
-    b"\x0f\x01\x38",      # invlpg [eax]
-    b"\x0f\x09",          # wbinvd
-    b"\x0f\x06",          # clts
-    b"\xfb",              # sti
-    b"\xfa",              # cli
-    b"\xcf",              # iret
-    b"\xcc",              # int3
-    b"\x0f\x34",          # sysenter
-    b"\x0f\x35",          # sysexit
-    b"\x0f\x01\xc1",      # vmcall
-    b"\x0f\x01\xd9",      # vmmcall
-]
+_MODE = {
+    TextKind.X86_REAL: x86.REAL16,
+    TextKind.X86_16: x86.PROT16,
+    TextKind.X86_32: x86.PROT32,
+    TextKind.X86_64: x86.LONG64,
+}
 
 DEFAULT_LEN = 10  # instructions per blob (reference: prog/rand.go:351)
 
 
-def _gen_insn(mode: TextKind, r: random.Random) -> bytes:
-    choice = r.randrange(10)
-    if choice == 0:
-        return SYSTEM_INSNS[r.randrange(len(SYSTEM_INSNS))]
-    out = bytearray()
-    # Optional legacy prefixes.
-    while r.randrange(3) == 0 and len(out) < 4:
-        out.append(PREFIXES[r.randrange(len(PREFIXES))])
-    if mode == TextKind.X86_64 and r.randrange(3) == 0:
-        out.append(0x40 | r.randrange(16))  # REX
-    # Opcode: 1-byte, 0F 2-byte, or 0F 38/3A 3-byte escape.
-    esc = r.randrange(8)
-    if esc == 0:
-        out += bytes([0x0F, 0x38, r.randrange(256)])
-    elif esc == 1:
-        out += bytes([0x0F, 0x3A, r.randrange(256)])
-    elif esc <= 3:
-        out += bytes([0x0F, r.randrange(256)])
-    else:
-        out.append(r.randrange(256))
-    # ModRM + optional SIB + displacement.
-    if r.randrange(2) == 0:
-        modrm = r.randrange(256)
-        out.append(modrm)
-        mod, rm = modrm >> 6, modrm & 7
-        if mod != 3 and rm == 4:
-            out.append(r.randrange(256))  # SIB
-        if mod == 1:
-            out.append(r.randrange(256))
-        elif mod == 2 or (mod == 0 and rm == 5):
-            out += r.randrange(1 << 32).to_bytes(4, "little")
-    # Optional immediate.
-    imm = r.randrange(4)
-    if imm == 1:
-        out.append(r.randrange(256))
-    elif imm == 2:
-        out += r.randrange(1 << 16).to_bytes(2, "little")
-    elif imm == 3:
-        out += r.randrange(1 << 32).to_bytes(4, "little")
-    return bytes(out)
-
-
 def generate(kind: TextKind, r: random.Random) -> bytes:
     if kind == TextKind.ARM64:
-        # Stub parity with the reference (reference: prog/rand.go:323-330).
-        return bytes(r.randrange(256) for _ in range(50))
-    out = bytearray()
-    for _ in range(DEFAULT_LEN):
-        out += _gen_insn(kind, r)
-    return bytes(out)
+        # Fixed-width 4-byte insns; random words are mostly decodable.
+        return b"".join(r.randrange(1 << 32).to_bytes(4, "little")
+                        for _ in range(12))
+    cfg = x86.Config(mode=_MODE[kind], priv=True, avx=True,
+                     len_insns=DEFAULT_LEN)
+    return x86.generate(cfg, r)
 
 
 def mutate(kind: TextKind, r: random.Random, text: bytes) -> bytes:
     if kind == TextKind.ARM64:
-        from syzkaller_tpu.models.mutation import mutate_data
-        from syzkaller_tpu.models.rand import RandGen
-
-        rng = RandGen(None, r)
-        return bytes(mutate_data(rng, bytearray(text), 40, 60))
-    data = bytearray(text)
-    for _ in range(r.randrange(3) + 1):
-        op = r.randrange(3)
-        if op == 0 and data:  # splice new instruction in
-            pos = r.randrange(len(data) + 1)
-            data[pos:pos] = _gen_insn(kind, r)
-        elif op == 1 and data:  # overwrite a byte
-            data[r.randrange(len(data))] = r.randrange(256)
-        elif data:  # cut a chunk
-            n = min(len(data), r.randrange(8) + 1)
-            pos = r.randrange(len(data) - n + 1)
-            del data[pos:pos + n]
-        else:
-            data += _gen_insn(kind, r)
-    return bytes(data)
+        data = bytearray(text)
+        for _ in range(r.randrange(3) + 1):
+            if not data or r.randrange(4) == 0:
+                pos = r.randrange(len(data) // 4 + 1) * 4
+                data[pos:pos] = r.randrange(1 << 32).to_bytes(4, "little")
+            elif r.randrange(3) == 0 and len(data) >= 4:
+                pos = r.randrange(len(data) // 4) * 4
+                del data[pos:pos + 4]
+            else:
+                data[r.randrange(len(data))] = r.randrange(256)
+        return bytes(data)
+    cfg = x86.Config(mode=_MODE[kind], priv=True, avx=True)
+    return x86.mutate(cfg, r, text)

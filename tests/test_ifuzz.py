@@ -1,0 +1,144 @@
+"""Tests for the table-driven x86 instruction model (utils/x86.py).
+
+Mirrors the reference's ifuzz tests (reference: pkg/ifuzz/ifuzz_test.go)
+— generate/decode round-trips per mode, mode filtering, pseudo
+sequences — against our spec-driven table.
+"""
+
+import random
+
+import pytest
+
+from syzkaller_tpu.models.types import TextKind
+from syzkaller_tpu.utils import ifuzz, x86
+
+MODES = [x86.REAL16, x86.PROT16, x86.PROT32, x86.LONG64]
+
+
+def test_table_size_and_shape():
+    assert len(x86.INSNS) >= 500
+    names = {i.name for i in x86.INSNS}
+    # spot-check families from every map region
+    for nm in ["add", "mov", "push_r", "jz", "lgdt", "wrmsr", "cpuid",
+               "vmcall", "vmrun", "movups", "pshufb", "palignr",
+               "vaddps", "bswap", "cmpxchg8b", "syscall", "x87"]:
+        assert nm in names, nm
+    privs = [i for i in x86.INSNS if i.priv]
+    assert len(privs) >= 40
+    vex = [i for i in x86.INSNS if i.flags & x86.VEX]
+    assert len(vex) >= 20
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_generate_decode_roundtrip(mode):
+    r = random.Random(1234 + mode)
+    cfg = x86.Config(mode=mode)
+    for _ in range(500):
+        insn = x86.generate_insn(cfg, r)
+        assert x86.decode(mode, insn) == len(insn), insn.hex()
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_stream_split(mode):
+    r = random.Random(99 + mode)
+    cfg = x86.Config(mode=mode, len_insns=16)
+    blob = x86.generate(cfg, r)
+    chunks = x86.split_insns(mode, blob)
+    assert b"".join(chunks) == blob
+    for c in chunks:
+        assert x86.decode(mode, c) == len(c), c.hex()
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_pseudo_sequences_decode(mode):
+    r = random.Random(7 + mode)
+    for _ in range(200):
+        seq = x86.pseudo(mode, r)
+        chunks = x86.split_insns(mode, seq)
+        assert b"".join(chunks) == seq
+        for c in chunks:
+            assert x86.decode(mode, c) == len(c), (seq.hex(), c.hex())
+
+
+def test_mode_filtering():
+    # NO64 instructions never generate in long mode and vice versa.
+    cfg64 = x86.Config(mode=x86.LONG64)
+    for i in x86.mode_insns(cfg64):
+        assert i.modes & x86.LONG64
+    cfg16 = x86.Config(mode=x86.REAL16)
+    names16 = {i.name for i in x86.mode_insns(cfg16)}
+    assert "aaa" in names16 and "syscall" not in names16
+    names64 = {i.name for i in x86.mode_insns(cfg64)}
+    assert "syscall" in names64 and "aaa" not in names64
+
+
+def test_priv_filtering():
+    cfg = x86.Config(mode=x86.LONG64, priv=False)
+    for i in x86.mode_insns(cfg):
+        assert not i.priv
+    r = random.Random(5)
+    # wrmsr (0F 30) must never appear as a generated instruction
+    for _ in range(300):
+        insn = x86.generate_insn(cfg, r)
+        stripped = insn.lstrip(bytes(x86.LEGACY_PREFIXES))
+        assert not stripped.startswith(b"\x0f\x30")
+
+
+def test_decode_garbage_no_crash():
+    r = random.Random(3)
+    for _ in range(2000):
+        data = bytes(r.randrange(256) for _ in range(r.randrange(1, 18)))
+        for mode in MODES:
+            n = x86.decode(mode, data)
+            assert isinstance(n, int) and (n == -1 or 0 < n <= len(data))
+
+
+def test_decode_known_encodings():
+    # Hand-checked SDM encodings.
+    assert x86.decode(x86.LONG64, bytes.fromhex("0fa2")) == 2      # cpuid
+    assert x86.decode(x86.LONG64, bytes.fromhex("f4")) == 1        # hlt
+    assert x86.decode(x86.LONG64, bytes.fromhex("4889d8")) == 3    # mov rax,rbx
+    assert x86.decode(x86.LONG64, bytes.fromhex("b878563412")) == 5  # mov eax,imm32
+    assert x86.decode(x86.LONG64,
+                      bytes.fromhex("48b80102030405060708")) == 10  # movabs
+    assert x86.decode(x86.LONG64, bytes.fromhex("0f0101")) == 3    # sgdt [rcx]
+    assert x86.decode(x86.LONG64, bytes.fromhex("0f01c1")) == 3    # vmcall
+    assert x86.decode(x86.LONG64, bytes.fromhex("e8deadbeef")) == 5  # call rel32
+    assert x86.decode(x86.REAL16, bytes.fromhex("e8dead")) == 3    # call rel16
+    assert x86.decode(x86.LONG64, bytes.fromhex("c3")) == 1        # ret
+    assert x86.decode(x86.LONG64,
+                      bytes.fromhex("810424efbeadde")) == 7  # add [rsp],imm32
+    # LES is invalid in long mode; C4 is VEX there (truncated => -1)
+    assert x86.decode(x86.LONG64, bytes.fromhex("c410")) == -1
+    assert x86.decode(x86.PROT32, bytes.fromhex("c410")) == 2      # les
+    # VEX3: vpaddd xmm,xmm,xmm = C4 E1 79... our table uses pp=0 form
+    assert x86.decode(x86.LONG64, bytes.fromhex("c4e178fec1")) == 5
+    # VEX2 vaddps
+    assert x86.decode(x86.LONG64, bytes.fromhex("c5f858c1")) == 4
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_mutate_structural(mode):
+    r = random.Random(42 + mode)
+    cfg = x86.Config(mode=mode)
+    blob = x86.generate(cfg, r)
+    for _ in range(50):
+        blob = x86.mutate(cfg, r, blob)
+        assert isinstance(blob, bytes)
+    # mutation keeps the stream mostly decodable (structural ops keep
+    # boundaries; only byte-perturbs can corrupt)
+    chunks = x86.split_insns(mode, blob)
+    ok = sum(1 for c in chunks if x86.decode(mode, c) == len(c))
+    assert ok >= len(chunks) // 2
+
+
+def test_ifuzz_facade():
+    r = random.Random(0)
+    for kind in (TextKind.X86_REAL, TextKind.X86_16, TextKind.X86_32,
+                 TextKind.X86_64, TextKind.ARM64):
+        blob = ifuzz.generate(kind, r)
+        assert isinstance(blob, bytes) and blob
+        mut = ifuzz.mutate(kind, r, blob)
+        assert isinstance(mut, bytes)
+    arm = ifuzz.generate(TextKind.ARM64, r)
+    assert len(arm) % 4 == 0

@@ -86,3 +86,112 @@ def test_real_bad_call_errno(linux_target):
         assert res.info[0].errno == 9  # EBADF
     finally:
         env.close()
+
+
+# ---- pseudo-syscalls (executor/pseudo_linux.h) ----------------------
+
+def _run_text(target, text, **env_kw):
+    from syzkaller_tpu.models.encoding import deserialize_prog
+
+    p = deserialize_prog(target, text)
+    env = make_env(0, sim=False, **env_kw)
+    try:
+        return env.exec(ExecOpts(), serialize_for_exec(p))
+    finally:
+        env.close()
+
+
+def test_syz_open_procfs(linux_target):
+    res = _run_text(
+        linux_target,
+        b"r0 = syz_open_procfs(0x0, &(0x7f0000000000)='status\\x00')\n"
+        b"read(r0, &(0x7f0000001000)=\"\"/64, 0x40)\n")
+    assert res.completed
+    assert res.info[0].errno == 0, "syz_open_procfs(self/status) failed"
+    assert res.info[1].errno == 0
+
+
+def test_syz_open_dev_hash_substitution(linux_target, tmp_path):
+    # '#' in the template is replaced by the id argument
+    base = tmp_path / "tzdev"
+    (tmp_path / "tzdev7").write_bytes(b"hello")
+    path = str(base).encode() + b"#"
+    text = (b"r0 = syz_open_dev(&(0x7f0000000000)='"
+            + path.replace(b"/", b"/") + b"\\x00', 0x7, 0x0)\n"
+            b"read(r0, &(0x7f0000001000)=\"\"/8, 0x5)\n")
+    res = _run_text(linux_target, text)
+    assert res.completed
+    assert res.info[0].errno == 0, "syz_open_dev did not substitute #"
+    assert res.info[1].errno == 0
+
+
+def test_syz_open_pts(linux_target):
+    if not os.path.exists("/dev/ptmx"):
+        pytest.skip("no /dev/ptmx")
+    res = _run_text(
+        linux_target,
+        b"r0 = syz_open_dev$ptmx(&(0x7f0000000000)='/dev/ptmx\\x00', "
+        b"0x0, 0x2)\n"
+        b"r1 = syz_open_pts(r0, 0x2)\n")
+    assert res.completed
+    assert res.info[0].errno == 0
+    # pts open can fail in exotic containers (no devpts); accept open
+    # errors but require the pseudo-call to have executed
+    assert res.info[1].flags & 1  # executed
+
+
+def test_syz_emit_ethernet_no_tun(linux_target):
+    # without ENABLE_TUN the call must fail cleanly with ENODEV (19)
+    res = _run_text(
+        linux_target,
+        b"syz_emit_ethernet(0xe, &(0x7f0000000000)=\""
+        + b"aa" * 14 + b"\")\n")
+    assert res.completed
+    assert res.info[0].errno == 19  # ENODEV
+
+
+def test_namespace_sandbox_and_tun_flags(linux_target):
+    # namespace sandbox + tun + cgroups are best-effort: the env must
+    # come up and run programs whether or not the kernel grants them
+    res = _run_text(linux_target,
+                    b"getpid()\n",
+                    sandbox="namespace", tun=True, cgroups=True)
+    assert res.completed
+    assert res.info[0].errno == 0
+
+
+def test_syz_genetlink_family(linux_target):
+    res = _run_text(
+        linux_target,
+        b"syz_genetlink_get_family_id(&(0x7f0000000000)='nlctrl\\x00')\n")
+    assert res.completed
+    info = res.info[0]
+    # on hosts with genetlink the call succeeds; otherwise clean errno
+    assert info.flags & 1
+
+
+def test_kvm_descriptions_compile(linux_target):
+    names = {c.name for c in linux_target.syscalls}
+    for n in ("openat$kvm", "ioctl$KVM_CREATE_VM", "ioctl$KVM_CREATE_VCPU",
+              "ioctl$KVM_RUN", "syz_kvm_setup_cpu"):
+        assert n in names
+    kvm = next(c for c in linux_target.syscalls
+               if c.name == "syz_kvm_setup_cpu")
+    assert kvm.nr == 0x81000008
+
+
+def test_syz_kvm_setup_cpu_live(linux_target):
+    if not os.path.exists("/dev/kvm"):
+        pytest.skip("no /dev/kvm")
+    res = _run_text(
+        linux_target,
+        b"r0 = openat$kvm(0xffffffffffffff9c, "
+        b"&(0x7f0000000000)='/dev/kvm\\x00', 0x2, 0x0)\n"
+        b"r1 = ioctl$KVM_CREATE_VM(r0, 0xae01, 0x0)\n"
+        b"r2 = ioctl$KVM_CREATE_VCPU(r1, 0xae41, 0x0)\n"
+        b"syz_kvm_setup_cpu(r1, r2, &(0x7f0000100000)=\"\"/98304, "
+        b"&(0x7f0000000100)=[{0x0, &(0x7f0000000200)=\"f4\", 0x1}], "
+        b"0x1, 0x0)\n")
+    assert res.completed
+    for i, info in enumerate(res.info):
+        assert info.errno == 0, f"call {i} errno={info.errno}"
