@@ -33,7 +33,7 @@ def _seed_programs(target, n, length=8, seed0=42):
             for i in range(n)]
 
 
-def bench_pipeline(batch_size=512, seconds=8.0, capacity=1024,
+def bench_pipeline(batch_size=2048, seconds=8.0, capacity=1024,
                    seeds=64) -> float:
     """End-to-end exec-ready mutants/sec off the DevicePipeline."""
     from syzkaller_tpu.models.target import get_target
@@ -62,7 +62,7 @@ def bench_pipeline(batch_size=512, seconds=8.0, capacity=1024,
     return n / dt
 
 
-def bench_device_kernel(batch_size=1024, edges_per_prog=128,
+def bench_device_kernel(batch_size=512, edges_per_prog=128,
                         steps=20) -> float:
     """The fused mutate+triage kernel alone (device steady state)."""
     import jax
@@ -215,7 +215,7 @@ def main() -> None:
         print(json.dumps(res))
         return
     batch = int(argv[argv.index("--batch") + 1]) \
-        if "--batch" in argv else 512
+        if "--batch" in argv else 2048
     secs = float(argv[argv.index("--seconds") + 1]) \
         if "--seconds" in argv else 8.0
     pipe_rate = bench_pipeline(batch_size=batch, seconds=secs)

@@ -858,6 +858,10 @@ static int executor_main(int argc, char** argv) {
   write_exact(1, &hr, sizeof(hr));
 
   bool fork_prog = g_env_flags & kEnvForkProg;
+#if defined(__linux__)
+  if (!(g_env_flags & kEnvSimOS))
+    pseudo_init_mount_root();  // parent + children share the root
+#endif
   // In fork mode the parent stays single-threaded and pool-less:
   // every program gets a fresh child with its own pool + sim state
   // (reference process model: common_linux.h:1931-2040).
@@ -931,6 +935,12 @@ static int executor_main(int argc, char** argv) {
     auto* hdr = (OutHeader*)g_out;
     if (got != child || !WIFEXITED(status) || WEXITSTATUS(status) != 0)
       hdr->completed = 0;  // partial or killed: host must not trust
+#if defined(__linux__)
+    // A child that died before its own pseudo_cleanup (exit_group
+    // mid-program, timeout SIGKILL) leaves its mounts behind in the
+    // shared mount namespace; sweep them here.
+    if (!(g_env_flags & kEnvSimOS)) pseudo_parent_sweep();
+#endif
     rep.ncalls = hdr->ncalls;
     rep.status = 0;
     write_exact(1, &rep, sizeof(rep));

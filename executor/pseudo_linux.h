@@ -35,6 +35,8 @@
 #include <sys/types.h>
 #include <unistd.h>
 
+#include <mutex>
+
 namespace tz {
 
 // Included from executor.cc after its guest()/debugf() definitions;
@@ -502,20 +504,46 @@ static long pseudo_genetlink_family(uint64_t name_addr) {
 // down by pseudo_cleanup() at end-of-program (the reference unmounts
 // between programs via its per-program namespace teardown,
 // common_linux.h remove_dir; we unmount explicitly because the
-// fork-server shares one mount namespace with its children).
+// fork-server shares one mount namespace with its children).  All
+// mount points live under a per-proc root so the PARENT of a
+// fork-per-program child can sweep stragglers even when the child
+// died (exit_group mid-program, timeout SIGKILL) before its own
+// pseudo_cleanup ran — child-local bookkeeping dies with the child,
+// the mount namespace does not.  Calls run on worker-pool threads, so
+// the registry is mutex-guarded.
 static constexpr int kMaxMounts = 8;
-static char g_mounts[kMaxMounts][128];
+static char g_mounts[kMaxMounts][160];
 static int g_nmounts = 0;
+static std::mutex g_mounts_mu;
+static char g_mount_root[64];
+
+// Initialized in the fork SERVER before any program runs, so parent
+// and every child agree on the same root path.
+static void pseudo_init_mount_root() {
+  snprintf(g_mount_root, sizeof(g_mount_root), "/tmp/tz_mnt_%d",
+           (int)getpid());
+  mkdir(g_mount_root, 0777);
+}
+
+static const char* mount_root() {
+  if (!g_mount_root[0]) pseudo_init_mount_root();  // non-fork path
+  return g_mount_root;
+}
 
 static long pseudo_mount_image(uint64_t fs_addr, uint64_t dir_addr,
                                uint64_t size, uint64_t nsegs,
                                uint64_t segs_addr, uint64_t flags,
                                uint64_t opts_addr) {
-  if (g_nmounts >= kMaxMounts) return -EMFILE;
-  char fs[64], dir[128], opts[256];
+  char fs[64], reqdir[64], dir[160], opts[256];
   read_guest_str(fs_addr, fs, sizeof(fs));
-  read_guest_str(dir_addr, dir, sizeof(dir));
+  read_guest_str(dir_addr, reqdir, sizeof(reqdir));
   read_guest_str(opts_addr, opts, sizeof(opts));
+  // confine the mount point under the per-proc root: use only the
+  // basename of the requested dir
+  const char* base = strrchr(reqdir, '/');
+  base = base ? base + 1 : reqdir;
+  snprintf(dir, sizeof(dir), "%s/%s", mount_root(),
+           base[0] ? base : "m");
   int img = build_image(size, nsegs, segs_addr);
   if (img < 0) return -errno;
   int lfd = loop_attach(img);
@@ -541,17 +569,53 @@ static long pseudo_mount_image(uint64_t fs_addr, uint64_t dir_addr,
   if (res < 0) return res;
   // register for end-of-program unmount; hand back an fd to the root
   // so the program can operate on the mounted fs
-  snprintf(g_mounts[g_nmounts++], sizeof(g_mounts[0]), "%s", dir);
+  {
+    std::lock_guard<std::mutex> lk(g_mounts_mu);
+    if (g_nmounts >= kMaxMounts) {
+      umount2(dir, MNT_DETACH);
+      return -EMFILE;
+    }
+    snprintf(g_mounts[g_nmounts++], sizeof(g_mounts[0]), "%s", dir);
+  }
   long dfd = open(dir, O_RDONLY | O_DIRECTORY);
   return dfd < 0 ? -errno : dfd;
 }
 
 // end-of-program teardown (called from execute_program)
 static void pseudo_cleanup() {
+  std::lock_guard<std::mutex> lk(g_mounts_mu);
   for (int i = g_nmounts - 1; i >= 0; i--)
     if (umount2(g_mounts[i], MNT_DETACH))
       debugf("umount %s failed: %d\n", g_mounts[i], errno);
   g_nmounts = 0;
+}
+
+// Parent-side sweep after reaping a fork-per-program child: unmount
+// anything still mounted under the per-proc root (the child's own
+// registry died with it).
+static void pseudo_parent_sweep() {
+  const char* root = mount_root();
+  size_t rootlen = strlen(root);
+  for (int pass = 0; pass < 4; pass++) {
+    FILE* f = fopen("/proc/self/mounts", "r");
+    if (f == nullptr) return;
+    char line[512];
+    bool any = false;
+    while (fgets(line, sizeof(line), f)) {
+      // format: dev mountpoint fstype opts ...
+      char* sp1 = strchr(line, ' ');
+      if (sp1 == nullptr) continue;
+      char* mp = sp1 + 1;
+      char* sp2 = strchr(mp, ' ');
+      if (sp2 == nullptr) continue;
+      *sp2 = 0;
+      if (strncmp(mp, root, rootlen) == 0) {
+        if (umount2(mp, MNT_DETACH) == 0) any = true;
+      }
+    }
+    fclose(f);
+    if (!any) return;  // nothing (left) to do
+  }
 }
 
 static long pseudo_read_part_table(uint64_t size, uint64_t nsegs,

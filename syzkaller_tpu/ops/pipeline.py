@@ -41,6 +41,7 @@ from syzkaller_tpu.ops.delta import (
     DeltaBatch,
     DeltaSpec,
     make_packer,
+    make_pooler,
 )
 from syzkaller_tpu.ops.emit import (
     ExecTemplate,
@@ -173,12 +174,21 @@ class PipelineStats:
 PIPELINE_TENSOR_CONFIG = TensorConfig(
     max_calls=32, max_slots=128, arena=2048, max_blob=768)
 
+# The tunneled host link moves ~9 MB/s on synchronous copies, so the
+# delta row size IS the throughput ceiling (row_bytes * rate = link
+# bandwidth).  P=1024 holds one full changed blob (max_blob 768,
+# 8-aligned) plus header/journals in a 1248-byte row — 1.8x less wire
+# than the 2048-payload default; multi-blob mutants that exceed it are
+# flagged OVERFLOW and dropped (counted in stats; rare, and a dropped
+# mutant costs only its slot in the batch).
+PIPELINE_DELTA_SPEC = DeltaSpec(K=16, D=4, P=1024)
+
 
 class DevicePipeline:
     """Corpus-on-device mutation engine producing exec-ready bytes."""
 
     def __init__(self, target, cfg: Optional[TensorConfig] = None,
-                 capacity: int = 2048, batch_size: int = 512,
+                 capacity: int = 2048, batch_size: int = 2048,
                  rounds: int = 4, seed: int = 0, prefetch: int = 2,
                  spec: Optional[DeltaSpec] = None, ct=None,
                  max_insert_calls: int = 30):
@@ -195,7 +205,7 @@ class DevicePipeline:
         self._random = random
         self.target = target
         self.cfg = cfg or PIPELINE_TENSOR_CONFIG
-        self.spec = spec or DeltaSpec()
+        self.spec = spec or PIPELINE_DELTA_SPEC
         self.flags = FlagTables.empty()
         self.capacity = capacity
         self.batch_size = batch_size
@@ -227,6 +237,7 @@ class DevicePipeline:
 
         B, R = batch_size, rounds
         pack = make_packer(self.spec)
+        pool = make_pooler(self.spec, B)
         p_insert = P_INSERT_GIVEN_DEVICE if n_blocks > 0 else 0.0
         runs = self._runs_dev
         by_syscall = self._by_syscall_dev
@@ -281,7 +292,8 @@ class DevicePipeline:
                 donor = jnp.where(is_insert, donor, jnp.int32(-1))
                 return pack(mutated, i, op=op, donor=donor, pos=pos)
 
-            return jax.vmap(one)(batch, keys, idx)
+            rows, payloads, needs = jax.vmap(one)(batch, keys, idx)
+            return pool(rows, payloads, needs)
 
         self._step = jax.jit(step)
 
@@ -392,7 +404,7 @@ class DevicePipeline:
 
         rows_dev, tmpl, ets = launched
         buf = np.asarray(rows_dev)  # the one device->host transfer
-        batch = DeltaBatch(buf, self.spec)
+        batch = DeltaBatch(buf, self.spec, self.batch_size)
         ok = (batch.flags & FLAG_OVERFLOW) == 0
         self.stats.overflows += int(np.count_nonzero(~ok))
         ok &= (batch.template_idx >= 0) & (batch.template_idx < len(tmpl))

@@ -12,7 +12,12 @@ from jax import random  # noqa: E402
 
 from syzkaller_tpu.models.generation import generate_prog  # noqa: E402
 from syzkaller_tpu.models.rand import RandGen  # noqa: E402
-from syzkaller_tpu.ops.delta import DeltaBatch, DeltaSpec, make_packer  # noqa: E402
+from syzkaller_tpu.ops.delta import (  # noqa: E402
+    DeltaBatch,
+    DeltaSpec,
+    make_packer,
+    make_pooler,
+)
 from syzkaller_tpu.ops.emit import (  # noqa: E402
     assemble,
     assemble_delta,
@@ -46,10 +51,12 @@ def test_delta_matches_dense_assembly(test_target, iters):
     spec = DeltaSpec()
     tensors = _encode_some(test_target, 8, cfg, flags)
     pack = make_packer(spec)
+    pool1 = make_pooler(spec, 1)
 
     def both(state, key, tidx):
         mutated = _mutate_one(state, key, fv, fc, 4)
-        return mutated, pack(mutated, tidx)
+        row, payload, needs = pack(mutated, tidx)
+        return mutated, pool1(row[None], payload[None], needs[None])
 
     fv, fc = jnp.asarray(flags.vals), jnp.asarray(flags.counts)
     fn = jax.jit(lambda st, k, i: both(st, k, i))
@@ -60,9 +67,8 @@ def test_delta_matches_dense_assembly(test_target, iters):
         et = build_exec_template(t)
         state = {k: jnp.asarray(v) for k, v in t.arrays().items()}
         key, sub = random.split(key)
-        mutated, row_bytes = fn(state, sub, jnp.int32(it % len(tensors)))
-        buf = np.asarray(row_bytes)[None]
-        batch = DeltaBatch(buf, spec)
+        mutated, flat = fn(state, sub, jnp.int32(it % len(tensors)))
+        batch = DeltaBatch(np.asarray(flat), spec, 1)
         if batch.overflowed(0):
             continue
         mut = {k: np.asarray(v) for k, v in mutated.items()}
@@ -98,11 +104,16 @@ def test_delta_template_index_roundtrip(test_target):
     spec = DeltaSpec()
     t = _encode_some(test_target, 1, cfg, flags)[0]
     pack = make_packer(spec)
+    pool1 = make_pooler(spec, 1)
     fv, fc = jnp.asarray(flags.vals), jnp.asarray(flags.counts)
     state = {k: jnp.asarray(v) for k, v in t.arrays().items()}
-    fn = jax.jit(lambda st, k, i: pack(
-        _mutate_one(st, k, fv, fc, 2), i))
+
+    def one(st, k, i):
+        row, payload, needs = pack(_mutate_one(st, k, fv, fc, 2), i)
+        return pool1(row[None], payload[None], needs[None])
+
+    fn = jax.jit(one)
     for tidx in (0, 7, 2047):
-        row = fn(state, random.key(tidx), jnp.int32(tidx))
-        batch = DeltaBatch(np.asarray(row)[None], spec)
+        flat = fn(state, random.key(tidx), jnp.int32(tidx))
+        batch = DeltaBatch(np.asarray(flat), spec, 1)
         assert int(batch.template_idx[0]) == tidx
