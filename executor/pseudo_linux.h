@@ -518,11 +518,17 @@ static std::mutex g_mounts_mu;
 static char g_mount_root[64];
 
 // Initialized in the fork SERVER before any program runs, so parent
-// and every child agree on the same root path.
+// and every child agree on the same root path.  The process also
+// chdirs into the root: programs mount at relative paths ("./file0")
+// and then operate on them by the same relative path, so the mount
+// point they see and the confined path the parent sweeps are the same
+// directory (the reference gives each proc its own cwd the same way).
 static void pseudo_init_mount_root() {
   snprintf(g_mount_root, sizeof(g_mount_root), "/tmp/tz_mnt_%d",
            (int)getpid());
   mkdir(g_mount_root, 0777);
+  if (chdir(g_mount_root))
+    debugf("chdir %s failed: %d\n", g_mount_root, errno);
 }
 
 static const char* mount_root() {
@@ -609,7 +615,10 @@ static void pseudo_parent_sweep() {
       char* sp2 = strchr(mp, ' ');
       if (sp2 == nullptr) continue;
       *sp2 = 0;
-      if (strncmp(mp, root, rootlen) == 0) {
+      // path-boundary match: /tmp/tz_mnt_12 must not sweep
+      // /tmp/tz_mnt_123's live mounts
+      if (strncmp(mp, root, rootlen) == 0 &&
+          (mp[rootlen] == '/' || mp[rootlen] == 0)) {
         if (umount2(mp, MNT_DETACH) == 0) any = true;
       }
     }

@@ -28,7 +28,8 @@ MAX_CRASHES_PER_BUG = 20
 
 STATUS_NEW = "new"
 STATUS_REPORTED = "reported"
-STATUS_FIXED = "fixed"
+STATUS_FIXED = "fixed"      # fix commit attached, not yet in a build
+STATUS_CLOSED = "closed"    # fix commit observed in an uploaded build
 STATUS_INVALID = "invalid"
 STATUS_DUP = "dup"
 
@@ -154,10 +155,22 @@ class Dashboard:
             kernel_branch=params.get("kernel_branch", ""),
             kernel_commit=params.get("kernel_commit", ""),
             compiler=params.get("compiler", ""), time=time.time())
+        closed = []
         with self._lock:
             self.builds[b.id] = b
+            # Fix detection (reference: dashboard/app fix flow): a bug
+            # whose attached fix commit appears in this build's commit
+            # list (or head commit) is now verified fixed -> closed.
+            commits = set(params.get("commits") or [])
+            if b.kernel_commit:
+                commits.add(b.kernel_commit)
+            for bug in self.bugs.values():
+                if bug.status == STATUS_FIXED and bug.fix_commit \
+                        and bug.fix_commit in commits:
+                    bug.status = STATUS_CLOSED
+                    closed.append(bug.id)
             self._save()
-        return {"id": b.id}
+        return {"id": b.id, "closed_bugs": closed}
 
     def report_crash(self, params: dict) -> dict:
         """Dedup by title into a Bug; returns whether a repro is
@@ -333,7 +346,9 @@ class Dashboard:
                 return {}
             job.status = "done"
             job.result_ok = bool(params.get("ok"))
-            job.result_error = params.get("error", "")
+            # a JSON null must not poison the persisted state (the UI
+            # escapes this field)
+            job.result_error = params.get("error") or ""
             self._save()
         return {}
 
@@ -381,25 +396,101 @@ def serve_dashboard(workdir: str, addr: tuple[str, int] = ("127.0.0.1", 0),
             except Exception as e:
                 self._reply(500, json.dumps({"error": str(e)}).encode())
 
+        def _html(self, title: str, body: str) -> None:
+            nav = ("<p><a href='/'>bugs</a> | <a href='/builds'>builds"
+                   "</a> | <a href='/jobs'>jobs</a></p>")
+            page = (f"<html><head><title>{html_mod.escape(title)}"
+                    f"</title></head><body><h2>"
+                    f"{html_mod.escape(title)}</h2>{nav}{body}"
+                    f"</body></html>")
+            self._reply(200, page.encode(), "text/html")
+
         def do_GET(self):  # noqa: N802
-            if self.path != "/":
-                return self._reply(404, b"not found", "text/plain")
+            from urllib.parse import parse_qs, urlparse
+
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
             # snapshot under the lock, render outside it so API POSTs
             # from the fleet aren't blocked by UI traffic
-            with dash._lock:
-                snap = [(b.title, b.status, b.num_crashes,
-                         any(c.repro_prog for c in b.crashes))
-                        for b in dash.bugs.values()]
-            snap.sort(key=lambda r: -r[2])
-            rows = "".join(
-                f"<tr><td>{html_mod.escape(title)}</td>"
-                f"<td>{status}</td><td>{n}</td>"
-                f"<td>{'yes' if has_repro else ''}</td></tr>"
-                for title, status, n, has_repro in snap)
-            page = ("<html><body><h2>bugs</h2><table border=1>"
-                    "<tr><th>title</th><th>status</th><th>crashes</th>"
-                    f"<th>repro</th></tr>{rows}</table></body></html>")
-            self._reply(200, page.encode(), "text/html")
+            if url.path == "/":
+                status_filter = q.get("status", [""])[0]
+                with dash._lock:
+                    snap = [(b.id, b.title, b.status, b.num_crashes,
+                             any(c.repro_prog for c in b.crashes))
+                            for b in dash.bugs.values()
+                            if not status_filter
+                            or b.status == status_filter]
+                snap.sort(key=lambda r: -r[3])
+                rows = "".join(
+                    f"<tr><td><a href='/bug?id={bid}'>"
+                    f"{html_mod.escape(title)}</a></td>"
+                    f"<td>{status}</td><td>{n}</td>"
+                    f"<td>{'yes' if has_repro else ''}</td></tr>"
+                    for bid, title, status, n, has_repro in snap)
+                self._html("bugs", "<table border=1>"
+                           "<tr><th>title</th><th>status</th>"
+                           f"<th>crashes</th><th>repro</th></tr>{rows}"
+                           "</table>")
+            elif url.path == "/bug":
+                bid = q.get("id", [""])[0]
+                with dash._lock:
+                    bug = dash.bugs.get(bid)
+                    if bug is None:
+                        return self._reply(404, b"no such bug",
+                                           "text/plain")
+                    crashes = list(bug.crashes)
+                    info = (bug.title, bug.status, bug.num_crashes,
+                            bug.fix_commit, bug.dup_of)
+                title, status, n, fix, dup = info
+                # dup_of holds a free-text bug TITLE from the email
+                # command, not an id: escape it, don't link it
+                body = (f"<p>status: {status} | crashes: {n}"
+                        + (f" | fix: {html_mod.escape(fix)}" if fix
+                           else "")
+                        + (f" | dup of: {html_mod.escape(dup)}"
+                           if dup else "") + "</p>")
+                body += ("<table border=1><tr><th>manager</th>"
+                         "<th>time</th><th>repro</th></tr>")
+                for c in crashes:
+                    body += (f"<tr><td>{html_mod.escape(c.manager)}"
+                             f"</td><td>{time.ctime(c.time)}</td>"
+                             f"<td>{'prog' if c.repro_prog else ''}"
+                             f"{' C' if c.repro_c else ''}</td></tr>")
+                body += "</table>"
+                repro = next((c.repro_prog for c in crashes
+                              if c.repro_prog), "")
+                if repro:
+                    body += (f"<h3>reproducer</h3><pre>"
+                             f"{html_mod.escape(repro)}</pre>")
+                self._html(title, body)
+            elif url.path == "/builds":
+                with dash._lock:
+                    snap = sorted(dash.builds.values(),
+                                  key=lambda b: -b.time)
+                rows = "".join(
+                    f"<tr><td>{b.id[:12]}</td>"
+                    f"<td>{html_mod.escape(b.manager)}</td>"
+                    f"<td>{html_mod.escape(b.kernel_repo)}</td>"
+                    f"<td>{html_mod.escape(b.kernel_commit[:12])}</td>"
+                    f"<td>{time.ctime(b.time)}</td></tr>"
+                    for b in snap[:200])
+                self._html("builds", "<table border=1><tr><th>id</th>"
+                           "<th>manager</th><th>repo</th><th>commit"
+                           f"</th><th>time</th></tr>{rows}</table>")
+            elif url.path == "/jobs":
+                with dash._lock:
+                    snap = list(dash.jobs.values())
+                rows = "".join(
+                    f"<tr><td>{j.id[:12]}</td>"
+                    f"<td><a href='/bug?id={j.bug_id}'>{j.bug_id[:12]}"
+                    f"</a></td><td>{j.status}</td>"
+                    f"<td>{'ok' if j.result_ok else html_mod.escape(j.result_error)}"
+                    f"</td></tr>" for j in snap)
+                self._html("jobs", "<table border=1><tr><th>id</th>"
+                           "<th>bug</th><th>status</th><th>result"
+                           f"</th></tr>{rows}</table>")
+            else:
+                self._reply(404, b"not found", "text/plain")
 
     srv = ThreadingHTTPServer(addr, Handler)
     threading.Thread(target=srv.serve_forever, daemon=True).start()

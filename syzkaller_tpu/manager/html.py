@@ -1,8 +1,11 @@
 """Manager HTTP UI.
 
-Summary, corpus, crash and stats pages rendered server-side
-(reference: syz-manager/html.go:30-41 endpoints: /, /syscalls,
-/corpus, /crash, /cover, /prio, /file, /report, /rawcover).
+Server-side-rendered pages mirroring the reference endpoint set
+(reference: syz-manager/html.go:30-41): / summary, /syscalls (with
+per-call corpus counts), /corpus (filterable by call), /input (one
+program by sig), /crash artifacts, /report (parsed report detail),
+/cover, /rawcover, /prio (the priority matrix behind ChoiceTable
+sampling), /stats JSON.
 """
 
 from __future__ import annotations
@@ -38,11 +41,17 @@ def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
                     self._send(json.dumps(mgr.stats_snapshot()),
                                "application/json")
                 elif url.path == "/corpus":
-                    self._send(_corpus_page(mgr))
+                    self._send(_corpus_page(mgr, q.get("call", [""])[0]))
+                elif url.path == "/input":
+                    self._send(_input_page(mgr, q.get("sig", [""])[0]))
                 elif url.path == "/crash":
                     self._send(_crash_page(mgr, q.get("id", [""])[0]))
+                elif url.path == "/report":
+                    self._send(_report_page(mgr, q.get("id", [""])[0]))
                 elif url.path == "/syscalls":
                     self._send(_syscalls_page(mgr))
+                elif url.path == "/prio":
+                    self._send(_prio_page(mgr, q.get("call", [""])[0]))
                 elif url.path == "/cover":
                     self._send(_cover_page(mgr))
                 elif url.path == "/rawcover":
@@ -73,8 +82,18 @@ def _page(title: str, body: str) -> str:
     return (f"<html><head><title>{html.escape(title)}</title>{_STYLE}"
             f"</head><body><h2>{html.escape(title)}</h2>"
             f"<p><a href='/'>summary</a> | <a href='/corpus'>corpus</a> | "
-            f"<a href='/syscalls'>syscalls</a> | "
+            f"<a href='/syscalls'>syscalls</a> | <a href='/prio'>prio</a> | "
+            f"<a href='/cover'>cover</a> | "
             f"<a href='/stats'>stats.json</a></p>{body}</body></html>")
+
+
+def _call_name(prog_line: str) -> str:
+    """First call name of a serialized program line ('r0 = open(...)'
+    or 'open(...)')."""
+    line = prog_line.split("\n", 1)[0]
+    if "=" in line.split("(", 1)[0]:
+        line = line.split("=", 1)[1].lstrip()
+    return line.split("(", 1)[0].strip()
 
 
 def _summary_page(mgr) -> str:
@@ -95,26 +114,52 @@ def _summary_page(mgr) -> str:
         sig = hash_string(title.encode())
         crashes += (f"<tr><td><a href='/crash?id={sig}'>"
                     f"{html.escape(title)}</a></td><td>{entry.count}</td>"
-                    f"<td>{'yes' if entry.repro_done else ''}</td></tr>")
+                    f"<td>{'yes' if entry.repro_done else ''}</td>"
+                    f"<td><a href='/report?id={sig}'>report</a></td></tr>")
     body = (f"<table>{rows}</table><h3>Crashes</h3>"
-            f"<table><tr><th>title</th><th>count</th><th>repro</th></tr>"
-            f"{crashes}</table>")
+            f"<table><tr><th>title</th><th>count</th><th>repro</th>"
+            f"<th></th></tr>{crashes}</table>")
     return _page(f"{mgr.cfg.name} syz-manager", body)
 
 
-def _corpus_page(mgr) -> str:
+def _prog_calls(text: str) -> list[str]:
+    return [_call_name(line) for line in text.splitlines()
+            if line.strip() and not line.startswith("#")]
+
+
+def _corpus_page(mgr, call_filter: str = "") -> str:
     # copy under the lock, render outside it — the render escapes full
     # program texts and must not stall fuzzer RPCs
     with mgr.serv._lock:
-        items = list(mgr.serv.corpus.items())[:1000]
+        items = list(mgr.serv.corpus.items())
     rows = ""
+    shown = 0
     for key, inp in items:
+        text = inp.get("prog", "")
+        if call_filter and call_filter not in _prog_calls(text):
+            continue
+        shown += 1
+        if shown > 1000:
+            break
         sig_len = len(inp.get("signal", [[], []])[0])
-        rows += (f"<tr><td>{key[:16]}</td><td>{sig_len}</td>"
-                 f"<td><pre>{html.escape(inp.get('prog', ''))}"
-                 f"</pre></td></tr>")
-    return _page("corpus", f"<table><tr><th>sig</th><th>signal</th>"
-                           f"<th>program</th></tr>{rows}</table>")
+        rows += (f"<tr><td><a href='/input?sig={key}'>{key[:16]}</a></td>"
+                 f"<td>{sig_len}</td>"
+                 f"<td><pre>{html.escape(text)}</pre></td></tr>")
+    title = f"corpus ({call_filter})" if call_filter else "corpus"
+    return _page(title, f"<table><tr><th>sig</th><th>signal</th>"
+                        f"<th>program</th></tr>{rows}</table>")
+
+
+def _input_page(mgr, sig: str) -> str:
+    """One corpus program by hash (reference: html.go /input)."""
+    with mgr.serv._lock:
+        inp = mgr.serv.corpus.get(sig)
+    if inp is None:
+        return _page("input", "not found")
+    sig_elems = inp.get("signal", [[], []])[0]
+    body = (f"<p>signal: {len(sig_elems)}</p>"
+            f"<pre>{html.escape(inp.get('prog', ''))}</pre>")
+    return _page(f"input {sig[:16]}", body)
 
 
 def _crash_page(mgr, crash_id: str) -> str:
@@ -143,8 +188,86 @@ def _cover_page(mgr) -> str:
 
 
 def _syscalls_page(mgr) -> str:
+    """Per-call table with corpus input counts (reference html.go
+    /syscalls shows per-call inputs/cover)."""
+    counts: dict[str, int] = {}
+    with mgr.serv._lock:
+        texts = [inp.get("prog", "") for inp in mgr.serv.corpus.values()]
+    for text in texts:
+        for name in set(_prog_calls(text)):
+            counts[name] = counts.get(name, 0) + 1
     rows = "".join(
-        f"<tr><td>{html.escape(c.name)}</td><td>{c.nr}</td></tr>"
+        f"<tr><td><a href='/corpus?call={html.escape(c.name)}'>"
+        f"{html.escape(c.name)}</a></td><td>{c.nr}</td>"
+        f"<td>{counts.get(c.name, 0)}</td>"
+        f"<td><a href='/prio?call={html.escape(c.name)}'>prio</a></td>"
+        f"</tr>"
         for c in mgr.target.syscalls)
     return _page("syscalls",
-                 f"<table><tr><th>call</th><th>nr</th></tr>{rows}</table>")
+                 f"<table><tr><th>call</th><th>nr</th><th>inputs</th>"
+                 f"<th></th></tr>{rows}</table>")
+
+
+def _prio_page(mgr, call: str) -> str:
+    """The static x dynamic priority matrix driving ChoiceTable
+    sampling (reference: html.go /prio, prog/prio.go)."""
+    names = [c.name for c in mgr.target.syscalls]
+    prios = mgr.serv.prios
+    if not prios:
+        return _page("prio", "no priorities")
+    if call:
+        try:
+            i = names.index(call)
+        except ValueError:
+            return _page("prio", "unknown call")
+        row = prios[i]
+        pairs = sorted(zip(names, row), key=lambda kv: -kv[1])[:50]
+        rows = "".join(
+            f"<tr><td>{html.escape(n)}</td><td>{p:.3f}</td></tr>"
+            for n, p in pairs)
+        return _page(f"prio: {call}",
+                     f"<table><tr><th>target call</th><th>prio</th></tr>"
+                     f"{rows}</table>")
+    # overview: each call's top-3 priority partners
+    rows = ""
+    for i, name in enumerate(names[:400]):
+        row = prios[i] if i < len(prios) else []
+        top = sorted(zip(names, row), key=lambda kv: -kv[1])[:3]
+        partners = ", ".join(f"{n} {p:.2f}" for n, p in top)
+        rows += (f"<tr><td><a href='/prio?call={html.escape(name)}'>"
+                 f"{html.escape(name)}</a></td>"
+                 f"<td>{html.escape(partners)}</td></tr>")
+    return _page("prio", f"<table><tr><th>call</th><th>top partners"
+                         f"</th></tr>{rows}</table>")
+
+
+def _report_page(mgr, crash_id: str) -> str:
+    """Parsed report detail for one crash: title, report text, log
+    tail (reference: html.go /report)."""
+    if not crash_id or any(c not in "0123456789abcdef" for c in crash_id):
+        return _page("report", "not found")
+    dirpath = os.path.join(mgr.crashdir, crash_id)
+    if not os.path.isdir(dirpath):
+        return _page("report", "not found")
+    names = sorted(os.listdir(dirpath))
+
+    def read(name):
+        try:
+            with open(os.path.join(dirpath, name), "rb") as f:
+                return f.read(128 << 10).decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    title = read("description").strip()
+    reports = [n for n in names if n.startswith("report")]
+    logs = [n for n in names if n.startswith("log")]
+    body = f"<p><b>{html.escape(title)}</b></p>"
+    if reports:
+        body += f"<h3>report</h3><pre>{html.escape(read(reports[-1]))}</pre>"
+    if logs:
+        tail = read(logs[-1])[-16384:]
+        body += f"<h3>log tail</h3><pre>{html.escape(tail)}</pre>"
+    repro = [n for n in names if n.startswith("repro")]
+    for n in repro:
+        body += f"<h3>{html.escape(n)}</h3><pre>{html.escape(read(n))}</pre>"
+    return _page("report", body)

@@ -174,14 +174,14 @@ class PipelineStats:
 PIPELINE_TENSOR_CONFIG = TensorConfig(
     max_calls=32, max_slots=128, arena=2048, max_blob=768)
 
-# The tunneled host link moves ~9 MB/s on synchronous copies, so the
-# delta row size IS the throughput ceiling (row_bytes * rate = link
-# bandwidth).  P=1024 holds one full changed blob (max_blob 768,
-# 8-aligned) plus header/journals in a 1248-byte row — 1.8x less wire
-# than the 2048-payload default; multi-blob mutants that exceed it are
-# flagged OVERFLOW and dropped (counted in stats; rare, and a dropped
-# mutant costs only its slot in the batch).
-PIPELINE_DELTA_SPEC = DeltaSpec(K=16, D=4, P=1024)
+# The tunneled host link moves ~9 MB/s on synchronous copies, so wire
+# bytes per mutant ARE the throughput ceiling.  DeltaSpec's defaults
+# (228-byte core row + pooled 1 KB payload slots for the ~6% of
+# mutants that change data bytes) are tuned for exactly this pipeline;
+# P=1024 holds one full changed blob (max_blob 768, 8-aligned), and
+# mutants that exceed the budgets are flagged OVERFLOW and dropped
+# (counted in stats; a dropped mutant costs only its batch slot).
+PIPELINE_DELTA_SPEC = DeltaSpec()
 
 
 class DevicePipeline:

@@ -271,3 +271,60 @@ def test_ci_patch_test_job(tmp_path, git_repo):
         assert dash.jobs[jid].result_ok
     finally:
         srv.shutdown()
+
+
+def test_dashboard_fix_commit_closes_on_build(tmp_path):
+    """A bug with an attached fix commit transitions fixed -> closed
+    when a build containing that commit is uploaded (reference
+    dashboard fix-detection flow)."""
+    from syzkaller_tpu.dashboard.app import (
+        STATUS_CLOSED, STATUS_FIXED, Dashboard)
+
+    dash = Dashboard(str(tmp_path / "dash"))
+    dash.report_crash({"title": "BUG: fixme", "manager": "m0"})
+    bug_id = next(iter(dash.bugs))
+    dash.update_bug(bug_id, fix_commit="net: fix refcount leak")
+    assert dash.bugs[bug_id].status == STATUS_FIXED
+    # build without the fix: stays fixed
+    dash.upload_build({"manager": "m0", "kernel_commit": "abc",
+                       "commits": ["unrelated: cleanup"]})
+    assert dash.bugs[bug_id].status == STATUS_FIXED
+    # build whose commit list contains the fix: closed
+    res = dash.upload_build({"manager": "m0", "kernel_commit": "def",
+                             "commits": ["net: fix refcount leak"]})
+    assert bug_id in res["closed_bugs"]
+    assert dash.bugs[bug_id].status == STATUS_CLOSED
+
+
+def test_dashboard_web_ui(tmp_path):
+    """Bug list/detail, builds and jobs pages serve real state."""
+    import urllib.request
+
+    from syzkaller_tpu.dashboard.app import serve_dashboard
+
+    srv, dash = serve_dashboard(str(tmp_path / "dash"))
+    try:
+        dash.report_crash({"title": "WARNING: odd thing",
+                           "manager": "m1",
+                           "repro_prog": "open()\nread()\n"})
+        dash.upload_build({"manager": "m1", "kernel_commit": "c0ffee"})
+        bug_id = next(iter(dash.bugs))
+        dash.add_job(bug_id, patch="--- a/f\n+++ b/f\n")
+        host, port = srv.server_address[:2]
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}{path}", timeout=10) as r:
+                return r.read().decode()
+
+        index = get("/")
+        assert "WARNING: odd thing" in index and f"/bug?id={bug_id}" in index
+        detail = get(f"/bug?id={bug_id}")
+        assert "reproducer" in detail and "open()" in detail
+        assert "m1" in get("/builds") and "c0ffee"[:12] in get("/builds")
+        jobs = get("/jobs")
+        assert bug_id[:12] in jobs and "pending" in jobs
+        filtered = get("/?status=closed")
+        assert "WARNING: odd thing" not in filtered
+    finally:
+        srv.shutdown()

@@ -214,3 +214,72 @@ def test_end_to_end_manager_fuzzer(tmp_path):
     finally:
         fp.shutdown()
         m.shutdown()
+
+
+# -- HTTP UI ------------------------------------------------------------
+
+
+def test_http_ui_endpoints(tmp_path, test_target):
+    """Every reference UI endpoint (html.go:30-41 analogue) serves a
+    sane page against a live manager with corpus + crash state."""
+    import json as json_mod
+    import urllib.request
+
+    from syzkaller_tpu.manager.html import serve_http
+    from syzkaller_tpu.report.report import Report
+    from syzkaller_tpu.utils.hashsig import hash_string
+
+    cfg = load_config({"workdir": str(tmp_path / "work"),
+                       "target": "test/64", "http": "",
+                       "reproduce": False})
+    m = Manager(cfg)
+    try:
+        p = generate_prog(test_target, RandGen(test_target, 3), 4)
+        text = serialize_prog(p).decode()
+        first_call = p.calls[0].meta.name
+        m.serv.NewInput({"name": "f",
+                         "input": _input_dict(text, [7, 8], call="c")})
+        rep = Report(title="KASAN: use-after-free in tz_write",
+                     report=b"KASAN: use-after-free in tz_write\n...",
+                     output=b"console log tail\n")
+        m.save_crash(rep)
+        srv = serve_http(m, ("127.0.0.1", 0))
+        try:
+            host, port = srv.server_address[:2]
+
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://{host}:{port}{path}", timeout=10) as r:
+                    return r.read().decode()
+
+            summary = get("/")
+            assert "Crashes" in summary and "use-after-free" in summary
+            stats = json_mod.loads(get("/stats"))
+            assert stats["corpus"] == 1
+            corpus = get("/corpus")
+            assert "/input?sig=" in corpus
+            sig = corpus.split("/input?sig=")[1].split("'")[0]
+            inp = get(f"/input?sig={sig}")
+            assert "signal: 2" in inp
+            filtered = get(f"/corpus?call={first_call}")
+            assert "<pre>" in filtered
+            empty = get("/corpus?call=definitely_not_a_call")
+            assert "<pre>" not in empty
+            syscalls = get("/syscalls")
+            assert first_call in syscalls and "inputs" in syscalls
+            prio = get("/prio")
+            assert "top partners" in prio
+            prio_one = get(f"/prio?call={first_call}")
+            assert "target call" in prio_one
+            crash_id = hash_string(rep.title.encode())
+            crash = get(f"/crash?id={crash_id}")
+            assert "console log tail" in crash
+            report = get(f"/report?id={crash_id}")
+            assert "use-after-free" in report
+            assert "not found" in get("/report?id=../../etc")
+            raw = get("/rawcover")
+            assert isinstance(raw, str)
+        finally:
+            srv.shutdown()
+    finally:
+        m.shutdown()
