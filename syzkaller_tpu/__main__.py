@@ -24,6 +24,13 @@ _TOOLS = {
                  "render bench JSON to HTML charts"),
     "symbolize": ("syzkaller_tpu.tools.symbolize",
                   "symbolize a crash report"),
+    "fmt": ("syzkaller_tpu.tools.fmt", "format syzlang descriptions"),
+    "upgrade": ("syzkaller_tpu.tools.upgrade",
+                "migrate a corpus.db to the current format"),
+    "tty": ("syzkaller_tpu.tools.tty",
+            "console/serial reader with crash highlighting"),
+    "imagegen": ("syzkaller_tpu.tools.imagegen",
+                 "generate a VM disk-image build script"),
 }
 
 

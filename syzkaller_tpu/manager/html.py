@@ -162,18 +162,30 @@ def _input_page(mgr, sig: str) -> str:
     return _page(f"input {sig[:16]}", body)
 
 
-def _crash_page(mgr, crash_id: str) -> str:
-    # crash ids are hex title-hashes; reject anything else so the
-    # query param can't traverse out of crashdir.
+def _crash_dir(mgr, crash_id: str):
+    """Validated crash artifact dir for a hex title-hash id, or None.
+    The hex check is the path-traversal guard for the query param."""
     if not crash_id or any(c not in "0123456789abcdef" for c in crash_id):
-        return _page("crash", "not found")
+        return None
     dirpath = os.path.join(mgr.crashdir, crash_id)
-    if not os.path.isdir(dirpath):
+    return dirpath if os.path.isdir(dirpath) else None
+
+
+def _read_capped(dirpath: str, name: str, cap: int = 128 << 10) -> str:
+    try:
+        with open(os.path.join(dirpath, name), "rb") as f:
+            return f.read(cap).decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def _crash_page(mgr, crash_id: str) -> str:
+    dirpath = _crash_dir(mgr, crash_id)
+    if dirpath is None:
         return _page("crash", "not found")
     parts = []
     for name in sorted(os.listdir(dirpath)):
-        with open(os.path.join(dirpath, name), "rb") as f:
-            content = f.read(64 << 10).decode("utf-8", "replace")
+        content = _read_capped(dirpath, name, 64 << 10)
         parts.append(f"<h3>{html.escape(name)}</h3>"
                      f"<pre>{html.escape(content)}</pre>")
     return _page("crash", "".join(parts))
@@ -220,6 +232,8 @@ def _prio_page(mgr, call: str) -> str:
             i = names.index(call)
         except ValueError:
             return _page("prio", "unknown call")
+        if i >= len(prios):
+            return _page("prio", "no priorities for call")
         row = prios[i]
         pairs = sorted(zip(names, row), key=lambda kv: -kv[1])[:50]
         rows = "".join(
@@ -244,19 +258,13 @@ def _prio_page(mgr, call: str) -> str:
 def _report_page(mgr, crash_id: str) -> str:
     """Parsed report detail for one crash: title, report text, log
     tail (reference: html.go /report)."""
-    if not crash_id or any(c not in "0123456789abcdef" for c in crash_id):
-        return _page("report", "not found")
-    dirpath = os.path.join(mgr.crashdir, crash_id)
-    if not os.path.isdir(dirpath):
+    dirpath = _crash_dir(mgr, crash_id)
+    if dirpath is None:
         return _page("report", "not found")
     names = sorted(os.listdir(dirpath))
 
     def read(name):
-        try:
-            with open(os.path.join(dirpath, name), "rb") as f:
-                return f.read(128 << 10).decode("utf-8", "replace")
-        except OSError:
-            return ""
+        return _read_capped(dirpath, name)
 
     title = read("description").strip()
     reports = [n for n in names if n.startswith("report")]

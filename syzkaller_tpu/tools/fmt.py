@@ -1,0 +1,63 @@
+"""tz-fmt: canonical formatter for syzlang description files
+(reference: tools/syz-fmt/syz-fmt.go — parse via pkg/ast, re-emit).
+
+Formatting IS the AST's own canonical rendering: parse the file and
+write Description.format() back.  `-w` rewrites files in place (only
+when the content changed); without it the formatted text goes to
+stdout.  `-d` exits nonzero if any file differs (CI check mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from syzkaller_tpu.compiler.parser import ParseError, parse
+
+
+def format_text(src: str, filename: str = "<src>") -> str:
+    return parse(src, filename).format()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-fmt")
+    ap.add_argument("-w", action="store_true",
+                    help="write result back to the file")
+    ap.add_argument("-d", action="store_true",
+                    help="exit 1 if any file is not canonically "
+                         "formatted (implies no output)")
+    ap.add_argument("files", nargs="+")
+    args = ap.parse_args(argv)
+
+    dirty = 0
+    for fname in args.files:
+        path = Path(fname)
+        try:
+            src = path.read_text()
+        except OSError as e:
+            print(f"{fname}: {e}", file=sys.stderr)
+            return 2
+        try:
+            out = format_text(src, fname)
+        except ParseError as e:
+            print(f"{fname}: {e}", file=sys.stderr)
+            return 2
+        changed = out != src
+        dirty += changed
+        if args.d:
+            if changed:
+                print(f"{fname}: not formatted", file=sys.stderr)
+        elif args.w:
+            if changed:
+                path.write_text(out)
+                print(f"formatted {fname}")
+        else:
+            # stdout mode always emits the (canonical) source, changed
+            # or not — consumers pipe it
+            sys.stdout.write(out)
+    return 1 if (args.d and dirty) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+"""tz-tty: console/serial reader with crash highlighting
+(reference: tools/syz-tty — dump a serial console, decoding the
+Windows KD protocol where needed, and flag kernel oopses live).
+
+Reads a device node, pipe, or file; `-kd` runs the stream through the
+KD DbgPrint decoder (utils/kd.py); every line is scanned with the
+report oops table and crash lines are prefixed so a human tailing a
+flaky board sees them immediately.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from syzkaller_tpu.report import get_reporter
+from syzkaller_tpu.utils import kd
+
+
+def process_stream(reader, out, use_kd: bool = False,
+                   target_os: str = "linux", max_bytes: int = 1 << 30
+                   ) -> int:
+    """Pump reader->out; returns number of crash lines seen."""
+    rep = get_reporter(target_os)
+    crashes = 0
+    pending = b""
+    text_buf = b""
+    total = 0
+    while total < max_bytes:
+        chunk = reader.read(4096)
+        if not chunk:
+            break
+        total += len(chunk)
+        if use_kd:
+            text, pending = kd.decode(pending + chunk)
+        else:
+            text = chunk
+        text_buf += text
+        while b"\n" in text_buf:
+            line, text_buf = text_buf.split(b"\n", 1)
+            shown = line.decode("utf-8", "replace")
+            if rep.contains_crash(line + b"\n"):
+                crashes += 1
+                out.write(f"*** CRASH: {shown}\n")
+            else:
+                out.write(shown + "\n")
+    if text_buf:
+        # the stream often dies MID-line at the crash: scan the
+        # unterminated tail too
+        shown = text_buf.decode("utf-8", "replace")
+        if rep.contains_crash(text_buf + b"\n"):
+            crashes += 1
+            out.write(f"*** CRASH: {shown}\n")
+        else:
+            out.write(shown + "\n")
+    return crashes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-tty")
+    ap.add_argument("device", help="tty device, pipe, or log file")
+    ap.add_argument("-kd", action="store_true",
+                    help="decode Windows KD DbgPrint packets")
+    ap.add_argument("-os", dest="target_os", default="linux")
+    args = ap.parse_args(argv)
+    with open(args.device, "rb", buffering=0) as f:
+        crashes = process_stream(f, sys.stdout, use_kd=args.kd,
+                                 target_os=args.target_os)
+    return 0 if crashes == 0 else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

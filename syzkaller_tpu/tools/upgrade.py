@@ -1,0 +1,60 @@
+"""tz-upgrade: migrate a corpus.db to the current format
+(reference: tools/syz-upgrade — re-serialize every program through the
+current descriptions, dropping ones that no longer parse).
+
+Programs from older description revisions survive where the text
+parser's excess-argument tolerance allows (models/encoding.py
+eat_excessive, mirroring the reference's cross-version corpus
+policy); programs that reference removed syscalls are dropped and
+counted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from syzkaller_tpu.db import open_db
+from syzkaller_tpu.db.db import CUR_VERSION
+from syzkaller_tpu.models.encoding import deserialize_prog, serialize_prog
+from syzkaller_tpu.models.target import get_target
+from syzkaller_tpu.utils.hashsig import hash_string
+
+
+def upgrade_db(path: str, target_os: str = "test",
+               arch: str = "64") -> tuple[int, int]:
+    """Returns (kept, dropped)."""
+    target = get_target(target_os, arch)
+    db = open_db(path)
+    kept, dropped = {}, 0
+    for key, rec in db.records.items():
+        try:
+            p = deserialize_prog(target, rec.val)
+            text = serialize_prog(p)
+        except Exception:
+            dropped += 1
+            continue
+        kept[hash_string(text)] = (text, rec.seq)
+    # rewrite: delete everything, re-save the survivors, bump version
+    for key in list(db.records):
+        db.delete(key)
+    for key, (text, seq) in kept.items():
+        db.save(key, text, seq)
+    db.bump_version(CUR_VERSION)
+    db.flush()
+    return len(kept), dropped
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-upgrade")
+    ap.add_argument("db", help="corpus.db to upgrade in place")
+    ap.add_argument("-os", dest="target_os", default="test")
+    ap.add_argument("-arch", default="64")
+    args = ap.parse_args(argv)
+    kept, dropped = upgrade_db(args.db, args.target_os, args.arch)
+    print(f"upgraded: kept {kept}, dropped {dropped}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,3 +114,146 @@ def test_dispatcher_lists_tools(capsys, monkeypatch):
     out = capsys.readouterr().out
     for tool in ("manager", "fuzzer", "execprog", "repro", "hub"):
         assert tool in out
+
+
+# ---- tz-fmt ----------------------------------------------------------
+
+def test_fmt_tool(tmp_path, capsys):
+    from syzkaller_tpu.compiler.parser import parse
+    from syzkaller_tpu.tools.fmt import format_text, main
+
+    src = ("resource  fd2 [ int32 ] : -1\n"
+           "\n"
+           "mycall( a  fd2 , b int32 )  fd2\n")
+    f = tmp_path / "x.txt"
+    f.write_text(src)
+    # canonical form parses to the same description and is idempotent
+    out = format_text(src)
+    assert format_text(out) == out
+    assert len(parse(out).decls) == len(parse(src).decls)
+    # -d flags the unformatted file
+    assert main(["-d", str(f)]) == 1
+    # -w rewrites; then -d is clean
+    assert main(["-w", str(f)]) == 0
+    capsys.readouterr()
+    assert main(["-d", str(f)]) == 0
+    # parse errors exit 2
+    bad = tmp_path / "bad.txt"
+    bad.write_text("mycall(((\n")
+    assert main([str(bad)]) == 2
+
+
+def test_fmt_real_descriptions_roundtrip(tmp_path):
+    """Formatting the shipped linux descriptions preserves them
+    semantically (same decl count after a reparse)."""
+    from pathlib import Path
+
+    from syzkaller_tpu.compiler.parser import parse
+    from syzkaller_tpu.tools.fmt import format_text
+
+    root = Path(__file__).resolve().parents[1] / \
+        "syzkaller_tpu/sys/descriptions/linux"
+    for path in sorted(root.glob("*.txt"))[:4]:
+        src = path.read_text()
+        out = format_text(src, str(path))
+        assert len(parse(out, str(path)).decls) == \
+            len(parse(src, str(path)).decls), path
+        assert format_text(out) == out, f"{path} not idempotent"
+
+
+# ---- tz-upgrade ------------------------------------------------------
+
+def test_upgrade_tool(tmp_path, test_target, capsys):
+    from syzkaller_tpu.db import open_db
+    from syzkaller_tpu.db.db import CUR_VERSION
+    from syzkaller_tpu.tools.upgrade import main
+
+    dbpath = str(tmp_path / "corpus.db")
+    db = open_db(dbpath, version=0)
+    for seed in (1, 2):
+        _, p = _write_prog(tmp_path, test_target, seed=seed,
+                           name=f"p{seed}.prog")
+        db.save(f"k{seed}", serialize_prog(p), 0)
+    db.save("junk", b"not_a_syscall(0x1)\n", 0)
+    db.flush()
+    assert main([dbpath]) == 0
+    assert "kept 2" in capsys.readouterr().out
+    db2 = open_db(dbpath)
+    assert db2.version == CUR_VERSION
+    assert len(db2.records) == 2
+
+
+# ---- tz-tty ----------------------------------------------------------
+
+def test_tty_tool_plain(tmp_path, capsys):
+    from syzkaller_tpu.tools.tty import main
+
+    log = tmp_path / "console.log"
+    log.write_bytes(b"booting...\n"
+                    b"BUG: unable to handle kernel NULL pointer "
+                    b"dereference at 0000000000000000\n"
+                    b"bye\n")
+    assert main([str(log)]) == 3  # crash seen
+    out = capsys.readouterr().out
+    assert "*** CRASH:" in out and "booting..." in out
+
+
+def test_tty_tool_kd(tmp_path, capsys):
+    import struct
+
+    from syzkaller_tpu.tools.tty import main
+    from syzkaller_tpu.utils import kd
+
+    text = b"hello from kd\n"
+    body = struct.pack("<I", kd.DBGKD_PRINT_STRING) + b"\0" * 8 + \
+        struct.pack("<I", len(text)) + text
+    pkt = kd.PACKET_LEADER + struct.pack(
+        "<HHII", kd.PACKET_TYPE_KD_DEBUG_IO, len(body), 0, 0) + \
+        body + b"\xaa"
+    log = tmp_path / "kd.bin"
+    log.write_bytes(pkt)
+    assert main([str(log), "-kd", "-os", "linux"]) == 0
+    assert "hello from kd" in capsys.readouterr().out
+
+
+# ---- tz-imagegen -----------------------------------------------------
+
+def test_imagegen_tool(tmp_path, capsys):
+    import subprocess
+
+    from syzkaller_tpu.tools.imagegen import generate, main
+
+    script = generate("bzImage", "disk.raw", "tz-executor")
+    assert "mkfs.ext4" in script and "panic_on_warn=1" in script
+    assert "busybox" in script
+    out = tmp_path / "create-image.sh"
+    assert main(["-kernel", "bzImage", "-o", str(out)]) == 0
+    assert os.access(out, os.X_OK)
+    # the generated script is valid shell
+    subprocess.run(["sh", "-n", str(out)], check=True)
+    deb = generate("bzImage", "d.raw", "x", userspace="debootstrap")
+    assert "debootstrap" in deb
+
+
+# ---- tz-extract kernel-src mode --------------------------------------
+
+def test_extract_kernel_src_includes(tmp_path):
+    """Extraction against a kernel source tree picks up constants the
+    host libc doesn't define, via the arch include-path ladder."""
+    from syzkaller_tpu.sys.extract import (
+        extract_consts, kernel_include_flags)
+
+    # fake kernel tree: include/uapi defines an exotic constant
+    uapi = tmp_path / "include" / "uapi" / "linux"
+    uapi.mkdir(parents=True)
+    (uapi / "tzfake.h").write_text("#define TZ_FAKE_CONST 0xabc\n")
+    (tmp_path / "arch" / "x86" / "include" / "uapi").mkdir(parents=True)
+    flags = kernel_include_flags(str(tmp_path), "amd64")
+    assert "-I" in flags
+    # the flags must be usable AS SHIPPED alongside libc headers
+    vals = extract_consts(["TZ_FAKE_CONST", "TZ_MISSING"],
+                          includes=["<stdio.h>", "<unistd.h>",
+                                    "<linux/tzfake.h>"],
+                          cflags=flags)
+    assert vals["TZ_FAKE_CONST"] == 0xABC
+    assert vals["TZ_MISSING"] is None
