@@ -31,18 +31,21 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     dirty = 0
+    errors = 0
     for fname in args.files:
         path = Path(fname)
         try:
             src = path.read_text()
         except OSError as e:
             print(f"{fname}: {e}", file=sys.stderr)
-            return 2
+            errors += 1
+            continue
         try:
             out = format_text(src, fname)
         except ParseError as e:
             print(f"{fname}: {e}", file=sys.stderr)
-            return 2
+            errors += 1
+            continue
         changed = out != src
         dirty += changed
         if args.d:
@@ -56,6 +59,8 @@ def main(argv=None) -> int:
             # stdout mode always emits the (canonical) source, changed
             # or not — consumers pipe it
             sys.stdout.write(out)
+    if errors:  # every file was still visited (gofmt behavior)
+        return 2
     return 1 if (args.d and dirty) else 0
 
 

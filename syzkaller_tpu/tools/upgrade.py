@@ -22,8 +22,10 @@ from syzkaller_tpu.utils.hashsig import hash_string
 
 
 def upgrade_db(path: str, target_os: str = "test",
-               arch: str = "64") -> tuple[int, int]:
-    """Returns (kept, dropped)."""
+               arch: str = "64", force: bool = False) -> tuple[int, int]:
+    """Returns (kept, dropped).  Refuses a total wipe unless `force`:
+    dropping EVERY record almost always means the wrong -os/-arch was
+    given, and the rewrite is irreversible."""
     target = get_target(target_os, arch)
     db = open_db(path)
     kept, dropped = {}, 0
@@ -35,6 +37,10 @@ def upgrade_db(path: str, target_os: str = "test",
             dropped += 1
             continue
         kept[hash_string(text)] = (text, rec.seq)
+    if db.records and not kept and not force:
+        raise SystemExit(
+            f"refusing to drop all {dropped} records (wrong -os/-arch "
+            f"for this corpus? use -force to really wipe)")
     # rewrite: delete everything, re-save the survivors, bump version
     for key in list(db.records):
         db.delete(key)
@@ -50,8 +56,11 @@ def main(argv=None) -> int:
     ap.add_argument("db", help="corpus.db to upgrade in place")
     ap.add_argument("-os", dest="target_os", default="test")
     ap.add_argument("-arch", default="64")
+    ap.add_argument("-force", action="store_true",
+                    help="allow dropping every record")
     args = ap.parse_args(argv)
-    kept, dropped = upgrade_db(args.db, args.target_os, args.arch)
+    kept, dropped = upgrade_db(args.db, args.target_os, args.arch,
+                               force=args.force)
     print(f"upgraded: kept {kept}, dropped {dropped}")
     return 0
 

@@ -114,3 +114,50 @@ def test_revision_tracks_descriptions(linux):
 
     assert linux.revision == revision_hash("linux")
     assert len(linux.revision) == 40
+
+
+def test_new_subsystem_surfaces(linux):
+    """bpf/perf/tty/block/random/alg/namespace surfaces compile with
+    real NRs and ioctl codes from the extracted consts."""
+    names = {c.name for c in linux.syscalls}
+    for n in ("bpf$BPF_MAP_CREATE", "bpf$BPF_PROG_LOAD",
+              "perf_event_open", "ioctl$PERF_EVENT_IOC_ENABLE",
+              "ioctl$TCGETS", "ioctl$TIOCGPTN",
+              "syz_open_dev$loop", "ioctl$LOOP_SET_FD",
+              "ioctl$BLKRRPART", "ioctl$RNDADDENTROPY",
+              "socket$alg", "bind$alg", "accept4$alg",
+              "unshare", "setns", "syz_open_procfs$ns"):
+        assert n in names, n
+    nrs = {c.name: c.nr for c in linux.syscalls}
+    assert nrs["bpf$BPF_MAP_CREATE"] == 321       # __NR_bpf on amd64
+    assert nrs["perf_event_open"] == 298
+    # ioctl const args carry real codes (TCGETS = 0x5401)
+    tcgets = next(c for c in linux.syscalls if c.name == "ioctl$TCGETS")
+    assert tcgets.args[1].val == 0x5401
+
+
+def test_new_surfaces_generate_and_serialize(linux, iters):
+    """Focused generation over the new call families round-trips
+    through text and exec serialization."""
+    from syzkaller_tpu.models.encoding import (
+        deserialize_prog, serialize_prog)
+    from syzkaller_tpu.models.encodingexec import serialize_for_exec
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.prio import build_choice_table
+    from syzkaller_tpu.models.rand import RandGen
+
+    fams = ("bpf", "perf_event_open", "ioctl$TC", "ioctl$LOOP",
+            "socket$alg", "setns")
+    enabled = {c: c.name.startswith(fams) for c in linux.syscalls}
+    ct = build_choice_table(linux, enabled=enabled)
+    hit = set()
+    for seed in range(max(iters, 10) * 4):
+        p = generate_prog(linux, RandGen(linux, 7000 + seed), 8, ct=ct)
+        text = serialize_prog(p)
+        p2 = deserialize_prog(linux, text)
+        assert serialize_prog(p2) == text
+        serialize_for_exec(p)
+        for c in p.calls:
+            if c.meta.name.startswith(fams):
+                hit.add(c.meta.name.split("$")[0])
+    assert hit, "new families never generated"
