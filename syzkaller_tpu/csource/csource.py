@@ -31,11 +31,13 @@ class Options:
     collide: bool = False
     repeat: bool = False
     procs: int = 1
-    sandbox: str = "none"
+    sandbox: str = "none"  # none | setuid | namespace
     fault: bool = False
     fault_call: int = -1
     fault_nth: int = 0
     use_tmp_dir: bool = True
+    tun: bool = False      # tap-device packet injection env
+    cgroups: bool = False  # per-proc cgroup join
 
     def serialize(self) -> str:
         """One-line option descriptor stored with repro artifacts
@@ -43,7 +45,8 @@ class Options:
         return ("{" + f"threaded:{self.threaded} collide:{self.collide} "
                 f"repeat:{self.repeat} procs:{self.procs} "
                 f"sandbox:{self.sandbox} fault:{self.fault} "
-                f"fault_call:{self.fault_call} fault_nth:{self.fault_nth}"
+                f"fault_call:{self.fault_call} fault_nth:{self.fault_nth} "
+                f"tun:{self.tun} cgroups:{self.cgroups}"
                 + "}")
 
     @staticmethod
@@ -104,7 +107,27 @@ class _Renderer:
             backend = _SIM_BACKEND
         body = self._render_body()
         main = self._render_main()
-        return "\n".join([header, backend, body, main, ""])
+        pseudo = self._render_pseudo_helpers()
+        return "\n".join([header, backend, pseudo, body, main, ""])
+
+    def _used_pseudo(self) -> set[str]:
+        return {c.meta.call_name for c in self.p.calls
+                if c.meta.call_name in _PSEUDO_C}
+
+    def _render_pseudo_helpers(self) -> str:
+        """C implementations for the syz_* calls the program uses
+        (reference: csource embeds executor/common_linux.h's syz_*
+        bodies the same way)."""
+        if self.target.os != "linux":
+            return ""
+        used = self._used_pseudo()
+        out = []
+        if self.opts.tun or used & {"syz_emit_ethernet",
+                                    "syz_extract_tcp_res"}:
+            out.append(_C_TUN)
+        for name in sorted(used):
+            out.append(_PSEUDO_C[name])
+        return "\n".join(out)
 
     def _render_body(self) -> str:
         out = []
@@ -217,7 +240,12 @@ class _Renderer:
         ret = ""
         if c.ret is not None and id(c.ret) in self.res_index:
             ret = f"r[{self.res_index[id(c.ret)]}] = "
-        if self.target.os == "linux":
+        if self.target.os == "linux" and \
+                c.meta.call_name in _PSEUDO_C:
+            call = f"{c.meta.call_name}("
+            call += ", ".join(f"(long)({a})" for a in args)
+            call += ")"
+        elif self.target.os == "linux":
             call = f"syscall({c.meta.nr}"
             if args:
                 call += ", " + ", ".join(args)
@@ -257,6 +285,14 @@ class _Renderer:
         if o.use_tmp_dir:
             out.append("  use_temporary_dir();")
         out.append(f"  install_segv_handler();")
+        if o.sandbox == "namespace":
+            out.append("  sandbox_namespace();")
+        if self.target.os == "linux" and (
+                o.tun or self._used_pseudo() & {"syz_emit_ethernet",
+                                                "syz_extract_tcp_res"}):
+            out.append("  setup_tun();")
+        if o.cgroups:
+            out.append("  setup_cgroups();")
         if o.sandbox == "setuid":
             out.append("  sandbox_setuid();")
         loop_body = "execute_one();"
@@ -348,6 +384,50 @@ static void sandbox_setuid(void)
   if (setuid(65534)) {}
 }
 
+#ifdef __linux__
+#include <sched.h>
+#include <sys/mount.h>
+static void write_str_file(const char* path, const char* data)
+{
+  int fd = open(path, O_WRONLY);
+  if (fd < 0) return;
+  if (write(fd, data, strlen(data))) {}
+  close(fd);
+}
+// fresh user/mount/net/ipc/uts namespaces, uid 0 inside
+// (executor/pseudo_linux.h sandbox_namespace twin)
+static void sandbox_namespace(void)
+{
+  int uid = getuid(), gid = getgid();
+  char buf[64];
+  if (unshare(CLONE_NEWUSER | CLONE_NEWNS | CLONE_NEWNET |
+              CLONE_NEWIPC | CLONE_NEWUTS) == 0) {
+    write_str_file("/proc/self/setgroups", "deny");
+    snprintf(buf, sizeof(buf), "0 %d 1", uid);
+    write_str_file("/proc/self/uid_map", buf);
+    snprintf(buf, sizeof(buf), "0 %d 1", gid);
+    write_str_file("/proc/self/gid_map", buf);
+  } else if (unshare(CLONE_NEWNS | CLONE_NEWNET | CLONE_NEWIPC |
+                     CLONE_NEWUTS)) {
+    return;
+  }
+  if (mount(NULL, "/", NULL, MS_REC | MS_PRIVATE, NULL)) {}
+}
+static void setup_cgroups(void)
+{
+  char dir[64], self[32];
+  snprintf(dir, sizeof(dir), "/sys/fs/cgroup/tz%d", procid);
+  if (mkdir(dir, 0777) && errno != EEXIST) return;
+  char procs[96];
+  snprintf(procs, sizeof(procs), "%s/cgroup.procs", dir);
+  snprintf(self, sizeof(self), "%d", getpid());
+  write_str_file(procs, self);
+}
+#else
+static void sandbox_namespace(void) {}
+static void setup_cgroups(void) {}
+#endif
+
 struct csum_inet {
   uint32_t acc;
 };
@@ -388,3 +468,228 @@ static intptr_t sim_call(intptr_t nr, ...)
 {
   return nr >= 0 ? 0 : -1;
 }"""
+
+# ---- syz_* pseudo-syscall C bodies (executor/pseudo_linux.h twins;
+# reference: csource embeds common_linux.h) --------------------------
+
+_C_TUN = r"""#include <arpa/inet.h>
+#include <linux/if.h>
+#include <linux/if_tun.h>
+#include <sys/ioctl.h>
+#include <sys/socket.h>
+static int tun_fd = -1;
+static void setup_tun(void)
+{
+  tun_fd = open("/dev/net/tun", O_RDWR | O_NONBLOCK);
+  if (tun_fd < 0) return;
+  struct ifreq ifr;
+  memset(&ifr, 0, sizeof(ifr));
+  snprintf(ifr.ifr_name, IFNAMSIZ, "tz_tun%d", procid);
+  ifr.ifr_flags = IFF_TAP | IFF_NO_PI;
+  if (ioctl(tun_fd, TUNSETIFF, &ifr)) { close(tun_fd); tun_fd = -1; return; }
+  int sock = socket(AF_INET, SOCK_DGRAM, 0);
+  if (sock >= 0) {
+    ioctl(sock, SIOCGIFFLAGS, &ifr);
+    ifr.ifr_flags |= IFF_UP | IFF_RUNNING;
+    ioctl(sock, SIOCSIFFLAGS, &ifr);
+    close(sock);
+  }
+}"""
+
+_PSEUDO_C = {
+    "syz_open_dev": r"""static long syz_open_dev(long name, long id, long flags)
+{
+  char buf[256], *hash;
+  snprintf(buf, sizeof(buf), "%s", (char*)name);
+  hash = strchr(buf, '#');
+  if (hash) {
+    char tail[128];
+    snprintf(tail, sizeof(tail), "%s", hash + 1);
+    snprintf(hash, sizeof(buf) - (hash - buf), "%d%s", (int)id, tail);
+  }
+  return open(buf, flags, 0666);
+}""",
+    "syz_open_procfs": r"""static long syz_open_procfs(long pid, long file)
+{
+  char buf[160];
+  if (pid == 0)
+    snprintf(buf, sizeof(buf), "/proc/self/%s", (char*)file);
+  else
+    snprintf(buf, sizeof(buf), "/proc/%d/%s", (int)pid, (char*)file);
+  int fd = open(buf, O_RDWR);
+  if (fd < 0) fd = open(buf, O_RDONLY);
+  return fd;
+}""",
+    "syz_open_pts": r"""#include <sys/ioctl.h>
+static long syz_open_pts(long master, long flags)
+{
+  int ptyno = 0;
+  if (ioctl((int)master, TIOCGPTN, &ptyno)) return -1;
+  char buf[32];
+  snprintf(buf, sizeof(buf), "/dev/pts/%d", ptyno);
+  return open(buf, flags);
+}""",
+    "syz_emit_ethernet": r"""static long syz_emit_ethernet(long len, long packet)
+{
+  if (tun_fd < 0) return -1;
+  return write(tun_fd, (void*)packet, len);
+}""",
+    "syz_extract_tcp_res": r"""static long syz_extract_tcp_res(long res, long seq_inc, long ack_inc)
+{
+  if (tun_fd < 0) return -1;
+  unsigned char pkt[2048];
+  int n = read(tun_fd, pkt, sizeof(pkt));
+  if (n < 14 + 20 + 20) return -1;
+  if (pkt[12] != 0x08 || pkt[13] != 0x00) return -1;
+  int ihl = (pkt[14] & 0xf) * 4;
+  if (pkt[14 + 9] != 6 || n < 14 + ihl + 20) return -1;
+  uint32_t seq, ack;
+  memcpy(&seq, pkt + 14 + ihl + 4, 4);
+  memcpy(&ack, pkt + 14 + ihl + 8, 4);
+  seq = htonl(ntohl(seq) + (uint32_t)seq_inc);
+  ack = htonl(ntohl(ack) + (uint32_t)ack_inc);
+  memcpy((void*)res, &seq, 4);
+  memcpy((void*)(res + 4), &ack, 4);
+  return 0;
+}""",
+    "syz_genetlink_get_family_id":
+        r"""#include <linux/netlink.h>
+static long syz_genetlink_get_family_id(long name)
+{
+  int sock = socket(AF_NETLINK, SOCK_RAW, 16);
+  if (sock < 0) return -1;
+  struct {
+    struct nlmsghdr hdr;
+    uint8_t cmd, version; uint16_t reserved;
+    uint16_t attr_len, attr_type;
+    char attr[64];
+  } __attribute__((packed)) req;
+  memset(&req, 0, sizeof(req));
+  size_t name_len = strlen((char*)name) + 1;
+  req.hdr.nlmsg_type = 0x10;
+  req.hdr.nlmsg_flags = NLM_F_REQUEST;
+  req.cmd = 3; req.version = 1;
+  req.attr_type = 2;
+  memcpy(req.attr, (char*)name, name_len);
+  req.attr_len = 4 + name_len;
+  req.hdr.nlmsg_len = 20 + ((req.attr_len + 3) & ~3u);
+  long ret = -1;
+  if (send(sock, &req, req.hdr.nlmsg_len, 0) >= 0) {
+    uint8_t buf[4096];
+    int got = recv(sock, buf, sizeof(buf), 0);
+    size_t off = 20;
+    while (got >= 24 && off + 4 <= (size_t)got) {
+      uint16_t alen, atype;
+      memcpy(&alen, buf + off, 2);
+      memcpy(&atype, buf + off + 2, 2);
+      if (alen < 4) break;
+      if (atype == 1 && alen >= 6) {
+        uint16_t id; memcpy(&id, buf + off + 4, 2); ret = id; break;
+      }
+      off += (alen + 3) & ~3u;
+    }
+  }
+  close(sock);
+  return ret;
+}""",
+    "syz_mount_image": r"""#include <linux/loop.h>
+#include <sys/ioctl.h>
+#include <sys/mount.h>
+struct tz_img_segment { uint64_t addr, size, offset; };
+static long syz_mount_image(long fs, long dir, long size, long nsegs,
+                            long segs, long flags, long opts)
+{
+  char tmpl[] = "/tmp/tz_img_XXXXXX";
+  int img = mkstemp(tmpl);
+  if (img < 0) return -1;
+  unlink(tmpl);
+  if (ftruncate(img, size)) { close(img); return -1; }
+  struct tz_img_segment* seg = (struct tz_img_segment*)segs;
+  for (long i = 0; i < nsegs && i < 64; i++)
+    if (pwrite(img, (void*)seg[i].addr, seg[i].size, seg[i].offset)) {}
+  int ctl = open("/dev/loop-control", O_RDWR);
+  if (ctl < 0) { close(img); return -1; }
+  int idx = ioctl(ctl, LOOP_CTL_GET_FREE);
+  close(ctl);
+  if (idx < 0) { close(img); return -1; }
+  char ldev[32];
+  snprintf(ldev, sizeof(ldev), "/dev/loop%d", idx);
+  int lfd = open(ldev, O_RDWR);
+  if (lfd < 0) { close(img); return -1; }
+  if (ioctl(lfd, LOOP_SET_FD, img)) { close(lfd); close(img); return -1; }
+  close(img);
+  // AUTOCLEAR: the kernel frees the loop device when its last user
+  // (the mount, or our fd) goes away — no leak under repeat mode
+  struct loop_info64 info;
+  memset(&info, 0, sizeof(info));
+  if (ioctl(lfd, LOOP_GET_STATUS64, &info) == 0) {
+    info.lo_flags |= LO_FLAGS_AUTOCLEAR;
+    ioctl(lfd, LOOP_SET_STATUS64, &info);
+  }
+  mkdir((char*)dir, 0777);
+  long res = mount(ldev, (char*)dir, (char*)fs, flags,
+                   opts ? (char*)opts : NULL);
+  close(lfd);
+  if (res < 0) return res;
+  return open((char*)dir, O_RDONLY | O_DIRECTORY);
+}""",
+    "syz_read_part_table": r"""#include <linux/fs.h>
+#include <linux/loop.h>
+#include <sys/ioctl.h>
+struct tz_rpt_segment { uint64_t addr, size, offset; };
+static long syz_read_part_table(long size, long nsegs, long segs)
+{
+  char tmpl[] = "/tmp/tz_img_XXXXXX";
+  int img = mkstemp(tmpl);
+  if (img < 0) return -1;
+  unlink(tmpl);
+  if (ftruncate(img, size)) { close(img); return -1; }
+  struct tz_rpt_segment* seg = (struct tz_rpt_segment*)segs;
+  for (long i = 0; i < nsegs && i < 64; i++)
+    if (pwrite(img, (void*)seg[i].addr, seg[i].size, seg[i].offset)) {}
+  int ctl = open("/dev/loop-control", O_RDWR);
+  if (ctl < 0) { close(img); return -1; }
+  int idx = ioctl(ctl, LOOP_CTL_GET_FREE);
+  close(ctl);
+  if (idx < 0) { close(img); return -1; }
+  char ldev[32];
+  snprintf(ldev, sizeof(ldev), "/dev/loop%d", idx);
+  int lfd = open(ldev, O_RDWR);
+  if (lfd < 0) { close(img); return -1; }
+  long res = -1;
+  if (ioctl(lfd, LOOP_SET_FD, img) == 0) {
+    res = ioctl(lfd, BLKRRPART, 0);
+    ioctl(lfd, LOOP_CLR_FD, 0);
+  }
+  close(lfd);
+  close(img);
+  return res;
+}""",
+    "syz_kvm_setup_cpu": r"""#include <linux/kvm.h>
+#include <sys/ioctl.h>
+struct tz_kvm_text { uint64_t typ, text, len; };
+static long syz_kvm_setup_cpu(long vmfd, long cpufd, long usermem,
+                              long text, long ntext, long flags)
+{
+  if (ntext == 0) return -1;
+  struct tz_kvm_text* seg = (struct tz_kvm_text*)text;
+  struct kvm_userspace_memory_region mem;
+  memset(&mem, 0, sizeof(mem));
+  mem.memory_size = 24 << 12;
+  mem.userspace_addr = (uint64_t)usermem;
+  if (ioctl(vmfd, KVM_SET_USER_MEMORY_REGION, &mem)) return -1;
+  memset((void*)usermem, 0xf4, 0x2000);
+  uint64_t len = seg->len > 0x1000 ? 0x1000 : seg->len;
+  memcpy((char*)usermem + 0x1000, (void*)seg->text, len);
+  struct kvm_sregs sregs;
+  if (ioctl(cpufd, KVM_GET_SREGS, &sregs)) return -1;
+  struct kvm_regs regs;
+  memset(&regs, 0, sizeof(regs));
+  regs.rflags = 2;
+  sregs.cs.base = 0x1000; sregs.cs.selector = 0x100;
+  regs.rip = 0; regs.rsp = 0xf000;
+  if (ioctl(cpufd, KVM_SET_SREGS, &sregs)) return -1;
+  if (ioctl(cpufd, KVM_SET_REGS, &regs)) return -1;
+  return 0;
+}""",
+}

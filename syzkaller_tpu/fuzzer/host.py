@@ -1,14 +1,18 @@
 """Host feature/syscall support detection (reference: pkg/host/).
 
-The reference probes the live kernel (test syscalls, /proc and /dev
-paths, KCOV/fault-injection sysfs knobs — pkg/host/host_linux.go:20-216).
-Here the "host" is the executor's backend: the simulated kernel
-supports every described call, while a real-OS backend restricts by
-syscall-number presence and probe hooks registered per target.
+The reference probes the live kernel: issue each syscall with
+all-invalid arguments and treat ENOSYS as "not implemented", check
+the filesystem paths that file-opening calls reference, and stat the
+debugfs knobs behind coverage/fault-injection
+(reference: pkg/host/host_linux.go:20-240).  The sim backend supports
+every described call; the linux backend uses the real probes below.
 """
 
 from __future__ import annotations
 
+import errno
+import functools
+import os
 from typing import Callable, Optional
 
 from syzkaller_tpu.models.target import Target
@@ -17,18 +21,23 @@ from syzkaller_tpu.models.target import Target
 _probes: dict[str, Callable] = {}
 
 
-def register_probe(os: str, fn: Callable) -> None:
-    _probes[os] = fn
+def register_probe(os_name: str, fn: Callable) -> None:
+    _probes[os_name] = fn
 
 
 def detect_supported_syscalls(target: Target, sandbox: str = "none",
-                              enabled: Optional[set[int]] = None
-                              ) -> tuple[list, dict]:
+                              enabled: Optional[set[int]] = None,
+                              backend: str = "sim") -> tuple[list, dict]:
     """Returns (supported syscalls, {syscall: reason} for unsupported)
-    (reference: pkg/host/host.go:12-40)."""
+    (reference: pkg/host/host.go:12-40).
+
+    Support is a property of the EXECUTION BACKEND, not of the machine
+    the fuzzer process runs on: the sim backend implements every
+    described call, so the kernel probes only run for backend="linux"
+    (where programs hit the host kernel for real)."""
     supported = []
     unsupported = {}
-    probe = _probes.get(target.os)
+    probe = _probes.get(target.os) if backend == "linux" else None
     for c in target.syscalls:
         if enabled is not None and c.id not in enabled:
             continue
@@ -44,12 +53,30 @@ def detect_supported_syscalls(target: Target, sandbox: str = "none",
     return supported, unsupported
 
 
-def check_fault_injection() -> bool:
+def check_fault_injection(backend: str = "sim") -> bool:
     """Whether the backend supports fail-nth fault injection.  The sim
-    kernel always does (executor/sim_kernel.h fault arm); a real-linux
-    backend would stat /sys/kernel/debug/failslab
+    kernel always does (executor/sim_kernel.h fault arm); real linux
+    needs CONFIG_FAULT_INJECTION's debugfs knobs
     (reference: pkg/host/host_linux.go:216-240)."""
-    return True
+    if backend != "linux":
+        return True
+    return os.path.exists("/sys/kernel/debug/failslab") or \
+        os.path.exists("/proc/self/make-it-fail")
+
+
+def check_coverage(backend: str = "sim") -> bool:
+    """KCOV availability (reference: host_linux.go checkCoverage).
+    The sim backend computes coverage in-process — always on."""
+    if backend != "linux":
+        return True
+    return os.path.exists("/sys/kernel/debug/kcov")
+
+
+def check_comparisons(backend: str = "sim") -> bool:
+    """KCOV_TRACE_CMP needs KCOV plus a recent-enough kernel; presence
+    of the kcov node is the host-side gate (the executor degrades at
+    ioctl time if CMP tracing is absent)."""
+    return check_coverage(backend)
 
 
 def enabled_calls(target: Target, supported: list,
@@ -60,3 +87,101 @@ def enabled_calls(target: Target, supported: list,
     enabled_map = {c: True for c in supported}
     enabled, disabled = target.transitively_enabled_calls(enabled_map)
     return enabled, disabled
+
+
+# ---- the linux probe -------------------------------------------------
+
+PSEUDO_NR_BASE = 0x81000000
+
+# Pseudo-syscalls gate on the kernel facility they wrap
+# (executor/pseudo_linux.h dispatch).
+_PSEUDO_REQUIRES = {
+    "syz_emit_ethernet": "/dev/net/tun",
+    "syz_extract_tcp_res": "/dev/net/tun",
+    "syz_kvm_setup_cpu": "/dev/kvm",
+    "syz_mount_image": "/dev/loop-control",
+    "syz_read_part_table": "/dev/loop-control",
+    "syz_open_pts": "/dev/ptmx",
+}
+
+# Never issue these as probes: they block, signal, fork, kill the
+# process, or flip process-wide state even with bogus arguments
+# (reference keeps the same kind of special-case list,
+# host_linux.go isSupportedSyscall).  All are baseline linux calls;
+# treat as present.
+_NO_PROBE = frozenset("""
+exit exit_group rt_sigreturn pause kill tkill tgkill fork vfork clone
+clone3 execve execveat reboot vhangup umask personality setsid setpgid
+setuid setgid setreuid setregid setresuid setresgid setfsuid setfsgid
+setgroups capset chroot pivot_root sync syncfs munlockall mlockall
+shutdown close_range rt_sigsuspend sigsuspend wait4 waitid waitpid
+ptrace seccomp unshare setns iopl ioperm
+""".split())
+
+
+@functools.lru_cache(maxsize=None)
+def _nr_implemented(nr: int) -> bool:
+    """ENOSYS probe: issue the syscall with all-invalid args; any
+    other outcome (EFAULT/EBADF/EINVAL/...) proves the entry point
+    exists (reference: host_linux.go:20-60)."""
+    import ctypes
+
+    libc = ctypes.CDLL(None, use_errno=True)
+    bad = ctypes.c_long(-1)
+    res = libc.syscall(ctypes.c_long(nr), bad, bad, bad, bad, bad, bad)
+    if res != -1:
+        return True
+    return ctypes.get_errno() != errno.ENOSYS
+
+
+def _const_path_arg(c) -> Optional[str]:
+    """The fixed filename a call opens, when statically known (string
+    type with exactly one value among its pointer args)."""
+    from syzkaller_tpu.models.types import BufferKind, BufferType, PtrType
+
+    for a in c.args:
+        if isinstance(a, PtrType) and isinstance(a.elem, BufferType) \
+                and a.elem.kind == BufferKind.STRING \
+                and len(a.elem.values) == 1:
+            v = a.elem.values[0].rstrip(b"\x00")
+            if v.startswith(b"/"):
+                return v.decode("utf-8", "replace")
+    return None
+
+
+def _linux_probe(c, sandbox: str) -> Optional[str]:
+    if c.nr >= PSEUDO_NR_BASE:
+        need = _PSEUDO_REQUIRES.get(c.call_name)
+        if need is not None and not os.path.exists(need):
+            return f"{need} is absent"
+        if c.call_name == "syz_open_dev":
+            # variants with a fixed device template: the device must
+            # exist (reference: isSupportedSyzOpenDev)
+            path = _const_path_arg(c)
+            if path is not None and not os.path.exists(
+                    path.replace("#", "0")):
+                return f"{path} does not exist"
+        return None
+    # file-opening variants with a fixed path: the path must exist
+    # (reference: isSupportedOpenAt)
+    if c.call_name in ("open", "openat", "creat"):
+        path = _const_path_arg(c)
+        if path is not None:
+            probe = path.replace("#", "0")
+            if not os.path.exists(probe):
+                return f"{probe} does not exist"
+        return None
+    if c.call_name in _NO_PROBE:
+        return None
+    if not _nr_implemented(c.nr):
+        return "syscall is not implemented (ENOSYS)"
+    return None
+
+
+def _maybe_register_linux() -> None:
+    # the probe issues real syscalls: only meaningful on a linux host
+    if os.path.exists("/proc/version"):
+        register_probe("linux", _linux_probe)
+
+
+_maybe_register_linux()

@@ -48,7 +48,9 @@ class FuzzerProcess:
         self.conn = RPCClient(manager_addr, name=name) \
             if manager_addr else None
 
-        supported, _unsup = detect_supported_syscalls(self.target)
+        backend = "sim" if sim else self.target.os
+        supported, _unsup = detect_supported_syscalls(self.target,
+                                                      backend=backend)
         enabled, disabled = enabled_calls(self.target, supported)
         self.enabled = sorted(c.id for c in enabled)
         for c, reason in disabled.items():
@@ -59,9 +61,13 @@ class FuzzerProcess:
             connect_res = self.conn.call("Manager.Connect",
                                          {"name": name}) or {}
             if connect_res.get("need_check"):
+                from syzkaller_tpu.fuzzer.host import (check_comparisons,
+                                                       check_coverage)
+
                 self.conn.call("Manager.Check", {
-                    "name": name, "kcov": True, "comps": True,
-                    "fault": check_fault_injection(),
+                    "name": name, "kcov": check_coverage(backend),
+                    "comps": check_comparisons(backend),
+                    "fault": check_fault_injection(backend),
                     "leak": False, "calls": self.enabled,
                 })
 

@@ -227,3 +227,50 @@ def test_sim_model_matches_executor(target, env):
         return len(res.info[0].signal)
 
     assert run(magic) > run((magic + 7) & 0xFFFFFFFF)
+
+
+# ---- host syscall-support detection (pkg/host analogue) --------------
+
+def test_host_detection_linux_probes():
+    """The linux probe excludes calls whose backing facility is absent
+    and calls the kernel doesn't implement, keeps the rest."""
+    import os
+
+    import pytest
+
+    if not os.path.exists("/proc/version"):
+        pytest.skip("not a linux host")
+    from syzkaller_tpu.fuzzer.host import (
+        check_comparisons, check_coverage, check_fault_injection,
+        detect_supported_syscalls, enabled_calls)
+    from syzkaller_tpu.models.target import get_target
+
+    t = get_target("linux", "amd64")
+    sup, unsup = detect_supported_syscalls(t, backend="linux")
+    assert len(sup) > 300
+    names = {c.name for c in sup}
+    assert "getpid" in names and "openat" in names
+    # a no-probe call is never spuriously dropped
+    assert "exit_group" in names
+    if not os.path.exists("/dev/kvm"):
+        assert "openat$kvm" not in names
+        assert "syz_kvm_setup_cpu" not in names
+        # the kvm ioctl chain dies transitively with its ctor
+        enabled, disabled = enabled_calls(t, sup)
+        dis_names = {c.name for c in disabled}
+        assert "ioctl$KVM_CREATE_VM" in dis_names
+    assert isinstance(check_fault_injection("linux"), bool)
+    assert isinstance(check_coverage("linux"), bool)
+    assert isinstance(check_comparisons("linux"), bool)
+    # sim backend: everything is supported by construction
+    assert check_fault_injection() and check_coverage()
+    sup_sim, unsup_sim = detect_supported_syscalls(t)
+    assert not unsup_sim
+
+
+def test_host_detection_sim_supports_all(test_target):
+    from syzkaller_tpu.fuzzer.host import detect_supported_syscalls
+
+    sup, unsup = detect_supported_syscalls(test_target)
+    assert not unsup
+    assert len(sup) == len(test_target.syscalls)

@@ -125,7 +125,8 @@ def test_new_subsystem_surfaces(linux):
               "ioctl$TCGETS", "ioctl$TIOCGPTN",
               "syz_open_dev$loop", "ioctl$LOOP_SET_FD",
               "ioctl$BLKRRPART", "ioctl$RNDADDENTROPY",
-              "socket$alg", "bind$alg", "accept4$alg",
+              "socket$alg", "bind$alg_hash", "bind$alg_aead",
+              "accept4$alg",
               "unshare", "setns", "syz_open_procfs$ns"):
         assert n in names, n
     nrs = {c.name: c.nr for c in linux.syscalls}
