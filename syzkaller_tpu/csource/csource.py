@@ -287,14 +287,18 @@ class _Renderer:
         out.append(f"  install_segv_handler();")
         if o.sandbox == "namespace":
             out.append("  sandbox_namespace();")
+        # per-PROC env setup (tap fd, cgroup dir) runs after the fork
+        # so each proc gets its own procid-keyed instances; the
+        # privilege drop comes last, in the proc itself
+        proc_setup = []
         if self.target.os == "linux" and (
                 o.tun or self._used_pseudo() & {"syz_emit_ethernet",
                                                 "syz_extract_tcp_res"}):
-            out.append("  setup_tun();")
+            proc_setup.append("setup_tun();")
         if o.cgroups:
-            out.append("  setup_cgroups();")
+            proc_setup.append("setup_cgroups();")
         if o.sandbox == "setuid":
-            out.append("  sandbox_setuid();")
+            proc_setup.append("sandbox_setuid();")
         loop_body = "execute_one();"
         if o.repeat:
             loop_body = "for (;;) { execute_one(); }"
@@ -302,12 +306,16 @@ class _Renderer:
             out.append(f"  for (procid = 0; procid < {o.procs}; "
                        "procid++) {")
             out.append("    if (fork() == 0) {")
+            for s in proc_setup:
+                out.append(f"      {s}")
             out.append(f"      {loop_body}")
             out.append("      exit(0);")
             out.append("    }")
             out.append("  }")
             out.append("  sleep(1000000);")
         else:
+            for s in proc_setup:
+                out.append(f"  {s}")
             out.append(f"  {loop_body}")
         out.append("  return 0;\n}")
         return "\n".join(out)
@@ -566,6 +574,7 @@ static long syz_genetlink_get_family_id(long name)
   } __attribute__((packed)) req;
   memset(&req, 0, sizeof(req));
   size_t name_len = strlen((char*)name) + 1;
+  if (name_len > sizeof(req.attr)) name_len = sizeof(req.attr);
   req.hdr.nlmsg_type = 0x10;
   req.hdr.nlmsg_flags = NLM_F_REQUEST;
   req.cmd = 3; req.version = 1;

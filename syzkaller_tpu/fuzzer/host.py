@@ -91,7 +91,7 @@ def enabled_calls(target: Target, supported: list,
 
 # ---- the linux probe -------------------------------------------------
 
-PSEUDO_NR_BASE = 0x81000000
+from syzkaller_tpu.ipc.env import PSEUDO_NR_BASE  # noqa: E402  (single source)
 
 # Pseudo-syscalls gate on the kernel facility they wrap
 # (executor/pseudo_linux.h dispatch).
@@ -115,8 +115,17 @@ clone3 execve execveat reboot vhangup umask personality setsid setpgid
 setuid setgid setreuid setregid setresuid setresgid setfsuid setfsgid
 setgroups capset chroot pivot_root sync syncfs munlockall mlockall
 shutdown close_range rt_sigsuspend sigsuspend wait4 waitid waitpid
-ptrace seccomp unshare setns iopl ioperm
+ptrace seccomp unshare setns iopl ioperm futex
 """.split())
+# futex: the kernel answers ENOSYS for an invalid futex OP, so the
+# all-invalid-args probe would falsely mark it unimplemented.
+
+
+@functools.lru_cache(maxsize=1)
+def _libc():
+    import ctypes
+
+    return ctypes.CDLL(None, use_errno=True)
 
 
 @functools.lru_cache(maxsize=None)
@@ -126,7 +135,7 @@ def _nr_implemented(nr: int) -> bool:
     exists (reference: host_linux.go:20-60)."""
     import ctypes
 
-    libc = ctypes.CDLL(None, use_errno=True)
+    libc = _libc()
     bad = ctypes.c_long(-1)
     res = libc.syscall(ctypes.c_long(nr), bad, bad, bad, bad, bad, bad)
     if res != -1:

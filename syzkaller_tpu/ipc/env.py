@@ -44,6 +44,12 @@ STATUS_FAIL = 67
 STATUS_ERROR = 68
 STATUS_RETRY = 69
 
+# syz_* pseudo-syscalls occupy a reserved NR range dispatched inside
+# the executor (executor/wire.h kPseudoNrBase; values pinned in
+# sys/descriptions/linux/pseudo_amd64.const — a test cross-checks all
+# three stay in sync).
+PSEUDO_NR_BASE = 0x81000000
+
 
 class EnvFlags(enum.IntFlag):
     DEBUG = 1 << 0

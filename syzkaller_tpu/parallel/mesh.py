@@ -106,3 +106,49 @@ def make_sharded_fuzz_step(mesh: Mesh, rounds: int = 4, plane_size: int = dsig.P
             check_vma=False,
         ))
     return step
+
+
+def make_sharded_pack_step(mesh: Mesh, spec=None, rounds: int = 4):
+    """The production pipeline step sharded over 'batch': each device
+    mutates its corpus-row shard, packs deltas, and pools payloads
+    LOCALLY (ops/delta.py pack/pool), emitting one flat wire buffer
+    per shard — the multi-chip form of DevicePipeline._step, where
+    each chip feeds its own host-side assembler and executor fleet.
+
+    step(batch, key, flag_vals, flag_counts, template_idx) -> uint8
+    flat buffer whose shards each hold rows ++ pool for their local
+    sub-batch (split with unshard_delta)."""
+    from syzkaller_tpu.ops.delta import DeltaSpec, make_packer, make_pooler
+
+    spec = spec or DeltaSpec()
+    pack = make_packer(spec)
+
+    def local(batch, key, flag_vals, flag_counts, tidx):
+        b = batch["kind"].shape[0]
+        key = random.fold_in(key, lax.axis_index("batch"))
+        keys = random.split(key, b)
+
+        def one(st, k, i):
+            m = _mutate_one(st, k, flag_vals, flag_counts, rounds)
+            return pack(m, i)
+
+        rows, payloads, needs = jax.vmap(one)(batch, keys, tidx)
+        return make_pooler(spec, b)(rows, payloads, needs)
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("batch"), P(), P(), P(), P("batch")),
+        out_specs=P("batch"), check_vma=False))
+
+
+def unshard_delta(flat: np.ndarray, mesh: Mesh, spec=None) -> list:
+    """Split a sharded pack-step result into per-shard DeltaBatch
+    views (each shard's rows ++ pool block is self-contained)."""
+    from syzkaller_tpu.ops.delta import DeltaBatch, DeltaSpec
+
+    spec = spec or DeltaSpec()
+    n = mesh.shape["batch"]
+    flat = np.asarray(flat)
+    per = flat.size // n
+    return [DeltaBatch(flat[i * per:(i + 1) * per], spec)
+            for i in range(n)]

@@ -162,3 +162,24 @@ def test_new_surfaces_generate_and_serialize(linux, iters):
             if c.meta.name.startswith(fams):
                 hit.add(c.meta.name.split("$")[0])
     assert hit, "new families never generated"
+
+
+def test_pseudo_nr_base_contract(linux):
+    """The executor<->descriptions pseudo-NR range is pinned in three
+    places (wire.h, pseudo_amd64.const, ipc/env.py) — they must
+    agree."""
+    import re
+    from pathlib import Path
+
+    from syzkaller_tpu.ipc.env import PSEUDO_NR_BASE
+
+    wire = (Path(__file__).resolve().parents[1]
+            / "executor" / "wire.h").read_text()
+    m = re.search(r"kPseudoNrBase = (0x[0-9a-fA-F]+)", wire)
+    assert m and int(m.group(1), 16) == PSEUDO_NR_BASE
+    pseudo_nrs = [c.nr for c in linux.syscalls
+                  if c.call_name.startswith("syz_")]
+    assert pseudo_nrs and all(nr >= PSEUDO_NR_BASE for nr in pseudo_nrs)
+    real_nrs = [c.nr for c in linux.syscalls
+                if not c.call_name.startswith("syz_")]
+    assert all(nr < PSEUDO_NR_BASE for nr in real_nrs)

@@ -75,3 +75,56 @@ def test_pipeline_mutants_decode_valid(test_target):
             validate_prog(m.prog())
     finally:
         pl.stop()
+
+
+def test_sharded_pack_step_parses_per_shard(built):
+    """The sharded production step (mutate -> pack -> pool) emits a
+    self-contained wire block per shard whose mutants assemble to
+    parseable exec streams."""
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.rand import RandGen
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops.delta import FLAG_OVERFLOW
+    from syzkaller_tpu.ops.emit import (assemble_delta,
+                                        build_exec_template, parse_stream)
+    from syzkaller_tpu.ops.pipeline import PIPELINE_TENSOR_CONFIG
+    from syzkaller_tpu.ops.tensor import FlagTables, encode_prog, stack_batch
+    from syzkaller_tpu.parallel.mesh import (make_sharded_pack_step,
+                                             shard_batch, unshard_delta)
+
+    target = get_target("test", "64")
+    flags = FlagTables.empty()
+    tensors = []
+    i = 0
+    while len(tensors) < 16 and i < 128:
+        p = generate_prog(target, RandGen(target, 600 + i), 6)
+        i += 1
+        try:
+            tensors.append(encode_prog(p, PIPELINE_TENSOR_CONFIG, flags))
+        except Exception:
+            continue
+    assert len(tensors) == 16
+    ets = [build_exec_template(t) for t in tensors]
+    mesh = make_mesh(jax.devices()[:8], cov=1)
+    batch = shard_batch(
+        mesh, {k: jnp.asarray(v)
+               for k, v in stack_batch(tensors).items()})
+    fv, fc = jnp.asarray(flags.vals), jnp.asarray(flags.counts)
+    tidx = jnp.arange(16, dtype=jnp.int32)
+    step = make_sharded_pack_step(mesh, rounds=2)
+    flat = step(batch, random.key(3), fv, fc, tidx)
+    shards = unshard_delta(flat, mesh)
+    assert len(shards) == 8
+    parsed = 0
+    for si, db in enumerate(shards):
+        assert len(db) == 2
+        for j in range(len(db)):
+            if db.flags[j] & FLAG_OVERFLOW:
+                continue
+            ti = int(db.template_idx[j])
+            assert si * 2 <= ti < (si + 1) * 2
+            data = assemble_delta(ets[ti], db, j)
+            if data is not None:
+                parse_stream(data)
+                parsed += 1
+    assert parsed >= 8, f"only {parsed} mutants assembled"
