@@ -127,7 +127,11 @@ def test_new_subsystem_surfaces(linux):
               "ioctl$BLKRRPART", "ioctl$RNDADDENTROPY",
               "socket$alg", "bind$alg_hash", "bind$alg_aead",
               "accept4$alg",
-              "unshare", "setns", "syz_open_procfs$ns"):
+              "unshare", "setns", "syz_open_procfs$ns",
+              "openat$fuse", "write$fuse_init",
+              "ioctl$UI_DEV_CREATE", "write$uinput_event",
+              "ioctl$VT_ACTIVATE", "ioctl$KDSETMODE",
+              "ioctl$KCOV_ENABLE", "prctl$PR_MCE_KILL"):
         assert n in names, n
     nrs = {c.name: c.nr for c in linux.syscalls}
     assert nrs["bpf$BPF_MAP_CREATE"] == 321       # __NR_bpf on amd64
