@@ -63,6 +63,7 @@ class Crash:
 class Bug:
     id: str = ""
     title: str = ""
+    namespace: str = "default"
     status: str = STATUS_NEW
     first_time: float = 0.0
     last_time: float = 0.0
@@ -82,6 +83,7 @@ class Job:
     """Patch-test job (reference: dashboard/app/jobs.go)."""
     id: str = ""
     bug_id: str = ""
+    namespace: str = "default"
     manager: str = ""
     patch: str = ""
     kernel_repo: str = ""
@@ -93,6 +95,15 @@ class Job:
 
 
 class Dashboard:
+    """Multi-namespace bug tracker: each client is bound to a
+    namespace (kernel flavor: upstream, stable, android, ...); bugs
+    dedup and report within their namespace only, the same partition
+    the reference's syzbot runs (reference: dashboard/app config
+    namespaces + access levels).
+
+    `clients` maps client -> key (single-namespace legacy form) or
+    client -> {"key": ..., "namespace": ...}."""
+
     def __init__(self, workdir: str, clients: Optional[dict] = None,
                  reporting_delay_s: float = 0.0):
         os.makedirs(workdir, exist_ok=True)
@@ -115,16 +126,27 @@ class Dashboard:
             raw = json.load(open(self._state_path()))
         except (OSError, json.JSONDecodeError):
             return
+        remap = {}
         for b in raw.get("bugs", []):
             crashes = [Crash(**c) for c in b.pop("crashes", [])]
             bug = Bug(**b)
             bug.crashes = crashes
+            # migrate pre-namespace ids (hash(title)) to the
+            # namespaced scheme so dedup/reporting state survives the
+            # upgrade instead of orphaning every existing bug
+            legacy = hash_string(bug.title.encode())[:16]
+            if bug.id == legacy:
+                new_id = hash_string(
+                    f"{bug.namespace}\x00{bug.title}".encode())[:16]
+                remap[legacy] = new_id
+                bug.id = new_id
             self.bugs[bug.id] = bug
         for b in raw.get("builds", []):
             build = Build(**b)
             self.builds[build.id] = build
         for j in raw.get("jobs", []):
             job = Job(**j)
+            job.bug_id = remap.get(job.bug_id, job.bug_id)
             self.jobs[job.id] = job
 
     def _save(self) -> None:
@@ -140,13 +162,26 @@ class Dashboard:
     # -- API (reference: dashboard/app/api.go) ---------------------------
 
     def _auth(self, params: dict) -> str:
+        """Authenticate and return the client's NAMESPACE."""
         client = params.get("client", "")
-        if self.clients and self.clients.get(client) != params.get("key"):
+        if not self.clients:
+            return "default"
+        ent = self.clients.get(client)
+        if ent is None:
             raise PermissionError(f"unauthorized client {client!r}")
-        return client
+        if isinstance(ent, dict):
+            # fail CLOSED on a missing/empty configured key: None ==
+            # None must never authenticate
+            key = ent.get("key")
+            if not key or key != params.get("key"):
+                raise PermissionError(f"unauthorized client {client!r}")
+            return ent.get("namespace", "default")
+        if ent != params.get("key"):
+            raise PermissionError(f"unauthorized client {client!r}")
+        return "default"
 
     def upload_build(self, params: dict) -> dict:
-        self._auth(params)
+        ns = self._auth(params)
         b = Build(id=params.get("id") or hash_string(
             json.dumps(params, sort_keys=True).encode())[:16],
             manager=params.get("manager", ""),
@@ -165,19 +200,20 @@ class Dashboard:
             if b.kernel_commit:
                 commits.add(b.kernel_commit)
             for bug in self.bugs.values():
-                if bug.status == STATUS_FIXED and bug.fix_commit \
-                        and bug.fix_commit in commits:
+                if bug.namespace == ns and bug.status == STATUS_FIXED \
+                        and bug.fix_commit and bug.fix_commit in commits:
                     bug.status = STATUS_CLOSED
                     closed.append(bug.id)
             self._save()
         return {"id": b.id, "closed_bugs": closed}
 
     def report_crash(self, params: dict) -> dict:
-        """Dedup by title into a Bug; returns whether a repro is
-        wanted (reference: api.go apiReportCrash + needRepro logic)."""
-        self._auth(params)
+        """Dedup by (namespace, title) into a Bug; returns whether a
+        repro is wanted (reference: api.go apiReportCrash +
+        needRepro logic)."""
+        ns = self._auth(params)
         title = params.get("title", "unknown")
-        bug_id = hash_string(title.encode())[:16]
+        bug_id = hash_string(f"{ns}\x00{title}".encode())[:16]
         now = time.time()
         crash = Crash(manager=params.get("manager", ""),
                       build_id=params.get("build_id", ""),
@@ -186,7 +222,8 @@ class Dashboard:
         with self._lock:
             bug = self.bugs.get(bug_id)
             if bug is None:
-                bug = Bug(id=bug_id, title=title, first_time=now,
+                bug = Bug(id=bug_id, title=title, namespace=ns,
+                          first_time=now,
                           reporting_due=now + self.reporting_delay_s)
                 self.bugs[bug_id] = bug
             bug.last_time = now
@@ -222,9 +259,9 @@ class Dashboard:
                 and bug.status not in (STATUS_INVALID, STATUS_DUP)}
 
     def need_repro(self, params: dict) -> dict:
-        self._auth(params)
+        ns = self._auth(params)
         title = params.get("title", "")
-        bug_id = hash_string(title.encode())[:16]
+        bug_id = hash_string(f"{ns}\x00{title}".encode())[:16]
         with self._lock:
             bug = self.bugs.get(bug_id)
             if bug is None:
@@ -245,17 +282,21 @@ class Dashboard:
 
     # -- reporting state machine (reference: reporting.go) ---------------
 
-    def poll_reports(self) -> list[dict]:
+    def poll_reports(self, namespace: Optional[str] = None) -> list[dict]:
         """Bugs due for (email-style) reporting; transitions them to
-        reported."""
+        reported.  Optionally restricted to one namespace (each
+        reporting loop serves its own)."""
         now = time.time()
         out = []
         with self._lock:
             for bug in self.bugs.values():
+                if namespace is not None and bug.namespace != namespace:
+                    continue
                 if bug.status == STATUS_NEW and bug.reporting_due <= now:
                     bug.status = STATUS_REPORTED
                     bug.reported_time = now
                     out.append({"id": bug.id, "title": bug.title,
+                                "namespace": bug.namespace,
                                 "num_crashes": bug.num_crashes})
             if out:
                 self._save()
@@ -315,7 +356,10 @@ class Dashboard:
                 kernel_branch: str = "", manager: str = "") -> str:
         jid = hash_string(f"{bug_id}{patch}{time.time()}".encode())[:16]
         with self._lock:
-            self.jobs[jid] = Job(id=jid, bug_id=bug_id, patch=patch,
+            ns = self.bugs[bug_id].namespace \
+                if bug_id in self.bugs else "default"
+            self.jobs[jid] = Job(id=jid, bug_id=bug_id, namespace=ns,
+                                 patch=patch,
                                  kernel_repo=kernel_repo,
                                  kernel_branch=kernel_branch,
                                  manager=manager)
@@ -323,11 +367,13 @@ class Dashboard:
         return jid
 
     def job_poll(self, params: dict) -> dict:
-        self._auth(params)
+        # a client only receives jobs from its own namespace (the
+        # partition covers the whole lifecycle, not just bugs)
+        ns = self._auth(params)
         managers = params.get("managers") or []
         with self._lock:
             for job in self.jobs.values():
-                if job.status == "pending" and \
+                if job.status == "pending" and job.namespace == ns and \
                         (not job.manager or job.manager in managers):
                     job.status = "claimed"
                     job.claimed_by = params.get("client", "")
@@ -414,21 +460,30 @@ def serve_dashboard(workdir: str, addr: tuple[str, int] = ("127.0.0.1", 0),
             # from the fleet aren't blocked by UI traffic
             if url.path == "/":
                 status_filter = q.get("status", [""])[0]
+                ns_filter = q.get("ns", [""])[0]
                 with dash._lock:
-                    snap = [(b.id, b.title, b.status, b.num_crashes,
+                    snap = [(b.id, b.title, b.namespace, b.status,
+                             b.num_crashes,
                              any(c.repro_prog for c in b.crashes))
                             for b in dash.bugs.values()
-                            if not status_filter
-                            or b.status == status_filter]
-                snap.sort(key=lambda r: -r[3])
+                            if (not status_filter
+                                or b.status == status_filter)
+                            and (not ns_filter
+                                 or b.namespace == ns_filter)]
+                snap.sort(key=lambda r: -r[4])
+                from urllib.parse import quote
+
                 rows = "".join(
                     f"<tr><td><a href='/bug?id={bid}'>"
                     f"{html_mod.escape(title)}</a></td>"
+                    f"<td><a href='/?ns={quote(ns, safe='')}'>"
+                    f"{html_mod.escape(ns)}</a></td>"
                     f"<td>{status}</td><td>{n}</td>"
                     f"<td>{'yes' if has_repro else ''}</td></tr>"
-                    for bid, title, status, n, has_repro in snap)
+                    for bid, title, ns, status, n, has_repro in snap)
                 self._html("bugs", "<table border=1>"
-                           "<tr><th>title</th><th>status</th>"
+                           "<tr><th>title</th><th>namespace</th>"
+                           "<th>status</th>"
                            f"<th>crashes</th><th>repro</th></tr>{rows}"
                            "</table>")
             elif url.path == "/bug":

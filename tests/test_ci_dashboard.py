@@ -328,3 +328,87 @@ def test_dashboard_web_ui(tmp_path):
         assert "WARNING: odd thing" not in filtered
     finally:
         srv.shutdown()
+
+
+def test_dashboard_namespaces_partition(tmp_path):
+    """Clients bound to different namespaces see separate bug spaces:
+    the same crash title dedups within a namespace, never across; fix
+    detection and reporting respect the partition (reference:
+    dashboard/app namespaces)."""
+    from syzkaller_tpu.dashboard.app import Dashboard
+
+    dash = Dashboard(str(tmp_path / "dash"), clients={
+        "ci-up": {"key": "k1", "namespace": "upstream"},
+        "ci-and": {"key": "k2", "namespace": "android"},
+        "legacy": "k3",  # single-namespace legacy form -> default
+    })
+    up = {"client": "ci-up", "key": "k1"}
+    an = {"client": "ci-and", "key": "k2"}
+    dash.report_crash({**up, "title": "BUG: same title"})
+    dash.report_crash({**an, "title": "BUG: same title"})
+    dash.report_crash({**up, "title": "BUG: same title"})
+    bugs = list(dash.bugs.values())
+    assert len(bugs) == 2
+    by_ns = {b.namespace: b for b in bugs}
+    assert by_ns["upstream"].num_crashes == 2
+    assert by_ns["android"].num_crashes == 1
+    # wrong key rejected
+    import pytest as _pytest
+    with _pytest.raises(PermissionError):
+        dash.report_crash({"client": "ci-up", "key": "bad", "title": "x"})
+    # legacy client lands in default
+    dash.report_crash({"client": "legacy", "key": "k3", "title": "t2"})
+    assert any(b.namespace == "default" for b in dash.bugs.values())
+    # per-namespace reporting
+    reps = dash.poll_reports(namespace="android")
+    assert len(reps) == 1 and reps[0]["namespace"] == "android"
+    # fix detection confined to the uploader's namespace
+    up_bug = by_ns["upstream"]
+    an_bug = by_ns["android"]
+    dash.update_bug(up_bug.id, fix_commit="net: fix it")
+    dash.update_bug(an_bug.id, fix_commit="net: fix it")
+    res = dash.upload_build({**an, "commits": ["net: fix it"]})
+    assert res["closed_bugs"] == [an_bug.id]
+    assert dash.bugs[up_bug.id].status == "fixed"
+
+
+def test_dashboard_namespace_migration_and_jobs(tmp_path):
+    """Pre-namespace state.json bugs survive the id-scheme change
+    (dedup continues under the new id); jobs only flow to clients of
+    the bug's namespace."""
+    import json as json_mod
+
+    from syzkaller_tpu.dashboard.app import Dashboard
+    from syzkaller_tpu.utils.hashsig import hash_string
+
+    work = tmp_path / "dash"
+    work.mkdir()
+    legacy_id = hash_string(b"BUG: old")[:16]
+    (work / "state.json").write_text(json_mod.dumps({
+        "bugs": [{"id": legacy_id, "title": "BUG: old",
+                  "status": "reported", "num_crashes": 3}],
+        "builds": [],
+        "jobs": [{"id": "j1", "bug_id": legacy_id, "patch": "p"}],
+    }))
+    dash = Dashboard(str(work), clients={
+        "up": {"key": "k1", "namespace": "upstream"},
+        "an": {"key": "k2", "namespace": "android"},
+    })
+    new_id = hash_string(b"default\x00BUG: old")[:16]
+    assert new_id in dash.bugs and legacy_id not in dash.bugs
+    assert dash.jobs["j1"].bug_id == new_id
+    # job routing respects namespaces
+    dash.report_crash({"client": "up", "key": "k1", "title": "B2"})
+    up_bug = next(b for b in dash.bugs.values()
+                  if b.namespace == "upstream")
+    dash.add_job(up_bug.id, patch="diff")
+    got = dash.job_poll({"client": "an", "key": "k2"})
+    assert got == {}, "android client claimed an upstream job"
+    got = dash.job_poll({"client": "up", "key": "k1"})
+    assert got.get("bug_id") == up_bug.id
+    # fail-closed: dict client entry without a key never authenticates
+    dash2 = Dashboard(str(tmp_path / "d2"),
+                      clients={"c": {"namespace": "x"}})
+    import pytest as _pytest
+    with _pytest.raises(PermissionError):
+        dash2.report_crash({"client": "c", "title": "t"})
