@@ -31,6 +31,10 @@ _TOOLS = {
             "console/serial reader with crash highlighting"),
     "imagegen": ("syzkaller_tpu.tools.imagegen",
                  "generate a VM disk-image build script"),
+    "parse": ("syzkaller_tpu.tools.parse_tool",
+              "extract programs from a fuzzer console log"),
+    "headerparser": ("syzkaller_tpu.tools.headerparser",
+                     "draft syzlang structs from C headers"),
 }
 
 

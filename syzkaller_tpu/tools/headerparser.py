@@ -1,0 +1,130 @@
+"""tz-headerparser: draft syzlang structs from C header definitions
+(reference: tools/syz-headerparser — parses struct definitions out of
+kernel headers and emits description skeletons for a human to
+refine).
+
+Parses `struct name { ... };` blocks with scalar/array/pointer/nested
+fields and prints the equivalent syzlang struct declarations plus a
+TODO note per field whose type needs human judgment.  This is a
+description-authoring aid, not a compiler: the output is a starting
+point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+_INT_TYPES = {
+    "char": "int8", "unsigned char": "int8", "signed char": "int8",
+    "__u8": "int8", "__s8": "int8", "u8": "int8", "s8": "int8",
+    "uint8_t": "int8", "int8_t": "int8",
+    "short": "int16", "unsigned short": "int16",
+    "__u16": "int16", "__s16": "int16", "u16": "int16", "s16": "int16",
+    "uint16_t": "int16", "int16_t": "int16", "__be16": "int16be",
+    "__le16": "int16",
+    "int": "int32", "unsigned int": "int32", "unsigned": "int32",
+    "__u32": "int32", "__s32": "int32", "u32": "int32", "s32": "int32",
+    "uint32_t": "int32", "int32_t": "int32", "__be32": "int32be",
+    "__le32": "int32",
+    "long": "intptr", "unsigned long": "intptr", "size_t": "intptr",
+    "long long": "int64", "unsigned long long": "int64",
+    "__u64": "int64", "__s64": "int64", "u64": "int64", "s64": "int64",
+    "uint64_t": "int64", "int64_t": "int64", "__be64": "int64be",
+    "__le64": "int64",
+}
+
+_STRUCT_RE = re.compile(
+    r"struct\s+(\w+)\s*\{(.*?)\}\s*(?:__attribute__\s*\(\([^)]*\)\))?\s*;",
+    re.DOTALL)
+_FIELD_RE = re.compile(
+    r"^\s*(?P<type>[A-Za-z_][\w \t]*?)\s*"
+    r"(?P<ptr>\*+)?\s*"
+    r"(?P<name>\w+)\s*"
+    r"(?:\[(?P<arr>[^\]]*)\])?\s*"
+    r"(?::\s*(?P<bits>\d+))?\s*;")
+
+
+def _strip_comments(src: str) -> str:
+    src = re.sub(r"/\*.*?\*/", "", src, flags=re.DOTALL)
+    return re.sub(r"//[^\n]*", "", src)
+
+
+def _lower_type(ctype: str, ptr: bool, arr: str, bits: str
+                ) -> tuple[str, str]:
+    """Returns (syzlang type, note)."""
+    ctype = re.sub(r"\b(const|volatile|struct)\b", "", ctype).strip()
+    ctype = re.sub(r"\s+", " ", ctype)
+    if ptr:
+        return "ptr64[inout, array[int8]]", "TODO: pointee type"
+    base = _INT_TYPES.get(ctype)
+    if base is None:
+        # unknown name: nested struct or typedef — reference by name
+        base, note = ctype, "TODO: define or map this type"
+    else:
+        note = ""
+    if bits:
+        return f"{base}:{bits}", note
+    if arr is not None:
+        arr = arr.strip()
+        if arr and arr.isdigit():
+            return f"array[{base}, {arr}]", note
+        return f"array[{base}]", note or "TODO: array bound"
+    return base, note
+
+
+def parse_header(src: str) -> list[tuple[str, list[tuple[str, str, str]]]]:
+    """[(struct_name, [(field, syz_type, note)])] for each struct."""
+    out = []
+    src = _strip_comments(src)
+    for m in _STRUCT_RE.finditer(src):
+        name, body = m.group(1), m.group(2)
+        if "{" in body:  # nested anonymous blocks need a human
+            continue
+        fields = []
+        for line in body.split(";"):
+            fm = _FIELD_RE.match(line + ";")
+            if not fm:
+                continue
+            typ, note = _lower_type(fm.group("type"),
+                                    bool(fm.group("ptr")),
+                                    fm.group("arr"), fm.group("bits"))
+            fields.append((fm.group("name"), typ, note))
+        if fields:
+            out.append((name, fields))
+    return out
+
+
+def render(structs) -> str:
+    out = []
+    for name, fields in structs:
+        out.append(f"{name} {{")
+        width = max(len(f) for f, _, _ in fields)
+        for fname, typ, note in fields:
+            line = f"\t{fname.ljust(width)}\t{typ}"
+            if note:
+                line += f"\t# {note}"
+            out.append(line)
+        out.append("}")
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-headerparser")
+    ap.add_argument("headers", nargs="+")
+    args = ap.parse_args(argv)
+    any_out = False
+    for path in args.headers:
+        structs = parse_header(Path(path).read_text(errors="replace"))
+        if structs:
+            any_out = True
+            print(f"# drafted from {path}")
+            print(render(structs))
+    return 0 if any_out else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

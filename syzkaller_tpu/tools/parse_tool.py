@@ -1,0 +1,52 @@
+"""tz-parse: extract the programs from a fuzzer console log
+(reference: tools/syz-parse — split a log into deserializable
+programs and write/print them).
+
+Uses the same log scanner as repro extraction (models/parse.py);
+programs that no longer deserialize are skipped with a note.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from syzkaller_tpu.models.encoding import serialize_prog
+from syzkaller_tpu.models.parse import parse_log
+from syzkaller_tpu.models.target import get_target
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tz-parse")
+    ap.add_argument("log", help="fuzzer console log")
+    ap.add_argument("-os", dest="target_os", default="test")
+    ap.add_argument("-arch", default="64")
+    ap.add_argument("-o", default=None,
+                    help="write progN files into this directory "
+                         "instead of stdout")
+    args = ap.parse_args(argv)
+    target = get_target(args.target_os, args.arch)
+    data = Path(args.log).read_bytes()
+    entries = parse_log(target, data)
+    if not entries:
+        print("no programs found", file=sys.stderr)
+        return 1
+    outdir = Path(args.o) if args.o else None
+    if outdir:
+        outdir.mkdir(parents=True, exist_ok=True)
+    for i, ent in enumerate(entries):
+        text = serialize_prog(ent.p)
+        if outdir:
+            (outdir / f"prog{i}").write_bytes(text)
+        else:
+            sys.stdout.write(f"# proc {ent.proc}\n")
+            sys.stdout.write(text.decode())
+            sys.stdout.write("\n")
+    if outdir:
+        print(f"wrote {len(entries)} programs to {outdir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -257,3 +257,66 @@ def test_extract_kernel_src_includes(tmp_path):
                           cflags=flags)
     assert vals["TZ_FAKE_CONST"] == 0xABC
     assert vals["TZ_MISSING"] is None
+
+from syzkaller_tpu.models.encoding import serialize_prog
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.rand import RandGen
+
+
+def test_parse_tool(tmp_path, capsys):
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.tools.parse_tool import main
+
+    test_target = get_target("test", "64")
+
+    progs = [generate_prog(test_target, RandGen(test_target, s), 3)
+             for s in (1, 2)]
+    log = b"boot noise\n"
+    for i, p in enumerate(progs):
+        log += f"{i:02}:00:00 executing program {i}:\n".encode()
+        log += serialize_prog(p)
+    log += b"tail noise\n"
+    f = tmp_path / "console.log"
+    f.write_bytes(log)
+    assert main([str(f)]) == 0
+    out = capsys.readouterr().out
+    assert "# proc 0" in out and "# proc 1" in out
+    outdir = tmp_path / "progs"
+    assert main([str(f), "-o", str(outdir)]) == 0
+    assert sorted(os.listdir(outdir)) == ["prog0", "prog1"]
+    empty = tmp_path / "empty.log"
+    empty.write_bytes(b"nothing here\n")
+    assert main([str(empty)]) == 1
+
+
+def test_headerparser_tool(tmp_path, capsys):
+    from syzkaller_tpu.tools.headerparser import main, parse_header
+
+    hdr = tmp_path / "foo.h"
+    hdr.write_text("""
+/* a comment */
+struct foo_req {
+        __u32 id;       // inline comment
+        __u16 flags;
+        __u8  data[16];
+        char *name;
+        __u64 big : 12;
+        struct bar nested;
+};
+""")
+    structs = parse_header(hdr.read_text())
+    assert len(structs) == 1
+    name, fields = structs[0]
+    assert name == "foo_req"
+    fmap = {f: t for f, t, _ in fields}
+    assert fmap["id"] == "int32"
+    assert fmap["flags"] == "int16"
+    assert fmap["data"] == "array[int8, 16]"
+    assert fmap["name"].startswith("ptr64")
+    assert fmap["big"] == "int64:12"
+    assert fmap["nested"] == "bar"
+    notes = {f: n for f, _, n in fields}
+    assert "TODO" in notes["name"] and "TODO" in notes["nested"]
+    assert main([str(hdr)]) == 0
+    out = capsys.readouterr().out
+    assert "foo_req {" in out
