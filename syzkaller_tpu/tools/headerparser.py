@@ -58,7 +58,11 @@ def _lower_type(ctype: str, ptr: bool, arr: str, bits: str
     ctype = re.sub(r"\b(const|volatile|struct)\b", "", ctype).strip()
     ctype = re.sub(r"\s+", " ", ctype)
     if ptr:
-        return "ptr64[inout, array[int8]]", "TODO: pointee type"
+        base = "ptr64[inout, array[int8]]"
+        if arr is not None and arr.strip().isdigit():
+            # pointer ARRAY: N pointers, not one
+            return f"array[{base}, {arr.strip()}]", "TODO: pointee type"
+        return base, "TODO: pointee type"
     base = _INT_TYPES.get(ctype)
     if base is None:
         # unknown name: nested struct or typedef — reference by name
@@ -87,6 +91,14 @@ def parse_header(src: str) -> list[tuple[str, list[tuple[str, str, str]]]]:
         for line in body.split(";"):
             fm = _FIELD_RE.match(line + ";")
             if not fm:
+                # anything non-empty we can't parse (multi-declarator
+                # `int a, b;`, function pointers, ...) must leave a
+                # visible marker — silently dropping fields shifts
+                # every later offset
+                if line.strip():
+                    fields.append(("unparsed", "int8",
+                                   f"TODO: could not parse "
+                                   f"{line.strip()!r}"))
                 continue
             typ, note = _lower_type(fm.group("type"),
                                     bool(fm.group("ptr")),
