@@ -104,6 +104,18 @@ _PSEUDO_REQUIRES = {
     "syz_open_pts": "/dev/ptmx",
 }
 
+# Devices whose mere OPEN arms machine-level state: /dev/watchdog
+# starts the watchdog timer, and a close without the magic 'V' write
+# leaves it running — the VM hard-reboots after the timeout and the
+# manager records a spurious lost-connection crash.  Described for
+# completeness (operators can enable explicitly), disabled by default
+# (the reference takes the same dangerous-device stance in its
+# sanitize layer).
+_DANGEROUS_PATHS = {
+    "/dev/watchdog": "arms the watchdog timer (would reboot the VM)",
+    "/dev/watchdog0": "arms the watchdog timer (would reboot the VM)",
+}
+
 # Never issue these as probes: they block, signal, fork, kill the
 # process, or flip process-wide state even with bogus arguments
 # (reference keeps the same kind of special-case list,
@@ -176,6 +188,8 @@ def _linux_probe(c, sandbox: str) -> Optional[str]:
     if c.call_name in ("open", "openat", "creat"):
         path = _const_path_arg(c)
         if path is not None:
+            if path in _DANGEROUS_PATHS:
+                return _DANGEROUS_PATHS[path]
             probe = path.replace("#", "0")
             if not os.path.exists(probe):
                 return f"{probe} does not exist"

@@ -59,9 +59,12 @@ def _lower_type(ctype: str, ptr: bool, arr: str, bits: str
     ctype = re.sub(r"\s+", " ", ctype)
     if ptr:
         base = "ptr64[inout, array[int8]]"
-        if arr is not None and arr.strip().isdigit():
-            # pointer ARRAY: N pointers, not one
-            return f"array[{base}, {arr.strip()}]", "TODO: pointee type"
+        if arr is not None:
+            # pointer ARRAY: N pointers, not one; non-literal bounds
+            # still need the array wrapper + a visible marker
+            if arr.strip().isdigit():
+                return f"array[{base}, {arr.strip()}]", "TODO: pointee type"
+            return f"array[{base}]", "TODO: pointee type + array bound"
         return base, "TODO: pointee type"
     base = _INT_TYPES.get(ctype)
     if base is None:
@@ -96,7 +99,7 @@ def parse_header(src: str) -> list[tuple[str, list[tuple[str, str, str]]]]:
                 # visible marker — silently dropping fields shifts
                 # every later offset
                 if line.strip():
-                    fields.append(("unparsed", "int8",
+                    fields.append((f"unparsed{len(fields)}", "int8",
                                    f"TODO: could not parse "
                                    f"{line.strip()!r}"))
                 continue

@@ -274,3 +274,27 @@ def test_host_detection_sim_supports_all(test_target):
     sup, unsup = detect_supported_syscalls(test_target)
     assert not unsup
     assert len(sup) == len(test_target.syscalls)
+
+
+def test_host_detection_dangerous_devices():
+    """Opening /dev/watchdog arms a reboot timer: the linux probe
+    keeps it (and its ioctl chain, transitively) out of the default
+    enabled set even when the device exists."""
+    import os
+
+    import pytest
+
+    if not os.path.exists("/proc/version"):
+        pytest.skip("not a linux host")
+    from syzkaller_tpu.fuzzer.host import (detect_supported_syscalls,
+                                           enabled_calls)
+    from syzkaller_tpu.models.target import get_target
+
+    t = get_target("linux", "amd64")
+    sup, unsup = detect_supported_syscalls(t, backend="linux")
+    names = {c.name: r for c, r in unsup.items()}
+    assert "openat$watchdog" in names
+    assert "watchdog" in names["openat$watchdog"]
+    enabled, disabled = enabled_calls(t, sup)
+    dis = {c.name for c in disabled}
+    assert "ioctl$WDIOC_KEEPALIVE" in dis  # dies with its ctor
