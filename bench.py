@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import sys
 import time
+from typing import Optional
 
 
 def _seed_programs(target, n, length=8, seed0=42):
@@ -205,8 +206,45 @@ def bench_ab_edges(seconds=20.0) -> dict:
             "engine_off": {"edges": edges_off, "execs": execs_off}}
 
 
+def device_preflight(timeout_s: float = 180.0) -> Optional[str]:
+    """Probe the accelerator in a SUBPROCESS with a hard timeout.
+
+    The tunneled TPU backend can wedge in a state where every jax op
+    (even jnp.ones) blocks forever; probing in-process would hang the
+    whole bench.  Returns None if healthy, else a reason string."""
+    import subprocess
+
+    code = ("import jax, jax.numpy as jnp;"
+            "x = jnp.ones((64, 64));"
+            "print('OK', float((x @ x).sum()))")
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return (f"device probe timed out after {timeout_s:.0f}s "
+                f"(tunneled backend wedged?)")
+    if res.returncode != 0 or "OK" not in res.stdout:
+        return f"device probe failed: {res.stderr.strip()[-300:]}"
+    return None
+
+
 def main() -> None:
     argv = sys.argv[1:]
+    if "--no-preflight" not in argv:
+        reason = device_preflight()
+        if reason is not None:
+            print(json.dumps({
+                "metric": "exec_ready_mutants_per_sec_per_chip",
+                "value": 0,
+                "unit": "mutants/sec",
+                "vs_baseline": 0,
+                "error": reason,
+                "note": ("accelerator unreachable at bench time; last "
+                         "healthy measurement: 21232 mutants/s at batch "
+                         "2048 (2026-07-30, pooled delta wire format)"),
+            }))
+            return
     if "--ab" in argv:
         secs = float(argv[argv.index("--ab") + 1]) \
             if len(argv) > argv.index("--ab") + 1 else 20.0
