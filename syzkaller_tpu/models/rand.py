@@ -105,10 +105,15 @@ class RandGen:
         return v
 
     def rand_range_int(self, begin: int, end: int) -> int:
-        """(reference: prog/rand.go:93-98)"""
+        """(reference: prog/rand.go:93-98).  Negative range bounds
+        arrive as two's-complement uint64s (begin > end numerically,
+        e.g. int32[-20:19]); the span must be computed with Go-style
+        uint64 wraparound or the Python modulus goes negative and the
+        result is ~uniform 64-bit garbage."""
         if self.one_of(100):
             return self.rand_int()
-        return (begin + self.uint64() % (end - begin + 1)) & MASK64
+        span = ((end - begin) & MASK64) + 1
+        return (begin + self.uint64() % span) & MASK64
 
     def biased_rand(self, n: int, k: int) -> int:
         """Random int in [0, n); probability of n-1 is k times higher
