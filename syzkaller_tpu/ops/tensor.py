@@ -76,6 +76,16 @@ class TensorConfig:
     # a single mutant's changed spans fit a delta-transfer payload.
     max_blob: int = MAX_BLOB_DEVICE
 
+    def __post_init__(self):
+        # The device length-fixup path divides non-power-of-2 LEN
+        # granularities in float32 (ops/mutate.py _fixup_lens); the
+        # 24-bit mantissa keeps that division exact only while every
+        # length stays below 2^24.  Growing past it would produce
+        # silently wrong length words in exec streams — fail loudly at
+        # config time instead (VERDICT r2 weak #6).
+        assert self.arena < (1 << 24) and self.max_blob < (1 << 24), \
+            "arena/max_blob must stay < 2^24 (f32-exact device division)"
+
     def like(self) -> dict:
         return dict(max_calls=self.max_calls, max_slots=self.max_slots,
                     arena=self.arena, max_blob=self.max_blob)
