@@ -178,9 +178,8 @@ class _Renderer:
                 expr = f"0x{val:x}"
                 if pid_stride:
                     expr += f" + procid*{pid_stride}"
-                if big_endian:
-                    expr = f"htobe{t.size * 8}({expr})" if t.size > 1 \
-                        else expr
+                if big_endian and arg.size() > 1:
+                    expr = f"htobe{arg.size() * 8}({expr})"
                 out.append(self._store(addr, arg.size(), expr, t))
 
         foreach_arg(c, copyin)
