@@ -561,6 +561,7 @@ static long syz_open_pts(long master, long flags)
 }""",
     "syz_genetlink_get_family_id":
         r"""#include <linux/netlink.h>
+#include <sys/socket.h>
 static long syz_genetlink_get_family_id(long name)
 {
   int sock = socket(AF_NETLINK, SOCK_RAW, 16);
