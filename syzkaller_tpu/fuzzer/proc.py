@@ -12,6 +12,7 @@ step 8; reference shape: syz-fuzzer/proc.go:66-98).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Union
 
 from syzkaller_tpu.fuzzer.fuzzer import Fuzzer, Stat, signal_prio
@@ -58,16 +59,75 @@ class PipelineMutator:
 
     next() returns either an exec-ready ExecMutant or a typed Prog;
     Proc.execute handles both.  Corpus growth is fed to the device
-    ring on every draw (one scatter per pipeline step)."""
+    ring on every draw (one scatter per pipeline step).
 
-    def __init__(self, pipeline, drain_timeout: float = 60.0):
+    Health latch: after demote_after CONSECUTIVE drain timeouts the
+    mutator latches to "demoted" — device draws return None instantly
+    (Proc falls back to CPU mutation within the same draw) instead of
+    serializing every proc on drain_timeout waits against a wedged
+    device (the axon-tunnel failure mode).  A background probe keeps
+    polling the pipeline and clears the latch the moment the device
+    answers again."""
+
+    def __init__(self, pipeline, drain_timeout: float = 60.0,
+                 demote_after: int = 3, probe_interval: float = 5.0,
+                 probe_timeout: Optional[float] = None):
         self.pipeline = pipeline
         self.drain_timeout = drain_timeout
+        self.demote_after = demote_after
+        self.probe_interval = probe_interval
+        self.probe_timeout = (drain_timeout if probe_timeout is None
+                              else probe_timeout)
         self._lock = threading.Lock()
         self._fed = 0
         self._corpus_cache: list[Prog] = []
+        self._consec_timeouts = 0
+        self._demoted = threading.Event()
+        self._stash = None  # mutant recovered by the health probe
+        self._probe_thread: Optional[threading.Thread] = None
         # Tests set this to a list to observe the op-class stream.
         self.ops_journal: Optional[list[str]] = None
+
+    # -- health latch -----------------------------------------------------
+
+    def healthy(self) -> bool:
+        return not self._demoted.is_set()
+
+    def _note_drain_timeout(self) -> None:
+        # One mutator is shared by every proc thread: the streak
+        # counter and the demote-check must be atomic or two threads
+        # can both pass the gate and spawn duplicate probes.
+        with self._lock:
+            self._consec_timeouts += 1
+            if self._consec_timeouts < self.demote_after \
+                    or self._demoted.is_set():
+                return
+            self._demoted.set()
+            n = self._consec_timeouts
+            t = threading.Thread(target=self._probe_loop, daemon=True,
+                                 name="pipeline-health-probe")
+            self._probe_thread = t
+        log.logf(0, "DEVICE PIPELINE UNRESPONSIVE: %d consecutive %.0fs "
+                    "drain timeouts; demoting to CPU mutation "
+                    "(background probe will re-enable)",
+                 n, self.drain_timeout)
+        t.start()
+
+    def _probe_loop(self) -> None:
+        while self._demoted.is_set():
+            pstop = getattr(self.pipeline, "_stop", None)
+            if pstop is not None and pstop.is_set():
+                return  # pipeline shut down; stay demoted
+            m = self.pipeline.next(timeout=self.probe_timeout)
+            if m is not None:
+                with self._lock:
+                    self._stash = m
+                    self._consec_timeouts = 0
+                    self._demoted.clear()
+                log.logf(0, "device pipeline answering again; "
+                            "re-enabling device mutation")
+                return
+            time.sleep(self.probe_interval)
 
     def _sync_corpus(self, fuzzer: Fuzzer) -> list[Prog]:
         """Feed new corpus items to the device ring; returns the
@@ -114,8 +174,17 @@ class PipelineMutator:
             else:
                 op = "device"
             if op == "device":
-                m = self.pipeline.next(timeout=self.drain_timeout)
-                if m is not None and self.ops_journal is not None:
+                if self._demoted.is_set():
+                    return None  # health latch: CPU fallback in Proc
+                with self._lock:
+                    m, self._stash = self._stash, None
+                if m is None:
+                    m = self.pipeline.next(timeout=self.drain_timeout)
+                if m is None:
+                    self._note_drain_timeout()
+                    return None
+                self._consec_timeouts = 0
+                if self.ops_journal is not None:
                     self.ops_journal.append("device")
                 return m
             if p is None:

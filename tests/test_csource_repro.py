@@ -2,6 +2,7 @@
 
 import os
 import struct as st
+import tempfile
 
 import pytest
 
@@ -230,7 +231,9 @@ def test_csource_pseudo_syscalls_compile_and_run():
     assert "syz_open_procfs((long)" in s
     binpath = build_csource(src)
     try:
-        res = subprocess.run([binpath], timeout=30)
+        # run in a scratch cwd: the generated C mkdtemp's ./syzkaller.XXXXXX
+        with tempfile.TemporaryDirectory() as scratch:
+            res = subprocess.run([binpath], timeout=30, cwd=scratch)
         assert res.returncode == 0
     finally:
         os.unlink(binpath)
