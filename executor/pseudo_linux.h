@@ -109,6 +109,10 @@ static void sandbox_namespace() {
 static int g_tun_fd = -1;
 
 static void setup_tun(uint64_t pid) {
+  // Per-proc addressing is one byte wide (172.20.<pid>.1, MAC byte
+  // 5): mask so pid 257 does not alias pid 1's subnet or bleed into
+  // the second octet.
+  pid &= 0xff;
   g_tun_fd = open("/dev/net/tun", O_RDWR | O_NONBLOCK);
   if (g_tun_fd < 0) {
     debugf("tun: /dev/net/tun unavailable: %d\n", errno);
@@ -173,12 +177,19 @@ static void setup_cgroups(uint64_t pid) {
 // ---- guest strings --------------------------------------------------
 
 static void read_guest_str(uint64_t addr, char* out, size_t cap) {
+  // Bounded by the arena end: a mutated string whose NUL was
+  // overwritten near the arena edge must fail THIS call (empty path →
+  // ENOENT), not failf-exit the whole fork server via guest().
+  out[0] = 0;
+  if (addr == 0 || addr < g_arena_base ||
+      addr >= g_arena_base + g_arena_size)
+    return;
+  uint64_t remain = g_arena_base + g_arena_size - addr;
+  size_t max = cap - 1;
+  if (remain < (uint64_t)max) max = (size_t)remain;
+  const char* src = (const char*)(g_arena + (addr - g_arena_base));
   size_t i = 0;
-  for (; addr != 0 && i < cap - 1; i++) {
-    char c = ((const char*)guest(addr + i, 1))[0];
-    if (c == 0) break;
-    out[i] = c;
-  }
+  for (; i < max && src[i]; i++) out[i] = src[i];
   out[i] = 0;
 }
 
@@ -570,6 +581,9 @@ static long pseudo_mount_image(uint64_t fs_addr, uint64_t dir_addr,
     if (res < 0) res = -errno;
   } else {
     res = -errno;
+    // AUTOCLEAR was never set: detach explicitly or the loop device
+    // (and its unlinked backing file) leaks for the rest of the run.
+    ioctl(lfd, LOOP_CLR_FD, 0);
   }
   close(lfd);  // mount (if any) holds the loop device from here
   if (res < 0) return res;

@@ -298,9 +298,16 @@ class _Renderer:
             proc_setup.append("setup_cgroups();")
         if o.sandbox == "setuid":
             proc_setup.append("sandbox_setuid();")
-        loop_body = "execute_one();"
+        # Sweep only single-proc repros inside a tmp-dir sandbox: with
+        # procs > 1 the children share one cwd and a sweeping sibling
+        # would detach another proc's live mount mid-iteration.
+        sweep = ""
+        if "syz_mount_image" in self._used_pseudo() and o.use_tmp_dir \
+                and o.procs <= 1:
+            sweep = " tz_unmount_all();"
+        loop_body = f"execute_one();{sweep}"
         if o.repeat:
-            loop_body = "for (;;) { execute_one(); }"
+            loop_body = f"for (;;) {{ execute_one();{sweep} }}"
         if o.procs > 1:
             out.append(f"  for (procid = 0; procid < {o.procs}; "
                        "procid++) {")
@@ -605,6 +612,11 @@ static long syz_genetlink_get_family_id(long name)
 #include <sys/ioctl.h>
 #include <sys/mount.h>
 struct tz_img_segment { uint64_t addr, size, offset; };
+// Mirrors the executor's pseudo_mount_image clamps (pseudo_linux.h
+// build_image): 64MB image cap, <=64 segments of <=1MB bounded to the
+// image, mountpoint confined to the basename under the cwd — so a
+// repro behaves like the fuzzed execution and a mutated huge size
+// cannot exhaust the repro host's disk.
 static long syz_mount_image(long fs, long dir, long size, long nsegs,
                             long segs, long flags, long opts)
 {
@@ -612,10 +624,15 @@ static long syz_mount_image(long fs, long dir, long size, long nsegs,
   int img = mkstemp(tmpl);
   if (img < 0) return -1;
   unlink(tmpl);
+  if ((uint64_t)size > (64ull << 20)) size = 64ll << 20;
   if (ftruncate(img, size)) { close(img); return -1; }
   struct tz_img_segment* seg = (struct tz_img_segment*)segs;
-  for (long i = 0; i < nsegs && i < 64; i++)
-    if (pwrite(img, (void*)seg[i].addr, seg[i].size, seg[i].offset)) {}
+  for (long i = 0; i < nsegs && i < 64; i++) {
+    uint64_t ssize = seg[i].size, soff = seg[i].offset;
+    if (ssize > (1 << 20) || soff > (uint64_t)size) continue;
+    if (soff + ssize > (uint64_t)size) ssize = size - soff;
+    if (pwrite(img, (void*)seg[i].addr, ssize, soff)) {}
+  }
   int ctl = open("/dev/loop-control", O_RDWR);
   if (ctl < 0) { close(img); return -1; }
   int idx = ioctl(ctl, LOOP_CTL_GET_FREE);
@@ -631,16 +648,78 @@ static long syz_mount_image(long fs, long dir, long size, long nsegs,
   // (the mount, or our fd) goes away — no leak under repeat mode
   struct loop_info64 info;
   memset(&info, 0, sizeof(info));
-  if (ioctl(lfd, LOOP_GET_STATUS64, &info) == 0) {
-    info.lo_flags |= LO_FLAGS_AUTOCLEAR;
-    ioctl(lfd, LOOP_SET_STATUS64, &info);
+  if (ioctl(lfd, LOOP_GET_STATUS64, &info)) {
+    ioctl(lfd, LOOP_CLR_FD, 0);
+    close(lfd);
+    return -1;
   }
-  mkdir((char*)dir, 0777);
-  long res = mount(ldev, (char*)dir, (char*)fs, flags,
+  info.lo_flags |= LO_FLAGS_AUTOCLEAR;
+  ioctl(lfd, LOOP_SET_STATUS64, &info);
+  // copy under NONFAILING: dir may be NULL/unmapped (EFAULT in the
+  // fuzzed run must not become a repro-killing segfault here)
+  char dbuf[64];
+  dbuf[0] = 0;
+  NONFAILING(strncpy(dbuf, (char*)dir, sizeof(dbuf) - 1));
+  dbuf[sizeof(dbuf) - 1] = 0;
+  const char* rbase = strrchr(dbuf, '/');
+  rbase = rbase ? rbase + 1 : dbuf;
+  if (!rbase[0] || !strcmp(rbase, ".") || !strcmp(rbase, ".."))
+    rbase = "m";  // keep the mount confined to the cwd
+  char mdir[160];
+  snprintf(mdir, sizeof(mdir), "./%s", rbase);
+  mkdir(mdir, 0777);
+  long res = mount(ldev, mdir, (char*)fs, flags,
                    opts ? (char*)opts : NULL);
   close(lfd);
   if (res < 0) return res;
-  return open((char*)dir, O_RDONLY | O_DIRECTORY);
+  return open(mdir, O_RDONLY | O_DIRECTORY);
+}
+// End-of-iteration sweep: unmount everything mounted under the cwd so
+// repeat mode reuses mountpoints and a one-shot repro exits clean
+// (executor twin: pseudo_linux.h pseudo_cleanup/pseudo_parent_sweep).
+static void tz_unmount_all(void)
+{
+  char cwd[256];
+  if (!getcwd(cwd, sizeof(cwd))) return;
+  // only sweep inside a use_temporary_dir() sandbox: if mkdtemp/chdir
+  // failed (or the repro was built without a tmp dir) the cwd is the
+  // user's own directory and their mounts must not be touched
+  const char* cb = strrchr(cwd, '/');
+  if (!cb || strncmp(cb + 1, "syzkaller.", 10)) return;
+  size_t n = strlen(cwd);
+  for (int pass = 0; pass < 4; pass++) {
+    FILE* f = fopen("/proc/self/mounts", "r");
+    if (!f) return;
+    char line[512];
+    int any = 0;
+    while (fgets(line, sizeof(line), f)) {
+      char* sp = strchr(line, ' ');
+      if (!sp) continue;
+      char* mnt = sp + 1;
+      char* end = strchr(mnt, ' ');
+      if (!end) continue;
+      *end = 0;
+      // /proc/self/mounts octal-escapes space/tab/newline (\040...)
+      char dec[512];
+      size_t di = 0;
+      for (char* c = mnt; *c && di < sizeof(dec) - 1; c++, di++) {
+        if (c[0] == '\\' && c[1] >= '0' && c[1] <= '3' &&
+            c[2] >= '0' && c[2] <= '7' && c[3] >= '0' && c[3] <= '7') {
+          dec[di] = (char)((c[1] - '0') * 64 + (c[2] - '0') * 8 +
+                           (c[3] - '0'));
+          c += 3;
+        } else {
+          dec[di] = c[0];
+        }
+      }
+      dec[di] = 0;
+      if (strncmp(dec, cwd, n) == 0 && dec[n] == '/' &&
+          umount2(dec, MNT_DETACH) == 0)
+        any = 1;
+    }
+    fclose(f);
+    if (!any) break;
+  }
 }""",
     "syz_read_part_table": r"""#include <linux/fs.h>
 #include <linux/loop.h>
@@ -652,10 +731,15 @@ static long syz_read_part_table(long size, long nsegs, long segs)
   int img = mkstemp(tmpl);
   if (img < 0) return -1;
   unlink(tmpl);
+  if ((uint64_t)size > (64ull << 20)) size = 64ll << 20;
   if (ftruncate(img, size)) { close(img); return -1; }
   struct tz_rpt_segment* seg = (struct tz_rpt_segment*)segs;
-  for (long i = 0; i < nsegs && i < 64; i++)
-    if (pwrite(img, (void*)seg[i].addr, seg[i].size, seg[i].offset)) {}
+  for (long i = 0; i < nsegs && i < 64; i++) {
+    uint64_t ssize = seg[i].size, soff = seg[i].offset;
+    if (ssize > (1 << 20) || soff > (uint64_t)size) continue;
+    if (soff + ssize > (uint64_t)size) ssize = size - soff;
+    if (pwrite(img, (void*)seg[i].addr, ssize, soff)) {}
+  }
   int ctl = open("/dev/loop-control", O_RDWR);
   if (ctl < 0) { close(img); return -1; }
   int idx = ioctl(ctl, LOOP_CTL_GET_FREE);
