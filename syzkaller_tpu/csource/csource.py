@@ -654,7 +654,12 @@ static long syz_mount_image(long fs, long dir, long size, long nsegs,
     return -1;
   }
   info.lo_flags |= LO_FLAGS_AUTOCLEAR;
-  ioctl(lfd, LOOP_SET_STATUS64, &info);
+  if (ioctl(lfd, LOOP_SET_STATUS64, &info)) {
+    // without AUTOCLEAR the device would outlive every user: detach
+    ioctl(lfd, LOOP_CLR_FD, 0);
+    close(lfd);
+    return -1;
+  }
   // copy under NONFAILING: dir may be NULL/unmapped (EFAULT in the
   // fuzzed run must not become a repro-killing segfault here)
   char dbuf[64];
