@@ -150,6 +150,13 @@ class Compiler:
             rep = env[t.name]
             if isinstance(rep, A.TypeExpr):
                 return copy.deepcopy(rep)
+            if isinstance(rep, A.StrValue):
+                # A string literal has no meaning as a standalone type
+                # (only inside string[...]/stringnoz[...] args, handled
+                # by the arg loop below); report it precisely.
+                self._error(t.pos, f"string template argument "
+                                   f"{rep.value!r} used in type position")
+                return A.TypeExpr(pos=t.pos, name="void")
             # An int parameter used in type position is only valid where
             # the consumer expects an int; wrap for the lowerer to unpack.
             out = A.TypeExpr(pos=t.pos, name="__intparam__")
@@ -162,7 +169,13 @@ class Compiler:
                 out.name = rep.name
         for a in t.args:
             if isinstance(a, A.TypeExpr):
-                out.args.append(self._substitute(a, env))
+                if a.is_bare_ident() and a.name in env \
+                        and isinstance(env[a.name], A.StrValue):
+                    # string-literal template arg (e.g. fs_opt["uid"])
+                    # must stay a StrValue for string[...] lowering
+                    out.args.append(copy.deepcopy(env[a.name]))
+                else:
+                    out.args.append(self._substitute(a, env))
             elif isinstance(a, A.IntValue) and a.ident and a.ident in env:
                 rep = env[a.ident]
                 if isinstance(rep, A.IntValue):
