@@ -825,7 +825,69 @@ static void* map_file(const char* path, uint64_t size, bool writable) {
   return p;
 }
 
+#if defined(__linux__)
+// Self-contained proof that the staged long-mode KVM setup executes
+// guest text: stage a vcpu via kvm_setup_cpu (the same code the
+// syz_kvm_setup_cpu pseudo-syscall runs), KVM_RUN it, and print the
+// exit reason + rbx so the caller can verify a marker instruction
+// actually ran.  Usage: tz-executor --selftest-kvm <hex-text>
+static int kvm_selftest(const char* hex) {
+#ifndef TZ_HAVE_KVM
+  fprintf(stderr, "kvm-selftest: built without <linux/kvm.h>\n");
+  return 2;
+#else
+  // private arena for guest() translation
+  g_arena = (uint8_t*)mmap(nullptr, g_arena_size, PROT_READ | PROT_WRITE,
+                           MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (g_arena == MAP_FAILED) failf("kvm-selftest: arena mmap");
+  size_t text_len = strlen(hex) / 2;
+  if (text_len == 0 || text_len > 0x1000)
+    failf("kvm-selftest: bad text length %zu", text_len);
+  uint64_t usermem = g_arena_base + 0x100000;
+  uint64_t seg_gaddr = g_arena_base + 0x100;
+  uint64_t text_gaddr = g_arena_base + 0x200;
+  uint8_t* text = guest(text_gaddr, text_len);
+  for (size_t i = 0; i < text_len; i++) {
+    unsigned v = 0;
+    if (sscanf(hex + 2 * i, "%2x", &v) != 1)
+      failf("kvm-selftest: bad hex");
+    text[i] = (uint8_t)v;
+  }
+  KvmTextSeg seg{2 /* long64 */, text_gaddr, text_len};
+  memcpy(guest(seg_gaddr, sizeof(seg)), &seg, sizeof(seg));
+
+  int kvm = open("/dev/kvm", O_RDWR);
+  if (kvm < 0) {
+    fprintf(stderr, "kvm-selftest: no /dev/kvm: %d\n", errno);
+    return 3;
+  }
+  int vmfd = ioctl(kvm, KVM_CREATE_VM, 0);
+  int cpufd = vmfd >= 0 ? ioctl(vmfd, KVM_CREATE_VCPU, 0) : -1;
+  if (vmfd < 0 || cpufd < 0) failf("kvm-selftest: create vm/vcpu");
+  long res = kvm_setup_cpu(vmfd, cpufd, usermem, seg_gaddr, 1, 0);
+  if (res != 0) failf("kvm-selftest: setup_cpu: %ld", res);
+  int run_size = ioctl(kvm, KVM_GET_VCPU_MMAP_SIZE, 0);
+  auto* run = (struct kvm_run*)mmap(nullptr, run_size,
+                                    PROT_READ | PROT_WRITE, MAP_SHARED,
+                                    cpufd, 0);
+  if (run == MAP_FAILED) failf("kvm-selftest: run mmap");
+  if (ioctl(cpufd, KVM_RUN, 0)) failf("kvm-selftest: KVM_RUN: %d", errno);
+  struct kvm_regs regs;
+  if (ioctl(cpufd, KVM_GET_REGS, &regs))
+    failf("kvm-selftest: KVM_GET_REGS: %d", errno);
+  printf("kvm-selftest: exit=%u rip=0x%llx rbx=0x%llx\n",
+         run->exit_reason, (unsigned long long)regs.rip,
+         (unsigned long long)regs.rbx);
+  return 0;
+#endif
+}
+#endif  // __linux__
+
 static int executor_main(int argc, char** argv) {
+#if defined(__linux__)
+  if (argc >= 3 && strcmp(argv[1], "--selftest-kvm") == 0)
+    return kvm_selftest(argv[2]);
+#endif
   if (argc < 3) failf("usage: tz-executor <in-file> <out-file>");
   g_in = (uint64_t*)map_file(argv[1], kInShmemSize, false);
   g_out = (uint8_t*)map_file(argv[2], kOutShmemSize, true);

@@ -275,6 +275,30 @@ struct KvmTextSeg {  // guest layout of the text array arg
 
 static constexpr uint64_t kKvmGuestMemSize = 24 << 12;  // 24 pages
 
+// Real-mode trampoline executed by the guest itself: lgdt/lidt from
+// guest descriptor tables, CR4.PAE, CR3, EFER.LME (wrmsr), CR0.PG|PE,
+// far jump through the 64-bit GDT descriptor into the user text at
+// 0x8000 (the real->long staging the reference does in kvm.S).
+static const uint8_t kKvmTramp[] = {
+    0xfa,                                      // cli
+    0x66, 0x0f, 0x01, 0x16, 0x80, 0x70,        // lgdtl [0x7080]
+    0x66, 0x0f, 0x01, 0x1e, 0x88, 0x70,        // lidtl [0x7088]
+    0x0f, 0x20, 0xe0,                          // mov eax, cr4
+    0x0c, 0x20,                                // or  al, 0x20 (PAE)
+    0x0f, 0x22, 0xe0,                          // mov cr4, eax
+    0x66, 0xb8, 0x00, 0x30, 0x00, 0x00,        // mov eax, 0x3000
+    0x0f, 0x22, 0xd8,                          // mov cr3, eax
+    0x66, 0xb9, 0x80, 0x00, 0x00, 0xc0,        // mov ecx, 0xc0000080
+    0x0f, 0x32,                                // rdmsr
+    0x66, 0x0d, 0x00, 0x01, 0x00, 0x00,        // or  eax, 0x100 (LME)
+    0x0f, 0x30,                                // wrmsr
+    0x0f, 0x20, 0xc0,                          // mov eax, cr0
+    0x66, 0x0d, 0x01, 0x00, 0x00, 0x80,        // or  eax, PG|PE
+    0x0f, 0x22, 0xc0,                          // mov cr0, eax
+    0x66, 0xea, 0x00, 0x80, 0x00, 0x00,        // ljmpl 0x08:0x8000
+    0x08, 0x00,
+};
+
 static long kvm_setup_cpu(int vmfd, int cpufd, uint64_t usermem,
                           uint64_t text_addr, uint64_t ntext,
                           uint64_t flags) {
@@ -307,8 +331,18 @@ static long kvm_setup_cpu(int vmfd, int cpufd, uint64_t usermem,
   memset(&regs, 0, sizeof(regs));
   regs.rflags = 2;
   if (seg.typ == 2) {
-    // long mode: identity-map the low 2MB with a 3-level table placed
-    // in the guest pages above the text
+    // Long mode via REAL staging: the vcpu starts in real mode at a
+    // trampoline that executes the architectural bring-up itself —
+    // lgdt/lidt from guest-memory descriptor tables, CR4.PAE, CR3 at
+    // the identity page tables, EFER.LME via wrmsr, CR0.PG|PE, then
+    // a far jump through the 64-bit GDT code descriptor into the
+    // user text.  Guest layout:
+    //   0x1000 IDT (zero-limit would do; real entries triple-fault
+    //          cleanly), 0x2000 GDT, 0x3000-0x5fff PML4/PDPT/PD,
+    //   0x7000 trampoline (+ GDTR/IDTR operands), 0x8000 user text,
+    //   0xf000 stack top.
+    // (reference: executor/common_kvm_amd64.h + kvm.S stage the same
+    // transition with their own table layout)
     uint64_t pml4_gpa = 0x3000, pdpt_gpa = 0x4000, pd_gpa = 0x5000;
     auto w64 = [&](uint64_t gpa, uint64_t val) {
       memcpy(host_mem + gpa, &val, 8);
@@ -316,29 +350,37 @@ static long kvm_setup_cpu(int vmfd, int cpufd, uint64_t usermem,
     w64(pml4_gpa, pdpt_gpa | 3);
     w64(pdpt_gpa, pd_gpa | 3);
     w64(pd_gpa, 0x83);  // 2MB page, present|rw|ps
-    sregs.cr3 = pml4_gpa;
-    sregs.cr4 |= 0x20;               // PAE
-    sregs.cr0 |= 0x80000001u;        // PG | PE
-    sregs.efer |= 0x500;             // LME | LMA
-    struct kvm_segment cs;
-    memset(&cs, 0, sizeof(cs));
-    cs.base = 0;
-    cs.limit = 0xffffffff;
-    cs.selector = 0x8;
-    cs.type = 11;
-    cs.present = 1;
-    cs.s = 1;
-    cs.l = 1;
-    cs.g = 1;
-    sregs.cs = cs;
-    struct kvm_segment ds = cs;
-    ds.type = 3;
-    ds.selector = 0x10;
-    ds.l = 0;
-    ds.db = 1;
-    sregs.ds = sregs.es = sregs.ss = ds;
-    regs.rip = 0x1000;
-    regs.rsp = 0x2000;
+    // GDT: null, 0x08 = 64-bit code, 0x10 = flat data, 0x18 = 32-bit
+    // code (kept for protected-mode hops), 4 entries = limit 0x1f
+    w64(0x2000 + 0x00, 0);
+    w64(0x2000 + 0x08, 0x00209A0000000000ull);  // L=1 code
+    w64(0x2000 + 0x10, 0x00CF92000000FFFFull);  // flat data
+    w64(0x2000 + 0x18, 0x00CF9A000000FFFFull);  // 32-bit code
+    // user text moves to 0x8000 on the staged path
+    memset(host_mem + 0x8000, 0xf4, 0x1000);
+    memcpy(host_mem + 0x8000, guest(seg.text_addr, seg.text_len),
+           seg.text_len);
+    memcpy(host_mem + 0x7000, kKvmTramp, sizeof(kKvmTramp));
+    // GDTR/IDTR operands live at 0x7080/0x7088 — past the trampoline
+    // (0x42 bytes at 0x7000) so they never overwrite its tail
+    host_mem[0x7080] = 0x1f;  // GDT limit (4 entries)
+    host_mem[0x7081] = 0x00;
+    uint32_t gdt_base = 0x2000;
+    memcpy(host_mem + 0x7082, &gdt_base, 4);
+    // zero-limit IDT: any guest exception triple-faults into a clean
+    // KVM_EXIT_SHUTDOWN
+    host_mem[0x7088] = 0x00;
+    host_mem[0x7089] = 0x00;
+    uint32_t idt_base = 0x1000;
+    memcpy(host_mem + 0x708a, &idt_base, 4);
+    // real-mode start at the trampoline; all data segs base 0 so the
+    // lgdt/lidt disp16 operands address guest-physical directly
+    sregs.cs.base = 0x7000;
+    sregs.cs.selector = 0x700;
+    sregs.ds.base = sregs.es.base = sregs.ss.base = 0;
+    sregs.ds.selector = sregs.es.selector = sregs.ss.selector = 0;
+    regs.rip = 0;
+    regs.rsp = 0xf000;
   } else if (seg.typ == 1) {
     // protected 32-bit, flat segments, no paging
     sregs.cr0 |= 1;  // PE

@@ -27,6 +27,8 @@ _TOOLS = {
     "fmt": ("syzkaller_tpu.tools.fmt", "format syzlang descriptions"),
     "upgrade": ("syzkaller_tpu.tools.upgrade",
                 "migrate a corpus.db to the current format"),
+    "demo": ("syzkaller_tpu.tools.demo",
+             "one-command full-stack demo (manager+VMs+fuzzer+repro)"),
     "tty": ("syzkaller_tpu.tools.tty",
             "console/serial reader with crash highlighting"),
     "imagegen": ("syzkaller_tpu.tools.imagegen",

@@ -33,6 +33,26 @@ STATUS_CLOSED = "closed"    # fix commit observed in an uploaded build
 STATUS_INVALID = "invalid"
 STATUS_DUP = "dup"
 
+# Access levels gate which bugs a viewer sees: a bug is visible at a
+# level iff its CURRENT reporting stage's access <= the viewer's
+# (reference: dashboard/app/access.go AccessPublic/User/Admin).
+ACCESS_PUBLIC = "public"
+ACCESS_USER = "user"
+ACCESS_ADMIN = "admin"
+_ACCESS_RANK = {ACCESS_PUBLIC: 0, ACCESS_USER: 1, ACCESS_ADMIN: 2}
+
+
+@dataclass
+class ReportingStage:
+    """One stage of a namespace's reporting pipeline (reference:
+    dashboard/app/reporting.go Reporting + config.go namespace
+    Reporting lists).  Typical two-stage setup: a moderation stage
+    (access admin, short delay) that a human upstreams, then the
+    public stage."""
+    name: str = "public"
+    access: str = ACCESS_PUBLIC
+    delay_s: float = 0.0
+
 
 @dataclass
 class Build:
@@ -70,6 +90,10 @@ class Bug:
     num_crashes: int = 0
     reporting_due: float = 0.0
     reported_time: float = 0.0
+    # index into the namespace's reporting-stage list; the bug's
+    # moderation->public progress (reference: reporting.go bugReporting)
+    reporting_idx: int = 0
+    reporting_stage: str = ""  # stage name at which last reported
     fix_commit: str = ""
     dup_of: str = ""
     # Message-ID of the report mail; threads replies back to the bug
@@ -105,16 +129,45 @@ class Dashboard:
     client -> {"key": ..., "namespace": ...}."""
 
     def __init__(self, workdir: str, clients: Optional[dict] = None,
-                 reporting_delay_s: float = 0.0):
+                 reporting_delay_s: float = 0.0,
+                 reporting: Optional[dict] = None):
         os.makedirs(workdir, exist_ok=True)
         self.workdir = workdir
         self.clients = clients or {}
         self.reporting_delay_s = reporting_delay_s
+        # Per-namespace reporting pipelines; "*" is the fallback.  The
+        # default is the single public stage (legacy single-reporting
+        # behavior); pass e.g. {"ns": [ReportingStage("moderation",
+        # ACCESS_ADMIN, 0), ReportingStage("public", ACCESS_PUBLIC,
+        # 3600)]} for the two-stage syzbot flow.
+        self.reporting: dict[str, list[ReportingStage]] = {}
+        for ns, stages in (reporting or {}).items():
+            self.reporting[ns] = [
+                st if isinstance(st, ReportingStage)
+                else ReportingStage(**st) for st in stages]
         self._lock = threading.Lock()
         self.bugs: dict[str, Bug] = {}
         self.builds: dict[str, Build] = {}
         self.jobs: dict[str, Job] = {}
         self._load()
+
+    def stages_for(self, namespace: str) -> list[ReportingStage]:
+        return self.reporting.get(namespace) or self.reporting.get("*")             or [ReportingStage(delay_s=self.reporting_delay_s)]
+
+    def bug_stage(self, bug: Bug) -> ReportingStage:
+        stages = self.stages_for(bug.namespace)
+        return stages[min(bug.reporting_idx, len(stages) - 1)]
+
+    def bug_access(self, bug: Bug) -> str:
+        return self.bug_stage(bug).access
+
+    def visible_bugs(self, access: str = ACCESS_ADMIN) -> list[Bug]:
+        """Bugs visible at the given access level (reference:
+        access.go checkAccessLevel applied to bug listings)."""
+        rank = _ACCESS_RANK.get(access, 0)
+        with self._lock:
+            return [b for b in self.bugs.values()
+                    if _ACCESS_RANK[self.bug_access(b)] <= rank]
 
     # -- persistence ------------------------------------------------------
 
@@ -222,9 +275,16 @@ class Dashboard:
         with self._lock:
             bug = self.bugs.get(bug_id)
             if bug is None:
+                # configured pipelines use their stage-0 delay verbatim
+                # (0.0 means report immediately); only the legacy
+                # single-stage default inherits reporting_delay_s
+                configured = ns in self.reporting or "*" in self.reporting
+                stage0 = self.stages_for(ns)[0]
+                delay = stage0.delay_s if configured \
+                    else self.reporting_delay_s
                 bug = Bug(id=bug_id, title=title, namespace=ns,
                           first_time=now,
-                          reporting_due=now + self.reporting_delay_s)
+                          reporting_due=now + delay)
                 self.bugs[bug_id] = bug
             bug.last_time = now
             bug.num_crashes += 1
@@ -293,11 +353,18 @@ class Dashboard:
                 if namespace is not None and bug.namespace != namespace:
                     continue
                 if bug.status == STATUS_NEW and bug.reporting_due <= now:
+                    stage = self.bug_stage(bug)
                     bug.status = STATUS_REPORTED
                     bug.reported_time = now
+                    bug.reporting_stage = stage.name
+                    stages = self.stages_for(bug.namespace)
                     out.append({"id": bug.id, "title": bug.title,
                                 "namespace": bug.namespace,
-                                "num_crashes": bug.num_crashes})
+                                "num_crashes": bug.num_crashes,
+                                "stage": stage.name,
+                                "access": stage.access,
+                                "moderation": bug.reporting_idx
+                                < len(stages) - 1})
             if out:
                 self._save()
         return out
@@ -349,6 +416,33 @@ class Dashboard:
             elif status:
                 bug.status = status
             self._save()
+
+    def upstream_bug(self, bug_id: str) -> bool:
+        """Advance a moderation-stage bug to the next reporting stage:
+        it goes back to NEW with the next stage's delay and will be
+        re-reported (and re-emailed, with a fresh thread) at that
+        stage's access level (reference: reporting.go
+        incomingCommandCmd upstream -> bugReporting advance).
+        Returns False if the bug is already at the last stage."""
+        now = time.time()
+        with self._lock:
+            bug = self.bugs.get(bug_id)
+            if bug is None:
+                return False
+            # only live bugs advance: a fixed/invalid/dup bug must not
+            # be reopened by a stray '#syz upstream' reply
+            if bug.status not in (STATUS_NEW, STATUS_REPORTED):
+                return False
+            stages = self.stages_for(bug.namespace)
+            if bug.reporting_idx >= len(stages) - 1:
+                return False
+            bug.reporting_idx += 1
+            nxt = stages[bug.reporting_idx]
+            bug.status = STATUS_NEW
+            bug.reporting_due = now + nxt.delay_s
+            bug.report_msg_id = ""  # next stage threads a fresh mail
+            self._save()
+        return True
 
     # -- jobs (reference: dashboard/app/jobs.go:105) ---------------------
 

@@ -10,7 +10,7 @@ extractCommand):
   #syz invalid                  close as invalid
   #syz undup                    undo a dup
   #syz test: <repo> <branch>    patch-test job (patch from the body)
-  #syz upstream                 escalate reporting (recorded only)
+  #syz upstream                 advance to the next reporting stage
 """
 
 from __future__ import annotations

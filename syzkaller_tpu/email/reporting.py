@@ -58,7 +58,12 @@ class EmailReporting:
         sent = 0
         for rep in self.dash.poll_reports():
             bug_id = rep["id"]
-            msg_id = f"<tz-bug-{bug_id}@localhost>"
+            # per-stage Message-ID: after '#syz upstream' the next
+            # stage must start a FRESH thread, not collapse into (or
+            # dedup against) the moderation-stage mail
+            stage = rep.get("stage", "")
+            suffix = f"-{stage}" if stage else ""
+            msg_id = f"<tz-bug-{bug_id}{suffix}@localhost>"
             payload = self.dash.bug_report_payload(bug_id)
             self.mailbox.send(render_report(payload, self.from_addr,
                                             self.to, msg_id))
@@ -112,7 +117,10 @@ class EmailReporting:
                 self.dash.add_job(bug_id, em.patch, kernel_repo=repo,
                                   kernel_branch=branch)
             elif cmd.name == "upstream":
-                pass  # recorded implicitly; single-reporting setup
+                if not self.dash.upstream_bug(bug_id):
+                    self._nack(em, "bug is already at the last "
+                                   "reporting stage")
+                    continue
             else:
                 self._nack(em, f"unknown command {cmd.name!r}")
                 continue

@@ -239,5 +239,17 @@ class Fuzzer:
         self.stat_add(Stat.CRASHES)
         log.logf(0, "kernel crash detected (%d bytes of console log)",
                  len(console_log))
+        if self.conn is not None and console_log:
+            # Under a manager the instance console is the crash
+            # channel (reference: the guest kernel prints the oops to
+            # the serial console that MonitorExecution scans).  Our
+            # "kernel console" is the executor's captured stderr —
+            # replay it so the monitor sees the oops and the manager
+            # saves/repros the crash.
+            import sys as _sys
+
+            _sys.stderr.write(console_log if console_log.endswith("\n")
+                              else console_log + "\n")
+            _sys.stderr.flush()
         if self.on_crash is not None:
             self.on_crash(console_log, last_prog)

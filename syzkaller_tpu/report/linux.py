@@ -25,6 +25,8 @@ def _fmt(pat: bytes, fmt: str, **kw) -> OopsFormat:
 
 LINUX_OOPSES = [
     Oops(b"KASAN:", [
+        _fmt(rb"KASAN: (double-free or invalid-free) in " + _FUNC,
+             "KASAN: %s in %s"),
         _fmt(rb"KASAN: ([a-z\-]+) in " + _FUNC, "KASAN: %s in %s"),
         _fmt(rb"KASAN: ([a-z\-]+) on address", "KASAN: %s"),
         _fmt(rb"KASAN: (\S+)", "KASAN: %s"),
@@ -35,16 +37,22 @@ LINUX_OOPSES = [
     Oops(b"BUG: KFENCE:", [
         _fmt(rb"BUG: KFENCE: ([a-z\- ]+) in " + _FUNC, "KFENCE: %s in %s"),
     ]),
+    Oops(b"BUG: memory leak", [  # kmemleak (before the generic BUG:)
+        _fmt(rb"BUG: memory leak\n(?:.*\n)*?.*?backtrace:\s*\n\s*\[<[0-9a-fx]+>\] "
+             + _FUNC, "memory leak in %s"),
+        _fmt(rb"BUG: memory leak", "memory leak"),
+    ]),
     Oops(b"BUG:", [
+        _fmt(rb"BUG: stack guard page was hit", "kernel stack overflow"),
         _fmt(rb"BUG: unable to handle kernel paging request.*\n.*?(?:IP|RIP):? "
              rb"(?:\[<[0-9a-f]+>\] )?(?:\w+:)?" + _FUNC,
              "BUG: unable to handle kernel paging request in %s"),
         _fmt(rb"BUG: unable to handle kernel NULL pointer dereference"
              rb".*\n.*?(?:IP|RIP):? (?:\[<[0-9a-f]+>\] )?(?:\w+:)?" + _FUNC,
              "BUG: unable to handle kernel NULL pointer dereference in %s"),
-        _fmt(rb"BUG: spinlock (\w+) on CPU", "BUG: spinlock %s"),
+        _fmt(rb"BUG: spinlock ([a-z ]+) on CPU", "BUG: spinlock %s"),
         _fmt(rb"BUG: soft lockup - CPU#\d+ stuck for \d+s! \[([^\]:]+)",
-             "BUG: soft lockup in %s"),
+             "BUG: soft lockup in %s", stack_title=True),
         _fmt(rb"BUG: workqueue lockup", "BUG: workqueue lockup"),
         _fmt(rb"BUG: sleeping function called from invalid context"
              rb" (?:at|in) ([a-zA-Z0-9_/.\-]+)",
@@ -79,7 +87,7 @@ LINUX_OOPSES = [
         _fmt(rb"INFO: rcu_(?:preempt|sched|bh) (?:self-)?detected"
              rb"(?: expedited)? stalls?", "INFO: rcu detected stall"),
         _fmt(rb"INFO: task ([^ :]+):\d+ blocked for more than \d+ seconds",
-             "INFO: task hung in %s"),
+             "INFO: task hung in %s", stack_title=True),
         _fmt(rb"INFO: possible circular locking dependency detected",
              "possible deadlock (circular locking)"),
         _fmt(rb"INFO: trying to register non-static key",
@@ -101,8 +109,6 @@ LINUX_OOPSES = [
     Oops(b"Kernel panic", [
         _fmt(rb"Kernel panic - not syncing: Attempted to kill init",
              "kernel panic: Attempted to kill init", corrupted=True),
-        _fmt(rb"Kernel panic - not syncing: Out of memory",
-             "kernel panic: Out of memory"),
         _fmt(rb"Kernel panic - not syncing: ([^\n\r]*)",
              "kernel panic: %s"),
     ]),
@@ -115,11 +121,6 @@ LINUX_OOPSES = [
     Oops(b"unregister_netdevice: waiting for", [
         _fmt(rb"unregister_netdevice: waiting for (\S+)",
              "unregister_netdevice: waiting for %s"),
-    ]),
-    Oops(b"BUG: memory leak", [  # kmemleak
-        _fmt(rb"BUG: memory leak\n(?:.*\n)*?.*?backtrace:\s*\n\s*\[<[0-9a-fx]+>\] "
-             + _FUNC, "memory leak in %s"),
-        _fmt(rb"BUG: memory leak", "memory leak"),
     ]),
     Oops(b"UBSAN:", [
         _fmt(rb"UBSAN: ([a-z\-_ ]+) in ([a-zA-Z0-9_/.\-]+):\d+",
@@ -136,27 +137,122 @@ _NON_GUILTY = re.compile(
     r"__asan|__kasan|__kmsan|__ubsan|memcpy|memset|memmove|__warn|"
     r"warn_slowpath|panic|_raw_spin|lock_acquire|lock_release|"
     r"debug_|should_fail|fail_dump|slab_|kmalloc|kfree|krealloc|"
-    r"__alloc|page_alloc|stack_trace|save_stack|show_stack)")
+    r"__alloc|page_alloc|stack_trace|save_stack|show_stack|"
+    r"schedule|__schedule|context_switch|io_schedule|__switch_to)")
 
 _FRAME_RE = re.compile(
     rb"^(?:\[[\s\d.]+\])?\s+(?:\[<[0-9a-fx]+>\]\s*)?\??\s*"
     rb"([a-zA-Z0-9_.]+)\+0x[0-9a-f]+", re.M)
 
 
+_RIP_RE = re.compile(rb"(?:RIP|IP|pc)\s*:\s*(?:0010:|\[<[0-9a-f]+>\]\s*)?"
+                     + _FUNC + rb"\+0x", re.M)
+
+
 def guilty_function(region: bytes) -> str:
-    """First non-infrastructure frame of the first call trace."""
+    """First non-infrastructure frame of the first call trace, with
+    the faulting RIP/IP as fallback when the trace has no usable
+    frames (inline-only traces, truncated logs)."""
     idx = region.find(b"Call Trace:")
     if idx < 0:
         idx = region.find(b"Backtrace:")
     if idx < 0:
         idx = region.find(b"backtrace:")
-    if idx < 0:
-        return ""
-    for m in _FRAME_RE.finditer(region[idx:idx + (16 << 10)]):
+    if idx >= 0:
+        for m in _FRAME_RE.finditer(region[idx:idx + (16 << 10)]):
+            fn = m.group(1).decode("utf-8", "replace")
+            if not _NON_GUILTY.match(fn):
+                return fn
+    m = _RIP_RE.search(region)
+    if m is not None:
         fn = m.group(1).decode("utf-8", "replace")
         if not _NON_GUILTY.match(fn):
             return fn
     return ""
+
+
+# Source paths named in oops lines ("kernel BUG at fs/ext4/inode.c:123",
+# "WARNING: ... at net/core/dev.c:2345 fn+0x..").  Report-machinery
+# files are never the guilty one (reference: linux.go:373-447).
+_SRC_PATH_RE = re.compile(
+    rb"\b((?:kernel|mm|fs|net|drivers|sound|block|crypto|security|lib|"
+    rb"arch|ipc|io_uring|virt)/[A-Za-z0-9_/.\-]+\.[chS])[:!,]")
+
+_NON_GUILTY_SRC = re.compile(
+    r"^(mm/kasan/|mm/kmsan/|mm/kfence/|kernel/locking/lockdep|"
+    r"lib/dump_stack|kernel/panic|lib/ubsan|mm/page_alloc|mm/slab|"
+    r"mm/slub|kernel/rcu/|lib/fault-inject)")
+
+
+def guilty_source(region: bytes) -> str:
+    """First source path named by the report that isn't reporting
+    machinery (the file get_maintainer would be asked about)."""
+    for m in _SRC_PATH_RE.finditer(region[:16 << 10]):
+        path = m.group(1).decode("utf-8", "replace")
+        if not _NON_GUILTY_SRC.match(path):
+            return path
+    return ""
+
+
+# Subsystem routing when no kernel tree (with scripts/get_maintainer.pl)
+# is configured: the longest matching path prefix wins, everything also
+# goes to LKML — the same routing shape get_maintainer.pl yields.
+LKML = "linux-kernel@vger.kernel.org"
+_MAINTAINERS_TABLE = [
+    ("net/ipv4/", ["netdev@vger.kernel.org"]),
+    ("net/ipv6/", ["netdev@vger.kernel.org"]),
+    ("net/sctp/", ["linux-sctp@vger.kernel.org",
+                   "netdev@vger.kernel.org"]),
+    ("net/", ["netdev@vger.kernel.org"]),
+    ("fs/ext4/", ["linux-ext4@vger.kernel.org"]),
+    ("fs/btrfs/", ["linux-btrfs@vger.kernel.org"]),
+    ("fs/xfs/", ["linux-xfs@vger.kernel.org"]),
+    ("fs/f2fs/", ["linux-f2fs-devel@lists.sourceforge.net"]),
+    ("fs/", ["linux-fsdevel@vger.kernel.org"]),
+    ("mm/", ["linux-mm@kvack.org"]),
+    ("drivers/usb/", ["linux-usb@vger.kernel.org"]),
+    ("drivers/input/", ["linux-input@vger.kernel.org"]),
+    ("drivers/media/", ["linux-media@vger.kernel.org"]),
+    ("drivers/block/", ["linux-block@vger.kernel.org"]),
+    ("drivers/net/", ["netdev@vger.kernel.org"]),
+    ("sound/", ["alsa-devel@alsa-project.org"]),
+    ("block/", ["linux-block@vger.kernel.org"]),
+    ("crypto/", ["linux-crypto@vger.kernel.org"]),
+    ("security/selinux/", ["selinux@vger.kernel.org"]),
+    ("kernel/bpf/", ["bpf@vger.kernel.org"]),
+    ("kernel/trace/", ["linux-trace-kernel@vger.kernel.org"]),
+    ("arch/x86/kvm/", ["kvm@vger.kernel.org"]),
+    ("virt/kvm/", ["kvm@vger.kernel.org"]),
+]
+
+
+def maintainers_for(path: str, kernel_src: str = "") -> list[str]:
+    """Maintainer addresses for a guilty source file (reference:
+    linux.go getMaintainers via scripts/get_maintainer.pl)."""
+    if not path:
+        return []
+    if kernel_src:
+        import os
+        import subprocess
+        script = os.path.join(kernel_src, "scripts", "get_maintainer.pl")
+        if os.path.exists(script):
+            try:
+                out = subprocess.run(
+                    [script, "--no-n", "--no-rolestats", "-f", path],
+                    capture_output=True, text=True, timeout=60,
+                    cwd=kernel_src)
+                addrs = [ln.strip() for ln in out.stdout.splitlines()
+                         if "@" in ln]
+                if addrs:
+                    return addrs
+            except (OSError, subprocess.SubprocessError):
+                pass
+    best: list[str] = []
+    best_len = -1
+    for prefix, addrs in _MAINTAINERS_TABLE:
+        if path.startswith(prefix) and len(prefix) > best_len:
+            best, best_len = addrs, len(prefix)
+    return best + [LKML] if best else [LKML]
 
 
 def corrupted_reason(title: str, region: bytes) -> Optional[str]:
@@ -179,17 +275,24 @@ def corrupted_reason(title: str, region: bytes) -> Optional[str]:
 
 
 def make_linux_reporter(kernel_obj: str = "", ignores=None,
-                        suppressions=None) -> Reporter:
+                        suppressions=None,
+                        kernel_src: str = "") -> Reporter:
     symbolize_fn = None
     if kernel_obj:
         from syzkaller_tpu.report.symbolizer import make_report_symbolizer
 
         symbolize_fn = make_report_symbolizer(kernel_obj)
+
+    def attribution_fn(region: bytes) -> tuple[str, list[str]]:
+        src = guilty_source(region)
+        return src, maintainers_for(src, kernel_src=kernel_src)
+
     return Reporter(LINUX_OOPSES, ignores=ignores,
                     suppressions=suppressions,
                     symbolize_fn=symbolize_fn,
                     guilty_fn=guilty_function,
-                    corrupted_fn=corrupted_reason)
+                    corrupted_fn=corrupted_reason,
+                    attribution_fn=attribution_fn)
 
 
 register_reporter("linux", make_linux_reporter)

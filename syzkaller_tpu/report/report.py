@@ -27,7 +27,8 @@ class Report:
     corrupted_reason: str = ""
     suppressed: bool = False
     maintainers: list[str] = field(default_factory=list)
-    guilty_file: str = ""
+    guilty_file: str = ""  # guilty function (first non-infra frame)
+    guilty_src: str = ""  # guilty source path (maintainer routing key)
 
 
 @dataclass
@@ -39,6 +40,10 @@ class OopsFormat:
     alt: Optional[Pattern] = None
     no_stack_trace: bool = False
     corrupted: bool = False
+    # Title the crash by the first guilty stack frame instead of the
+    # regex capture (reference: report.go oopsFormat.stack extraction
+    # for hang/lockup reports whose header names only the comm).
+    stack_title: bool = False
 
 
 @dataclass
@@ -66,7 +71,9 @@ class Reporter:
                  symbolize_fn: Optional[Callable[[Report], None]] = None,
                  guilty_fn: Optional[Callable[[bytes], str]] = None,
                  corrupted_fn: Optional[
-                     Callable[[str, bytes], Optional[str]]] = None):
+                     Callable[[str, bytes], Optional[str]]] = None,
+                 attribution_fn: Optional[
+                     Callable[[bytes], tuple[str, list[str]]]] = None):
         self.oopses = oopses
         self.ignores = [re.compile(p.encode() if isinstance(p, str) else p)
                         if isinstance(p, (str, bytes)) else p
@@ -78,6 +85,7 @@ class Reporter:
         self._symbolize = symbolize_fn
         self._guilty = guilty_fn
         self._corrupted = corrupted_fn
+        self._attribution = attribution_fn
 
     # -- detection --------------------------------------------------------
 
@@ -131,6 +139,8 @@ class Reporter:
                 rep.corrupted_reason = reason
         if self._guilty is not None:
             rep.guilty_file = self._guilty(region)
+        if self._attribution is not None:
+            rep.guilty_src, rep.maintainers = self._attribution(region)
         return rep
 
     def _extract_title(self, region: bytes, oops: Oops) -> tuple[str, bool]:
@@ -142,6 +152,12 @@ class Reporter:
                 continue
             groups = [g.decode("utf-8", "replace") if g is not None else ""
                       for g in m.groups()]
+            if f.stack_title and self._guilty is not None:
+                # Title by the guilty stack frame; the regex capture
+                # (usually the comm name) is only the fallback.
+                frame = self._guilty(region)
+                if frame and groups:
+                    groups[-1] = frame
             title = f.fmt
             for g in groups:
                 title = title.replace("%s", sanitize_symbol(g), 1)

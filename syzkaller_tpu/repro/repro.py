@@ -131,10 +131,21 @@ class Reproducer:
                       ) -> Optional[tuple[Prog, Options]]:
         """Last-single-prog with escalating durations, then multi-prog
         bisection over the log suffix (reference: repro.go:233-420)."""
-        # Single-program attempts: last few entries, newest first.
+        # Single-program attempts: the last few entries overall plus
+        # the final entry of EACH proc — on an interleaved multi-proc
+        # console the crasher is the last program of its own proc, not
+        # necessarily one of the last lines (reference: repro.go
+        # indexes candidate entries per procs count).
+        last_per_proc: dict[int, object] = {}
+        for e in entries:
+            last_per_proc[e.proc] = e
+        singles = list(reversed(entries[-5:]))
+        for e in last_per_proc.values():
+            if e not in singles:
+                singles.append(e)
         for duration_mult in (1, 3):
             duration = self.base_duration_s * duration_mult
-            for entry in reversed(entries[-5:]):
+            for entry in singles:
                 if self._test([entry.p], opts, duration):
                     log.logf(1, "repro: single-program reproducer found")
                     return entry.p, opts
@@ -232,6 +243,19 @@ def make_env_tester(target, title_filter: Optional[str] = None,
 def run_from_manager(mgr, title: str, crash_log: bytes
                      ) -> Optional[Result]:
     """Entry point used by the manager's repro scheduler."""
+    from syzkaller_tpu.report import get_reporter
+
+    # On a real kernel the VM dies at the oops, so the log ends near
+    # the crasher.  The sim executor is respawned by the fuzzer, which
+    # keeps logging programs until the monitor kills the instance —
+    # cut the log at the first oops so "last entries" means "last
+    # before the crash", not detection-latency noise.
+    try:
+        rep = get_reporter(mgr.target.os).parse(crash_log)
+        if rep is not None and rep.start_pos > 0:
+            crash_log = crash_log[:rep.start_pos]
+    except Exception:
+        pass
     tester = make_env_tester(mgr.target, title_filter=title)
     r = Reproducer(mgr.target, tester)
     return r.run(crash_log)

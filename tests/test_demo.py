@@ -1,0 +1,25 @@
+"""One-command full-stack demo (VERDICT r3 item #3): manager + local
+VM pool + real fuzzer subprocesses + sim-kernel executor run until
+the workdir holds all five artifacts — grown corpus.db, a detected
+crash, an extracted repro.prog, an emitted repro.c, and a bug filed
+in the live dashboard (reference shape: RunManager -> vmLoop ->
+saveCrash -> repro.Run -> saveRepro,
+/root/reference/syz-manager/manager.go:141-534,736)."""
+
+from __future__ import annotations
+
+import pytest
+
+from syzkaller_tpu.tools.demo import run_demo
+
+
+@pytest.mark.slow
+def test_demo_produces_all_artifacts(tmp_path):
+    status = run_demo(str(tmp_path / "work"), minutes=12.0,
+                      engine="cpu", vms=2, procs=2,
+                      log=lambda *a: None)
+    assert status["corpus.db"], status
+    assert status["crash"], status
+    assert status["repro.prog"], status
+    assert status["repro.c"], status
+    assert status["dashboard_bug"], status

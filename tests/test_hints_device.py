@@ -149,3 +149,67 @@ def test_smash_hint_pass_drains_device_batch(test_target):
         assert ran > 0, "no hint mutants executed via the device engine"
     finally:
         env.close()
+
+
+def test_per_key_overflow_supplement_exact(test_target):
+    """A map mixing normal keys with one hot key (>vmax operands) must
+    stay on device for the normal keys and produce the exact CPU
+    mutant sequence via the per-key CPU supplement — no wholesale
+    bailout (VERDICT r3 item #9)."""
+    cm = CompMap()
+    for i in range(40):  # hot key: 40 operands > vmax=16
+        cm.add_comp(0x1234, 0x2000 + i)
+    for k in range(12):  # plenty of in-budget keys
+        cm.add_comp(0x9000 + k, 0x100 + k)
+        cm.add_comp(0x9000 + k, 0x200 + k)
+    dmap = DeviceCompMap.from_comp_map(cm)
+    assert dmap.overflow is not None
+    assert list(dmap.overflow.m.keys()) == [0x1234]
+    assert len(dmap) == 12  # normal keys stayed on device
+    p = generate_prog(test_target, RandGen(test_target, 21), 2)
+    cpu_out: list[bytes] = []
+    dev_out: list[bytes] = []
+    mutate_with_hints(p, 0, cm, lambda m: cpu_out.append(serialize_prog(m)))
+    mutate_with_hints_device(p, 0, cm,
+                             lambda m: dev_out.append(serialize_prog(m)))
+    assert dev_out == cpu_out
+
+
+def test_fallback_rate_on_sim_trace_cmp(test_target):
+    """Measure how often real TRACE_CMP data from the sim kernel
+    overflows the per-key operand budget: the rate must be small
+    enough that the device path handles the bulk of real comps (the
+    observability VERDICT r3 item #9 asked for)."""
+    from syzkaller_tpu.fuzzer.proc import Proc  # noqa: F401
+    from syzkaller_tpu.ipc.env import ExecFlags, ExecOpts, make_env
+    from syzkaller_tpu.models.encodingexec import serialize_for_exec
+    from syzkaller_tpu.ops import hints as dhints
+
+    env = make_env(pid=0, sim=True, signal=True)
+    opts = ExecOpts(flags=ExecFlags.COLLECT_COMPS)
+    before = dict(dhints.FALLBACK_STATS)
+    maps = 0
+    try:
+        for seed in range(40):
+            p = generate_prog(test_target, RandGen(test_target, 100 + seed),
+                              4)
+            res = env.exec(opts, serialize_for_exec(p))
+            if res is None:
+                continue
+            for ci in res.info:
+                if not ci.comps:
+                    continue
+                cm = CompMap()
+                for a, b in ci.comps:
+                    cm.add_comp(a, b)
+                DeviceCompMap.from_comp_map(cm)
+                maps += 1
+    finally:
+        env.close()
+    assert maps > 10, "sim kernel produced no TRACE_CMP data"
+    keys = dhints.FALLBACK_STATS["keys"] - before["keys"]
+    overflow = dhints.FALLBACK_STATS["overflow_keys"] - before["overflow_keys"]
+    assert keys > 0
+    rate = overflow / keys
+    # the budget must cover the overwhelming majority of real keys
+    assert rate < 0.05, f"per-key overflow rate {rate:.1%} on sim comps"

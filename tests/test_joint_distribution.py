@@ -1,0 +1,145 @@
+"""Joint (per-draw sequence) op-distribution bound for the integrated
+PipelineMutator vs the CPU reference ladder (VERDICT r3 item #8).
+
+The round-3 parity test checked only the FIRST landed op's marginal.
+This one compares whole per-draw class patterns over >=10k draws.
+
+Known, architectural deviation (documented here and bounded below):
+a draw that lands a device-class op first returns an exec-ready
+device mutant immediately — the reference's continue-coin would
+sometimes additionally land a structural (squash/splice) op inside
+the same draw.  Decoding every device mutant back to a typed tree to
+apply that tail would forfeit the lazy-decode throughput the engine
+exists for, so device-first draws are device-pure by design.  Draws
+that land a structural op first DO compose into device classes via
+the CPU ladder, exactly as the reference does.
+
+(NB: landed-op rates are success-conditioned — squash/splice fail and
+redraw far more often than the raw ladder weights suggest, e.g.
+structural-first lands at ~8.5% not 20.8% on this corpus — so the
+bound below is computed from the reference sample itself, not from
+the ladder constants.)
+
+The test therefore asserts:
+  1. first-landed-op marginals match;
+  2. P(mixed | structural-first) matches the reference within
+     tolerance — the composition that IS implemented is faithful;
+  3. the pipeline's overall mixing equals the reference's
+     structural-first mixing (its only mixing source), i.e. the
+     whole deficit is the documented device-first tail and there is
+     no ADDITIONAL unexplained drift
+     (reference ladder: prog/mutation.go:17-131).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from syzkaller_tpu.fuzzer import Fuzzer, FuzzerConfig, WorkQueue
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.mutation import mutate_prog
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.models.target import get_target
+from syzkaller_tpu.signal import Signal
+from syzkaller_tpu.signal.cover import Cover
+
+STRUCTURAL = {"squash", "splice"}
+DEVICE = {"insert", "mutate_arg", "remove", "device"}
+
+
+def _pattern(seq: list[str]) -> str:
+    has_s = any(o in STRUCTURAL for o in seq)
+    has_d = any(o in DEVICE for o in seq)
+    if has_s and has_d:
+        return "mixed"
+    return "structural" if has_s else "device"
+
+
+def _first_class(seq: list[str]) -> str:
+    return "structural" if seq[0] in STRUCTURAL else "device"
+
+
+@pytest.mark.slow
+def test_joint_op_sequence_distribution():
+    pytest.importorskip("jax")
+    from syzkaller_tpu.fuzzer.proc import PipelineMutator
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
+
+    target = get_target("test", "64")
+    fuzzer = Fuzzer(target, wq=WorkQueue(), cfg=FuzzerConfig())
+    for i in range(8):
+        p = generate_prog(target, RandGen(target, 5000 + i), 4)
+        fuzzer.add_input_to_corpus(p, Signal({i: 1}), Cover())
+    corpus = [it.p for it in fuzzer.corpus_snapshot()]
+
+    n = 10_000
+
+    # Reference sample: the CPU ladder, sequences per draw.
+    ref_rng = RandGen(target, 777)
+    ref_seqs = []
+    for i in range(n):
+        ops: list[str] = []
+        q = corpus[i % len(corpus)].clone()
+        mutate_prog(q, ref_rng, 12, ct=fuzzer.ct, corpus=corpus,
+                    ops_out=ops)
+        if ops:
+            ref_seqs.append(ops)
+
+    # Integrated sample: PipelineMutator draws.
+    pl = DevicePipeline(target, capacity=64, batch_size=64, seed=11)
+    pm = PipelineMutator(pl, drain_timeout=300.0)
+    pm_rng = RandGen(target, 888)
+    pm_seqs = []
+    try:
+        for _ in range(n):
+            pm.ops_journal = journal = []
+            m = pm.next(fuzzer, pm_rng)
+            if m is not None and journal:
+                pm_seqs.append(list(journal))
+    finally:
+        pl.stop()
+
+    assert len(ref_seqs) > 9000 and len(pm_seqs) > 9000
+
+    def stats(seqs):
+        pats = {"structural": 0, "device": 0, "mixed": 0}
+        firsts = {"structural": 0, "device": 0}
+        mixed_given_struct_first = [0, 0]  # mixed, total
+        for s in seqs:
+            pats[_pattern(s)] += 1
+            fc = _first_class(s)
+            firsts[fc] += 1
+            if fc == "structural":
+                mixed_given_struct_first[1] += 1
+                if _pattern(s) == "mixed":
+                    mixed_given_struct_first[0] += 1
+        total = len(seqs)
+        return ({k: v / total for k, v in pats.items()},
+                {k: v / total for k, v in firsts.items()},
+                mixed_given_struct_first[0]
+                / max(1, mixed_given_struct_first[1]))
+
+    ref_pats, ref_firsts, ref_mix_sf = stats(ref_seqs)
+    pm_pats, pm_firsts, pm_mix_sf = stats(pm_seqs)
+
+    # 1. First-op marginals match (binomial tolerance at n=10k ~ 1.3%
+    #    at 3 sigma; use 3% to keep the test unflaky).
+    assert abs(ref_firsts["structural"] - pm_firsts["structural"]) < 0.03, \
+        (ref_firsts, pm_firsts)
+
+    # 2. The composition that IS implemented (structural-first draws
+    #    continuing into device classes) is faithful.
+    assert abs(ref_mix_sf - pm_mix_sf) < 0.06, (ref_mix_sf, pm_mix_sf)
+
+    # 3. The pipeline's only mixing source is structural-first draws:
+    #    its overall mixed share must equal the reference's
+    #    structural-first mixing contribution.  A larger gap in either
+    #    direction means an unexplained distribution bug (measured on
+    #    this corpus: ref mixed ~17%, of which ~5% structural-first —
+    #    the ~12% device-first tail is the documented deviation).
+    predicted_pm_mixed = ref_firsts["structural"] * ref_mix_sf
+    assert abs(pm_pats["mixed"] - predicted_pm_mixed) < 0.03, \
+        (ref_pats, pm_pats, ref_firsts, ref_mix_sf, predicted_pm_mixed)
+    # and the documented deficit itself stays bounded
+    deficit = ref_pats["mixed"] - pm_pats["mixed"]
+    assert deficit < 0.2, (ref_pats, pm_pats)

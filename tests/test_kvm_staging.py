@@ -1,0 +1,108 @@
+"""Full KVM guest staging (VERDICT r3 item #6): the executor's
+syz_kvm_setup_cpu long-mode path stages the guest through the real
+architectural bring-up — the vcpu starts in REAL mode at a trampoline
+that loads GDT/IDT from guest-memory descriptor tables, enables
+CR4.PAE, points CR3 at identity page tables, sets EFER.LME over
+wrmsr, turns on CR0.PG|PE, and far-jumps through the 64-bit GDT
+descriptor into the user text (reference model, not copied:
+executor/common_kvm_amd64.h + kvm.S).
+
+Verification layers (the live one needs /dev/kvm):
+ 1. the build must have KVM support compiled in (CI assert);
+ 2. the hand-assembled trampoline disassembles, via GNU binutils, to
+    exactly the documented staging sequence;
+ 3. live: a guest executes x86-table-generated long-mode text under
+    KVM_RUN — proven by a marker register read back via KVM_GET_REGS.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import subprocess
+import tempfile
+
+import pytest
+
+from syzkaller_tpu.ipc.env import build_executor
+
+PSEUDO_H = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "executor", "pseudo_linux.h")
+
+
+def _selftest(hex_text: str) -> subprocess.CompletedProcess:
+    binpath = build_executor()
+    return subprocess.run([str(binpath), "--selftest-kvm", hex_text],
+                          capture_output=True, text=True, timeout=60)
+
+
+def test_build_has_kvm_support():
+    """CI assert (VERDICT r3 weak #8): a header-less build would
+    silently lose syz_kvm_setup_cpu; the selftest mode reports that
+    state with exit code 2."""
+    res = _selftest("f4")
+    assert res.returncode != 2, "executor built without <linux/kvm.h>"
+    assert "built without" not in res.stderr
+
+
+def test_trampoline_is_the_staging_sequence():
+    """Disassemble the trampoline bytes with binutils in 16-bit mode
+    and assert the exact architectural bring-up order."""
+    src = open(PSEUDO_H).read()
+    m = re.search(r"static const uint8_t kKvmTramp\[\] = \{(.*?)\};",
+                  src, re.S)
+    assert m, "trampoline array not found"
+    body = re.sub(r"//[^\n]*", "", m.group(1))
+    blob = bytes(int(t, 16)
+                 for t in re.findall(r"0x([0-9a-fA-F]{2})\b", body))
+    with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
+        f.write(blob)
+        path = f.name
+    try:
+        out = subprocess.run(
+            ["objdump", "-D", "-b", "binary", "-m", "i386",
+             "-Maddr16,data16", path],
+            capture_output=True, text=True, timeout=30).stdout
+    finally:
+        os.unlink(path)
+    mnemonics = [ln.split("\t")[-1].split()[0]
+                 for ln in out.splitlines()
+                 if re.match(r"\s+[0-9a-f]+:", ln)]
+    want = ["cli", "lgdtl", "lidtl",
+            "mov", "or", "mov",          # CR4 |= PAE
+            "mov", "mov",                # CR3 = tables
+            "mov", "rdmsr", "or", "wrmsr",  # EFER |= LME
+            "mov", "or", "mov",          # CR0 |= PG|PE
+            "ljmpl"]                     # -> 64-bit code descriptor
+    assert mnemonics[:len(want)] == want, mnemonics
+    # the far jump must target the 64-bit code selector
+    assert "ljmpl  $0x8,$0x8000" in out
+
+
+def test_staged_long_mode_executes_generated_text():
+    """Live: table-generated long-mode text runs under KVM_RUN after
+    the real->long staging; a marker movabs at the head proves the
+    guest reached the user text (read back via KVM_GET_REGS)."""
+    if not os.path.exists("/dev/kvm"):
+        pytest.skip("no /dev/kvm")
+    from syzkaller_tpu.utils import x86
+
+    marker = 0x7A6B766D6B564D31  # arbitrary distinctive value
+    # movabs rbx, marker ; <generated long-mode insns> ; hlt-fill
+    text = b"\x48\xbb" + marker.to_bytes(8, "little")
+    cfg = x86.Config(mode=x86.LONG64, priv=False, avx=False, len_insns=4)
+    text += x86.generate(cfg, random.Random(42))
+    res = _selftest(text.hex())
+    assert res.returncode == 0, res.stderr
+    m = re.search(r"exit=(\d+) rip=0x([0-9a-f]+) rbx=0x([0-9a-f]+)",
+                  res.stdout)
+    assert m, res.stdout
+    # the marker can only be in rbx if the staged guest entered the
+    # user text in long mode (the movabs encoding is 64-bit-only)
+    assert int(m.group(3), 16) == marker, res.stdout
+    # exit 5 = KVM_EXIT_HLT (clean run into the hlt fill); generated
+    # instructions may fault first, which triple-faults into
+    # KVM_EXIT_SHUTDOWN (8) — both prove execution, the marker is the
+    # real assertion
+    assert int(m.group(1)) in (5, 8), res.stdout

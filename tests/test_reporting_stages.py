@@ -1,0 +1,138 @@
+"""Two-stage (moderation -> public) reporting with access levels
+(VERDICT r3 item #7; reference: dashboard/app/reporting.go Reporting
+lists + access.go levels).
+
+A bug in a namespace configured with [moderation(admin), public]
+flows: new -> reported@moderation (visible to admins only) ->
+'#syz upstream' email -> new@public -> reported@public (visible to
+everyone) -> '#syz fix:' -> fixed.  A second namespace with the
+legacy single public stage reports directly at public access.
+"""
+
+from __future__ import annotations
+
+from email.message import EmailMessage
+
+import pytest
+
+from syzkaller_tpu.dashboard.app import (
+    ACCESS_ADMIN,
+    ACCESS_PUBLIC,
+    ACCESS_USER,
+    STATUS_FIXED,
+    STATUS_NEW,
+    STATUS_REPORTED,
+    Dashboard,
+    ReportingStage,
+)
+from syzkaller_tpu.email import EmailReporting, Mailbox, parse_email
+
+
+@pytest.fixture
+def dash(tmp_path):
+    return Dashboard(
+        str(tmp_path),
+        clients={
+            "mod-mgr": {"key": "k1", "namespace": "moderated"},
+            "pub-mgr": {"key": "k2", "namespace": "open"},
+        },
+        reporting={
+            "moderated": [
+                ReportingStage("moderation", ACCESS_ADMIN, 0.0),
+                ReportingStage("public", ACCESS_PUBLIC, 0.0),
+            ],
+            # "open" gets the default single public stage
+        })
+
+
+def _crash(dash, client, key, title):
+    return dash.report_crash({
+        "client": client, "key": key, "manager": client,
+        "title": title, "log": "log", "report": "rep",
+    })["bug_id"]
+
+
+def _reply(reporting, commands, report_raw=None):
+    if report_raw is None:
+        report_raw = reporting.mailbox.outgoing[-1]
+    rep = parse_email(report_raw)
+    m = EmailMessage()
+    m["Subject"] = "Re: " + rep.subject
+    m["From"] = "moderator@kernel.org"
+    m["To"] = rep.from_addr
+    m["In-Reply-To"] = rep.msg_id
+    m["Message-ID"] = f"<r{len(reporting.mailbox.outgoing)}@k.org>"
+    m.set_content(commands + "\n")
+    reporting.mailbox.deliver(bytes(m))
+
+
+def test_moderation_to_public_flow(dash):
+    mbox = Mailbox()
+    reporting = EmailReporting(dash, mbox)
+    bug_id = _crash(dash, "mod-mgr", "k1", "KASAN: use-after-free in a")
+
+    bug = dash.bugs[bug_id]
+    assert bug.status == STATUS_NEW
+    assert dash.bug_stage(bug).name == "moderation"
+
+    # Stage 1: reported at moderation, admin-access only.
+    assert reporting.poll_and_send() == 1
+    bug = dash.bugs[bug_id]
+    assert bug.status == STATUS_REPORTED
+    assert bug.reporting_stage == "moderation"
+    assert dash.bug_access(bug) == ACCESS_ADMIN
+    admin_ids = {b.id for b in dash.visible_bugs(ACCESS_ADMIN)}
+    public_ids = {b.id for b in dash.visible_bugs(ACCESS_PUBLIC)}
+    user_ids = {b.id for b in dash.visible_bugs(ACCESS_USER)}
+    assert bug_id in admin_ids
+    assert bug_id not in public_ids and bug_id not in user_ids
+
+    # Moderator upstreams -> back to NEW at the public stage.
+    _reply(reporting, "#syz upstream")
+    assert reporting.process_incoming() == 1
+    bug = dash.bugs[bug_id]
+    assert bug.status == STATUS_NEW
+    assert dash.bug_stage(bug).name == "public"
+
+    # Stage 2: re-reported publicly with a fresh mail thread.
+    n_before = len(mbox.outgoing)
+    assert reporting.poll_and_send() == 1
+    bug = dash.bugs[bug_id]
+    assert bug.status == STATUS_REPORTED
+    assert bug.reporting_stage == "public"
+    assert dash.bug_access(bug) == ACCESS_PUBLIC
+    assert bug_id in {b.id for b in dash.visible_bugs(ACCESS_PUBLIC)}
+    assert len(mbox.outgoing) == n_before + 1  # new report mail
+
+    # Fix closes it from the public thread.
+    _reply(reporting, "#syz fix: net: fix uaf in a")
+    assert reporting.process_incoming() == 1
+    assert dash.bugs[bug_id].status == STATUS_FIXED
+
+
+def test_single_stage_namespace_reports_publicly(dash):
+    mbox = Mailbox()
+    reporting = EmailReporting(dash, mbox)
+    bug_id = _crash(dash, "pub-mgr", "k2", "WARNING in b")
+    assert reporting.poll_and_send() == 1
+    bug = dash.bugs[bug_id]
+    assert bug.reporting_stage == "public"
+    assert dash.bug_access(bug) == ACCESS_PUBLIC
+    assert bug_id in {b.id for b in dash.visible_bugs(ACCESS_PUBLIC)}
+    # upstream on a last-stage bug is a user error -> nack mail
+    _reply(reporting, "#syz upstream")
+    n_out = len(mbox.outgoing)
+    assert reporting.process_incoming() == 0
+    assert len(mbox.outgoing) == n_out + 1  # the nack
+    assert b"already at the last" in mbox.outgoing[-1]
+
+
+def test_two_namespaces_do_not_cross(dash):
+    mbox = Mailbox()
+    reporting = EmailReporting(dash, mbox)
+    a = _crash(dash, "mod-mgr", "k1", "BUG: t")
+    b = _crash(dash, "pub-mgr", "k2", "BUG: t")
+    assert a != b  # same title, different namespaces -> distinct bugs
+    assert reporting.poll_and_send() == 2
+    assert dash.bugs[a].reporting_stage == "moderation"
+    assert dash.bugs[b].reporting_stage == "public"
