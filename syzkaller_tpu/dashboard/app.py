@@ -53,6 +53,11 @@ class ReportingStage:
     access: str = ACCESS_PUBLIC
     delay_s: float = 0.0
 
+    def __post_init__(self):
+        if self.access not in _ACCESS_RANK:
+            raise ValueError(f"unknown access level {self.access!r} "
+                             f"(one of {sorted(_ACCESS_RANK)})")
+
 
 @dataclass
 class Build:
@@ -96,9 +101,12 @@ class Bug:
     reporting_stage: str = ""  # stage name at which last reported
     fix_commit: str = ""
     dup_of: str = ""
-    # Message-ID of the report mail; threads replies back to the bug
-    # across restarts (reference: reporting.go Reporting.ID).
+    # Message-IDs of the report mails (one per reporting stage);
+    # threads replies back to the bug across restarts — a reply to an
+    # older stage's thread must still resolve (reference:
+    # reporting.go Reporting.ID).
     report_msg_id: str = ""
+    report_msg_ids: list[str] = field(default_factory=list)
     crashes: list[Crash] = field(default_factory=list)
 
 
@@ -370,17 +378,25 @@ class Dashboard:
         return out
 
     def set_report_msg_id(self, bug_id: str, msg_id: str) -> None:
-        """Persist the report-mail threading id on the bug."""
+        """Persist the report-mail threading id on the bug (appended:
+        earlier stages' threads stay resolvable)."""
         with self._lock:
-            self.bugs[bug_id].report_msg_id = msg_id
+            bug = self.bugs[bug_id]
+            bug.report_msg_id = msg_id
+            if msg_id not in bug.report_msg_ids:
+                bug.report_msg_ids.append(msg_id)
             self._save()
 
     def report_threads(self) -> dict[str, str]:
         """msg_id -> bug_id map rebuilt from persisted bugs (restart
         recovery for the email reporting loop)."""
         with self._lock:
-            return {b.report_msg_id: b.id for b in self.bugs.values()
-                    if b.report_msg_id}
+            out = {}
+            for b in self.bugs.values():
+                for mid in b.report_msg_ids or \
+                        ([b.report_msg_id] if b.report_msg_id else []):
+                    out[mid] = b.id
+            return out
 
     def bug_report_payload(self, bug_id: str) -> dict:
         """Report-mail payload for a bug: title, counts, best repro
@@ -440,7 +456,9 @@ class Dashboard:
             nxt = stages[bug.reporting_idx]
             bug.status = STATUS_NEW
             bug.reporting_due = now + nxt.delay_s
-            bug.report_msg_id = ""  # next stage threads a fresh mail
+            # next stage threads a fresh mail; the moderation thread's
+            # id stays in report_msg_ids so late replies still resolve
+            bug.report_msg_id = ""
             self._save()
         return True
 

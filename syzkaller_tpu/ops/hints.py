@@ -56,12 +56,14 @@ class DeviceCompMap:
     the CPU path."""
 
     def __init__(self, keys: np.ndarray, vals: np.ndarray,
-                 nvals: np.ndarray, dropped: int,
+                 nvals: np.ndarray, overflow_operands: int,
                  overflow: Optional[CompMap] = None):
         self.keys = keys
         self.vals = vals
         self.nvals = nvals
-        self.dropped = dropped
+        # operands living in overflow keys — purely informational:
+        # exactness is preserved (those keys take the CPU supplement)
+        self.overflow_operands = overflow_operands
         self.overflow = overflow  # None = no overflowing keys
 
     @classmethod
@@ -69,13 +71,13 @@ class DeviceCompMap:
         all_keys = sorted(cm.m.keys())
         dev_keys = []
         overflow: Optional[CompMap] = None
-        dropped = 0
+        overflow_operands = 0
         for k in all_keys:
             if len(cm.m[k]) > vmax:
                 if overflow is None:
                     overflow = CompMap()
                 overflow.m[k] = set(cm.m[k])
-                dropped += len(cm.m[k]) - vmax
+                overflow_operands += len(cm.m[k])
             else:
                 dev_keys.append(k)
         FALLBACK_STATS["maps"] += 1
@@ -90,7 +92,7 @@ class DeviceCompMap:
             vs = sorted(cm.m[int(k)])
             vals[i, :len(vs)] = vs
             nvals[i] = len(vs)
-        return cls(keys, vals, nvals, dropped, overflow)
+        return cls(keys, vals, nvals, overflow_operands, overflow)
 
     def __len__(self) -> int:
         return len(self.keys)

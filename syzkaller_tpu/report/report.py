@@ -126,7 +126,9 @@ class Reporter:
         rep = Report(output=output, start_pos=start,
                      end_pos=min(len(output), start + len(region)),
                      report=region)
-        rep.title, corrupted_fmt = self._extract_title(region, oops)
+        guilty = self._guilty(region) if self._guilty is not None else ""
+        rep.title, corrupted_fmt = self._extract_title(region, oops,
+                                                       guilty)
         if any(s.search(rep.title.encode()) for s in self.suppressions):
             rep.suppressed = True
         if corrupted_fmt:
@@ -137,13 +139,13 @@ class Reporter:
             if reason:
                 rep.corrupted = True
                 rep.corrupted_reason = reason
-        if self._guilty is not None:
-            rep.guilty_file = self._guilty(region)
+        rep.guilty_file = guilty
         if self._attribution is not None:
             rep.guilty_src, rep.maintainers = self._attribution(region)
         return rep
 
-    def _extract_title(self, region: bytes, oops: Oops) -> tuple[str, bool]:
+    def _extract_title(self, region: bytes, oops: Oops,
+                       guilty: str = "") -> tuple[str, bool]:
         for f in oops.formats:
             m = f.report.search(region)
             if m is None and f.alt is not None:
@@ -152,12 +154,10 @@ class Reporter:
                 continue
             groups = [g.decode("utf-8", "replace") if g is not None else ""
                       for g in m.groups()]
-            if f.stack_title and self._guilty is not None:
+            if f.stack_title and guilty and groups:
                 # Title by the guilty stack frame; the regex capture
                 # (usually the comm name) is only the fallback.
-                frame = self._guilty(region)
-                if frame and groups:
-                    groups[-1] = frame
+                groups[-1] = guilty
             title = f.fmt
             for g in groups:
                 title = title.replace("%s", sanitize_symbol(g), 1)

@@ -6,13 +6,15 @@ Each OS target is described either via the Python builder API
 
   test/64   hermetic fake OS exercising every type-system feature
             (the unit-test target; reference: sys/test)
-  linux/amd64  subset of the linux model (grown over time)
+  linux/amd64  the linux model (1,458 syscall variants)
+  freebsd/amd64  compact FreeBSD model (multi-OS machinery proof)
   dsl/64    syzlang-compiled fake OS (exercises the description
             pipeline; compiled lazily from sys/descriptions/dsl)
 """
 
 from syzkaller_tpu.sys import testtarget  # noqa: F401  (registers test/64)
 from syzkaller_tpu.sys import linux  # noqa: F401  (registers linux/amd64)
+from syzkaller_tpu.sys import freebsd  # noqa: F401  (registers freebsd/amd64)
 from syzkaller_tpu.sys import sysgen
 
 sysgen.register_all()

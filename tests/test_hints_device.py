@@ -48,7 +48,7 @@ def test_shrink_expand_parity_random():
     for it in range(30):
         cm = _random_comp_map(rs, nkeys=rs.randint(1, 12))
         dmap = DeviceCompMap.from_comp_map(cm)
-        assert dmap.dropped == 0
+        assert dmap.overflow is None
         # Values: random, plus exact keys (hit path), plus truncations.
         vals = [int(rs.randint(0, 1 << 62)) for _ in range(6)]
         vals += [int(k) for k in list(cm.m.keys())[:6]]
@@ -114,7 +114,7 @@ def test_device_comp_map_overflow_falls_back(test_target):
     for i in range(40):  # one key, 40 operands > vmax=16
         cm.add_comp(0x1234, 0x1000 + i)
     dmap = DeviceCompMap.from_comp_map(cm)
-    assert dmap.dropped > 0
+    assert dmap.overflow is not None and dmap.overflow_operands == 40
     p = generate_prog(test_target, RandGen(test_target, 9), 2)
     cpu_out: list[bytes] = []
     dev_out: list[bytes] = []
