@@ -69,7 +69,8 @@ def journal_last_healthy() -> Optional[dict]:
         except ValueError:
             continue
         if e.get("metric") == "exec_ready_mutants_per_sec_per_chip" \
-                and e.get("value", 0) > 0:
+                and e.get("value", 0) > 0 and not e.get("platform"):
+            # platform-pinned (CPU) runs are not accelerator numbers
             return e
     return None
 
@@ -290,6 +291,19 @@ def device_preflight(timeout_s: float = 180.0, attempts: int = 2,
 
 def main() -> None:
     argv = sys.argv[1:]
+    # TZ_BENCH_PLATFORM=cpu pins jax to the host backend (the axon
+    # plugin ignores JAX_PLATFORMS; the config flag is honored) —
+    # used to record functional A/B artifacts while the tunneled
+    # device is wedged.  Results are labeled with the platform.
+    platform = os.environ.get("TZ_BENCH_PLATFORM", "")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+        # a pinned platform states the intent explicitly — probing the
+        # (possibly wedged) default accelerator would be wrong and slow
+        if "--no-preflight" not in argv:
+            argv.append("--no-preflight")
     if "--no-preflight" not in argv:
         reason = device_preflight(
             timeout_s=float(os.environ.get("TZ_BENCH_PREFLIGHT_TIMEOUT",
@@ -325,6 +339,8 @@ def main() -> None:
             if len(argv) > argv.index("--ab") + 1 else 20.0
         res = bench_ab_edges(secs)
         res["metric"] = "new_edges_sim_kernel_ab"
+        if platform:
+            res["platform"] = platform
         journal_append(res)
         print(json.dumps(res))
         return
@@ -352,6 +368,8 @@ def main() -> None:
                  "toolchain in the image to run the reference's own "
                  "tools/syz-mutate."),
     }
+    if platform:
+        result["platform"] = platform
     journal_append(result)
     print(json.dumps(result))
 

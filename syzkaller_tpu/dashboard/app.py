@@ -382,6 +382,11 @@ class Dashboard:
         earlier stages' threads stay resolvable)."""
         with self._lock:
             bug = self.bugs[bug_id]
+            # backfill a legacy single id (pre-list state files) so
+            # older threads keep resolving after this stage reports
+            if bug.report_msg_id and \
+                    bug.report_msg_id not in bug.report_msg_ids:
+                bug.report_msg_ids.append(bug.report_msg_id)
             bug.report_msg_id = msg_id
             if msg_id not in bug.report_msg_ids:
                 bug.report_msg_ids.append(msg_id)

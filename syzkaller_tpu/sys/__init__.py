@@ -8,6 +8,7 @@ Each OS target is described either via the Python builder API
             (the unit-test target; reference: sys/test)
   linux/amd64  the linux model (1,458 syscall variants)
   freebsd/amd64  compact FreeBSD model (multi-OS machinery proof)
+  netbsd/amd64   compact NetBSD model (model-only cross-OS target)
   dsl/64    syzlang-compiled fake OS (exercises the description
             pipeline; compiled lazily from sys/descriptions/dsl)
 """
@@ -15,6 +16,7 @@ Each OS target is described either via the Python builder API
 from syzkaller_tpu.sys import testtarget  # noqa: F401  (registers test/64)
 from syzkaller_tpu.sys import linux  # noqa: F401  (registers linux/amd64)
 from syzkaller_tpu.sys import freebsd  # noqa: F401  (registers freebsd/amd64)
+from syzkaller_tpu.sys import netbsd  # noqa: F401  (registers netbsd/amd64)
 from syzkaller_tpu.sys import sysgen
 
 sysgen.register_all()

@@ -1,0 +1,84 @@
+"""Shared arch-hook machinery for the BSD model targets.
+
+FreeBSD and NetBSD share the hook shape (MAP_ANON|MAP_PRIVATE|
+MAP_FIXED mmap with fd -1, kill-signal sanitizing); each OS module
+parameterizes this builder instead of copying it (the role the
+reference's per-OS init.go files play, factored once).
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.prog import (
+    Call,
+    ConstArg,
+    PointerArg,
+    make_return_arg,
+)
+from syzkaller_tpu.models.target import Target
+
+
+def load_bsd_consts(os_name: str) -> dict[str, int]:
+    from syzkaller_tpu.compiler.consts import load_const_files
+    from syzkaller_tpu.sys.sysgen import DESC_ROOT
+
+    return load_const_files(
+        str(p)
+        for p in sorted((DESC_ROOT / os_name).glob("*_amd64.const")))
+
+
+def make_bsd_target_builder(os_name: str, string_dictionary: list[str],
+                            kill_signals: tuple[int, ...] = (9, 17)):
+    """Returns a build_<os>_target(register=False) factory."""
+
+    def build(register: bool = False) -> Target:
+        from syzkaller_tpu.models.target import register_target
+        from syzkaller_tpu.sys.sysgen import compile_os
+
+        res = compile_os(os_name, "amd64", register=False)
+        t = res.target
+        _attach_hooks(t, load_bsd_consts(os_name), string_dictionary,
+                      kill_signals)
+        if register:
+            register_target(t)
+        return t
+
+    return build
+
+
+def _attach_hooks(t: Target, k: dict[str, int],
+                  string_dictionary: list[str],
+                  kill_signals: tuple[int, ...]) -> None:
+    t.string_dictionary = list(string_dictionary)
+
+    mmap_meta = next(c for c in t.syscalls if c.name == "mmap")
+    prot = k.get("PROT_READ", 1) | k.get("PROT_WRITE", 2)
+    mflags = (k.get("MAP_ANON", 0x1000) | k.get("MAP_PRIVATE", 2)
+              | k.get("MAP_FIXED", 0x10))
+
+    def make_mmap(addr: int, size: int) -> Call:
+        a = [
+            PointerArg.make_vma(mmap_meta.args[0], addr, size),
+            ConstArg(mmap_meta.args[1], size),
+            ConstArg(mmap_meta.args[2], prot),
+            ConstArg(mmap_meta.args[3], mflags),
+            ConstArg(mmap_meta.args[4], 0xFFFFFFFFFFFFFFFF),
+            ConstArg(mmap_meta.args[5], 0),
+        ]
+        return Call(meta=mmap_meta, args=a,
+                    ret=make_return_arg(mmap_meta.ret))
+
+    t.make_mmap = make_mmap
+
+    def sanitize(c: Call) -> None:
+        name = c.meta.call_name
+        if name == "kill":
+            sig = c.args[-1]
+            if isinstance(sig, ConstArg) and sig.val in kill_signals:
+                sig.val = 0
+        elif name == "exit":
+            code = c.args[0] if c.args else None
+            if isinstance(code, ConstArg) \
+                    and (code.val & 0xFF) in (67, 68, 69):
+                code.val = 1
+
+    t.sanitize_call = sanitize

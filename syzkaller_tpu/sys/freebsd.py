@@ -1,4 +1,4 @@
-"""freebsd/amd64 target: syzlang descriptions + arch hooks.
+"""freebsd/amd64 target: syzlang descriptions + BSD arch hooks.
 
 Second real-OS target proving the multi-OS machinery end to end
 (descriptions + const tables + arch hooks + registry), the role the
@@ -9,77 +9,18 @@ freebsd_amd64.const (see that file's provenance note).
 
 from __future__ import annotations
 
-from syzkaller_tpu.models.prog import (
-    Call,
-    ConstArg,
-    PointerArg,
-    make_return_arg,
-)
-from syzkaller_tpu.models.target import Target, register_lazy_target
+from syzkaller_tpu.models.target import register_lazy_target
+from syzkaller_tpu.sys.bsd import load_bsd_consts, make_bsd_target_builder
 
 
 def _load_consts() -> dict[str, int]:
-    from syzkaller_tpu.compiler.consts import load_const_files
-    from syzkaller_tpu.sys.sysgen import DESC_ROOT
-
-    return load_const_files(
-        str(p)
-        for p in sorted((DESC_ROOT / "freebsd").glob("*_amd64.const")))
+    return load_bsd_consts("freebsd")
 
 
-def build_freebsd_target(register: bool = False) -> Target:
-    from syzkaller_tpu.models.target import register_target
-    from syzkaller_tpu.sys.sysgen import compile_os
-
-    res = compile_os("freebsd", "amd64", register=False)
-    t = res.target
-    _attach_arch_hooks(t, _load_consts())
-    if register:
-        register_target(t)
-    return t
-
-
-def _attach_arch_hooks(t: Target, k: dict[str, int]) -> None:
-    t.string_dictionary = [
-        "/dev/null", "/dev/zero", "./file0", "./file1", "lo0", "em0",
-    ]
-
-    mmap_meta = next(c for c in t.syscalls if c.name == "mmap")
-    prot = k.get("PROT_READ", 1) | k.get("PROT_WRITE", 2)
-    # BSD anonymous mappings use MAP_ANON and fd -1
-    mflags = (k.get("MAP_ANON", 0x1000) | k.get("MAP_PRIVATE", 2)
-              | k.get("MAP_FIXED", 0x10))
-
-    def make_mmap(addr: int, size: int) -> Call:
-        a = [
-            PointerArg.make_vma(mmap_meta.args[0], addr, size),
-            ConstArg(mmap_meta.args[1], size),
-            ConstArg(mmap_meta.args[2], prot),
-            ConstArg(mmap_meta.args[3], mflags),
-            ConstArg(mmap_meta.args[4], 0xFFFFFFFFFFFFFFFF),
-            ConstArg(mmap_meta.args[5], 0),
-        ]
-        return Call(meta=mmap_meta, args=a,
-                    ret=make_return_arg(mmap_meta.ret))
-
-    t.make_mmap = make_mmap
-
-    sigkill = 9
-    sigstop = 17  # FreeBSD SIGSTOP
-
-    def sanitize(c: Call) -> None:
-        name = c.meta.call_name
-        if name == "kill":
-            sig = c.args[-1]
-            if isinstance(sig, ConstArg) and sig.val in (sigkill, sigstop):
-                sig.val = 0
-        elif name == "exit":
-            code = c.args[0] if c.args else None
-            if isinstance(code, ConstArg) \
-                    and (code.val & 0xFF) in (67, 68, 69):
-                code.val = 1
-
-    t.sanitize_call = sanitize
-
+build_freebsd_target = make_bsd_target_builder(
+    "freebsd",
+    string_dictionary=["/dev/null", "/dev/zero", "./file0", "./file1",
+                       "lo0", "em0"],
+    kill_signals=(9, 17))  # SIGKILL, SIGSTOP (BSD numbering)
 
 register_lazy_target("freebsd", "amd64", build_freebsd_target)

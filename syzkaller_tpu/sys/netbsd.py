@@ -1,0 +1,18 @@
+"""netbsd/amd64 target: syzlang descriptions + BSD arch hooks.
+
+Third OS target (model-only on this host — there is no NetBSD kernel
+to execute against here, exactly like cross-OS models in the
+reference tree).  See sys/descriptions/netbsd/*.txt for provenance.
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.target import register_lazy_target
+from syzkaller_tpu.sys.bsd import make_bsd_target_builder
+
+build_netbsd_target = make_bsd_target_builder(
+    "netbsd",
+    string_dictionary=["/dev/null", "./file0", "./file1", "lo0"],
+    kill_signals=(9, 17))
+
+register_lazy_target("netbsd", "amd64", build_netbsd_target)

@@ -71,3 +71,21 @@ def test_registered_next_to_linux():
     assert lt is not ft
     assert len({c.name for c in lt.syscalls}) != \
         len({c.name for c in ft.syscalls})
+
+
+def test_netbsd_target_compiles_and_roundtrips(iters):
+    """Third OS (model-only): NetBSD compiles with nothing disabled
+    and round-trips; NRs follow the NetBSD table (mmap=197)."""
+    from syzkaller_tpu.sys.sysgen import compile_os
+
+    res = compile_os("netbsd", "amd64", register=False)
+    assert res.disabled_calls == []
+    t = get_target("netbsd", "amd64")
+    by_name = {c.name: c for c in t.syscalls}
+    assert by_name["read"].nr == 3
+    assert by_name["mmap"].nr == 197  # NetBSD numbering, not BSD 477
+    for i in range(max(iters, 15)):
+        p = generate_prog(t, RandGen(t, 8800 + i), 6)
+        s = serialize_prog(p)
+        assert serialize_prog(deserialize_prog(t, s)) == s
+        serialize_for_exec(p)
