@@ -303,7 +303,7 @@ def main() -> None:
         # a pinned platform states the intent explicitly — probing the
         # (possibly wedged) default accelerator would be wrong and slow
         if "--no-preflight" not in argv:
-            argv.append("--no-preflight")
+            argv.insert(0, "--no-preflight")
     if "--no-preflight" not in argv:
         reason = device_preflight(
             timeout_s=float(os.environ.get("TZ_BENCH_PREFLIGHT_TIMEOUT",
