@@ -291,15 +291,14 @@ def device_preflight(timeout_s: float = 180.0, attempts: int = 2,
 
 def main() -> None:
     argv = sys.argv[1:]
-    # TZ_BENCH_PLATFORM=cpu pins jax to the host backend (the axon
-    # plugin ignores JAX_PLATFORMS; the config flag is honored) —
-    # used to record functional A/B artifacts while the tunneled
-    # device is wedged.  Results are labeled with the platform.
-    platform = os.environ.get("TZ_BENCH_PLATFORM", "")
-    if platform:
-        import jax
+    # TZ_BENCH_PLATFORM (or the shared TZ_JAX_PLATFORM) pins jax to a
+    # working backend — used to record functional A/B artifacts while
+    # the tunneled device is wedged.  Results are labeled with the
+    # platform.
+    from syzkaller_tpu.utils.jaxenv import pin_jax_platform
 
-        jax.config.update("jax_platforms", platform)
+    platform = pin_jax_platform(os.environ.get("TZ_BENCH_PLATFORM", ""))
+    if platform:
         # a pinned platform states the intent explicitly — probing the
         # (possibly wedged) default accelerator would be wrong and slow
         if "--no-preflight" not in argv:

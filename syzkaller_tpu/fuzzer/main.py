@@ -89,6 +89,13 @@ class FuzzerProcess:
 
         self.mutator = None
         if engine == "jax":
+            # TZ_JAX_PLATFORM lets a supervisor (e.g. the demo) pin
+            # fuzzer subprocesses to a working backend instead of a
+            # wedged tunnel (see utils/jaxenv.py for why env vars
+            # alone do not work).
+            from syzkaller_tpu.utils.jaxenv import pin_jax_platform
+
+            pin_jax_platform()
             from syzkaller_tpu.fuzzer.proc import PipelineMutator
             from syzkaller_tpu.ops.pipeline import DevicePipeline
 

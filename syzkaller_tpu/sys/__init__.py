@@ -6,7 +6,7 @@ Each OS target is described either via the Python builder API
 
   test/64   hermetic fake OS exercising every type-system feature
             (the unit-test target; reference: sys/test)
-  linux/amd64  the linux model (1,458 syscall variants)
+  linux/amd64  the linux model (1,487 syscall variants)
   freebsd/amd64  compact FreeBSD model (multi-OS machinery proof)
   netbsd/amd64   compact NetBSD model (model-only cross-OS target)
   dsl/64    syzlang-compiled fake OS (exercises the description

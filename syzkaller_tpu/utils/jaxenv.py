@@ -1,0 +1,36 @@
+"""Pin the jax backend by request.
+
+The tunneled-accelerator plugin ignores the JAX_PLATFORMS env var;
+only `jax.config.update("jax_platforms", ...)` is honored, and only
+BEFORE any backend initializes — afterwards the update is a silent
+no-op.  This helper is the one place implementing that dance
+(previously copied across bench.py / conftest / __graft_entry__ /
+fuzzer.main): it applies the pin and loudly warns when the pin could
+not take effect.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_VAR = "TZ_JAX_PLATFORM"
+
+
+def pin_jax_platform(platform: str = "") -> str:
+    """Pin jax to `platform` (or $TZ_JAX_PLATFORM when empty).
+    Returns the platform requested ("" = no pin).  Must run before
+    the first jax computation in the process."""
+    platform = platform or os.environ.get(ENV_VAR, "")
+    if not platform:
+        return ""
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    backend = jax.default_backend()
+    if backend != platform:
+        from syzkaller_tpu.utils import log
+
+        log.logf(0, "WARNING: jax backend is %r despite %s=%r — the "
+                    "pin ran after a backend initialized and was "
+                    "silently ignored", backend, ENV_VAR, platform)
+    return platform
