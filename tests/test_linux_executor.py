@@ -285,3 +285,48 @@ def test_real_inet6_mcast_group_req(linux_target):
                                      e.ENOBUFS)
     finally:
         env.close()
+
+
+def test_real_typed_netlink_families(linux_target):
+    """Round-4 family smoke: xfrm SA flush, audit status query, and a
+    traffic-shaping qdisc get run against the host kernel's netlink
+    stacks (families compiled out degrade to clean socket errnos)."""
+    import errno as e
+
+    from syzkaller_tpu.models.encoding import deserialize_prog
+
+    text = (
+        # xfrm: FLUSHSA (no payload body beyond proto byte)
+        b"r0 = socket$nl_xfrm(0x10, 0x3, 0x6)\n"
+        b"sendmsg$nl_xfrm(r0, &(0x7f0000000000)={0x0, 0x0, "
+        b"&(0x7f0000000100)={&(0x7f0000000200)=@flushsa={{0x18, 0x1c, "
+        b"0x1, 0x0, 0x0, 0x32}}, 0x18}}, 0x0)\n"
+        # audit: AUDIT_GET
+        b"r1 = socket$nl_audit(0x10, 0x3, 0x9)\n"
+        b"sendmsg$auditctl(r1, &(0x7f0000001000)={0x0, 0x0, "
+        b"&(0x7f0000001100)={&(0x7f0000001200)=@get={{0x10, 0x3e8, "
+        b"0x1, 0x0, 0x0}}, 0x10}}, 0x0)\n"
+        # tc: GETQDISC dump
+        b"r2 = socket$nl_route(0x10, 0x3, 0x0)\n"
+        b"sendmsg$nl_route_sched(r2, &(0x7f0000002000)={0x0, 0x0, "
+        b"&(0x7f0000002100)={&(0x7f0000002200)=@getqdisc={{0x24, 0x26, "
+        b"0x301, 0x0, 0x0, {0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0}}}, "
+        b"0x24}}, 0x0)\n"
+    )
+    p = deserialize_prog(linux_target, text)
+    env = make_env(0, sim=False)
+    try:
+        res = env.exec(ExecOpts(), serialize_for_exec(p))
+        assert res.completed
+        errs = [ci.errno for ci in res.info]
+        # sockets: 0 or family-not-built; sendmsgs on good sockets
+        # must be accepted by the framing layer (0 / EPERM / ENOENT,
+        # never a framing EINVAL when the socket opened)
+        ok_send = (0, e.EPERM, e.ENOENT, e.EOPNOTSUPP)
+        for sock_i, send_i in ((0, 1), (2, 3), (4, 5)):
+            assert errs[sock_i] in (0, e.EPROTONOSUPPORT,
+                                    e.EAFNOSUPPORT), errs
+            if errs[sock_i] == 0:
+                assert errs[send_i] in ok_send, errs
+    finally:
+        env.close()
