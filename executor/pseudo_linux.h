@@ -110,8 +110,9 @@ static int g_tun_fd = -1;
 
 static void setup_tun(uint64_t pid) {
   // Per-proc addressing is one byte wide (172.20.<pid>.1, MAC byte
-  // 5): mask so pid 257 does not alias pid 1's subnet or bleed into
-  // the second octet.
+  // 5): the mask keeps the octet valid for pids >255.  Procs 256
+  // apart therefore share a subnet — accepted, since proc counts
+  // stay far below 256 (reference uses the same single-octet scheme).
   pid &= 0xff;
   g_tun_fd = open("/dev/net/tun", O_RDWR | O_NONBLOCK);
   if (g_tun_fd < 0) {

@@ -183,7 +183,10 @@ class PipelineMutator:
                 if m is None:
                     self._note_drain_timeout()
                     return None
-                self._consec_timeouts = 0
+                with self._lock:
+                    # Reset under the lock: a racing _note_drain_timeout
+                    # must not overwrite this and demote one draw early.
+                    self._consec_timeouts = 0
                 if self.ops_journal is not None:
                     self.ops_journal.append("device")
                 return m

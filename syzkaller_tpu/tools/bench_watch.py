@@ -46,7 +46,81 @@ def probe(timeout_s: float = 240.0) -> bool:
     return res.returncode == 0 and "OK" in res.stdout
 
 
+def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
+    """On probe timeout: capture WHAT hangs, not just that it hangs.
+
+    Three layers, logged in order:
+    1. Python stack of the hung init (faulthandler dump while
+       jax.devices() blocks) — distinguishes backend-init vs dispatch.
+    2. The transport endpoint the axon plugin dials
+       (PALLAS_AXON_POOL_IPS : relay port) — TCP connect/greeting
+       behavior tells loopback-listener state from upstream state.
+    3. Who owns the listener (ss -tlnp), so 'wedged?' has a subject.
+    """
+    code = ("import faulthandler\n"
+            f"faulthandler.dump_traceback_later({stack_timeout_s - 5},"
+            " exit=True)\n"
+            "import jax\n"
+            "jax.devices()\n"
+            "print('DEVICES-OK')\n")
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=stack_timeout_s, cwd=REPO)
+        out = (res.stdout + res.stderr).strip()
+    except subprocess.TimeoutExpired as e:
+        out = ((e.stdout or b"").decode(errors="replace") +
+               (e.stderr or b"").decode(errors="replace")).strip()
+    if "DEVICES-OK" in out:
+        log("diagnose: backend init succeeded this time (transient)")
+        return
+    # Keep only the hang frames, not the jax import noise.
+    frames = [ln for ln in out.splitlines()
+              if "File \"" in ln or "Thread" in ln or "Timeout" in ln]
+    log("diagnose: hung init stack (innermost first):")
+    for ln in frames[:12]:
+        log(f"  {ln.strip()}")
+    pool_ip = os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0]
+    if pool_ip:
+        import socket
+        for port in (2024,):
+            try:
+                s = socket.socket()
+                s.settimeout(5)
+                s.connect((pool_ip, port))
+                s.settimeout(3)
+                try:
+                    data = s.recv(64)
+                    state = (f"connect ok, server sent {data!r}"
+                             if data else
+                             "connect ok, server closed immediately "
+                             "(EOF) — upstream/vsock bridge dead, "
+                             "listener is readiness-only")
+                except socket.timeout:
+                    state = ("connect ok, silent server (no greeting "
+                             "in 3s) — handshake peer absent")
+                s.close()
+            except OSError as e:
+                state = f"connect failed: {e}"
+            log(f"diagnose: {pool_ip}:{port} → {state}")
+    try:
+        res = subprocess.run(["ss", "-tlnp"], capture_output=True,
+                             text=True, timeout=10)
+        for ln in res.stdout.splitlines():
+            if ":2024" in ln:
+                log(f"diagnose: listener: {ln.strip()}")
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+
+
 def flagship_entries() -> int:
+    """On-chip flagship entries in the journal.
+
+    Mirrors bench.py's journal_last_healthy filter: entries carrying a
+    'platform' key are platform-pinned (e.g. TZ_BENCH_PLATFORM=cpu)
+    and must NOT satisfy --want — the watcher exists to record
+    *accelerator* measurements.
+    """
     path = os.path.join(REPO, "BENCH_HISTORY.jsonl")
     n = 0
     try:
@@ -57,7 +131,10 @@ def flagship_entries() -> int:
                 except ValueError:
                     continue
                 if e.get("metric") == "exec_ready_mutants_per_sec_per_chip" \
-                        and e.get("value", 0) > 0:
+                        and e.get("value", 0) > 0 \
+                        and not e.get("platform") \
+                        and not e.get("harness_artifact") \
+                        and not e.get("reconstructed"):
                     n += 1
     except OSError:
         pass
@@ -91,10 +168,14 @@ def main() -> None:
     ap.add_argument("--probe-interval", type=float, default=600.0)
     ap.add_argument("--measure-interval", type=float, default=900.0,
                     help="spacing between flagship measurements")
-    ap.add_argument("--round", type=int, default=4)
+    ap.add_argument("--round", type=int, default=5)
+    ap.add_argument("--diagnose-every", type=int, default=6,
+                    help="capture a full wedge diagnostic every N "
+                         "failed probes (0 = never)")
     opts = ap.parse_args()
 
     ab_path = os.path.join(REPO, f"BENCH_AB_r{opts.round:02d}.json")
+    failed_probes = 0
     while True:
         have = flagship_entries()
         ab_done = os.path.exists(ab_path)
@@ -103,9 +184,15 @@ def main() -> None:
                 "leaving the chip alone")
             return
         if not probe():
-            log("device wedged/unreachable; retrying later")
+            failed_probes += 1
+            log(f"device wedged/unreachable (probe #{failed_probes}); "
+                "retrying later")
+            if opts.diagnose_every and \
+                    failed_probes % opts.diagnose_every == 1:
+                diagnose_wedge()
             time.sleep(opts.probe_interval)
             continue
+        failed_probes = 0
         log("device healthy")
         # Priority: one flagship first (proves the chip), then the
         # never-yet-recorded A/B artifact, then the remaining flagship
