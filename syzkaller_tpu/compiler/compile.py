@@ -590,9 +590,21 @@ class Compiler:
                           size=size)
 
     def _declare_calls(self) -> None:
+        # Auto-numbering exists for NR-less description sets (the
+        # hermetic test target).  When the const set carries a real
+        # __NR_ table, a missing entry means the arch genuinely lacks
+        # the syscall (e.g. open/fork on arm64's generic table) — the
+        # call must be disabled, not silently given a fake number.
+        have_nr_table = any(k.startswith("__NR_") for k in self.consts)
         for c in self.calls:
             nr = self.consts.get(f"__NR_{c.call_name}")
             if nr is None:
+                if have_nr_table and not c.call_name.startswith("syz_"):
+                    self.disabled.append(c.name)
+                    self.warnings.append(
+                        f"{c.pos}: {c.name} disabled: no __NR_"
+                        f"{c.call_name} on this arch")
+                    continue
                 nr = self.auto_nr
                 self.auto_nr += 1
             try:

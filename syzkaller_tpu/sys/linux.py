@@ -21,24 +21,32 @@ from syzkaller_tpu.models.prog import (
 from syzkaller_tpu.models.target import Target, register_lazy_target
 
 
-def _load_consts() -> dict[str, int]:
+def _load_consts(arch: str = "amd64") -> dict[str, int]:
     from syzkaller_tpu.compiler.consts import load_const_files
     from syzkaller_tpu.sys.sysgen import DESC_ROOT
 
     return load_const_files(
-        str(p) for p in sorted((DESC_ROOT / "linux").glob("*_amd64.const")))
+        str(p) for p in sorted((DESC_ROOT / "linux").glob(f"*_{arch}.const")))
 
 
-def build_linux_target(register: bool = False) -> Target:
+def build_linux_target(register: bool = False, arch: str = "amd64") -> Target:
     from syzkaller_tpu.models.target import register_target
     from syzkaller_tpu.sys.sysgen import compile_os
 
-    res = compile_os("linux", "amd64", register=False)
+    res = compile_os("linux", arch, register=False)
     t = res.target
-    _attach_arch_hooks(t, _load_consts())
+    _attach_arch_hooks(t, _load_consts(arch))
     if register:
         register_target(t)
     return t
+
+
+def build_linux_arm64_target(register: bool = False) -> Target:
+    """linux/arm64: same descriptions, arm64's own syscall-number table
+    (generic unistd) — legacy x86-only calls (open, fork, epoll_wait,
+    ...) are compiled disabled, as on the reference's arm64 target
+    (reference: sys/linux/gen/arm64.go built from per-arch .const)."""
+    return build_linux_target(register=register, arch="arm64")
 
 
 def _attach_arch_hooks(t: Target, k: dict[str, int]) -> None:
@@ -101,3 +109,4 @@ def _attach_arch_hooks(t: Target, k: dict[str, int]) -> None:
 
 
 register_lazy_target("linux", "amd64", build_linux_target)
+register_lazy_target("linux", "arm64", build_linux_arm64_target)
