@@ -1,0 +1,37 @@
+// BSD-backend pseudo-syscall layer.
+//
+// The FreeBSD/NetBSD description trees declare no syz_* pseudo calls
+// (sys/descriptions/freebsd, sys/descriptions/netbsd), so this layer
+// is a clean ENOSYS fallback that keeps the dispatch contract of
+// pseudo_linux.h's execute_pseudo: any pseudo NR that reaches a BSD
+// executor answers -ENOSYS instead of being thrown at syscall(2)
+// (where the 0x81000000 NR range would be meaningless).  Environment
+// hooks are no-ops: no netns/TUN/cgroup analog is set up — the BSD
+// sandbox story is the setuid drop in executor.cc's
+// apply_sandbox_and_env (reference analog: executor/common_bsd.h,
+// which is similarly thin next to common_linux.h).
+
+#ifndef TZ_EXECUTOR_PSEUDO_BSD_H
+#define TZ_EXECUTOR_PSEUDO_BSD_H
+
+#if defined(TZ_BSD)
+
+#include <errno.h>
+
+namespace tz {
+
+static long execute_pseudo(uint32_t nr, const uint64_t* args, int nargs) {
+  (void)args;
+  (void)nargs;
+  debugf("pseudo: nr 0x%x unsupported on BSD backend\n", nr);
+  return -ENOSYS;
+}
+
+static void pseudo_cleanup() {}
+static void pseudo_parent_sweep() {}
+static void pseudo_init_mount_root() {}
+
+}  // namespace tz
+
+#endif  // TZ_BSD
+#endif  // TZ_EXECUTOR_PSEUDO_BSD_H

@@ -17,7 +17,7 @@
 #ifndef TZ_EXECUTOR_PSEUDO_LINUX_H
 #define TZ_EXECUTOR_PSEUDO_LINUX_H
 
-#if defined(__linux__)
+#if defined(__linux__) && !defined(TZ_OS_FREEBSD)
 
 #include <arpa/inet.h>
 #include <fcntl.h>
@@ -727,5 +727,5 @@ static long execute_pseudo(uint32_t nr, const uint64_t* a, int nargs) {
 
 }  // namespace tz
 
-#endif  // __linux__
+#endif  // __linux__ && !TZ_OS_FREEBSD
 #endif  // TZ_EXECUTOR_PSEUDO_LINUX_H

@@ -101,7 +101,12 @@ class _Renderer:
 
     def render(self) -> str:
         header = _HEADER
-        if self.target.os == "linux":
+        if self.target.os in ("linux", "freebsd", "netbsd"):
+            # real-OS backends share the raw-syscall(2) rendering; the
+            # namespace/TUN/cgroup helpers in the templates are
+            # __linux__-guarded so the same output compiles on a BSD
+            # toolchain (reference analog: per-OS common_*.h split,
+            # executor/common_bsd.h)
             backend = _LINUX_BACKEND
         else:
             backend = _SIM_BACKEND
@@ -244,8 +249,8 @@ class _Renderer:
             call = f"{c.meta.call_name}("
             call += ", ".join(f"(long)({a})" for a in args)
             call += ")"
-        elif self.target.os == "linux":
-            call = f"syscall({c.meta.nr}"
+        elif self.target.os in ("linux", "freebsd", "netbsd"):
+            call = f"tz_syscall({c.meta.nr}"
             if args:
                 call += ", " + ", ".join(args)
             call += ")"
@@ -329,7 +334,18 @@ class _Renderer:
 
 _HEADER = r"""// autogenerated C reproducer
 #define _GNU_SOURCE
+#if defined(__FreeBSD__) || defined(__NetBSD__)
+#include <sys/endian.h>
+#else
 #include <endian.h>
+#endif
+// FreeBSD's syscall(2) returns int — 64-bit results (mmap addresses,
+// lseek offsets) would truncate; __syscall is the 64-bit-clean form.
+#if defined(__FreeBSD__)
+#define tz_syscall __syscall
+#else
+#define tz_syscall syscall
+#endif
 #include <errno.h>
 #include <fcntl.h>
 #include <setjmp.h>

@@ -1,0 +1,62 @@
+"""FreeBSD execution capability (VERDICT r4 ask #4): the executor's
+BSD backend type-checks end to end and csource renders BSD-buildable
+C for freebsd-target programs.
+
+No FreeBSD host or sysroot exists in this image, so the contract
+verified here is the one the ask names: the executor BUILDS against a
+FreeBSD-selecting compile (the TZ_OS_FREEBSD force-flag compiles the
+exact code path __FreeBSD__ selects; its surface is plain POSIX), and
+a freebsd-targeted csource compiles cleanly.  Execution on a real BSD
+host stays untested, loudly (reference analog: per-OS executor builds
+via sys/targets cflags, reference Makefile:139-144 +
+executor/common_bsd.h)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+import pytest
+
+from syzkaller_tpu.csource.csource import Options, write_csource
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.models.target import get_target
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_executor_freebsd_backend_typechecks():
+    res = subprocess.run(["make", "freebsd-check"],
+                         cwd=os.path.join(REPO, "executor"),
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout
+
+
+def test_freebsd_csource_renders_and_compiles(tmp_path):
+    target = get_target("freebsd", "amd64")
+    p = generate_prog(target, RandGen(target, 11), 6)
+    src = write_csource(p, Options(repeat=False)).decode()
+    # raw-syscall rendering (via the 64-bit-clean tz_syscall shim),
+    # no linux pseudo bodies
+    assert "tz_syscall(" in src
+    assert "sim_call(" not in src
+    assert "__NR_" not in src  # numeric NRs: no libc syscall-name dep
+    path = str(tmp_path / "tz_bsd_repro.c")
+    with open(path, "w") as f:
+        f.write(src)
+    # Host gcc syntax pass: the output's only OS-conditional include
+    # is the endian header; everything else is portable POSIX, so a
+    # clean host compile is a faithful proxy for the BSD cc pass.
+    res = subprocess.run(
+        ["gcc", "-fsyntax-only", "-Wall", path],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+
+
+def test_netbsd_csource_renders():
+    target = get_target("netbsd", "amd64")
+    p = generate_prog(target, RandGen(target, 13), 6)
+    src = write_csource(p, Options()).decode()
+    assert "tz_syscall(" in src and "sim_call(" not in src
