@@ -6,9 +6,12 @@ pkg/ifuzz/generated/insns.go generated table, pkg/ifuzz/pseudo.go
 hand-written system sequences).  We build the same capability from a
 compact declarative opcode-map spec (NASM/SDM-style lines, parsed at
 import into Insn records) instead of shipping a 100k-line generated
-literal: the spec below covers the full one-byte opcode map, the bulk
-of the 0F map (system, conditional, bit, string, MMX/SSE), 0F38/0F3A
-entries, VEX-encoded AVX forms, and the VMX/SVM virtualization sets.
+literal: ~1,600 instructions covering the full one-byte map, the 0F
+map with its 66/F3/F2 mandatory-prefix planes (SSE2/SSE3 scalar+
+packed), x87 (memory groups, register families, control ops),
+SSSE3/SSE4 via 0F38/0F3A with prefixes, AES/SHA/CLMUL, the VMX/SVM
+virtualization sets, BMI1/2, the VEX AVX/AVX2/FMA planes, and an
+EVEX AVX-512-foundation plane.
 
 Three capabilities mirror the reference API:
   * generate(cfg, r)  - emit one structurally-valid instruction
@@ -47,6 +50,7 @@ VEX = 2        # VEX-encoded (AVX)
 MEMONLY = 4    # modrm must encode memory (mod != 3)
 REGONLY = 8    # modrm must encode a register (mod == 3)
 D64 = 16       # default 64-bit operand size in long mode (push/pop/jmp)
+EVEX = 32      # EVEX-encoded (AVX-512)
 
 IMM_TOKENS = ("ib", "iw", "id", "iz", "iv", "cb", "cz", "mo")
 
@@ -62,10 +66,17 @@ class Insn:
     modrm: bool = False
     reg: int = -1          # /digit for groups, -1 for /r
     imms: tuple = ()
+    mprefix: int = 0       # mandatory prefix byte (0x66/0xF3/0xF2)
+                           # — VEX specs encode it as the pp field
 
     @property
     def priv(self) -> bool:
         return bool(self.flags & PRIV)
+
+
+#: SDM mandatory-prefix tokens → prefix byte (pp field for VEX)
+_MPREFIX = {"p66": 0x66, "pF3": 0xF3, "pF2": 0xF2}
+_PP = {0: 0, 0x66: 1, 0xF3: 2, 0xF2: 3}
 
 
 def _parse_spec(name: str, enc: str, modes: int, flags: int = 0) -> Insn:
@@ -74,6 +85,7 @@ def _parse_spec(name: str, enc: str, modes: int, flags: int = 0) -> Insn:
     reg = -1
     imms = []
     vexmap = 0
+    mprefix = 0
     for tok in enc.split():
         if tok == "/r":
             modrm = True
@@ -87,13 +99,19 @@ def _parse_spec(name: str, enc: str, modes: int, flags: int = 0) -> Insn:
             flags |= MEMONLY
         elif tok == "rr":
             flags |= REGONLY
+        elif tok in _MPREFIX:
+            mprefix = _MPREFIX[tok]
+        elif tok in ("e0F", "e0F38", "e0F3A"):
+            flags |= EVEX
+            vexmap = {"e0F": 1, "e0F38": 2, "e0F3A": 3}[tok]
         elif tok.startswith("v"):
             flags |= VEX
             vexmap = {"v0F": 1, "v0F38": 2, "v0F3A": 3}[tok]
         else:
             opcode.append(int(tok, 16))
     return Insn(name, modes, flags, bytes(opcode), vexmap=vexmap,
-                plusr=plusr, modrm=modrm, reg=reg, imms=tuple(imms))
+                plusr=plusr, modrm=modrm, reg=reg, imms=tuple(imms),
+                mprefix=mprefix)
 
 
 # -- the opcode-map spec ----------------------------------------------
@@ -222,8 +240,6 @@ _s("aam", "D4 ib", NO64)
 _s("aad", "D5 ib", NO64)
 _s("salc", "D6", NO64)
 _s("xlat", "D7", ALL)
-for b in range(0xD8, 0xE0):  # x87: every D8-DF takes a modrm
-    _s("x87", f"{b:02X} /r", ALL)
 _s("loopne", "E0 cb", ALL)
 _s("loope", "E1 cb", ALL)
 _s("loop", "E2 cb", ALL)
@@ -480,6 +496,390 @@ for b, nm in [(0x0F, "vpalignr"), (0x4A, "vblendvps"), (0x18, "vinsertf128"),
               (0x19, "vextractf128")]:
     _s(nm, f"v0F3A {b:02X} /r ib", _VEXM)
 
+# ---- r5 expansion: mandatory-prefix SSE planes, x87, wide VEX -------
+# (SDM volume 2 opcode maps; the p66/pF3/pF2 tokens are the mandatory
+# prefixes, riding the VEX.pp field for v-forms.)
+
+# 66-prefixed 0F map: the packed-double + integer-SSE2 plane.
+_SSE2_66_0F = [
+    (0x10, "movupd"), (0x11, "movupd"), (0x12, "movlpd_m"), (0x13, "movlpd_m"),
+    (0x14, "unpcklpd"), (0x15, "unpckhpd"), (0x16, "movhpd_m"),
+    (0x17, "movhpd_m"), (0x28, "movapd"), (0x29, "movapd"),
+    (0x2A, "cvtpi2pd"), (0x2B, "movntpd"), (0x2C, "cvttpd2pi"),
+    (0x2D, "cvtpd2pi"), (0x2E, "ucomisd"), (0x2F, "comisd"),
+    (0x51, "sqrtpd"), (0x54, "andpd"), (0x55, "andnpd"), (0x56, "orpd"),
+    (0x57, "xorpd"), (0x58, "addpd"), (0x59, "mulpd"),
+    (0x5A, "cvtpd2ps"), (0x5B, "cvtps2dq"), (0x5C, "subpd"),
+    (0x5D, "minpd"), (0x5E, "divpd"), (0x5F, "maxpd"),
+    (0x60, "punpcklbw"), (0x61, "punpcklwd"), (0x62, "punpckldq"),
+    (0x63, "packsswb"), (0x64, "pcmpgtb"), (0x65, "pcmpgtw"),
+    (0x66, "pcmpgtd"), (0x67, "packuswb"), (0x68, "punpckhbw"),
+    (0x69, "punpckhwd"), (0x6A, "punpckhdq"), (0x6B, "packssdw"),
+    (0x6C, "punpcklqdq"), (0x6D, "punpckhqdq"), (0x6E, "movd_x"),
+    (0x6F, "movdqa"), (0x74, "pcmpeqb"), (0x75, "pcmpeqw"),
+    (0x76, "pcmpeqd"), (0x7C, "haddpd"), (0x7D, "hsubpd"),
+    (0x7E, "movd_x"), (0x7F, "movdqa"), (0xD0, "addsubpd"),
+    (0xD1, "psrlw_x"), (0xD2, "psrld_x"), (0xD3, "psrlq_x"),
+    (0xD4, "paddq_x"), (0xD5, "pmullw_x"), (0xD8, "psubusb_x"),
+    (0xD9, "psubusw_x"), (0xDA, "pminub_x"), (0xDB, "pand_x"),
+    (0xDC, "paddusb_x"), (0xDD, "paddusw_x"), (0xDE, "pmaxub_x"),
+    (0xDF, "pandn_x"), (0xE0, "pavgb_x"), (0xE1, "psraw_x"),
+    (0xE2, "psrad_x"), (0xE3, "pavgw_x"), (0xE4, "pmulhuw_x"),
+    (0xE5, "pmulhw_x"), (0xE6, "cvttpd2dq"), (0xE7, "movntdq"),
+    (0xE8, "psubsb_x"), (0xE9, "psubsw_x"), (0xEA, "pminsw_x"),
+    (0xEB, "por_x"), (0xEC, "paddsb_x"), (0xED, "paddsw_x"),
+    (0xEE, "pmaxsw_x"), (0xEF, "pxor_x"), (0xF1, "psllw_x"),
+    (0xF2, "pslld_x"), (0xF3, "psllq_x"), (0xF4, "pmuludq_x"),
+    (0xF5, "pmaddwd_x"), (0xF6, "psadbw_x"), (0xF8, "psubb_x"),
+    (0xF9, "psubw_x"), (0xFA, "psubd_x"), (0xFB, "psubq_x"),
+    (0xFC, "paddb_x"), (0xFD, "paddw_x"), (0xFE, "paddd_x"),
+]
+_SSE2_MEMONLY = {"movlpd_m", "movhpd_m", "movntpd", "movntdq"}
+for b, nm in _SSE2_66_0F:
+    suffix = " m" if nm in _SSE2_MEMONLY else ""
+    _s(nm, f"p66 0F {b:02X} /r{suffix}", ALL)
+_s("movmskpd", "p66 0F 50 /r rr", ALL)
+_s("pshufd", "p66 0F 70 /r ib", ALL)
+for grp, ops in ((0x71, (2, 4, 6)), (0x72, (2, 4, 6)), (0x73, (2, 3, 6, 7))):
+    for d in ops:
+        _s(f"pshift_{grp:02X}_{d}", f"p66 0F {grp:02X} /{d} rr ib", ALL)
+_s("cmppd", "p66 0F C2 /r ib", ALL)
+_s("pinsrw_x", "p66 0F C4 /r ib", ALL)
+_s("pextrw_x", "p66 0F C5 /r rr ib", ALL)
+_s("shufpd", "p66 0F C6 /r ib", ALL)
+_s("movq_x", "p66 0F D6 /r", ALL)
+_s("pmovmskb_x", "p66 0F D7 /r rr", ALL)
+
+# F3-prefixed 0F map: scalar-single + misc.
+_SSE_F3_0F = [
+    (0x10, "movss"), (0x11, "movss"), (0x12, "movsldup"),
+    (0x16, "movshdup"), (0x2A, "cvtsi2ss"), (0x2C, "cvttss2si"),
+    (0x2D, "cvtss2si"), (0x51, "sqrtss"), (0x52, "rsqrtss"),
+    (0x53, "rcpss"), (0x58, "addss"), (0x59, "mulss"),
+    (0x5A, "cvtss2sd"), (0x5B, "cvttps2dq"), (0x5C, "subss"),
+    (0x5D, "minss"), (0x5E, "divss"), (0x5F, "maxss"),
+    (0x6F, "movdqu"), (0x7E, "movq_f3"), (0x7F, "movdqu"),
+    (0xB8, "popcnt"), (0xBC, "tzcnt"), (0xBD, "lzcnt"),
+    (0xE6, "cvtdq2pd"),
+]
+for b, nm in _SSE_F3_0F:
+    _s(nm, f"pF3 0F {b:02X} /r", ALL)
+_s("pshufhw", "pF3 0F 70 /r ib", ALL)
+_s("cmpss", "pF3 0F C2 /r ib", ALL)
+_s("movq2dq", "pF3 0F D6 /r rr", ALL)
+
+# F2-prefixed 0F map: scalar-double + misc.
+_SSE_F2_0F = [
+    (0x10, "movsd_x"), (0x11, "movsd_x"), (0x12, "movddup"),
+    (0x2A, "cvtsi2sd"), (0x2C, "cvttsd2si"), (0x2D, "cvtsd2si"),
+    (0x51, "sqrtsd"), (0x58, "addsd"), (0x59, "mulsd"),
+    (0x5A, "cvtsd2ss"), (0x5C, "subsd"), (0x5D, "minsd"),
+    (0x5E, "divsd"), (0x5F, "maxsd"), (0x7C, "haddps"),
+    (0x7D, "hsubps"), (0xD0, "addsubps"), (0xE6, "cvtpd2dq"),
+]
+for b, nm in _SSE_F2_0F:
+    _s(nm, f"pF2 0F {b:02X} /r", ALL)
+_s("pshuflw", "pF2 0F 70 /r ib", ALL)
+_s("cmpsd_x", "pF2 0F C2 /r ib", ALL)
+_s("movdq2q", "pF2 0F D6 /r rr", ALL)
+_s("lddqu", "pF2 0F F0 /r m", ALL)
+
+# legacy 0F leftovers: bswap + the reserved hint-nop block
+for b in range(0x19, 0x1F):
+    _s("hint_nop", f"0F {b:02X} /r", ALL)
+# CET end-branch markers (F3 0F 1E FA/FB fixed forms)
+_s("endbr64", "pF3 0F 1E FB", ALL)
+_s("endbr32", "pF3 0F 1E FA", ALL)
+
+# fsgsbase group (F3 0F AE /0-/3, long mode only)
+for d, nm in ((0, "rdfsbase"), (1, "rdgsbase"), (2, "wrfsbase"),
+              (3, "wrgsbase")):
+    _s(nm, f"pF3 0F AE /{d} rr", X64)
+
+# 66 0F38: SSSE3/SSE4 xmm plane (the no-prefix forms are the MMX duals
+# already in the table) + AES-NI + adcx/adox + F2 crc32.
+_SSE4_66_0F38 = [
+    (0x00, "pshufb_x"), (0x01, "phaddw_x"), (0x02, "phaddd_x"),
+    (0x03, "phaddsw_x"), (0x04, "pmaddubsw_x"), (0x05, "phsubw_x"),
+    (0x06, "phsubd_x"), (0x07, "phsubsw_x"), (0x08, "psignb_x"),
+    (0x09, "psignw_x"), (0x0A, "psignd_x"), (0x0B, "pmulhrsw_x"),
+    (0x10, "pblendvb"), (0x14, "blendvps"), (0x15, "blendvpd"),
+    (0x17, "ptest"), (0x1C, "pabsb_x"), (0x1D, "pabsw_x"),
+    (0x1E, "pabsd_x"), (0x20, "pmovsxbw"), (0x21, "pmovsxbd"),
+    (0x22, "pmovsxbq"), (0x23, "pmovsxwd"), (0x24, "pmovsxwq"),
+    (0x25, "pmovsxdq"), (0x28, "pmuldq"), (0x29, "pcmpeqq"),
+    (0x2B, "packusdw"), (0x30, "pmovzxbw"), (0x31, "pmovzxbd"),
+    (0x32, "pmovzxbq"), (0x33, "pmovzxwd"), (0x34, "pmovzxwq"),
+    (0x35, "pmovzxdq"), (0x37, "pcmpgtq"), (0x38, "pminsb"),
+    (0x39, "pminsd"), (0x3A, "pminuw"), (0x3B, "pminud"),
+    (0x3C, "pmaxsb"), (0x3D, "pmaxsd"), (0x3E, "pmaxuw"),
+    (0x3F, "pmaxud"), (0x40, "pmulld"), (0x41, "phminposuw"),
+    (0xDB, "aesimc"), (0xDC, "aesenc"), (0xDD, "aesenclast"),
+    (0xDE, "aesdec"), (0xDF, "aesdeclast"), (0xF6, "adcx"),
+]
+for b, nm in _SSE4_66_0F38:
+    _s(nm, f"p66 0F 38 {b:02X} /r", ALL)
+_s("movntdqa", "p66 0F 38 2A /r m", ALL)
+_s("adox", "pF3 0F 38 F6 /r", ALL)
+_s("crc32_8", "pF2 0F 38 F0 /r", ALL)
+_s("crc32", "pF2 0F 38 F1 /r", ALL)
+
+# 66 0F3A: SSE4 immediates + PCLMUL + AES keygen.
+_SSE4_66_0F3A = [
+    (0x08, "roundps"), (0x09, "roundpd"), (0x0A, "roundss"),
+    (0x0B, "roundsd"), (0x0C, "blendps"), (0x0D, "blendpd"),
+    (0x0E, "pblendw"), (0x0F, "palignr_x"), (0x14, "pextrb"),
+    (0x15, "pextrw_sse4"), (0x16, "pextrd"), (0x17, "extractps"),
+    (0x20, "pinsrb"), (0x21, "insertps"), (0x22, "pinsrd"),
+    (0x40, "dpps"), (0x41, "dppd"), (0x42, "mpsadbw"),
+    (0x44, "pclmulqdq"), (0x60, "pcmpestrm"), (0x61, "pcmpestri"),
+    (0x62, "pcmpistrm"), (0x63, "pcmpistri"), (0xDF, "aeskeygenassist"),
+]
+for b, nm in _SSE4_66_0F3A:
+    _s(nm, f"p66 0F 3A {b:02X} /r ib", ALL)
+
+# x87: the eight escape bytes as full modrm groups (mem forms) — the
+# register encodings (mod=3) flow through the same group for decode
+# lengths; the named reg families below are generation-side spellings.
+_X87_GROUPS = {
+    0xD8: ["fadd", "fmul", "fcom", "fcomp", "fsub", "fsubr", "fdiv",
+           "fdivr"],
+    0xD9: ["fld", "fxch_g", "fst", "fstp", "fldenv", "fldcw",
+           "fnstenv", "fnstcw"],
+    0xDA: ["fiadd", "fimul", "ficom", "ficomp", "fisub", "fisubr",
+           "fidiv", "fidivr"],
+    0xDB: ["fild", "fisttp", "fist", "fistp", "fcmov_g", "fld80",
+           "fucomi_g", "fstp80"],
+    0xDC: ["fadd64", "fmul64", "fcom64", "fcomp64", "fsub64",
+           "fsubr64", "fdiv64", "fdivr64"],
+    0xDD: ["fld64", "fisttp64", "fst64", "fstp64", "frstor",
+           "fucomp_g", "fnsave", "fnstsw"],
+    0xDE: ["fiadd16", "fimul16", "ficom16", "ficomp16", "fisub16",
+           "fisubr16", "fidiv16", "fidivr16"],
+    0xDF: ["fild16", "fisttp16", "fist16", "fistp16", "fbld",
+           "fild64", "fbstp", "fistp64"],
+}
+for esc, names in _X87_GROUPS.items():
+    for d, nm in enumerate(names):
+        _s(nm, f"{esc:02X} /{d}", ALL)
+# named register families (+i on st(i)) and fixed control ops
+for enc, nm in [("D8 C0", "fadd_st"), ("D8 C8", "fmul_st"),
+                ("D8 D0", "fcom_st"), ("D8 D8", "fcomp_st"),
+                ("D8 E0", "fsub_st"), ("D8 E8", "fsubr_st"),
+                ("D8 F0", "fdiv_st"), ("D8 F8", "fdivr_st"),
+                ("D9 C0", "fld_st"), ("D9 C8", "fxch"),
+                ("DA C0", "fcmovb"), ("DA C8", "fcmove"),
+                ("DA D0", "fcmovbe"), ("DA D8", "fcmovu"),
+                ("DB C0", "fcmovnb"), ("DB C8", "fcmovne"),
+                ("DB D0", "fcmovnbe"), ("DB D8", "fcmovnu"),
+                ("DB E8", "fucomi"), ("DB F0", "fcomi"),
+                ("DC C0", "fadd_to"), ("DC C8", "fmul_to"),
+                ("DC E0", "fsubr_to"), ("DC E8", "fsub_to"),
+                ("DC F0", "fdivr_to"), ("DC F8", "fdiv_to"),
+                ("DD C0", "ffree"), ("DD D0", "fst_st"),
+                ("DD D8", "fstp_st"), ("DD E0", "fucom"),
+                ("DD E8", "fucomp"), ("DE C0", "faddp"),
+                ("DE C8", "fmulp"), ("DE E0", "fsubrp"),
+                ("DE E8", "fsubp"), ("DE F0", "fdivrp"),
+                ("DE F8", "fdivp"), ("DF E8", "fucomip"),
+                ("DF F0", "fcomip")]:
+    _s(nm, f"{enc} +r", ALL)
+for enc, nm in [("D9 D0", "fnop"), ("D9 E0", "fchs"), ("D9 E1", "fabs"),
+                ("D9 E4", "ftst"), ("D9 E5", "fxam"), ("D9 E8", "fld1"),
+                ("D9 E9", "fldl2t"), ("D9 EA", "fldl2e"),
+                ("D9 EB", "fldpi"), ("D9 EC", "fldlg2"),
+                ("D9 ED", "fldln2"), ("D9 EE", "fldz"),
+                ("D9 F0", "f2xm1"), ("D9 F1", "fyl2x"),
+                ("D9 F2", "fptan"), ("D9 F3", "fpatan"),
+                ("D9 F4", "fxtract"), ("D9 F5", "fprem1"),
+                ("D9 F6", "fdecstp"), ("D9 F7", "fincstp"),
+                ("D9 F8", "fprem"), ("D9 F9", "fyl2xp1"),
+                ("D9 FA", "fsqrt"), ("D9 FB", "fsincos"),
+                ("D9 FC", "frndint"), ("D9 FD", "fscale"),
+                ("D9 FE", "fsin"), ("D9 FF", "fcos"),
+                ("DA E9", "fucompp"), ("DB E2", "fnclex"),
+                ("DB E3", "fninit"), ("DE D9", "fcompp"),
+                ("DF E0", "fnstsw_ax")]:
+    _s(nm, f"{enc}", ALL)
+
+# ---- VEX planes with pp ---------------------------------------------
+
+# v66 0F: AVX duals of the whole 66-prefixed SSE2 plane (AVX/AVX2).
+for b, nm in _SSE2_66_0F:
+    suffix = " m" if nm in _SSE2_MEMONLY else ""
+    _s(f"v{nm}", f"v0F p66 {b:02X} /r{suffix}", _VEXM)
+_s("vmovmskpd", "v0F p66 50 /r rr", _VEXM)
+_s("vpshufd", "v0F p66 70 /r ib", _VEXM)
+_s("vcmppd", "v0F p66 C2 /r ib", _VEXM)
+_s("vpinsrw", "v0F p66 C4 /r ib", _VEXM)
+_s("vpextrw", "v0F p66 C5 /r rr ib", _VEXM)
+_s("vshufpd", "v0F p66 C6 /r ib", _VEXM)
+_s("vpmovmskb", "v0F p66 D7 /r rr", _VEXM)
+
+# vF3/vF2 0F scalar planes.
+for b, nm in _SSE_F3_0F:
+    if nm in ("popcnt", "tzcnt", "lzcnt"):
+        continue
+    _s(f"v{nm}", f"v0F pF3 {b:02X} /r", _VEXM)
+for b, nm in _SSE_F2_0F:
+    _s(f"v{nm}", f"v0F pF2 {b:02X} /r", _VEXM)
+_s("vcmpss", "v0F pF3 C2 /r ib", _VEXM)
+_s("vcmpsd", "v0F pF2 C2 /r ib", _VEXM)
+_s("vpshufhw", "v0F pF3 70 /r ib", _VEXM)
+_s("vpshuflw", "v0F pF2 70 /r ib", _VEXM)
+_s("vlddqu", "v0F pF2 F0 /r m", _VEXM)
+
+# v0F no-pp gaps (packed-single plane beyond the r4 seed set).
+for b, nm in [(0x12, "vmovlps"), (0x13, "vmovlps_st"),
+              (0x15, "vunpckhps"), (0x16, "vmovhps"),
+              (0x17, "vmovhps_st"), (0x2E, "vucomiss"),
+              (0x2F, "vcomiss"), (0x50, "vmovmskps"),
+              (0x52, "vrsqrtps"), (0x53, "vrcpps"), (0x55, "vandnps"),
+              (0x56, "vorps"), (0x5A, "vcvtps2pd"),
+              (0x5B, "vcvtdq2ps"), (0x5D, "vminps"), (0x5F, "vmaxps")]:
+    _s(nm, f"v0F {b:02X} /r", _VEXM)
+_s("vcmpps", "v0F C2 /r ib", _VEXM)
+_s("vshufps", "v0F C6 /r ib", _VEXM)
+
+# v66 0F38: SSE4 duals + AVX2 integer extensions + gathers + FMA.
+for b, nm in _SSE4_66_0F38:
+    if nm == "adcx":
+        continue
+    _s(f"v{nm}", f"v0F38 p66 {b:02X} /r", _VEXM)
+for b, nm in [(0x0C, "vpermilps"), (0x0D, "vpermilpd"),
+              (0x0E, "vtestps"), (0x0F, "vtestpd"),
+              (0x13, "vcvtph2ps"), (0x16, "vpermps"), (0x18, "vbroadcastss_x"),
+              (0x19, "vbroadcastsd"), (0x1A, "vbroadcastf128"),
+              (0x2C, "vmaskmovps"), (0x2D, "vmaskmovpd"),
+              (0x36, "vpermd"), (0x45, "vpsrlvd"), (0x46, "vpsravd"),
+              (0x47, "vpsllvd"), (0x58, "vpbroadcastd"),
+              (0x59, "vpbroadcastq"), (0x5A, "vbroadcasti128"),
+              (0x78, "vpbroadcastb"), (0x79, "vpbroadcastw"),
+              (0x8C, "vpmaskmovd"), (0x8E, "vpmaskmovd_st")]:
+    _s(nm, f"v0F38 p66 {b:02X} /r", _VEXM)
+for b in range(0x90, 0x94):  # VSIB gathers: memory-only
+    _s(f"vgather_{b:02X}", f"v0F38 p66 {b:02X} /r m", _VEXM)
+for base in (0x96, 0x98, 0x9A, 0x9C, 0x9E, 0xA6, 0xA8, 0xAA, 0xAC,
+             0xAE, 0xB6, 0xB8, 0xBA, 0xBC, 0xBE):
+    _s(f"vfma_{base:02X}", f"v0F38 p66 {base:02X} /r", _VEXM)
+    _s(f"vfma_{base + 1:02X}", f"v0F38 p66 {base + 1:02X} /r", _VEXM)
+
+# BMI1/BMI2 (VEX-encoded GPR ops).
+_s("andn", "v0F38 F2 /r", _VEXM)
+_s("blsr", "v0F38 F3 /1 rr", _VEXM)
+_s("blsmsk", "v0F38 F3 /2 rr", _VEXM)
+_s("blsi", "v0F38 F3 /3 rr", _VEXM)
+_s("bzhi", "v0F38 F5 /r", _VEXM)
+_s("pext", "v0F38 pF3 F5 /r", _VEXM)
+_s("pdep", "v0F38 pF2 F5 /r", _VEXM)
+_s("mulx", "v0F38 pF2 F6 /r", _VEXM)
+_s("bextr", "v0F38 F7 /r", _VEXM)
+_s("shlx", "v0F38 p66 F7 /r", _VEXM)
+_s("sarx", "v0F38 pF3 F7 /r", _VEXM)
+_s("shrx", "v0F38 pF2 F7 /r", _VEXM)
+
+# v66 0F3A: immediates plane + AVX2 + F16C + RORX.
+for b, nm in _SSE4_66_0F3A:
+    _s(f"v{nm}", f"v0F3A p66 {b:02X} /r ib", _VEXM)
+for b, nm in [(0x00, "vpermq"), (0x01, "vpermpd"), (0x02, "vpblendd"),
+              (0x04, "vpermilps_i"), (0x05, "vpermilpd_i"),
+              (0x06, "vperm2f128"), (0x1D, "vcvtps2ph"),
+              (0x38, "vinserti128"), (0x39, "vextracti128"),
+              (0x46, "vperm2i128"), (0x4B, "vblendvpd"),
+              (0x4C, "vpblendvb")]:
+    _s(nm, f"v0F3A p66 {b:02X} /r ib", _VEXM)
+_s("rorx", "v0F3A pF2 F0 /r ib", _VEXM)
+
+# ---- EVEX plane (AVX-512 foundation) --------------------------------
+# The AVX-512 promotions of the SSE2/scalar/FMA planes plus the
+# 512-native permute/compress/ternlog family.  Length rule: the EVEX
+# payload is always 3 bytes after 62; disp8 compression rescales the
+# displacement VALUE, not its size, so decode shares the VEX logic.
+
+for b, nm in _SSE2_66_0F:
+    suffix = " m" if nm in _SSE2_MEMONLY else ""
+    _s(f"ev_{nm}", f"e0F p66 {b:02X} /r{suffix}", _VEXM)
+for b, nm in _SSE_F3_0F:
+    if nm in ("popcnt", "tzcnt", "lzcnt"):
+        continue
+    _s(f"ev_{nm}", f"e0F pF3 {b:02X} /r", _VEXM)
+for b, nm in _SSE_F2_0F:
+    _s(f"ev_{nm}", f"e0F pF2 {b:02X} /r", _VEXM)
+for base in (0x96, 0x98, 0x9A, 0x9C, 0x9E, 0xA6, 0xA8, 0xAA, 0xAC,
+             0xAE, 0xB6, 0xB8, 0xBA, 0xBC, 0xBE):
+    _s(f"ev_fma_{base:02X}", f"e0F38 p66 {base:02X} /r", _VEXM)
+    _s(f"ev_fma_{base + 1:02X}", f"e0F38 p66 {base + 1:02X} /r", _VEXM)
+for b, nm in [(0x16, "evpermps"), (0x1F, "evpabsq"), (0x36, "evpermd"),
+              (0x64, "evpblendmd"), (0x65, "evblendmps"),
+              (0x75, "evpermi2w"), (0x76, "evpermi2d"),
+              (0x77, "evpermi2ps"), (0x7D, "evpermt2w"),
+              (0x7E, "evpermt2d"), (0x7F, "evpermt2ps"),
+              (0x88, "evexpandps"), (0x89, "evpexpandd"),
+              (0x8A, "evcompressps"), (0x8B, "evpcompressd"),
+              (0xC4, "evpconflictd"), (0xC8, "evexp2ps_er"),
+              (0xCA, "evrcp28ps"), (0xCC, "evrsqrt28ps")]:
+    _s(nm, f"e0F38 p66 {b:02X} /r", _VEXM)
+for b, nm in [(0x03, "evalignd"), (0x08, "evrndscaleps"),
+              (0x09, "evrndscalepd"), (0x0A, "evrndscaless"),
+              (0x0B, "evrndscalesd"), (0x19, "evextractf32x4"),
+              (0x1B, "evextractf64x4"), (0x1E, "evpcmpud"),
+              (0x1F, "evpcmpd"), (0x23, "evshuff32x4"),
+              (0x25, "evpternlogd"), (0x26, "evgetmantps"),
+              (0x27, "evgetmantss"), (0x3E, "evpcmpuw"),
+              (0x3F, "evpcmpw"), (0x43, "evshufi32x4"),
+              (0x50, "evrangeps"), (0x51, "evrangess"),
+              (0x54, "evfixupimmps"), (0x55, "evfixupimmss")]:
+    _s(nm, f"e0F3A p66 {b:02X} /r ib", _VEXM)
+
+# ---- system / modern-ISA odds and ends ------------------------------
+
+_s("rdrand", "0F C7 /6 rr", ALL)
+_s("rdseed", "0F C7 /7 rr", ALL)
+_s("rdpid", "pF3 0F C7 /7 rr", ALL)
+_s("clflushopt", "p66 0F AE /7 m", ALL)
+_s("clwb", "p66 0F AE /6 m", ALL)
+_s("ptwrite", "pF3 0F AE /4", ALL)
+_s("invept", "p66 0F 38 80 /r m", ALL, PRIV)
+_s("invvpid", "p66 0F 38 81 /r m", ALL, PRIV)
+_s("invpcid", "p66 0F 38 82 /r m", ALL, PRIV)
+_s("movdiri", "0F 38 F9 /r m", ALL)
+_s("movdir64b", "p66 0F 38 F8 /r m", ALL)
+_s("enqcmds", "pF3 0F 38 F8 /r m", ALL, PRIV)
+_s("enqcmd", "pF2 0F 38 F8 /r m", ALL)
+_s("wbnoinvd", "pF3 0F 09", ALL, PRIV)
+_s("clac", "0F 01 CA", ALL, PRIV)
+_s("stac", "0F 01 CB", ALL, PRIV)
+_s("encls", "0F 01 CF", ALL, PRIV)
+_s("enclu", "0F 01 D7", ALL)
+_s("enclv", "0F 01 C0", ALL, PRIV)
+_s("xend", "0F 01 D5", ALL)
+_s("xtest", "0F 01 D6", ALL)
+_s("serialize", "0F 01 E8", ALL)
+_s("rdpkru", "0F 01 EE", ALL)
+_s("wrpkru", "0F 01 EF", ALL)
+_s("monitorx", "0F 01 FA", ALL, PRIV)
+_s("mwaitx", "0F 01 FB", ALL, PRIV)
+_s("clzero", "0F 01 FC", ALL)
+_s("rdpru", "0F 01 FD", ALL)
+# SHA extensions (no-prefix 0F38/0F3A)
+_s("sha1nexte", "0F 38 C8 /r", ALL)
+_s("sha1msg1", "0F 38 C9 /r", ALL)
+_s("sha1msg2", "0F 38 CA /r", ALL)
+_s("sha256rnds2", "0F 38 CB /r", ALL)
+_s("sha256msg1", "0F 38 CC /r", ALL)
+_s("sha256msg2", "0F 38 CD /r", ALL)
+_s("sha1rnds4", "0F 3A CC /r ib", ALL)
+# SSE4a (AMD)
+_s("movntss", "pF3 0F 2B /r m", ALL)
+_s("movntsd", "pF2 0F 2B /r m", ALL)
+# (SSE4a extrq/insertq omitted: 0F 78/79 collide with vmread/vmwrite
+# and differ in imm length only by prefix — the length decoder's
+# two-byte map is prefix-blind by design.)
+# 3DNow: 0F 0F modrm + operation-suffix byte.  The suffix occupies
+# the ib slot, so ONE table entry covers the family's length shape;
+# the random imm sweeps the whole suffix space (pfadd..pswapd).
+_s("now3d", "0F 0F /r ib", ALL)
+
 INSNS: list[Insn] = [_parse_spec(*e) for e in _SPEC]
 
 # -- lookup maps for decode -------------------------------------------
@@ -492,6 +892,7 @@ def _build_maps():
     m3a: dict[int, Insn] = {}
     fixed: dict[bytes, Insn] = {}   # full fixed encodings (0F 01 C1 ..)
     vex: dict[tuple, Insn] = {}     # (map, opcode) -> Insn
+    evex: dict[tuple, Insn] = {}    # (map, opcode) -> Insn (AVX-512)
 
     def add(table, key, insn):
         if insn.reg >= 0:
@@ -507,14 +908,28 @@ def _build_maps():
         if insn.flags & VEX:
             vex.setdefault((insn.vexmap, insn.opcode[-1]), insn)
             continue
+        if insn.flags & EVEX:
+            evex.setdefault((insn.vexmap, insn.opcode[-1]), insn)
+            continue
         op = insn.opcode
         if insn.plusr:
             for r in range(8):
                 b = bytes(op[:-1]) + bytes([op[-1] + r])
                 if len(b) == 1:
                     add(one, b[0], insn)
-                else:
+                elif b[0] == 0x0F:
                     add(two, b[1], insn)
+                else:
+                    # x87 register family (D9 C0+r fld st(i), ...):
+                    # length-equivalent to the escape byte's modrm
+                    # group; recorded so the generator can emit the
+                    # specific form.  decode() resolves these through
+                    # the group entry at the escape byte.
+                    continue
+            continue
+        if len(op) == 2 and 0xD8 <= op[0] <= 0xDF:
+            # fixed x87 register encoding (DB E3 fninit, DF E0
+            # fnstsw-ax, ...): same story — generation-only spec.
             continue
         if len(op) >= 3 and op[0] == 0x0F and op[1] == 0x38:
             m38.setdefault(op[2], insn)
@@ -526,31 +941,37 @@ def _build_maps():
             add(two, op[1], insn)
         else:
             add(one, op[0], insn)
-    return one, two, m38, m3a, fixed, vex
+    return one, two, m38, m3a, fixed, vex, evex
 
 
-_MAP1, _MAP2, _MAP38, _MAP3A, _FIXED, _VEXMAP = _build_maps()
+(_MAP1, _MAP2, _MAP38, _MAP3A, _FIXED, _VEXMAP,
+ _EVEXMAP) = _build_maps()
 
 LEGACY_PREFIXES = frozenset(
     [0x66, 0x67, 0xF0, 0xF2, 0xF3, 0x2E, 0x36, 0x3E, 0x26, 0x64, 0x65])
 
 
-def _pick(table_entry, regbits, mode):
-    """Resolve a one/two-byte map entry to an Insn valid in `mode`."""
+def _pick(table_entry, regbits, mode, mod=-1):
+    """Resolve a one/two-byte map entry to an Insn valid in `mode`.
+
+    mod: the modrm mod bits at the decode position (-1 if unknown) —
+    entries whose MEMONLY/REGONLY contradicts it are deprioritized so
+    a memory-only prefix variant cannot shadow a register-form one
+    sharing the opcode byte."""
     if table_entry is None:
         return None
-    if isinstance(table_entry, dict):
-        cands = table_entry.get(regbits)
-        if not cands:
-            return None
-        for c in cands:
-            if c.modes & mode:
-                return c
-        return None
-    for c in table_entry:
-        if c.modes & mode:
-            return c
-    return None
+    cands = (table_entry.get(regbits) or [])         if isinstance(table_entry, dict) else table_entry
+    fallback = None
+    for c in cands:
+        if not (c.modes & mode):
+            continue
+        if mod >= 0 and ((c.flags & MEMONLY and mod == 3) or
+                         (c.flags & REGONLY and mod != 3)):
+            if fallback is None:
+                fallback = c
+            continue
+        return c
+    return fallback
 
 
 def _opsize(mode, osz66, rexw):
@@ -638,6 +1059,29 @@ def decode(mode: int, data: bytes) -> int:
     osz = _opsize(mode, osz66, rexw)
     asz = _addrsize(mode, asz67)
     b0 = data[pos]
+    # EVEX: 62 is EVEX in long mode always; in prot32 only when the
+    # payload's top two bits are 11 (else BOUND).  Payload is always
+    # 3 bytes; disp8 compression rescales the displacement value, not
+    # its size, so the tail length rules are the VEX ones.
+    if b0 == 0x62 and pos + 3 < len(data) and (
+            mode == LONG64 or
+            (mode == PROT32 and (data[pos + 1] & 0xC0) == 0xC0)):
+        emap = data[pos + 1] & 0x07
+        insn = _EVEXMAP.get((emap, data[pos + 4])) \
+            if pos + 4 < len(data) else None
+        if insn is None or not (insn.modes & mode):
+            return -1
+        pos += 5
+        # prefix-blind like the VEX path: the (map, opcode) entry may
+        # be a different pp-plane's insn, so MEMONLY/REGONLY flags are
+        # not enforced here — only length structure is shared.
+        n = _modrm_len(data, pos, asz) if insn.modrm else 0
+        if n < 0:
+            return -1
+        pos += n
+        for tok in insn.imms:
+            pos += _imm_len(tok, osz, asz)
+        return pos if pos <= len(data) else -1
     # VEX: C4/C5 are VEX in long mode always; in prot32 only when the
     # next byte's top two bits are 11 (else LES/LDS).
     if b0 in (0xC4, 0xC5) and pos + 1 < len(data) and (
@@ -688,13 +1132,15 @@ def decode(mode: int, data: bytes) -> int:
                         pos += _imm_len(tok, osz, asz)
                     return pos if pos <= len(data) else -1
             regbits = (data[pos + 2] >> 3) & 7 if pos + 2 < len(data) else 0
-            insn = _pick(_MAP2.get(b1), regbits, mode)
+            mod = (data[pos + 2] >> 6) if pos + 2 < len(data) else -1
+            insn = _pick(_MAP2.get(b1), regbits, mode, mod)
             if insn is None:
                 return -1
             pos += 2
     else:
         regbits = (data[pos + 1] >> 3) & 7 if pos + 1 < len(data) else 0
-        insn = _pick(_MAP1.get(b0), regbits, mode)
+        mod = (data[pos + 1] >> 6) if pos + 1 < len(data) else -1
+        insn = _pick(_MAP1.get(b0), regbits, mode, mod)
         if insn is None:
             return -1
         pos += 1
@@ -735,7 +1181,7 @@ def mode_insns(cfg: Config) -> list[Insn]:
         got = [i for i in INSNS
                if i.modes & cfg.mode
                and (cfg.priv or not i.priv)
-               and (cfg.avx or not i.flags & VEX)]
+               and (cfg.avx or not i.flags & (VEX | EVEX))]
         _MODE_CACHE[key] = got
     return got
 
@@ -789,16 +1235,31 @@ def generate_insn(cfg: Config, r: random.Random) -> bytes:
     insn = insns[r.randrange(len(insns))]
     out = bytearray()
     osz66 = asz67 = rexw = False
+    if insn.flags & EVEX:
+        # 62 P0 P1 P2 opcode [modrm...] — P0: RXBR'0mmm (all extension
+        # bits 1 = "not extended"), P1: Wvvvv1pp, P2: zL'Lb V'aaa.
+        opb = insn.opcode[-1]
+        p0 = 0xF0 | insn.vexmap
+        p1 = 0x7C | _PP[insn.mprefix]   # W=0, vvvv=1111, bit2=1
+        p2 = 0x08 | (r.randrange(3) << 5) | r.randrange(8)  # V'=1, L, aaa
+        out += bytes([0x62, p0, p1, p2, opb])
+        if insn.modrm:
+            out += _gen_modrm(insn, _addrsize(cfg.mode, asz67), r)
+        for tok in insn.imms:
+            out += _gen_imm(_imm_len(tok, _opsize(cfg.mode, False, False),
+                                     _addrsize(cfg.mode, asz67)), r)
+        return bytes(out)
     if insn.flags & VEX:
         # optional 67 prefix only (66/F2/F3 change VEX pp semantics)
         if r.randrange(8) == 0:
             out.append(0x67)
             asz67 = True
         opb = insn.opcode[-1]
+        pp = _PP[insn.mprefix]  # mandatory prefix rides the pp field
         if insn.vexmap == 1 and r.randrange(2) == 0:
             # C5 R'vvvvLpp: top two bits must be 11 outside long mode
-            # (the prot32 VEX-vs-LDS disambiguation); pp stays 00.
-            b1 = r.randrange(256) & 0x7C
+            # (the prot32 VEX-vs-LDS disambiguation).
+            b1 = (r.randrange(256) & 0x7C) | pp
             if cfg.mode != LONG64:
                 b1 |= 0xC0
             else:
@@ -806,7 +1267,7 @@ def generate_insn(cfg: Config, r: random.Random) -> bytes:
             out += bytes([0xC5, b1])
         else:
             b1 = 0xE0 | insn.vexmap      # R'X'B' = 111, m-mmmm = map
-            b2 = r.randrange(256) & 0x7C  # W=0, pp=00
+            b2 = (r.randrange(256) & 0x7C) | pp  # W=0
             out += bytes([0xC4, b1, b2])
         out.append(opb)
         if insn.modrm:
@@ -815,8 +1276,11 @@ def generate_insn(cfg: Config, r: random.Random) -> bytes:
             out += _gen_imm(_imm_len(tok, _opsize(cfg.mode, False, False),
                                      _addrsize(cfg.mode, asz67)), r)
         return bytes(out)
-    # legacy prefixes
-    if r.randrange(6) == 0:
+    # legacy prefixes.  A mandatory prefix (SSE/SSE2+ forms) must be
+    # present and must be the LAST legacy prefix so it stays adjacent
+    # to the opcode; the random 66 roll is suppressed for those insns
+    # (66+F3 stacking flips meaning per SDM).
+    if insn.mprefix != 0x66 and r.randrange(6) == 0:
         out.append(0x66)
         osz66 = True
     if r.randrange(10) == 0:
@@ -824,6 +1288,10 @@ def generate_insn(cfg: Config, r: random.Random) -> bytes:
         asz67 = True
     if r.randrange(10) == 0:
         out.append(r.choice([0x2E, 0x36, 0x3E, 0x26, 0x64, 0x65]))
+    if insn.mprefix:
+        out.append(insn.mprefix)
+        if insn.mprefix == 0x66:
+            osz66 = True
     if cfg.mode == LONG64 and r.randrange(4) == 0:
         rex = 0x40 | r.randrange(16)
         rexw = bool(rex & 8)

@@ -100,3 +100,29 @@ def _disasm_first(blob: bytes, march: str) -> str:
         if re.match(r"\s*0:\t", line):
             return line
     return ""
+
+
+def test_decoder_agrees_with_objdump_avx():
+    """The VEX/EVEX planes against the oracle (long mode, where the
+    encodings are unambiguous)."""
+    march = "x86-64"
+    r = random.Random(991)
+    cfg = x86.Config(mode=x86.LONG64, avx=True)
+    mismatches = []
+    total = 0
+    for _ in range(400):
+        insn = x86.generate_insn(cfg, r)
+        if insn[0] not in (0xC4, 0xC5, 0x62):
+            continue
+        got = _objdump_lengths(insn + b"\x90" * 4, march)
+        if not got:
+            continue
+        total += 1
+        ours = x86.decode(x86.LONG64, insn)
+        if got[0] != ours:
+            disasm = _disasm_first(insn, march)
+            if "(bad)" in disasm:
+                continue
+            mismatches.append((insn.hex(), ours, got[0], disasm))
+    assert total >= 60
+    assert not mismatches, mismatches[:10]
