@@ -941,10 +941,42 @@ static int kvm_selftest(const char* hex) {
 }
 #endif  // TZ_LINUX
 
+#if defined(TZ_LINUX) && defined(TZ_HAVE_KVM)
+// Byte-exact staging dump: run kvm_stage_long into an anonymous
+// buffer (no /dev/kvm involved) and hex-dump it so a unit test can
+// verify the GDT/IDT/page-table/TSS/trampoline bytes.
+// Usage: tz-executor --dump-kvm-stage <hex-text>
+static int kvm_stage_dump(const char* hex) {
+  size_t text_len = strlen(hex) / 2;
+  if (text_len == 0 || text_len > 0x1000)
+    failf("dump-kvm-stage: bad text length %zu", text_len);
+  std::vector<uint8_t> text(text_len);
+  for (size_t i = 0; i < text_len; i++) {
+    unsigned v = 0;
+    if (sscanf(hex + 2 * i, "%2x", &v) != 1)
+      failf("dump-kvm-stage: bad hex");
+    text[i] = (uint8_t)v;
+  }
+  std::vector<uint8_t> mem(kKvmGuestMemSize, 0);
+  kvm_stage_long(mem.data(), text.data(), text_len);
+  // dump 0x1000..0x9000 (IDT..user text) as hex lines of 32 bytes
+  for (uint64_t off = 0x1000; off < 0x9000; off += 32) {
+    printf("%06llx ", (unsigned long long)off);
+    for (int i = 0; i < 32; i++) printf("%02x", mem[off + i]);
+    printf("\n");
+  }
+  return 0;
+}
+#endif
+
 static int executor_main(int argc, char** argv) {
 #if defined(TZ_LINUX)
   if (argc >= 3 && strcmp(argv[1], "--selftest-kvm") == 0)
     return kvm_selftest(argv[2]);
+#ifdef TZ_HAVE_KVM
+  if (argc >= 3 && strcmp(argv[1], "--dump-kvm-stage") == 0)
+    return kvm_stage_dump(argv[2]);
+#endif
 #endif
   if (argc < 3) failf("usage: tz-executor <in-file> <out-file>");
   g_in = (uint64_t*)map_file(argv[1], kInShmemSize, false);

@@ -296,9 +296,100 @@ static const uint8_t kKvmTramp[] = {
     0x0f, 0x20, 0xc0,                          // mov eax, cr0
     0x66, 0x0d, 0x01, 0x00, 0x00, 0x80,        // or  eax, PG|PE
     0x0f, 0x22, 0xc0,                          // mov cr0, eax
-    0x66, 0xea, 0x00, 0x80, 0x00, 0x00,        // ljmpl 0x08:0x8000
-    0x08, 0x00,
+    0x66, 0xea, 0x00, 0x78, 0x00, 0x00,        // ljmpl 0x08:0x7800
+    0x08, 0x00,                                //   (long-mode prologue)
 };
+
+// Stage the full long-mode bring-up image into a guest-memory buffer.
+// Pure memory writes — no KVM fds — so tests can verify every
+// descriptor table byte-exactly without /dev/kvm (the
+// --dump-kvm-stage CLI below drives exactly this function).
+//
+// Guest layout (reference: executor/common_kvm_amd64.h:1-812 + kvm.S
+// stage the same real->protected->long transition with their own
+// table layout):
+//   0x1000 IDT: 256 x 16-byte present interrupt gates -> ISR @0x7F00
+//   0x2000 GDT: null | 0x08 code64 | 0x10 data | 0x18 code32 |
+//               0x20 TSS64 desc (16b) | 0x30 code16 | 0x38 data16 |
+//               0x40 user code64 (DPL3) | 0x48 user data (DPL3)
+//   0x3000 PML4  0x4000 PDPT  0x5000 PD (4 x 2MB identity = 8MB)
+//   0x6000 TSS64 (104 bytes: rsp0=0xE000, IST1=0xE800)
+//   0x7000 real-mode trampoline + GDTR/IDTR operands @0x7080/0x7088
+//   0x7800 long-mode prologue: ltr, data-segment loads, jmp text
+//   0x7F00 ISR stub (hlt loop)
+//   0x8000 user text        0xF000 initial stack top
+static void kvm_stage_long(uint8_t* host_mem, const uint8_t* text,
+                           uint64_t text_len) {
+  auto w64 = [&](uint64_t gpa, uint64_t val) {
+    memcpy(host_mem + gpa, &val, 8);
+  };
+  // page tables: identity-map 8MB through 4 2MB PD entries
+  w64(0x3000, 0x4000 | 3);
+  w64(0x4000, 0x5000 | 3);
+  for (uint64_t i = 0; i < 4; i++)
+    w64(0x5000 + 8 * i, (i << 21) | 0x83);  // present|rw|ps
+  // GDT
+  w64(0x2000 + 0x00, 0);
+  w64(0x2000 + 0x08, 0x00209A0000000000ull);  // L=1 kernel code
+  w64(0x2000 + 0x10, 0x00CF92000000FFFFull);  // flat data
+  w64(0x2000 + 0x18, 0x00CF9A000000FFFFull);  // 32-bit code
+  // 64-bit TSS descriptor (16 bytes): base 0x6000, limit 0x67, type 9
+  w64(0x2000 + 0x20, 0x0000890060000067ull);
+  w64(0x2000 + 0x28, 0);
+  w64(0x2000 + 0x30, 0x00009A000000FFFFull);  // 16-bit code
+  w64(0x2000 + 0x38, 0x000092000000FFFFull);  // 16-bit data
+  w64(0x2000 + 0x40, 0x0020FA0000000000ull);  // user code64 DPL3
+  w64(0x2000 + 0x48, 0x00CFF2000000FFFFull);  // user data DPL3
+  // TSS: rsp0 at +4, IST1 at +36, iomap base = sizeof(tss)
+  memset(host_mem + 0x6000, 0, 0x68);
+  w64(0x6000 + 4, 0xE000);
+  w64(0x6000 + 36, 0xE800);
+  host_mem[0x6000 + 102] = 0x68;
+  // IDT: every vector a present DPL0 interrupt gate to the ISR stub
+  for (int v = 0; v < 256; v++) {
+    uint8_t* g = host_mem + 0x1000 + 16 * v;
+    memset(g, 0, 16);
+    g[0] = 0x00;  // offset 15:0 = 0x7F00
+    g[1] = 0x7F;
+    g[2] = 0x08;  // selector: kernel code64
+    g[3] = 0x00;
+    g[4] = 0x00;  // IST 0
+    g[5] = 0x8E;  // present, type E (interrupt gate)
+  }
+  // ISR stub: hlt; jmp $-1 (vcpu parks on any exception/interrupt)
+  host_mem[0x7F00] = 0xF4;
+  host_mem[0x7F01] = 0xEB;
+  host_mem[0x7F02] = 0xFD;
+  // user text
+  memset(host_mem + 0x8000, 0xf4, 0x1000);
+  memcpy(host_mem + 0x8000, text, text_len);
+  // real-mode trampoline + its GDTR/IDTR operands
+  memcpy(host_mem + 0x7000, kKvmTramp, sizeof(kKvmTramp));
+  host_mem[0x7080] = 0x4F;  // GDT limit: through user data
+  host_mem[0x7081] = 0x00;
+  uint32_t gdt_base = 0x2000;
+  memcpy(host_mem + 0x7082, &gdt_base, 4);
+  host_mem[0x7088] = 0xFF;  // IDT limit: full 256 gates
+  host_mem[0x7089] = 0x0F;
+  uint32_t idt_base = 0x1000;
+  memcpy(host_mem + 0x708a, &idt_base, 4);
+  // long-mode prologue at 0x7800 (the trampoline far-jumps here):
+  //   mov ax, 0x20 ; ltr ax        -- hardware task register
+  //   mov ax, 0x10 ; mov ds/es/ss/fs/gs, ax
+  //   mov rsp, 0xF000
+  //   mov rax, 0x8000 ; jmp rax    -- into the user text
+  static const uint8_t prologue[] = {
+      0x66, 0xb8, 0x20, 0x00,              // mov ax, 0x20
+      0x0f, 0x00, 0xd8,                    // ltr ax
+      0x66, 0xb8, 0x10, 0x00,              // mov ax, 0x10
+      0x8e, 0xd8, 0x8e, 0xc0, 0x8e, 0xd0,  // mov ds/es/ss, ax
+      0x8e, 0xe0, 0x8e, 0xe8,              // mov fs/gs, ax
+      0x48, 0xc7, 0xc4, 0x00, 0xf0, 0x00, 0x00,  // mov rsp, 0xf000
+      0x48, 0xc7, 0xc0, 0x00, 0x80, 0x00, 0x00,  // mov rax, 0x8000
+      0xff, 0xe0,                          // jmp rax
+  };
+  memcpy(host_mem + 0x7800, prologue, sizeof(prologue));
+}
 
 static long kvm_setup_cpu(int vmfd, int cpufd, uint64_t usermem,
                           uint64_t text_addr, uint64_t ntext,
@@ -332,48 +423,13 @@ static long kvm_setup_cpu(int vmfd, int cpufd, uint64_t usermem,
   memset(&regs, 0, sizeof(regs));
   regs.rflags = 2;
   if (seg.typ == 2) {
-    // Long mode via REAL staging: the vcpu starts in real mode at a
-    // trampoline that executes the architectural bring-up itself —
-    // lgdt/lidt from guest-memory descriptor tables, CR4.PAE, CR3 at
-    // the identity page tables, EFER.LME via wrmsr, CR0.PG|PE, then
-    // a far jump through the 64-bit GDT code descriptor into the
-    // user text.  Guest layout:
-    //   0x1000 IDT (zero-limit would do; real entries triple-fault
-    //          cleanly), 0x2000 GDT, 0x3000-0x5fff PML4/PDPT/PD,
-    //   0x7000 trampoline (+ GDTR/IDTR operands), 0x8000 user text,
-    //   0xf000 stack top.
-    // (reference: executor/common_kvm_amd64.h + kvm.S stage the same
-    // transition with their own table layout)
-    uint64_t pml4_gpa = 0x3000, pdpt_gpa = 0x4000, pd_gpa = 0x5000;
-    auto w64 = [&](uint64_t gpa, uint64_t val) {
-      memcpy(host_mem + gpa, &val, 8);
-    };
-    w64(pml4_gpa, pdpt_gpa | 3);
-    w64(pdpt_gpa, pd_gpa | 3);
-    w64(pd_gpa, 0x83);  // 2MB page, present|rw|ps
-    // GDT: null, 0x08 = 64-bit code, 0x10 = flat data, 0x18 = 32-bit
-    // code (kept for protected-mode hops), 4 entries = limit 0x1f
-    w64(0x2000 + 0x00, 0);
-    w64(0x2000 + 0x08, 0x00209A0000000000ull);  // L=1 code
-    w64(0x2000 + 0x10, 0x00CF92000000FFFFull);  // flat data
-    w64(0x2000 + 0x18, 0x00CF9A000000FFFFull);  // 32-bit code
-    // user text moves to 0x8000 on the staged path
-    memset(host_mem + 0x8000, 0xf4, 0x1000);
-    memcpy(host_mem + 0x8000, guest(seg.text_addr, seg.text_len),
-           seg.text_len);
-    memcpy(host_mem + 0x7000, kKvmTramp, sizeof(kKvmTramp));
-    // GDTR/IDTR operands live at 0x7080/0x7088 — past the trampoline
-    // (0x42 bytes at 0x7000) so they never overwrite its tail
-    host_mem[0x7080] = 0x1f;  // GDT limit (4 entries)
-    host_mem[0x7081] = 0x00;
-    uint32_t gdt_base = 0x2000;
-    memcpy(host_mem + 0x7082, &gdt_base, 4);
-    // zero-limit IDT: any guest exception triple-faults into a clean
-    // KVM_EXIT_SHUTDOWN
-    host_mem[0x7088] = 0x00;
-    host_mem[0x7089] = 0x00;
-    uint32_t idt_base = 0x1000;
-    memcpy(host_mem + 0x708a, &idt_base, 4);
+    // Long mode via REAL staging: the vcpu starts in real mode at
+    // the trampoline, which performs the architectural bring-up
+    // itself (lgdt/lidt, CR4.PAE, CR3, EFER.LME, CR0.PG|PE), far-
+    // jumps into the long-mode prologue (ltr + segment loads), and
+    // lands in the user text.  All tables staged by kvm_stage_long.
+    kvm_stage_long(host_mem, guest(seg.text_addr, seg.text_len),
+                   seg.text_len);
     // real-mode start at the trampoline; all data segs base 0 so the
     // lgdt/lidt disp16 operands address guest-physical directly
     sregs.cs.base = 0x7000;

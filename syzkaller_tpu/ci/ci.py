@@ -32,11 +32,20 @@ class ManagedInstance:
     branch: str = "main"
     build_cmd: str = ""  # rebuild artifacts; cwd=repo
     manager_cmd: str = ""  # start the manager process
+    # kernel-build pipeline: when kernel_src is set, _build drives
+    # configure -> bzImage -> boot image through ci/kernel.py instead
+    # of build_cmd (reference: pkg/kernel + syz-ci/manager.go:235)
+    kernel_src: str = ""
+    kernel_defconfig: str = "defconfig"
+    kernel_config_fragment: str = ""
+    image_dir: str = ""  # where {bzImage, initramfs.cpio} land
+    executor_bin: str = ""  # packed into the initramfs when set
     # runtime state
     current_commit: str = ""
     proc: Optional[subprocess.Popen] = None
     last_build_ok: bool = True
     last_error: str = ""
+    image: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -98,6 +107,8 @@ class CI:
     def _build(self, m: ManagedInstance) -> bool:
         """(reference: syz-ci/manager.go:235 build; failures reported
         to the dashboard as build errors)"""
+        if m.kernel_src:
+            return self._build_kernel(m)
         if not m.build_cmd:
             m.last_build_ok = True
             return True
@@ -106,16 +117,45 @@ class CI:
         m.last_build_ok = res.returncode == 0
         m.last_error = res.stderr[-2048:] if res.returncode else ""
         if not m.last_build_ok:
-            log.logf(0, "ci: %s: build failed: %s", m.name,
-                     m.last_error[-256:])
-            if self.dash is not None:
-                try:
-                    self.dash.report_crash(
-                        manager=m.name,
-                        title=f"{m.name} build error",
-                        log=m.last_error)
-                except Exception as e:
-                    log.logf(0, "ci: dashboard report failed: %s", e)
+            self._report_build_failure(m)
+        return m.last_build_ok
+
+    def _report_build_failure(self, m: ManagedInstance) -> None:
+        log.logf(0, "ci: %s: build failed: %s", m.name,
+                 m.last_error[-256:])
+        if self.dash is not None:
+            try:
+                self.dash.report_crash(
+                    manager=m.name,
+                    title=f"{m.name} build error",
+                    log=m.last_error)
+            except Exception as e:
+                log.logf(0, "ci: dashboard report failed: %s", e)
+
+    def _build_kernel(self, m: ManagedInstance) -> bool:
+        """configure -> build -> image through the kernel pipeline;
+        the produced {kernel, initrd} pair is what a qemu-backed
+        manager boots (vm/qemu.py -kernel/-initrd)."""
+        from syzkaller_tpu.ci.kernel import BuildError, KernelBuilder
+
+        out_dir = os.path.join(self.cfg.workdir, f"{m.name}-kbuild")
+        image_dir = m.image_dir or os.path.join(self.cfg.workdir,
+                                                f"{m.name}-image")
+        kb = KernelBuilder(kernel_src=m.kernel_src, out_dir=out_dir,
+                           defconfig=m.kernel_defconfig,
+                           config_fragment=m.kernel_config_fragment)
+        try:
+            kb.configure()
+            m.image = kb.make_image(image_dir, executor=m.executor_bin)
+            m.last_build_ok = True
+            m.last_error = ""
+        except (BuildError, OSError) as e:
+            # OSError covers environment failures (no make binary,
+            # missing kernel_src) — they must surface as build errors
+            # too, not escape with last_build_ok still True
+            m.last_build_ok = False
+            m.last_error = str(e)[-2048:]
+            self._report_build_failure(m)
         return m.last_build_ok
 
     def _restart(self, m: ManagedInstance) -> None:

@@ -1,0 +1,183 @@
+"""Kernel build + boot-image pipeline (reference: pkg/kernel/kernel.go
+configure/build and syz-ci/manager.go:235 image creation).
+
+Three stages, each a plain `make` invocation against a kernel source
+tree so the same driver runs on a stub makefile tree in tests and a
+real kernel checkout on capable hosts:
+
+  configure(): `make O=<out> <defconfig>` then append the fuzzing
+      config fragment (KCOV, KASAN, debug info, panic-on-warn — the
+      reference writes the same set) and re-normalize with
+      `make olddefconfig`.
+  build():     `make O=<out> -j<n> bzImage` -> the compressed kernel.
+  make_image(): package a bootable artifact for vm/qemu.py's
+      -kernel/-initrd mode: the bzImage plus a minimal initramfs
+      (newc cpio written directly — no root, no loop devices) that
+      contains /init and the tz-executor binary, so a booted guest
+      can immediately serve the fuzzing fork-server.
+
+The real-kernel path is documented in docs/real_kernel.md; nothing
+here requires root or kvm — only `make` and a kernel tree.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import subprocess
+from dataclasses import dataclass, field
+from typing import Optional
+
+#: Config fragment the fuzzing kernel needs (reference:
+#: pkg/kernel/kernel.go + docs/linux/setup.md recommended configs).
+FUZZING_CONFIG = """\
+CONFIG_KCOV=y
+CONFIG_KCOV_INSTRUMENT_ALL=y
+CONFIG_KCOV_ENABLE_COMPARISONS=y
+CONFIG_DEBUG_FS=y
+CONFIG_DEBUG_INFO=y
+CONFIG_KASAN=y
+CONFIG_KASAN_INLINE=y
+CONFIG_CONFIGFS_FS=y
+CONFIG_SECURITYFS=y
+CONFIG_FAULT_INJECTION=y
+CONFIG_FAULT_INJECTION_DEBUG_FS=y
+CONFIG_FAILSLAB=y
+CONFIG_FAIL_PAGE_ALLOC=y
+CONFIG_PANIC_ON_OOPS=y
+CONFIG_PANIC_TIMEOUT=86400
+"""
+
+
+class BuildError(Exception):
+    pass
+
+
+@dataclass
+class KernelBuilder:
+    kernel_src: str
+    out_dir: str
+    defconfig: str = "defconfig"
+    config_fragment: str = ""
+    jobs: int = 4
+    make: str = "make"
+    env: dict = field(default_factory=dict)
+
+    def _run(self, *target: str) -> str:
+        env = dict(os.environ)
+        env.update(self.env)
+        res = subprocess.run(
+            [self.make, f"O={self.out_dir}", *target],
+            cwd=self.kernel_src, capture_output=True, text=True,
+            env=env)
+        if res.returncode != 0:
+            raise BuildError(
+                f"make {' '.join(target)} failed:\n{res.stderr[-2048:]}")
+        return res.stdout
+
+    def configure(self) -> str:
+        """defconfig + fuzzing fragment + olddefconfig; returns the
+        .config path."""
+        os.makedirs(self.out_dir, exist_ok=True)
+        self._run(self.defconfig)
+        cfg = os.path.join(self.out_dir, ".config")
+        with open(cfg, "a") as f:
+            f.write("\n# tz fuzzing fragment\n")
+            f.write(FUZZING_CONFIG)
+            if self.config_fragment:
+                f.write(self.config_fragment)
+                if not self.config_fragment.endswith("\n"):
+                    f.write("\n")
+        self._run("olddefconfig")
+        return cfg
+
+    def build(self) -> str:
+        """Build the compressed kernel; returns the bzImage path."""
+        self._run(f"-j{self.jobs}", "bzImage")
+        for rel in ("arch/x86/boot/bzImage", "bzImage"):
+            p = os.path.join(self.out_dir, rel)
+            if os.path.exists(p):
+                return p
+        raise BuildError(f"bzImage not found under {self.out_dir}")
+
+    def make_image(self, image_dir: str,
+                   executor: Optional[str] = None) -> dict:
+        """Package {kernel, initrd} for qemu -kernel/-initrd boot.
+
+        The initramfs is a newc cpio with /init (mounts proc/sys/dev,
+        brings up loopback, idles on the console so the manager's ssh/
+        pipe wiring can take over) and optionally /bin/tz-executor."""
+        os.makedirs(image_dir, exist_ok=True)
+        bz = self.build()
+        kernel_out = os.path.join(image_dir, "bzImage")
+        _copy(bz, kernel_out)
+        init = ("#!/bin/sh\n"
+                "mount -t proc none /proc 2>/dev/null\n"
+                "mount -t sysfs none /sys 2>/dev/null\n"
+                "mount -t devtmpfs none /dev 2>/dev/null\n"
+                "ip link set lo up 2>/dev/null\n"
+                "echo tz-guest-ready\n"
+                "exec /bin/sh\n").encode()
+        entries = [("init", 0o755, init),
+                   ("bin", 0o40755, b""),
+                   ("proc", 0o40755, b""),
+                   ("sys", 0o40755, b""),
+                   ("dev", 0o40755, b"")]
+        if executor and os.path.exists(executor):
+            with open(executor, "rb") as f:
+                entries.append(("bin/tz-executor", 0o755, f.read()))
+        initrd_out = os.path.join(image_dir, "initramfs.cpio")
+        with open(initrd_out, "wb") as f:
+            f.write(cpio_newc(entries))
+        return {"kernel": kernel_out, "initrd": initrd_out}
+
+
+def _copy(src: str, dst: str) -> None:
+    with open(src, "rb") as fi, open(dst, "wb") as fo:
+        fo.write(fi.read())
+
+
+def cpio_newc(entries: list[tuple[str, int, bytes]]) -> bytes:
+    """Minimal newc ("070701") cpio archive writer.
+
+    entries: (name, mode, data); mode 0o40000-bit marks a directory.
+    Written directly so image creation needs no cpio binary, no root,
+    no loop devices (the reference shells out to external tooling for
+    its image step; a library writer keeps this testable anywhere)."""
+    out = io.BytesIO()
+    ino = 721
+
+    def header(name: str, mode: int, size: int) -> bytes:
+        nonlocal ino
+        ino += 1
+        fields = [
+            ino,          # inode
+            mode if mode & 0o40000 else (0o100000 | mode),
+            0, 0,         # uid, gid
+            2 if mode & 0o40000 else 1,  # nlink
+            0,            # mtime
+            size,
+            0, 0, 0, 0,   # devmajor/minor, rdevmajor/minor
+            len(name) + 1,
+            0,            # check
+        ]
+        return b"070701" + b"".join(b"%08X" % f for f in fields)
+
+    def align(n: int) -> bytes:
+        return b"\0" * ((4 - n % 4) % 4)
+
+    for name, mode, data in entries:
+        hdr = header(name, mode, len(data))
+        out.write(hdr)
+        nb = name.encode() + b"\0"
+        out.write(nb)
+        out.write(align(len(hdr) + len(nb)))
+        out.write(data)
+        out.write(align(len(data)))
+    trailer = "TRAILER!!!"
+    hdr = header(trailer, 0, 0)
+    out.write(hdr)
+    nb = trailer.encode() + b"\0"
+    out.write(nb)
+    out.write(align(len(hdr) + len(nb)))
+    return out.getvalue()

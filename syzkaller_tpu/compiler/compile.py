@@ -83,7 +83,7 @@ class Compiler:
     def __init__(self, desc: A.Description, consts: dict[str, int],
                  os: str, arch: str, ptr_size: int = 8,
                  page_size: int = 4096, num_pages: int = 4096,
-                 auto_nr_base: int = 0):
+                 auto_nr_base: int = 0, strict_nr: bool = False):
         self.desc = desc
         self.consts = dict(consts)
         self.os = os
@@ -99,6 +99,12 @@ class Compiler:
         self.strflags: dict[str, A.StrFlags] = {}
         self.calls: list[A.Call] = []
         self.auto_nr = auto_nr_base
+        # strict_nr: the const set is a real kernel syscall-number
+        # table — a missing __NR_ means the arch lacks the syscall and
+        # the call must be disabled (arm64 vs x86 legacy calls), not
+        # auto-numbered.  Hermetic description sets (test/dsl targets,
+        # unit tests) keep auto-numbering for NR-less calls.
+        self.strict_nr = strict_nr
         self._instantiating: set[str] = set()
         self._declared: set[str] = set()
         self.disabled: list[str] = []
@@ -590,16 +596,10 @@ class Compiler:
                           size=size)
 
     def _declare_calls(self) -> None:
-        # Auto-numbering exists for NR-less description sets (the
-        # hermetic test target).  When the const set carries a real
-        # __NR_ table, a missing entry means the arch genuinely lacks
-        # the syscall (e.g. open/fork on arm64's generic table) — the
-        # call must be disabled, not silently given a fake number.
-        have_nr_table = any(k.startswith("__NR_") for k in self.consts)
         for c in self.calls:
             nr = self.consts.get(f"__NR_{c.call_name}")
             if nr is None:
-                if have_nr_table and not c.call_name.startswith("syz_"):
+                if self.strict_nr and not c.call_name.startswith("syz_"):
                     self.disabled.append(c.name)
                     self.warnings.append(
                         f"{c.pos}: {c.name} disabled: no __NR_"

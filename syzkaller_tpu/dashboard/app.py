@@ -48,10 +48,16 @@ class ReportingStage:
     dashboard/app/reporting.go Reporting + config.go namespace
     Reporting lists).  Typical two-stage setup: a moderation stage
     (access admin, short delay) that a human upstreams, then the
-    public stage."""
+    public stage.
+
+    email_to: per-stage destination list — together with the
+    per-namespace stage lists this forms the reporting-config matrix
+    (namespace x stage -> access/delay/destination; reference:
+    config.go Reporting{Name, AccessLevel, Embargo, Config{Email}})."""
     name: str = "public"
     access: str = ACCESS_PUBLIC
     delay_s: float = 0.0
+    email_to: str = ""
 
     def __post_init__(self):
         if self.access not in _ACCESS_RANK:
@@ -138,11 +144,18 @@ class Dashboard:
 
     def __init__(self, workdir: str, clients: Optional[dict] = None,
                  reporting_delay_s: float = 0.0,
-                 reporting: Optional[dict] = None):
+                 reporting: Optional[dict] = None,
+                 upstream_ns: Optional[str] = None):
         os.makedirs(workdir, exist_ok=True)
         self.workdir = workdir
         self.clients = clients or {}
         self.reporting_delay_s = reporting_delay_s
+        # Cross-namespace dedup target: bugs that exhaust their own
+        # namespace's stage ladder upstream into this namespace, so
+        # the same crash title seen by several downstream namespaces
+        # converges to ONE upstream bug (reference: reporting.go
+        # originalNS -> upstream reporting chains).
+        self.upstream_ns = upstream_ns
         # Per-namespace reporting pipelines; "*" is the fallback.  The
         # default is the single public stage (legacy single-reporting
         # behavior); pass e.g. {"ns": [ReportingStage("moderation",
@@ -371,6 +384,7 @@ class Dashboard:
                                 "num_crashes": bug.num_crashes,
                                 "stage": stage.name,
                                 "access": stage.access,
+                                "email_to": stage.email_to,
                                 "moderation": bug.reporting_idx
                                 < len(stages) - 1})
             if out:
@@ -422,18 +436,67 @@ class Dashboard:
                 out["repro_prog"] = best.repro_prog
             return out
 
+    def _resolve_bug(self, ident: str, prefer_ns: str) -> Optional[Bug]:
+        """Resolve a bug by id or by exact title — the '#syz dup:'
+        command carries a TITLE, and the duplicate may live in another
+        namespace (same-namespace match preferred, then the upstream
+        namespace, then any).  Caller holds the lock."""
+        b = self.bugs.get(ident)
+        if b is not None:
+            return b
+        candidates = [x for x in self.bugs.values() if x.title == ident]
+        for ns in (prefer_ns, self.upstream_ns):
+            for x in candidates:
+                if ns and x.namespace == ns:
+                    return x
+        return candidates[0] if candidates else None
+
     def update_bug(self, bug_id: str, status: Optional[str] = None,
-                   fix_commit: str = "", dup_of: str = "") -> None:
-        """Operator/email commands: fix/invalid/dup
-        (reference: reporting.go incomingCommand)."""
+                   fix_commit: str = "", dup_of: str = "",
+                   undup: bool = False) -> None:
+        """Operator/email commands: fix/invalid/dup/undup
+        (reference: reporting.go incomingCommand).  dup_of accepts a
+        bug id or an exact title, cross-namespace; the duplicate's
+        crash count folds into the canonical bug."""
         with self._lock:
             bug = self.bugs[bug_id]
             if fix_commit:
                 bug.fix_commit = fix_commit
                 bug.status = STATUS_FIXED
             elif dup_of:
-                bug.dup_of = dup_of
+                target = self._resolve_bug(dup_of, bug.namespace)
+                if target is None or target.id == bug.id:
+                    raise KeyError(f"dup target {dup_of!r} not found")
+                # folding into a dup would hide the chain's tail;
+                # point at the canonical end instead.  A walk that
+                # reaches the bug being duped (or revisits a node)
+                # would create a dup CYCLE — reject the command, the
+                # same way a self-dup is rejected.
+                seen = {bug.id}
+                while target.status == STATUS_DUP and target.dup_of:
+                    if target.id in seen:
+                        raise KeyError(
+                            f"dup of {dup_of!r} would create a cycle")
+                    seen.add(target.id)
+                    nxt = self.bugs.get(target.dup_of)
+                    if nxt is None:
+                        break
+                    target = nxt
+                if target.id in seen:
+                    raise KeyError(
+                        f"dup of {dup_of!r} would create a cycle")
+                bug.dup_of = target.id
                 bug.status = STATUS_DUP
+                target.num_crashes += bug.num_crashes
+            elif undup:
+                # un-fold the crash count dup added to the canonical
+                # bug, so dup/undup round-trips do not inflate it
+                target = self.bugs.get(bug.dup_of)
+                if target is not None:
+                    target.num_crashes = max(
+                        0, target.num_crashes - bug.num_crashes)
+                bug.dup_of = ""
+                bug.status = status or STATUS_REPORTED
             elif status:
                 bug.status = status
             self._save()
@@ -456,7 +519,43 @@ class Dashboard:
                 return False
             stages = self.stages_for(bug.namespace)
             if bug.reporting_idx >= len(stages) - 1:
-                return False
+                # Past the namespace's own ladder: cross-namespace
+                # upstreaming.  The bug merges into (or creates) the
+                # upstream namespace's bug for the same title and
+                # becomes its dup — so every downstream namespace
+                # seeing this title converges on ONE upstream bug.
+                if not self.upstream_ns \
+                        or bug.namespace == self.upstream_ns:
+                    return False
+                up_id = hash_string(
+                    f"{self.upstream_ns}\x00{bug.title}".encode())[:16]
+                up = self.bugs.get(up_id)
+                if up is None:
+                    up_stage0 = self.stages_for(self.upstream_ns)[0]
+                    up = Bug(id=up_id, title=bug.title,
+                             namespace=self.upstream_ns,
+                             first_time=bug.first_time, last_time=now,
+                             reporting_due=now + up_stage0.delay_s)
+                    # the upstream bug inherits the crash evidence
+                    up.crashes = list(bug.crashes)
+                    self.bugs[up_id] = up
+                up.num_crashes += bug.num_crashes
+                up.last_time = max(up.last_time, bug.last_time)
+                # merge crash evidence: a later namespace may carry
+                # the only reproducer — a repro crash always lands,
+                # evicting a repro-less one when the bug is full
+                for c in bug.crashes:
+                    if len(up.crashes) < MAX_CRASHES_PER_BUG:
+                        up.crashes.append(c)
+                    elif c.repro_prog:
+                        for i, old in enumerate(up.crashes):
+                            if not old.repro_prog:
+                                up.crashes[i] = c
+                                break
+                bug.status = STATUS_DUP
+                bug.dup_of = up_id
+                self._save()
+                return True
             bug.reporting_idx += 1
             nxt = stages[bug.reporting_idx]
             bug.status = STATUS_NEW

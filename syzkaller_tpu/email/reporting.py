@@ -102,11 +102,15 @@ class EmailReporting:
                 if not cmd.args:
                     self._nack(em, "dup command needs a bug title")
                     continue
-                self.dash.update_bug(bug_id, dup_of=cmd.args)
+                try:
+                    self.dash.update_bug(bug_id, dup_of=cmd.args)
+                except KeyError as e:
+                    self._nack(em, str(e))
+                    continue
             elif cmd.name == "invalid":
                 self.dash.update_bug(bug_id, status="invalid")
             elif cmd.name == "undup":
-                self.dash.update_bug(bug_id, status="reported", dup_of="")
+                self.dash.update_bug(bug_id, undup=True)
             elif cmd.name == "test":
                 parts = cmd.args.split()
                 if not em.patch:

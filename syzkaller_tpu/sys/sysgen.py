@@ -58,7 +58,14 @@ def compile_os(os_name: str, arch: str, root: Path = DESC_ROOT,
     desc = parse_glob(src_files)
     consts = load_const_files(str(p) for p in const_files)
     ptr_size = 4 if arch in ("32", "386", "arm") else 8
-    c = Compiler(desc, consts, os_name, arch, ptr_size=ptr_size)
+    # Strictness is a property of the const set itself: a real-kernel
+    # description set ships a genuine syscall-number table (hundreds
+    # of __NR_ entries), where a missing entry means the arch lacks
+    # the call and it must compile disabled.  Hermetic sets (test/dsl,
+    # unit-test fixtures with a stray __NR_) auto-number instead.
+    nr_entries = sum(1 for k in consts if k.startswith("__NR_"))
+    c = Compiler(desc, consts, os_name, arch, ptr_size=ptr_size,
+                 strict_nr=nr_entries >= 50)
     res = c.compile(register=register)
     res.target.revision = revision_hash(os_name, root)
     return res

@@ -75,6 +75,7 @@ class QemuInstance(Instance):
         self.mem_mb = int(cfg.get("mem", 2048))
         self.cpus = int(cfg.get("cpu", 2))
         self.kernel = cfg.get("kernel", "")
+        self.initrd = cfg.get("initrd", "")
         self.cmdline = cfg.get("cmdline", "")
         self.qemu_args = cfg.get("qemu_args", "")
         self.ssh_port = _free_port()
@@ -105,6 +106,8 @@ class QemuInstance(Instance):
                        "oops=panic panic_on_warn=1 panic=86400 "
                        + self.cmdline)
             args += ["-kernel", self.kernel, "-append", cmdline]
+            if self.initrd:
+                args += ["-initrd", self.initrd]
         if self.qemu_args:
             args += self.qemu_args.split()
         try:

@@ -1,0 +1,142 @@
+"""Cross-namespace dup management + the reporting-config matrix
+(VERDICT r4 ask #7; reference: dashboard/app/reporting.go:1-731
+upstream reporting chains + incomingCommand dup/undup).
+
+Done-when contract verified here: two namespaces sharing a crash
+title dedup to ONE upstream bug, and the email flow round-trips
+#syz dup / #syz undup."""
+
+from __future__ import annotations
+
+from email.message import EmailMessage
+
+import pytest
+
+from syzkaller_tpu.dashboard.app import (
+    ACCESS_ADMIN,
+    ACCESS_PUBLIC,
+    STATUS_DUP,
+    STATUS_REPORTED,
+    Dashboard,
+    ReportingStage,
+)
+from syzkaller_tpu.email import EmailReporting, Mailbox, parse_email
+
+
+@pytest.fixture
+def dash(tmp_path):
+    return Dashboard(
+        str(tmp_path),
+        clients={
+            "stable-mgr": {"key": "k1", "namespace": "stable"},
+            "android-mgr": {"key": "k2", "namespace": "android"},
+            "up-mgr": {"key": "k3", "namespace": "upstream"},
+        },
+        reporting={
+            "stable": [ReportingStage("stable-public", ACCESS_PUBLIC,
+                                      0.0, email_to="stable@lists")],
+            "android": [ReportingStage("android-public", ACCESS_PUBLIC,
+                                       0.0, email_to="android@lists")],
+            "upstream": [ReportingStage("upstream-public",
+                                        ACCESS_PUBLIC, 0.0,
+                                        email_to="lkml@lists")],
+        },
+        upstream_ns="upstream")
+
+
+def _crash(dash, client, key, title):
+    return dash.report_crash({
+        "client": client, "key": key, "manager": client,
+        "title": title, "log": "log", "report": "rep",
+    })["bug_id"]
+
+
+def test_two_namespaces_dedup_to_one_upstream_bug(dash):
+    title = "KASAN: use-after-free in shared_path"
+    b_stable = _crash(dash, "stable-mgr", "k1", title)
+    b_android = _crash(dash, "android-mgr", "k2", title)
+    assert b_stable != b_android  # per-namespace bugs at first
+
+    # both namespaces exhaust their ladder -> upstreaming
+    assert dash.upstream_bug(b_stable)
+    assert dash.upstream_bug(b_android)
+
+    up_ids = {dash.bugs[b_stable].dup_of, dash.bugs[b_android].dup_of}
+    assert len(up_ids) == 1, "must converge on ONE upstream bug"
+    up = dash.bugs[up_ids.pop()]
+    assert up.namespace == "upstream"
+    assert up.title == title
+    assert dash.bugs[b_stable].status == STATUS_DUP
+    assert dash.bugs[b_android].status == STATUS_DUP
+    # crash evidence folded upstream
+    assert up.num_crashes >= 2
+
+    # upstream bug reports through the upstream namespace's stage
+    reports = dash.poll_reports("upstream")
+    assert [r["id"] for r in reports] == [up.id]
+    assert reports[0]["email_to"] == "lkml@lists"
+
+
+def test_upstream_ns_is_terminal(dash):
+    title = "BUG: terminal"
+    up_direct = _crash(dash, "up-mgr", "k3", title)
+    # already in the upstream namespace: no further upstreaming
+    assert not dash.upstream_bug(up_direct)
+
+
+def test_dup_by_title_crosses_namespaces(dash):
+    t1 = "WARNING: odd state in foo"
+    t2 = "WARNING: odd state in foo (stable flavor)"
+    b_up = _crash(dash, "up-mgr", "k3", t1)
+    b_stable = _crash(dash, "stable-mgr", "k1", t2)
+    dash.update_bug(b_stable, dup_of=t1)  # by TITLE, other namespace
+    assert dash.bugs[b_stable].status == STATUS_DUP
+    assert dash.bugs[b_stable].dup_of == b_up
+
+    # dup chains resolve to the canonical end
+    b_android = _crash(dash, "android-mgr", "k2", "third flavor")
+    dash.update_bug(b_android, dup_of=t2)
+    assert dash.bugs[b_android].dup_of == b_up
+
+
+def test_reporting_config_matrix(dash):
+    """Each namespace x stage carries its own access/delay/email
+    destination."""
+    assert dash.stages_for("stable")[0].email_to == "stable@lists"
+    assert dash.stages_for("android")[0].email_to == "android@lists"
+    assert dash.stages_for("upstream")[0].email_to == "lkml@lists"
+    assert dash.stages_for("stable")[0].access == ACCESS_PUBLIC
+
+
+def _reply(reporting, commands):
+    rep = parse_email(reporting.mailbox.outgoing[-1])
+    m = EmailMessage()
+    m["Subject"] = "Re: " + rep.subject
+    m["From"] = "maintainer@kernel.org"
+    m["To"] = rep.from_addr
+    m["In-Reply-To"] = rep.msg_id
+    m["Message-ID"] = f"<r{len(reporting.mailbox.outgoing)}@k.org>"
+    m.set_content(commands + "\n")
+    reporting.mailbox.deliver(bytes(m))
+
+
+def test_email_round_trips_dup_and_undup(dash):
+    mbox = Mailbox()
+    reporting = EmailReporting(dash, mbox)
+    canonical = "BUG: canonical crash"
+    flavor = "BUG: crash flavor two"
+    b_can = _crash(dash, "up-mgr", "k3", canonical)
+    b_dup = _crash(dash, "up-mgr", "k3", flavor)
+    assert reporting.poll_and_send() == 2
+
+    # the last-sent report is the flavor bug; mark it a dup by title
+    _reply(reporting, f"#syz dup: {canonical}")
+    assert reporting.process_incoming() == 1
+    assert dash.bugs[b_dup].status == STATUS_DUP
+    assert dash.bugs[b_dup].dup_of == b_can
+
+    # and undo it
+    _reply(reporting, "#syz undup")
+    assert reporting.process_incoming() == 1
+    assert dash.bugs[b_dup].status == STATUS_REPORTED
+    assert dash.bugs[b_dup].dup_of == ""

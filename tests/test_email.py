@@ -91,7 +91,9 @@ def test_lifecycle_invalid_and_dup(dash):
     _reply(reporting, "#syz dup: WARNING in bar")
     reporting.process_incoming()
     assert dash.bugs[b2].status == STATUS_DUP
-    assert dash.bugs[b2].dup_of == "WARNING in bar"
+    # dup targets resolve to the canonical bug id (cross-namespace
+    # dup management, r5): the title names it, the id is stored
+    assert dash.bugs[b2].dup_of == b1
 
     # undup restores the reported state.
     _reply(reporting, "#syz undup")

@@ -1,0 +1,115 @@
+"""Kernel build + image pipeline on a stub makefile tree (VERDICT r4
+ask #9; reference: pkg/kernel/kernel.go, syz-ci/manager.go:235).
+
+The stub tree implements the same make targets a kernel tree exposes
+(defconfig / olddefconfig / bzImage), so the pipeline driver is
+exercised end to end — configure writes and normalizes .config with
+the fuzzing fragment, build produces the bzImage, make_image packages
+a bootable {kernel, initrd} pair whose initramfs is a valid newc cpio
+containing /init and the executor."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+import pytest
+
+from syzkaller_tpu.ci.ci import CI, CIConfig, ManagedInstance
+from syzkaller_tpu.ci.kernel import (
+    BuildError,
+    FUZZING_CONFIG,
+    KernelBuilder,
+    cpio_newc,
+)
+
+STUB_MAKEFILE = r"""
+O ?= .
+defconfig:
+	mkdir -p $(O)/arch/x86/boot
+	printf 'CONFIG_64BIT=y\n' > $(O)/.config
+olddefconfig:
+	printf '# normalized\n' >> $(O)/.config
+bzImage:
+	mkdir -p $(O)/arch/x86/boot
+	printf 'FAKEKERNEL' > $(O)/arch/x86/boot/bzImage
+broken:
+	exit 1
+"""
+
+
+@pytest.fixture
+def stub_tree(tmp_path):
+    src = tmp_path / "linux"
+    src.mkdir()
+    (src / "Makefile").write_text(STUB_MAKEFILE)
+    return str(src)
+
+
+def test_configure_build_image(stub_tree, tmp_path):
+    out = str(tmp_path / "kbuild")
+    kb = KernelBuilder(kernel_src=stub_tree, out_dir=out)
+    cfg = kb.configure()
+    text = open(cfg).read()
+    assert "CONFIG_64BIT=y" in text          # defconfig ran
+    assert "CONFIG_KCOV=y" in text           # fuzzing fragment applied
+    assert "CONFIG_KASAN=y" in text
+    assert text.endswith("# normalized\n")   # olddefconfig ran last
+
+    image = kb.make_image(str(tmp_path / "image"))
+    assert open(image["kernel"], "rb").read() == b"FAKEKERNEL"
+    data = open(image["initrd"], "rb").read()
+    assert data.startswith(b"070701")        # newc magic
+    assert b"init\0" in data
+    assert b"TRAILER!!!" in data
+
+
+def test_image_packs_executor(stub_tree, tmp_path):
+    exe = tmp_path / "tz-executor"
+    exe.write_bytes(b"\x7fELF-fake")
+    kb = KernelBuilder(kernel_src=stub_tree, out_dir=str(tmp_path / "o"))
+    kb.configure()
+    image = kb.make_image(str(tmp_path / "img"), executor=str(exe))
+    data = open(image["initrd"], "rb").read()
+    assert b"bin/tz-executor\0" in data
+    assert b"\x7fELF-fake" in data
+
+
+def test_build_failure_surfaces(stub_tree, tmp_path):
+    kb = KernelBuilder(kernel_src=stub_tree, out_dir=str(tmp_path / "o"),
+                       defconfig="broken")
+    with pytest.raises(BuildError):
+        kb.configure()
+
+
+def test_cpio_is_readable_by_system_cpio(tmp_path):
+    """The archive must round-trip through the system cpio/file tools
+    when present — it is what the kernel's initramfs loader parses."""
+    import shutil
+
+    data = cpio_newc([("init", 0o755, b"#!/bin/sh\n"),
+                      ("bin", 0o40755, b""),
+                      ("bin/x", 0o644, b"payload-bytes")])
+    p = tmp_path / "t.cpio"
+    p.write_bytes(data)
+    if shutil.which("cpio"):
+        res = subprocess.run(["cpio", "-it"], input=data,
+                             capture_output=True, timeout=30)
+        names = res.stdout.decode().split()
+        assert names == ["init", "bin", "bin/x"], (names, res.stderr)
+    else:
+        assert data.startswith(b"070701")
+
+
+def test_ci_drives_kernel_pipeline(stub_tree, tmp_path):
+    ci = CI(CIConfig(workdir=str(tmp_path / "ci"), managers=[]))
+    m = ManagedInstance(name="kmgr", kernel_src=stub_tree)
+    assert ci._build(m)
+    assert m.last_build_ok
+    assert os.path.exists(m.image["kernel"])
+    assert os.path.exists(m.image["initrd"])
+
+    bad = ManagedInstance(name="bad", kernel_src=stub_tree,
+                          kernel_defconfig="broken")
+    assert not ci._build(bad)
+    assert "make broken failed" in bad.last_error
