@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import io
 import os
+import shutil
 import subprocess
 from dataclasses import dataclass, field
 from typing import Optional
@@ -110,7 +111,7 @@ class KernelBuilder:
         os.makedirs(image_dir, exist_ok=True)
         bz = self.build()
         kernel_out = os.path.join(image_dir, "bzImage")
-        _copy(bz, kernel_out)
+        shutil.copyfile(bz, kernel_out)
         init = ("#!/bin/sh\n"
                 "mount -t proc none /proc 2>/dev/null\n"
                 "mount -t sysfs none /sys 2>/dev/null\n"
@@ -130,11 +131,6 @@ class KernelBuilder:
         with open(initrd_out, "wb") as f:
             f.write(cpio_newc(entries))
         return {"kernel": kernel_out, "initrd": initrd_out}
-
-
-def _copy(src: str, dst: str) -> None:
-    with open(src, "rb") as fi, open(dst, "wb") as fo:
-        fo.write(fi.read())
 
 
 def cpio_newc(entries: list[tuple[str, int, bytes]]) -> bytes:

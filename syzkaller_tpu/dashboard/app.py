@@ -107,6 +107,10 @@ class Bug:
     reporting_stage: str = ""  # stage name at which last reported
     fix_commit: str = ""
     dup_of: str = ""
+    # crashes folded into dup_of at dup time — undup subtracts exactly
+    # this, not the current count (crashes keep deduping into THIS bug
+    # after the dup, never forwarded)
+    dup_folded: int = 0
     # Message-IDs of the report mails (one per reporting stage);
     # threads replies back to the bug across restarts — a reply to an
     # older stage's thread must still resolve (reference:
@@ -464,6 +468,12 @@ class Dashboard:
                 bug.fix_commit = fix_commit
                 bug.status = STATUS_FIXED
             elif dup_of:
+                if bug.status == STATUS_DUP:
+                    # correcting a dup requires an undup first —
+                    # silently re-folding would double-count into the
+                    # new target while the old stays inflated
+                    raise KeyError(
+                        f"bug {bug_id} is already a dup; undup first")
                 target = self._resolve_bug(dup_of, bug.namespace)
                 if target is None or target.id == bug.id:
                     raise KeyError(f"dup target {dup_of!r} not found")
@@ -487,15 +497,17 @@ class Dashboard:
                         f"dup of {dup_of!r} would create a cycle")
                 bug.dup_of = target.id
                 bug.status = STATUS_DUP
+                bug.dup_folded = bug.num_crashes
                 target.num_crashes += bug.num_crashes
             elif undup:
-                # un-fold the crash count dup added to the canonical
-                # bug, so dup/undup round-trips do not inflate it
+                # un-fold exactly what dup folded, so round-trips do
+                # not drift the canonical bug's count either way
                 target = self.bugs.get(bug.dup_of)
                 if target is not None:
                     target.num_crashes = max(
-                        0, target.num_crashes - bug.num_crashes)
+                        0, target.num_crashes - bug.dup_folded)
                 bug.dup_of = ""
+                bug.dup_folded = 0
                 bug.status = status or STATUS_REPORTED
             elif status:
                 bug.status = status
@@ -536,9 +548,8 @@ class Dashboard:
                              namespace=self.upstream_ns,
                              first_time=bug.first_time, last_time=now,
                              reporting_due=now + up_stage0.delay_s)
-                    # the upstream bug inherits the crash evidence
-                    up.crashes = list(bug.crashes)
                     self.bugs[up_id] = up
+                    # (crash evidence lands via the merge loop below)
                 up.num_crashes += bug.num_crashes
                 up.last_time = max(up.last_time, bug.last_time)
                 # merge crash evidence: a later namespace may carry
@@ -554,6 +565,7 @@ class Dashboard:
                                 break
                 bug.status = STATUS_DUP
                 bug.dup_of = up_id
+                bug.dup_folded = bug.num_crashes
                 self._save()
                 return True
             bug.reporting_idx += 1

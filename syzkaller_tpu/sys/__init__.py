@@ -17,6 +17,9 @@ from syzkaller_tpu.sys import testtarget  # noqa: F401  (registers test/64)
 from syzkaller_tpu.sys import linux  # noqa: F401  (registers linux/amd64)
 from syzkaller_tpu.sys import freebsd  # noqa: F401  (registers freebsd/amd64)
 from syzkaller_tpu.sys import netbsd  # noqa: F401  (registers netbsd/amd64)
+from syzkaller_tpu.sys import fuchsia  # noqa: F401  (registers fuchsia/amd64)
+from syzkaller_tpu.sys import windows  # noqa: F401  (registers windows/amd64)
+from syzkaller_tpu.sys import akaros  # noqa: F401  (registers akaros/amd64)
 from syzkaller_tpu.sys import sysgen
 
 sysgen.register_all()

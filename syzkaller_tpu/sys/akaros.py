@@ -1,0 +1,59 @@
+"""akaros/amd64 target: POSIX-compat model + arch hooks (model-only;
+see sys/descriptions/akaros/sys.txt).  Akaros mmap takes the Linux
+argument shape, so the memory-setup factory mirrors the linux one
+(reference: sys/akaros/init.go)."""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.prog import (
+    Call,
+    ConstArg,
+    PointerArg,
+    make_return_arg,
+)
+from syzkaller_tpu.models.target import Target, register_lazy_target
+
+
+def build_akaros_target(register: bool = False) -> Target:
+    from syzkaller_tpu.compiler.consts import load_const_files
+    from syzkaller_tpu.models.target import register_target
+    from syzkaller_tpu.sys.sysgen import DESC_ROOT, compile_os
+
+    res = compile_os("akaros", "amd64", register=False)
+    t = res.target
+    t.string_dictionary = ["file0", "file1", "dir0"]
+    k = load_const_files(
+        str(p) for p in sorted(
+            (DESC_ROOT / "akaros").glob("*_amd64.const")))
+    mmap_meta = next(c for c in t.syscalls if c.name == "mmap")
+    prot = k.get("PROT_READ", 1) | k.get("PROT_WRITE", 2)
+    mflags = (k.get("MAP_ANONYMOUS", 32) | k.get("MAP_PRIVATE", 2)
+              | k.get("MAP_FIXED", 16))
+
+    def make_mmap(addr: int, size: int) -> Call:
+        a = [
+            PointerArg.make_vma(mmap_meta.args[0], addr, size),
+            ConstArg(mmap_meta.args[1], size),
+            ConstArg(mmap_meta.args[2], prot),
+            ConstArg(mmap_meta.args[3], mflags),
+            ConstArg(mmap_meta.args[4], 0xFFFFFFFFFFFFFFFF),
+            ConstArg(mmap_meta.args[5], 0),
+        ]
+        return Call(meta=mmap_meta, args=a,
+                    ret=make_return_arg(mmap_meta.ret))
+
+    t.make_mmap = make_mmap
+
+    def sanitize(c: Call) -> None:
+        if c.meta.call_name == "kill":
+            sig = c.args[-1]
+            if isinstance(sig, ConstArg) and sig.val in (9, 19):
+                sig.val = 0
+
+    t.sanitize = sanitize
+    if register:
+        register_target(t)
+    return t
+
+
+register_lazy_target("akaros", "amd64", build_akaros_target)

@@ -1,0 +1,64 @@
+"""fuchsia/amd64 target: Zircon handle-centric model + arch hooks.
+
+Model-only on this host (no Zircon kernel), like the reference's
+cross-OS trees; see sys/descriptions/fuchsia/sys.txt for provenance.
+The memory-setup factory maps a VMO through the root VMAR —
+zx_vmar_map is Zircon's mmap (reference: sys/fuchsia/init.go).
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.prog import (
+    Call,
+    ConstArg,
+    PointerArg,
+    make_return_arg,
+)
+from syzkaller_tpu.models.target import Target, register_lazy_target
+
+
+def build_fuchsia_target(register: bool = False) -> Target:
+    from syzkaller_tpu.models.target import register_target
+    from syzkaller_tpu.sys.sysgen import compile_os
+
+    res = compile_os("fuchsia", "amd64", register=False)
+    t = res.target
+    t.string_dictionary = ["fuzz", "proc0", "thr0"]
+    from syzkaller_tpu.compiler.consts import load_const_files
+    from syzkaller_tpu.sys.sysgen import DESC_ROOT
+    k = load_const_files(
+        str(p) for p in sorted(
+            (DESC_ROOT / "fuchsia").glob("*_amd64.const")))
+    mmap_meta = next(c for c in t.syscalls if c.name == "zx_vmar_map")
+    perm = (k.get("ZX_VM_PERM_READ", 1) | k.get("ZX_VM_PERM_WRITE", 2)
+            | k.get("ZX_VM_SPECIFIC", 8))
+
+    def make_mmap(addr: int, size: int) -> Call:
+        a = [
+            ConstArg(mmap_meta.args[0], 0),      # root vmar (handle 0)
+            ConstArg(mmap_meta.args[1], perm),
+            ConstArg(mmap_meta.args[2], addr),
+            ConstArg(mmap_meta.args[3], 0),      # vmo handle
+            ConstArg(mmap_meta.args[4], 0),
+            ConstArg(mmap_meta.args[5], size),
+            PointerArg.make_null(mmap_meta.args[6]),
+        ]
+        return Call(meta=mmap_meta, args=a,
+                    ret=make_return_arg(mmap_meta.ret))
+
+    t.make_mmap = make_mmap
+
+    def sanitize(c: Call) -> None:
+        # a fuzzed zx_process_exit would kill the executor proc
+        if c.meta.call_name == "zx_process_exit":
+            c.meta = next(s for s in t.syscalls
+                          if s.name == "zx_nanosleep")
+            c.args = [ConstArg(c.meta.args[0], 0)]
+
+    t.sanitize = sanitize
+    if register:
+        register_target(t)
+    return t
+
+
+register_lazy_target("fuchsia", "amd64", build_fuchsia_target)

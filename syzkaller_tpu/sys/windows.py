@@ -1,0 +1,51 @@
+"""windows/amd64 target: typed Win32 model + arch hooks.
+
+Model-only on this host (no Windows runtime), like the reference's
+sys/windows tree; see sys/descriptions/windows/sys.txt for
+provenance.  The memory-setup factory is VirtualAlloc, Windows's
+mmap (reference: sys/windows/init.go).
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.prog import (
+    Call,
+    ConstArg,
+    PointerArg,
+    make_return_arg,
+)
+from syzkaller_tpu.models.target import Target, register_lazy_target
+
+
+def build_windows_target(register: bool = False) -> Target:
+    from syzkaller_tpu.compiler.consts import load_const_files
+    from syzkaller_tpu.models.target import register_target
+    from syzkaller_tpu.sys.sysgen import DESC_ROOT, compile_os
+
+    res = compile_os("windows", "amd64", register=False)
+    t = res.target
+    t.string_dictionary = ["fuzz0.tmp", "fuzzdir", "Software\\Fuzz"]
+    k = load_const_files(
+        str(p) for p in sorted(
+            (DESC_ROOT / "windows").glob("*_amd64.const")))
+    mmap_meta = next(c for c in t.syscalls if c.name == "VirtualAlloc")
+    alloc = k.get("MEM_COMMIT", 0x1000) | k.get("MEM_RESERVE", 0x2000)
+    prot = k.get("PAGE_READWRITE", 4)
+
+    def make_mmap(addr: int, size: int) -> Call:
+        a = [
+            PointerArg.make_vma(mmap_meta.args[0], addr, size),
+            ConstArg(mmap_meta.args[1], size),
+            ConstArg(mmap_meta.args[2], alloc),
+            ConstArg(mmap_meta.args[3], prot),
+        ]
+        return Call(meta=mmap_meta, args=a,
+                    ret=make_return_arg(mmap_meta.ret))
+
+    t.make_mmap = make_mmap
+    if register:
+        register_target(t)
+    return t
+
+
+register_lazy_target("windows", "amd64", build_windows_target)
