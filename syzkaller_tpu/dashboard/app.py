@@ -209,6 +209,11 @@ class Dashboard:
             crashes = [Crash(**c) for c in b.pop("crashes", [])]
             bug = Bug(**b)
             bug.crashes = crashes
+            # state written before dup_folded existed: a dup'd bug's
+            # folded count was its own crash count — backfill so a
+            # later undup subtracts what the dup actually added.
+            if bug.status == "dup" and not bug.dup_folded:
+                bug.dup_folded = bug.num_crashes
             # migrate pre-namespace ids (hash(title)) to the
             # namespaced scheme so dedup/reporting state survives the
             # upgrade instead of orphaning every existing bug

@@ -93,9 +93,13 @@ class FuzzerProcess:
             # fuzzer subprocesses to a working backend instead of a
             # wedged tunnel (see utils/jaxenv.py for why env vars
             # alone do not work).
-            from syzkaller_tpu.utils.jaxenv import pin_jax_platform
+            from syzkaller_tpu.utils.jaxenv import (
+                enable_compilation_cache, pin_jax_platform)
 
             pin_jax_platform()
+            # Fuzzer restarts must not re-pay the ~2min tunnel compile
+            # of the pipeline step.
+            enable_compilation_cache()
             from syzkaller_tpu.fuzzer.proc import PipelineMutator
             from syzkaller_tpu.ops.pipeline import DevicePipeline
 

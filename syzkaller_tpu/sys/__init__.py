@@ -6,7 +6,10 @@ Each OS target is described either via the Python builder API
 
   test/64   hermetic fake OS exercising every type-system feature
             (the unit-test target; reference: sys/test)
-  linux/amd64  the linux model (1,487 syscall variants)
+  linux/{amd64,arm64}  the linux model (1,906 syscall variants on
+            amd64; arm64 compiles the same set against its own
+            syscall-number table)
+  android/{amd64,arm64}  linux plus the ION staging surface
   freebsd/amd64  compact FreeBSD model (multi-OS machinery proof)
   netbsd/amd64   compact NetBSD model (model-only cross-OS target)
   dsl/64    syzlang-compiled fake OS (exercises the description
@@ -20,6 +23,7 @@ from syzkaller_tpu.sys import netbsd  # noqa: F401  (registers netbsd/amd64)
 from syzkaller_tpu.sys import fuchsia  # noqa: F401  (registers fuchsia/amd64)
 from syzkaller_tpu.sys import windows  # noqa: F401  (registers windows/amd64)
 from syzkaller_tpu.sys import akaros  # noqa: F401  (registers akaros/amd64)
+from syzkaller_tpu.sys import android  # noqa: F401  (android/{amd64,arm64})
 from syzkaller_tpu.sys import sysgen
 
 sysgen.register_all()

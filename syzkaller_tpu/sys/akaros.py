@@ -15,16 +15,13 @@ from syzkaller_tpu.models.target import Target, register_lazy_target
 
 
 def build_akaros_target(register: bool = False) -> Target:
-    from syzkaller_tpu.compiler.consts import load_const_files
     from syzkaller_tpu.models.target import register_target
-    from syzkaller_tpu.sys.sysgen import DESC_ROOT, compile_os
+    from syzkaller_tpu.sys.sysgen import compile_os, load_os_consts
 
     res = compile_os("akaros", "amd64", register=False)
     t = res.target
     t.string_dictionary = ["file0", "file1", "dir0"]
-    k = load_const_files(
-        str(p) for p in sorted(
-            (DESC_ROOT / "akaros").glob("*_amd64.const")))
+    k = load_os_consts("akaros")
     mmap_meta = next(c for c in t.syscalls if c.name == "mmap")
     prot = k.get("PROT_READ", 1) | k.get("PROT_WRITE", 2)
     mflags = (k.get("MAP_ANONYMOUS", 32) | k.get("MAP_PRIVATE", 2)

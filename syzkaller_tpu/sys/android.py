@@ -1,0 +1,47 @@
+"""android targets: the linux model plus the ION staging surface.
+
+The reference's android tree is exactly this shape — linux
+descriptions with sys/android/ion.txt layered on top (reference:
+sys/android/, the only description set there).  Here the compiler
+merges sys/descriptions/linux/*.txt with sys/descriptions/android/
+ion.txt under one namespace, so ION's openat$ion reuses linux's
+open_flags and the resulting target runs on any linux host executor
+(the ioctls just fail cleanly where /dev/ion does not exist).
+"""
+
+from __future__ import annotations
+
+from syzkaller_tpu.models.target import Target, register_lazy_target
+
+
+def build_android_target(register: bool = False,
+                         arch: str = "amd64") -> Target:
+    from syzkaller_tpu.compiler.compile import Compiler
+    from syzkaller_tpu.compiler.consts import load_const_files
+    from syzkaller_tpu.compiler.parser import parse_glob
+    from syzkaller_tpu.models.target import register_target
+    from syzkaller_tpu.sys.linux import _attach_arch_hooks, _load_consts
+    from syzkaller_tpu.sys.sysgen import DESC_ROOT, revision_hash
+
+    src = sorted((DESC_ROOT / "linux").glob("*.txt")) \
+        + sorted((DESC_ROOT / "android").glob("*.txt"))
+    consts = load_const_files(
+        [str(p) for p in sorted(
+            (DESC_ROOT / "linux").glob(f"*_{arch}.const"))]
+        + [str(p) for p in sorted(
+            (DESC_ROOT / "android").glob(f"*_{arch}.const"))])
+    c = Compiler(parse_glob(src), consts, "android", arch, ptr_size=8,
+                 strict_nr=True)
+    res = c.compile(register=False)
+    t = res.target
+    t.revision = revision_hash("linux") + "+" + revision_hash("android")
+    _attach_arch_hooks(t, _load_consts(arch))
+    if register:
+        register_target(t)
+    return t
+
+
+register_lazy_target("android", "amd64",
+                     lambda: build_android_target(arch="amd64"))
+register_lazy_target("android", "arm64",
+                     lambda: build_android_target(arch="arm64"))

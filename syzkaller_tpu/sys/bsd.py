@@ -18,12 +18,9 @@ from syzkaller_tpu.models.target import Target
 
 
 def load_bsd_consts(os_name: str) -> dict[str, int]:
-    from syzkaller_tpu.compiler.consts import load_const_files
-    from syzkaller_tpu.sys.sysgen import DESC_ROOT
+    from syzkaller_tpu.sys.sysgen import load_os_consts
 
-    return load_const_files(
-        str(p)
-        for p in sorted((DESC_ROOT / os_name).glob("*_amd64.const")))
+    return load_os_consts(os_name)
 
 
 def make_bsd_target_builder(os_name: str, string_dictionary: list[str],

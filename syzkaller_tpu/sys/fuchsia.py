@@ -24,11 +24,8 @@ def build_fuchsia_target(register: bool = False) -> Target:
     res = compile_os("fuchsia", "amd64", register=False)
     t = res.target
     t.string_dictionary = ["fuzz", "proc0", "thr0"]
-    from syzkaller_tpu.compiler.consts import load_const_files
-    from syzkaller_tpu.sys.sysgen import DESC_ROOT
-    k = load_const_files(
-        str(p) for p in sorted(
-            (DESC_ROOT / "fuchsia").glob("*_amd64.const")))
+    from syzkaller_tpu.sys.sysgen import load_os_consts
+    k = load_os_consts("fuchsia")
     mmap_meta = next(c for c in t.syscalls if c.name == "zx_vmar_map")
     perm = (k.get("ZX_VM_PERM_READ", 1) | k.get("ZX_VM_PERM_WRITE", 2)
             | k.get("ZX_VM_SPECIFIC", 8))

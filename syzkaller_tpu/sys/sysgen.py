@@ -37,6 +37,17 @@ def description_arches(os_name: str, root: Path = DESC_ROOT) -> list[str]:
     return sorted(arches)
 
 
+def load_os_consts(os_name: str, arch: str = "amd64",
+                   root: Path = DESC_ROOT) -> dict[str, int]:
+    """The merged const dict of an OS tree for one arch — the same
+    files compile_os feeds the Compiler, for arch-hook modules that
+    need individual values (mmap prot bits, sanitize tables, ...)."""
+    from syzkaller_tpu.compiler.consts import load_const_files
+
+    return load_const_files(
+        str(p) for p in sorted((root / os_name).glob(f"*_{arch}.const")))
+
+
 def revision_hash(os_name: str, root: Path = DESC_ROOT) -> str:
     h = hashlib.sha1()
     for p in sorted((root / os_name).glob("*")):

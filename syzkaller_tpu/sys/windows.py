@@ -18,16 +18,13 @@ from syzkaller_tpu.models.target import Target, register_lazy_target
 
 
 def build_windows_target(register: bool = False) -> Target:
-    from syzkaller_tpu.compiler.consts import load_const_files
     from syzkaller_tpu.models.target import register_target
-    from syzkaller_tpu.sys.sysgen import DESC_ROOT, compile_os
+    from syzkaller_tpu.sys.sysgen import compile_os, load_os_consts
 
     res = compile_os("windows", "amd64", register=False)
     t = res.target
     t.string_dictionary = ["fuzz0.tmp", "fuzzdir", "Software\\Fuzz"]
-    k = load_const_files(
-        str(p) for p in sorted(
-            (DESC_ROOT / "windows").glob("*_amd64.const")))
+    k = load_os_consts("windows")
     mmap_meta = next(c for c in t.syscalls if c.name == "VirtualAlloc")
     alloc = k.get("MEM_COMMIT", 0x1000) | k.get("MEM_RESERVE", 0x2000)
     prot = k.get("PAGE_READWRITE", 4)

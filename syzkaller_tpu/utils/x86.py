@@ -104,6 +104,12 @@ def _parse_spec(name: str, enc: str, modes: int, flags: int = 0) -> Insn:
         elif tok in ("e0F", "e0F38", "e0F3A"):
             flags |= EVEX
             vexmap = {"e0F": 1, "e0F38": 2, "e0F3A": 3}[tok]
+        elif tok in ("x08", "x09", "x0A"):
+            # AMD XOP: VEX-shaped 3-byte form behind the 8F escape,
+            # map_select 8/9/10 (disambiguated from pop_rm by
+            # modrm.reg != 0, which mmmm >= 8 guarantees).
+            flags |= VEX
+            vexmap = {"x08": 8, "x09": 9, "x0A": 10}[tok]
         elif tok.startswith("v"):
             flags |= VEX
             vexmap = {"v0F": 1, "v0F38": 2, "v0F3A": 3}[tok]
@@ -879,6 +885,132 @@ _s("movntsd", "pF2 0F 2B /r m", ALL)
 # the ib slot, so ONE table entry covers the family's length shape;
 # the random imm sweeps the whole suffix space (pfadd..pswapd).
 _s("now3d", "0F 0F /r ib", ALL)
+_s("femms", "0F 0E", ALL)
+
+# ---- VMX VMCS-pointer ops: the memory forms of the 0F C7 group ------
+# (rdrand/rdseed above are the register forms of /6 and /7; _pick
+# resolves by modrm.mod).
+_s("vmptrld", "0F C7 /6 m", ALL, PRIV)
+_s("vmclear", "p66 0F C7 /6 m", ALL, PRIV)
+_s("vmxon", "pF3 0F C7 /6 m", ALL, PRIV)
+_s("vmptrst", "0F C7 /7 m", ALL, PRIV)
+
+# ---- MPX bounds registers (0F 1A/1B prefix planes) ------------------
+_s("bndldx", "0F 1A /r m", PROT32 | LONG64)
+_s("bndstx", "0F 1B /r m", PROT32 | LONG64)
+_s("bndmov", "p66 0F 1A /r", PROT32 | LONG64)
+_s("bndmov_st", "p66 0F 1B /r", PROT32 | LONG64)
+_s("bndcl", "pF3 0F 1A /r", PROT32 | LONG64)
+_s("bndmk", "pF3 0F 1B /r m", PROT32 | LONG64)
+_s("bndcu", "pF2 0F 1A /r", PROT32 | LONG64)
+_s("bndcn", "pF2 0F 1B /r", PROT32 | LONG64)
+
+# ---- string/convert width spellings ---------------------------------
+# The base entries (movsd "A5", cbw "98", ...) already sweep widths
+# via the random 66/REX.W rolls; these named forms pin the width the
+# way the reference's per-width entries do (insw/movsq/cdqe/...).
+for op, nm in [(0x6D, "insw"), (0x6F, "outsw"), (0xA5, "movsw"),
+               (0xA7, "cmpsw"), (0xAB, "stosw"), (0xAD, "lodsw"),
+               (0xAF, "scasw")]:
+    _s(nm, f"p66 {op:02X}", ALL, PRIV if op in (0x6D, 0x6F) else 0)
+for op, nm in [(0xA5, "movsq"), (0xA7, "cmpsq"), (0xAB, "stosq"),
+               (0xAD, "lodsq"), (0xAF, "scasq")]:
+    _s(nm, f"48 {op:02X}", X64)
+_s("cdqe", "48 98", X64)
+_s("cqo", "48 99", X64)
+
+# ---- LOCK-prefixed atomics ------------------------------------------
+# The reference's table carries *_LOCK entries for every lockable
+# memory form; same here, generated from the lockable spec list (the
+# F0 byte rides in the opcode so it is always adjacent, and MEMONLY
+# keeps modrm off the register forms, where LOCK is #UD).
+for i, op in enumerate(_ARITH):
+    base = i * 8
+    if op == "cmp":
+        continue  # cmp has no LOCK form
+    _s(f"{op}_lock", f"F0 {base:02X} /r m", ALL)
+    _s(f"{op}_lock", f"F0 {base + 1:02X} /r m", ALL)
+for d, op in enumerate(_ARITH):
+    if op == "cmp":
+        continue
+    _s(f"{op}_lock", f"F0 80 /{d} ib m", ALL)
+    _s(f"{op}_lock", f"F0 81 /{d} iz m", ALL)
+    _s(f"{op}_lock", f"F0 83 /{d} ib m", ALL)
+_s("inc_lock", "F0 FE /0 m", ALL)
+_s("dec_lock", "F0 FE /1 m", ALL)
+_s("inc_lock", "F0 FF /0 m", ALL)
+_s("dec_lock", "F0 FF /1 m", ALL)
+_s("not_lock", "F0 F6 /2 m", ALL)
+_s("neg_lock", "F0 F6 /3 m", ALL)
+_s("not_lock", "F0 F7 /2 m", ALL)
+_s("neg_lock", "F0 F7 /3 m", ALL)
+_s("xchg_lock", "F0 86 /r m", ALL)
+_s("xchg_lock", "F0 87 /r m", ALL)
+_s("xadd_lock", "F0 0F C0 /r m", ALL)
+_s("xadd_lock", "F0 0F C1 /r m", ALL)
+_s("bts_lock", "F0 0F AB /r m", ALL)
+_s("btr_lock", "F0 0F B3 /r m", ALL)
+_s("btc_lock", "F0 0F BB /r m", ALL)
+_s("bts_lock", "F0 0F BA /5 ib m", ALL)
+_s("btr_lock", "F0 0F BA /6 ib m", ALL)
+_s("btc_lock", "F0 0F BA /7 ib m", ALL)
+_s("cmpxchg_lock", "F0 0F B0 /r m", ALL)
+_s("cmpxchg_lock", "F0 0F B1 /r m", ALL)
+_s("cmpxchg8b_lock", "F0 0F C7 /1 m", ALL)
+
+# ---- AMD FMA4 / VPERMIL2 (VEX 0F3A with the is4 register byte) ------
+_FMA4 = [(0x5C, "vfmaddsubps"), (0x5D, "vfmaddsubpd"),
+         (0x5E, "vfmsubaddps"), (0x5F, "vfmsubaddpd"),
+         (0x68, "vfmaddps"), (0x69, "vfmaddpd"), (0x6A, "vfmaddss"),
+         (0x6B, "vfmaddsd"), (0x6C, "vfmsubps"), (0x6D, "vfmsubpd"),
+         (0x6E, "vfmsubss"), (0x6F, "vfmsubsd"), (0x78, "vfnmaddps"),
+         (0x79, "vfnmaddpd"), (0x7A, "vfnmaddss"), (0x7B, "vfnmaddsd"),
+         (0x7C, "vfnmsubps"), (0x7D, "vfnmsubpd"), (0x7E, "vfnmsubss"),
+         (0x7F, "vfnmsubsd")]
+for b, nm in _FMA4:
+    _s(nm, f"v0F3A p66 {b:02X} /r ib", _VEXM)  # ib = is4 operand
+_s("vpermil2ps", "v0F3A p66 48 /r ib", _VEXM)
+_s("vpermil2pd", "v0F3A p66 49 /r ib", _VEXM)
+
+# ---- AMD XOP map 8: MACs, permutes, rotates-by-imm, compares --------
+_XOP8 = [(0x85, "vpmacssww"), (0x86, "vpmacsswd"), (0x87, "vpmacssdql"),
+         (0x8E, "vpmacssdd"), (0x8F, "vpmacssdqh"), (0x95, "vpmacsww"),
+         (0x96, "vpmacswd"), (0x97, "vpmacsdql"), (0x9E, "vpmacsdd"),
+         (0x9F, "vpmacsdqh"), (0xA2, "vpcmov"), (0xA3, "vpperm"),
+         (0xA6, "vpmadcsswd"), (0xB6, "vpmadcswd"),
+         (0xC0, "vprotb_i"), (0xC1, "vprotw_i"), (0xC2, "vprotd_i"),
+         (0xC3, "vprotq_i"), (0xCC, "vpcomb"), (0xCD, "vpcomw"),
+         (0xCE, "vpcomd"), (0xCF, "vpcomq"), (0xEC, "vpcomub"),
+         (0xED, "vpcomuw"), (0xEE, "vpcomud"), (0xEF, "vpcomuq")]
+for b, nm in _XOP8:
+    _s(nm, f"x08 {b:02X} /r ib", _VEXM)
+
+# ---- AMD XOP map 9: TBM groups, LWP control, frcz, shifts/rotates ---
+for d, nm in [(1, "blcfill"), (2, "blsfill"), (3, "blcs"), (4, "tzmsk"),
+              (5, "blcic"), (6, "blsic"), (7, "t1mskc")]:
+    _s(nm, f"x09 01 /{d}", _VEXM)
+_s("blcmsk", "x09 02 /1", _VEXM)
+_s("blci", "x09 02 /6", _VEXM)
+_s("llwpcb", "x09 12 /0 rr", _VEXM)
+_s("slwpcb", "x09 12 /1 rr", _VEXM)
+_XOP9 = [(0x80, "vfrczps"), (0x81, "vfrczpd"), (0x82, "vfrczss"),
+         (0x83, "vfrczsd"), (0x90, "vprotb"), (0x91, "vprotw"),
+         (0x92, "vprotd"), (0x93, "vprotq"), (0x94, "vpshlb"),
+         (0x95, "vpshlw"), (0x96, "vpshld"), (0x97, "vpshlq"),
+         (0x98, "vpshab"), (0x99, "vpshaw"), (0x9A, "vpshad"),
+         (0x9B, "vpshaq"), (0xC1, "vphaddbw"), (0xC2, "vphaddbd"),
+         (0xC3, "vphaddbq"), (0xC6, "vphaddwd"), (0xC7, "vphaddwq"),
+         (0xCB, "vphadddq"), (0xD1, "vphaddubw"), (0xD2, "vphaddubd"),
+         (0xD3, "vphaddubq"), (0xD6, "vphadduwd"), (0xD7, "vphadduwq"),
+         (0xDB, "vphaddudq"), (0xE1, "vphsubbw"), (0xE2, "vphsubwd"),
+         (0xE3, "vphsubdq")]
+for b, nm in _XOP9:
+    _s(nm, f"x09 {b:02X} /r", _VEXM)
+
+# ---- AMD XOP map A: bextr-imm32 + LWP inserts -----------------------
+_s("bextr_xop", "x0A 10 /r id", _VEXM)
+_s("lwpins", "x0A 12 /0 id", _VEXM)
+_s("lwpval", "x0A 12 /1 id", _VEXM)
 
 INSNS: list[Insn] = [_parse_spec(*e) for e in _SPEC]
 
@@ -912,6 +1044,12 @@ def _build_maps():
             evex.setdefault((insn.vexmap, insn.opcode[-1]), insn)
             continue
         op = insn.opcode
+        # Literal F0 (LOCK) / 48 (REX.W) lead bytes are generation-
+        # side spellings; the decoder consumes them as prefixes, so
+        # the map key is the opcode behind them (the base entry at
+        # that key already provides the same length shape).
+        while len(op) > 1 and op[0] in (0xF0, 0x48):
+            op = op[1:]
         if insn.plusr:
             for r in range(8):
                 b = bytes(op[:-1]) + bytes([op[-1] + r])
@@ -1075,6 +1213,24 @@ def decode(mode: int, data: bytes) -> int:
         # prefix-blind like the VEX path: the (map, opcode) entry may
         # be a different pp-plane's insn, so MEMONLY/REGONLY flags are
         # not enforced here — only length structure is shared.
+        n = _modrm_len(data, pos, asz) if insn.modrm else 0
+        if n < 0:
+            return -1
+        pos += n
+        for tok in insn.imms:
+            pos += _imm_len(tok, osz, asz)
+        return pos if pos <= len(data) else -1
+    # XOP: 8F with map_select >= 8 (bits 0-4 of the next byte).  A
+    # pop_rm modrm has reg == 0, so its byte & 0x1F is always <= 7 —
+    # the two encodings cannot collide.
+    if b0 == 0x8F and pos + 3 < len(data) \
+            and (data[pos + 1] & 0x1F) >= 8:
+        vmap = data[pos + 1] & 0x1F
+        opb = data[pos + 3]
+        insn = _VEXMAP.get((vmap, opb))
+        if insn is None or not (insn.modes & mode):
+            return -1
+        pos += 4
         n = _modrm_len(data, pos, asz) if insn.modrm else 0
         if n < 0:
             return -1
@@ -1256,6 +1412,18 @@ def generate_insn(cfg: Config, r: random.Random) -> bytes:
             asz67 = True
         opb = insn.opcode[-1]
         pp = _PP[insn.mprefix]  # mandatory prefix rides the pp field
+        if insn.vexmap >= 8:
+            # XOP: 8F escape, 3-byte payload only (no 2-byte form).
+            b1 = 0xE0 | insn.vexmap
+            b2 = (r.randrange(256) & 0x7C) | pp
+            out += bytes([0x8F, b1, b2, opb])
+            if insn.modrm:
+                out += _gen_modrm(insn, _addrsize(cfg.mode, asz67), r)
+            for tok in insn.imms:
+                out += _gen_imm(
+                    _imm_len(tok, _opsize(cfg.mode, False, False),
+                             _addrsize(cfg.mode, asz67)), r)
+            return bytes(out)
         if insn.vexmap == 1 and r.randrange(2) == 0:
             # C5 R'vvvvLpp: top two bits must be 11 outside long mode
             # (the prot32 VEX-vs-LDS disambiguation).
@@ -1292,11 +1460,19 @@ def generate_insn(cfg: Config, r: random.Random) -> bytes:
         out.append(insn.mprefix)
         if insn.mprefix == 0x66:
             osz66 = True
-    if cfg.mode == LONG64 and r.randrange(4) == 0:
+    opcode = bytearray(insn.opcode)
+    if opcode[0] == 0xF0:
+        # literal LOCK rides with the legacy prefixes, before REX
+        out.append(0xF0)
+        del opcode[0]
+    rex_literal = len(opcode) > 1 and opcode[0] == 0x48 \
+        and cfg.mode == LONG64
+    if rex_literal:
+        rexw = True  # the spelled REX.W (movsq/cdqe/...) IS the REX
+    elif cfg.mode == LONG64 and r.randrange(4) == 0:
         rex = 0x40 | r.randrange(16)
         rexw = bool(rex & 8)
         out.append(rex)
-    opcode = bytearray(insn.opcode)
     if insn.plusr:
         opcode[-1] += r.randrange(8)
     out += opcode

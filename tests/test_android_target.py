@@ -1,0 +1,69 @@
+"""android targets: the linux model + ION staging surface
+(reference tree: sys/android/ion.txt layered on the linux set)."""
+
+import pytest
+
+from syzkaller_tpu.models.encoding import deserialize_prog, serialize_prog
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.models.target import get_target
+
+ION_CALLS = {
+    "openat$ion", "ioctl$ION_IOC_ALLOC", "ioctl$ION_IOC_FREE",
+    "ioctl$ION_IOC_MAP", "ioctl$ION_IOC_SHARE", "ioctl$ION_IOC_IMPORT",
+    "ioctl$ION_IOC_SYNC", "ioctl$ION_IOC_CUSTOM",
+}
+
+
+@pytest.fixture(scope="module")
+def android():
+    return get_target("android", "amd64")
+
+
+def test_superset_of_linux(android):
+    linux = get_target("linux", "amd64")
+    android_names = {c.name for c in android.syscalls}
+    linux_names = {c.name for c in linux.syscalls}
+    assert linux_names <= android_names
+    assert android_names - linux_names == ION_CALLS
+
+
+def test_ion_calls_enabled(android):
+    by_name = {c.name: c for c in android.syscalls}
+    for name in ION_CALLS:
+        assert name in by_name
+    # the typed opener produces fd_ion, consumed by the ioctls
+    opener = by_name["openat$ion"]
+    assert opener.ret is not None
+    alloc = by_name["ioctl$ION_IOC_ALLOC"]
+    assert alloc.args[0].__class__.__name__ == "ResourceType"
+
+
+def test_ion_ioctl_encodings(android):
+    """ION_IOC_* are _IOWR('I', nr, size) — dir/type/nr/size facts of
+    the 3.18 uapi, spot-checked against the computed encoding."""
+    by_name = {c.name: c for c in android.syscalls}
+
+    def cmd_of(call):
+        return by_name[call].args[1].val
+
+    def iowr(nr, size):
+        return (3 << 30) | (size << 16) | (ord("I") << 8) | nr
+
+    assert cmd_of("ioctl$ION_IOC_ALLOC") == iowr(0, 32)
+    assert cmd_of("ioctl$ION_IOC_FREE") == iowr(1, 4)
+    assert cmd_of("ioctl$ION_IOC_MAP") == iowr(2, 8)
+    assert cmd_of("ioctl$ION_IOC_CUSTOM") == iowr(6, 16)
+
+
+def test_generate_roundtrip_both_arches(android):
+    for t in (android, get_target("android", "arm64")):
+        p = generate_prog(t, RandGen(t, 3), 10)
+        s = serialize_prog(p)
+        assert serialize_prog(deserialize_prog(t, s)) == s
+
+
+def test_arm64_uses_arm64_nr_table():
+    t = get_target("android", "arm64")
+    ioctl = next(c for c in t.syscalls if c.name == "ioctl")
+    assert ioctl.nr == 29  # generic unistd, not amd64's 16

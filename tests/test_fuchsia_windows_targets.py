@@ -43,12 +43,9 @@ def test_fuchsia_handle_model(fuchsia):
     assert create.args[1].elem.name == create.args[2].elem.name
     assert "zx_channel" in create.args[1].elem.name
     # rights constants resolved from the hand const table
-    from syzkaller_tpu.compiler.consts import load_const_files
-    from syzkaller_tpu.sys.sysgen import DESC_ROOT
+    from syzkaller_tpu.sys.sysgen import load_os_consts
 
-    k = load_const_files(
-        str(p) for p in sorted(
-            (DESC_ROOT / "fuchsia").glob("*_amd64.const")))
+    k = load_os_consts("fuchsia")
     assert k["ZX_RIGHT_SAME_RIGHTS"] == 1 << 31
     assert k["ZX_VM_PERM_READ"] == 1
 
