@@ -32,6 +32,7 @@
 #include <sys/mount.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/sysmacros.h>
 #include <sys/types.h>
 #include <unistd.h>
 
@@ -646,6 +647,15 @@ static const char* mount_root() {
   return g_mount_root;
 }
 
+// Register a successful mount for end-of-program teardown; returns
+// false when the table is full.
+static bool register_mount(const char* dir) {
+  std::lock_guard<std::mutex> lk(g_mounts_mu);
+  if (g_nmounts >= kMaxMounts) return false;
+  snprintf(g_mounts[g_nmounts++], sizeof(g_mounts[0]), "%s", dir);
+  return true;
+}
+
 static long pseudo_mount_image(uint64_t fs_addr, uint64_t dir_addr,
                                uint64_t size, uint64_t nsegs,
                                uint64_t segs_addr, uint64_t flags,
@@ -688,13 +698,9 @@ static long pseudo_mount_image(uint64_t fs_addr, uint64_t dir_addr,
   if (res < 0) return res;
   // register for end-of-program unmount; hand back an fd to the root
   // so the program can operate on the mounted fs
-  {
-    std::lock_guard<std::mutex> lk(g_mounts_mu);
-    if (g_nmounts >= kMaxMounts) {
-      umount2(dir, MNT_DETACH);
-      return -EMFILE;
-    }
-    snprintf(g_mounts[g_nmounts++], sizeof(g_mounts[0]), "%s", dir);
+  if (!register_mount(dir)) {
+    umount2(dir, MNT_DETACH);
+    return -EMFILE;
   }
   long dfd = open(dir, O_RDONLY | O_DIRECTORY);
   return dfd < 0 ? -errno : dfd;
@@ -740,6 +746,114 @@ static void pseudo_parent_sweep() {
   }
 }
 
+// syz_init_net_socket: create a socket inside the INIT network
+// namespace — some families (bluetooth HCI/SCO/L2CAP) refuse to
+// exist in the per-proc sandbox netns.  Implementation differs from
+// the reference's pre-opened-fd scheme (common_linux.h kInitNetNsFd):
+// we enter /proc/1/ns/net for the one socket() call and hop back.
+// Requires CAP_SYS_ADMIN in the init userns; degrades to a plain
+// socket() when the hop fails (still a valid socket for fuzzing).
+static long pseudo_init_net_socket(uint64_t family, uint64_t type,
+                                   uint64_t proto) {
+  int self_ns = open("/proc/self/ns/net", O_RDONLY);
+  int init_ns = open("/proc/1/ns/net", O_RDONLY);
+  bool hopped = false;
+  if (self_ns >= 0 && init_ns >= 0 && setns(init_ns, CLONE_NEWNET) == 0)
+    hopped = true;
+  long fd = socket((int)family, (int)type, (int)proto);
+  long err = fd < 0 ? errno : 0;
+  if (hopped && setns(self_ns, CLONE_NEWNET))
+    debugf("init_net_socket: failed to return to proc netns: %d\n",
+           errno);
+  if (self_ns >= 0) close(self_ns);
+  if (init_ns >= 0) close(init_ns);
+  return fd < 0 ? -err : fd;
+}
+
+// Build the fuse mount option string shared by both fuse mounts.
+// mode mixes rootmode type bits with option bits 1/2 (the kernel
+// wants rootmode as octal file-type bits; 1 and 2 select the
+// default_permissions / allow_other options).
+static void fuse_opts(char* buf, size_t cap, int fd, uint64_t mode,
+                      uint64_t uid, uint64_t gid, uint64_t maxread,
+                      uint64_t blksize) {
+  size_t n = (size_t)snprintf(
+      buf, cap, "fd=%d,user_id=%lu,group_id=%lu,rootmode=0%o", fd,
+      (unsigned long)uid, (unsigned long)gid,
+      (unsigned)mode & ~3u);
+  if (maxread && n < cap)
+    n += (size_t)snprintf(buf + n, cap - n, ",max_read=%lu",
+                          (unsigned long)maxread);
+  if (blksize && n < cap)
+    n += (size_t)snprintf(buf + n, cap - n, ",blksize=%lu",
+                          (unsigned long)blksize);
+  if ((mode & 1) && n < cap)
+    n += (size_t)snprintf(buf + n, cap - n, ",default_permissions");
+  if ((mode & 2) && n < cap)
+    n += (size_t)snprintf(buf + n, cap - n, ",allow_other");
+}
+
+// Confine a caller-supplied mount target under the per-proc root
+// (basename only), mkdir it, and return the final path in dir.
+static void confine_mount_dir(uint64_t dir_addr, char* dir,
+                              size_t cap) {
+  char reqdir[64];
+  read_guest_str(dir_addr, reqdir, sizeof(reqdir));
+  const char* base = strrchr(reqdir, '/');
+  base = base ? base + 1 : reqdir;
+  snprintf(dir, cap, "%s/%s", mount_root(), base[0] ? base : "m");
+  mkdir(dir, 0777);
+}
+
+// syz_fuse_mount: open /dev/fuse and mount a filesystem driven by
+// that fd.  The mount is attempted best-effort — the fd alone is
+// useful to the fuzzer (reads pending requests, FUSE_DEV_IOC_CLONE,
+// write$fuse replies), matching reference behavior
+// (executor/common_linux.h syz_fuse_mount: "Ignore errors").
+static long pseudo_fuse_mount(uint64_t target_addr, uint64_t mode,
+                              uint64_t uid, uint64_t gid,
+                              uint64_t maxread, uint64_t flags) {
+  int fd = open("/dev/fuse", O_RDWR);
+  if (fd < 0) return -errno;
+  char dir[160];
+  confine_mount_dir(target_addr, dir, sizeof(dir));
+  char opts[256];
+  fuse_opts(opts, sizeof(opts), fd, mode, uid, gid, maxread, 0);
+  if (mount("", dir, "fuse", flags, opts) == 0 &&
+      !register_mount(dir))
+    umount2(dir, MNT_DETACH);  // table full: do not leak the mount
+  return fd;
+}
+
+// syz_fuseblk_mount: same, but a block-device-backed fuseblk mount.
+// The node is created under the per-proc root at loop device 199 —
+// an index the image pipeline never allocates, so a stray fuseblk
+// daemonless mount cannot collide with syz_mount_image loops.
+static long pseudo_fuseblk_mount(uint64_t target_addr,
+                                 uint64_t blkdev_addr, uint64_t mode,
+                                 uint64_t uid, uint64_t gid,
+                                 uint64_t maxread, uint64_t blksize,
+                                 uint64_t flags) {
+  int fd = open("/dev/fuse", O_RDWR);
+  if (fd < 0) return -errno;
+  char blkreq[64], blkdev[160];
+  read_guest_str(blkdev_addr, blkreq, sizeof(blkreq));
+  const char* base = strrchr(blkreq, '/');
+  base = base ? base + 1 : blkreq;
+  snprintf(blkdev, sizeof(blkdev), "%s/%s", mount_root(),
+           base[0] ? base : "blk");
+  if (mknod(blkdev, S_IFBLK | 0600, makedev(7, 199)) && errno != EEXIST)
+    return fd;  // fd is still useful without the mount
+  char dir[160];
+  confine_mount_dir(target_addr, dir, sizeof(dir));
+  char opts[256];
+  fuse_opts(opts, sizeof(opts), fd, mode, uid, gid, maxread, blksize);
+  if (mount(blkdev, dir, "fuseblk", flags, opts) == 0 &&
+      !register_mount(dir))
+    umount2(dir, MNT_DETACH);
+  return fd;
+}
+
 static long pseudo_read_part_table(uint64_t size, uint64_t nsegs,
                                    uint64_t segs_addr) {
   int img = build_image(size, nsegs, segs_addr);
@@ -776,6 +890,13 @@ static long execute_pseudo(uint32_t nr, const uint64_t* a, int nargs) {
       return pseudo_read_part_table(a[0], a[1], a[2]);
     case kPseudoKvmSetupCpu:
       return kvm_setup_cpu((int)a[0], (int)a[1], a[2], a[3], a[4], a[5]);
+    case kPseudoFuseMount:
+      return pseudo_fuse_mount(a[0], a[1], a[2], a[3], a[4], a[5]);
+    case kPseudoFuseblkMount:
+      return pseudo_fuseblk_mount(a[0], a[1], a[2], a[3], a[4], a[5],
+                                  a[6], a[7]);
+    case kPseudoInitNetSocket:
+      return pseudo_init_net_socket(a[0], a[1], a[2]);
     default:
       return -ENOSYS;
   }

@@ -95,7 +95,10 @@ constexpr uint32_t kPseudoGenetlinkFamily = kPseudoNrBase + 5;
 constexpr uint32_t kPseudoMountImage = kPseudoNrBase + 6;
 constexpr uint32_t kPseudoReadPartTable = kPseudoNrBase + 7;
 constexpr uint32_t kPseudoKvmSetupCpu = kPseudoNrBase + 8;
-constexpr uint32_t kPseudoNrLast = kPseudoKvmSetupCpu;
+constexpr uint32_t kPseudoFuseMount = kPseudoNrBase + 9;
+constexpr uint32_t kPseudoFuseblkMount = kPseudoNrBase + 10;
+constexpr uint32_t kPseudoInitNetSocket = kPseudoNrBase + 11;
+constexpr uint32_t kPseudoNrLast = kPseudoInitNetSocket;
 
 // exec flags (per-request)
 constexpr uint64_t kExecCollectCover = 1 << 0;

@@ -130,6 +130,8 @@ class _Renderer:
         if self.opts.tun or used & {"syz_emit_ethernet",
                                     "syz_extract_tcp_res"}:
             out.append(_C_TUN)
+        if used & {"syz_fuse_mount", "syz_fuseblk_mount"}:
+            out.append(_C_FUSE_OPTS)
         for name in sorted(used):
             out.append(_PSEUDO_C[name])
         return "\n".join(out)
@@ -526,7 +528,73 @@ static void setup_tun(void)
   }
 }"""
 
+# shared option-string builder for the two fuse mount helpers
+_C_FUSE_OPTS = r"""// fuse mount option string (executor twin: pseudo_linux.h fuse_opts)
+static void tz_fuse_opts(char* buf, size_t cap, int fd, long mode,
+                         long uid, long gid, long maxread, long blksize)
+{
+  size_t n = (size_t)snprintf(buf, cap,
+      "fd=%d,user_id=%lu,group_id=%lu,rootmode=0%o", fd,
+      (unsigned long)uid, (unsigned long)gid, (unsigned)mode & ~3u);
+  if (maxread && n < cap)
+    n += (size_t)snprintf(buf + n, cap - n, ",max_read=%lu",
+                          (unsigned long)maxread);
+  if (blksize && n < cap)
+    n += (size_t)snprintf(buf + n, cap - n, ",blksize=%lu",
+                          (unsigned long)blksize);
+  if ((mode & 1) && n < cap)
+    n += (size_t)snprintf(buf + n, cap - n, ",default_permissions");
+  if ((mode & 2) && n < cap)
+    n += (size_t)snprintf(buf + n, cap - n, ",allow_other");
+}"""
+
 _PSEUDO_C = {
+    "syz_fuse_mount": r"""// open /dev/fuse + mount a fs driven by that fd; mount errors are
+// ignored, the fd alone is useful (executor twin: pseudo_fuse_mount)
+static long syz_fuse_mount(long target, long mode, long uid, long gid,
+                           long maxread, long flags)
+{
+  char opts[256];
+  int fd = open("/dev/fuse", O_RDWR);
+  if (fd < 0) return fd;
+  mkdir((char*)target, 0777);
+  tz_fuse_opts(opts, sizeof(opts), fd, mode, uid, gid, maxread, 0);
+  mount("", (char*)target, "fuse", flags, opts);
+  return fd;
+}""",
+    "syz_fuseblk_mount": r"""#include <sys/sysmacros.h>
+static long syz_fuseblk_mount(long target, long blkdev, long mode,
+                              long uid, long gid, long maxread,
+                              long blksize, long flags)
+{
+  char opts[256];
+  int fd = open("/dev/fuse", O_RDWR);
+  if (fd < 0) return fd;
+  if (mknod((char*)blkdev, S_IFBLK | 0600, makedev(7, 199)) &&
+      errno != EEXIST)
+    return fd;
+  mkdir((char*)target, 0777);
+  tz_fuse_opts(opts, sizeof(opts), fd, mode, uid, gid, maxread,
+               blksize);
+  mount((char*)blkdev, (char*)target, "fuseblk", flags, opts);
+  return fd;
+}""",
+    "syz_init_net_socket": r"""#include <sched.h>
+// socket() in the init net namespace; falls back to the current ns
+// (executor twin: pseudo_init_net_socket)
+static long syz_init_net_socket(long family, long type, long proto)
+{
+  long fd;
+  int self_ns = open("/proc/self/ns/net", O_RDONLY);
+  int init_ns = open("/proc/1/ns/net", O_RDONLY);
+  int hopped = self_ns >= 0 && init_ns >= 0 &&
+               setns(init_ns, CLONE_NEWNET) == 0;
+  fd = socket(family, type, proto);
+  if (hopped) setns(self_ns, CLONE_NEWNET);
+  if (self_ns >= 0) close(self_ns);
+  if (init_ns >= 0) close(init_ns);
+  return fd;
+}""",
     "syz_open_dev": r"""static long syz_open_dev(long name, long id, long flags)
 {
   char buf[256], *hash;

@@ -209,9 +209,11 @@ class Dashboard:
             crashes = [Crash(**c) for c in b.pop("crashes", [])]
             bug = Bug(**b)
             bug.crashes = crashes
-            # state written before dup_folded existed: a dup'd bug's
-            # folded count was its own crash count — backfill so a
-            # later undup subtracts what the dup actually added.
+            # state written before dup_folded existed: approximate the
+            # folded count with the dup's current crash count (crashes
+            # that landed on the dup after folding inflate this, but
+            # undup clamps at zero — better than subtracting nothing
+            # and leaving the canonical bug inflated forever).
             if bug.status == "dup" and not bug.dup_folded:
                 bug.dup_folded = bug.num_crashes
             # migrate pre-namespace ids (hash(title)) to the

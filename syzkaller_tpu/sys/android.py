@@ -17,19 +17,16 @@ from syzkaller_tpu.models.target import Target, register_lazy_target
 def build_android_target(register: bool = False,
                          arch: str = "amd64") -> Target:
     from syzkaller_tpu.compiler.compile import Compiler
-    from syzkaller_tpu.compiler.consts import load_const_files
     from syzkaller_tpu.compiler.parser import parse_glob
     from syzkaller_tpu.models.target import register_target
     from syzkaller_tpu.sys.linux import _attach_arch_hooks, _load_consts
-    from syzkaller_tpu.sys.sysgen import DESC_ROOT, revision_hash
+    from syzkaller_tpu.sys.sysgen import (DESC_ROOT, load_os_consts,
+                                          revision_hash)
 
     src = sorted((DESC_ROOT / "linux").glob("*.txt")) \
         + sorted((DESC_ROOT / "android").glob("*.txt"))
-    consts = load_const_files(
-        [str(p) for p in sorted(
-            (DESC_ROOT / "linux").glob(f"*_{arch}.const"))]
-        + [str(p) for p in sorted(
-            (DESC_ROOT / "android").glob(f"*_{arch}.const"))])
+    consts = {**load_os_consts("linux", arch),
+              **load_os_consts("android", arch)}
     c = Compiler(parse_glob(src), consts, "android", arch, ptr_size=8,
                  strict_nr=True)
     res = c.compile(register=False)

@@ -1,0 +1,69 @@
+"""Bluetooth / DRM / ashmem model families + syz_init_net_socket
+(reference: sys/linux/socket_bluetooth.txt, dri.txt, ashmem.txt)."""
+
+import pytest
+
+from syzkaller_tpu.models.encoding import deserialize_prog, serialize_prog
+from syzkaller_tpu.models.generation import generate_prog
+from syzkaller_tpu.models.rand import RandGen
+from syzkaller_tpu.models.target import get_target
+
+
+@pytest.fixture(scope="module")
+def linux():
+    return get_target("linux", "amd64")
+
+
+def test_family_counts(linux):
+    names = {c.name for c in linux.syscalls}
+    bt = [n for n in names if "bt_" in n or n.startswith(
+        ("ioctl$HIDP", "ioctl$CMTP", "ioctl$BNEP"))]
+    drm = [n for n in names if "DRM_IOCTL" in n or "$dri" in n]
+    ash = [n for n in names if "ashmem" in n.lower() or "ASHMEM" in n]
+    assert len(bt) >= 55, bt
+    assert len(drm) >= 55, drm
+    assert len(ash) >= 9, ash
+    assert len(names) >= 2000  # past reference's 1,986 declared variants
+
+
+def test_init_net_socket_nr(linux):
+    by = {c.name: c for c in linux.syscalls}
+    assert by["syz_init_net_socket$bt_hci"].nr == 2164260875
+    assert by["syz_init_net_socket$bt_sco"].nr == 2164260875
+    # HCI ioctl table resolved (spot value: HCIDEVUP = _IOW('H',201,int))
+    hci = by["ioctl$sock_bt_hci"]
+    assert 1074022601 in hci.args[1].vals
+
+
+def test_drm_ioctl_encodings(linux):
+    by = {c.name: c for c in linux.syscalls}
+    assert by["ioctl$DRM_IOCTL_VERSION"].args[1].val == 3225445376
+    assert by["ioctl$DRM_IOCTL_GEM_OPEN"].args[1].val == 3222299659
+    assert by["ioctl$DRM_IOCTL_MODE_GETCRTC"].args[1].val == 3228066977
+    # resource flow: GEM_OPEN consumes a name, produces a handle
+    gem = by["ioctl$DRM_IOCTL_GEM_OPEN"]
+    assert gem.args[2].elem.fields[0].name == "drm_gem_name"
+
+
+def test_generate_serialize_roundtrip(linux):
+    for seed in (11, 12, 13):
+        p = generate_prog(linux, RandGen(linux, seed), 12)
+        s = serialize_prog(p)
+        assert serialize_prog(deserialize_prog(linux, s)) == s
+
+
+def test_executor_init_net_socket(linux):
+    """syz_init_net_socket returns a usable socket fd (falls back to
+    the current netns without privileges)."""
+    import os
+
+    from tests.test_linux_executor import _run_text
+
+    if not os.path.exists("/proc/1/ns/net"):
+        pytest.skip("no /proc/1/ns/net")
+    text = (b"r0 = syz_init_net_socket$bt_hci(0x1f, 0x3, 0x1)\n")
+    res = _run_text(linux, text)
+    assert res.completed
+    # AF_BLUETOOTH may be compiled out of the host kernel; accept
+    # EAFNOSUPPORT/EPROTONOSUPPORT but not a crash
+    assert res.info[0].errno in (0, 97, 93, 22)
