@@ -793,16 +793,16 @@ static void fuse_opts(char* buf, size_t cap, int fd, uint64_t mode,
     n += (size_t)snprintf(buf + n, cap - n, ",allow_other");
 }
 
-// Confine a caller-supplied mount target under the per-proc root
-// (basename only), mkdir it, and return the final path in dir.
+// Confine a caller-supplied path under the per-proc root (basename
+// only); optionally mkdir it (mount targets yes, device nodes no).
 static void confine_mount_dir(uint64_t dir_addr, char* dir,
-                              size_t cap) {
+                              size_t cap, bool make_dir = true) {
   char reqdir[64];
   read_guest_str(dir_addr, reqdir, sizeof(reqdir));
   const char* base = strrchr(reqdir, '/');
   base = base ? base + 1 : reqdir;
   snprintf(dir, cap, "%s/%s", mount_root(), base[0] ? base : "m");
-  mkdir(dir, 0777);
+  if (make_dir) mkdir(dir, 0777);
 }
 
 // syz_fuse_mount: open /dev/fuse and mount a filesystem driven by
@@ -836,12 +836,9 @@ static long pseudo_fuseblk_mount(uint64_t target_addr,
                                  uint64_t flags) {
   int fd = open("/dev/fuse", O_RDWR);
   if (fd < 0) return -errno;
-  char blkreq[64], blkdev[160];
-  read_guest_str(blkdev_addr, blkreq, sizeof(blkreq));
-  const char* base = strrchr(blkreq, '/');
-  base = base ? base + 1 : blkreq;
-  snprintf(blkdev, sizeof(blkdev), "%s/%s", mount_root(),
-           base[0] ? base : "blk");
+  char blkdev[160];
+  confine_mount_dir(blkdev_addr, blkdev, sizeof(blkdev),
+                    /*make_dir=*/false);
   if (mknod(blkdev, S_IFBLK | 0600, makedev(7, 199)) && errno != EEXIST)
     return fd;  // fd is still useful without the mount
   char dir[160];

@@ -705,9 +705,29 @@ def serve_dashboard(workdir: str, addr: tuple[str, int] = ("127.0.0.1", 0),
                                 or b.status == status_filter)
                             and (not ns_filter
                                  or b.namespace == ns_filter)]
+                    ns_counts: dict = {}
+                    for b in dash.bugs.values():
+                        row = ns_counts.setdefault(
+                            b.namespace, {"open": 0, "fixed": 0,
+                                          "other": 0})
+                        key = ("open" if b.status in ("new", "open")
+                               else "fixed" if b.status == "fixed"
+                               else "other")
+                        row[key] += 1
                 snap.sort(key=lambda r: -r[4])
                 from urllib.parse import quote
 
+                # namespace summary header (reference: main.go
+                # handleMain renders per-namespace bug groups)
+                summary = "".join(
+                    f"<tr><td><a href='/?ns={quote(ns, safe='')}'>"
+                    f"{html_mod.escape(ns)}</a></td>"
+                    f"<td>{c['open']}</td><td>{c['fixed']}</td>"
+                    f"<td>{c['other']}</td></tr>"
+                    for ns, c in sorted(ns_counts.items()))
+                head = ("<table border=1><tr><th>namespace</th>"
+                        "<th>open</th><th>fixed</th><th>other</th>"
+                        f"</tr>{summary}</table><hr>")
                 rows = "".join(
                     f"<tr><td><a href='/bug?id={bid}'>"
                     f"{html_mod.escape(title)}</a></td>"
@@ -716,7 +736,7 @@ def serve_dashboard(workdir: str, addr: tuple[str, int] = ("127.0.0.1", 0),
                     f"<td>{status}</td><td>{n}</td>"
                     f"<td>{'yes' if has_repro else ''}</td></tr>"
                     for bid, title, ns, status, n, has_repro in snap)
-                self._html("bugs", "<table border=1>"
+                self._html("bugs", head + "<table border=1>"
                            "<tr><th>title</th><th>namespace</th>"
                            "<th>status</th>"
                            f"<th>crashes</th><th>repro</th></tr>{rows}"
@@ -747,12 +767,81 @@ def serve_dashboard(workdir: str, addr: tuple[str, int] = ("127.0.0.1", 0),
                              f"<td>{'prog' if c.repro_prog else ''}"
                              f"{' C' if c.repro_c else ''}</td></tr>")
                 body += "</table>"
+                # text-blob links per crash (reference: main.go
+                # /x/log.txt /x/repro.syz /x/repro.c)
+                links = []
+                for i, c in enumerate(crashes):
+                    if c.log:
+                        links.append(f"<a href='/x/log.txt?id={bid}"
+                                     f"&crash={i}'>log{i}</a>")
+                    if c.report:
+                        links.append(f"<a href='/x/report.txt?id={bid}"
+                                     f"&crash={i}'>report{i}</a>")
+                    if c.repro_prog:
+                        links.append(f"<a href='/x/repro.syz?id={bid}"
+                                     f"&crash={i}'>repro{i}.syz</a>")
+                    if c.repro_c:
+                        links.append(f"<a href='/x/repro.c?id={bid}"
+                                     f"&crash={i}'>repro{i}.c</a>")
+                if links:
+                    body += "<p>" + " | ".join(links) + "</p>"
                 repro = next((c.repro_prog for c in crashes
                               if c.repro_prog), "")
                 if repro:
                     body += (f"<h3>reproducer</h3><pre>"
                              f"{html_mod.escape(repro)}</pre>")
                 self._html(title, body)
+            elif url.path in ("/text", "/x/log.txt", "/x/report.txt",
+                              "/x/repro.syz", "/x/repro.c",
+                              "/x/patch.diff"):
+                tag = {"/x/log.txt": "log", "/x/report.txt": "report",
+                       "/x/repro.syz": "repro_syz",
+                       "/x/repro.c": "repro_c",
+                       "/x/patch.diff": "patch"}.get(url.path) \
+                    or q.get("tag", [""])[0]
+                ident = q.get("id", [""])[0]
+                try:
+                    ci = int(q.get("crash", ["0"])[0] or 0)
+                except ValueError:
+                    ci = 0
+                if tag not in ("log", "report", "repro_syz",
+                               "repro_c", "patch"):
+                    return self._reply(404, b"no such text",
+                                       "text/plain")
+                if tag == "patch":
+                    with dash._lock:
+                        job = dash.jobs.get(ident)
+                        data = job.patch if job else None
+                else:
+                    with dash._lock:
+                        bug = dash.bugs.get(ident)
+                        crash = bug.crashes[ci] if bug \
+                            and 0 <= ci < len(bug.crashes) else None
+                        if crash is None:
+                            data = None
+                        elif tag == "repro_syz":
+                            data = crash.repro_prog
+                        elif tag == "repro_c":
+                            data = crash.repro_c
+                        else:
+                            data = getattr(crash, tag, "")
+                    if tag in ("log", "report") and data:
+                        # stored as a blob file; confine to workdir in
+                        # case state.json was tampered with
+                        path = os.path.realpath(data)
+                        root = os.path.realpath(dash.workdir)
+                        if path.startswith(root + os.sep):
+                            try:
+                                with open(path) as f:
+                                    data = f.read()
+                            except OSError:
+                                data = None
+                        else:
+                            data = None
+                if not data:
+                    return self._reply(404, b"no such text",
+                                       "text/plain")
+                self._reply(200, data.encode(), "text/plain")
             elif url.path == "/builds":
                 with dash._lock:
                     snap = sorted(dash.builds.values(),

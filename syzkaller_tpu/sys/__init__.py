@@ -6,7 +6,7 @@ Each OS target is described either via the Python builder API
 
   test/64   hermetic fake OS exercising every type-system feature
             (the unit-test target; reference: sys/test)
-  linux/{amd64,arm64}  the linux model (2,033 syscall variants on
+  linux/{amd64,arm64}  the linux model (2,062 syscall variants on
             amd64; arm64 compiles the same set against its own
             syscall-number table)
   android/{amd64,arm64}  linux plus the ION staging surface

@@ -412,3 +412,40 @@ def test_dashboard_namespace_migration_and_jobs(tmp_path):
     import pytest as _pytest
     with _pytest.raises(PermissionError):
         dash2.report_crash({"client": "c", "title": "t"})
+
+
+def test_web_text_blobs_and_ns_summary(tmp_path):
+    """Plain-text blob endpoints + namespace summary (reference:
+    dashboard/app/main.go /x/log.txt, /x/repro.syz, handleMain)."""
+    from urllib.request import urlopen
+
+    srv, dash = serve_dashboard(str(tmp_path),
+                                clients={"mgr": "secret"})
+    try:
+        host, port = srv.server_address
+        c = DashClient(f"{host}:{port}", client="mgr", key="secret")
+        res = c.report_crash("m1", "BUG: web blob", log="the log text",
+                            repro_prog="r0 = open()\n", repro_c="int main")
+        bid = res["bug_id"]
+        base = f"http://{host}:{port}"
+        assert urlopen(f"{base}/x/log.txt?id={bid}&crash=0").read() \
+            == b"the log text"
+        assert urlopen(f"{base}/x/repro.syz?id={bid}").read() \
+            == b"r0 = open()\n"
+        assert urlopen(f"{base}/x/repro.c?id={bid}").read() \
+            == b"int main"
+        assert urlopen(
+            f"{base}/text?tag=repro_syz&id={bid}").read().startswith(b"r0")
+        main = urlopen(base + "/").read().decode()
+        assert "namespace" in main and "open" in main
+        bugpage = urlopen(f"{base}/bug?id={bid}").read().decode()
+        assert "/x/log.txt" in bugpage and "repro0.syz" in bugpage
+        # unknown blob 404s
+        import urllib.error
+        try:
+            urlopen(f"{base}/x/patch.diff?id=nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.shutdown()
