@@ -1,8 +1,21 @@
 """tz-bench-watch: measure early and often, survive the wedge.
 
 The tunneled TPU backend can wedge for hours (every jax op blocks).
-This watcher probes the device on a cadence and, whenever it answers,
-records real measurements: the flagship bench (appends to
+This watcher drives measurement attempts DIRECTLY — the bench
+subprocess's own PJRT client is the probe.  Round-5 thread-level
+evidence (BENCH_WEDGE_DIAGNOSIS.md §"lease flap") showed why a
+separate probe client is actively harmful: the plugin's Client_Create
+sits in an endless sleep-retry reconnect loop (main thread in
+nanosleep, tokio IO worker in ep_poll) until the far-side pool grants
+a session, and the pool serves one client at a time — so a probe
+client that wins the grant *starves the measurement client that
+follows it* (observed live: probe served 03:17:19, measurement client
+12 s later starved >600 s).  A long-running measurement attempt is
+therefore both the probe and a standing lease-catcher: it queues in
+the retry loop and converts the grant directly into a recorded
+artifact instead of a throwaway 64x64 matmul.
+
+Whenever an attempt lands, it records: the flagship bench (appends to
 BENCH_HISTORY.jsonl via bench.py's journal) and, once, the A/B
 edges-per-hour artifact (BENCH_AB_r<N>.json).  After `--want` flagship
 entries plus the A/B artifact it exits and leaves the chip alone —
@@ -13,7 +26,7 @@ Reference analog: syz-manager's -bench minutely snapshots
 measurement, not one attempt at shutdown.
 
 Usage: python -m syzkaller_tpu.tools.bench_watch [--want 3] [--ab-secs 60]
-       [--probe-interval 600] [--round 4]
+       [--probe-interval 600] [--round 5]
 """
 
 from __future__ import annotations
@@ -46,16 +59,43 @@ def probe(timeout_s: float = 240.0) -> bool:
     return res.returncode == 0 and "OK" in res.stdout
 
 
-def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
-    """On probe timeout: capture WHAT hangs, not just that it hangs.
+def _thread_table(pid: int) -> list[str]:
+    """comm + kernel wait channel of every thread of `pid`.
 
-    Three layers, logged in order:
+    This is the evidence layer that pinpointed the round-5 wedge mode:
+    a hung Client_Create shows main=hrtimer_nanosleep (the plugin's
+    reconnect backoff) + tokio-rt-worker=ep_poll (IO runtime waiting
+    on the socket) — an endless retry loop, not a deadlock.
+    """
+    rows = []
+    try:
+        for tid in sorted(os.listdir(f"/proc/{pid}/task")):
+            base = f"/proc/{pid}/task/{tid}"
+            try:
+                with open(f"{base}/comm") as f:
+                    comm = f.read().strip()
+                with open(f"{base}/wchan") as f:
+                    wchan = f.read().strip() or "?"
+            except OSError:
+                continue
+            rows.append(f"tid {tid} {comm}: wchan={wchan}")
+    except OSError:
+        pass
+    return rows
+
+
+def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
+    """On measurement timeout: capture WHAT hangs, not just that it hangs.
+
+    Four layers, logged in order:
     1. Python stack of the hung init (faulthandler dump while
        jax.devices() blocks) — distinguishes backend-init vs dispatch.
-    2. The transport endpoint the axon plugin dials
+    2. Thread table of the hung subprocess (/proc wchan) — tells an
+       idle retry loop (nanosleep + ep_poll) from a hard deadlock.
+    3. The transport endpoint the axon plugin dials
        (PALLAS_AXON_POOL_IPS : relay port) — TCP connect/greeting
        behavior tells loopback-listener state from upstream state.
-    3. Who owns the listener (ss -tlnp), so 'wedged?' has a subject.
+    4. Who owns the listener (ss -tlnp), so 'wedged?' has a subject.
     """
     code = ("import faulthandler\n"
             f"faulthandler.dump_traceback_later({stack_timeout_s - 5},"
@@ -63,14 +103,20 @@ def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
             "import jax\n"
             "jax.devices()\n"
             "print('DEVICES-OK')\n")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, cwd=REPO)
+    # Sample the thread table while it is (presumably) hung, before
+    # the faulthandler exit fires.
+    time.sleep(min(20.0, stack_timeout_s / 2))
+    threads = _thread_table(proc.pid)
     try:
-        res = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True,
-                             timeout=stack_timeout_s, cwd=REPO)
-        out = (res.stdout + res.stderr).strip()
-    except subprocess.TimeoutExpired as e:
-        out = ((e.stdout or b"").decode(errors="replace") +
-               (e.stderr or b"").decode(errors="replace")).strip()
+        stdout, stderr = proc.communicate(timeout=stack_timeout_s)
+        out = (stdout + stderr).strip()
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        stdout, stderr = proc.communicate()
+        out = ((stdout or "") + (stderr or "")).strip()
     if "DEVICES-OK" in out:
         log("diagnose: backend init succeeded this time (transient)")
         return
@@ -80,6 +126,10 @@ def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
     log("diagnose: hung init stack (innermost first):")
     for ln in frames[:12]:
         log(f"  {ln.strip()}")
+    log("diagnose: hung-process threads (nanosleep+ep_poll = plugin "
+        "reconnect-retry loop waiting for a pool lease):")
+    for row in threads[:8]:
+        log(f"  {row}")
     pool_ip = os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0]
     if pool_ip:
         import socket
@@ -142,11 +192,17 @@ def flagship_entries() -> int:
 
 
 def run_bench(args: list[str], timeout_s: float) -> dict | None:
+    # Give the pipeline warmup most of the subprocess budget: the
+    # warmup's first batch is where a starved PJRT client waits for
+    # the pool lease, so a short warmup timeout would abandon the
+    # standing-lease-catcher role (module docstring) early.
+    env = dict(os.environ,
+               TZ_BENCH_WARMUP_TIMEOUT_S=str(int(timeout_s - 300)))
     try:
         res = subprocess.run([sys.executable, "bench.py",
                               "--no-preflight"] + args,
                              capture_output=True, text=True,
-                             timeout=timeout_s, cwd=REPO)
+                             timeout=timeout_s, cwd=REPO, env=env)
     except subprocess.TimeoutExpired:
         log(f"bench {args} timed out after {timeout_s:.0f}s")
         return None
@@ -175,7 +231,7 @@ def main() -> None:
     opts = ap.parse_args()
 
     ab_path = os.path.join(REPO, f"BENCH_AB_r{opts.round:02d}.json")
-    failed_probes = 0
+    failed_attempts = 0
     while True:
         have = flagship_entries()
         ab_done = os.path.exists(ab_path)
@@ -183,32 +239,38 @@ def main() -> None:
             log(f"done: {have} flagship entries + A/B artifact; "
                 "leaving the chip alone")
             return
-        if not probe():
-            failed_probes += 1
-            log(f"device wedged/unreachable (probe #{failed_probes}); "
-                "retrying later")
-            if opts.diagnose_every and \
-                    failed_probes % opts.diagnose_every == 1:
-                diagnose_wedge()
-            time.sleep(opts.probe_interval)
-            continue
-        failed_probes = 0
-        log("device healthy")
-        # Priority: one flagship first (proves the chip), then the
-        # never-yet-recorded A/B artifact, then the remaining flagship
-        # entries for journal depth.
+        # No separate probe client: the measurement subprocess IS the
+        # probe.  Its PJRT client queues in the plugin's reconnect
+        # loop and converts a pool-lease grant directly into a
+        # recorded artifact (see module docstring).  Priority: one
+        # flagship first (proves the chip), then the never-yet-
+        # recorded A/B artifact, then journal depth.
         if have >= 1 and not ab_done:
-            r = run_bench(["--ab", str(opts.ab_secs)], timeout_s=1800)
+            what = "A/B"
+            r = run_bench(["--ab", str(opts.ab_secs)], timeout_s=2700)
             if r is not None:
                 with open(ab_path, "w") as f:
                     json.dump(r, f)
                     f.write("\n")
                 log(f"A/B artifact written: {ab_path}")
         else:
-            r = run_bench([], timeout_s=1800)
-            if r is not None:
+            what = "flagship"
+            r = run_bench([], timeout_s=2700)
+            if r is not None and r.get("value", 0) > 0:
                 log(f"flagship: {r.get('value')} mutants/s "
                     f"(vs_baseline {r.get('vs_baseline')})")
+            elif r is not None:
+                r = None  # an error JSON is a failed attempt
+        if r is None:
+            failed_attempts += 1
+            log(f"{what} attempt #{failed_attempts} did not land "
+                "(lease never granted or bench failed); retrying")
+            if opts.diagnose_every and \
+                    failed_attempts % opts.diagnose_every == 1:
+                diagnose_wedge()
+            time.sleep(opts.probe_interval)
+            continue
+        failed_attempts = 0
         time.sleep(opts.measure_interval)
 
 
