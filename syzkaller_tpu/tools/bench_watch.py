@@ -46,19 +46,6 @@ def log(msg: str) -> None:
     print(f"[bench-watch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
-def probe(timeout_s: float = 240.0) -> bool:
-    code = ("import jax, jax.numpy as jnp;"
-            "x = jnp.ones((64, 64));"
-            "print('OK', float((x @ x).sum()))")
-    try:
-        res = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True,
-                             timeout=timeout_s, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        return False
-    return res.returncode == 0 and "OK" in res.stdout
-
-
 def _thread_table(pid: int) -> list[str]:
     """comm + kernel wait channel of every thread of `pid`.
 
@@ -248,6 +235,17 @@ def main() -> None:
         if have >= 1 and not ab_done:
             what = "A/B"
             r = run_bench(["--ab", str(opts.ab_secs)], timeout_s=2700)
+            # Same eligibility bar as flagship_entries: an error JSON,
+            # a platform-pinned (CPU) run, or a malformed payload must
+            # not permanently mark the round's accelerator A/B done.
+            if r is not None and (
+                    r.get("error") or r.get("platform")
+                    or r.get("metric") != "new_edges_sim_kernel_ab"
+                    or not r.get("engine_on")):
+                log(f"A/B attempt produced an ineligible result "
+                    f"(error={r.get('error')!r} "
+                    f"platform={r.get('platform')!r}); not recording")
+                r = None
             if r is not None:
                 with open(ab_path, "w") as f:
                     json.dump(r, f)

@@ -6,12 +6,20 @@ pkg/ifuzz/generated/insns.go generated table, pkg/ifuzz/pseudo.go
 hand-written system sequences).  We build the same capability from a
 compact declarative opcode-map spec (NASM/SDM-style lines, parsed at
 import into Insn records) instead of shipping a 100k-line generated
-literal: ~1,600 instructions covering the full one-byte map, the 0F
+literal: ~1,900 instructions covering the full one-byte map, the 0F
 map with its 66/F3/F2 mandatory-prefix planes (SSE2/SSE3 scalar+
-packed), x87 (memory groups, register families, control ops),
-SSSE3/SSE4 via 0F38/0F3A with prefixes, AES/SHA/CLMUL, the VMX/SVM
-virtualization sets, BMI1/2, the VEX AVX/AVX2/FMA planes, and an
-EVEX AVX-512-foundation plane.
+packed), the bare-MMX integer rows and the 3DNow! suffix plane, x87
+(memory groups, register families, control ops), SSSE3/SSE4 via
+0F38/0F3A with prefixes, AES/SHA/CLMUL, the VMX/SVM virtualization
+sets, XSAVE/TSX/CET system state, LOCK-spelled atomics, BMI1/2, the
+VEX AVX/AVX2/FMA planes (incl. AVX2 shift-imm groups and VSIB
+gathers), AMD XOP/FMA4/TBM, an EVEX AVX-512 plane
+(F/BW/DQ promotions + VNNI/IFMA/VBMI/BITALG/VPOPCNTDQ/BF16), and
+GFNI/VAES/VPCLMULQDQ in all three encodings — the post-2017 families
+are coverage the reference's generated table predates.  Width
+variants the reference tables as separate rows (r8/r16/r32/r64,
+XSAVE64 vs XSAVE) fold into one row here via the prefix/REX rolls,
+except where the 64-bit layout differs (the 48-spelled entries).
 
 Three capabilities mirror the reference API:
   * generate(cfg, r)  - emit one structurally-valid instruction
@@ -68,6 +76,8 @@ class Insn:
     imms: tuple = ()
     mprefix: int = 0       # mandatory prefix byte (0x66/0xF3/0xF2)
                            # — VEX specs encode it as the pp field
+    suffix: int = -1       # fixed opcode-suffix byte in the ib slot
+                           # (3DNow!: 0F 0F modrm <op>), -1 = none
 
     @property
     def priv(self) -> bool:
@@ -83,6 +93,7 @@ def _parse_spec(name: str, enc: str, modes: int, flags: int = 0) -> Insn:
     opcode = bytearray()
     plusr = modrm = False
     reg = -1
+    suffix = -1
     imms = []
     vexmap = 0
     mprefix = 0
@@ -101,6 +112,10 @@ def _parse_spec(name: str, enc: str, modes: int, flags: int = 0) -> Insn:
             flags |= REGONLY
         elif tok in _MPREFIX:
             mprefix = _MPREFIX[tok]
+        elif len(tok) == 3 and tok[0] == "s":
+            # fixed opcode-suffix byte occupying the ib slot (3DNow!)
+            suffix = int(tok[1:], 16)
+            imms.append("ib")
         elif tok in ("e0F", "e0F38", "e0F3A"):
             flags |= EVEX
             vexmap = {"e0F": 1, "e0F38": 2, "e0F3A": 3}[tok]
@@ -117,7 +132,7 @@ def _parse_spec(name: str, enc: str, modes: int, flags: int = 0) -> Insn:
             opcode.append(int(tok, 16))
     return Insn(name, modes, flags, bytes(opcode), vexmap=vexmap,
                 plusr=plusr, modrm=modrm, reg=reg, imms=tuple(imms),
-                mprefix=mprefix)
+                mprefix=mprefix, suffix=suffix)
 
 
 # -- the opcode-map spec ----------------------------------------------
@@ -132,6 +147,14 @@ _SPEC: list = []
 
 def _s(name, enc, modes, flags=0):
     _SPEC.append((name, enc, modes, flags))
+
+
+def _vx(nm: str) -> str:
+    """VEX/EVEX dual name of a legacy entry: the _x suffix marks the
+    xmm form only where a same-named MMX form exists in the legacy
+    maps; V/EVEX encodings have no MMX duals, so the plain name is
+    the correct (and reference-matching) spelling."""
+    return nm[:-2] if nm.endswith("_x") else nm
 
 
 # One-byte map: the 8 classic ALU families at 00,08,10,18,20,28,30,38.
@@ -387,8 +410,15 @@ _s("subps", "0F 5C /r", ALL)
 _s("minps", "0F 5D /r", ALL)
 _s("divps", "0F 5E /r", ALL)
 _s("maxps", "0F 5F /r", ALL)
-for b in range(0x60, 0x6C):  # punpck/packss/pcmpgt/packus MMX row
-    _s("mmx_60", f"0F {b:02X} /r", ALL)
+# punpck/packss/pcmpgt/packus MMX row (the p66 duals carry the plain
+# names in the SSE2 plane below; these are the mm-register forms)
+for b, nm in [(0x60, "punpcklbw_mmx"), (0x61, "punpcklwd_mmx"),
+              (0x62, "punpckldq_mmx"), (0x63, "packsswb_mmx"),
+              (0x64, "pcmpgtb_mmx"), (0x65, "pcmpgtw_mmx"),
+              (0x66, "pcmpgtd_mmx"), (0x67, "packuswb_mmx"),
+              (0x68, "punpckhbw_mmx"), (0x69, "punpckhwd_mmx"),
+              (0x6A, "punpckhdq_mmx"), (0x6B, "packssdw_mmx")]:
+    _s(nm, f"0F {b:02X} /r", ALL)
 _s("movd", "0F 6E /r", ALL)
 _s("movq", "0F 6F /r", ALL)
 _s("pshufw", "0F 70 /r ib", ALL)
@@ -458,15 +488,29 @@ _s("pextrw", "0F C5 /r ib rr", ALL)
 _s("shufps", "0F C6 /r ib", ALL)
 _s("cmpxchg8b", "0F C7 /1 m", ALL)
 _s("bswap", "0F C8 +r", ALL)
-for b in list(range(0xD1, 0xD4)) + [0xD5, 0xD7] + \
-        list(range(0xD8, 0xE0)):   # MMX arithmetic rows
-    _s("mmx_d", f"0F {b:02X} /r", ALL)
-for b in list(range(0xE0, 0xE6)) + list(range(0xE8, 0xF0)):
-    _s("mmx_e", f"0F {b:02X} /r", ALL)
+# MMX arithmetic rows D1-FE: same opcode positions as the 66-prefixed
+# SSE2 plane below, operating on mm registers (SDM table A-3, no-pfx
+# column).  These carry the reference's plain names; the xmm duals
+# keep their _x suffix.
+for b, nm in [(0xD1, "psrlw"), (0xD2, "psrld"), (0xD3, "psrlq"),
+              (0xD4, "paddq"), (0xD5, "pmullw"),
+              (0xD8, "psubusb"), (0xD9, "psubusw"), (0xDA, "pminub"),
+              (0xDB, "pand"), (0xDC, "paddusb"), (0xDD, "paddusw"),
+              (0xDE, "pmaxub"), (0xDF, "pandn"),
+              (0xE0, "pavgb"), (0xE1, "psraw"), (0xE2, "psrad"),
+              (0xE3, "pavgw"), (0xE4, "pmulhuw"), (0xE5, "pmulhw"),
+              (0xE8, "psubsb"), (0xE9, "psubsw"), (0xEA, "pminsw"),
+              (0xEB, "por"), (0xEC, "paddsb"), (0xED, "paddsw"),
+              (0xEE, "pmaxsw"), (0xEF, "pxor"),
+              (0xF1, "psllw"), (0xF2, "pslld"), (0xF3, "psllq"),
+              (0xF4, "pmuludq"), (0xF5, "pmaddwd"), (0xF6, "psadbw"),
+              (0xF8, "psubb"), (0xF9, "psubw"), (0xFA, "psubd"),
+              (0xFB, "psubq"), (0xFC, "paddb"), (0xFD, "paddw"),
+              (0xFE, "paddd")]:
+    _s(nm, f"0F {b:02X} /r", ALL)
 _s("movntq", "0F E7 /r m", ALL)
-for b in list(range(0xF1, 0xF7)) + list(range(0xF8, 0xFF)):
-    _s("mmx_f", f"0F {b:02X} /r", ALL)
 _s("maskmovq", "0F F7 /r rr", ALL)
+_s("pmovmskb", "0F D7 /r rr", ALL)
 
 # 0F38 / 0F3A maps (SSSE3/SSE4 subset; all take modrm).
 for b, nm in [(0x00, "pshufb"), (0x01, "phaddw"), (0x02, "phaddd"),
@@ -713,7 +757,7 @@ for enc, nm in [("D9 D0", "fnop"), ("D9 E0", "fchs"), ("D9 E1", "fabs"),
 # v66 0F: AVX duals of the whole 66-prefixed SSE2 plane (AVX/AVX2).
 for b, nm in _SSE2_66_0F:
     suffix = " m" if nm in _SSE2_MEMONLY else ""
-    _s(f"v{nm}", f"v0F p66 {b:02X} /r{suffix}", _VEXM)
+    _s(f"v{_vx(nm)}", f"v0F p66 {b:02X} /r{suffix}", _VEXM)
 _s("vmovmskpd", "v0F p66 50 /r rr", _VEXM)
 _s("vpshufd", "v0F p66 70 /r ib", _VEXM)
 _s("vcmppd", "v0F p66 C2 /r ib", _VEXM)
@@ -726,9 +770,9 @@ _s("vpmovmskb", "v0F p66 D7 /r rr", _VEXM)
 for b, nm in _SSE_F3_0F:
     if nm in ("popcnt", "tzcnt", "lzcnt"):
         continue
-    _s(f"v{nm}", f"v0F pF3 {b:02X} /r", _VEXM)
+    _s(f"v{_vx(nm)}", f"v0F pF3 {b:02X} /r", _VEXM)
 for b, nm in _SSE_F2_0F:
-    _s(f"v{nm}", f"v0F pF2 {b:02X} /r", _VEXM)
+    _s(f"v{_vx(nm)}", f"v0F pF2 {b:02X} /r", _VEXM)
 _s("vcmpss", "v0F pF3 C2 /r ib", _VEXM)
 _s("vcmpsd", "v0F pF2 C2 /r ib", _VEXM)
 _s("vpshufhw", "v0F pF3 70 /r ib", _VEXM)
@@ -751,7 +795,7 @@ _s("vshufps", "v0F C6 /r ib", _VEXM)
 for b, nm in _SSE4_66_0F38:
     if nm == "adcx":
         continue
-    _s(f"v{nm}", f"v0F38 p66 {b:02X} /r", _VEXM)
+    _s(f"v{_vx(nm)}", f"v0F38 p66 {b:02X} /r", _VEXM)
 for b, nm in [(0x0C, "vpermilps"), (0x0D, "vpermilpd"),
               (0x0E, "vtestps"), (0x0F, "vtestpd"),
               (0x13, "vcvtph2ps"), (0x16, "vpermps"), (0x18, "vbroadcastss_x"),
@@ -763,12 +807,39 @@ for b, nm in [(0x0C, "vpermilps"), (0x0D, "vpermilpd"),
               (0x78, "vpbroadcastb"), (0x79, "vpbroadcastw"),
               (0x8C, "vpmaskmovd"), (0x8E, "vpmaskmovd_st")]:
     _s(nm, f"v0F38 p66 {b:02X} /r", _VEXM)
-for b in range(0x90, 0x94):  # VSIB gathers: memory-only
-    _s(f"vgather_{b:02X}", f"v0F38 p66 {b:02X} /r m", _VEXM)
-for base in (0x96, 0x98, 0x9A, 0x9C, 0x9E, 0xA6, 0xA8, 0xAA, 0xAC,
-             0xAE, 0xB6, 0xB8, 0xBA, 0xBC, 0xBE):
-    _s(f"vfma_{base:02X}", f"v0F38 p66 {base:02X} /r", _VEXM)
-    _s(f"vfma_{base + 1:02X}", f"v0F38 p66 {base + 1:02X} /r", _VEXM)
+for b, nm in [(0x90, "vpgatherdd"), (0x91, "vpgatherqd"),
+              (0x92, "vgatherdps"), (0x93, "vgatherqps")]:
+    _s(nm, f"v0F38 p66 {b:02X} /r m", _VEXM)  # VSIB: memory-only
+# FMA3: three accumulation orders x {packed, scalar}; VEX.W picks
+# s/d within an entry, so each opcode is one table row.
+_FMA3 = {0x96: "vfmaddsub132ps", 0x97: "vfmsubadd132ps",
+         0x98: "vfmadd132ps", 0x99: "vfmadd132ss",
+         0x9A: "vfmsub132ps", 0x9B: "vfmsub132ss",
+         0x9C: "vfnmadd132ps", 0x9D: "vfnmadd132ss",
+         0x9E: "vfnmsub132ps", 0x9F: "vfnmsub132ss"}
+for base, nm in _FMA3.items():
+    _s(nm, f"v0F38 p66 {base:02X} /r", _VEXM)
+    _s(nm.replace("132", "213"), f"v0F38 p66 {base + 0x10:02X} /r",
+       _VEXM)
+    _s(nm.replace("132", "231"), f"v0F38 p66 {base + 0x20:02X} /r",
+       _VEXM)
+# AVX2 shift-by-immediate groups (VEX duals of the p66 0F 71-73
+# groups; vvvv carries the destination).
+for grp, ops in ((0x71, ((2, "vpsrlw_i"), (4, "vpsraw_i"),
+                         (6, "vpsllw_i"))),
+                 (0x72, ((2, "vpsrld_i"), (4, "vpsrad_i"),
+                         (6, "vpslld_i"))),
+                 (0x73, ((2, "vpsrlq_i"), (3, "vpsrldq_i"),
+                         (6, "vpsllq_i"), (7, "vpslldq_i")))):
+    for d, nm in ops:
+        _s(nm, f"v0F p66 {grp:02X} /{d} rr ib", _VEXM)
+_s("vmaskmovdqu", "v0F p66 F7 /r rr", _VEXM)
+_s("vmovntdq", "v0F p66 E7 /r m", _VEXM)
+_s("vmovntpd", "v0F p66 2B /r m", _VEXM)
+_s("vmovntps", "v0F 2B /r m", _VEXM)
+_s("vzeroupper", "v0F 77", _VEXM)   # VEX.L picks vzeroall; one row
+_s("vldmxcsr", "v0F AE /2 m", _VEXM)
+_s("vstmxcsr", "v0F AE /3 m", _VEXM)
 
 # BMI1/BMI2 (VEX-encoded GPR ops).
 _s("andn", "v0F38 F2 /r", _VEXM)
@@ -786,7 +857,7 @@ _s("shrx", "v0F38 pF2 F7 /r", _VEXM)
 
 # v66 0F3A: immediates plane + AVX2 + F16C + RORX.
 for b, nm in _SSE4_66_0F3A:
-    _s(f"v{nm}", f"v0F3A p66 {b:02X} /r ib", _VEXM)
+    _s(f"v{_vx(nm)}", f"v0F3A p66 {b:02X} /r ib", _VEXM)
 for b, nm in [(0x00, "vpermq"), (0x01, "vpermpd"), (0x02, "vpblendd"),
               (0x04, "vpermilps_i"), (0x05, "vpermilpd_i"),
               (0x06, "vperm2f128"), (0x1D, "vcvtps2ph"),
@@ -804,13 +875,13 @@ _s("rorx", "v0F3A pF2 F0 /r ib", _VEXM)
 
 for b, nm in _SSE2_66_0F:
     suffix = " m" if nm in _SSE2_MEMONLY else ""
-    _s(f"ev_{nm}", f"e0F p66 {b:02X} /r{suffix}", _VEXM)
+    _s(f"ev_{_vx(nm)}", f"e0F p66 {b:02X} /r{suffix}", _VEXM)
 for b, nm in _SSE_F3_0F:
     if nm in ("popcnt", "tzcnt", "lzcnt"):
         continue
-    _s(f"ev_{nm}", f"e0F pF3 {b:02X} /r", _VEXM)
+    _s(f"ev_{_vx(nm)}", f"e0F pF3 {b:02X} /r", _VEXM)
 for b, nm in _SSE_F2_0F:
-    _s(f"ev_{nm}", f"e0F pF2 {b:02X} /r", _VEXM)
+    _s(f"ev_{_vx(nm)}", f"e0F pF2 {b:02X} /r", _VEXM)
 for base in (0x96, 0x98, 0x9A, 0x9C, 0x9E, 0xA6, 0xA8, 0xAA, 0xAC,
              0xAE, 0xB6, 0xB8, 0xBA, 0xBC, 0xBE):
     _s(f"ev_fma_{base:02X}", f"e0F38 p66 {base:02X} /r", _VEXM)
@@ -825,6 +896,45 @@ for b, nm in [(0x16, "evpermps"), (0x1F, "evpabsq"), (0x36, "evpermd"),
               (0xC4, "evpconflictd"), (0xC8, "evexp2ps_er"),
               (0xCA, "evrcp28ps"), (0xCC, "evrsqrt28ps")]:
     _s(nm, f"e0F38 p66 {b:02X} /r", _VEXM)
+# EVEX promotions of the 66 0F38 integer plane (AVX-512F/BW/DQ
+# subset with a 1:1 legacy dual; blendv/ptest got replaced by
+# mask-register ops and are deliberately absent).
+for b, nm in _SSE4_66_0F38:
+    if nm in ("pblendvb", "blendvps", "blendvpd", "ptest", "adcx"):
+        continue
+    _s(f"ev_{_vx(nm)}", f"e0F38 p66 {b:02X} /r", _VEXM)
+# Post-AVX2 ISA families the 2017-era reference table predates:
+# GFNI, VAES, VPCLMULQDQ, AVX-512 VNNI / VPOPCNTDQ / BITALG / IFMA /
+# VBMI and the BF16 plane — both VEX and EVEX spellings where both
+# exist (SDM vol. 2 current maps).
+_s("gf2p8mulb", "p66 0F 38 CF /r", ALL)
+_s("gf2p8affineqb", "p66 0F 3A CE /r ib", ALL)
+_s("gf2p8affineinvqb", "p66 0F 3A CF /r ib", ALL)
+_s("vgf2p8mulb", "v0F38 p66 CF /r", _VEXM)
+_s("vgf2p8affineqb", "v0F3A p66 CE /r ib", _VEXM)
+_s("vgf2p8affineinvqb", "v0F3A p66 CF /r ib", _VEXM)
+_s("ev_gf2p8mulb", "e0F38 p66 CF /r", _VEXM)
+_s("ev_gf2p8affineqb", "e0F3A p66 CE /r ib", _VEXM)
+_s("ev_gf2p8affineinvqb", "e0F3A p66 CF /r ib", _VEXM)
+for b, nm in [(0x50, "vpdpbusd"), (0x51, "vpdpbusds"),
+              (0x52, "vpdpwssd"), (0x53, "vpdpwssds")]:
+    _s(nm, f"v0F38 p66 {b:02X} /r", _VEXM)          # AVX-VNNI
+    _s(f"ev_{nm[1:]}", f"e0F38 p66 {b:02X} /r", _VEXM)
+_s("evpopcntd", "e0F38 p66 55 /r", _VEXM)           # VPOPCNTDQ
+_s("evpopcntb", "e0F38 p66 54 /r", _VEXM)           # BITALG
+_s("evpshufbitqmb", "e0F38 p66 8F /r", _VEXM)
+_s("evpmadd52luq", "e0F38 p66 B4 /r", _VEXM)        # IFMA
+_s("evpmadd52huq", "e0F38 p66 B5 /r", _VEXM)
+_s("evpermb", "e0F38 p66 8D /r", _VEXM)             # VBMI
+_s("evpmultishiftqb", "e0F38 p66 83 /r", _VEXM)
+_s("evpermi2b", "e0F38 p66 75 /r", _VEXM)
+_s("evpermt2b", "e0F38 p66 7D /r", _VEXM)
+_s("evcvtne2ps2bf16", "e0F38 pF2 72 /r", _VEXM)     # BF16
+_s("evcvtneps2bf16", "e0F38 pF3 72 /r", _VEXM)
+_s("evdpbf16ps", "e0F38 pF3 52 /r", _VEXM)
+# (VAES-512 ev_aesenc.. arrive via the promotion loop above)
+_s("ev_pclmulqdq", "e0F3A p66 44 /r ib", _VEXM)     # VPCLMULQDQ-512
+
 for b, nm in [(0x03, "evalignd"), (0x08, "evrndscaleps"),
               (0x09, "evrndscalepd"), (0x0A, "evrndscaless"),
               (0x0B, "evrndscalesd"), (0x19, "evextractf32x4"),
@@ -881,11 +991,58 @@ _s("movntsd", "pF2 0F 2B /r m", ALL)
 # (SSE4a extrq/insertq omitted: 0F 78/79 collide with vmread/vmwrite
 # and differ in imm length only by prefix — the length decoder's
 # two-byte map is prefix-blind by design.)
-# 3DNow: 0F 0F modrm + operation-suffix byte.  The suffix occupies
-# the ib slot, so ONE table entry covers the family's length shape;
-# the random imm sweeps the whole suffix space (pfadd..pswapd).
+# 3DNow!: 0F 0F modrm + operation-suffix byte (AMD appendix D).  The
+# named entries pin the defined suffixes via the sXX token; the
+# `now3d` wildcard keeps sweeping the UNDEFINED suffix space — for a
+# fuzzer both matter.  All share the (0F,0F) length shape.
+for sfx, nm in [(0x0C, "pi2fw"), (0x0D, "pi2fd"), (0x1C, "pf2iw"),
+                (0x1D, "pf2id"), (0x8A, "pfnacc"), (0x8E, "pfpnacc"),
+                (0x90, "pfcmpge"), (0x94, "pfmin"), (0x96, "pfrcp"),
+                (0x97, "pfrsqrt"), (0x9A, "pfsub"), (0x9E, "pfadd"),
+                (0xA0, "pfcmpgt"), (0xA4, "pfmax"), (0xA6, "pfrcpit1"),
+                (0xA7, "pfrsqit1"), (0xAA, "pfsubr"), (0xAE, "pfacc"),
+                (0xB0, "pfcmpeq"), (0xB4, "pfmul"), (0xB6, "pfrcpit2"),
+                (0xB7, "pmulhrw"), (0xBB, "pswapd"), (0xBF, "pavgusb")]:
+    _s(nm, f"0F 0F /r s{sfx:02X}", ALL)
 _s("now3d", "0F 0F /r ib", ALL)
 _s("femms", "0F 0E", ALL)
+
+# SSE reg-reg movers that share opcodes with the MEMONLY movlps/movhps
+# rows (mod=3 selects the register form per SDM).
+_s("movhlps", "0F 12 /r rr", ALL)
+_s("movlhps", "0F 16 /r rr", ALL)
+_s("pause", "F3 90", ALL)
+
+# XSAVE-state family: compacted/supervisor forms + the REX.W-spelled
+# 64-bit layouts the reference tables as separate entries.
+_s("xsaveopt", "0F AE /6 m", ALL)
+_s("xsavec", "0F C7 /4 m", ALL)
+_s("xsaves", "0F C7 /5 m", ALL, PRIV)
+_s("xrstors", "0F C7 /3 m", ALL, PRIV)
+for nm, enc in [("fxsave64", "48 0F AE /0 m"),
+                ("fxrstor64", "48 0F AE /1 m"),
+                ("xsave64", "48 0F AE /4 m"),
+                ("xrstor64", "48 0F AE /5 m"),
+                ("xsaveopt64", "48 0F AE /6 m"),
+                ("xsavec64", "48 0F C7 /4 m"),
+                ("xsaves64", "48 0F C7 /5 m"),
+                ("xrstors64", "48 0F C7 /3 m")]:
+    _s(nm, enc, X64, PRIV if "xsaves" in nm or "xrstors" in nm else 0)
+
+# TSX: XBEGIN's rel is operand-size wide; XABORT carries a status imm.
+_s("xbegin", "C7 F8 cz", ALL)
+_s("xabort", "C6 F8 ib", ALL)
+
+# 16-byte compare-exchange: the REX.W form of the 0F C7 /1 group.
+_s("cmpxchg16b", "48 0F C7 /1 m", X64)
+_s("cmpxchg16b_lock", "F0 48 0F C7 /1 m", X64)
+
+# x87 oddities kept by hardware for compatibility (decode as the
+# register families they alias).
+_s("ffreep", "DF C0 +r", ALL)
+_s("feni8087_nop", "DB E0", ALL)
+_s("fdisi8087_nop", "DB E1", ALL)
+_s("fsetpm287_nop", "DB E4", ALL)
 
 # ---- VMX VMCS-pointer ops: the memory forms of the 0F C7 group ------
 # (rdrand/rdseed above are the register forms of /6 and /7; _pick
@@ -1023,6 +1180,7 @@ def _build_maps():
     m38: dict[int, Insn] = {}
     m3a: dict[int, Insn] = {}
     fixed: dict[bytes, Insn] = {}   # full fixed encodings (0F 01 C1 ..)
+    fixed1: dict[bytes, Insn] = {}  # legacy 2-byte fixed (C7 F8 ..)
     vex: dict[tuple, Insn] = {}     # (map, opcode) -> Insn
     evex: dict[tuple, Insn] = {}    # (map, opcode) -> Insn (AVX-512)
 
@@ -1077,12 +1235,18 @@ def _build_maps():
             fixed[op] = insn          # 0F 01 C1 style
         elif len(op) == 2 and op[0] == 0x0F:
             add(two, op[1], insn)
+        elif len(op) == 2 and not insn.modrm:
+            # legacy fixed 2-byte: trailing opcode-extension byte
+            # (C7 F8 xbegin / C6 F8 xabort); F3-led spellings (pause)
+            # decode through the prefix path, entry kept for
+            # generation only.
+            fixed1[op] = insn
         else:
             add(one, op[0], insn)
-    return one, two, m38, m3a, fixed, vex, evex
+    return one, two, m38, m3a, fixed, fixed1, vex, evex
 
 
-(_MAP1, _MAP2, _MAP38, _MAP3A, _FIXED, _VEXMAP,
+(_MAP1, _MAP2, _MAP38, _MAP3A, _FIXED, _FIXED1, _VEXMAP,
  _EVEXMAP) = _build_maps()
 
 LEGACY_PREFIXES = frozenset(
@@ -1294,6 +1458,17 @@ def decode(mode: int, data: bytes) -> int:
                 return -1
             pos += 2
     else:
+        # fixed legacy 2-byte first (C7 F8 xbegin, C6 F8 xabort):
+        # the trailing byte is an opcode extension, not modrm.
+        if pos + 1 < len(data):
+            insn = _FIXED1.get(bytes([b0, data[pos + 1]]))
+            if insn is not None and insn.modes & mode:
+                pos += 2
+                if insn.flags & D64 and mode == LONG64 and not osz66:
+                    osz = 8
+                for tok in insn.imms:
+                    pos += _imm_len(tok, osz, asz)
+                return pos if pos <= len(data) else -1
         regbits = (data[pos + 1] >> 3) & 7 if pos + 1 < len(data) else 0
         mod = (data[pos + 1] >> 6) if pos + 1 < len(data) else -1
         insn = _pick(_MAP1.get(b0), regbits, mode, mod)
@@ -1483,7 +1658,10 @@ def generate_insn(cfg: Config, r: random.Random) -> bytes:
     if insn.modrm:
         out += _gen_modrm(insn, asz, r)
     for tok in insn.imms:
-        out += _gen_imm(_imm_len(tok, osz, asz), r)
+        if tok == "ib" and insn.suffix >= 0:
+            out.append(insn.suffix)  # fixed 3DNow! operation suffix
+        else:
+            out += _gen_imm(_imm_len(tok, osz, asz), r)
     return bytes(out)
 
 

@@ -23,7 +23,8 @@ def test_table_size_and_shape():
                "vmcall", "vmrun", "movups", "pshufb", "palignr",
                "vaddps", "bswap", "cmpxchg8b", "syscall", "fadd",
                    "movapd", "movss", "cvtsd2si", "pshufd", "roundps",
-                   "vfma_98", "pclmulqdq", "popcnt", "fsqrt", "rorx"]:
+                   "vfmadd132ps", "pclmulqdq", "popcnt", "fsqrt",
+                   "rorx"]:
         assert nm in names, nm
     privs = [i for i in x86.INSNS if i.priv]
     assert len(privs) >= 40
