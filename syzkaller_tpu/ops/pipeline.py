@@ -27,6 +27,7 @@ integrated op distribution matches the reference weighted loop
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -191,7 +192,7 @@ class DevicePipeline:
                  capacity: int = 2048, batch_size: int = 2048,
                  rounds: int = 4, seed: int = 0, prefetch: int = 2,
                  spec: Optional[DeltaSpec] = None, ct=None,
-                 max_insert_calls: int = 30):
+                 max_insert_calls: int = 30, dispatch_depth: int = 2):
         import jax
         import jax.numpy as jnp
         from jax import random
@@ -298,6 +299,14 @@ class DevicePipeline:
         self._step = jax.jit(step)
 
         self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+        # In-flight device dispatches the worker keeps ahead of the
+        # drain.  Depth 1 serializes [transfer + host assembly] with
+        # the next batch's compute; depth 2 pipelines all three stages
+        # (compute N+2 ‖ d2h-transfer N+1 ‖ assemble N), which matters
+        # on the tunneled chip where the per-batch link transfer is
+        # comparable to the kernel time itself.
+        self._dispatch_depth = max(1, int(os.environ.get(
+            "TZ_PIPELINE_DISPATCH_DEPTH", str(dispatch_depth))))
         self._have_corpus = threading.Event()
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._worker_loop,
@@ -445,16 +454,24 @@ class DevicePipeline:
         return out
 
     def _worker_loop(self) -> None:
-        pending = None
+        from collections import deque
+
+        pending: deque = deque()
         while not self._stop.is_set():
             if not self._have_corpus.wait(timeout=0.2):
                 continue
-            if pending is None:
-                pending = self._launch()
+            # Keep `dispatch_depth` batches in flight before draining
+            # the oldest, so device compute, d2h transfer, and host
+            # assembly overlap as independent pipeline stages.
+            while len(pending) < self._dispatch_depth \
+                    and not self._stop.is_set():
+                launched = self._launch()
+                if launched is None:
+                    break
+                pending.append(launched)
+            if not pending:
                 continue
-            nxt = self._launch()  # dispatch N+1 before assembling N
-            batch = self._drain(pending)
-            pending = nxt
+            batch = self._drain(pending.popleft())
             while not self._stop.is_set():
                 try:
                     self._queue.put(batch, timeout=0.2)

@@ -898,11 +898,17 @@ for b, nm in [(0x16, "evpermps"), (0x1F, "evpabsq"), (0x36, "evpermd"),
     _s(nm, f"e0F38 p66 {b:02X} /r", _VEXM)
 # EVEX promotions of the 66 0F38 integer plane (AVX-512F/BW/DQ
 # subset with a 1:1 legacy dual; blendv/ptest got replaced by
-# mask-register ops and are deliberately absent).
+# mask-register ops, and the SSSE3 horizontal/sign family plus
+# phminposuw were never promoted — all deliberately absent).
+_NO_EVEX_0F38 = {"pblendvb", "blendvps", "blendvpd", "ptest", "adcx",
+                 "phaddw_x", "phaddd_x", "phaddsw_x", "phsubw_x",
+                 "phsubd_x", "phsubsw_x", "psignb_x", "psignw_x",
+                 "psignd_x", "phminposuw", "aesimc"}
 for b, nm in _SSE4_66_0F38:
-    if nm in ("pblendvb", "blendvps", "blendvpd", "ptest", "adcx"):
+    if nm in _NO_EVEX_0F38:
         continue
     _s(f"ev_{_vx(nm)}", f"e0F38 p66 {b:02X} /r", _VEXM)
+_s("ev_movntdqa", "e0F38 p66 2A /r m", _VEXM)
 # Post-AVX2 ISA families the 2017-era reference table predates:
 # GFNI, VAES, VPCLMULQDQ, AVX-512 VNNI / VPOPCNTDQ / BITALG / IFMA /
 # VBMI and the BF16 plane — both VEX and EVEX spellings where both
@@ -1458,23 +1464,22 @@ def decode(mode: int, data: bytes) -> int:
                 return -1
             pos += 2
     else:
-        # fixed legacy 2-byte first (C7 F8 xbegin, C6 F8 xabort):
-        # the trailing byte is an opcode extension, not modrm.
-        if pos + 1 < len(data):
-            insn = _FIXED1.get(bytes([b0, data[pos + 1]]))
-            if insn is not None and insn.modes & mode:
-                pos += 2
-                if insn.flags & D64 and mode == LONG64 and not osz66:
-                    osz = 8
-                for tok in insn.imms:
-                    pos += _imm_len(tok, osz, asz)
-                return pos if pos <= len(data) else -1
-        regbits = (data[pos + 1] >> 3) & 7 if pos + 1 < len(data) else 0
-        mod = (data[pos + 1] >> 6) if pos + 1 < len(data) else -1
-        insn = _pick(_MAP1.get(b0), regbits, mode, mod)
-        if insn is None:
-            return -1
-        pos += 1
+        # fixed legacy 2-byte first (C7 F8 xbegin, C6 F8 xabort): the
+        # trailing byte is an opcode extension, not modrm — consume
+        # both and fall through to the shared D64/imm epilogue.
+        fixed1 = _FIXED1.get(bytes([b0, data[pos + 1]])) \
+            if pos + 1 < len(data) else None
+        if fixed1 is not None and fixed1.modes & mode:
+            insn = fixed1
+            pos += 2
+        else:
+            regbits = (data[pos + 1] >> 3) & 7 \
+                if pos + 1 < len(data) else 0
+            mod = (data[pos + 1] >> 6) if pos + 1 < len(data) else -1
+            insn = _pick(_MAP1.get(b0), regbits, mode, mod)
+            if insn is None:
+                return -1
+            pos += 1
     if insn.flags & D64 and mode == LONG64 and not osz66:
         osz = 8
     if insn.modrm:
