@@ -97,7 +97,17 @@ def _seed_programs(target, n, length=8, seed0=42):
             for i in range(n)]
 
 
-def bench_pipeline(batch_size=2048, seconds=8.0, capacity=1024,
+
+#: Shared pipeline shape for the flagship bench AND the A/B engine:
+#: the jit signature (ring capacity x batch) must be identical so the
+#: A/B can load the flagship's persistently-cached executable when the
+#: tunnel's remote-compile service is down (r5 failure mode:
+#: UNAVAILABLE on fresh compiles only).
+PIPE_CAPACITY = 1024
+PIPE_BATCH = 2048
+
+def bench_pipeline(batch_size=PIPE_BATCH, seconds=8.0,
+                   capacity=PIPE_CAPACITY,
                    seeds=64) -> float:
     """End-to-end exec-ready mutants/sec off the DevicePipeline."""
     from syzkaller_tpu.models.target import get_target
@@ -260,7 +270,13 @@ def _ab_run(engine_on: bool, seconds: Optional[float] = None,
         from syzkaller_tpu.fuzzer.proc import PipelineMutator
         from syzkaller_tpu.ops.pipeline import DevicePipeline
 
-        pl = DevicePipeline(target, capacity=256, batch_size=256)
+        # Same capacity/batch as the flagship bench: the jit signature
+        # matches the flagship's persistently-cached compile, so the
+        # A/B works even when the tunnel's remote-compile service is
+        # down but cached executables still load (the r5 failure mode:
+        # UNAVAILABLE on fresh compiles only).
+        pl = DevicePipeline(target, capacity=PIPE_CAPACITY,
+                            batch_size=PIPE_BATCH)
         mutator = PipelineMutator(pl, drain_timeout=120.0)
         mutator.ops_journal = []  # count device vs CPU-op draws
         mutator._sync_corpus(fuzzer)
@@ -336,8 +352,7 @@ def bench_ab_edges(seconds=20.0) -> dict:
     # counts are too sparse to be a rate).  The chip must beat
     # demand/supply for supply stalls to vanish — THE break-even.
     demand = off["execs"] / off["wall_secs"] if off["wall_secs"] else 0.0
-    supply = bench_pipeline(batch_size=256, seconds=4.0, capacity=256,
-                            seeds=16)
+    supply = bench_pipeline(seconds=4.0, seeds=16)
     break_even_x = round(demand / supply, 2) if supply else None
     statement = (
         "engine-on pays {:.1f}% of exec throughput at equal wall time "
@@ -536,7 +551,7 @@ def main() -> None:
         print(json.dumps(res))
         return
     batch = int(argv[argv.index("--batch") + 1]) \
-        if "--batch" in argv else 2048
+        if "--batch" in argv else PIPE_BATCH
     secs = float(argv[argv.index("--seconds") + 1]) \
         if "--seconds" in argv else 8.0
     pipe_rate = bench_pipeline(batch_size=batch, seconds=secs)

@@ -219,6 +219,7 @@ def main() -> None:
 
     ab_path = os.path.join(REPO, f"BENCH_AB_r{opts.round:02d}.json")
     failed_attempts = 0
+    prefer_ab = True
     while True:
         have = flagship_entries()
         ab_done = os.path.exists(ab_path)
@@ -230,9 +231,13 @@ def main() -> None:
         # probe.  Its PJRT client queues in the plugin's reconnect
         # loop and converts a pool-lease grant directly into a
         # recorded artifact (see module docstring).  Priority: one
-        # flagship first (proves the chip), then the never-yet-
-        # recorded A/B artifact, then journal depth.
-        if have >= 1 and not ab_done:
+        # flagship first (proves the chip), then alternate between the
+        # A/B artifact and journal-depth flagships — a failing A/B
+        # (e.g. the tunnel's remote-compile service down while cached
+        # executables still load) must not starve flagship collection.
+        want_ab = (have >= 1 and not ab_done
+                   and (prefer_ab or have >= opts.want))
+        if want_ab:
             what = "A/B"
             r = run_bench(["--ab", str(opts.ab_secs)], timeout_s=2700)
             # Same eligibility bar as flagship_entries: an error JSON,
@@ -261,6 +266,7 @@ def main() -> None:
                 r = None  # an error JSON is a failed attempt
         if r is None:
             failed_attempts += 1
+            prefer_ab = not want_ab  # alternate the next attempt kind
             log(f"{what} attempt #{failed_attempts} did not land "
                 "(lease never granted or bench failed); retrying")
             if opts.diagnose_every and \
@@ -269,6 +275,7 @@ def main() -> None:
             time.sleep(opts.probe_interval)
             continue
         failed_attempts = 0
+        prefer_ab = True
         time.sleep(opts.measure_interval)
 
 
