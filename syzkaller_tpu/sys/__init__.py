@@ -6,9 +6,10 @@ Each OS target is described either via the Python builder API
 
   test/64   hermetic fake OS exercising every type-system feature
             (the unit-test target; reference: sys/test)
-  linux/{amd64,arm64}  the linux model (2,062 syscall variants on
-            amd64; arm64 compiles the same set against its own
-            syscall-number table)
+  linux/{amd64,arm64,386}  the linux model (2,062 syscall variants
+            on amd64; arm64 (2,024) and 386 (2,051) compile the same
+            descriptions against their own syscall-number tables and
+            pointer widths)
   android/{amd64,arm64}  linux plus the ION staging surface
   freebsd/amd64  compact FreeBSD model (multi-OS machinery proof)
   netbsd/amd64   compact NetBSD model (model-only cross-OS target)

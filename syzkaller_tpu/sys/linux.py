@@ -49,6 +49,14 @@ def build_linux_arm64_target(register: bool = False) -> Target:
     return build_linux_target(register=register, arch="arm64")
 
 
+def build_linux_386_target(register: bool = False) -> Target:
+    """linux/386: 32-bit pointers (sysgen pins ptr_size=4) and the
+    i386 syscall table from <asm/unistd_32.h> (sys/extract.extract_386
+    two-pass); amd64-only entries compile disabled (reference:
+    sys/linux/gen/386.go built from per-arch .const)."""
+    return build_linux_target(register=register, arch="386")
+
+
 def _attach_arch_hooks(t: Target, k: dict[str, int]) -> None:
     t.string_dictionary = [
         "/dev/null", "/dev/zero", "/dev/full", "/proc/self/exe",
@@ -110,3 +118,4 @@ def _attach_arch_hooks(t: Target, k: dict[str, int]) -> None:
 
 register_lazy_target("linux", "amd64", build_linux_target)
 register_lazy_target("linux", "arm64", build_linux_arm64_target)
+register_lazy_target("linux", "386", build_linux_386_target)
