@@ -59,6 +59,10 @@ MEMONLY = 4    # modrm must encode memory (mod != 3)
 REGONLY = 8    # modrm must encode a register (mod == 3)
 D64 = 16       # default 64-bit operand size in long mode (push/pop/jmp)
 EVEX = 32      # EVEX-encoded (AVX-512)
+FIXEDENC = 64  # opcode bytes are a complete fixed encoding: emit
+               # verbatim, no random prefixes/REX (canonical NOPs,
+               # pause) — generation-only rows, decode resolves them
+               # through the group/prefix rules
 
 IMM_TOKENS = ("ib", "iw", "id", "iz", "iv", "cb", "cz", "mo")
 
@@ -1017,7 +1021,7 @@ _s("femms", "0F 0E", ALL)
 # rows (mod=3 selects the register form per SDM).
 _s("movhlps", "0F 12 /r rr", ALL)
 _s("movlhps", "0F 16 /r rr", ALL)
-_s("pause", "F3 90", ALL)
+_s("pause", "F3 90", ALL, FIXEDENC)
 
 # XSAVE-state family: compacted/supervisor forms + the REX.W-spelled
 # 64-bit layouts the reference tables as separate entries.
@@ -1042,6 +1046,20 @@ _s("xabort", "C6 F8 ib", ALL)
 # 16-byte compare-exchange: the REX.W form of the 0F C7 /1 group.
 _s("cmpxchg16b", "48 0F C7 /1 m", X64)
 _s("cmpxchg16b_lock", "F0 48 0F C7 /1 m", X64)
+
+# Canonical multi-byte NOPs (SDM table 4-12).  Length-decode flows
+# through the 0F 1F modrm group / prefix rules; these entries give the
+# generator the recommended byte sequences.
+_s("nop2", "66 90", ALL, FIXEDENC)
+_s("nop3", "0F 1F 00", ALL, FIXEDENC)
+# the SIB/disp forms assume 32-bit modrm addressing, and the literal
+# bytes must not pick up random prefixes (a 67 would change how the
+# embedded modrm decodes) — FIXEDENC emits them verbatim
+_s("nop4", "0F 1F 40 00", PROT32 | LONG64, FIXEDENC)
+_s("nop5", "0F 1F 44 00 00", PROT32 | LONG64, FIXEDENC)
+_s("nop6", "66 0F 1F 44 00 00", PROT32 | LONG64, FIXEDENC)
+_s("nop7", "0F 1F 80 00 00 00 00", PROT32 | LONG64, FIXEDENC)
+_s("nop8", "0F 1F 84 00 00 00 00 00", PROT32 | LONG64, FIXEDENC)
 
 # x87 oddities kept by hardware for compatibility (decode as the
 # register families they alias).
@@ -1201,6 +1219,11 @@ def _build_maps():
             lst.append(insn)
 
     for insn in INSNS:
+        if insn.flags & FIXEDENC:
+            # generation-only verbatim rows (canonical NOPs, pause):
+            # decode resolves their bytes through the prefix rules and
+            # the group entries, so they must not pollute the maps.
+            continue
         if insn.flags & VEX:
             vex.setdefault((insn.vexmap, insn.opcode[-1]), insn)
             continue
@@ -1571,6 +1594,8 @@ def generate_insn(cfg: Config, r: random.Random) -> bytes:
     insn = insns[r.randrange(len(insns))]
     out = bytearray()
     osz66 = asz67 = rexw = False
+    if insn.flags & FIXEDENC:
+        return bytes(insn.opcode)  # complete encoding, verbatim
     if insn.flags & EVEX:
         # 62 P0 P1 P2 opcode [modrm...] — P0: RXBR'0mmm (all extension
         # bits 1 = "not extended"), P1: Wvvvv1pp, P2: zL'Lb V'aaa.
@@ -1649,7 +1674,10 @@ def generate_insn(cfg: Config, r: random.Random) -> bytes:
         and cfg.mode == LONG64
     if rex_literal:
         rexw = True  # the spelled REX.W (movsq/cdqe/...) IS the REX
-    elif cfg.mode == LONG64 and r.randrange(4) == 0:
+    elif cfg.mode == LONG64 and opcode[0] not in LEGACY_PREFIXES \
+            and r.randrange(4) == 0:
+        # (suppressed when the opcode spells its own lead prefix —
+        # 66 0F 1F nop6, F3 90 pause — REX must touch the opcode)
         rex = 0x40 | r.randrange(16)
         rexw = bool(rex & 8)
         out.append(rex)
