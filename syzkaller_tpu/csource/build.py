@@ -13,19 +13,25 @@ class BuildError(Exception):
 
 
 def build_csource(src: bytes, out_path: Optional[str] = None,
-                  cc: str = "gcc", extra_flags: Optional[list[str]] = None
-                  ) -> str:
-    """Compile to a binary; returns its path (caller owns the file)."""
+                  cc: str = "gcc", extra_flags: Optional[list[str]] = None,
+                  compile_only: bool = False) -> str:
+    """Compile to a binary; returns its path (caller owns the file).
+
+    compile_only (-c) supports cross-width gates on hosts without the
+    target libc: a linux/386 reproducer compile-checks with
+    `extra_flags=m32_flags()` even though no 32-bit libc.a exists to
+    link (the run path needs a real 32-bit userland)."""
     fd, src_path = tempfile.mkstemp(suffix=".c", prefix="tz-repro-")
     with os.fdopen(fd, "wb") as f:
         f.write(src)
     if out_path is None:
         fd2, out_path = tempfile.mkstemp(prefix="tz-repro-bin-")
         os.close(fd2)
-    args = [cc, "-o", out_path, src_path, "-O1", "-static-pie", "-pthread",
+    mode = ["-c"] if compile_only else ["-static-pie", "-pthread"]
+    args = [cc, "-o", out_path, src_path, "-O1", *mode,
             *(extra_flags or [])]
     res = subprocess.run(args, capture_output=True)
-    if res.returncode != 0:
+    if res.returncode != 0 and not compile_only:
         # -static-pie unsupported on some toolchains: retry dynamic
         args = [cc, "-o", out_path, src_path, "-O1", "-pthread",
                 *(extra_flags or [])]
@@ -35,3 +41,12 @@ def build_csource(src: bytes, out_path: Optional[str] = None,
         raise BuildError(f"failed to build reproducer:\n"
                          f"{res.stderr.decode()[-2048:]}")
     return out_path
+
+
+def m32_flags(shim_dir: str) -> list[str]:
+    """cflags to compile-check a 32-bit reproducer on a 64-bit host
+    with no 32-bit libc-dev (utils/m32 holds the shared shim logic;
+    shim_dir is required so the caller owns its lifetime)."""
+    from syzkaller_tpu.utils.m32 import m32_compile_flags
+
+    return m32_compile_flags(shim_dir)

@@ -1,0 +1,40 @@
+"""Shared -m32 compile support on a 64-bit host with no 32-bit
+libc-dev.
+
+The host glibc HEADERS are i386-correct (they branch on __i386__ /
+bits/wordsize.h); only the 32-bit development stub list
+(gnu/stubs-32.h, shipped by the 32-bit libc-dev package) is absent,
+and on multiarch hosts the asm/ uapi directory hangs under the 64-bit
+triplet dir that gcc only adds for the default arch.  Used by
+sys/extract (32-bit const extraction) and csource/build (compile-only
+gate for 32-bit reproducers).
+"""
+
+from __future__ import annotations
+
+import os
+
+#: Contents of the stand-in for the missing 32-bit libc-dev stub list.
+#: The stub list only declares which libc functions are unavailable;
+#: header-only compiles need none of that information.
+STUBS_32_SHIM = ("/* empty: 32-bit libc-dev stubs absent on this "
+                 "host; headers-only compile */\n")
+
+MULTIARCH_INCLUDE = "/usr/include/x86_64-linux-gnu"
+
+
+def m32_compile_flags(shim_dir: str) -> list[str]:
+    """cflags for an -m32 header-only compile: writes the empty
+    gnu/stubs-32.h stand-in into shim_dir (caller owns the directory
+    and its cleanup) and adds the multiarch asm/ include root where
+    present (the x86 uapi asm/ headers are width-shared and branch on
+    __i386__ internally)."""
+    os.makedirs(os.path.join(shim_dir, "gnu"), exist_ok=True)
+    stub = os.path.join(shim_dir, "gnu", "stubs-32.h")
+    if not os.path.exists(stub):
+        with open(stub, "w") as f:
+            f.write(STUBS_32_SHIM)
+    flags = ["-m32", "-I", shim_dir]
+    if os.path.isdir(os.path.join(MULTIARCH_INCLUDE, "asm")):
+        flags += ["-I", MULTIARCH_INCLUDE]
+    return flags
