@@ -19,8 +19,8 @@ def build_csource(src: bytes, out_path: Optional[str] = None,
 
     compile_only (-c) supports cross-width gates on hosts without the
     target libc: a linux/386 reproducer compile-checks with
-    `extra_flags=m32_flags()` even though no 32-bit libc.a exists to
-    link (the run path needs a real 32-bit userland)."""
+    `extra_flags=m32_flags(shim_dir)` even though no 32-bit libc.a
+    exists to link (the run path needs a real 32-bit userland)."""
     fd, src_path = tempfile.mkstemp(suffix=".c", prefix="tz-repro-")
     with os.fdopen(fd, "wb") as f:
         f.write(src)

@@ -76,13 +76,11 @@ def test_generation_and_serialization_on_386(i386):
     assert serialize_prog(deserialize_prog(i386, s)) == s
 
 
-def test_csource_compile_checks_for_386(i386):
+def test_csource_compile_checks_for_386(i386, tmp_path):
     """A linux/386 reproducer compile-checks with -m32 on this 64-bit
     host (no 32-bit libc.a to link; the syscall numbers and pointer
     widths in the rendered C are the 32-bit ones)."""
     import os
-    import shutil
-    import tempfile
 
     from syzkaller_tpu.csource import Options, write_csource
     from syzkaller_tpu.csource.build import build_csource, m32_flags
@@ -92,13 +90,9 @@ def test_csource_compile_checks_for_386(i386):
     p = generate_prog(i386, RandGen(i386, 11), 6)
     src = write_csource(p, Options())
     assert b"syscall(" in src
-    shim = tempfile.mkdtemp(prefix="tz-m32-shim-")
+    obj = build_csource(src, extra_flags=m32_flags(str(tmp_path)),
+                        compile_only=True)
     try:
-        obj = build_csource(src, extra_flags=m32_flags(shim),
-                            compile_only=True)
-        try:
-            assert os.path.getsize(obj) > 0
-        finally:
-            os.unlink(obj)
+        assert os.path.getsize(obj) > 0
     finally:
-        shutil.rmtree(shim, ignore_errors=True)
+        os.unlink(obj)
