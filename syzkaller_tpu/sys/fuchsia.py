@@ -17,11 +17,12 @@ from syzkaller_tpu.models.prog import (
 from syzkaller_tpu.models.target import Target, register_lazy_target
 
 
-def build_fuchsia_target(register: bool = False) -> Target:
+def build_fuchsia_target(register: bool = False,
+                         arch: str = "amd64") -> Target:
     from syzkaller_tpu.models.target import register_target
     from syzkaller_tpu.sys.sysgen import compile_os
 
-    res = compile_os("fuchsia", "amd64", register=False)
+    res = compile_os("fuchsia", arch, register=False)
     t = res.target
     t.string_dictionary = ["fuzz", "proc0", "thr0"]
     from syzkaller_tpu.sys.sysgen import load_os_consts
@@ -59,3 +60,8 @@ def build_fuchsia_target(register: bool = False) -> Target:
 
 
 register_lazy_target("fuchsia", "amd64", build_fuchsia_target)
+# Zircon syscalls dispatch by vDSO name and auto-number identically on
+# every arch; the arm64 target shares the model with its own const
+# file (reference ships sys/fuchsia/*_arm64.const the same way).
+register_lazy_target("fuchsia", "arm64",
+                     lambda: build_fuchsia_target(arch="arm64"))

@@ -93,3 +93,16 @@ def test_seven_os_trees_registered():
                       ("test", "64")):
         t = get_target(osn, arch)
         assert len(t.syscalls) > 0, osn
+
+
+def test_fuchsia_arm64_shares_the_model():
+    """Zircon calls dispatch by vDSO name (no per-arch NR table), so
+    the arm64 target is the same model against its own const file —
+    the reference ships sys/fuchsia/*_arm64.const identically."""
+    a64 = get_target("fuchsia", "arm64")
+    amd = get_target("fuchsia", "amd64")
+    assert {c.name for c in a64.syscalls} == \
+        {c.name for c in amd.syscalls}
+    p = generate_prog(a64, RandGen(a64, 5), 8)
+    s = serialize_prog(p)
+    assert serialize_prog(deserialize_prog(a64, s)) == s
