@@ -26,10 +26,10 @@ def build_fuchsia_target(register: bool = False,
     t = res.target
     t.string_dictionary = ["fuzz", "proc0", "thr0"]
     from syzkaller_tpu.sys.sysgen import load_os_consts
-    k = load_os_consts("fuchsia")
+    k = load_os_consts("fuchsia", arch)
     mmap_meta = next(c for c in t.syscalls if c.name == "zx_vmar_map")
     perm = (k.get("ZX_VM_PERM_READ", 1) | k.get("ZX_VM_PERM_WRITE", 2)
-            | k.get("ZX_VM_SPECIFIC", 8))
+            | k.get("ZX_VM_SPECIFIC", 16))
 
     def make_mmap(addr: int, size: int) -> Call:
         a = [

@@ -945,6 +945,83 @@ _s("evdpbf16ps", "e0F38 pF3 52 /r", _VEXM)
 # (VAES-512 ev_aesenc.. arrive via the promotion loop above)
 _s("ev_pclmulqdq", "e0F3A p66 44 /r ib", _VEXM)     # VPCLMULQDQ-512
 
+# AVX-512 gathers/scatters (VSIB, memory-only; scatter is EVEX-native
+# with no VEX dual).
+for b, nm in [(0x90, "evpgatherdd"), (0x91, "evpgatherqd"),
+              (0x92, "evgatherdps"), (0x93, "evgatherqps"),
+              (0xA0, "evpscatterdd"), (0xA1, "evpscatterqd"),
+              (0xA2, "evscatterdps"), (0xA3, "evscatterqps")]:
+    _s(nm, f"e0F38 p66 {b:02X} /r m", _VEXM)
+# Truncating down-converts (EVEX-native, pF3 plane): vpmov[s|us]?{q,d,w}
+# to narrower elements; W/size handled by the payload rolls.
+for b, nm in [(0x10, "evpmovuswb"), (0x11, "evpmovusdb"),
+              (0x12, "evpmovusqb"), (0x13, "evpmovusdw"),
+              (0x14, "evpmovusqw"), (0x15, "evpmovusqd"),
+              (0x20, "evpmovswb"), (0x21, "evpmovsdb"),
+              (0x22, "evpmovsqb"), (0x23, "evpmovsdw"),
+              (0x24, "evpmovsqw"), (0x25, "evpmovsqd"),
+              (0x30, "evpmovwb"), (0x31, "evpmovdb"),
+              (0x32, "evpmovqb"), (0x33, "evpmovdw"),
+              (0x34, "evpmovqw"), (0x35, "evpmovqd")]:
+    _s(nm, f"e0F38 pF3 {b:02X} /r", _VEXM)
+# Mask<->vector moves and mask tests (pF3 0F38 plane).
+for b, nm in [(0x28, "evpmovm2b"), (0x29, "evpmovb2m"),
+              (0x38, "evpmovm2d"), (0x39, "evpmovd2m")]:
+    _s(nm, f"e0F38 pF3 {b:02X} /r rr", _VEXM)
+_s("evptestm", "e0F38 p66 26 /r", _VEXM)
+_s("evptestnm", "e0F38 pF3 26 /r", _VEXM)
+_s("evptestmd", "e0F38 p66 27 /r", _VEXM)
+_s("evptestnmd", "e0F38 pF3 27 /r", _VEXM)
+# Math helper planes: scalef/getexp/rcp14/rsqrt14, fpclass/reduce/
+# getmant-sd, and the 32x8/64x2 insert/extract shapes.
+_s("evscalefps", "e0F38 p66 2C /r", _VEXM)
+_s("evscalefss", "e0F38 p66 2D /r", _VEXM)
+_s("evgetexpps", "e0F38 p66 42 /r", _VEXM)
+_s("evgetexpss", "e0F38 p66 43 /r", _VEXM)
+_s("evrcp14ps", "e0F38 p66 4C /r", _VEXM)
+_s("evrcp14ss", "e0F38 p66 4D /r", _VEXM)
+_s("evrsqrt14ps", "e0F38 p66 4E /r", _VEXM)
+_s("evrsqrt14ss", "e0F38 p66 4F /r", _VEXM)
+_s("evfpclassps", "e0F3A p66 66 /r ib", _VEXM)
+_s("evfpclassss", "e0F3A p66 67 /r ib", _VEXM)
+_s("evreduceps", "e0F3A p66 56 /r ib", _VEXM)
+_s("evreducess", "e0F3A p66 57 /r ib", _VEXM)
+_s("evinsertf32x4", "e0F3A p66 18 /r ib", _VEXM)
+_s("evinsertf64x4", "e0F3A p66 1A /r ib", _VEXM)
+_s("evinserti32x4", "e0F3A p66 38 /r ib", _VEXM)
+_s("evinserti64x4", "e0F3A p66 3A /r ib", _VEXM)
+_s("evextracti32x4", "e0F3A p66 39 /r ib", _VEXM)
+_s("evextracti64x4", "e0F3A p66 3B /r ib", _VEXM)
+_s("evpbroadcastb_r", "e0F38 p66 7A /r rr", _VEXM)
+_s("evpbroadcastw_r", "e0F38 p66 7B /r rr", _VEXM)
+_s("evpbroadcastd_r", "e0F38 p66 7C /r rr", _VEXM)
+_s("evprolvd", "e0F38 p66 15 /r", _VEXM)
+_s("evprorvd", "e0F38 p66 14 /r", _VEXM)
+_s("evpsravq", "e0F38 p66 46 /r", _VEXM)
+_s("evpsllvw", "e0F38 p66 12 /r", _VEXM)
+_s("evpsrlvw", "e0F38 p66 10 /r", _VEXM)
+_s("evpsravw", "e0F38 p66 11 /r", _VEXM)
+
+# Opmask (k-register) ops: VEX-encoded, pp selects the width family.
+for b, nm in [(0x41, "kand"), (0x42, "kandn"), (0x44, "knot"),
+              (0x45, "kor"), (0x46, "kxnor"), (0x47, "kxor"),
+              (0x4A, "kadd"), (0x4B, "kunpck")]:
+    _s(f"{nm}w", f"v0F {b:02X} /r rr", _VEXM)
+    _s(f"{nm}b", f"v0F p66 {b:02X} /r rr", _VEXM)
+_s("kmovw", "v0F 90 /r", _VEXM)
+_s("kmovb", "v0F p66 90 /r", _VEXM)
+_s("kmovw_st", "v0F 91 /r m", _VEXM)
+_s("kmovw_r", "v0F 92 /r rr", _VEXM)
+_s("kmovw_gr", "v0F 93 /r rr", _VEXM)
+_s("kortestw", "v0F 98 /r rr", _VEXM)
+_s("kortestb", "v0F p66 98 /r rr", _VEXM)
+_s("ktestw", "v0F 99 /r rr", _VEXM)
+_s("ktestb", "v0F p66 99 /r rr", _VEXM)
+_s("kshiftrw", "v0F3A p66 30 /r rr ib", _VEXM)
+_s("kshiftrd", "v0F3A p66 31 /r rr ib", _VEXM)
+_s("kshiftlw", "v0F3A p66 32 /r rr ib", _VEXM)
+_s("kshiftld", "v0F3A p66 33 /r rr ib", _VEXM)
+
 for b, nm in [(0x03, "evalignd"), (0x08, "evrndscaleps"),
               (0x09, "evrndscalepd"), (0x0A, "evrndscaless"),
               (0x0B, "evrndscalesd"), (0x19, "evextractf32x4"),
