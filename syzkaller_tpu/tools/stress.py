@@ -34,6 +34,16 @@ def main(argv=None) -> int:
     fuzzer = Fuzzer(target, WorkQueue(), cfg=FuzzerConfig())
     mutator = None
     if args.engine == "jax":
+        # Honor $TZ_JAX_PLATFORM before anything touches jax: the
+        # tunneled accelerator plugin ignores JAX_PLATFORMS, and on a
+        # wedged tunnel the very first module-level jnp constant would
+        # otherwise block forever in backend init (utils/jaxenv).
+        from syzkaller_tpu.utils.jaxenv import (enable_compilation_cache,
+                                                pin_jax_platform)
+
+        enable_compilation_cache()
+        pin_jax_platform()
+
         from syzkaller_tpu.fuzzer.proc import PipelineMutator
         from syzkaller_tpu.ops.pipeline import DevicePipeline
 
