@@ -1,0 +1,72 @@
+"""Const-extraction machinery (sys/extract): hosted stubs, the
+freestanding -m32 pass, and cross-arch curated inheritance.
+
+The 386/arm64 target tests cover the shipped OUTPUT files; these
+cover the functions, in particular the two properties that make a
+32-bit const set trustworthy on this 64-bit host: struct-size-encoded
+ioctls come from a real -m32 compile, and size-coupled values never
+inherit across pointer widths (reference analog: per-arch
+sys/linux/*.const produced by syz-extract with real cross sysroots).
+"""
+
+from __future__ import annotations
+
+import shutil
+
+import pytest
+
+from syzkaller_tpu.sys import extract
+
+pytestmark = pytest.mark.skipif(
+    not shutil.which("gcc"), reason="gcc not available")
+
+
+def test_hosted_extraction_macros_and_enums():
+    v = extract.extract_consts(
+        ["O_APPEND", "KCMP_FILE", "TZ_NO_SUCH_CONST"],
+        includes=["<fcntl.h>", "<linux/kcmp.h>"])
+    assert v["O_APPEND"] == 0o2000
+    assert v["KCMP_FILE"] == 0      # enumerator: via the fallback pass
+    assert v["TZ_NO_SUCH_CONST"] is None
+
+
+def test_hosted_extraction_skips_enum_fallback_when_disabled():
+    v = extract.extract_consts(
+        ["KCMP_FILE"], includes=["<linux/kcmp.h>"], enum_fallback=False)
+    assert v["KCMP_FILE"] is None   # #ifdef can't see enumerators
+
+
+def test_m32_pass_gets_32bit_ioctl_sizes():
+    """The point of the freestanding pass: _IOR/_IOW numbers embed
+    sizeof(struct ...), and 32-bit structs holding longs/pointers are
+    smaller — amd64 values are actively wrong for them."""
+    v = extract.extract_consts_m32(
+        ["VIDIOC_QUERYBUF", "KCOV_INIT_TRACE", "O_LARGEFILE"],
+        includes=["<linux/videodev2.h>", "<linux/kcov.h>",
+                  "<asm/fcntl.h>"])
+    assert v["VIDIOC_QUERYBUF"] == 0xC0445609   # 68-byte 32-bit struct
+    assert v["KCOV_INIT_TRACE"] == 0x80046301   # 4-byte unsigned
+    assert v["O_LARGEFILE"] == 0o100000         # kernel-ABI view
+
+
+def test_curated_inheritance_word_size_guard(tmp_path, monkeypatch):
+    from syzkaller_tpu.sys import sysgen
+
+    (tmp_path / "linux").mkdir()
+    (tmp_path / "linux" / "linux_amd64.const").write_text(
+        "HCI_CHANNEL_RAW = 0\n"            # plain: portable
+        "ASHMEM_GET_SIZE = 30468\n"        # _IO (size 0): portable
+        "ASHMEM_SET_SIZE = 1074296579\n"   # _IOW(size 8): width-coupled
+    )
+    monkeypatch.setattr(sysgen, "DESC_ROOT", tmp_path)
+    merged = {"HCI_CHANNEL_RAW": None, "ASHMEM_GET_SIZE": None,
+              "ASHMEM_SET_SIZE": None, "__NR_open": None}
+    extract._inherit_curated(merged, "amd64", same_word_size=False)
+    assert merged["HCI_CHANNEL_RAW"] == 0
+    assert merged["ASHMEM_GET_SIZE"] == 30468
+    assert merged["ASHMEM_SET_SIZE"] is None   # stays disabled
+    assert merged["__NR_open"] is None         # NR tables never inherit
+    # same word size (arm64): the size-encoded value IS portable
+    merged2 = {"ASHMEM_SET_SIZE": None}
+    extract._inherit_curated(merged2, "amd64", same_word_size=True)
+    assert merged2["ASHMEM_SET_SIZE"] == 1074296579
