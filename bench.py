@@ -555,7 +555,16 @@ def main() -> None:
     secs = float(argv[argv.index("--seconds") + 1]) \
         if "--seconds" in argv else 8.0
     pipe_rate = bench_pipeline(batch_size=batch, seconds=secs)
-    kernel_rate = bench_device_kernel()
+    # The flagship rate is measured; don't let an auxiliary compile
+    # failure discard it.  On the tunneled backend the far-side
+    # compiler can break BETWEEN compiles (BENCH_WEDGE_DIAGNOSIS.md
+    # §8 mode 3) — a transient window that yields the pipeline rate
+    # must still produce a journal artifact.
+    try:
+        kernel_rate = bench_device_kernel()
+    except Exception as e:
+        kernel_rate = None
+        kernel_err = f"{type(e).__name__}: {e}"[:200]
     cpu_rate = bench_cpu()
     result = {
         "metric": "exec_ready_mutants_per_sec_per_chip",
@@ -563,7 +572,9 @@ def main() -> None:
         "unit": "mutants/sec",
         "vs_baseline": round(pipe_rate / cpu_rate, 2),
         "sub": {
-            "device_kernel_mutations_per_sec": round(kernel_rate, 1),
+            "device_kernel_mutations_per_sec":
+                round(kernel_rate, 1) if kernel_rate is not None
+                else None,
             "cpu_baseline_mutants_per_sec": round(cpu_rate, 1),
             "pipeline_batch": batch,
         },
@@ -574,6 +585,8 @@ def main() -> None:
                  "toolchain in the image to run the reference's own "
                  "tools/syz-mutate."),
     }
+    if kernel_rate is None:
+        result["sub"]["device_kernel_error"] = kernel_err
     if platform:
         result["platform"] = platform
     journal_append(result)
