@@ -167,6 +167,7 @@ class PipelineStats:
     assemble_errors: int = 0
     overflows: int = 0  # delta rows exceeding the K/D/P budget
     inserts: int = 0  # insert-class mutants produced
+    worker_errors: int = 0  # device failures survived by the worker
 
 
 # Lean device shapes for the pipeline: mutation cost is dominated by
@@ -307,6 +308,11 @@ class DevicePipeline:
         # comparable to the kernel time itself.
         self._dispatch_depth = max(1, int(os.environ.get(
             "TZ_PIPELINE_DISPATCH_DEPTH", str(dispatch_depth))))
+        # Worker retry backoff after a device failure (seconds);
+        # instance attrs so tests and deployments can tune recovery
+        # latency without waiting out real backoffs.
+        self.retry_backoff_initial = 1.0
+        self.retry_backoff_cap = 60.0
         self._have_corpus = threading.Event()
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._worker_loop,
@@ -357,28 +363,42 @@ class DevicePipeline:
             ets = list(self.exec_templates)
         if n == 0:
             return None, 0, tmpl, ets
-        if self._corpus_dev is None:
-            proto = pending[0][1] if pending else tmpl[0].arrays()
-            self._corpus_dev = {
-                k: jnp.zeros((self.capacity,) + np.shape(v),
-                             dtype=np.asarray(v).dtype)
-                for k, v in proto.items()}
-        if pending:
-            # Ring wrap can stage two rows for the same slot; XLA
-            # scatter order with duplicate indices is unspecified, so
-            # keep only the LAST row per index (matching the host
-            # template snapshot).
-            last = {i: r for i, r in pending}
-            idx = np.array(list(last.keys()), dtype=np.int32)
-            for k in self._corpus_dev:
-                rows = np.stack([np.asarray(r[k]) for r in last.values()])
-                self._corpus_dev[k] = self._corpus_dev[k].at[idx].set(rows)
+        try:
+            if self._corpus_dev is None:
+                proto = pending[0][1] if pending else tmpl[0].arrays()
+                self._corpus_dev = {
+                    k: jnp.zeros((self.capacity,) + np.shape(v),
+                                 dtype=np.asarray(v).dtype)
+                    for k, v in proto.items()}
+            if pending:
+                # Ring wrap can stage two rows for the same slot; XLA
+                # scatter order with duplicate indices is unspecified,
+                # so keep only the LAST row per index (matching the
+                # host template snapshot).
+                last = {i: r for i, r in pending}
+                idx = np.array(list(last.keys()), dtype=np.int32)
+                for k in self._corpus_dev:
+                    rows = np.stack([np.asarray(r[k])
+                                     for r in last.values()])
+                    self._corpus_dev[k] = \
+                        self._corpus_dev[k].at[idx].set(rows)
+        except Exception:
+            # The worker survives device failures and retries
+            # (_worker_loop); consumed-but-unapplied rows must go
+            # back on the staging queue or device rows desync from
+            # the host template snapshot permanently.
+            with self._lock:
+                self._pending_rows = pending + self._pending_rows
+            raise
         # Flag tables grow as new sets are interned; pad the row count
         # to a power of two so growth doesn't re-jit the step, and
         # re-upload only on growth (the host link is latency-bound).
+        # _flags_len is committed only AFTER a successful upload, so a
+        # device failure between the two retries the upload instead of
+        # leaving a stale device table that under-indexes new sets.
         if self._flags_dev is None or self._flags_len != len(self.flags.counts):
             fv_np, fc_np = self.flags.vals, self.flags.counts
-            self._flags_len = len(fc_np)
+            new_len = len(fc_np)
             rows = 1 << max(0, (len(fc_np) - 1).bit_length())
             if rows > len(fc_np):
                 fv_np = np.vstack([fv_np, np.zeros(
@@ -387,6 +407,7 @@ class DevicePipeline:
                                                   dtype=fc_np.dtype))
             self._flags_dev = (self._jnp.asarray(fv_np),
                                self._jnp.asarray(fc_np))
+            self._flags_len = new_len
         return self._corpus_dev, n, tmpl, ets
 
     # -- the device loop ---------------------------------------------------
@@ -457,21 +478,45 @@ class DevicePipeline:
         from collections import deque
 
         pending: deque = deque()
+        backoff = self.retry_backoff_initial
         while not self._stop.is_set():
             if not self._have_corpus.wait(timeout=0.2):
                 continue
-            # Keep `dispatch_depth` batches in flight before draining
-            # the oldest, so device compute, d2h transfer, and host
-            # assembly overlap as independent pipeline stages.
-            while len(pending) < self._dispatch_depth \
-                    and not self._stop.is_set():
-                launched = self._launch()
-                if launched is None:
-                    break
-                pending.append(launched)
-            if not pending:
+            # A device failure must not kill the worker thread: the
+            # tunneled backend can refuse COMPILES while the session
+            # stays up (BENCH_WEDGE_DIAGNOSIS.md §8 mode 3), and a
+            # dead worker would pin the fuzzer's health latch demoted
+            # forever.  Drop in-flight work, back off, retry — when
+            # the backend recovers, the latch's probe loop re-enables
+            # device mutation on its own.
+            try:
+                # Keep `dispatch_depth` batches in flight before
+                # draining the oldest, so device compute, d2h
+                # transfer, and host assembly overlap as independent
+                # pipeline stages.
+                while len(pending) < self._dispatch_depth \
+                        and not self._stop.is_set():
+                    launched = self._launch()
+                    if launched is None:
+                        break
+                    pending.append(launched)
+                if not pending:
+                    continue
+                batch = self._drain(pending.popleft())
+            except Exception as e:
+                pending.clear()
+                self.stats.worker_errors += 1
+                from syzkaller_tpu.utils import log
+
+                log.logf(0, "device pipeline worker error (#%d, "
+                            "retrying in %.0fs): %s",
+                         self.stats.worker_errors, backoff,
+                         str(e)[:200])
+                if self._stop.wait(timeout=backoff):
+                    return
+                backoff = min(backoff * 2, self.retry_backoff_cap)
                 continue
-            batch = self._drain(pending.popleft())
+            backoff = self.retry_backoff_initial
             while not self._stop.is_set():
                 try:
                     self._queue.put(batch, timeout=0.2)

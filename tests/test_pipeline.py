@@ -109,3 +109,34 @@ def test_exec_mutant_contains_any(test_target):
         assert m.contains_any_call(999) is False
     finally:
         pl.stop()
+
+
+def test_worker_survives_device_failures(test_target):
+    """A device failure (e.g. the tunneled backend refusing compiles
+    while the session stays up) must not kill the worker thread: it
+    drops in-flight work, backs off, and recovers when the device
+    answers again — so the fuzzer's health-latch probe can re-enable
+    device mutation."""
+    import time
+
+    pl = _make_pipeline(test_target)
+    pl.retry_backoff_initial = 0.05
+    pl.retry_backoff_cap = 0.2
+    real_step = pl._step
+    fail = {"n": 0}
+
+    def flaky_step(*a, **kw):
+        if fail["n"] < 3:
+            fail["n"] += 1
+            raise RuntimeError("UNAVAILABLE: injected compile error")
+        return real_step(*a, **kw)
+
+    pl._step = flaky_step
+    try:
+        batch = pl.next_batch(timeout=120)
+        assert batch, "worker never recovered from injected failures"
+        assert fail["n"] == 3
+        assert pl.stats.worker_errors == 3
+        assert pl._worker.is_alive()
+    finally:
+        pl.stop()
