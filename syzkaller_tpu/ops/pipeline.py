@@ -474,11 +474,27 @@ class DevicePipeline:
         self.stats.mutants += len(out)
         return out
 
+    def _reset_device_state(self) -> None:
+        """Drop device buffers and re-stage every live template from
+        the host-side snapshot.  Recovery path for failures that
+        invalidate existing device buffers (a backend/session restart,
+        not just a refused compile): the host templates are the
+        authoritative corpus, so the next successful flush rebuilds
+        the ring from scratch."""
+        with self._lock:
+            self._corpus_dev = None
+            self._flags_dev = None
+            self._flags_len = 0
+            self._pending_rows = [
+                (i, t.arrays()) for i, t in enumerate(self.templates)
+                if t is not None]
+
     def _worker_loop(self) -> None:
         from collections import deque
 
         pending: deque = deque()
         backoff = self.retry_backoff_initial
+        errors_since_ok = 0
         while not self._stop.is_set():
             if not self._have_corpus.wait(timeout=0.2):
                 continue
@@ -506,17 +522,26 @@ class DevicePipeline:
             except Exception as e:
                 pending.clear()
                 self.stats.worker_errors += 1
+                errors_since_ok += 1
                 from syzkaller_tpu.utils import log
 
                 log.logf(0, "device pipeline worker error (#%d, "
-                            "retrying in %.0fs): %s",
+                            "retrying in %.1fs): %s",
                          self.stats.worker_errors, backoff,
                          str(e)[:200])
+                if errors_since_ok == 4:
+                    # Persistent failures may mean the backend
+                    # restarted and the old device buffers are dead —
+                    # rebuild the ring from the host-side snapshot.
+                    log.logf(0, "device pipeline: rebuilding device "
+                                "state from the host corpus snapshot")
+                    self._reset_device_state()
                 if self._stop.wait(timeout=backoff):
                     return
                 backoff = min(backoff * 2, self.retry_backoff_cap)
                 continue
             backoff = self.retry_backoff_initial
+            errors_since_ok = 0
             while not self._stop.is_set():
                 try:
                     self._queue.put(batch, timeout=0.2)

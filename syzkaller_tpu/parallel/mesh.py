@@ -28,6 +28,23 @@ from syzkaller_tpu.ops import signal as dsig
 from syzkaller_tpu.ops.mutate import _mutate_one
 
 
+def _batch_spec(mesh: Mesh):
+    """Partition spec for program tensors: over ('host','batch')
+    jointly on a multi-host mesh, else 'batch' (single source for
+    every sharded step in this module)."""
+    return P(("host", "batch")) if "host" in mesh.axis_names \
+        else P("batch")
+
+
+def _global_shard_idx(mesh: Mesh):
+    """Traced host-major global shard index for RNG decorrelation —
+    must match _batch_spec's layout."""
+    idx = lax.axis_index("batch")
+    if "host" in mesh.axis_names:
+        idx = idx + lax.axis_index("host") * mesh.shape["batch"]
+    return idx
+
+
 def make_mesh(devices: Optional[list] = None, cov: int = 1) -> Mesh:
     """Mesh with ('batch', 'cov') axes over the given devices."""
     devices = devices if devices is not None else jax.devices()
@@ -37,9 +54,45 @@ def make_mesh(devices: Optional[list] = None, cov: int = 1) -> Mesh:
     return Mesh(arr, ("batch", "cov"))
 
 
+def make_host_mesh(devices: Optional[list] = None, hosts: int = 2,
+                   cov: int = 1) -> Mesh:
+    """Mesh with ('host', 'batch', 'cov') axes: the multi-host form.
+
+    The outer 'host' axis maps to DCN; 'batch' x 'cov' to each host's
+    ICI-connected chips.  Program tensors shard over ('host','batch')
+    jointly — each host's fleet works its own corpus shard, exactly
+    the reference's per-manager corpus partition — while the coverage
+    plane shards over 'cov' WITHIN a host and replicates across
+    hosts.  Cross-host plane agreement is a pmax over 'host': inline
+    per step when the step is built with the 'host' axis present, or
+    amortized over DCN via the separate plane_host_sync step
+    (reference analog: hub corpus sync on a cadence, syz-hub)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    assert n % (hosts * cov) == 0, \
+        f"{n} devices not divisible by hosts*cov={hosts * cov}"
+    arr = np.array(devices).reshape(hosts, n // (hosts * cov), cov)
+    return Mesh(arr, ("host", "batch", "cov"))
+
+
+def make_plane_host_sync(mesh: Mesh):
+    """Jitted periodic cross-host coverage sync: pmax of each plane
+    shard over the 'host' axis — the DCN collective a deployment runs
+    every N batches instead of inline (the plane is idempotent
+    max-merge state, so late syncs only delay dedup, never lose
+    signal)."""
+    def local(plane_l):
+        return lax.pmax(plane_l, "host")
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P("cov"),), out_specs=P("cov"),
+        check_vma=False))
+
+
 def shard_batch(mesh: Mesh, batch: dict) -> dict:
-    """Place stacked program tensors batch-sharded on the mesh."""
-    sh = NamedSharding(mesh, P("batch"))
+    """Place stacked program tensors batch-sharded on the mesh
+    (over ('host','batch') jointly on a multi-host mesh)."""
+    sh = NamedSharding(mesh, _batch_spec(mesh))
     return {k: jax.device_put(jnp.asarray(v), sh) for k, v in batch.items()}
 
 
@@ -61,6 +114,7 @@ def make_sharded_fuzz_step(mesh: Mesh, rounds: int = 4, plane_size: int = dsig.P
     """
     n_cov = mesh.shape["cov"]
     shard = plane_size // n_cov
+    has_host = "host" in mesh.axis_names
 
     def local_step(batch, plane_l, edges, nedges, prios, key,
                    flag_vals, flag_counts):
@@ -85,18 +139,26 @@ def make_sharded_fuzz_step(mesh: Mesh, rounds: int = 4, plane_size: int = dsig.P
         plane_l = plane_l.at[jnp.clip(idx - base, 0, shard - 1).reshape(-1)
                              ].max(val.reshape(-1))
         plane_l = lax.pmax(plane_l, "batch")
+        if has_host:
+            # Inline cross-host agreement (DCN pmax).  A deployment
+            # trading DCN traffic for slightly-delayed dedup builds
+            # the step on a host-free mesh per fleet and runs
+            # make_plane_host_sync on a cadence instead — the
+            # reference's hub-sync shape.  Same-step double-discovery
+            # across hosts matches multi-manager reference behavior.
+            plane_l = lax.pmax(plane_l, "host")
 
         # --- mutate my batch shard for the next round ---
         b = batch["kind"].shape[0]
-        # decorrelate across batch shards
-        key = random.fold_in(key, lax.axis_index("batch"))
+        # decorrelate across (host x) batch shards
+        key = random.fold_in(key, _global_shard_idx(mesh))
         keys = random.split(key, b)
         mutated = jax.vmap(
             lambda st, k: _mutate_one(st, k, flag_vals, flag_counts, rounds)
         )(batch, keys)
         return mutated, plane_l, new_counts
 
-    batch_spec = P("batch")
+    batch_spec = _batch_spec(mesh)
     step = jax.jit(
         jax.shard_map(
             local_step, mesh=mesh,
@@ -122,10 +184,11 @@ def make_sharded_pack_step(mesh: Mesh, spec=None, rounds: int = 4):
 
     spec = spec or DeltaSpec()
     pack = make_packer(spec)
+    has_host = "host" in mesh.axis_names
 
     def local(batch, key, flag_vals, flag_counts, tidx):
         b = batch["kind"].shape[0]
-        key = random.fold_in(key, lax.axis_index("batch"))
+        key = random.fold_in(key, _global_shard_idx(mesh))
         keys = random.split(key, b)
 
         def one(st, k, i):
@@ -135,10 +198,11 @@ def make_sharded_pack_step(mesh: Mesh, spec=None, rounds: int = 4):
         rows, payloads, needs = jax.vmap(one)(batch, keys, tidx)
         return make_pooler(spec, b)(rows, payloads, needs)
 
+    bspec = _batch_spec(mesh)
     return jax.jit(jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P("batch"), P(), P(), P(), P("batch")),
-        out_specs=P("batch"), check_vma=False))
+        in_specs=(bspec, P(), P(), P(), bspec),
+        out_specs=bspec, check_vma=False))
 
 
 def unshard_delta(flat: np.ndarray, mesh: Mesh, spec=None) -> list:
@@ -147,7 +211,7 @@ def unshard_delta(flat: np.ndarray, mesh: Mesh, spec=None) -> list:
     from syzkaller_tpu.ops.delta import DeltaBatch, DeltaSpec
 
     spec = spec or DeltaSpec()
-    n = mesh.shape["batch"]
+    n = mesh.shape["batch"] * mesh.shape.get("host", 1)
     flat = np.asarray(flat)
     per = flat.size // n
     return [DeltaBatch(flat[i * per:(i + 1) * per], spec)

@@ -128,3 +128,35 @@ def test_sharded_pack_step_parses_per_shard(built):
                 parse_stream(data)
                 parsed += 1
     assert parsed >= 8, f"only {parsed} mutants assembled"
+
+
+@pytest.mark.parametrize("hosts,cov", [(2, 1), (2, 2), (4, 1)])
+def test_host_mesh_step_matches_single_device(built, hosts, cov):
+    """The 3-axis ('host','batch','cov') step with inline DCN pmax
+    produces exactly the single-device triage/merge result, and the
+    periodic plane_host_sync collective is idempotent on the agreed
+    plane."""
+    from syzkaller_tpu.parallel.mesh import (
+        make_host_mesh,
+        make_plane_host_sync,
+    )
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    batch, plane, edges, nedges, prios, key, fv, fc = built
+    mesh = make_host_mesh(jax.devices()[:8], hosts=hosts, cov=cov)
+    step = make_sharded_fuzz_step(mesh, rounds=2)
+    sb = shard_batch(mesh, batch)
+    sp = shard_plane(mesh, plane)
+    mutated, new_plane, counts = step(sb, sp, edges, nedges, prios, key,
+                                      fv, fc)
+    jax.block_until_ready(counts)
+
+    ref_mask, ref_counts = dsig.diff_batch(plane, edges, nedges, prios)
+    assert np.array_equal(np.asarray(counts), np.asarray(ref_counts))
+    ref_plane = dsig.merge(plane, edges, nedges, prios, ref_counts > 0)
+    assert np.array_equal(np.asarray(new_plane), np.asarray(ref_plane))
+
+    sync = make_plane_host_sync(mesh)
+    synced = sync(new_plane)
+    assert np.array_equal(np.asarray(synced), np.asarray(ref_plane))

@@ -140,3 +140,31 @@ def test_worker_survives_device_failures(test_target):
         assert pl._worker.is_alive()
     finally:
         pl.stop()
+
+
+def test_worker_rebuilds_device_state_after_persistent_failures(test_target):
+    """Four consecutive failures trigger the device-state rebuild (a
+    backend restart invalidates old buffers); the ring re-stages from
+    the host template snapshot and mutants stay template-consistent."""
+    pl = _make_pipeline(test_target)
+    pl.retry_backoff_initial = 0.05
+    pl.retry_backoff_cap = 0.1
+    real_step = pl._step
+    fail = {"n": 0}
+
+    def flaky_step(*a, **kw):
+        if fail["n"] < 5:
+            fail["n"] += 1
+            raise RuntimeError("UNAVAILABLE: injected backend restart")
+        return real_step(*a, **kw)
+
+    pl._step = flaky_step
+    try:
+        batch = pl.next_batch(timeout=120)
+        assert batch, "worker never recovered after state rebuild"
+        assert pl.stats.worker_errors >= 5
+        # post-rebuild mutants parse and reference live templates
+        for m in batch[:8]:
+            parse_stream(m.exec_bytes)
+    finally:
+        pl.stop()
