@@ -37,6 +37,8 @@ class Stat(IntEnum):
     EXEC_TOTAL = 8
     EXECUTOR_RESTARTS = 9
     CRASHES = 10
+    DEVICE_MUTANTS = 11
+    DEVICE_WORKER_ERRORS = 12
 
 
 STAT_NAMES = {
@@ -51,6 +53,8 @@ STAT_NAMES = {
     Stat.EXEC_TOTAL: "exec total",
     Stat.EXECUTOR_RESTARTS: "executor restarts",
     Stat.CRASHES: "crashes",
+    Stat.DEVICE_MUTANTS: "device mutants",
+    Stat.DEVICE_WORKER_ERRORS: "device worker errors",
 }
 
 

@@ -85,6 +85,7 @@ class PipelineMutator:
         self._demoted = threading.Event()
         self._stash = None  # mutant recovered by the health probe
         self._probe_thread: Optional[threading.Thread] = None
+        self._reported_worker_errors = 0  # drained into Stat counters
         # Tests set this to a list to observe the op-class stream.
         self.ops_journal: Optional[list[str]] = None
 
@@ -189,6 +190,13 @@ class PipelineMutator:
                     self._consec_timeouts = 0
                 if self.ops_journal is not None:
                     self.ops_journal.append("device")
+                fuzzer.stat_add(Stat.DEVICE_MUTANTS)
+                pstats = getattr(self.pipeline, "stats", None)
+                we = pstats.worker_errors if pstats is not None else 0
+                if we > self._reported_worker_errors:
+                    fuzzer.stat_add(Stat.DEVICE_WORKER_ERRORS,
+                                    we - self._reported_worker_errors)
+                    self._reported_worker_errors = we
                 return m
             if p is None:
                 p = base.clone()
