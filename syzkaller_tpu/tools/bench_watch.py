@@ -42,8 +42,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+#: Append-per-write log target (opened fresh each call): shell
+#: redirection pins an inode, and anything that swaps the file on
+#: disk (observed live in r5: writes after a swap went to the deleted
+#: inode for an hour) silently swallows the evidence log.  None =
+#: stdout only.
+LOG_PATH: str | None = None
+
+
 def log(msg: str) -> None:
-    print(f"[bench-watch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+    line = f"[bench-watch {time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    if LOG_PATH:
+        try:
+            with open(LOG_PATH, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass
 
 
 def _thread_table(pid: int) -> list[str]:
@@ -215,7 +230,13 @@ def main() -> None:
     ap.add_argument("--diagnose-every", type=int, default=6,
                     help="capture a full wedge diagnostic every N "
                          "failed probes (0 = never)")
+    ap.add_argument("--log-file", default="",
+                    help="also append every log line here (inode-swap"
+                         "-proof, reopened per write)")
     opts = ap.parse_args()
+    if opts.log_file:
+        global LOG_PATH
+        LOG_PATH = opts.log_file
 
     ab_path = os.path.join(REPO, f"BENCH_AB_r{opts.round:02d}.json")
     failed_attempts = 0
