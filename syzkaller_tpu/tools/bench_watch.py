@@ -193,6 +193,15 @@ def flagship_entries() -> int:
     return n
 
 
+def ab_result_eligible(r: dict) -> bool:
+    """Same eligibility bar as flagship_entries: an error JSON, a
+    platform-pinned (CPU) run, or a malformed payload must not
+    permanently mark the round's accelerator A/B done."""
+    return not (r.get("error") or r.get("platform")
+                or r.get("metric") != "new_edges_sim_kernel_ab"
+                or not r.get("engine_on"))
+
+
 def run_bench(args: list[str], timeout_s: float) -> dict | None:
     # Give the pipeline warmup most of the subprocess budget: the
     # warmup's first batch is where a starved PJRT client waits for
@@ -261,13 +270,7 @@ def main() -> None:
         if want_ab:
             what = "A/B"
             r = run_bench(["--ab", str(opts.ab_secs)], timeout_s=2700)
-            # Same eligibility bar as flagship_entries: an error JSON,
-            # a platform-pinned (CPU) run, or a malformed payload must
-            # not permanently mark the round's accelerator A/B done.
-            if r is not None and (
-                    r.get("error") or r.get("platform")
-                    or r.get("metric") != "new_edges_sim_kernel_ab"
-                    or not r.get("engine_on")):
+            if r is not None and not ab_result_eligible(r):
                 log(f"A/B attempt produced an ineligible result "
                     f"(error={r.get('error')!r} "
                     f"platform={r.get('platform')!r}); not recording")

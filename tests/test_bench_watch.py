@@ -1,0 +1,59 @@
+"""Watcher decision logic (tools/bench_watch): the journal filter and
+the A/B artifact eligibility gate.
+
+The r4 advisor finding was precisely a filter bug here (CPU-pinned
+runs satisfying --want); these pin both filters so the watcher's
+done-conditions can only be met by accelerator measurements."""
+
+from __future__ import annotations
+
+import json
+
+from syzkaller_tpu.tools import bench_watch as bw
+
+
+def _write_journal(tmp_path, entries):
+    with open(tmp_path / "BENCH_HISTORY.jsonl", "w") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_flagship_entries_filters(tmp_path, monkeypatch):
+    monkeypatch.setattr(bw, "REPO", str(tmp_path))
+    flag = {"metric": "exec_ready_mutants_per_sec_per_chip", "value": 9000}
+    _write_journal(tmp_path, [
+        flag,                                          # counts
+        {**flag, "platform": "cpu"},                   # pinned: no
+        {**flag, "harness_artifact": True},            # artifact: no
+        {**flag, "reconstructed": True},               # reconstructed: no
+        {**flag, "value": 0},                          # zero: no
+        {"metric": "new_edges_sim_kernel_ab", "value": 5},  # wrong metric
+        flag,                                          # counts
+    ])
+    assert bw.flagship_entries() == 2
+
+
+def test_flagship_entries_missing_journal(tmp_path, monkeypatch):
+    monkeypatch.setattr(bw, "REPO", str(tmp_path))
+    assert bw.flagship_entries() == 0
+
+
+def test_ab_eligibility_gate():
+    good = {"metric": "new_edges_sim_kernel_ab",
+            "engine_on": {"edges": 10}, "engine_off": {"edges": 9}}
+    assert bw.ab_result_eligible(good)
+    assert not bw.ab_result_eligible({**good, "platform": "cpu"})
+    assert not bw.ab_result_eligible({**good, "error": "UNAVAILABLE"})
+    assert not bw.ab_result_eligible({**good, "metric": "other"})
+    assert not bw.ab_result_eligible(
+        {"metric": "new_edges_sim_kernel_ab"})  # no engine_on payload
+
+
+def test_log_file_survives_inode_swap(tmp_path, monkeypatch):
+    path = tmp_path / "watch.log"
+    monkeypatch.setattr(bw, "LOG_PATH", str(path))
+    bw.log("first")
+    # swap the file on disk (what detached the r5 evidence log)
+    path.unlink()
+    bw.log("second")
+    assert "second" in path.read_text()
