@@ -57,3 +57,33 @@ def test_log_file_survives_inode_swap(tmp_path, monkeypatch):
     path.unlink()
     bw.log("second")
     assert "second" in path.read_text()
+
+
+def test_bench_journal_last_healthy_filter(tmp_path, monkeypatch):
+    """bench.py's wedge-path note reads the journal, never a constant
+    (r4 ask #10); the filter must skip platform-pinned and
+    harness-artifact entries but accept reconstructed ones (they carry
+    provenance flags through to the caller)."""
+    import bench
+
+    path = tmp_path / "BENCH_HISTORY.jsonl"
+    monkeypatch.setattr(bench, "JOURNAL", str(path))
+    flag = {"metric": "exec_ready_mutants_per_sec_per_chip",
+            "value": 9000, "ts": "t1"}
+    entries = [
+        flag,
+        {**flag, "value": 21000, "ts": "t2", "platform": "cpu"},
+        {**flag, "value": 139, "ts": "t3", "harness_artifact": True},
+    ]
+    with open(path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+    got = bench.journal_last_healthy()
+    assert got["value"] == 9000 and got["ts"] == "t1"
+    # reconstructed entries ARE eligible, flags carried through
+    with open(path, "a") as f:
+        f.write(json.dumps({**flag, "value": 20947, "ts": "t4",
+                            "reconstructed": True,
+                            "provenance": "weak"}) + "\n")
+    got = bench.journal_last_healthy()
+    assert got["value"] == 20947 and got.get("reconstructed")
