@@ -254,6 +254,19 @@ def main() -> None:
         have = flagship_entries()
         ab_done = os.path.exists(ab_path)
         if have >= opts.want and ab_done:
+            # Gravy before leaving the chip alone: one on-chip
+            # discovery-scaling run (VERDICT r4 ask #2's simulation
+            # variant, measured where the speedup is real).
+            scaled = os.path.join(REPO,
+                                  f"BENCH_AB_SCALED_r{opts.round:02d}.json")
+            if not os.path.exists(scaled):
+                r = run_bench(["--ab-scaled"], timeout_s=2700)
+                if r is not None and not r.get("error") \
+                        and not r.get("platform"):
+                    with open(scaled, "w") as f:
+                        json.dump(r, f)
+                        f.write("\n")
+                    log(f"scaled A/B artifact written: {scaled}")
             log(f"done: {have} flagship entries + A/B artifact; "
                 "leaving the chip alone")
             return
