@@ -135,8 +135,9 @@ def bench_pipeline(batch_size=PIPE_BATCH, seconds=8.0,
         # client retries in a sleep loop until the far side grants a
         # session, so a generous first-batch timeout converts a
         # mid-window grant into a measurement instead of a failure.
-        warmup_to = float(os.environ.get("TZ_BENCH_WARMUP_TIMEOUT_S",
-                                         "600"))
+        from syzkaller_tpu.health import env_float
+
+        warmup_to = env_float("TZ_BENCH_WARMUP_TIMEOUT_S", 600.0)
         fast = 0
         for attempt in range(12):
             tw = time.time()
@@ -487,10 +488,11 @@ def main() -> None:
         if "--no-preflight" not in argv:
             argv.insert(0, "--no-preflight")
     if "--no-preflight" not in argv:
+        from syzkaller_tpu.health import env_float, env_int
+
         reason = device_preflight(
-            timeout_s=float(os.environ.get("TZ_BENCH_PREFLIGHT_TIMEOUT",
-                                           "180")),
-            attempts=int(os.environ.get("TZ_BENCH_PREFLIGHT_ATTEMPTS", "2")))
+            timeout_s=env_float("TZ_BENCH_PREFLIGHT_TIMEOUT", 180.0),
+            attempts=env_int("TZ_BENCH_PREFLIGHT_ATTEMPTS", 2))
         if reason is not None:
             result = {
                 "metric": "exec_ready_mutants_per_sec_per_chip",

@@ -39,6 +39,13 @@ class Stat(IntEnum):
     CRASHES = 10
     DEVICE_MUTANTS = 11
     DEVICE_WORKER_ERRORS = 12
+    # Self-healing runtime transitions (syzkaller_tpu/health): synced
+    # to the manager so the status page shows engine health per fleet.
+    DEVICE_DEMOTIONS = 13
+    DEVICE_REPROMOTIONS = 14
+    DEVICE_BREAKER_OPENS = 15
+    DEVICE_REBUILDS = 16
+    DEVICE_WEDGES = 17
 
 
 STAT_NAMES = {
@@ -55,6 +62,11 @@ STAT_NAMES = {
     Stat.CRASHES: "crashes",
     Stat.DEVICE_MUTANTS: "device mutants",
     Stat.DEVICE_WORKER_ERRORS: "device worker errors",
+    Stat.DEVICE_DEMOTIONS: "device demotions",
+    Stat.DEVICE_REPROMOTIONS: "device repromotions",
+    Stat.DEVICE_BREAKER_OPENS: "device breaker opens",
+    Stat.DEVICE_REBUILDS: "device ring rebuilds",
+    Stat.DEVICE_WEDGES: "device wedges",
 }
 
 

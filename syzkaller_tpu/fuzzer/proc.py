@@ -61,13 +61,18 @@ class PipelineMutator:
     Proc.execute handles both.  Corpus growth is fed to the device
     ring on every draw (one scatter per pipeline step).
 
-    Health latch: after demote_after CONSECUTIVE drain timeouts the
-    mutator latches to "demoted" — device draws return None instantly
+    Health latch: after demote_after CONSECUTIVE drain timeouts — or
+    the moment the pipeline's circuit breaker reports open
+    (syzkaller_tpu/health/breaker.py), which detects the same wedge
+    from the worker side without burning drain_timeout waits — the
+    mutator latches to "demoted": device draws return None instantly
     (Proc falls back to CPU mutation within the same draw) instead of
     serializing every proc on drain_timeout waits against a wedged
     device (the axon-tunnel failure mode).  A background probe keeps
     polling the pipeline and clears the latch the moment the device
-    answers again."""
+    answers again.  Demotions/re-promotions and the pipeline's
+    breaker/watchdog transitions are drained into Stat counters so
+    the manager status page shows them."""
 
     def __init__(self, pipeline, drain_timeout: float = 60.0,
                  demote_after: int = 3, probe_interval: float = 5.0,
@@ -85,7 +90,11 @@ class PipelineMutator:
         self._demoted = threading.Event()
         self._stash = None  # mutant recovered by the health probe
         self._probe_thread: Optional[threading.Thread] = None
-        self._reported_worker_errors = 0  # drained into Stat counters
+        # Health transition counters (drained into Stat counters by
+        # _sync_health_stats so the manager sees them).
+        self.demotions = 0
+        self.repromotions = 0
+        self._reported: dict[str, int] = {}
         # Tests set this to a list to observe the op-class stream.
         self.ops_journal: Optional[list[str]] = None
 
@@ -94,25 +103,41 @@ class PipelineMutator:
     def healthy(self) -> bool:
         return not self._demoted.is_set()
 
-    def _note_drain_timeout(self) -> None:
-        # One mutator is shared by every proc thread: the streak
-        # counter and the demote-check must be atomic or two threads
-        # can both pass the gate and spawn duplicate probes.
+    def health_snapshot(self) -> dict:
+        """Latch + pipeline breaker/watchdog state, for tests and
+        status surfaces."""
+        out = {"demoted": self._demoted.is_set(),
+               "demotions": self.demotions,
+               "repromotions": self.repromotions}
+        snap = getattr(self.pipeline, "health_snapshot", None)
+        if callable(snap):
+            out["pipeline"] = snap()
+        return out
+
+    def _demote(self, reason: str) -> None:
+        # One mutator is shared by every proc thread: the latch set
+        # and probe spawn must be atomic or two threads can both pass
+        # the gate and spawn duplicate probes.
         with self._lock:
-            self._consec_timeouts += 1
-            if self._consec_timeouts < self.demote_after \
-                    or self._demoted.is_set():
+            if self._demoted.is_set():
                 return
             self._demoted.set()
-            n = self._consec_timeouts
+            self.demotions += 1
             t = threading.Thread(target=self._probe_loop, daemon=True,
                                  name="pipeline-health-probe")
             self._probe_thread = t
-        log.logf(0, "DEVICE PIPELINE UNRESPONSIVE: %d consecutive %.0fs "
-                    "drain timeouts; demoting to CPU mutation "
-                    "(background probe will re-enable)",
-                 n, self.drain_timeout)
+        log.logf(0, "DEVICE PIPELINE DEMOTED: %s; falling back to CPU "
+                    "mutation (background probe will re-enable)", reason)
         t.start()
+
+    def _note_drain_timeout(self) -> None:
+        with self._lock:
+            self._consec_timeouts += 1
+            n = self._consec_timeouts
+        if n < self.demote_after:
+            return
+        self._demote(f"{n} consecutive {self.drain_timeout:.0f}s "
+                     "drain timeouts")
 
     def _probe_loop(self) -> None:
         while self._demoted.is_set():
@@ -124,11 +149,39 @@ class PipelineMutator:
                 with self._lock:
                     self._stash = m
                     self._consec_timeouts = 0
+                    self.repromotions += 1
                     self._demoted.clear()
                 log.logf(0, "device pipeline answering again; "
                             "re-enabling device mutation")
                 return
             time.sleep(self.probe_interval)
+
+    def _sync_health_stats(self, fuzzer: Fuzzer) -> None:
+        """Drain monotonic health counters (mutator latch + pipeline
+        breaker/watchdog) into the fuzzer's poll-synced Stat deltas."""
+        pstats = getattr(self.pipeline, "stats", None)
+        br = getattr(self.pipeline, "breaker", None)
+        wd = getattr(self.pipeline, "watchdog", None)
+        with self._lock:
+            totals = {
+                Stat.DEVICE_DEMOTIONS: self.demotions,
+                Stat.DEVICE_REPROMOTIONS: self.repromotions,
+            }
+            if pstats is not None:
+                totals[Stat.DEVICE_WORKER_ERRORS] = pstats.worker_errors
+            if br is not None:
+                totals[Stat.DEVICE_BREAKER_OPENS] = br.counters.opens
+                totals[Stat.DEVICE_REBUILDS] = br.counters.rebuilds
+            if wd is not None:
+                totals[Stat.DEVICE_WEDGES] = wd.stats.wedges
+            deltas = []
+            for stat, total in totals.items():
+                seen = self._reported.get(stat.name, 0)
+                if total > seen:
+                    self._reported[stat.name] = total
+                    deltas.append((stat, total - seen))
+        for stat, d in deltas:
+            fuzzer.stat_add(stat, d)
 
     def _sync_corpus(self, fuzzer: Fuzzer) -> list[Prog]:
         """Feed new corpus items to the device ring; returns the
@@ -175,6 +228,15 @@ class PipelineMutator:
             else:
                 op = "device"
             if op == "device":
+                self._sync_health_stats(fuzzer)
+                br = getattr(self.pipeline, "breaker", None)
+                if br is not None and not self._demoted.is_set() \
+                        and br.is_open():
+                    # The pipeline worker's breaker detected the wedge
+                    # from its side: demote immediately instead of
+                    # burning demote_after drain-timeout waits
+                    # rediscovering it from the proc side.
+                    self._demote(f"device circuit breaker {br.state}")
                 if self._demoted.is_set():
                     return None  # health latch: CPU fallback in Proc
                 with self._lock:
@@ -191,12 +253,6 @@ class PipelineMutator:
                 if self.ops_journal is not None:
                     self.ops_journal.append("device")
                 fuzzer.stat_add(Stat.DEVICE_MUTANTS)
-                pstats = getattr(self.pipeline, "stats", None)
-                we = pstats.worker_errors if pstats is not None else 0
-                if we > self._reported_worker_errors:
-                    fuzzer.stat_add(Stat.DEVICE_WORKER_ERRORS,
-                                    we - self._reported_worker_errors)
-                    self._reported_worker_errors = we
                 return m
             if p is None:
                 p = base.clone()

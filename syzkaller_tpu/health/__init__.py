@@ -1,0 +1,52 @@
+"""Self-healing device runtime: watchdog, circuit breaker, and the
+deterministic fault-injection harness.
+
+The round-5 flagship rested on a single healthy measurement: a wedged
+PJRT backend hung the pipeline worker forever and the one-shot rebuild
+latch fired exactly once (BENCH_WEDGE_DIAGNOSIS.md, ADVICE.md r5).
+This package makes every device failure path *detected*, *bounded*,
+and *exercisable deterministically*:
+
+  - faultinject: named seams (`device.launch`, `device.compile`,
+    `rpc.send_frame`, `rpc.recv_frame`, `queue.put`) scripted by a
+    TZ_FAULT_PLAN env plan — syzkaller's fail_nth discipline applied
+    to the host side of the TPU engine,
+  - watchdog: a heartbeat + deadline wrapper converting a wedged
+    device call into a structured DeviceWedged instead of an eternal
+    stall,
+  - breaker: the closed → open → half-open → closed circuit breaker
+    that replaces the ad-hoc errors_since_ok counter in
+    DevicePipeline._worker, with transition counters for tests and
+    the manager status page.
+
+See docs/health.md for the state machine and the plan grammar.
+"""
+
+from syzkaller_tpu.health.breaker import BreakerCounters, CircuitBreaker
+from syzkaller_tpu.health.envsafe import env_float, env_int
+from syzkaller_tpu.health.faultinject import (
+    SEAMS,
+    FaultInjected,
+    FaultPlan,
+    fault_point,
+    install_plan,
+    plan_from_env,
+    reset_plan,
+)
+from syzkaller_tpu.health.watchdog import DeviceWedged, Watchdog
+
+__all__ = [
+    "BreakerCounters",
+    "CircuitBreaker",
+    "DeviceWedged",
+    "FaultInjected",
+    "FaultPlan",
+    "SEAMS",
+    "Watchdog",
+    "env_float",
+    "env_int",
+    "fault_point",
+    "install_plan",
+    "plan_from_env",
+    "reset_plan",
+]

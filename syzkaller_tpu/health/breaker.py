@@ -1,0 +1,184 @@
+"""Circuit breaker for the device mutation engine.
+
+Replaces the ad-hoc `errors_since_ok` counter in
+DevicePipeline._worker_loop, whose rebuild latch fired exactly once
+(at error #4) and whose backoff was interleaved with normal dispatch.
+The breaker makes the health state machine explicit:
+
+  closed     normal operation; a streak of `failure_threshold`
+             consecutive failures trips it open,
+  open       the device is presumed down: no dispatch, in-flight work
+             dropped, consumers demote to the CPU engine.  Probes are
+             scheduled with exponential backoff + deterministic
+             jitter,
+  half_open  one probe batch in flight.  Entering half-open marks a
+             host-snapshot rebuild pending (EVERY re-entry, not just
+             the first — the r5 one-shot-latch bug), so a backend
+             that restarted mid-streak always gets a fresh ring,
+  closed     a successful probe re-promotes and resets the backoff.
+
+Every transition is counted (BreakerCounters) so tests can assert the
+exact trajectory and the manager status page can show it.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+@dataclass
+class BreakerCounters:
+    opens: int = 0  # transitions to open, incl. failed-probe reopens
+    closes: int = 0  # re-promotions (half-open probe succeeded)
+    half_opens: int = 0  # probe windows entered
+    rebuilds: int = 0  # host-snapshot rebuilds consumed
+    failures: int = 0  # failures recorded (any state)
+    successes: int = 0  # successes recorded (any state)
+
+    def as_dict(self) -> dict[str, int]:
+        return {
+            "opens": self.opens,
+            "closes": self.closes,
+            "half_opens": self.half_opens,
+            "rebuilds": self.rebuilds,
+            "failures": self.failures,
+            "successes": self.successes,
+        }
+
+
+class CircuitBreaker:
+    """Thread-safe; driven by the single pipeline worker, read by
+    consumers (PipelineMutator fast-demote) and the status page.
+
+    `clock` and `seed` are injectable so tests get deterministic
+    backoff trajectories without sleeping real time."""
+
+    def __init__(self, failure_threshold: int = 4,
+                 backoff_initial: float = 1.0,
+                 backoff_cap: float = 60.0,
+                 jitter: float = 0.1,
+                 seed: int = 0,
+                 clock=time.monotonic):
+        self.failure_threshold = max(1, failure_threshold)
+        self.backoff_initial = backoff_initial
+        self.backoff_cap = backoff_cap
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consec_failures = 0
+        self._backoff = backoff_initial
+        self._next_probe_at = 0.0
+        self._rebuild_pending = False
+        self.counters = BreakerCounters()
+
+    def configure_backoff(self, initial: float = None,
+                          cap: float = None) -> None:
+        """Retune the probe backoff (tests, deployments).  Takes
+        effect immediately when the breaker is not mid-backoff."""
+        with self._lock:
+            if initial is not None:
+                self.backoff_initial = initial
+                if self._state == CLOSED:
+                    self._backoff = initial
+            if cap is not None:
+                self.backoff_cap = cap
+                self._backoff = min(self._backoff, cap)
+
+    # -- reads ------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def is_open(self) -> bool:
+        """True while the device engine is demoted (open or probing)."""
+        with self._lock:
+            return self._state != CLOSED
+
+    def seconds_until_probe(self) -> float:
+        with self._lock:
+            if self._state != OPEN:
+                return 0.0
+            return max(0.0, self._next_probe_at - self._clock())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = self.counters.as_dict()
+            out["state"] = self._state
+            out["consecutive_failures"] = self._consec_failures
+            out["backoff_s"] = round(self._backoff, 3)
+            return out
+
+    # -- the state machine ------------------------------------------------
+
+    def allow(self) -> bool:
+        """May the worker dispatch right now?  In open state this is
+        the probe gate: once the backoff elapses it transitions to
+        half-open (marking a rebuild pending) and admits one probe."""
+        with self._lock:
+            if self._state == CLOSED or self._state == HALF_OPEN:
+                return True
+            if self._clock() < self._next_probe_at:
+                return False
+            self._state = HALF_OPEN
+            self.counters.half_opens += 1
+            self._rebuild_pending = True
+            return True
+
+    def consume_rebuild(self) -> bool:
+        """One host-snapshot rebuild per half-open entry: True exactly
+        once after each open→half-open transition."""
+        with self._lock:
+            if not self._rebuild_pending:
+                return False
+            self._rebuild_pending = False
+            self.counters.rebuilds += 1
+            return True
+
+    def record_failure(self) -> str:
+        """Returns the state after accounting the failure."""
+        with self._lock:
+            self.counters.failures += 1
+            self._consec_failures += 1
+            if self._state == CLOSED:
+                if self._consec_failures < self.failure_threshold:
+                    return self._state
+                self._trip_locked()
+            elif self._state == HALF_OPEN:
+                # Failed probe: back off harder and reopen.
+                self._backoff = min(self._backoff * 2, self.backoff_cap)
+                self._trip_locked()
+            else:  # already open (e.g. a straggler in-flight failure)
+                self._next_probe_at = self._clock() + self._jittered()
+            return self._state
+
+    def record_success(self) -> str:
+        with self._lock:
+            self.counters.successes += 1
+            self._consec_failures = 0
+            if self._state != CLOSED:
+                self._state = CLOSED
+                self.counters.closes += 1
+                self._backoff = self.backoff_initial
+                self._rebuild_pending = False
+            return self._state
+
+    def _trip_locked(self) -> None:
+        self._state = OPEN
+        self.counters.opens += 1
+        self._next_probe_at = self._clock() + self._jittered()
+
+    def _jittered(self) -> float:
+        # Deterministic jitter (seeded RNG): spreads probe storms
+        # across workers without making test trajectories flaky.
+        return self._backoff * (1.0 + self.jitter * self._rng.random())

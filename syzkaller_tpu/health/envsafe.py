@@ -1,0 +1,34 @@
+"""Defensive TZ_* env parsing.
+
+A malformed operator-supplied value (`TZ_PIPELINE_DISPATCH_DEPTH=two`)
+must degrade to the compiled-in default, not kill fuzzer startup with
+a ValueError half-way through DevicePipeline.__init__ — a fuzzer that
+boots with a default knob finds bugs; one that dies on a typo in a
+supervisor script finds nothing.
+"""
+
+from __future__ import annotations
+
+import os
+
+from syzkaller_tpu.utils import log
+
+
+def _env_num(name: str, default, conv):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return conv(raw)
+    except (ValueError, TypeError):
+        log.logf(0, "ignoring malformed %s=%r (using default %r)",
+                 name, raw, default)
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    return _env_num(name, default, lambda s: int(s, 0))
+
+
+def env_float(name: str, default: float) -> float:
+    return _env_num(name, default, float)

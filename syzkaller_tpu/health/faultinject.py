@@ -1,0 +1,236 @@
+"""Deterministic fault injection for the host side of the TPU engine.
+
+syzkaller's executor treats fault injection as a first-class
+capability (fail_nth: "fail the Nth blocking point of this call").
+This module is the same discipline applied to the engine's own seams:
+every place the fuzzer touches the device, the RPC link, or the
+worker queue is a *named seam*, and a plan scripts exactly which
+invocations of which seam fail or hang:
+
+    TZ_FAULT_PLAN=device.launch:fail@3,5;rpc.send_frame:hang@2
+
+reads "fail the 3rd and 5th device launches, hang the 2nd RPC frame
+send".  Occurrences are 1-based invocation indices per seam, counted
+process-wide; `N-M` spans an inclusive range, so `fail@1-8` scripts
+eight consecutive failures.  `@*` fires on every invocation until the
+seam is healed.
+
+Seams are free when no plan is installed (one attribute load + `is
+None` test), so production hot paths pay nothing.
+
+Modes:
+  fail — raise FaultInjected (a ConnectionError subclass, so the RPC
+         client's reconnect path and the pipeline worker's generic
+         failure handling both see a realistic error),
+  hang — block until the seam is healed or the plan reset, modeling a
+         wedged PJRT call / stalled TCP peer.  The watchdog is what
+         converts a scripted hang into DeviceWedged; a hang seam left
+         unreleased holds only a daemon thread.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Optional
+
+from syzkaller_tpu.utils import log
+
+# The registry of seams the engine actually guards.  A plan may name
+# others (future seams, downstream forks) — that logs a warning rather
+# than failing, but tests should stick to these.
+SEAMS = (
+    "device.launch",
+    "device.compile",
+    "rpc.send_frame",
+    "rpc.recv_frame",
+    "queue.put",
+)
+
+MODES = ("fail", "hang")
+
+_RULE_RE = re.compile(
+    r"^(?P<seam>[a-z0-9_.]+):(?P<mode>[a-z]+)@(?P<occ>[0-9,*-]+)$")
+
+
+class FaultInjected(ConnectionError):
+    """A scripted seam failure.  Subclasses ConnectionError so the
+    transports under test exercise their real reconnect/retry paths
+    instead of a synthetic exception type they would never see."""
+
+    def __init__(self, seam: str, n: int):
+        super().__init__(f"fault injected at {seam} (invocation #{n})")
+        self.seam = seam
+        self.n = n
+
+
+class _Rule:
+    __slots__ = ("mode", "occurrences", "always")
+
+    def __init__(self, mode: str, occurrences: frozenset[int],
+                 always: bool):
+        self.mode = mode
+        self.occurrences = occurrences
+        self.always = always
+
+    def fires_at(self, n: int) -> bool:
+        return self.always or n in self.occurrences
+
+
+def _parse_occurrences(spec: str) -> tuple[frozenset[int], bool]:
+    if spec == "*":
+        return frozenset(), True
+    out: set[int] = set()
+    for part in spec.split(","):
+        lo, sep, hi = part.partition("-")
+        try:
+            if sep:
+                a, b = int(lo), int(hi)
+            else:
+                a = b = int(lo)
+        except ValueError:
+            raise ValueError(f"bad occurrence spec {part!r}")
+        if a < 1 or b < a:
+            raise ValueError(f"bad occurrence range {part!r}")
+        out.update(range(a, b + 1))
+    if not out:
+        raise ValueError(f"empty occurrence spec {spec!r}")
+    return frozenset(out), False
+
+
+class FaultPlan:
+    """A parsed TZ_FAULT_PLAN: per-seam rules + invocation counters.
+
+    Thread-safe; one plan is active process-wide (install_plan).
+    heal(seam) removes a seam's rules and releases its hung threads —
+    the test-side lever for "the backend recovered"."""
+
+    def __init__(self, rules: Optional[dict[str, _Rule]] = None):
+        self._rules: dict[str, _Rule] = dict(rules or {})
+        self._counts: dict[str, int] = {}
+        self._fired: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._releases: dict[str, threading.Event] = {
+            seam: threading.Event() for seam in self._rules}
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        rules: dict[str, _Rule] = {}
+        for clause in filter(None, (c.strip() for c in text.split(";"))):
+            m = _RULE_RE.match(clause)
+            if m is None:
+                raise ValueError(f"bad fault clause {clause!r} "
+                                 "(want seam:mode@occurrences)")
+            seam, mode, occ = m.group("seam", "mode", "occ")
+            if mode not in MODES:
+                raise ValueError(f"unknown fault mode {mode!r} "
+                                 f"(want one of {MODES})")
+            if seam not in SEAMS:
+                log.logf(0, "fault plan names unregistered seam %r "
+                            "(known: %s)", seam, ", ".join(SEAMS))
+            if seam in rules:
+                raise ValueError(f"duplicate seam {seam!r} in plan")
+            occurrences, always = _parse_occurrences(occ)
+            rules[seam] = _Rule(mode, occurrences, always)
+        if not rules:
+            raise ValueError("empty fault plan")
+        return cls(rules)
+
+    # -- introspection (tests) --------------------------------------------
+
+    def invocations(self, seam: str) -> int:
+        with self._lock:
+            return self._counts.get(seam, 0)
+
+    def fired(self, seam: str) -> int:
+        with self._lock:
+            return self._fired.get(seam, 0)
+
+    # -- runtime ----------------------------------------------------------
+
+    def heal(self, seam: str) -> None:
+        """Stop injecting at this seam and release its hung threads."""
+        with self._lock:
+            self._rules.pop(seam, None)
+            ev = self._releases.get(seam)
+        if ev is not None:
+            ev.set()
+
+    def release_all(self) -> None:
+        for ev in self._releases.values():
+            ev.set()
+
+    def hit(self, seam: str) -> None:
+        """One invocation of `seam`; fail/hang per the plan."""
+        with self._lock:
+            n = self._counts.get(seam, 0) + 1
+            self._counts[seam] = n
+            rule = self._rules.get(seam)
+            if rule is None or not rule.fires_at(n):
+                return
+            self._fired[seam] = self._fired.get(seam, 0) + 1
+            mode = rule.mode
+            ev = self._releases[seam]
+        if mode == "fail":
+            raise FaultInjected(seam, n)
+        log.logf(2, "fault plan: hanging %s invocation #%d", seam, n)
+        ev.wait()
+
+
+_active: Optional[FaultPlan] = None
+_env_loaded = False
+_install_lock = threading.Lock()
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Activate a plan process-wide (None deactivates); returns it."""
+    global _active, _env_loaded
+    with _install_lock:
+        prev = _active
+        _active = plan
+        _env_loaded = True  # an explicit install overrides the env
+    if prev is not None and prev is not plan:
+        prev.release_all()
+    return plan
+
+
+def reset_plan() -> None:
+    """Deactivate the plan and release any hung seams (test teardown)."""
+    install_plan(None)
+
+
+def plan_from_env() -> Optional[FaultPlan]:
+    """Parse TZ_FAULT_PLAN; a malformed plan logs and is ignored (the
+    harness must never take the engine down by itself)."""
+    import os
+
+    text = os.environ.get("TZ_FAULT_PLAN", "")
+    if not text:
+        return None
+    try:
+        return FaultPlan.parse(text)
+    except ValueError as e:
+        log.logf(0, "ignoring malformed TZ_FAULT_PLAN: %s", e)
+        return None
+
+
+def _load_env_plan() -> Optional[FaultPlan]:
+    global _active, _env_loaded
+    with _install_lock:
+        if not _env_loaded:
+            _env_loaded = True
+            _active = plan_from_env()
+        return _active
+
+
+def fault_point(seam: str) -> None:
+    """The per-seam hook.  No active plan: one global load + None
+    test.  With a plan: count the invocation and fail/hang on script."""
+    plan = _active
+    if plan is None:
+        if _env_loaded:
+            return
+        plan = _load_env_plan()
+        if plan is None:
+            return
+    plan.hit(seam)

@@ -1,0 +1,139 @@
+"""Watchdog: heartbeat + deadline around device dispatch and compile.
+
+The round-5 wedge (BENCH_WEDGE_DIAGNOSIS.md) was a PJRT Client_Create
+/ dispatch hanging on a dead relay: the worker thread blocked inside
+the runtime forever, the pipeline produced nothing, and the fuzzer's
+only signal was N drain timeouts later.  Python cannot cancel a
+thread stuck in a C extension, but it CAN refuse to wait on one: the
+watchdog runs each guarded call on a disposable daemon thread, waits
+out the deadline, and converts a stall into a structured
+DeviceWedged — the worker's failure handling (circuit breaker,
+host-snapshot rebuild) then proceeds while the wedged call is
+abandoned to finish (or not) in the background.
+
+Deadlines come from the pipeline's env knobs (TZ_WATCHDOG_DEADLINE_S
+for steady-state launches, TZ_WATCHDOG_COMPILE_S for the first call,
+which carries the jit trace + tunnel compile).  A deadline of 0
+disables the wrapper (direct call) for deployments that cannot spare
+the thread-per-call overhead.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class DeviceWedged(RuntimeError):
+    """A guarded device call exceeded its watchdog deadline.  The
+    call's thread is abandoned, not cancelled: `op` names the seam
+    for triage and the breaker treats this like any device failure."""
+
+    def __init__(self, op: str, deadline_s: float):
+        super().__init__(
+            f"device call {op!r} exceeded watchdog deadline "
+            f"({deadline_s:.1f}s); treating the backend as wedged")
+        self.op = op
+        self.deadline_s = deadline_s
+
+
+@dataclass
+class WatchdogStats:
+    calls: int = 0
+    wedges: int = 0  # calls converted to DeviceWedged
+    abandoned_live: int = 0  # wedged threads that never finished
+    last_duration_s: float = 0.0
+    last_op: str = ""
+
+
+class Watchdog:
+    """Deadline-guards blocking device calls; tracks a heartbeat.
+
+    One watchdog per pipeline; call() may be invoked from any thread.
+    """
+
+    def __init__(self, deadline_s: float = 120.0,
+                 compile_deadline_s: float = 600.0,
+                 clock=time.monotonic):
+        self.deadline_s = deadline_s
+        self.compile_deadline_s = compile_deadline_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.stats = WatchdogStats()
+        self._last_beat = clock()
+        self._abandoned: list[threading.Thread] = []
+
+    # -- heartbeat --------------------------------------------------------
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last_beat = self._clock()
+
+    def since_last_beat(self) -> float:
+        with self._lock:
+            return self._clock() - self._last_beat
+
+    # -- the guard --------------------------------------------------------
+
+    def call(self, fn, op: str, deadline_s=None):
+        """Run fn() under `deadline_s` (default: the launch deadline).
+        Returns fn's result, re-raises its exception, or raises
+        DeviceWedged when the deadline passes first."""
+        if deadline_s is None:
+            deadline_s = self.deadline_s
+        with self._lock:
+            self.stats.calls += 1
+            self.stats.last_op = op
+            # Reap abandoned threads that eventually came back.
+            self._abandoned = [t for t in self._abandoned if t.is_alive()]
+            self.stats.abandoned_live = len(self._abandoned)
+        if not deadline_s or deadline_s <= 0:
+            t0 = self._clock()
+            try:
+                return fn()
+            finally:
+                self._note_done(self._clock() - t0)
+        box: dict = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # delivered to the caller
+                box["error"] = e
+            finally:
+                done.set()
+
+        t0 = self._clock()
+        th = threading.Thread(target=run, daemon=True,
+                              name=f"watchdog-{op}")
+        th.start()
+        if not done.wait(timeout=deadline_s):
+            with self._lock:
+                self.stats.wedges += 1
+                self._abandoned.append(th)
+                self.stats.abandoned_live = len(self._abandoned)
+            raise DeviceWedged(op, deadline_s)
+        self._note_done(self._clock() - t0)
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def _note_done(self, duration: float) -> None:
+        with self._lock:
+            self.stats.last_duration_s = duration
+            self._last_beat = self._clock()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "calls": self.stats.calls,
+                "wedges": self.stats.wedges,
+                "abandoned_live": self.stats.abandoned_live,
+                "last_duration_s": round(self.stats.last_duration_s, 3),
+                "since_last_beat_s": round(
+                    self._clock() - self._last_beat, 3),
+                "deadline_s": self.deadline_s,
+                "compile_deadline_s": self.compile_deadline_s,
+            }

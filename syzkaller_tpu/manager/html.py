@@ -103,7 +103,19 @@ def _summary_page(mgr) -> str:
                    for k, v in sorted(s.items()) if not isinstance(v, dict))
     stats = s.get("stats", {})
     rows += "".join(f"<tr><td>{html.escape(k)}</td>"
-                    f"<td>{v}</td></tr>" for k, v in sorted(stats.items()))
+                    f"<td>{v}</td></tr>" for k, v in sorted(stats.items())
+                    if not k.startswith("device "))
+    # Device-engine health: the breaker/watchdog transition counters
+    # the fuzzers sync up (demotions, breaker opens, ring rebuilds,
+    # wedges) get their own section — this is the page an operator
+    # checks when the flagship number looks off (docs/health.md).
+    health = ""
+    dev = s.get("device_health") or {}
+    if dev:
+        hrows = "".join(f"<tr><td>{html.escape(k)}</td><td>{v}</td></tr>"
+                        for k, v in sorted(dev.items()))
+        health = (f"<h3>Device engine health</h3>"
+                  f"<table>{hrows}</table>")
     crashes = ""
     with mgr._lock:
         items = sorted(mgr.crash_types.items(),
@@ -116,7 +128,7 @@ def _summary_page(mgr) -> str:
                     f"{html.escape(title)}</a></td><td>{entry.count}</td>"
                     f"<td>{'yes' if entry.repro_done else ''}</td>"
                     f"<td><a href='/report?id={sig}'>report</a></td></tr>")
-    body = (f"<table>{rows}</table><h3>Crashes</h3>"
+    body = (f"<table>{rows}</table>{health}<h3>Crashes</h3>"
             f"<table><tr><th>title</th><th>count</th><th>repro</th>"
             f"<th></th></tr>{crashes}</table>")
     return _page(f"{mgr.cfg.name} syz-manager", body)

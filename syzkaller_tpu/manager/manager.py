@@ -274,6 +274,14 @@ class Manager:
         s["fuzzing_time_s"] = int(time.time() - self.first_connect) \
             if self.first_connect else 0
         s["triaged"] = self.serv.triaged_candidates
+        # Device-engine health rollup (fed by the fuzzers' breaker/
+        # watchdog transition counters, fuzzer/proc.py
+        # _sync_health_stats): its own block so the HTTP status page
+        # and the bench snapshots can show engine health at a glance.
+        s["device_health"] = {
+            k[len("device "):]: v
+            for k, v in (s.get("stats") or {}).items()
+            if k.startswith("device ")}
         return s
 
     def start_bench(self, path: str, period_s: float = 60.0) -> None:

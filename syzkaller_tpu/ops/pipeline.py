@@ -27,7 +27,6 @@ integrated op distribution matches the reference weighted loop
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
@@ -36,6 +35,14 @@ from typing import Optional
 
 import numpy as np
 
+from syzkaller_tpu.health import (
+    CircuitBreaker,
+    FaultInjected,
+    Watchdog,
+    env_float,
+    env_int,
+    fault_point,
+)
 from syzkaller_tpu.models.prog import Prog
 from syzkaller_tpu.ops.delta import (
     FLAG_OVERFLOW,
@@ -168,6 +175,7 @@ class PipelineStats:
     overflows: int = 0  # delta rows exceeding the K/D/P budget
     inserts: int = 0  # insert-class mutants produced
     worker_errors: int = 0  # device failures survived by the worker
+    delivery_errors: int = 0  # batches dropped at the queue.put seam
 
 
 # Lean device shapes for the pipeline: mutation cost is dominated by
@@ -305,19 +313,57 @@ class DevicePipeline:
         # the next batch's compute; depth 2 pipelines all three stages
         # (compute N+2 ‖ d2h-transfer N+1 ‖ assemble N), which matters
         # on the tunneled chip where the per-batch link transfer is
-        # comparable to the kernel time itself.
-        self._dispatch_depth = max(1, int(os.environ.get(
-            "TZ_PIPELINE_DISPATCH_DEPTH", str(dispatch_depth))))
-        # Worker retry backoff after a device failure (seconds);
-        # instance attrs so tests and deployments can tune recovery
-        # latency without waiting out real backoffs.
-        self.retry_backoff_initial = 1.0
-        self.retry_backoff_cap = 60.0
+        # comparable to the kernel time itself.  A malformed env value
+        # falls back to the constructor argument (health.envsafe).
+        self._dispatch_depth = max(1, env_int(
+            "TZ_PIPELINE_DISPATCH_DEPTH", dispatch_depth))
+        # Self-healing runtime (syzkaller_tpu/health, docs/health.md):
+        # the breaker paces recovery after device failures (closed →
+        # open → half-open probe with host-snapshot rebuild → closed)
+        # and the watchdog bounds wedge-prone blocking calls.  Both
+        # are plain attributes so tests and deployments can tune
+        # recovery latency without waiting out real backoffs.
+        self.breaker = CircuitBreaker(
+            failure_threshold=max(1, env_int("TZ_BREAKER_THRESHOLD", 4)),
+            backoff_initial=env_float("TZ_BREAKER_BACKOFF_S", 1.0),
+            backoff_cap=env_float("TZ_BREAKER_BACKOFF_CAP_S", 60.0),
+            seed=seed)
+        self.watchdog = Watchdog(
+            deadline_s=env_float("TZ_WATCHDOG_DEADLINE_S", 120.0),
+            compile_deadline_s=env_float("TZ_WATCHDOG_COMPILE_S", 600.0))
+        self._compiled = False  # first dispatch carries the jit compile
         self._have_corpus = threading.Event()
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._worker_loop,
                                         name="device-pipeline", daemon=True)
         self._started = False
+
+    # Pre-breaker tuning knobs kept as proxies: tests and deployments
+    # set these to shrink recovery latency (test_pipeline.py).
+    @property
+    def retry_backoff_initial(self) -> float:
+        return self.breaker.backoff_initial
+
+    @retry_backoff_initial.setter
+    def retry_backoff_initial(self, v: float) -> None:
+        self.breaker.configure_backoff(initial=v)
+
+    @property
+    def retry_backoff_cap(self) -> float:
+        return self.breaker.backoff_cap
+
+    @retry_backoff_cap.setter
+    def retry_backoff_cap(self, v: float) -> None:
+        self.breaker.configure_backoff(cap=v)
+
+    def health_snapshot(self) -> dict:
+        """Breaker + watchdog state for tests and the status page."""
+        return {
+            "breaker": self.breaker.snapshot(),
+            "watchdog": self.watchdog.snapshot(),
+            "worker_errors": self.stats.worker_errors,
+            "delivery_errors": self.stats.delivery_errors,
+        }
 
     # -- corpus management -------------------------------------------------
 
@@ -376,10 +422,22 @@ class DevicePipeline:
                 # so keep only the LAST row per index (matching the
                 # host template snapshot).
                 last = {i: r for i, r in pending}
-                idx = np.array(list(last.keys()), dtype=np.int32)
+                idx_list = list(last.keys())
+                # Pad the scatter to a power-of-two row count so
+                # corpus growth / ring rebuilds don't re-jit the
+                # per-field scatter on every new pending-count shape
+                # (a host-snapshot rebuild stages the whole ring, and
+                # on the tunneled chip each re-jit costs more than
+                # the scatter itself).  Duplicating one index with
+                # identical row data is well-defined even under
+                # XLA's unspecified duplicate-index order.
+                pad = (1 << max(0, (len(idx_list) - 1).bit_length())) \
+                    - len(idx_list)
+                idx = np.array(idx_list + idx_list[-1:] * pad,
+                               dtype=np.int32)
                 for k in self._corpus_dev:
-                    rows = np.stack([np.asarray(r[k])
-                                     for r in last.values()])
+                    vals = [np.asarray(r[k]) for r in last.values()]
+                    rows = np.stack(vals + vals[-1:] * pad)
                     self._corpus_dev[k] = \
                         self._corpus_dev[k].at[idx].set(rows)
         except Exception:
@@ -418,7 +476,21 @@ class DevicePipeline:
             return None
         self._key, sub = self._random.split(self._key)
         fv, fc = self._flags_dev
-        rows_dev = self._step(corpus, n, sub, fv, fc)
+        # The first dispatch carries the jit trace + (tunneled) XLA
+        # compile, so it runs under the compile seam/deadline; steady
+        # state runs under the launch seam.  A wedged PJRT call is
+        # converted into DeviceWedged by the watchdog instead of
+        # hanging the worker forever (BENCH_WEDGE_DIAGNOSIS.md).
+        op = "device.launch" if self._compiled else "device.compile"
+        deadline = (self.watchdog.deadline_s if self._compiled
+                    else self.watchdog.compile_deadline_s)
+
+        def dispatch():
+            fault_point(op)
+            return self._step(corpus, n, sub, fv, fc)
+
+        rows_dev = self.watchdog.call(dispatch, op, deadline_s=deadline)
+        self._compiled = True
         # Start the device->host copy now: the tunneled link has a
         # ~70 ms per-sync fixed cost that fully hides behind the next
         # batch's compute (the worker dispatches N+1 before draining N).
@@ -433,7 +505,10 @@ class DevicePipeline:
         from syzkaller_tpu.ops.emit import splice_insert
 
         rows_dev, tmpl, ets = launched
-        buf = np.asarray(rows_dev)  # the one device->host transfer
+        # The one device->host transfer — the blocking sync where a
+        # wedged tunnel stalls, so it runs under the watchdog too.
+        buf = self.watchdog.call(lambda: np.asarray(rows_dev),
+                                 "device.drain")
         batch = DeltaBatch(buf, self.spec, self.batch_size)
         ok = (batch.flags & FLAG_OVERFLOW) == 0
         self.stats.overflows += int(np.count_nonzero(~ok))
@@ -492,9 +567,10 @@ class DevicePipeline:
     def _worker_loop(self) -> None:
         from collections import deque
 
+        from syzkaller_tpu.health.breaker import HALF_OPEN
+        from syzkaller_tpu.utils import log
+
         pending: deque = deque()
-        backoff = self.retry_backoff_initial
-        errors_since_ok = 0
         while not self._stop.is_set():
             if not self._have_corpus.wait(timeout=0.2):
                 continue
@@ -502,16 +578,39 @@ class DevicePipeline:
             # tunneled backend can refuse COMPILES while the session
             # stays up (BENCH_WEDGE_DIAGNOSIS.md §8 mode 3), and a
             # dead worker would pin the fuzzer's health latch demoted
-            # forever.  Drop in-flight work, back off, retry — when
-            # the backend recovers, the latch's probe loop re-enables
-            # device mutation on its own.
+            # forever.  The circuit breaker owns the recovery policy:
+            # a failure streak trips it open (in-flight work dropped,
+            # consumers demote to CPU mutation), probes re-enter with
+            # exponential backoff + jitter, and EVERY half-open
+            # re-entry rebuilds the device ring from the host-side
+            # snapshot — not just the 4th error, so long failure
+            # streaks keep re-triggering rebuilds (ADVICE.md r5).
+            if not self.breaker.allow():
+                wait = min(0.2, max(0.02,
+                                    self.breaker.seconds_until_probe()))
+                if self._stop.wait(timeout=wait):
+                    return
+                continue
+            probing = self.breaker.state == HALF_OPEN
             try:
+                if self.breaker.consume_rebuild():
+                    # Re-entering half-open: the backend may have
+                    # restarted and invalidated the old buffers —
+                    # rebuild the ring from the host template snapshot
+                    # before the probe batch.
+                    log.logf(0, "device pipeline: rebuilding device "
+                                "state from the host corpus snapshot "
+                                "(probe #%d)",
+                             self.breaker.counters.half_opens)
+                    self._reset_device_state()
                 # Keep `dispatch_depth` batches in flight before
                 # draining the oldest, so device compute, d2h
                 # transfer, and host assembly overlap as independent
-                # pipeline stages.
-                while len(pending) < self._dispatch_depth \
-                        and not self._stop.is_set():
+                # pipeline stages.  A probe window flies a single
+                # batch: the point is a cheap health verdict, not
+                # throughput.
+                depth = 1 if probing else self._dispatch_depth
+                while len(pending) < depth and not self._stop.is_set():
                     launched = self._launch()
                     if launched is None:
                         break
@@ -522,26 +621,26 @@ class DevicePipeline:
             except Exception as e:
                 pending.clear()
                 self.stats.worker_errors += 1
-                errors_since_ok += 1
-                from syzkaller_tpu.utils import log
-
+                state = self.breaker.record_failure()
                 log.logf(0, "device pipeline worker error (#%d, "
-                            "retrying in %.1fs): %s",
-                         self.stats.worker_errors, backoff,
+                            "breaker %s, next probe in %.1fs): %s",
+                         self.stats.worker_errors, state,
+                         self.breaker.seconds_until_probe(),
                          str(e)[:200])
-                if errors_since_ok == 4:
-                    # Persistent failures may mean the backend
-                    # restarted and the old device buffers are dead —
-                    # rebuild the ring from the host-side snapshot.
-                    log.logf(0, "device pipeline: rebuilding device "
-                                "state from the host corpus snapshot")
-                    self._reset_device_state()
-                if self._stop.wait(timeout=backoff):
-                    return
-                backoff = min(backoff * 2, self.retry_backoff_cap)
                 continue
-            backoff = self.retry_backoff_initial
-            errors_since_ok = 0
+            self.breaker.record_success()
+            try:
+                # The delivery seam (one invocation per produced
+                # batch, so occurrence plans stay deterministic under
+                # queue backpressure): a scripted failure drops the
+                # batch — costing only its slot — but must not kill
+                # the worker or trip the device breaker.
+                fault_point("queue.put")
+            except FaultInjected as e:
+                self.stats.delivery_errors += 1
+                log.logf(0, "device pipeline: batch dropped at "
+                            "delivery seam: %s", e)
+                continue
             while not self._stop.is_set():
                 try:
                     self._queue.put(batch, timeout=0.2)

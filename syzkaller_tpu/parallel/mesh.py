@@ -184,7 +184,6 @@ def make_sharded_pack_step(mesh: Mesh, spec=None, rounds: int = 4):
 
     spec = spec or DeltaSpec()
     pack = make_packer(spec)
-    has_host = "host" in mesh.axis_names
 
     def local(batch, key, flag_vals, flag_counts, tidx):
         b = batch["kind"].shape[0]

@@ -21,6 +21,8 @@ import threading
 import zlib
 from typing import Any, Callable, Optional
 
+from syzkaller_tpu.health.faultinject import fault_point
+
 _FRAME = struct.Struct("<IB")  # payload length, flags
 _FLAG_ZLIB = 1
 _COMPRESS_MIN = 4 << 10
@@ -32,6 +34,11 @@ class RPCError(Exception):
 
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
+    # Fault seam: a scripted `fail` here raises FaultInjected (a
+    # ConnectionError), driving the client's reconnect/retry path and
+    # the server's connection-drop path exactly as a real peer death
+    # would (health/faultinject.py).
+    fault_point("rpc.send_frame")
     data = json.dumps(obj, separators=(",", ":")).encode()
     flags = 0
     if len(data) >= _COMPRESS_MIN:
@@ -51,6 +58,7 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_frame(sock: socket.socket) -> Any:
+    fault_point("rpc.recv_frame")
     hdr = _recv_exact(sock, _FRAME.size)
     length, flags = _FRAME.unpack(hdr)
     if length > _MAX_FRAME:

@@ -206,9 +206,15 @@ def run_bench(args: list[str], timeout_s: float) -> dict | None:
     # Give the pipeline warmup most of the subprocess budget: the
     # warmup's first batch is where a starved PJRT client waits for
     # the pool lease, so a short warmup timeout would abandon the
-    # standing-lease-catcher role (module docstring) early.
-    env = dict(os.environ,
-               TZ_BENCH_WARMUP_TIMEOUT_S=str(int(timeout_s - 300)))
+    # standing-lease-catcher role (module docstring) early.  A/B runs
+    # need a bigger post-warmup window: after the lease lands they
+    # still run the timed leg AND the engine-off leg, and a lease
+    # caught late in the warmup window must not be killed by the
+    # outer timeout with only one leg measured (r5 lost an A/B
+    # artifact exactly this way).
+    post_warmup = 900 if "--ab" in args else 300
+    warmup = max(60, int(timeout_s - post_warmup))
+    env = dict(os.environ, TZ_BENCH_WARMUP_TIMEOUT_S=str(warmup))
     try:
         res = subprocess.run([sys.executable, "bench.py",
                               "--no-preflight"] + args,

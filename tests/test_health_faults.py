@@ -1,0 +1,474 @@
+"""Self-healing device runtime, driven by the deterministic fault
+plan (syzkaller_tpu/health): scripted seam failures take the real
+DevicePipeline through demote → half-open probe (with host-snapshot
+rebuild on EVERY re-entry) → re-promote, with zero lost corpus items;
+a scripted hang proves the watchdog converts a stall into DeviceWedged
+within its deadline instead of blocking the worker thread forever
+(the round-5 wedge, BENCH_WEDGE_DIAGNOSIS.md)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import pytest
+
+from syzkaller_tpu.health import (
+    CircuitBreaker,
+    DeviceWedged,
+    FaultInjected,
+    FaultPlan,
+    Watchdog,
+    env_float,
+    env_int,
+    fault_point,
+    install_plan,
+    plan_from_env,
+    reset_plan,
+)
+from syzkaller_tpu.health.breaker import CLOSED, HALF_OPEN, OPEN
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    reset_plan()
+    yield
+    reset_plan()
+
+
+# -- fault plan grammar ---------------------------------------------------
+
+
+def test_plan_grammar():
+    plan = FaultPlan.parse(
+        "device.launch:fail@3,5;rpc.send_frame:hang@2")
+    assert plan._rules["device.launch"].mode == "fail"
+    assert plan._rules["device.launch"].occurrences == {3, 5}
+    assert plan._rules["rpc.send_frame"].mode == "hang"
+
+    ranged = FaultPlan.parse("device.launch:fail@1-8")
+    assert ranged._rules["device.launch"].occurrences == set(range(1, 9))
+
+    always = FaultPlan.parse("queue.put:fail@*")
+    assert always._rules["queue.put"].always
+
+
+@pytest.mark.parametrize("bad", [
+    "", "device.launch", "device.launch:fail", "device.launch:@3",
+    "device.launch:explode@3", "device.launch:fail@0",
+    "device.launch:fail@5-3", "device.launch:fail@x",
+    "device.launch:fail@1;device.launch:fail@2",
+])
+def test_plan_grammar_rejects(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_env_plan_malformed_is_ignored(monkeypatch):
+    monkeypatch.setenv("TZ_FAULT_PLAN", "this is not a plan")
+    assert plan_from_env() is None
+    monkeypatch.setenv("TZ_FAULT_PLAN", "device.launch:fail@2")
+    plan = plan_from_env()
+    assert plan is not None and "device.launch" in plan._rules
+
+
+def test_fault_point_fires_on_scripted_invocations_only():
+    install_plan(FaultPlan.parse("device.launch:fail@2"))
+    fault_point("device.launch")  # invocation 1: clean
+    with pytest.raises(FaultInjected) as ei:
+        fault_point("device.launch")  # invocation 2: scripted
+    assert ei.value.seam == "device.launch" and ei.value.n == 2
+    assert isinstance(ei.value, ConnectionError)  # realistic type
+    fault_point("device.launch")  # invocation 3: clean again
+    fault_point("rpc.recv_frame")  # other seams unaffected
+    install_plan(None)  # deactivated: seams are free
+    fault_point("device.launch")
+
+
+def test_fault_point_hang_releases_on_heal():
+    plan = install_plan(FaultPlan.parse("device.launch:hang@1"))
+    done = threading.Event()
+
+    def hit():
+        fault_point("device.launch")
+        done.set()
+
+    t = threading.Thread(target=hit, daemon=True)
+    t.start()
+    assert not done.wait(timeout=0.3), "hang seam did not block"
+    plan.heal("device.launch")
+    assert done.wait(timeout=5), "heal did not release the hung seam"
+
+
+# -- env hardening --------------------------------------------------------
+
+
+def test_envsafe_falls_back_on_malformed(monkeypatch):
+    monkeypatch.setenv("TZ_X_INT", "not-a-number")
+    monkeypatch.setenv("TZ_X_FLOAT", "1.5.9")
+    assert env_int("TZ_X_INT", 7) == 7
+    assert env_float("TZ_X_FLOAT", 2.5) == 2.5
+    monkeypatch.setenv("TZ_X_INT", "0x10")
+    assert env_int("TZ_X_INT", 7) == 16
+    monkeypatch.setenv("TZ_X_FLOAT", "3.5")
+    assert env_float("TZ_X_FLOAT", 2.5) == 3.5
+    assert env_int("TZ_X_UNSET", 9) == 9
+
+
+def test_pipeline_survives_malformed_dispatch_depth(monkeypatch):
+    jax = pytest.importorskip("jax")
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
+
+    monkeypatch.setenv("TZ_PIPELINE_DISPATCH_DEPTH", "two")
+    pl = DevicePipeline(get_target("test", "64"), capacity=8,
+                        batch_size=4, dispatch_depth=3)
+    assert pl._dispatch_depth == 3  # constructor fallback, not a crash
+
+
+# -- circuit breaker ------------------------------------------------------
+
+
+def test_breaker_state_machine_deterministic():
+    clk = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=3, backoff_initial=1.0,
+                        backoff_cap=4.0, jitter=0.0, seed=7,
+                        clock=lambda: clk["t"])
+    assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED  # below threshold
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED  # success reset the streak
+    br.record_failure()
+    assert br.state == OPEN and br.counters.opens == 1
+    assert not br.allow()  # backoff not elapsed
+    assert br.seconds_until_probe() == pytest.approx(1.0)
+
+    clk["t"] = 1.0
+    assert br.allow()  # probe admitted
+    assert br.state == HALF_OPEN and br.counters.half_opens == 1
+    assert br.consume_rebuild()  # one rebuild per half-open entry
+    assert not br.consume_rebuild()
+    br.record_failure()  # failed probe: reopen, backoff doubles
+    assert br.state == OPEN and br.counters.opens == 2
+    assert br.seconds_until_probe() == pytest.approx(2.0)
+
+    clk["t"] = 3.0
+    assert br.allow() and br.consume_rebuild()  # rebuild re-triggers
+    br.record_failure()
+    assert br.seconds_until_probe() == pytest.approx(4.0)  # capped next
+    clk["t"] = 7.0
+    assert br.allow() and br.consume_rebuild()
+    br.record_success()  # probe succeeded: re-promotion
+    assert br.state == CLOSED and br.counters.closes == 1
+    assert br.counters.rebuilds == 3
+    assert not br.consume_rebuild()  # cleared by the close
+    snap = br.snapshot()
+    assert snap["state"] == CLOSED and snap["opens"] == 3
+
+
+def test_breaker_jitter_is_deterministic():
+    def mk():
+        clk = {"t": 0.0}
+        br = CircuitBreaker(failure_threshold=1, backoff_initial=1.0,
+                            backoff_cap=60.0, jitter=0.2, seed=42,
+                            clock=lambda: clk["t"])
+        br.record_failure()
+        return br.seconds_until_probe()
+
+    assert mk() == mk()  # same seed, same trajectory
+
+
+# -- watchdog -------------------------------------------------------------
+
+
+def test_watchdog_passes_results_and_errors_through():
+    wd = Watchdog(deadline_s=5.0)
+    assert wd.call(lambda: 42, "device.launch") == 42
+    with pytest.raises(KeyError):
+        wd.call(lambda: {}["x"], "device.launch")
+    assert wd.stats.calls == 2 and wd.stats.wedges == 0
+    wd0 = Watchdog(deadline_s=0)  # disabled: direct call
+    assert wd0.call(lambda: "ok", "device.launch") == "ok"
+
+
+def test_watchdog_converts_hang_to_device_wedged():
+    wd = Watchdog(deadline_s=0.2)
+    release = threading.Event()
+    t0 = time.monotonic()
+    with pytest.raises(DeviceWedged) as ei:
+        wd.call(release.wait, "device.launch")
+    detect = time.monotonic() - t0
+    assert ei.value.op == "device.launch"
+    assert detect < 5.0  # detected promptly, not an eternal stall
+    assert wd.stats.wedges == 1
+    assert wd.stats.abandoned_live == 1  # the stuck call lives on
+    release.set()  # let the abandoned thread finish
+
+
+# -- pipeline integration -------------------------------------------------
+
+
+def _build_pipeline(target, n_seeds=8, **kw):
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.rand import RandGen
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
+
+    kw.setdefault("capacity", 64)
+    kw.setdefault("batch_size", 8)
+    pl = DevicePipeline(target, seed=3, **kw)
+    added, i = 0, 0
+    while added < n_seeds and i < n_seeds * 6:
+        p = generate_prog(target, RandGen(target, 4000 + i), 5)
+        i += 1
+        if pl.add(p):
+            added += 1
+    assert added >= n_seeds // 2
+    return pl
+
+
+@pytest.fixture(scope="module")
+def device_rig():
+    """One warm (compiled) pipeline shared by the integration tests —
+    the jit compile dominates test wall-clock, and every test below
+    scripts its faults from a freshly installed plan, so seam counts
+    are deterministic from the install point regardless of history.
+    depth 1 keeps at most one launch in flight, so a scripted failure
+    cannot silently drop an unrelated healthy batch from the deque.
+    Each test must leave the pipeline healthy (breaker closed, no
+    active plan — the autouse _clean_plan fixture enforces the
+    latter)."""
+    pytest.importorskip("jax")
+    from syzkaller_tpu.models.target import get_target
+
+    target = get_target("test", "64")
+    pl = _build_pipeline(target, dispatch_depth=1, rounds=1)
+    pl.breaker.configure_backoff(initial=0.15, cap=0.4)
+    first = pl.next_batch(timeout=300)  # compile + warmup
+    assert first
+    yield target, pl
+    pl.stop()
+
+
+def _drain_until(pl, cond, timeout=60.0):
+    """Keep draining batches (unblocking the worker's delivery) until
+    cond() holds; returns the last drained batch, if any."""
+    last = None
+    deadline = time.time() + timeout
+    while not cond() and time.time() < deadline:
+        try:
+            last = pl.next_batch(timeout=0.1)
+        except queue.Empty:
+            pass
+    return last
+
+
+@pytest.fixture()
+def fuzzer_state():
+    pytest.importorskip("jax")
+    from syzkaller_tpu.fuzzer import Fuzzer, FuzzerConfig, WorkQueue
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.rand import RandGen
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.signal import Signal
+    from syzkaller_tpu.signal.cover import Cover
+
+    target = get_target("test", "64")
+    fz = Fuzzer(target, wq=WorkQueue(),
+                cfg=FuzzerConfig(program_length=6))
+    for i in range(6):
+        p = generate_prog(target, RandGen(target, 8800 + i), 4)
+        fz.add_input_to_corpus(p, Signal({i: 1}), Cover())
+    return target, fz
+
+
+def test_fault_plan_demote_rebuild_repromote_no_corpus_loss(
+        device_rig, fuzzer_state):
+    """The acceptance trajectory: ≥8 consecutive scripted
+    device-launch failures trip the breaker (CPU demotion), every
+    half-open probe re-triggers the host-snapshot rebuild (not just
+    once at error #4), and the pipeline re-promotes after the seam
+    heals — with zero lost corpus items."""
+    from syzkaller_tpu.fuzzer.proc import PipelineMutator
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.rand import RandGen
+
+    target, pl = device_rig
+    _, fz = fuzzer_state
+    pm = PipelineMutator(pl, drain_timeout=0.5, demote_after=3,
+                         probe_interval=0.05, probe_timeout=0.5)
+    # Don't also feed the fuzzer's corpus into the shared ring: the
+    # feed path is covered by test_fuzzer, and keeping the add set
+    # small avoids paying XLA scatter compiles for extra row-count
+    # shapes in this timing-sensitive test.
+    pm._fed = fz.corpus_len()
+    rng = RandGen(target, 17)
+    errors0 = pl.stats.worker_errors
+    snap0 = pl.breaker.snapshot()
+    # Seam counting starts at install: the worker's next 8 launches
+    # fail back-to-back; invocation 9 is unscripted (the heal).
+    install_plan(FaultPlan.parse("device.launch:fail@1-8"))
+
+    # Drain pre-fault batches so the worker keeps launching into the
+    # seam; the failure streak trips the breaker open.
+    _drain_until(pl, pl.breaker.is_open)
+    assert pl.breaker.is_open(), "breaker never opened"
+
+    # The mutator's fast-demote path must latch to CPU fallback
+    # without burning demote_after drain timeouts.
+    deadline = time.time() + 60
+    while pm.healthy() and pl.breaker.is_open() \
+            and time.time() < deadline:
+        pm.next(fz, rng)
+    assert not pm.healthy(), "mutator never demoted to CPU"
+
+    # Corpus items added while the device is down must not be lost:
+    # they stage host-side and ride the next rebuild.
+    added_while_down = 0
+    for i in range(3):
+        p = generate_prog(target, RandGen(target, 9900 + i), 5)
+        if pl.add(p):
+            added_while_down += 1
+    assert added_while_down > 0
+
+    # Recovery: a half-open probe eventually lands and re-closes.
+    deadline = time.time() + 120
+    while pl.breaker.state != CLOSED and time.time() < deadline:
+        time.sleep(0.02)
+    assert pl.breaker.state == CLOSED, "breaker never re-closed"
+    assert pl.stats.worker_errors - errors0 >= 8
+    snap = pl.breaker.snapshot()
+    assert snap["opens"] - snap0["opens"] >= 2, \
+        "failed probes must re-open"
+    # The one-shot-latch bug: the rebuild must have re-triggered on
+    # EVERY half-open re-entry, not fired once at error #4.
+    rebuilds = snap["rebuilds"] - snap0["rebuilds"]
+    assert rebuilds >= 2, \
+        f"rebuild latch fired {rebuilds}x across the streak"
+    assert rebuilds == snap["half_opens"] - snap0["half_opens"]
+    assert snap["closes"] - snap0["closes"] >= 1
+
+    # The probe thread re-promotes the mutator.
+    deadline = time.time() + 60
+    while not pm.healthy() and time.time() < deadline:
+        time.sleep(0.02)
+    assert pm.healthy(), "mutator never re-promoted"
+    assert pm.demotions >= 1 and pm.repromotions >= 1
+
+    # Zero lost corpus: every add is still live host-side and the
+    # rebuilt ring serves templates for every produced mutant.
+    batch = pl.next_batch(timeout=300)
+    assert batch
+    assert pl.stats.evictions == 0
+    assert len(pl) == pl.stats.adds
+    live = sum(t is not None for t in pl.templates)
+    assert live == pl.stats.adds
+    for m in batch[:8]:
+        assert pl.templates[int(m.batch.template_idx[m.j])] is not None
+    health = pm.health_snapshot()
+    assert health["pipeline"]["breaker"]["state"] == CLOSED
+
+
+def test_watchdog_detects_hung_launch_in_pipeline(device_rig):
+    """A hung device.launch (the r5 PJRT wedge) is detected by the
+    watchdog within its deadline and converted into a structured
+    failure the worker survives — not an eternal worker stall."""
+    _target, pl = device_rig
+    saved_deadline = pl.watchdog.deadline_s
+    pl.watchdog.deadline_s = 0.3
+    wedges0 = pl.watchdog.stats.wedges
+    errors0 = pl.stats.worker_errors
+    plan = install_plan(FaultPlan.parse("device.launch:hang@1"))
+    try:
+        # Keep draining so the worker keeps launching into the seam.
+        _drain_until(
+            pl, lambda: pl.watchdog.stats.wedges > wedges0, timeout=30)
+        assert pl.watchdog.stats.wedges > wedges0, \
+            "watchdog never converted the hang into DeviceWedged"
+        assert pl.stats.worker_errors > errors0
+        assert pl._worker.is_alive(), "worker thread died on the wedge"
+
+        # Only invocation 1 is scripted: the very next launch succeeds
+        # and batches flow again — the wedge cost one deadline, not
+        # the fuzzer.
+        batch = pl.next_batch(timeout=300)
+        assert batch, "pipeline never recovered after the wedge"
+    finally:
+        pl.watchdog.deadline_s = saved_deadline
+        plan.heal("device.launch")  # release the abandoned thread
+
+
+def test_queue_put_seam_drops_batch_without_tripping_breaker(device_rig):
+    _target, pl = device_rig
+    drops0 = pl.stats.delivery_errors
+    failures0 = pl.breaker.counters.failures
+    install_plan(FaultPlan.parse("queue.put:fail@1"))
+    batch = _drain_until(
+        pl, lambda: pl.stats.delivery_errors > drops0, timeout=30)
+    # One batch died at the delivery seam; the next ones still flow.
+    assert pl.stats.delivery_errors == drops0 + 1
+    if batch is None:
+        batch = pl.next_batch(timeout=300)
+    assert batch
+    assert pl.breaker.state == CLOSED
+    assert pl.breaker.counters.failures == failures0
+
+
+# -- rpc seams ------------------------------------------------------------
+
+
+class _Echo:
+    def Ping(self, params):
+        return {"pong": params.get("n")}
+
+
+def test_rpc_send_seam_exercises_client_retry():
+    """fail@N on rpc.send_frame kills the pooled connection exactly
+    once; the client's reconnect-and-resend path recovers
+    transparently (the real stale-connection code path)."""
+    from syzkaller_tpu.rpc import RPCClient, RPCServer
+
+    srv = RPCServer()
+    srv.register("Echo", _Echo())
+    srv.serve_in_background()
+    cli = RPCClient(srv.addr, timeout_s=5.0)
+    try:
+        assert cli.call("Echo.Ping", {"n": 1}) == {"pong": 1}
+        # Seam counting starts at plan install.  Call 2 burns send
+        # invocations 1 (client request) and 2 (server response);
+        # call 3's request is invocation 3 — scripted to fail on the
+        # pooled connection, recovered by reconnect-and-resend.
+        plan = install_plan(FaultPlan.parse("rpc.send_frame:fail@3"))
+        assert cli.call("Echo.Ping", {"n": 2}) == {"pong": 2}
+        assert plan.fired("rpc.send_frame") == 0
+        assert cli.call("Echo.Ping", {"n": 3}) == {"pong": 3}
+        assert plan.fired("rpc.send_frame") == 1
+    finally:
+        cli.close()
+        srv.close()
+
+
+def test_rpc_recv_seam_surfaces_connection_error():
+    from syzkaller_tpu.rpc import RPCClient, RPCServer
+
+    srv = RPCServer()
+    srv.register("Echo", _Echo())
+    srv.serve_in_background()
+    cli = RPCClient(srv.addr, timeout_s=5.0)
+    try:
+        assert cli.call("Echo.Ping", {"n": 1}) == {"pong": 1}
+        # The client's NEXT recv (invocation 3: server already did
+        # recv #1... counting is process-wide, so script by mode
+        # instead: every recv fails until healed.
+        plan = install_plan(FaultPlan.parse("rpc.recv_frame:fail@*"))
+        with pytest.raises((ConnectionError, OSError)):
+            cli.call("Echo.Ping", {"n": 2})
+        plan.heal("rpc.recv_frame")
+        assert cli.call("Echo.Ping", {"n": 3}) == {"pong": 3}
+    finally:
+        cli.close()
+        srv.close()

@@ -121,6 +121,77 @@ def test_latch_demotes_and_recovers(fuzzer):
                       FakeMutant)
 
 
+def test_latch_fast_demotes_on_open_breaker(fuzzer):
+    """When the pipeline's circuit breaker reports open (the worker
+    detected the failure streak first), the mutator demotes on the
+    next device draw instead of burning demote_after drain-timeout
+    waits rediscovering the wedge — and the probe re-promotes once
+    the breaker closes and batches flow again."""
+    from syzkaller_tpu.health import CircuitBreaker
+
+    rng = RandGen(fuzzer.target, 23)
+    fake = FakePipeline()
+    fake.breaker = CircuitBreaker(failure_threshold=1,
+                                  backoff_initial=60.0)
+    pm = PipelineMutator(fake, drain_timeout=30.0, demote_after=50,
+                         probe_interval=0.02, probe_timeout=0.01)
+
+    # Healthy breaker: device draws flow normally.
+    assert isinstance(_draw_device(pm, fuzzer, rng, want_mutant=True),
+                      FakeMutant)
+
+    # Trip the breaker; the pipeline itself still answers (the worker
+    # may have failed on a later batch) but the latch must not wait
+    # for 50 drain timeouts — it demotes on the next device draw.
+    fake.mode = "dead"
+    fake.breaker.record_failure()
+    assert fake.breaker.is_open()
+    deadline = time.time() + 10
+    while pm.healthy() and time.time() < deadline:
+        pm.next(fuzzer, rng)
+    assert not pm.healthy(), "open breaker did not fast-demote"
+    assert pm.demotions == 1
+
+    # Breaker closes + pipeline answers: probe re-promotes.
+    fake.breaker.record_success()
+    fake.mode = "ok"
+    deadline = time.time() + 10
+    while not pm.healthy() and time.time() < deadline:
+        time.sleep(0.02)
+    assert pm.healthy(), "probe never re-promoted after breaker close"
+    assert pm.repromotions == 1
+    snap = pm.health_snapshot()
+    assert snap["demotions"] == 1 and not snap["demoted"]
+
+
+def test_latch_reports_health_transitions_as_stats(fuzzer):
+    """Demotions/re-promotions reach the fuzzer's poll-synced Stat
+    counters (the manager status page's data source)."""
+    rng = RandGen(fuzzer.target, 31)
+    fake = FakePipeline()
+    pm = PipelineMutator(fake, drain_timeout=0.01, demote_after=2,
+                         probe_interval=0.02, probe_timeout=0.01)
+    fake.mode = "dead"
+    deadline = time.time() + 10
+    while pm.healthy() and time.time() < deadline:
+        pm.next(fuzzer, rng)
+    assert not pm.healthy()
+    fake.mode = "ok"
+    deadline = time.time() + 10
+    while not pm.healthy() and time.time() < deadline:
+        time.sleep(0.02)
+    # One more draw syncs the counters into stats.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        pm.next(fuzzer, rng)
+        stats = fuzzer.grab_stats()
+        if stats.get("device demotions"):
+            assert stats["device demotions"] == 1
+            break
+    else:
+        raise AssertionError("demotion never reached Stat counters")
+
+
 def test_latch_not_tripped_by_single_timeout(fuzzer):
     """One isolated timeout (demote_after=3) must not demote."""
     rng = RandGen(fuzzer.target, 5)
