@@ -35,6 +35,24 @@ JOURNAL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "BENCH_HISTORY.jsonl")
 
 
+def dump_telemetry() -> None:
+    """Write the process telemetry snapshot where TZ_TELEMETRY_SNAPSHOT
+    points (set by tools/bench_watch): per-phase latency percentiles +
+    breaker/watchdog transition timelines for its wedge diagnostics.
+    Called after each warmup batch, not just at exit — a wedged attempt
+    is killed by the watcher's outer timeout, and the last mid-run dump
+    is exactly the evidence the diagnosis needs."""
+    path = os.environ.get("TZ_TELEMETRY_SNAPSHOT")
+    if not path:
+        return
+    try:
+        from syzkaller_tpu import telemetry
+
+        telemetry.dump_snapshot(path)
+    except Exception:
+        pass  # diagnostics must never fail a measurement
+
+
 def _git_rev() -> str:
     try:
         out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
@@ -143,6 +161,7 @@ def bench_pipeline(batch_size=PIPE_BATCH, seconds=8.0,
             tw = time.time()
             pl.next_batch(timeout=warmup_to if attempt == 0 else 600)
             fast = fast + 1 if time.time() - tw < 5.0 else 0
+            dump_telemetry()
             if fast >= 2:
                 break
         n = 0
@@ -152,6 +171,7 @@ def bench_pipeline(batch_size=PIPE_BATCH, seconds=8.0,
         dt = time.time() - t0
     finally:
         pl.stop()
+        dump_telemetry()
     return n / dt
 
 
@@ -473,6 +493,12 @@ def device_preflight(timeout_s: float = 180.0, attempts: int = 2,
 
 def main() -> None:
     argv = sys.argv[1:]
+    # Every exit path leaves a final telemetry snapshot for the
+    # watcher's wedge diagnostics (dump_telemetry is a no-op unless
+    # TZ_TELEMETRY_SNAPSHOT is set).
+    import atexit
+
+    atexit.register(dump_telemetry)
     # TZ_BENCH_PLATFORM (or the shared TZ_JAX_PLATFORM) pins jax to a
     # working backend — used to record functional A/B artifacts while
     # the tunneled device is wedged.  Results are labeled with the

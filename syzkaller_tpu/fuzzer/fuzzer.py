@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from enum import IntEnum
 from typing import Callable, Optional
 
+from syzkaller_tpu import telemetry
 from syzkaller_tpu.models.any_squash import call_contains_any
 from syzkaller_tpu.models.encoding import serialize_prog
 from syzkaller_tpu.models.prio import ChoiceTable, build_choice_table
@@ -67,6 +68,39 @@ STAT_NAMES = {
     Stat.DEVICE_BREAKER_OPENS: "device breaker opens",
     Stat.DEVICE_REBUILDS: "device ring rebuilds",
     Stat.DEVICE_WEDGES: "device wedges",
+}
+
+
+def _check_stat_names(stats_enum, names) -> None:
+    """Stat <-> STAT_NAMES drift guard: adding a Stat member without a
+    display name silently drops it from polls and the registry, so
+    registration fails loudly instead."""
+    missing = [s.name for s in stats_enum if s not in names]
+    if missing:
+        raise AssertionError(
+            f"Stat members without a STAT_NAMES entry: {missing}")
+    stale = [s for s in names if s not in list(stats_enum)]
+    if stale:
+        raise AssertionError(
+            f"STAT_NAMES entries without a Stat member: {stale}")
+
+
+def _stat_metric_name(display_name: str) -> str:
+    """'device ring rebuilds' -> 'tz_fuzzer_device_ring_rebuilds_total'
+    (tools/lint_metrics.py derives the same mapping from STAT_NAMES to
+    cross-check the docs catalogue)."""
+    return "tz_fuzzer_" + display_name.replace(" ", "_") + "_total"
+
+
+_check_stat_names(Stat, STAT_NAMES)
+
+#: Monotonic per-Stat registry counters: the poll-drained deltas in
+#: Fuzzer.stats feed the manager; these feed /metrics and stay
+#: monotonic across polls (one source of truth per surface).
+_STAT_COUNTERS = {
+    s: telemetry.counter(_stat_metric_name(STAT_NAMES[s]),
+                         f"fuzzer stat: {STAT_NAMES[s]}")
+    for s in Stat
 }
 
 
@@ -136,6 +170,11 @@ class Fuzzer:
             self.stats[s] += v
             if s == Stat.EXEC_TOTAL:
                 self._exec_total += v
+        # Registry mirror: monotonic (never drained by polls), so
+        # /metrics shows lifetime totals while grab_stats keeps its
+        # delta semantics.  Outside the fuzzer lock — the counter has
+        # its own, and ordering between the two surfaces is free.
+        _STAT_COUNTERS[s].inc(v)
 
     def exec_count(self) -> int:
         """Monotonic total executions (not drained by grab_stats)."""
@@ -143,12 +182,16 @@ class Fuzzer:
             return self._exec_total
 
     def grab_stats(self) -> dict[str, int]:
-        """Drain counters for a manager poll (fuzzer.go:323-338)."""
+        """Drain counters for a manager poll (fuzzer.go:323-338).
+
+        The snapshot AND the reset happen under one lock acquisition:
+        proc threads inc() concurrently, and a read-then-separately-
+        reset would lose every increment that lands between the two
+        (test_telemetry.py pins the conservation invariant)."""
         with self._lock:
-            out = {STAT_NAMES[Stat(i)]: v
-                   for i, v in enumerate(self.stats) if v}
-            self.stats = [0] * len(Stat)
-        return out
+            grabbed, self.stats = self.stats, [0] * len(Stat)
+        return {STAT_NAMES[Stat(i)]: v
+                for i, v in enumerate(grabbed) if v}
 
     def restore_poll_data(self, sig: Signal, stats: dict[str, int]) -> None:
         """Re-queue drained poll payload after a failed RPC so the

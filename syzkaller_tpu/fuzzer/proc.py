@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Optional, Union
 
+from syzkaller_tpu import telemetry
 from syzkaller_tpu.fuzzer.fuzzer import Fuzzer, Stat, signal_prio
 from syzkaller_tpu.fuzzer.workqueue import (
     ProgTypes,
@@ -42,6 +43,12 @@ from syzkaller_tpu.models.rand import RandGen
 from syzkaller_tpu.signal import Signal, from_raw
 from syzkaller_tpu.signal.cover import Cover
 from syzkaller_tpu.utils import log
+
+# Poll-loop telemetry (docs/observability.md): iteration count plus
+# span-timed phases — executor round-trips (proc.exec), triage passes
+# (proc.triage), and waits on the device pipeline (proc.device_wait).
+_M_LOOP_ITERS = telemetry.counter(
+    "tz_proc_loop_iterations_total", "proc fuzz-loop iterations")
 
 
 class PipelineMutator:
@@ -242,7 +249,8 @@ class PipelineMutator:
                 with self._lock:
                     m, self._stash = self._stash, None
                 if m is None:
-                    m = self.pipeline.next(timeout=self.drain_timeout)
+                    with telemetry.span("proc.device_wait"):
+                        m = self.pipeline.next(timeout=self.drain_timeout)
                 if m is None:
                     self._note_drain_timeout()
                     return None
@@ -311,10 +319,12 @@ class Proc:
         for i in range(iterations):
             if stop is not None and stop.is_set():
                 return
+            _M_LOOP_ITERS.inc()
             item = self.fuzzer.wq.dequeue()
             if item is not None:
                 if isinstance(item, WorkTriage):
-                    self.triage_input(item)
+                    with telemetry.span("proc.triage"):
+                        self.triage_input(item)
                 elif isinstance(item, WorkCandidate):
                     self.execute(self.exec_opts, item.p, Stat.CANDIDATE,
                                  flags=item.flags)
@@ -514,7 +524,8 @@ class Proc:
         else:
             data = serialize_for_exec(p)
         try:
-            result = self.env.exec(opts, data)
+            with telemetry.span("proc.exec"):
+                result = self.env.exec(opts, data)
         except ExecutorCrash as e:
             self.fuzzer.record_crash(
                 e.log, p.prog() if _is_exec_mutant(p) else p)

@@ -28,9 +28,28 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from syzkaller_tpu import telemetry
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
+
+# Process-wide transition counters (syzkaller_tpu/telemetry): the same
+# numbers the BreakerCounters dataclass tracks per instance, folded
+# into the one registry /metrics and bench_watch read.  Registered at
+# import so a manager-only process still exposes them at zero.
+_M_OPENS = telemetry.counter(
+    "tz_breaker_opens_total", "breaker transitions to open")
+_M_CLOSES = telemetry.counter(
+    "tz_breaker_closes_total", "breaker re-promotions to closed")
+_M_HALF_OPENS = telemetry.counter(
+    "tz_breaker_half_opens_total", "probe windows entered")
+_M_REBUILDS = telemetry.counter(
+    "tz_breaker_rebuilds_total", "host-snapshot ring rebuilds consumed")
+_M_FAILURES = telemetry.counter(
+    "tz_breaker_failures_total", "device failures recorded")
+_M_SUCCESSES = telemetry.counter(
+    "tz_breaker_successes_total", "device successes recorded")
 
 
 @dataclass
@@ -79,6 +98,12 @@ class CircuitBreaker:
         self._next_probe_at = 0.0
         self._rebuild_pending = False
         self.counters = BreakerCounters()
+        # Wallclock transition timestamps (0.0 = never): the timeline
+        # anchors bench_watch's wedge diagnostics correlate against
+        # logs, so these are time.time(), not the injected clock.
+        self._last_open_at = 0.0
+        self._last_close_at = 0.0
+        self._last_half_open_at = 0.0
 
     def configure_backoff(self, initial: float = None,
                           cap: float = None) -> None:
@@ -117,6 +142,9 @@ class CircuitBreaker:
             out["state"] = self._state
             out["consecutive_failures"] = self._consec_failures
             out["backoff_s"] = round(self._backoff, 3)
+            out["last_open_at"] = round(self._last_open_at, 3)
+            out["last_close_at"] = round(self._last_close_at, 3)
+            out["last_half_open_at"] = round(self._last_half_open_at, 3)
             return out
 
     # -- the state machine ------------------------------------------------
@@ -133,6 +161,11 @@ class CircuitBreaker:
             self._state = HALF_OPEN
             self.counters.half_opens += 1
             self._rebuild_pending = True
+            self._last_half_open_at = time.time()
+            _M_HALF_OPENS.inc()
+            telemetry.record_event(
+                "breaker.half_open",
+                f"probe #{self.counters.half_opens}")
             return True
 
     def consume_rebuild(self) -> bool:
@@ -143,10 +176,14 @@ class CircuitBreaker:
                 return False
             self._rebuild_pending = False
             self.counters.rebuilds += 1
+            _M_REBUILDS.inc()
+            telemetry.record_event(
+                "breaker.rebuild", f"rebuild #{self.counters.rebuilds}")
             return True
 
     def record_failure(self) -> str:
         """Returns the state after accounting the failure."""
+        _M_FAILURES.inc()
         with self._lock:
             self.counters.failures += 1
             self._consec_failures += 1
@@ -163,6 +200,7 @@ class CircuitBreaker:
             return self._state
 
     def record_success(self) -> str:
+        _M_SUCCESSES.inc()
         with self._lock:
             self.counters.successes += 1
             self._consec_failures = 0
@@ -171,12 +209,23 @@ class CircuitBreaker:
                 self.counters.closes += 1
                 self._backoff = self.backoff_initial
                 self._rebuild_pending = False
+                self._last_close_at = time.time()
+                _M_CLOSES.inc()
+                telemetry.record_event(
+                    "breaker.close",
+                    f"re-promoted after {self.counters.opens} opens")
             return self._state
 
     def _trip_locked(self) -> None:
         self._state = OPEN
         self.counters.opens += 1
         self._next_probe_at = self._clock() + self._jittered()
+        self._last_open_at = time.time()
+        _M_OPENS.inc()
+        telemetry.record_event(
+            "breaker.open",
+            f"after {self._consec_failures} consecutive failures, "
+            f"backoff {self._backoff:.1f}s")
 
     def _jittered(self) -> float:
         # Deterministic jitter (seeded RNG): spreads probe storms

@@ -24,6 +24,19 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from syzkaller_tpu import telemetry
+
+# Process-wide watchdog metrics (syzkaller_tpu/telemetry): folded into
+# the same registry as the breaker transitions so /metrics and
+# bench_watch's wedge diagnostics read one source of truth.
+_M_CALLS = telemetry.counter(
+    "tz_watchdog_calls_total", "device calls run under the watchdog")
+_M_WEDGES = telemetry.counter(
+    "tz_watchdog_wedges_total", "calls converted to DeviceWedged")
+_M_LAST_WEDGE = telemetry.gauge(
+    "tz_watchdog_last_wedge_ts",
+    "wallclock timestamp of the most recent wedge (0 = never)")
+
 
 class DeviceWedged(RuntimeError):
     """A guarded device call exceeded its watchdog deadline.  The
@@ -45,6 +58,7 @@ class WatchdogStats:
     abandoned_live: int = 0  # wedged threads that never finished
     last_duration_s: float = 0.0
     last_op: str = ""
+    last_wedge_at: float = 0.0  # wallclock; 0.0 = never wedged
 
 
 class Watchdog:
@@ -82,6 +96,7 @@ class Watchdog:
         DeviceWedged when the deadline passes first."""
         if deadline_s is None:
             deadline_s = self.deadline_s
+        _M_CALLS.inc()
         with self._lock:
             self.stats.calls += 1
             self.stats.last_op = op
@@ -110,10 +125,17 @@ class Watchdog:
                               name=f"watchdog-{op}")
         th.start()
         if not done.wait(timeout=deadline_s):
+            now = time.time()
             with self._lock:
                 self.stats.wedges += 1
+                self.stats.last_wedge_at = now
                 self._abandoned.append(th)
                 self.stats.abandoned_live = len(self._abandoned)
+            _M_WEDGES.inc()
+            _M_LAST_WEDGE.set(now)
+            telemetry.record_event(
+                "watchdog.wedge",
+                f"{op} exceeded {deadline_s:.1f}s deadline")
             raise DeviceWedged(op, deadline_s)
         self._note_done(self._clock() - t0)
         if "error" in box:
@@ -131,6 +153,7 @@ class Watchdog:
                 "calls": self.stats.calls,
                 "wedges": self.stats.wedges,
                 "abandoned_live": self.stats.abandoned_live,
+                "last_wedge_at": round(self.stats.last_wedge_at, 3),
                 "last_duration_s": round(self.stats.last_duration_s, 3),
                 "since_last_beat_s": round(
                     self._clock() - self._last_beat, 3),

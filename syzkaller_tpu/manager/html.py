@@ -17,8 +17,22 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from syzkaller_tpu import telemetry
+# Health is imported for its registration side effect: a manager-only
+# process (no device pipeline loaded) must still expose the breaker/
+# watchdog transition counters on /metrics, at zero.
+import syzkaller_tpu.health  # noqa: F401
+
 
 def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
+    # Pull-style gauges sampled at scrape time; re-registering rebinds
+    # the callback to THIS manager (telemetry.Registry.gauge).
+    telemetry.gauge("tz_manager_corpus_size",
+                    "corpus programs held by the manager",
+                    fn=lambda: len(mgr.serv.corpus))
+    telemetry.gauge("tz_manager_connected_fuzzers",
+                    "fuzzer processes that have connected",
+                    fn=lambda: len(mgr.serv.fuzzers))
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
@@ -40,6 +54,19 @@ def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
                 elif url.path == "/stats":
                     self._send(json.dumps(mgr.stats_snapshot()),
                                "application/json")
+                elif url.path == "/metrics":
+                    # Prometheus text exposition of the process-wide
+                    # telemetry registry (docs/observability.md).
+                    self._send(telemetry.render_prometheus(),
+                               "text/plain; version=0.0.4")
+                elif url.path == "/api/stats":
+                    # Machine-readable superset of /stats: the manager
+                    # rollup plus the full telemetry snapshot
+                    # (histogram percentiles, transition events).
+                    self._send(json.dumps({
+                        "manager": mgr.stats_snapshot(),
+                        "telemetry": telemetry.snapshot(),
+                    }), "application/json")
                 elif url.path == "/corpus":
                     self._send(_corpus_page(mgr, q.get("call", [""])[0]))
                 elif url.path == "/input":
@@ -84,7 +111,8 @@ def _page(title: str, body: str) -> str:
             f"<p><a href='/'>summary</a> | <a href='/corpus'>corpus</a> | "
             f"<a href='/syscalls'>syscalls</a> | <a href='/prio'>prio</a> | "
             f"<a href='/cover'>cover</a> | "
-            f"<a href='/stats'>stats.json</a></p>{body}</body></html>")
+            f"<a href='/stats'>stats.json</a> | "
+            f"<a href='/metrics'>metrics</a></p>{body}</body></html>")
 
 
 def _call_name(prog_line: str) -> str:

@@ -35,6 +35,7 @@ from typing import Optional
 
 import numpy as np
 
+from syzkaller_tpu import telemetry
 from syzkaller_tpu.health import (
     CircuitBreaker,
     FaultInjected,
@@ -80,6 +81,33 @@ P_DEVICE = P_INSERT + P_ARG_MUTATE + P_REMOVE
 P_HOST_STRUCTURAL = P_SQUASH + P_SPLICE
 # Conditional insert share among device classes.
 P_INSERT_GIVEN_DEVICE = P_INSERT / P_DEVICE
+
+# Hot-loop telemetry (docs/observability.md): process-wide, shared by
+# every pipeline instance.  Phase latencies come from span() contexts
+# at the call sites (pipeline.flush/compile/launch/drain/assemble);
+# these are the companion counts and queue/batch shape gauges.
+_M_BATCHES = telemetry.counter(
+    "tz_pipeline_batches_total", "mutant batches drained")
+_M_MUTANTS = telemetry.counter(
+    "tz_pipeline_mutants_total", "exec-ready mutants produced")
+_M_OVERFLOWS = telemetry.counter(
+    "tz_pipeline_overflows_total", "delta rows over the K/D/P budget")
+_M_ASSEMBLE_ERRORS = telemetry.counter(
+    "tz_pipeline_assemble_errors_total", "mutants dropped at assembly")
+_M_WORKER_ERRORS = telemetry.counter(
+    "tz_pipeline_worker_errors_total", "device failures in the worker")
+_M_DELIVERY_ERRORS = telemetry.counter(
+    "tz_pipeline_delivery_errors_total", "batches dropped at queue.put")
+_M_BACKOFF_WAITS = telemetry.counter(
+    "tz_pipeline_backoff_waits_total",
+    "worker waits behind an open breaker")
+_M_BACKOFF_SECONDS = telemetry.counter(
+    "tz_pipeline_backoff_wait_seconds_total",
+    "seconds the worker spent waiting behind an open breaker")
+_M_QUEUE_DEPTH = telemetry.gauge(
+    "tz_pipeline_queue_depth", "assembled batches waiting for procs")
+_M_BATCH_SIZE = telemetry.gauge(
+    "tz_pipeline_batch_size", "mutants per device batch")
 
 
 class ExecMutant:
@@ -220,6 +248,7 @@ class DevicePipeline:
         self.capacity = capacity
         self.batch_size = batch_size
         self.stats = PipelineStats()
+        _M_BATCH_SIZE.set(batch_size)
 
         self._lock = threading.Lock()
         self.templates: list[Optional[ProgTensor]] = [None] * capacity
@@ -471,7 +500,8 @@ class DevicePipeline:
     # -- the device loop ---------------------------------------------------
 
     def _launch(self):
-        corpus, n, tmpl, ets = self._flush_pending()
+        with telemetry.span("pipeline.flush"):
+            corpus, n, tmpl, ets = self._flush_pending()
         if corpus is None:
             return None
         self._key, sub = self._random.split(self._key)
@@ -489,7 +519,18 @@ class DevicePipeline:
             fault_point(op)
             return self._step(corpus, n, sub, fv, fc)
 
-        rows_dev = self.watchdog.call(dispatch, op, deadline_s=deadline)
+        # Spans time the host-observed dispatch (XLA returns async:
+        # steady-state launch is enqueue cost; the blocking transfer
+        # is timed separately by pipeline.drain).  Literal span names
+        # at each site keep tools/lint_metrics.py's grep exact.
+        if self._compiled:
+            with telemetry.span("pipeline.launch"):
+                rows_dev = self.watchdog.call(dispatch, op,
+                                              deadline_s=deadline)
+        else:
+            with telemetry.span("pipeline.compile"):
+                rows_dev = self.watchdog.call(dispatch, op,
+                                              deadline_s=deadline)
         self._compiled = True
         # Start the device->host copy now: the tunneled link has a
         # ~70 ms per-sync fixed cost that fully hides behind the next
@@ -501,17 +542,28 @@ class DevicePipeline:
         return rows_dev, tmpl, ets
 
     def _drain(self, launched) -> list[ExecMutant]:
-        from syzkaller_tpu.ops.delta import OP_INSERT
-        from syzkaller_tpu.ops.emit import splice_insert
-
         rows_dev, tmpl, ets = launched
         # The one device->host transfer — the blocking sync where a
         # wedged tunnel stalls, so it runs under the watchdog too.
-        buf = self.watchdog.call(lambda: np.asarray(rows_dev),
-                                 "device.drain")
+        with telemetry.span("pipeline.drain"):
+            buf = self.watchdog.call(lambda: np.asarray(rows_dev),
+                                     "device.drain")
+        # Host assembly + triage-merge bookkeeping, timed separately
+        # from the transfer so a slow link and a slow assembler are
+        # distinguishable in the phase percentiles.
+        with telemetry.span("pipeline.assemble"):
+            return self._assemble(buf, tmpl, ets)
+
+    def _assemble(self, buf, tmpl, ets) -> list[ExecMutant]:
+        from syzkaller_tpu.ops.delta import OP_INSERT
+        from syzkaller_tpu.ops.emit import splice_insert
+
         batch = DeltaBatch(buf, self.spec, self.batch_size)
         ok = (batch.flags & FLAG_OVERFLOW) == 0
-        self.stats.overflows += int(np.count_nonzero(~ok))
+        overflows = int(np.count_nonzero(~ok))
+        self.stats.overflows += overflows
+        if overflows:
+            _M_OVERFLOWS.inc(overflows)
         ok &= (batch.template_idx >= 0) & (batch.template_idx < len(tmpl))
         is_ins = batch.op == OP_INSERT
         js = np.flatnonzero(ok & ~is_ins)
@@ -520,6 +572,7 @@ class DevicePipeline:
         for j, data in zip(js, datas):
             if data is None:
                 self.stats.assemble_errors += 1
+                _M_ASSEMBLE_ERRORS.inc()
                 continue
             i = int(batch.template_idx[j])
             t = tmpl[i]
@@ -541,12 +594,15 @@ class DevicePipeline:
             data = splice_insert(et, alive, block, int(batch.pos[j]))
             if data is None:
                 self.stats.assemble_errors += 1
+                _M_ASSEMBLE_ERRORS.inc()
                 continue
             out.append(ExecMutant(data, t, et, batch, int(j),
                                   donor=block, donor_pos=int(batch.pos[j])))
             self.stats.inserts += 1
         self.stats.batches += 1
         self.stats.mutants += len(out)
+        _M_BATCHES.inc()
+        _M_MUTANTS.inc(len(out))
         return out
 
     def _reset_device_state(self) -> None:
@@ -588,6 +644,8 @@ class DevicePipeline:
             if not self.breaker.allow():
                 wait = min(0.2, max(0.02,
                                     self.breaker.seconds_until_probe()))
+                _M_BACKOFF_WAITS.inc()
+                _M_BACKOFF_SECONDS.inc(wait)
                 if self._stop.wait(timeout=wait):
                     return
                 continue
@@ -621,6 +679,7 @@ class DevicePipeline:
             except Exception as e:
                 pending.clear()
                 self.stats.worker_errors += 1
+                _M_WORKER_ERRORS.inc()
                 state = self.breaker.record_failure()
                 log.logf(0, "device pipeline worker error (#%d, "
                             "breaker %s, next probe in %.1fs): %s",
@@ -638,12 +697,14 @@ class DevicePipeline:
                 fault_point("queue.put")
             except FaultInjected as e:
                 self.stats.delivery_errors += 1
+                _M_DELIVERY_ERRORS.inc()
                 log.logf(0, "device pipeline: batch dropped at "
                             "delivery seam: %s", e)
                 continue
             while not self._stop.is_set():
                 try:
                     self._queue.put(batch, timeout=0.2)
+                    _M_QUEUE_DEPTH.set(self._queue.qsize())
                     break
                 except queue.Full:
                     continue
@@ -685,7 +746,9 @@ class DevicePipeline:
                 if wait <= 0:
                     raise queue.Empty
             try:
-                return self._queue.get(timeout=wait)
+                batch = self._queue.get(timeout=wait)
+                _M_QUEUE_DEPTH.set(self._queue.qsize())
+                return batch
             except queue.Empty:
                 continue
 

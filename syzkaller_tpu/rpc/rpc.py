@@ -21,12 +21,25 @@ import threading
 import zlib
 from typing import Any, Callable, Optional
 
+from syzkaller_tpu import telemetry
 from syzkaller_tpu.health.faultinject import fault_point
 
 _FRAME = struct.Struct("<IB")  # payload length, flags
 _FLAG_ZLIB = 1
 _COMPRESS_MIN = 4 << 10
 _MAX_FRAME = 512 << 20
+
+# Transport telemetry (docs/observability.md): frame/byte counts plus
+# span-timed frame latencies (rpc.send / rpc.recv) — recv latency is
+# the poll-loop wait, so its percentiles expose a slow or silent peer.
+_M_FRAMES_SENT = telemetry.counter(
+    "tz_rpc_frames_sent_total", "RPC frames sent")
+_M_FRAMES_RECV = telemetry.counter(
+    "tz_rpc_frames_recv_total", "RPC frames received")
+_M_BYTES_SENT = telemetry.counter(
+    "tz_rpc_bytes_sent_total", "RPC wire bytes sent (incl. headers)")
+_M_BYTES_RECV = telemetry.counter(
+    "tz_rpc_bytes_recv_total", "RPC wire bytes received (incl. headers)")
 
 
 class RPCError(Exception):
@@ -39,12 +52,15 @@ def _send_frame(sock: socket.socket, obj: Any) -> None:
     # the server's connection-drop path exactly as a real peer death
     # would (health/faultinject.py).
     fault_point("rpc.send_frame")
-    data = json.dumps(obj, separators=(",", ":")).encode()
-    flags = 0
-    if len(data) >= _COMPRESS_MIN:
-        data = zlib.compress(data, 1)
-        flags |= _FLAG_ZLIB
-    sock.sendall(_FRAME.pack(len(data), flags) + data)
+    with telemetry.span("rpc.send"):
+        data = json.dumps(obj, separators=(",", ":")).encode()
+        flags = 0
+        if len(data) >= _COMPRESS_MIN:
+            data = zlib.compress(data, 1)
+            flags |= _FLAG_ZLIB
+        sock.sendall(_FRAME.pack(len(data), flags) + data)
+    _M_FRAMES_SENT.inc()
+    _M_BYTES_SENT.inc(_FRAME.size + len(data))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -59,13 +75,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_frame(sock: socket.socket) -> Any:
     fault_point("rpc.recv_frame")
-    hdr = _recv_exact(sock, _FRAME.size)
-    length, flags = _FRAME.unpack(hdr)
-    if length > _MAX_FRAME:
-        raise RPCError(f"oversized frame ({length} bytes)")
-    data = _recv_exact(sock, length)
-    if flags & _FLAG_ZLIB:
-        data = zlib.decompress(data)
+    with telemetry.span("rpc.recv"):
+        hdr = _recv_exact(sock, _FRAME.size)
+        length, flags = _FRAME.unpack(hdr)
+        if length > _MAX_FRAME:
+            raise RPCError(f"oversized frame ({length} bytes)")
+        data = _recv_exact(sock, length)
+        if flags & _FLAG_ZLIB:
+            data = zlib.decompress(data)
+    _M_FRAMES_RECV.inc()
+    _M_BYTES_RECV.inc(_FRAME.size + length)
     return json.loads(data)
 
 

@@ -1,0 +1,139 @@
+"""Unified telemetry layer: metrics registry, spans, trace export.
+
+The observability spine of the engine (ISSUE 2).  Three consumers,
+one source of truth:
+
+  - the manager HTTP server renders the process registry as
+    Prometheus text (/metrics) and JSON (/api/stats),
+  - tools/bench_watch consumes snapshot() dumps for per-phase latency
+    percentiles and breaker-transition timelines in its wedge
+    diagnostics,
+  - TZ_TRACE_FILE streams every span as a Chrome trace event so a
+    wedge window opens in Perfetto (telemetry/trace.py).
+
+Usage: metrics register once at import/construction time and are
+cheap to update from any thread; spans wrap host-side hot-loop phases
+(NEVER jitted code — timing is host perf_counter only):
+
+    _M_BATCHES = telemetry.counter("tz_pipeline_batches_total", "...")
+    with telemetry.span("pipeline.drain"):
+        buf = np.asarray(rows_dev)
+
+A span named "pipeline.drain" records into the histogram
+`tz_pipeline_drain_seconds`; docs/observability.md catalogues every
+name, and tools/lint_metrics.py keeps code and catalogue in sync.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from syzkaller_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+from syzkaller_tpu.telemetry.trace import ENV_VAR, TraceWriter
+
+#: The process-wide registry.  Tests needing isolation construct their
+#: own Registry; everything in-tree registers here.
+REGISTRY = Registry()
+
+#: The process-wide trace writer, armed by TZ_TRACE_FILE.
+TRACE = TraceWriter(os.environ.get(ENV_VAR) or None)
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "", fn=None) -> Gauge:
+    return REGISTRY.gauge(name, help, fn)
+
+
+def histogram(name: str, help: str = "", bounds=None) -> Histogram:
+    return REGISTRY.histogram(name, help, bounds)
+
+
+def record_event(name: str, detail: str = "") -> None:
+    """Transition timeline entry + trace instant event (breaker
+    trips, wedges, demotions)."""
+    REGISTRY.record_event(name, detail)
+    TRACE.instant(name, {"detail": detail} if detail else None)
+
+
+def span_metric_name(span_name: str) -> str:
+    """Canonical histogram name for a span: 'pipeline.drain' times
+    into `tz_pipeline_drain_seconds`."""
+    return "tz_" + span_name.replace(".", "_") + "_seconds"
+
+
+class span:
+    """Timing context for one host-side hot-loop phase.  Records the
+    duration into the span's latency histogram and, when tracing is
+    armed, emits a complete trace event."""
+
+    __slots__ = ("name", "_hist", "_t0")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._hist = REGISTRY.histogram(span_metric_name(name))
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        self._hist.observe(dur)
+        if TRACE.enabled():
+            TRACE.emit(self.name, self._t0, dur)
+        return False
+
+
+def snapshot() -> dict:
+    return REGISTRY.snapshot()
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()
+
+
+def dump_snapshot(path: str) -> None:
+    REGISTRY.dump_snapshot(path)
+
+
+def set_trace_file(path: Optional[str]) -> None:
+    TRACE.set_path(path)
+
+
+def reset() -> None:
+    """Zero every registered metric in place (tests)."""
+    REGISTRY.reset_values()
+
+
+__all__ = [
+    "Counter",
+    "DEFAULT_LATENCY_BUCKETS",
+    "Gauge",
+    "Histogram",
+    "REGISTRY",
+    "Registry",
+    "TRACE",
+    "TraceWriter",
+    "counter",
+    "dump_snapshot",
+    "gauge",
+    "histogram",
+    "record_event",
+    "render_prometheus",
+    "reset",
+    "set_trace_file",
+    "snapshot",
+    "span",
+    "span_metric_name",
+]

@@ -1,0 +1,320 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+One registry is the source of truth for every runtime counter the
+engine exposes — the pipeline hot-loop phases, the fuzzer Stat
+counters, the RPC transport, and the health breaker/watchdog
+transitions all register here, and the manager HTTP server renders
+the same objects as Prometheus text (/metrics) and JSON (/api/stats).
+
+Design constraints (ISSUE 2):
+  - host-side only: nothing here may run inside jitted code, and all
+    timing uses time.perf_counter on the host (no wallclock in
+    kernels).  Wallclock (time.time) appears only in event timestamps
+    and last-transition gauges, which exist for operator timelines.
+  - cheap under contention: each metric has its own small lock;
+    the registry lock guards only name->metric resolution, which
+    callers do once at import/construction time.
+  - histograms use FIXED log-spaced latency buckets (quarter-decade
+    from 100 µs to 1000 s) so percentile estimates are comparable
+    across processes and runs without coordination.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+#: Quarter-decade log-spaced bounds from 1e-4 s (100 µs) to 1e3 s.
+#: Fixed (not configurable per call site) so every span histogram in
+#: every process buckets identically — snapshots merge and compare.
+DEFAULT_LATENCY_BUCKETS = tuple(10.0 ** (e / 4.0) for e in range(-16, 13))
+
+#: Bounded transition-event timeline (breaker trips, wedges, demotions)
+#: kept alongside the numeric metrics so a wedge window has a story,
+#: not just counts.
+EVENT_RING_SIZE = 256
+
+
+class Counter:
+    """Monotonic counter (float-valued: backoff-seconds accumulate
+    here too)."""
+
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, v: float = 1) -> None:
+        with self._lock:
+            self._value += v
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class Gauge:
+    """Point-in-time value.  Either set() push-style, or pull-style
+    via `fn` (sampled at snapshot/render time — used for corpus size
+    and queue depth owned by other objects)."""
+
+    __slots__ = ("name", "help", "_lock", "_value", "fn")
+
+    def __init__(self, name: str, help: str = "",
+                 fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self.fn = fn
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    @property
+    def value(self) -> float:
+        if self.fn is not None:
+            try:
+                return float(self.fn())
+            except Exception:
+                return 0.0
+        with self._lock:
+            return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class Histogram:
+    """Fixed-bound histogram with percentile estimation.
+
+    Buckets are cumulative at render time (Prometheus `le` semantics);
+    internally per-bucket counts.  percentile() linearly interpolates
+    within the owning bucket and clamps to the observed min/max, so
+    estimates never leave the data range."""
+
+    __slots__ = ("name", "help", "bounds", "_lock", "_counts", "_sum",
+                 "_count", "_min", "_max")
+
+    def __init__(self, name: str, help: str = "",
+                 bounds: Optional[tuple] = None):
+        self.name = name
+        self.help = help
+        self.bounds = tuple(bounds) if bounds else DEFAULT_LATENCY_BUCKETS
+        self._lock = threading.Lock()
+        # one overflow bucket past the last bound (= +Inf)
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._min = float("inf")
+        self._max = float("-inf")
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (q in [0, 1]) from bucket counts."""
+        with self._lock:
+            return self._percentile_locked(q)
+
+    def _percentile_locked(self, q: float) -> float:
+        if self._count == 0:
+            return 0.0
+        rank = q * self._count
+        cum = 0
+        for i, c in enumerate(self._counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else self._max
+                frac = (rank - cum) / c
+                est = lo + (hi - lo) * frac
+                return min(max(est, self._min), self._max)
+            cum += c
+        return self._max
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            cum, buckets = 0, []
+            for i, b in enumerate(self.bounds):
+                cum += self._counts[i]
+                buckets.append([b, cum])
+            buckets.append(["+Inf", cum + self._counts[-1]])
+            return {
+                "count": self._count,
+                "sum": round(self._sum, 6),
+                "min": round(self._min, 6) if self._count else 0.0,
+                "max": round(self._max, 6) if self._count else 0.0,
+                "p50": round(self._percentile_locked(0.50), 6),
+                "p90": round(self._percentile_locked(0.90), 6),
+                "p99": round(self._percentile_locked(0.99), 6),
+                "buckets": buckets,
+            }
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._sum = 0.0
+            self._count = 0
+            self._min = float("inf")
+            self._max = float("-inf")
+
+
+def _fmt(v: float) -> str:
+    """Render integral floats as ints (counter values are usually
+    counts; backoff-seconds and gauges keep their fraction)."""
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+class Registry:
+    """Name -> metric map with get-or-create registration.
+
+    Registration is idempotent (same name + same kind returns the
+    existing object, so module-level registration in N instances of a
+    class shares one metric) and kind-checked (same name + different
+    kind raises — that is always a bug)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+        self._events: deque = deque(maxlen=EVENT_RING_SIZE)
+
+    def _get_or_create(self, name: str, kind, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, kind):
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{type(m).__name__}, not {kind.__name__}")
+                return m
+            m = factory()
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter,
+                                   lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "",
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        g = self._get_or_create(name, Gauge, lambda: Gauge(name, help, fn))
+        if fn is not None:
+            # Re-registering with a callback rebinds it: a fresh
+            # manager in the same process must sample ITS corpus, not
+            # a closed predecessor's.
+            g.fn = fn
+        return g
+
+    def histogram(self, name: str, help: str = "",
+                  bounds: Optional[tuple] = None) -> Histogram:
+        return self._get_or_create(name, Histogram,
+                                   lambda: Histogram(name, help, bounds))
+
+    # -- events ------------------------------------------------------------
+
+    def record_event(self, name: str, detail: str = "") -> None:
+        """Append to the bounded transition timeline (wallclock ts —
+        operators correlate these against logs and bench journals)."""
+        with self._lock:
+            self._events.append((time.time(), name, detail))
+
+    def events(self) -> list[tuple[float, str, str]]:
+        with self._lock:
+            return list(self._events)
+
+    # -- exposition --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """One JSON-ready dict of everything: the API bench_watch and
+        /api/stats consume."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+            events = list(self._events)
+        out = {"ts": time.time(), "counters": {}, "gauges": {},
+               "histograms": {},
+               "events": [[round(ts, 3), n, d] for ts, n, d in events]}
+        for m in metrics:
+            if isinstance(m, Counter):
+                out["counters"][m.name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][m.name] = m.value
+            elif isinstance(m, Histogram):
+                out["histograms"][m.name] = m.snapshot()
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format (the /metrics body)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines = []
+        for m in metrics:
+            name = m.name.replace(".", "_")
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {_fmt(m.value)}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {_fmt(m.value)}")
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {name} histogram")
+                snap = m.snapshot()
+                for le, cum in snap["buckets"]:
+                    label = le if le == "+Inf" else format(le, ".6g")
+                    lines.append(
+                        f'{name}_bucket{{le="{label}"}} {cum}')
+                lines.append(f"{name}_sum {_fmt(snap['sum'])}")
+                lines.append(f"{name}_count {snap['count']}")
+        return "\n".join(lines) + "\n"
+
+    def dump_snapshot(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f)
+            f.write("\n")
+
+    def reset_values(self) -> None:
+        """Zero every metric IN PLACE and clear the event ring.  For
+        tests: module-level metric references stay valid (dropping the
+        objects would silently disconnect already-imported modules)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+            self._events.clear()
+        for m in metrics:
+            m._reset()

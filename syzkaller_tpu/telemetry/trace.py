@@ -1,0 +1,95 @@
+"""Chrome trace-event exporter: spans as Perfetto-loadable JSONL.
+
+When TZ_TRACE_FILE names a path, every completed span() writes one
+complete event ("ph": "X") line, so a wedge window can be opened in
+Perfetto / chrome://tracing and read as a per-thread timeline — which
+phase stalled, for how long, and what the other threads were doing.
+
+File shape: the Chrome JSON array format with the closing "]" omitted
+(explicitly allowed by the trace-event spec so crashed processes
+still leave a loadable file — exactly our wedge use case).  Each
+event is one line; timestamps are microseconds on the process-local
+perf_counter timebase, with the wallclock origin recorded in the
+leading metadata event so timelines can be correlated against logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+ENV_VAR = "TZ_TRACE_FILE"
+
+
+class TraceWriter:
+    """Thread-safe append-only trace-event writer.  Cheap when
+    disabled: enabled() is one attribute load."""
+
+    def __init__(self, path=None):
+        self._lock = threading.Lock()
+        self._file = None
+        self._path = path
+        self._t0 = time.perf_counter()
+
+    def enabled(self) -> bool:
+        return self._path is not None
+
+    def set_path(self, path) -> None:
+        """Install (or clear, with None) the trace target; closes any
+        open file.  Tests and tools call this; production picks the
+        path up from TZ_TRACE_FILE at import."""
+        with self._lock:
+            self._close_locked()
+            self._path = path
+
+    def _open_locked(self):
+        if self._file is None and self._path is not None:
+            self._file = open(self._path, "w")
+            self._file.write("[\n")
+            meta = {"name": "process_start", "ph": "i", "ts": 0,
+                    "pid": os.getpid(), "tid": 0, "s": "g",
+                    "args": {"wallclock": time.time(),
+                             "perf_counter": time.perf_counter()}}
+            self._file.write(json.dumps(meta) + ",\n")
+        return self._file
+
+    def emit(self, name: str, t0: float, dur: float,
+             args=None) -> None:
+        """One complete event: t0 is the span's perf_counter start,
+        dur its duration in seconds."""
+        if self._path is None:
+            return
+        ev = {"name": name, "cat": "tz", "ph": "X",
+              "ts": round((t0 - self._t0) * 1e6, 1),
+              "dur": round(dur * 1e6, 1),
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        line = json.dumps(ev) + ",\n"
+        with self._lock:
+            f = self._open_locked()
+            if f is None:
+                return
+            try:
+                f.write(line)
+                f.flush()  # wedge forensics: events must hit disk
+            except OSError:
+                self._close_locked()
+
+    def instant(self, name: str, args=None) -> None:
+        """Instant event ('i') — breaker trips, wedges, demotions."""
+        self.emit(name, time.perf_counter(), 0.0, args)
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None

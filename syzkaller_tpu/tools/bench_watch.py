@@ -41,6 +41,12 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+#: Where each bench subprocess dumps its telemetry snapshot
+#: (bench.dump_telemetry, armed via TZ_TELEMETRY_SNAPSHOT below).
+#: Re-dumped after every warmup batch, so even an attempt killed by
+#: the outer timeout leaves per-phase evidence for diagnose_wedge.
+TELEMETRY_SNAP = os.path.join(REPO, "TELEMETRY_SNAPSHOT.json")
+
 
 #: Append-per-write log target (opened fresh each call): shell
 #: redirection pins an inode, and anything that swaps the file on
@@ -84,6 +90,70 @@ def _thread_table(pid: int) -> list[str]:
     except OSError:
         pass
     return rows
+
+
+def _ms(v: float) -> str:
+    return f"{v * 1e3:.1f}ms" if v < 10.0 else f"{v:.1f}s"
+
+
+def wedge_report(snap: dict) -> list[str]:
+    """Render a telemetry snapshot (telemetry.snapshot() shape) into
+    wedge-diagnostic lines: per-phase latency percentiles, breaker
+    transition counts + timestamps, the last-wedge age, and the
+    transition event timeline.  Pure function — pinned by tests with
+    no live TPU (docs/observability.md 'reading a wedge')."""
+    lines: list[str] = []
+    for name in sorted(snap.get("histograms") or {}):
+        h = snap["histograms"][name]
+        if not name.endswith("_seconds") or not h.get("count"):
+            continue
+        lines.append(
+            f"phase {name}: n={h['count']} p50={_ms(h['p50'])} "
+            f"p90={_ms(h['p90'])} p99={_ms(h['p99'])} "
+            f"max={_ms(h['max'])}")
+    counters = snap.get("counters") or {}
+    trans = {k: v for k, v in sorted(counters.items())
+             if k.startswith("tz_breaker_") and v}
+    if trans:
+        lines.append("breaker transitions: " + " ".join(
+            f"{k[len('tz_breaker_'):-len('_total')]}={int(v)}"
+            for k, v in trans.items()))
+    gauges = snap.get("gauges") or {}
+    last_wedge = gauges.get("tz_watchdog_last_wedge_ts") or 0
+    if last_wedge:
+        age = max(0.0, (snap.get("ts") or time.time()) - last_wedge)
+        lines.append(
+            f"last wedge: "
+            f"{time.strftime('%H:%M:%S', time.localtime(last_wedge))} "
+            f"({age:.0f}s before snapshot)")
+    events = snap.get("events") or []
+    for ts, name, detail in events[-12:]:
+        stamp = time.strftime("%H:%M:%S", time.localtime(ts))
+        lines.append(f"  {stamp} {name}"
+                     + (f" ({detail})" if detail else ""))
+    if not lines:
+        lines.append("telemetry snapshot carried no phase latencies "
+                     "or health transitions")
+    return lines
+
+
+def report_telemetry(path: str | None = None) -> None:
+    """Log the last bench attempt's telemetry snapshot, if any — the
+    per-phase view of WHERE the pipeline spent its time before the
+    wedge (closes the ROADMAP item: breaker transition counters wired
+    into bench_watch's wedge diagnostics)."""
+    path = path or TELEMETRY_SNAP
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except (OSError, ValueError):
+        log(f"diagnose: no telemetry snapshot at {path} "
+            "(bench never reached its first warmup batch)")
+        return
+    log("diagnose: telemetry from the last bench attempt "
+        f"(snapshot ts {snap.get('ts', 0):.0f}):")
+    for line in wedge_report(snap):
+        log(f"  {line}")
 
 
 def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
@@ -163,6 +233,10 @@ def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
                 log(f"diagnose: listener: {ln.strip()}")
     except (OSError, subprocess.TimeoutExpired):
         pass
+    # Layer 5: what the engine itself measured before it stalled —
+    # per-phase latency percentiles + breaker/wedge timeline from the
+    # last attempt's telemetry snapshot.
+    report_telemetry()
 
 
 def flagship_entries() -> int:
@@ -214,7 +288,8 @@ def run_bench(args: list[str], timeout_s: float) -> dict | None:
     # artifact exactly this way).
     post_warmup = 900 if "--ab" in args else 300
     warmup = max(60, int(timeout_s - post_warmup))
-    env = dict(os.environ, TZ_BENCH_WARMUP_TIMEOUT_S=str(warmup))
+    env = dict(os.environ, TZ_BENCH_WARMUP_TIMEOUT_S=str(warmup),
+               TZ_TELEMETRY_SNAPSHOT=TELEMETRY_SNAP)
     try:
         res = subprocess.run([sys.executable, "bench.py",
                               "--no-preflight"] + args,

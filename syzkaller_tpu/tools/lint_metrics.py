@@ -1,0 +1,153 @@
+"""tz-lint-metrics: keep metric names, code, and docs in sync.
+
+The telemetry layer's contract is that every metric name is (a)
+registered exactly once through the telemetry registry API, and (b)
+catalogued in docs/observability.md.  Drift in either direction rots
+the observability spine silently — a typo'd name literal creates a
+parallel metric nobody scrapes, and a stale catalogue sends operators
+hunting for series that no longer exist.  This linter greps the source
+tree (no imports, so it runs in milliseconds inside the tier-1 suite —
+tests/test_tools.py invokes it):
+
+  1. registration scan: every `counter("...")` / `gauge("...")` /
+     `histogram("...")` literal and every `span("...")` literal (spans
+     register `tz_<name>_seconds`), plus the fuzzer Stat counters
+     derived from the STAT_NAMES table the same way fuzzer.py derives
+     them at import,
+  2. literal check: any metric-shaped string literal (`tz_*_total`,
+     `tz_*_seconds`, ...) anywhere in the source must be a registered
+     name — catches typos and copy-paste drift at use sites,
+  3. catalogue check: the set of registered names and the set of
+     backticked `tz_*` names in docs/observability.md must be equal.
+
+Usage: python -m syzkaller_tpu.tools.lint_metrics [repo_root]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+#: Shapes a metric name can take; a literal matching this anywhere in
+#: the tree must be registered.  Prefix-only literals ("tz_breaker_")
+#: used for startswith() filtering intentionally do not match.
+METRIC_SHAPE = re.compile(
+    r"^tz_[a-z0-9_]+_(?:total|seconds|bytes|depth|size|ts)$")
+
+_REG_RE = re.compile(
+    r"""(?:counter|gauge|histogram)\(\s*['"]([a-z0-9_.]+)['"]""")
+_SPAN_RE = re.compile(r"""span\(\s*['"]([a-z0-9_.]+)['"]""")
+_LIT_RE = re.compile(r"""['"](tz_[a-z0-9_]+)['"]""")
+_STAT_NAME_RE = re.compile(r'Stat\.[A-Z_0-9]+:\s*"([a-z ]+)"')
+_DOC_NAME_RE = re.compile(r"`(tz_[a-z0-9_]+)`")
+
+
+def _span_metric_name(span_name: str) -> str:
+    # Mirrors telemetry.span_metric_name without importing it: the
+    # linter must stay import-free so it lints a broken tree too.
+    return "tz_" + span_name.replace(".", "_") + "_seconds"
+
+
+def _source_files(root: str) -> list[str]:
+    out = []
+    pkg = os.path.join(root, "syzkaller_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        for f in files:
+            if f.endswith(".py"):
+                out.append(os.path.join(dirpath, f))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        out.append(bench)
+    return sorted(out)
+
+
+def scan_sources(root: str):
+    """(registered names, metric-shaped literals as (file, line, name))
+    over syzkaller_tpu/ + bench.py."""
+    self_path = os.path.abspath(__file__)
+    registered: set[str] = set()
+    literals: list[tuple[str, int, str]] = []
+    for path in _source_files(root):
+        if os.path.abspath(path) == self_path:
+            continue
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(path, root)
+        # Registration calls routinely wrap the name onto the next
+        # line, so these run over the whole file (\s spans newlines);
+        # the literal check stays per-line for usable line numbers.
+        for m in _REG_RE.finditer(src):
+            if m.group(1).startswith("tz_"):
+                registered.add(m.group(1))
+        for m in _SPAN_RE.finditer(src):
+            if "." in m.group(1):
+                registered.add(_span_metric_name(m.group(1)))
+        for lineno, line in enumerate(src.splitlines(), 1):
+            for m in _LIT_RE.finditer(line):
+                if METRIC_SHAPE.match(m.group(1)):
+                    literals.append((rel, lineno, m.group(1)))
+        if rel == os.path.join("syzkaller_tpu", "fuzzer", "fuzzer.py"):
+            # Stat counters are registered programmatically from
+            # STAT_NAMES; derive the same names the module does.
+            for m in _STAT_NAME_RE.finditer(src):
+                registered.add(
+                    "tz_fuzzer_" + m.group(1).replace(" ", "_")
+                    + "_total")
+    return registered, literals
+
+
+def doc_names(docs_path: str) -> set[str]:
+    try:
+        with open(docs_path) as f:
+            return set(_DOC_NAME_RE.findall(f.read()))
+    except OSError:
+        return set()
+
+
+def lint(root: str, docs_path: str | None = None) -> list[str]:
+    """All problems found, as printable strings (empty = clean)."""
+    if docs_path is None:
+        docs_path = os.path.join(root, "docs", "observability.md")
+    registered, literals = scan_sources(root)
+    problems = []
+    for rel, lineno, name in literals:
+        if name not in registered:
+            problems.append(
+                f"{rel}:{lineno}: metric-shaped literal {name!r} is "
+                "never registered through the telemetry API")
+    documented = doc_names(docs_path)
+    if not documented:
+        problems.append(f"{docs_path}: missing or has no `tz_*` "
+                        "catalogue entries")
+    for name in sorted(registered - documented):
+        problems.append(
+            f"{name}: registered in code but missing from the "
+            f"catalogue in {os.path.basename(docs_path)}")
+    for name in sorted(n for n in documented - registered
+                       if METRIC_SHAPE.match(n)):
+        problems.append(
+            f"{name}: catalogued in {os.path.basename(docs_path)} but "
+            "not registered anywhere in the source tree")
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    problems = lint(root)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"lint_metrics: {len(problems)} problem(s)")
+        return 1
+    print("lint_metrics: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,3 +87,72 @@ def test_bench_journal_last_healthy_filter(tmp_path, monkeypatch):
                             "provenance": "weak"}) + "\n")
     got = bench.journal_last_healthy()
     assert got["value"] == 20947 and got.get("reconstructed")
+
+
+# -- telemetry-driven wedge diagnostics (ISSUE 2) -----------------------
+
+
+def _wedge_snapshot():
+    """A real registry snapshot shaped like a wedged bench attempt:
+    healthy launches, a drain percentile walking toward the deadline,
+    breaker transitions with a timeline, and a recorded wedge."""
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    launch = reg.histogram("tz_pipeline_launch_seconds")
+    drain = reg.histogram("tz_pipeline_drain_seconds")
+    for _ in range(50):
+        launch.observe(0.002)
+        drain.observe(0.07)
+    for _ in range(5):
+        drain.observe(90.0)  # the stalls
+    reg.counter("tz_breaker_opens_total").inc(3)
+    reg.counter("tz_breaker_half_opens_total").inc(2)
+    reg.gauge("tz_watchdog_last_wedge_ts").set(1_700_000_000.0)
+    reg.record_event("breaker.open", "after 4 consecutive failures")
+    reg.record_event("watchdog.wedge", "device.drain exceeded 120.0s")
+    snap = reg.snapshot()
+    snap["ts"] = 1_700_000_123.0
+    return snap
+
+
+def test_wedge_report_phase_percentiles_and_timeline():
+    lines = bw.wedge_report(_wedge_snapshot())
+    text = "\n".join(lines)
+    # per-phase latency percentiles from telemetry.snapshot()
+    assert "phase tz_pipeline_drain_seconds: n=55" in text
+    assert "phase tz_pipeline_launch_seconds: n=50" in text
+    drain_line = next(ln for ln in lines
+                      if "tz_pipeline_drain_seconds" in ln)
+    assert "p50=" in drain_line and "p99=" in drain_line
+    # the p99 shows the stall (~90 s), not the healthy 70 ms
+    assert "s" in drain_line.split("p99=")[1].split()[0]
+    # breaker transition counters (the open ROADMAP item)
+    assert "breaker transitions:" in text
+    assert "opens=3" in text and "half_opens=2" in text
+    # last-wedge timestamp with age relative to the snapshot
+    assert "last wedge:" in text and "123s before snapshot" in text
+    # the transition event timeline
+    assert "breaker.open (after 4 consecutive failures)" in text
+    assert "watchdog.wedge" in text
+
+
+def test_wedge_report_empty_snapshot():
+    lines = bw.wedge_report({"ts": 0, "counters": {}, "gauges": {},
+                             "histograms": {}, "events": []})
+    assert lines == ["telemetry snapshot carried no phase latencies "
+                     "or health transitions"]
+
+
+def test_report_telemetry_reads_dump(tmp_path, monkeypatch, capsys):
+    """End-to-end: a telemetry dump on disk (what bench.dump_telemetry
+    leaves behind) renders into diagnose_wedge's log output."""
+    path = tmp_path / "TELEMETRY_SNAPSHOT.json"
+    with open(path, "w") as f:
+        json.dump(_wedge_snapshot(), f)
+    bw.report_telemetry(str(path))
+    out = capsys.readouterr().out
+    assert "breaker transitions:" in out and "opens=3" in out
+    # a missing snapshot degrades to a note, never a crash
+    bw.report_telemetry(str(tmp_path / "absent.json"))
+    assert "no telemetry snapshot" in capsys.readouterr().out

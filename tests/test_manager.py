@@ -256,6 +256,23 @@ def test_http_ui_endpoints(tmp_path, test_target):
             assert "Crashes" in summary and "use-after-free" in summary
             stats = json_mod.loads(get("/stats"))
             assert stats["corpus"] == 1
+            # /metrics: Prometheus exposition of the whole telemetry
+            # registry, health breaker transitions included (ISSUE 2)
+            metrics = get("/metrics")
+            assert "# TYPE tz_breaker_opens_total counter" in metrics
+            assert "tz_watchdog_wedges_total" in metrics
+            assert "tz_manager_corpus_size 1" in metrics
+            # every metric registered in this process is exposed:
+            # importing the fuzzer module registers its Stat mirrors
+            import syzkaller_tpu.fuzzer.fuzzer  # noqa: F401
+
+            metrics = get("/metrics")
+            assert "tz_fuzzer_exec_total_total" in metrics
+            # /api/stats: manager rollup + full telemetry snapshot
+            api = json_mod.loads(get("/api/stats"))
+            assert api["manager"]["corpus"] == 1
+            assert "tz_breaker_opens_total" in api["telemetry"]["counters"]
+            assert api["telemetry"]["gauges"]["tz_manager_corpus_size"] == 1
             corpus = get("/corpus")
             assert "/input?sig=" in corpus
             sig = corpus.split("/input?sig=")[1].split("'")[0]

@@ -1,0 +1,298 @@
+"""Unified telemetry layer (syzkaller_tpu/telemetry, ISSUE 2):
+registry semantics, histogram bucketing, span timing + trace export,
+Prometheus/JSON rendering, health-counter folding, the Stat drift
+guard, and the grab_stats snapshot-and-reset race regression.
+
+All CPU-only and stdlib-fast: no pipeline compiles, no device."""
+
+from __future__ import annotations
+
+import json
+import threading
+from enum import IntEnum
+
+import pytest
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    EVENT_RING_SIZE,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+
+# -- registry semantics -------------------------------------------------
+
+
+def test_registration_is_idempotent_and_kind_checked():
+    reg = Registry()
+    c1 = reg.counter("tz_x_total", "help text")
+    c2 = reg.counter("tz_x_total")
+    assert c1 is c2  # same object: module-level registration shares
+    with pytest.raises(TypeError):
+        reg.gauge("tz_x_total")  # same name, different kind
+
+
+def test_counter_and_gauge_values():
+    reg = Registry()
+    c = reg.counter("tz_c_total")
+    c.inc()
+    c.inc(2.5)  # float counters: backoff-seconds accumulate
+    assert c.value == 3.5
+    g = reg.gauge("tz_g_depth")
+    g.set(7)
+    assert g.value == 7
+    # pull-style gauge samples its callback at read time
+    box = {"v": 1}
+    gf = reg.gauge("tz_gf_size", fn=lambda: box["v"])
+    box["v"] = 42
+    assert gf.value == 42
+    # re-registering with a new callback rebinds (fresh manager case)
+    reg.gauge("tz_gf_size", fn=lambda: 9)
+    assert gf.value == 9
+    # a raising callback reads as 0, never propagates into a scrape
+    reg.gauge("tz_gf_size", fn=lambda: 1 / 0)
+    assert gf.value == 0
+
+
+# -- histogram bucketing ------------------------------------------------
+
+
+def test_histogram_fixed_log_buckets():
+    h = Histogram("tz_h_seconds")
+    assert h.bounds == DEFAULT_LATENCY_BUCKETS
+    assert h.bounds[0] == pytest.approx(1e-4)
+    assert h.bounds[-1] == pytest.approx(1e3)
+    for v in (0.0002, 0.0002, 0.05, 2.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(2.0504)
+    assert snap["min"] == pytest.approx(0.0002)
+    assert snap["max"] == pytest.approx(2.0)
+    # buckets are cumulative and end at +Inf
+    les, cums = zip(*snap["buckets"])
+    assert les[-1] == "+Inf" and cums[-1] == 4
+    assert all(a <= b for a, b in zip(cums, cums[1:]))
+    # the two 200 µs observations are fully counted by the 1 ms bound
+    cum_at_1ms = dict(snap["buckets"])[
+        min(b for b in h.bounds if b >= 1e-3)]
+    assert cum_at_1ms >= 2
+
+
+def test_histogram_percentiles_stay_in_data_range():
+    h = Histogram("tz_h2_seconds")
+    assert h.percentile(0.5) == 0.0  # empty
+    for _ in range(100):
+        h.observe(0.01)
+    for q in (0.5, 0.9, 0.99):
+        p = h.percentile(q)
+        assert 0.01 <= p <= max(b for b in h.bounds if b <= 0.011), p
+    h2 = Histogram("tz_h3_seconds")
+    h2.observe(5000.0)  # beyond the last bound: overflow bucket
+    assert h2.percentile(0.99) == pytest.approx(5000.0)
+
+
+def test_histogram_thread_safety_conserves_count():
+    h = Histogram("tz_h4_seconds")
+
+    def worker():
+        for _ in range(1000):
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 4000
+
+
+# -- spans + trace export -----------------------------------------------
+
+
+def test_span_records_into_named_histogram():
+    assert telemetry.span_metric_name("pipeline.drain") \
+        == "tz_pipeline_drain_seconds"
+    hist = telemetry.REGISTRY.histogram(
+        telemetry.span_metric_name("pipeline.drain"))
+    before = hist.count
+    with telemetry.span("pipeline.drain"):
+        pass
+    assert hist.count == before + 1
+
+
+def test_trace_file_shape(tmp_path):
+    path = tmp_path / "trace.json"
+    telemetry.set_trace_file(str(path))
+    try:
+        with telemetry.span("pipeline.drain"):
+            pass
+        telemetry.record_event("breaker.open", "test detail")
+    finally:
+        telemetry.set_trace_file(None)
+    text = path.read_text()
+    # Chrome JSON array format, closing "]" legally omitted
+    assert text.startswith("[\n")
+    events = [json.loads(ln.rstrip(",")) for ln in text.splitlines()[1:]]
+    names = [e["name"] for e in events]
+    assert "pipeline.drain" in names and "breaker.open" in names
+    span_ev = events[names.index("pipeline.drain")]
+    assert span_ev["ph"] == "X" and span_ev["cat"] == "tz"
+    assert span_ev["dur"] >= 0 and "tid" in span_ev and "pid" in span_ev
+    # the metadata header carries the wallclock origin for correlation
+    assert events[0]["name"] == "process_start"
+    assert "wallclock" in events[0]["args"]
+
+
+# -- rendering ----------------------------------------------------------
+
+
+def test_render_prometheus():
+    reg = Registry()
+    reg.counter("tz_c_total", "a counter").inc(3)
+    reg.gauge("tz_g_depth").set(1.5)
+    reg.histogram("tz_h_seconds").observe(0.01)
+    text = reg.render_prometheus()
+    assert "# HELP tz_c_total a counter" in text
+    assert "# TYPE tz_c_total counter" in text
+    assert "\ntz_c_total 3\n" in text
+    assert "tz_g_depth 1.5" in text
+    assert 'tz_h_seconds_bucket{le="+Inf"} 1' in text
+    assert "tz_h_seconds_count 1" in text
+    assert "tz_h_seconds_sum 0.01" in text
+
+
+def test_snapshot_roundtrips_through_json(tmp_path):
+    reg = Registry()
+    reg.counter("tz_c_total").inc()
+    reg.histogram("tz_h_seconds").observe(0.5)
+    reg.record_event("breaker.open", "detail")
+    path = tmp_path / "snap.json"
+    reg.dump_snapshot(str(path))
+    snap = json.loads(path.read_text())
+    assert snap["counters"]["tz_c_total"] == 1
+    assert snap["histograms"]["tz_h_seconds"]["count"] == 1
+    assert snap["events"][0][1] == "breaker.open"
+
+
+def test_event_ring_is_bounded():
+    reg = Registry()
+    for i in range(EVENT_RING_SIZE + 50):
+        reg.record_event("e", str(i))
+    events = reg.events()
+    assert len(events) == EVENT_RING_SIZE
+    assert events[-1][2] == str(EVENT_RING_SIZE + 49)  # newest kept
+
+
+# -- health counters folded into the registry ---------------------------
+
+
+def test_breaker_transitions_hit_registry_and_events():
+    from syzkaller_tpu.health import CircuitBreaker
+
+    snap0 = telemetry.snapshot()["counters"]
+    clock = [0.0]
+    br = CircuitBreaker(failure_threshold=2, backoff_initial=1.0,
+                        clock=lambda: clock[0])
+    br.record_failure()
+    br.record_failure()  # trips open
+    assert br.state == "open"
+    clock[0] = 10.0
+    assert br.allow()  # open -> half_open
+    assert br.consume_rebuild()
+    br.record_success()  # half_open -> closed
+    snap1 = telemetry.snapshot()["counters"]
+    for name in ("tz_breaker_opens_total", "tz_breaker_half_opens_total",
+                 "tz_breaker_rebuilds_total", "tz_breaker_closes_total"):
+        assert snap1[name] == snap0.get(name, 0) + 1, name
+    assert snap1["tz_breaker_failures_total"] \
+        == snap0.get("tz_breaker_failures_total", 0) + 2
+    recent = [n for _ts, n, _d in telemetry.REGISTRY.events()][-4:]
+    assert recent == ["breaker.open", "breaker.half_open",
+                      "breaker.rebuild", "breaker.close"]
+    # wallclock transition stamps for the wedge timeline
+    bsnap = br.snapshot()
+    assert bsnap["last_open_at"] > 0
+    assert bsnap["last_close_at"] >= bsnap["last_open_at"]
+
+
+def test_watchdog_wedge_sets_last_wedge_gauge():
+    from syzkaller_tpu.health import DeviceWedged, Watchdog
+
+    wd = Watchdog(deadline_s=0.05)
+    hang = threading.Event()
+    try:
+        with pytest.raises(DeviceWedged):
+            wd.call(hang.wait, "device.launch")
+    finally:
+        hang.set()  # release the abandoned thread
+    assert wd.stats.last_wedge_at > 0
+    assert wd.snapshot()["last_wedge_at"] == \
+        pytest.approx(wd.stats.last_wedge_at, abs=1e-3)
+    g = telemetry.REGISTRY.gauge("tz_watchdog_last_wedge_ts")
+    assert g.value == pytest.approx(wd.stats.last_wedge_at, abs=1e-3)
+
+
+# -- Stat drift guard ---------------------------------------------------
+
+
+def test_stat_names_drift_guard():
+    from syzkaller_tpu.fuzzer.fuzzer import (
+        STAT_NAMES,
+        Stat,
+        _check_stat_names,
+        _stat_metric_name,
+    )
+
+    _check_stat_names(Stat, STAT_NAMES)  # the real tables agree
+
+    class Drifted(IntEnum):
+        A = 0
+        B = 1
+
+    with pytest.raises(AssertionError, match="without a STAT_NAMES"):
+        _check_stat_names(Drifted, {Drifted.A: "a"})
+    with pytest.raises(AssertionError, match="without a Stat member"):
+        _check_stat_names(Drifted, {Drifted.A: "a", Drifted.B: "b",
+                                    "ghost": "g"})
+    # every Stat has a registered monotonic mirror in the registry
+    counters = telemetry.snapshot()["counters"]
+    for s in Stat:
+        assert _stat_metric_name(STAT_NAMES[s]) in counters
+
+
+# -- grab_stats vs concurrent inc() -------------------------------------
+
+
+def test_grab_stats_conserves_counts_under_concurrency():
+    """Regression (ISSUE 2 satellite): the poll drain must snapshot
+    AND reset under one lock acquisition — increments landing between
+    a read and a separate reset would be lost.  Hammer stat_add from
+    worker threads while draining and assert conservation."""
+    from syzkaller_tpu.fuzzer import Fuzzer, WorkQueue
+    from syzkaller_tpu.fuzzer.fuzzer import STAT_NAMES, Stat
+    from syzkaller_tpu.models.target import get_target
+
+    fz = Fuzzer(get_target("test", "64"), wq=WorkQueue())
+    per_thread, nthreads = 2000, 4
+
+    def worker():
+        for _ in range(per_thread):
+            fz.stat_add(Stat.FUZZ)
+
+    threads = [threading.Thread(target=worker) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    drained = 0
+    while any(t.is_alive() for t in threads):
+        drained += fz.grab_stats().get(STAT_NAMES[Stat.FUZZ], 0)
+    for t in threads:
+        t.join()
+    drained += fz.grab_stats().get(STAT_NAMES[Stat.FUZZ], 0)
+    assert drained == per_thread * nthreads
+    # and the registry mirror holds the same monotonic total
+    name = "tz_fuzzer_exec_fuzz_total"
+    assert telemetry.REGISTRY.counter(name).value >= drained

@@ -341,3 +341,55 @@ struct multi {
     # pointer arrays keep their dimension
     assert fmap["argv"] == "array[ptr64[inout, array[int8]], 4]"
     assert fmap["flags"] == "intptr"
+
+
+# -- metric-name linter (tools/lint_metrics, ISSUE 2) -------------------
+
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_lint_metrics_repo_is_clean(capsys):
+    """The tier-1 wrapper for the linter: the live tree's metric names
+    and the docs/observability.md catalogue must agree exactly."""
+    from syzkaller_tpu.tools.lint_metrics import lint, main
+
+    assert lint(REPO_ROOT) == []
+    assert main([REPO_ROOT]) == 0
+    assert "lint_metrics: ok" in capsys.readouterr().out
+
+
+def _lint_tree(tmp_path, source: str, docs: str):
+    from syzkaller_tpu.tools.lint_metrics import lint
+
+    pkg = tmp_path / "syzkaller_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(source)
+    docs_dir = tmp_path / "docs"
+    docs_dir.mkdir()
+    (docs_dir / "observability.md").write_text(docs)
+    return lint(str(tmp_path))
+
+
+def test_lint_metrics_flags_unregistered_literal(tmp_path):
+    problems = _lint_tree(
+        tmp_path,
+        'c = telemetry.counter("tz_good_total", "ok")\n'
+        'snap["tz_typo_total"] += 1\n',
+        "catalogue: `tz_good_total`\n")
+    assert any("tz_typo_total" in p and "never registered" in p
+               for p in problems)
+
+
+def test_lint_metrics_flags_docs_drift_both_ways(tmp_path):
+    problems = _lint_tree(
+        tmp_path,
+        'c = telemetry.counter(\n    "tz_undocumented_total")\n'
+        'with telemetry.span("phase.work"):\n    pass\n',
+        "catalogue: `tz_phase_work_seconds` and `tz_stale_total`\n")
+    # multi-line registration and span names are both recognized
+    assert any("tz_undocumented_total" in p and "missing from" in p
+               for p in problems)
+    assert any("tz_stale_total" in p and "not registered" in p
+               for p in problems)
+    assert not any("tz_phase_work_seconds" in p for p in problems)
