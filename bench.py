@@ -14,8 +14,13 @@ Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 Modes:
-  python bench.py            # flagship (pipeline + kernel + CPU baseline)
-  python bench.py --ab 20    # A/B: new edges on sim kernel, engine on/off
+  python bench.py                  # flagship (pipeline + kernel + CPU
+                                   # baseline + host-assembly sub-bench)
+  python bench.py --ab 20          # A/B: new edges on sim kernel,
+                                   # engine on/off
+  python bench.py --host-assembly  # drain->exec-ready stage only:
+                                   # pooled arena path vs single-thread
+                                   # per-mutant reference
 """
 
 from __future__ import annotations
@@ -126,8 +131,12 @@ PIPE_BATCH = 2048
 
 def bench_pipeline(batch_size=PIPE_BATCH, seconds=8.0,
                    capacity=PIPE_CAPACITY,
-                   seeds=64) -> float:
-    """End-to-end exec-ready mutants/sec off the DevicePipeline."""
+                   seeds=64, sub_out: Optional[dict] = None) -> float:
+    """End-to-end exec-ready mutants/sec off the DevicePipeline.
+
+    When `sub_out` is a dict, drops the run's transfer sub-metrics
+    into it (d2h_bytes_per_batch — the compacted device->host cost
+    the wedge diagnostics track)."""
     from syzkaller_tpu.models.target import get_target
     from syzkaller_tpu.ops.pipeline import DevicePipeline
 
@@ -169,10 +178,124 @@ def bench_pipeline(batch_size=PIPE_BATCH, seconds=8.0,
         while time.time() - t0 < seconds:
             n += len(pl.next_batch(timeout=600))
         dt = time.time() - t0
+        if sub_out is not None and pl.stats.d2h_batches:
+            sub_out["d2h_bytes_per_batch"] = round(
+                pl.stats.d2h_bytes / pl.stats.d2h_batches, 1)
     finally:
         pl.stop()
         dump_telemetry()
     return n / dt
+
+
+def bench_host_assembly(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
+                        seeds=64, repeats=6) -> dict:
+    """Host-assembly throughput on one drained batch, three numbers:
+
+      - host_assemble_mutants_per_sec: the vectorized one-pass stream
+        assemblers (emit.assemble_batch_table + splice_batch_table) —
+        delta rows -> exec wire streams, like-for-like with
+      - host_assemble_single_thread_mutants_per_sec: the per-mutant
+        reference (assemble_delta + splice_insert row by row), same
+        rows, same output streams,
+      - host_assemble_pipeline_mutants_per_sec: the full production
+        _assemble stage (sharding, pool, ExecMutant wrapping, stats) —
+        what the worker actually sustains.
+
+    Uses the flagship jit signature so a warm persistent compilation
+    cache serves the launch; the worker thread never starts — the
+    batch is launched and fetched inline, then assembled repeatedly
+    on the host, so the numbers isolate the drain->exec-ready stage."""
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops.delta import FLAG_OVERFLOW, OP_INSERT
+    from syzkaller_tpu.ops.emit import (
+        DonorBankTable, assemble_batch_table, assemble_delta,
+        splice_batch_table, splice_insert)
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
+
+    target = get_target("test", "64")
+    pl = DevicePipeline(target, capacity=capacity, batch_size=batch_size,
+                        seed=0)
+    added, i = 0, 0
+    while added < seeds and i < seeds * 8:
+        if pl.add(_seed_programs(target, 1, seed0=42 + i)[0]):
+            added += 1
+        i += 1
+    assert added > 0, "no seed programs tensorized"
+    try:
+        batch, tmpl, ets = pl._fetch(pl._launch())
+        ok = (batch.flags & FLAG_OVERFLOW) == 0
+        ok &= (batch.template_idx >= 0) & (batch.template_idx < len(tmpl))
+        is_ins = batch.op == OP_INSERT
+        import numpy as np
+
+        js = np.flatnonzero(ok & ~is_ins)
+        ins = np.flatnonzero(ok & is_ins)
+        table = pl._template_table(ets)
+        dtab = DonorBankTable(pl.bank.blocks)
+
+        # Single-thread per-mutant reference.
+        t0 = time.perf_counter()
+        n_ref = 0
+        for _ in range(repeats):
+            for j in js:
+                et = ets[int(batch.template_idx[j])]
+                if et is None:
+                    continue
+                assemble_delta(et, batch, int(j))
+                n_ref += 1
+            for j in ins:
+                i = int(batch.template_idx[j])
+                et = ets[i]
+                d = int(batch.donor[j])
+                if et is None or not (0 <= d < len(pl.bank.blocks)):
+                    continue
+                splice_insert(et, batch.call_alive(j, max(et.ncalls, 1)),
+                              pl.bank.blocks[d], int(batch.pos[j]))
+                n_ref += 1
+        ref_dt = time.perf_counter() - t0
+
+        # The vectorized one-pass stream assemblers, same rows.
+        t0 = time.perf_counter()
+        n_fast = 0
+        for _ in range(repeats):
+            n_fast += sum(d is not None
+                          for d in assemble_batch_table(table, batch, js))
+            datas, fast_mask = splice_batch_table(table, dtab, batch, ins)
+            n_fast += sum(d is not None for d in datas)
+            # Rows outside the fast conditions go per-mutant, exactly
+            # as the production path routes them.
+            for j in ins[~fast_mask]:
+                i = int(batch.template_idx[j])
+                et = ets[i]
+                d = int(batch.donor[j])
+                if et is None or not (0 <= d < len(pl.bank.blocks)):
+                    continue
+                if splice_insert(
+                        et, batch.call_alive(j, max(et.ncalls, 1)),
+                        pl.bank.blocks[d], int(batch.pos[j])) is not None:
+                    n_fast += 1
+        fast_dt = time.perf_counter() - t0
+
+        # The full production stage (pool + ExecMutant wrapping).
+        t0 = time.perf_counter()
+        n_pipe = 0
+        for _ in range(repeats):
+            n_pipe += len(pl._assemble(batch, tmpl, ets))
+        pipe_dt = time.perf_counter() - t0
+    finally:
+        pl.stop()
+    fast = n_fast / fast_dt if fast_dt else 0.0
+    ref = n_ref / ref_dt if ref_dt else 0.0
+    pipe = n_pipe / pipe_dt if pipe_dt else 0.0
+    return {
+        "host_assemble_mutants_per_sec": round(fast, 1),
+        "host_assemble_single_thread_mutants_per_sec": round(ref, 1),
+        "host_assemble_speedup_x": round(fast / ref, 2) if ref else None,
+        "host_assemble_pipeline_mutants_per_sec": round(pipe, 1),
+        "assemble_workers": pl._assemble_workers,
+        "d2h_bytes_per_batch": round(
+            pl.stats.d2h_bytes / max(1, pl.stats.d2h_batches), 1),
+    }
 
 
 def bench_device_kernel(batch_size=512, edges_per_prog=128,
@@ -578,11 +701,22 @@ def main() -> None:
         journal_append(res)
         print(json.dumps(res))
         return
+    if "--host-assembly" in argv:
+        res = {"metric": "host_assemble_mutants_per_sec", "unit":
+               "mutants/sec", **bench_host_assembly()}
+        res["value"] = res["host_assemble_mutants_per_sec"]
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
     batch = int(argv[argv.index("--batch") + 1]) \
         if "--batch" in argv else PIPE_BATCH
     secs = float(argv[argv.index("--seconds") + 1]) \
         if "--seconds" in argv else 8.0
-    pipe_rate = bench_pipeline(batch_size=batch, seconds=secs)
+    pipe_sub: dict = {}
+    pipe_rate = bench_pipeline(batch_size=batch, seconds=secs,
+                               sub_out=pipe_sub)
     # The flagship rate is measured; don't let an auxiliary compile
     # failure discard it.  On the tunneled backend the far-side
     # compiler can break BETWEEN compiles (BENCH_WEDGE_DIAGNOSIS.md
@@ -593,6 +727,14 @@ def main() -> None:
     except Exception as e:
         kernel_rate = None
         kernel_err = f"{type(e).__name__}: {e}"[:200]
+    # Host assembly sub-bench: same jit signature as the flagship, so
+    # the persistent compilation cache serves its launch; a failure
+    # here must not discard the measured flagship rate.
+    try:
+        assemble_sub = bench_host_assembly(batch_size=batch)
+    except Exception as e:
+        assemble_sub = {"host_assemble_error":
+                        f"{type(e).__name__}: {e}"[:200]}
     cpu_rate = bench_cpu()
     result = {
         "metric": "exec_ready_mutants_per_sec_per_chip",
@@ -605,6 +747,8 @@ def main() -> None:
                 else None,
             "cpu_baseline_mutants_per_sec": round(cpu_rate, 1),
             "pipeline_batch": batch,
+            **pipe_sub,
+            **assemble_sub,
         },
         "note": ("value = integrated corpus-tensor->exec-bytes rate off "
                  "ops/pipeline.DevicePipeline (the path fuzzer/proc.py "

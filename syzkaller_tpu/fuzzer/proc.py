@@ -65,7 +65,10 @@ class PipelineMutator:
     success-conditioned the same way the reference's is.
 
     next() returns either an exec-ready ExecMutant or a typed Prog;
-    Proc.execute handles both.  Corpus growth is fed to the device
+    Proc.execute handles both.  An ExecMutant's exec_bytes is a
+    zero-copy view into its batch's output arena (ops/emit), written
+    straight into the executor's shmem by Env.exec — the draw path
+    never copies mutant bytes.  Corpus growth is fed to the device
     ring on every draw (one scatter per pipeline step).
 
     Health latch: after demote_after CONSECUTIVE drain timeouts — or
@@ -111,8 +114,10 @@ class PipelineMutator:
         return not self._demoted.is_set()
 
     def health_snapshot(self) -> dict:
-        """Latch + pipeline breaker/watchdog state, for tests and
-        status surfaces."""
+        """Latch + pipeline breaker/watchdog state (including the
+        assembly pool's worker count and queue depth, which the
+        pipeline folds into its own snapshot), for tests and status
+        surfaces."""
         out = {"demoted": self._demoted.is_set(),
                "demotions": self.demotions,
                "repromotions": self.repromotions}
@@ -520,7 +525,7 @@ class Proc:
             log.logf(0, "%s:\n%s", marker,
                      serialize_prog(typed).decode())
         if _is_exec_mutant(p):
-            data = p.exec_bytes
+            data = p.exec_bytes  # arena view, handed zero-copy to Env
         else:
             data = serialize_for_exec(p)
         try:

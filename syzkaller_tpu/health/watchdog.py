@@ -90,12 +90,21 @@ class Watchdog:
 
     # -- the guard --------------------------------------------------------
 
-    def call(self, fn, op: str, deadline_s=None):
-        """Run fn() under `deadline_s` (default: the launch deadline).
+    def call(self, fn, op: str, deadline_s=None, compile: bool = False):
+        """Run fn() under a deadline.  An explicit `deadline_s` is
+        pinned for the call; with deadline_s=None the deadline is
+        DYNAMIC — re-read from `self.deadline_s` (or
+        `self.compile_deadline_s` when `compile`) on every wait tick,
+        so tightening the knob applies to a call already in flight
+        (an operator shortening deadlines on a wedging system — or a
+        test doing the same — must not wait out the old deadline).
         Returns fn's result, re-raises its exception, or raises
         DeviceWedged when the deadline passes first."""
-        if deadline_s is None:
-            deadline_s = self.deadline_s
+        def current() -> float:
+            if deadline_s is not None:
+                return deadline_s
+            return self.compile_deadline_s if compile else self.deadline_s
+
         _M_CALLS.inc()
         with self._lock:
             self.stats.calls += 1
@@ -103,7 +112,8 @@ class Watchdog:
             # Reap abandoned threads that eventually came back.
             self._abandoned = [t for t in self._abandoned if t.is_alive()]
             self.stats.abandoned_live = len(self._abandoned)
-        if not deadline_s or deadline_s <= 0:
+        d0 = current()
+        if not d0 or d0 <= 0:
             t0 = self._clock()
             try:
                 return fn()
@@ -124,19 +134,21 @@ class Watchdog:
         th = threading.Thread(target=run, daemon=True,
                               name=f"watchdog-{op}")
         th.start()
-        if not done.wait(timeout=deadline_s):
-            now = time.time()
-            with self._lock:
-                self.stats.wedges += 1
-                self.stats.last_wedge_at = now
-                self._abandoned.append(th)
-                self.stats.abandoned_live = len(self._abandoned)
-            _M_WEDGES.inc()
-            _M_LAST_WEDGE.set(now)
-            telemetry.record_event(
-                "watchdog.wedge",
-                f"{op} exceeded {deadline_s:.1f}s deadline")
-            raise DeviceWedged(op, deadline_s)
+        while not done.wait(timeout=0.2):
+            d = current()
+            if d and d > 0 and self._clock() - t0 >= d:
+                now = time.time()
+                with self._lock:
+                    self.stats.wedges += 1
+                    self.stats.last_wedge_at = now
+                    self._abandoned.append(th)
+                    self.stats.abandoned_live = len(self._abandoned)
+                _M_WEDGES.inc()
+                _M_LAST_WEDGE.set(now)
+                telemetry.record_event(
+                    "watchdog.wedge",
+                    f"{op} exceeded {d:.1f}s deadline")
+                raise DeviceWedged(op, d)
         self._note_done(self._clock() - t0)
         if "error" in box:
             raise box["error"]

@@ -233,9 +233,14 @@ class Env:
 
     # -- execution --------------------------------------------------------
 
-    def exec(self, opts: ExecOpts, prog_data: bytes,
+    def exec(self, opts: ExecOpts, prog_data,
              max_restarts: int = 3) -> ExecResult:
-        """Execute one serialized program (exec wire format bytes)."""
+        """Execute one serialized program (exec wire format).
+
+        prog_data is any bytes-like buffer; device mutants hand the
+        (offset, length) memoryview of their batch output arena
+        straight through (ops/emit), so the program bytes are copied
+        exactly once — into the executor's shmem mapping below."""
         if len(prog_data) > IN_SHMEM_SIZE:
             raise ValueError("program exceeds exec buffer")
         last_exc: Optional[Exception] = None
@@ -256,9 +261,9 @@ class Env:
                 self.close_proc()
         raise last_exc  # type: ignore[misc]
 
-    def _exec_once(self, opts: ExecOpts, prog_data: bytes) -> ExecResult:
+    def _exec_once(self, opts: ExecOpts, prog_data) -> ExecResult:
         self._in_mm.seek(0)
-        self._in_mm.write(prog_data)
+        self._in_mm.write(prog_data)  # accepts any buffer, one memcpy
         self.stat_execs += 1
         req = _EXECUTE_REQ.pack(
             EXECUTE_REQ_MAGIC, int(opts.flags), len(prog_data) // 8,

@@ -23,11 +23,16 @@ Op classes: OP_MUTATE (value/data/remove mutation of the template) and
 OP_INSERT (donor, pos valid: splice the donor block's exec segment at
 alive-call boundary pos — ops/insert.py).
 
-The whole batch is a single flat uint8 array — rows then pool, one
-transfer per batch.  The host reconstructs exec bytes by patching the
-template stream (ops/emit.assemble_delta) and rebuilds full tensor
-rows only for the rare triaged mutant (reference volume argument:
-triage is ~1/1000 of executions, syz-fuzzer/proc.go:100).
+Two transfer layouts share the same row format: make_pooler returns a
+single flat uint8 array (rows ++ pool, one transfer — the sharded
+mesh path), while make_compact_pooler returns rows, pool, and the
+claimed-slot count separately so the pipeline fetches only the
+power-of-two `pool_bucket` prefix of the pool a batch actually used
+(compacted D2H; the prefix-sum assignment packs claimed slots at the
+front).  The host reconstructs exec bytes by patching the template
+stream (ops/emit.assemble_delta) and rebuilds full tensor rows only
+for the rare triaged mutant (reference volume argument: triage is
+~1/1000 of executions, syz-fuzzer/proc.go:100).
 
 Mutants whose change set exceeds K/D/P — or that lose the race for a
 pool slot — are flagged OVERFLOW and dropped (counted; with rounds=4,
@@ -218,11 +223,36 @@ def make_pooler(spec: DeltaSpec, batch_size: int):
     sum, losers are flagged OVERFLOW, and the result is ONE flat uint8
     buffer (rows ++ pool) — the single device->host transfer."""
     import jax.numpy as jnp
-    from jax import lax
 
     POOL = spec.pool_slots(batch_size)
+    assign = _make_pool_assigner(spec, POOL)
 
     def pool_batch(rows, payloads, needs):
+        rows, pool, _n_used = assign(rows, payloads, needs)
+        return jnp.concatenate([rows.reshape(-1), pool.reshape(-1)])
+
+    return pool_batch
+
+
+def make_compact_pooler(spec: DeltaSpec, batch_size: int):
+    """Compacted-D2H variant of make_pooler: identical prefix-sum pool
+    assignment, but rows, pool, and the used-slot count come back as
+    SEPARATE device arrays.  The prefix sum packs every claimed slot at
+    the front of the pool, so the host only fetches the
+    `pool_bucket(n_used)` prefix — a power-of-two slot count, keeping
+    the transfer-shape set static (log2(POOL) variants, nothing
+    re-jits) while the ~94% of batches that touch few payload slots
+    stop shipping a full pool over the latency-bound link."""
+    import jax.numpy as jnp
+
+    return _make_pool_assigner(spec, spec.pool_slots(batch_size))
+
+
+def _make_pool_assigner(spec: DeltaSpec, POOL: int):
+    import jax.numpy as jnp
+    from jax import lax
+
+    def assign(rows, payloads, needs):
         idx = jnp.cumsum(needs.astype(jnp.int32)) - 1
         pool_idx = jnp.where(needs, idx, -1)
         lost = pool_idx >= POOL
@@ -236,9 +266,22 @@ def make_pooler(spec: DeltaSpec, batch_size: int):
         scatter = jnp.where(pool_idx >= 0, pool_idx, POOL)
         pool = jnp.zeros((POOL + 1, spec.P), jnp.uint8) \
             .at[scatter].set(payloads, mode="drop")[:POOL]
-        return jnp.concatenate([rows.reshape(-1), pool.reshape(-1)])
+        n_used = jnp.minimum(
+            needs.astype(jnp.int32).sum(), jnp.int32(POOL))
+        return rows, pool, n_used
 
-    return pool_batch
+    return assign
+
+
+def pool_bucket(n_used: int, pool_slots: int) -> int:
+    """Power-of-two transfer bucket covering `n_used` claimed payload
+    slots (0 = nothing to fetch).  Bucketing keeps the D2H slice-shape
+    set static so the pool fetch never compiles more than
+    log2(pool_slots)+1 distinct slices."""
+    n = int(n_used)
+    if n <= 0:
+        return 0
+    return min(int(pool_slots), 1 << max(0, (n - 1).bit_length()))
 
 
 class DeltaBatch:
@@ -246,9 +289,12 @@ class DeltaBatch:
     pool) — pure numpy slicing, no per-mutant parsing."""
 
     def __init__(self, flat: np.ndarray, spec: DeltaSpec,
-                 batch_size: Optional[int] = None):
+                 batch_size: Optional[int] = None,
+                 pool: Optional[np.ndarray] = None):
         if flat.ndim == 2:
-            # already-split rows with no pool (pool-free test path)
+            # already-split rows: pool is the separately-fetched
+            # (possibly bucket-compacted) payload array, or absent
+            # entirely (pool-free test path).
             if flat.shape[1] != spec.row_bytes:
                 raise ValueError(
                     f"row width {flat.shape[1]} != spec {spec.row_bytes}")
@@ -268,7 +314,13 @@ class DeltaBatch:
             self._pool = flat[nrow:].reshape(-1, spec.P)
         else:
             buf = flat
-            self._pool = np.zeros((0, spec.P), np.uint8)
+            if pool is not None:
+                if pool.ndim != 2 or pool.shape[1] != spec.P:
+                    raise ValueError(
+                        f"pool shape {pool.shape} != (*, {spec.P})")
+                self._pool = pool
+            else:
+                self._pool = np.zeros((0, spec.P), np.uint8)
         self.buf = buf
         self.nvals = buf[:, 0]
         self.ndata = buf[:, 1]

@@ -42,6 +42,10 @@ from syzkaller_tpu.ops.tensor import DATA, FLAGS, INT, LEN, PROC, ProgTensor
 MASK64 = np.uint64(0xFFFFFFFFFFFFFFFF)
 MAX_COPYOUT = 256  # executor copyout table size (executor/wire.h:53)
 
+# word_call sentinels (ExecTemplate.word_call).
+WORD_EOF = -1
+WORD_ORPHAN = -2
+
 
 @dataclass
 class ExecTemplate:
@@ -75,6 +79,14 @@ class ExecTemplate:
     is_proc: np.ndarray  # bool[S]
     calls_any: np.ndarray  # bool[ncalls]: call contains a squashed ANY
     # (consumed by the pipeline's signal_prio for undecoded mutants)
+    # Precomputed alive-slicing mask source: owning call per template
+    # word.  WORD_EOF marks the trailing EOF word (kept by every
+    # mutant); WORD_ORPHAN marks words outside any call segment
+    # (dropped whenever a call is sliced — matching _slice_alive,
+    # which concatenates only alive segments + EOF).
+    word_call: np.ndarray  # int32[W]
+    seg_tiled: bool  # call segments tile [0, W-1) in order
+    insert_cut: np.ndarray  # int64[ncalls+1] splice word offset by pos
 
 
 def build_exec_template(t: ProgTensor,
@@ -130,10 +142,30 @@ def build_exec_template(t: ProgTensor,
     calls_any = np.array(
         [call_contains_any(target, c) for c in t.template.calls], dtype=bool)
 
+    call_bounds = np.array(rec.call_bounds or np.empty((0, 2)),
+                           dtype=np.int32).reshape(-1, 2)
+    word_call = np.full(len(words), WORD_ORPHAN, dtype=np.int32)
+    for i, (a, b) in enumerate(call_bounds):
+        word_call[a:b] = i
+    word_call[-1] = WORD_EOF
+    # Segments tile the stream (call i ends where i+1 starts, EOF
+    # last) for every serializer-produced template; the flag guards
+    # the splice fast path against a future layout that interleaves.
+    seg_tiled = bool(
+        len(call_bounds) == 0
+        or (call_bounds[0, 0] == 0
+            and (call_bounds[1:, 0] == call_bounds[:-1, 1]).all()
+            and call_bounds[-1, 1] == len(words) - 1))
+    # Insertion word offset after `pos` alive calls when every call is
+    # alive: insert_cut[pos] (length ncalls+1).
+    insert_cut = np.concatenate(
+        [np.zeros(1, np.int64),
+         call_bounds[:, 1].astype(np.int64)]) \
+        if len(call_bounds) else np.zeros(1, np.int64)
+
     return ExecTemplate(
         words=words,
-        call_bounds=np.array(rec.call_bounds or np.empty((0, 2)),
-                             dtype=np.int32).reshape(-1, 2),
+        call_bounds=call_bounds,
         ncalls=t.ncalls,
         ncopyouts=rec.ncopyouts,
         val_word=val_word, meta_word=meta_word,
@@ -146,6 +178,9 @@ def build_exec_template(t: ProgTensor,
         data_slots=data_slots,
         is_proc=(kinds == PROC) & (val_word >= 0),
         calls_any=calls_any,
+        word_call=word_call,
+        seg_tiled=seg_tiled,
+        insert_cut=insert_cut,
     )
 
 
@@ -245,14 +280,18 @@ def assemble_delta(et: ExecTemplate, batch, j: int) -> bytes:
 
 
 def assemble_batch(ets: list, batch, js: np.ndarray) -> list:
-    """Assemble exec bytes for mutants `js` of a DeltaBatch in one
+    """Assemble exec streams for mutants `js` of a DeltaBatch in one
     vectorized numpy pass per template group (the host-side hot path:
     a Python-per-mutant loop here was 4x slower than the device kernel,
     so value patches scatter across the whole group at once).
 
     ets is the exec-template snapshot indexable by batch.template_idx.
-    Returns a list aligned with js; entries are bytes or None (missing
-    template / assembly failure)."""
+    Returns a list aligned with js; entries are bytes-like — zero-copy
+    (offset, length) memoryviews into a contiguous per-group output
+    arena on the fast path, plain bytes on the per-mutant fallback —
+    or None (missing template / assembly failure).  Views pin their
+    arena, so a batch's memory lives exactly as long as its last
+    undelivered mutant."""
     out: list = [None] * len(js)
     if len(js) == 0:
         return out
@@ -282,11 +321,374 @@ def assemble_batch(ets: list, batch, js: np.ndarray) -> list:
     return out
 
 
+class TemplateTable:
+    """Stacked per-template assembly metadata over one exec-template
+    snapshot: every slot-aligned patch array becomes a (T, S) table
+    and the word streams flatten into one array with offsets — so a
+    whole batch of full-alive mutants assembles in ONE vectorized
+    pass (assemble_batch_table) with no per-template Python at all.
+    Built once per corpus snapshot and cached by the pipeline; dead
+    slots (no template) stay masked via `valid`."""
+
+    __slots__ = ("ets", "valid", "w_len", "w_off", "words_flat",
+                 "wc_flat", "full_bits", "val_word", "meta_word",
+                 "len_word", "data_word", "data_cap", "aux0",
+                 "proc_meta_default", "proc_meta_concrete", "is_proc",
+                 "ncalls", "ncopyouts", "seg_tiled", "cut_off",
+                 "cut_flat")
+
+    def __init__(self, ets: list):
+        self.ets = ets
+        T = len(ets)
+        first = next((et for et in ets if et is not None), None)
+        S = first.val_word.shape[0] if first is not None else 0
+        self.valid = np.array([et is not None for et in ets], dtype=bool)
+        self.w_len = np.array([et.words.size if et is not None else 0
+                               for et in ets], dtype=np.int64)
+        self.w_off = np.cumsum(self.w_len) - self.w_len
+        self.words_flat = np.concatenate(
+            [et.words for et in ets if et is not None]) \
+            if first is not None else np.empty(0, np.uint64)
+        self.wc_flat = np.concatenate(
+            [et.word_call for et in ets if et is not None]) \
+            if first is not None else np.empty(0, np.int32)
+        self.full_bits = np.array(
+            [0 if et is None
+             else ((1 << et.ncalls) - 1 if et.ncalls < 64 else 2**64 - 1)
+             for et in ets], dtype=np.uint64)
+        # Insert-splice metadata (splice_batch_table): per-template
+        # call counts, copyout bases, tiling flags, and the flattened
+        # insert_cut tables (ragged, ncalls+1 entries each).
+        self.ncalls = np.array([et.ncalls if et is not None else 0
+                                for et in ets], dtype=np.int64)
+        self.ncopyouts = np.array(
+            [et.ncopyouts if et is not None else 0 for et in ets],
+            dtype=np.int64)
+        self.seg_tiled = np.array(
+            [bool(et.seg_tiled) if et is not None else False
+             for et in ets], dtype=bool)
+        cut_len = np.array(
+            [et.insert_cut.size if et is not None else 1 for et in ets],
+            dtype=np.int64)
+        self.cut_off = np.cumsum(cut_len) - cut_len
+        self.cut_flat = np.concatenate(
+            [et.insert_cut if et is not None else np.zeros(1, np.int64)
+             for et in ets]) if T else np.zeros(0, np.int64)
+
+        def stack(attr, fill, dtype):
+            tbl = np.full((T, S), fill, dtype=dtype)
+            for i, et in enumerate(ets):
+                if et is not None:
+                    tbl[i] = getattr(et, attr)
+            return tbl
+
+        self.val_word = stack("val_word", -1, np.int32)
+        self.meta_word = stack("meta_word", -1, np.int32)
+        self.len_word = stack("len_word", -1, np.int32)
+        self.data_word = stack("data_word", -1, np.int32)
+        self.data_cap = stack("data_cap", 0, np.int64)
+        self.aux0 = stack("aux0", 0, np.uint64)
+        self.proc_meta_default = stack("proc_meta_default", 0, np.uint64)
+        self.proc_meta_concrete = stack("proc_meta_concrete", 0, np.uint64)
+        self.is_proc = stack("is_proc", False, bool)
+
+
+def assemble_batch_table(table: TemplateTable, batch,
+                         js: np.ndarray) -> list:
+    """Assemble exec streams for mutants `js` in ONE vectorized pass
+    across ALL templates: base-copy every row's template words into a
+    single contiguous per-batch output arena (ragged gather), scatter
+    every value/PROC patch through the stacked (T, S) tables, run the
+    ragged payload memcpys globally, and return zero-copy memoryview
+    slices.  Rows with dead calls (alive slicing) or a missing
+    template degrade to the per-group assemble_batch path, which is
+    bit-exact by construction.  Aligned with js; None = failure."""
+    js = np.asarray(js, dtype=np.int64)
+    out: list = [None] * len(js)
+    if len(js) == 0:
+        return out
+    tid = batch.template_idx[js].astype(np.int64)
+    in_range = (tid >= 0) & (tid < len(table.valid))
+    tidc = np.where(in_range, tid, 0)
+    valid_t = table.valid[tidc] & in_range
+    main = np.flatnonzero(valid_t)
+    if not main.size:
+        return out
+    try:
+        datas = _assemble_rows_table(table, batch, js[main], tidc[main])
+    except Exception:
+        # One bad row cannot sink the whole pass: degrade to the
+        # per-group path (which itself degrades per-mutant).
+        datas = assemble_batch(table.ets, batch, js[main])
+    for p, d in zip(main, datas):
+        out[int(p)] = d
+    return out
+
+
+def _assemble_rows_table(table: TemplateTable, batch, mjs: np.ndarray,
+                         mt: np.ndarray) -> list:
+    """The global pass behind assemble_batch_table: one full-width
+    arena, three scatter/gather families, and — only for rows with
+    dead calls — a flat keep-mask compress through the stacked
+    word->call map into a side arena.  Zero per-row work.
+
+    Rows are processed template-sorted so the base copy collapses to
+    one broadcast memcpy per unique template (contiguous arena
+    block) instead of a ragged gather; outputs are mapped back to the
+    callers' row order at the end."""
+    order = np.argsort(mt, kind="stable")
+    mjs = mjs[order]
+    mt = mt[order]
+    w_len = table.w_len[mt]
+    ends = np.cumsum(w_len)
+    starts = ends - w_len
+    arena = np.empty(int(ends[-1]) if len(ends) else 0, np.uint64)
+    grp_bounds = np.flatnonzero(np.diff(mt)) + 1
+    for lo, hi in zip(np.concatenate([[0], grp_bounds]),
+                      np.concatenate([grp_bounds, [len(mt)]])):
+        et = table.ets[mt[lo]]
+        arena[starts[lo]:ends[hi - 1]].reshape(hi - lo, -1)[:] = et.words
+
+    # -- value patches --
+    K = batch.val_idx.shape[1]
+    slots = batch.val_idx[mjs].ravel().astype(np.int64)
+    sel = np.flatnonzero(slots >= 0)
+    if sel.size:
+        rr = sel // K
+        ss = slots[sel]
+        tr = mt[rr]
+        vw = table.val_word[tr, ss].astype(np.int64)
+        g = vw >= 0
+        if not g.all():
+            sel, rr, ss, tr, vw = (a[g] for a in (sel, rr, ss, tr, vw))
+        v = batch.vals[mjs].ravel()[sel]
+        dest = starts[rr] + vw
+        isp = table.is_proc[tr, ss]
+        if isp.any():
+            ni = np.flatnonzero(~isp)
+            arena[dest[ni]] = v[ni]
+            pi = np.flatnonzero(isp)
+            vv = v[pi]
+            dflt = vv == MASK64
+            tp, sp = tr[pi], ss[pi]
+            with np.errstate(over="ignore"):
+                arena[dest[pi]] = np.where(
+                    dflt, np.uint64(0), table.aux0[tp, sp] + vv)
+            mw = table.meta_word[tp, sp].astype(np.int64)
+            arena[starts[rr[pi]] + mw] = np.where(
+                dflt, table.proc_meta_default[tp, sp],
+                table.proc_meta_concrete[tp, sp])
+        else:
+            arena[dest] = v
+
+    # -- data patches (global ragged zero + payload copy) --
+    D = batch.data_slot.shape[1]
+    ds = batch.data_slot[mjs].ravel().astype(np.int64)
+    dsel = np.flatnonzero(ds >= 0)
+    if dsel.size:
+        drr = dsel // D
+        dss = ds[dsel]
+        dtr = mt[drr]
+        lw = table.len_word[dtr, dss].astype(np.int64)
+        g = lw >= 0
+        if not g.all():
+            dsel, drr, dss, dtr, lw = (
+                a[g] for a in (dsel, drr, dss, dtr, lw))
+        if dsel.size:
+            caps = table.data_cap[dtr, dss]
+            lens = np.minimum(
+                batch.data_len[mjs].ravel()[dsel].astype(np.int64), caps)
+            if np.any(lens < 0):
+                raise ValueError("negative data length in delta row")
+            arena[starts[drr] + lw] = (lens | (caps << 32)) \
+                .astype(np.uint64)
+            u8 = arena.view(np.uint8)
+            dst0 = (starts[drr]
+                    + table.data_word[dtr, dss].astype(np.int64)) * 8
+            e, k = _ragged_spans(caps + (-caps) % 8)
+            u8[dst0[e] + k] = 0
+            pidx = batch.pool_idx[mjs].astype(np.int64)[drr]
+            cp = np.flatnonzero(pidx >= 0)
+            if cp.size and len(batch._pool):
+                offs = batch.data_off[mjs].ravel()[dsel[cp]] \
+                    .astype(np.int64)
+                ln_e = lens[cp]
+                if np.any(offs < 0) or np.any(offs + ln_e > batch.spec.P):
+                    raise ValueError("payload span exceeds pool slot")
+                src0 = pidx[cp] * batch.spec.P + offs
+                e, k = _ragged_spans(ln_e)
+                u8[dst0[cp][e] + k] = batch._pool.reshape(-1)[src0[e] + k]
+
+    # -- alive slicing: rows with dead calls compress through the
+    # word->call map into a side arena; full rows stay where they are
+    # (the patched arena already IS their stream, orphans included —
+    # matching _slice_alive's full path) --
+    ab = batch.alive_bits[mjs] & table.full_bits[mt]
+    is_full = ab == table.full_bits[mt]
+    u8v = memoryview(arena.view(np.uint8))
+    inv = np.empty(len(order), np.int64)
+    inv[order] = np.arange(len(order))
+    if bool(is_full.all()):
+        return [u8v[int(starts[i]) * 8:int(ends[i]) * 8] for i in inv]
+    dead = np.flatnonzero(~is_full)
+    e, k = _ragged_spans(w_len[dead])
+    src = starts[dead][e] + k
+    wcv = table.wc_flat[table.w_off[mt[dead]][e] + k].astype(np.int64)
+    keep = wcv == WORD_EOF
+    call = wcv >= 0
+    keep[call] = ((ab[dead][e][call]
+                   >> wcv[call].astype(np.uint64)) & 1) != 0
+    sub = arena[src[keep]]
+    counts = np.bincount(e[keep], minlength=len(dead)).astype(np.int64)
+    dends = np.cumsum(counts)
+    su8 = memoryview(sub.view(np.uint8))
+    dmap = np.full(len(mjs), -1, np.int64)
+    dmap[dead] = np.arange(len(dead))
+    datas: list = []
+    for i in inv:
+        if is_full[i]:
+            datas.append(u8v[int(starts[i]) * 8:int(ends[i]) * 8])
+        else:
+            dp = int(dmap[i])
+            hi = int(dends[dp]) * 8
+            datas.append(su8[hi - int(counts[dp]) * 8:hi])
+    return datas
+
+
+class DonorBankTable:
+    """The donor bank flattened for the one-pass splicer: raw
+    (un-rebased) block words, per-block offsets/lengths, and the
+    flattened copyout-word positions so rebasing happens as one ragged
+    in-arena add.  Built once per bank — base-independent, unlike
+    build_donor_table."""
+
+    __slots__ = ("w_flat", "w_off", "w_len", "cw_flat", "cw_off",
+                 "cw_len", "ncopyouts")
+
+    def __init__(self, blocks: list):
+        self.w_len = np.array([b.words.size for b in blocks],
+                              dtype=np.int64)
+        self.w_off = np.cumsum(self.w_len) - self.w_len
+        self.w_flat = np.concatenate([b.words for b in blocks]) \
+            if blocks else np.empty(0, np.uint64)
+        self.cw_len = np.array([b.copyout_words.size for b in blocks],
+                               dtype=np.int64)
+        self.cw_off = np.cumsum(self.cw_len) - self.cw_len
+        self.cw_flat = np.concatenate(
+            [np.asarray(b.copyout_words, dtype=np.int64)
+             for b in blocks]) if blocks else np.empty(0, np.int64)
+        self.ncopyouts = np.array([b.ncopyouts for b in blocks],
+                                  dtype=np.int64)
+
+
+def splice_batch_table(table: TemplateTable, dtab: DonorBankTable,
+                       batch, ins: np.ndarray) -> tuple:
+    """One-pass insert splicing across ALL templates: rows whose
+    template is tiled and fully alive (the overwhelming case — insert
+    mutants keep the template's alive bitmap) are assembled as four
+    global ragged operations into one arena: template prefix, donor
+    words, an in-place copyout-rebase add, template suffix (+ EOF).
+    Returns (views aligned with ins, fast-row mask); rows outside the
+    fast conditions are left for the caller's per-group path."""
+    ins = np.asarray(ins, dtype=np.int64)
+    out: list = [None] * len(ins)
+    if len(ins) == 0:
+        return out, np.zeros(0, bool)
+    tid = batch.template_idx[ins].astype(np.int64)
+    in_range = (tid >= 0) & (tid < len(table.valid))
+    tidc = np.where(in_range, tid, 0)
+    d = batch.donor[ins].astype(np.int64)
+    d_ok = (d >= 0) & (d < len(dtab.w_len))
+    dc = np.where(d_ok, d, 0)
+    full = table.full_bits[tidc]
+    fast = (in_range & table.valid[tidc] & table.seg_tiled[tidc]
+            & d_ok
+            & ((batch.alive_bits[ins] & full) == full)
+            & (table.ncopyouts[tidc] + dtab.ncopyouts[dc] <= MAX_COPYOUT))
+    m = np.flatnonzero(fast)
+    if not m.size:
+        return out, fast
+    t = tidc[m]
+    dm = dc[m]
+    pos = np.minimum(batch.pos[ins[m]].astype(np.int64), table.ncalls[t])
+    cut = table.cut_flat[table.cut_off[t] + pos]
+    w_t = table.w_len[t]
+    dl = dtab.w_len[dm]
+    total = w_t + dl
+    ends = np.cumsum(total)
+    starts = ends - total
+    arena = np.empty(int(ends[-1]), np.uint64)
+    # Template words land in one fused pass: words past the cut shift
+    # right by the donor length (the gap the donor fills).
+    e, k = _ragged_spans(w_t)
+    arena[starts[e] + k + np.where(k >= cut[e], dl[e], 0)] = \
+        table.words_flat[table.w_off[t][e] + k]
+    e, k = _ragged_spans(dl)
+    arena[(starts + cut)[e] + k] = dtab.w_flat[dtab.w_off[dm][e] + k]
+    e, k = _ragged_spans(dtab.cw_len[dm])
+    if e.size:
+        # Rebase the spliced-in copyout indices in place: positions
+        # are unique per row, so the fancy add never collides.
+        at = (starts + cut)[e] + dtab.cw_flat[dtab.cw_off[dm][e] + k]
+        arena[at] += table.ncopyouts[t][e].astype(np.uint64)
+    u8 = memoryview(arena.view(np.uint8))
+    for idx, p in enumerate(m):
+        out[int(p)] = u8[int(starts[idx]) * 8:int(ends[idx]) * 8]
+    return out, fast
+
+
+def shard_by_template(template_idx: np.ndarray, js: np.ndarray,
+                      shards: int) -> list:
+    """Split mutants `js` into at most `shards` balanced work shards
+    WITHOUT splitting a template group (assemble_batch amortizes its
+    patch pass per group, so a split group costs two passes).  Greedy
+    smallest-shard assignment over size-sorted groups; returns a list
+    of js-subset arrays, largest first, empty shards dropped."""
+    js = np.asarray(js, dtype=np.int64)
+    if shards <= 1 or len(js) == 0:
+        return [js] if len(js) else []
+    tidx = template_idx[js]
+    order = np.argsort(tidx, kind="stable")
+    bounds = np.flatnonzero(np.diff(tidx[order])) + 1
+    groups = np.split(js[order], bounds)
+    groups.sort(key=len, reverse=True)
+    bins: list = [[] for _ in range(min(shards, len(groups)))]
+    sizes = [0] * len(bins)
+    for g in groups:
+        i = sizes.index(min(sizes))
+        bins[i].append(g)
+        sizes[i] += len(g)
+    return [np.concatenate(b) for b in bins if b]
+
+
+def _ragged_spans(lengths: np.ndarray):
+    """Flattened advanced-indexing coordinates for variable-length
+    spans: (entry index e, within-span offset k) for every byte of
+    every span, with no Python loop.  Positions into a flat buffer are
+    then `starts[e] + k` for any per-entry starts array.  int32: the
+    index arrays are the pass's main memory traffic, and spans here
+    are bounded far below 2^31."""
+    lengths = np.asarray(lengths, dtype=np.int64)
+    total = int(lengths.sum())
+    if total == 0:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    e = np.repeat(np.arange(lengths.size, dtype=np.int32), lengths)
+    k = np.arange(total, dtype=np.int32)
+    k -= np.repeat((np.cumsum(lengths) - lengths).astype(np.int32),
+                   lengths)
+    return e, k
+
+
 def _assemble_group(et: ExecTemplate, batch, rows: np.ndarray) -> list:
     """Vectorized assemble_delta over mutants `rows` sharing one
-    template: one (m, W) patch pass + per-row byte extraction."""
+    template: one (m, W) patch pass (value scatters + flattened
+    ragged payload memcpys), then one boolean-gather pass through the
+    precomputed word->call mask into a contiguous output arena.
+    Returns per-mutant memoryview slices of that arena — no per-mutant
+    tobytes() copy anywhere."""
     m = len(rows)
-    w = np.broadcast_to(et.words, (m, et.words.shape[0])).copy()
+    W = et.words.shape[0]
+    w = np.broadcast_to(et.words, (m, W)).copy()
 
     # -- value patches (vectorized scatter) --
     slots = batch.val_idx[rows]  # (m, K) int16, -1 padded
@@ -311,8 +713,11 @@ def _assemble_group(et: ExecTemplate, batch, rows: np.ndarray) -> list:
         w[r, et.meta_word[sv]] = np.where(
             dflt, et.proc_meta_default[sv], et.proc_meta_concrete[sv])
 
-    # -- data patches (len words vectorized; payload spans looped — a
-    # few variable-length memcpys per batch) --
+    # -- data patches: len words scattered, then the ragged payload
+    # memcpys as TWO flattened advanced-indexing passes (zero the full
+    # cap-padded regions, copy the live payload bytes over them) —
+    # bit-exact with the per-mutant path, which overwrites [0, ln)
+    # with payload and [ln, cappad) with zeros --
     dslots = batch.data_slot[rows]  # (m, D)
     dvalid = dslots >= 0
     if dvalid.any():
@@ -323,31 +728,169 @@ def _assemble_group(et: ExecTemplate, batch, rows: np.ndarray) -> list:
         lens = np.minimum(batch.data_len[rows].astype(np.int64), caps)
         r, c = np.nonzero(dvalid)
         if r.size:
+            if np.any(lens[r, c] < 0):
+                # A negative length raises per-mutant in assemble_delta;
+                # degrade to that path rather than wrap silently here.
+                raise ValueError("negative data length in delta row")
             w[r, lw[r, c]] = (lens[r, c] | (caps[r, c] << 32)) \
                 .astype(np.uint64)
-            u8 = w.view(np.uint8).reshape(m, -1)
-            for i, j in zip(r, c):
-                sl = int(ds[i, j])
-                ln = int(lens[i, j])
-                cap = int(caps[i, j])
-                start = int(et.data_word[sl]) * 8
-                po = int(batch.data_off[rows[i], j])
-                u8[i, start:start + ln] = batch.payload[rows[i], po:po + ln]
-                u8[i, start + ln:start + cap + (-cap) % 8] = 0
+            u8 = w.view(np.uint8).reshape(-1)  # one flat (m*W*8,) view
+            dst0 = r.astype(np.int64) * (W * 8) \
+                + et.data_word[ds[r, c]].astype(np.int64) * 8
+            cap_e = caps[r, c]
+            e, k = _ragged_spans(cap_e + (-cap_e) % 8)
+            u8[dst0[e] + k] = 0
+            # Payload copy: rows without a pool slot (pool_idx < 0)
+            # read all-zero payloads — the zero fill above already IS
+            # that copy, so only pooled entries move bytes.
+            pidx = batch.pool_idx[rows[r]].astype(np.int64)
+            cp = np.flatnonzero(pidx >= 0)
+            if cp.size and len(batch._pool):
+                pool_flat = batch._pool.reshape(-1)
+                ln_e = lens[r, c][cp]
+                offs = batch.data_off[rows[r[cp]], c[cp]].astype(np.int64)
+                if np.any(offs < 0) or np.any(offs + ln_e > batch.spec.P):
+                    # A span past its pool slot would read the next
+                    # slot's bytes; assemble_delta raises instead —
+                    # degrade to it.
+                    raise ValueError("payload span exceeds pool slot")
+                src0 = pidx[cp] * batch.spec.P + offs
+                e, k = _ragged_spans(ln_e)
+                u8[dst0[cp][e] + k] = pool_flat[src0[e] + k]
 
-    # -- alive slicing --
+    # -- alive slicing via the precomputed word->call mask, into one
+    # contiguous per-group arena --
     nc = et.ncalls
     full = np.uint64((1 << nc) - 1) if nc < 64 else np.uint64(2**64 - 1)
     alive_bits = batch.alive_bits[rows] & full
+    if bool((alive_bits == full).all()):
+        # Every call alive: the patched block already is the arena.
+        arena = w
+        counts = np.full(m, W, dtype=np.int64)
+    else:
+        wc = et.word_call
+        shift = np.where(wc >= 0, wc, 0).astype(np.uint64)
+        keep = ((alive_bits[:, None] >> shift[None, :]) & 1) != 0
+        keep[:, wc == WORD_EOF] = True
+        keep[:, wc == WORD_ORPHAN] = False
+        counts = keep.sum(axis=1, dtype=np.int64)
+        arena = w.reshape(-1)[keep.reshape(-1)]
+
+    u8 = memoryview(arena.reshape(-1).view(np.uint8))
+    ends = np.cumsum(counts) * 8
     datas: list = []
     for i in range(m):
-        if alive_bits[i] == full:
-            datas.append(w[i].tobytes())
-        else:
-            alive = ((alive_bits[i] >> np.arange(
-                max(nc, 1), dtype=np.uint64)) & 1).astype(bool)
-            datas.append(_slice_alive(et, w[i], alive))
+        hi = int(ends[i])
+        datas.append(u8[hi - int(counts[i]) * 8:hi])
     return datas
+
+
+def build_donor_table(base_copyouts: int, blocks: list) -> tuple:
+    """The whole donor bank rebased past `base_copyouts`, flattened
+    for ragged gathering: (flat words, per-block offsets, per-block
+    lengths, per-block budget-ok mask).  One table serves every
+    template with the same copyout count — callers cache by base
+    (bounded: base <= MAX_COPYOUT)."""
+    lens = np.array([b.words.size for b in blocks], dtype=np.int64)
+    offs = np.cumsum(lens) - lens
+    ok = np.array([base_copyouts + b.ncopyouts <= MAX_COPYOUT
+                   for b in blocks], dtype=bool)
+    flat = np.concatenate(
+        [b.rebased_words(base_copyouts) for b in blocks]) \
+        if blocks else np.empty(0, np.uint64)
+    return flat, offs, lens, ok
+
+
+def splice_insert_group(et: ExecTemplate, alive_bits: np.ndarray,
+                        donors: np.ndarray, poses: np.ndarray,
+                        blocks: list, table: Optional[tuple] = None) -> list:
+    """Vectorized splice_insert over insert mutants sharing one
+    template: donor words come from a pre-rebased flat bank table
+    (build_donor_table), and the template's alive segments plus the
+    donor words land in a single contiguous output arena via three
+    ragged flattened-index copies (before-splice words, donor words,
+    after-splice words + EOF) — no per-mutant Python.  Returns
+    memoryview slices of the arena aligned with the inputs; None
+    where the combined copyout budget would overflow."""
+    m = len(donors)
+    out: list = [None] * m
+    nc = et.ncalls
+    W = et.words.shape[0]
+    full = np.uint64((1 << nc) - 1) if nc < 64 else np.uint64(2**64 - 1)
+    ab = alive_bits & full
+    if nc:
+        calls = np.arange(nc, dtype=np.uint64)
+        alive = ((ab[:, None] >> calls[None, :]) & 1) != 0  # (m, nc)
+        rank = np.cumsum(alive, axis=1) - alive  # exclusive alive rank
+        n_alive = alive.sum(axis=1)
+    else:
+        alive = np.zeros((m, 0), bool)
+        rank = np.zeros((m, 0), np.int64)
+        n_alive = np.zeros(m, np.int64)
+    pos = np.minimum(poses.astype(np.int64), n_alive)
+
+    if table is None:
+        table = build_donor_table(et.ncopyouts, blocks)
+    dflat, doff_u, dlen_u, ok_u = table
+    donors = np.asarray(donors, dtype=np.int64)
+    rows_ok = np.flatnonzero(ok_u[donors])
+    if rows_ok.size == 0:
+        return out
+
+    pos_o = pos[rows_ok]
+    dl = dlen_u[donors[rows_ok]]
+    dsrc0 = doff_u[donors[rows_ok]]
+    if et.seg_tiled and bool((ab[rows_ok] == full).all()):
+        # Every call alive on a tiled template: the splice is two
+        # contiguous template slices around the cut word — no mask
+        # arrays at all, just ragged index math.
+        cut = et.insert_cut[np.minimum(pos_o, nc)]
+        n_a = cut
+        n_c = W - cut
+        total = n_a + dl + n_c
+        ends = np.cumsum(total)
+        starts = ends - total
+        arena = np.empty(int(ends[-1]) if len(ends) else 0, np.uint64)
+        e, k = _ragged_spans(n_a)
+        arena[starts[e] + k] = et.words[k]
+        e, k = _ragged_spans(dl)
+        arena[(starts + n_a)[e] + k] = dflat[dsrc0[e] + k]
+        e, k = _ragged_spans(n_c)
+        arena[(starts + n_a + dl)[e] + k] = et.words[cut[e] + k]
+    else:
+        alive_o = alive[rows_ok]
+        rank_o = rank[rows_ok]
+        wc = et.word_call
+        is_call = wc >= 0
+        if nc:
+            cw = np.where(is_call, wc, 0)
+            word_alive = alive_o[:, cw] & is_call[None, :]
+            word_rank = rank_o[:, cw]
+        else:
+            word_alive = np.zeros((len(rows_ok), W), bool)
+            word_rank = np.zeros((len(rows_ok), W), np.int64)
+        in_a = word_alive & (word_rank < pos_o[:, None])
+        in_c = word_alive & (word_rank >= pos_o[:, None])
+        in_c[:, wc == WORD_EOF] = True  # EOF rides the tail part
+
+        n_a = in_a.sum(axis=1, dtype=np.int64)
+        n_c = in_c.sum(axis=1, dtype=np.int64)
+        total = n_a + dl + n_c
+        ends = np.cumsum(total)
+        starts = ends - total
+        arena = np.empty(int(ends[-1]) if len(ends) else 0, np.uint64)
+        wb = np.broadcast_to(et.words, (len(rows_ok), W))
+        e, k = _ragged_spans(n_a)
+        arena[starts[e] + k] = wb[in_a]
+        e, k = _ragged_spans(dl)
+        arena[(starts + n_a)[e] + k] = dflat[dsrc0[e] + k]
+        e, k = _ragged_spans(n_c)
+        arena[(starts + n_a + dl)[e] + k] = wb[in_c]
+
+    u8 = memoryview(arena.view(np.uint8))
+    for idx, i in enumerate(rows_ok):
+        out[int(i)] = u8[int(starts[idx]) * 8:int(ends[idx]) * 8]
+    return out
 
 
 def mutant_call_ids(et: ExecTemplate, call_alive: np.ndarray) -> list[int]:

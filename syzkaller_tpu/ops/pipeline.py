@@ -10,12 +10,26 @@ on varying shapes, and decoded every mutant back to a typed tree
   - one jitted step at a STATIC batch shape samples templates
     uniformly (reference corpus pick: syz-fuzzer/proc.go:92) and
     mutates them in a single fused vmap — no per-batch recompile,
-  - mutated rows come back as numpy and become exec wire bytes via
-    the patch-table assembler (ops/emit.py) — no typed decode on the
-    hot path; ExecMutant decodes lazily for the rare triaged input,
+  - the D2H transfer is COMPACTED: delta rows ship in full (every row
+    is a mutant) but the payload pool ships only the pow2-bucketed
+    prefix of slots the batch actually claimed (ops/delta
+    make_compact_pooler; bucketing keeps the slice-shape set static
+    so nothing re-jits on the latency-bound tunneled link),
+  - drained rows become exec wire bytes via the vectorized
+    patch-table assembler (ops/emit.py): per template group, one
+    patch pass + one gather into a contiguous output arena whose
+    (offset, length) memoryview slices ARE the mutants' exec bytes —
+    handed zero-copy through to the executor's shmem write.  No typed
+    decode on the hot path; ExecMutant decodes lazily for the rare
+    triaged input,
+  - assembly runs on a pool of TZ_ASSEMBLE_WORKERS threads, sharded
+    by template group so a group's vectorized pass never splits; the
+    drain thread keeps `assemble_depth` batches in the pool and
+    delivers them strictly in drain order,
   - a background worker keeps `prefetch` assembled batches queued
     while executors drain the previous one (double buffering,
-    SURVEY.md §7 hard part (c)).
+    SURVEY.md §7 hard part (c)); docs/perf.md covers the stage
+    anatomy and the tuning knobs.
 
 fuzzer.proc.PipelineMutator draws the reference op ladder per mutant
 and routes the device classes here — insert (donor-bank splice with
@@ -47,16 +61,24 @@ from syzkaller_tpu.health import (
 from syzkaller_tpu.models.prog import Prog
 from syzkaller_tpu.ops.delta import (
     FLAG_OVERFLOW,
+    OP_INSERT,
     DeltaBatch,
     DeltaSpec,
+    make_compact_pooler,
     make_packer,
-    make_pooler,
+    pool_bucket,
 )
 from syzkaller_tpu.ops.emit import (
+    DonorBankTable,
     ExecTemplate,
-    assemble_batch,
+    TemplateTable,
+    assemble_batch_table,
     build_exec_template,
     mutant_call_ids,
+    shard_by_template,
+    splice_batch_table,
+    splice_insert,
+    splice_insert_group,
 )
 from syzkaller_tpu.ops.tensor import (
     FlagTables,
@@ -108,13 +130,33 @@ _M_QUEUE_DEPTH = telemetry.gauge(
     "tz_pipeline_queue_depth", "assembled batches waiting for procs")
 _M_BATCH_SIZE = telemetry.gauge(
     "tz_pipeline_batch_size", "mutants per device batch")
+_M_ASYNC_COPY_FALLBACKS = telemetry.counter(
+    "tz_pipeline_async_copy_fallback_total",
+    "copy_to_host_async calls that fell back to the synchronous drain")
+_M_D2H_BYTES = telemetry.counter(
+    "tz_pipeline_d2h_bytes_total",
+    "compacted delta bytes fetched device->host")
+_M_D2H_BATCH_BYTES = telemetry.gauge(
+    "tz_pipeline_d2h_batch_bytes",
+    "compacted bytes fetched for the most recent batch")
+_M_ASSEMBLE_QUEUE_DEPTH = telemetry.gauge(
+    "tz_pipeline_assemble_queue_depth",
+    "assembly shards queued for the worker pool")
+_M_ASSEMBLE_POOL_SIZE = telemetry.gauge(
+    "tz_pipeline_assemble_pool_size",
+    "assembler threads serving the pipeline")
 
 
 class ExecMutant:
     """A device-produced mutant: exec bytes now, typed program on
-    demand (only triage/logging ever needs the tree).  Holds a view
-    into its DeltaBatch; the full tensor row is rebuilt from template
-    + delta only when prog() is called.
+    demand (only triage/logging ever needs the tree).  exec_bytes is
+    bytes-like — on the fast path a zero-copy (offset, length)
+    memoryview into its batch's output arena (ops/emit), which the
+    IPC layer writes straight into the executor's shmem; the view
+    pins the arena, so batch memory lives exactly as long as its last
+    undelivered mutant.  Holds a view into its DeltaBatch; the full
+    tensor row is rebuilt from template + delta only when prog() is
+    called.
 
     Insert-class mutants additionally carry the donor block and the
     alive-call boundary it was spliced at (ops/insert.py)."""
@@ -122,7 +164,7 @@ class ExecMutant:
     __slots__ = ("exec_bytes", "template", "et", "batch", "j",
                  "donor", "donor_pos", "_anys", "_prog")
 
-    def __init__(self, exec_bytes: bytes, template: ProgTensor,
+    def __init__(self, exec_bytes, template: ProgTensor,
                  et: ExecTemplate, batch: DeltaBatch, j: int,
                  donor=None, donor_pos: int = 0):
         self.exec_bytes = exec_bytes
@@ -204,6 +246,119 @@ class PipelineStats:
     inserts: int = 0  # insert-class mutants produced
     worker_errors: int = 0  # device failures survived by the worker
     delivery_errors: int = 0  # batches dropped at the queue.put seam
+    async_copy_fallbacks: int = 0  # copy_to_host_async not available
+    d2h_bytes: int = 0  # compacted bytes fetched device->host
+    d2h_batches: int = 0  # batches those bytes cover
+
+
+class AssembledBatch(list):
+    """One drained batch of ExecMutants.  A plain list to consumers;
+    additionally carries the drain sequence number so delivery
+    ordering across the assembly pool is observable (tests, and the
+    bench's supply-ordering assertions)."""
+
+    __slots__ = ("seq",)
+
+    def __init__(self, mutants=(), seq: int = -1):
+        super().__init__(mutants)
+        self.seq = seq
+
+
+class _AssemblyTask:
+    """One unit of pool work: a callable + its eventual result."""
+
+    __slots__ = ("fn", "args", "result", "error", "done")
+
+    def __init__(self, fn, args):
+        self.fn = fn
+        self.args = args
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+    def run(self) -> None:
+        try:
+            self.result = self.fn(*self.args)
+        except BaseException as e:  # delivered to the waiter
+            self.error = e
+        self.done.set()
+
+    def wait(self, stop: Optional[threading.Event] = None) -> bool:
+        """Block until the task ran (True) or `stop` fired first
+        (False).  Re-raises the task's exception on completion."""
+        if stop is None:
+            self.done.wait()
+        else:
+            while not self.done.wait(timeout=0.2):
+                if stop.is_set():
+                    return False
+        if self.error is not None:
+            raise self.error
+        return True
+
+
+class AssemblyPool:
+    """N daemon assembler threads draining a shared task queue.
+
+    workers=0 (or a stopped pool) runs every submit inline in the
+    caller — the deterministic single-thread mode tests and the
+    post-shutdown bench path rely on.  Threads spawn lazily on first
+    submit so constructing a pipeline stays thread-free."""
+
+    def __init__(self, workers: int, name: str = "tz-assemble"):
+        self.workers = max(0, workers)
+        self.name = name
+        self._tasks: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        _M_ASSEMBLE_POOL_SIZE.set(self.workers)
+
+    def submit(self, fn, *args) -> _AssemblyTask:
+        task = _AssemblyTask(fn, args)
+        if self.workers == 0 or self._stop.is_set():
+            task.run()
+            return task
+        if not self._threads:
+            with self._lock:
+                if not self._threads and not self._stop.is_set():
+                    for i in range(self.workers):
+                        t = threading.Thread(
+                            target=self._worker_loop, daemon=True,
+                            name=f"{self.name}-{i}")
+                        self._threads.append(t)
+                        t.start()
+        self._tasks.put(task)
+        _M_ASSEMBLE_QUEUE_DEPTH.set(self._tasks.qsize())
+        return task
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                task = self._tasks.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            _M_ASSEMBLE_QUEUE_DEPTH.set(self._tasks.qsize())
+            with telemetry.span("pipeline.assemble_worker"):
+                task.run()
+
+    def queue_depth(self) -> int:
+        return self._tasks.qsize()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            threads, self._threads = self._threads, []
+        for t in threads:
+            t.join(timeout=10)
+        # Orphaned tasks would strand a waiter forever; run them
+        # inline (stop() is called from the owner after the worker
+        # loop exits, so nothing races these results).
+        try:
+            while True:
+                self._tasks.get_nowait().run()
+        except queue.Empty:
+            pass
 
 
 # Lean device shapes for the pipeline: mutation cost is dominated by
@@ -229,7 +384,9 @@ class DevicePipeline:
                  capacity: int = 2048, batch_size: int = 2048,
                  rounds: int = 4, seed: int = 0, prefetch: int = 2,
                  spec: Optional[DeltaSpec] = None, ct=None,
-                 max_insert_calls: int = 30, dispatch_depth: int = 2):
+                 max_insert_calls: int = 30, dispatch_depth: int = 2,
+                 assemble_workers: Optional[int] = None,
+                 assemble_depth: int = 2):
         import jax
         import jax.numpy as jnp
         from jax import random
@@ -276,7 +433,7 @@ class DevicePipeline:
 
         B, R = batch_size, rounds
         pack = make_packer(self.spec)
-        pool = make_pooler(self.spec, B)
+        pool = make_compact_pooler(self.spec, B)
         p_insert = P_INSERT_GIVEN_DEVICE if n_blocks > 0 else 0.0
         runs = self._runs_dev
         by_syscall = self._by_syscall_dev
@@ -346,6 +503,38 @@ class DevicePipeline:
         # falls back to the constructor argument (health.envsafe).
         self._dispatch_depth = max(1, env_int(
             "TZ_PIPELINE_DISPATCH_DEPTH", dispatch_depth))
+        # Host assembly runs on a pool of TZ_ASSEMBLE_WORKERS threads,
+        # template-group sharded so a group's vectorized patch pass is
+        # never split.  0 = assemble inline in the drain thread (the
+        # pre-pool single-thread behavior).  The default never spawns
+        # more assembler threads than spare cores — on a single-core
+        # host the pool only adds context switches under the GIL.
+        # assemble_depth bounds how many drained batches may sit in
+        # assembly at once — together with the prefetch queue cap this
+        # is the backpressure chain:
+        # procs <- prefetch queue <- assembling deque <- drain.
+        if assemble_workers is None:
+            import os
+
+            assemble_workers = min(2, max(0, (os.cpu_count() or 1) - 1))
+        self._assemble_workers = max(0, env_int(
+            "TZ_ASSEMBLE_WORKERS", assemble_workers))
+        self._assemble_depth = max(1, assemble_depth)
+        self._pool = AssemblyPool(self._assemble_workers)
+        self._seq = 0  # drain sequence: AssembledBatch.seq values
+        # Pre-rebased flat donor tables keyed by a template's copyout
+        # count (emit.build_donor_table): the insert splicer gathers
+        # donor words from these instead of rebasing per mutant.
+        # Bounded: at most MAX_COPYOUT+1 distinct bases; racing pool
+        # threads may build one twice, harmlessly.
+        self._donor_tables: dict = {}
+        # Stacked template table (emit.TemplateTable) for the one-pass
+        # batch assembler, cached per exec-template snapshot content
+        # (adds/evictions invalidate; steady-state batches reuse), and
+        # the flattened donor bank for the one-pass insert splicer.
+        self._table_key: Optional[tuple] = None
+        self._table: Optional[TemplateTable] = None
+        self._dbank_table: Optional[DonorBankTable] = None
         # Self-healing runtime (syzkaller_tpu/health, docs/health.md):
         # the breaker paces recovery after device failures (closed →
         # open → half-open probe with host-snapshot rebuild → closed)
@@ -392,6 +581,8 @@ class DevicePipeline:
             "watchdog": self.watchdog.snapshot(),
             "worker_errors": self.stats.worker_errors,
             "delivery_errors": self.stats.delivery_errors,
+            "assemble_workers": self._assemble_workers,
+            "assemble_queue_depth": self._pool.queue_depth(),
         }
 
     # -- corpus management -------------------------------------------------
@@ -512,8 +703,6 @@ class DevicePipeline:
         # converted into DeviceWedged by the watchdog instead of
         # hanging the worker forever (BENCH_WEDGE_DIAGNOSIS.md).
         op = "device.launch" if self._compiled else "device.compile"
-        deadline = (self.watchdog.deadline_s if self._compiled
-                    else self.watchdog.compile_deadline_s)
 
         def dispatch():
             fault_point(op)
@@ -522,43 +711,82 @@ class DevicePipeline:
         # Spans time the host-observed dispatch (XLA returns async:
         # steady-state launch is enqueue cost; the blocking transfer
         # is timed separately by pipeline.drain).  Literal span names
-        # at each site keep tools/lint_metrics.py's grep exact.
+        # at each site keep tools/lint_metrics.py's grep exact.  The
+        # deadline stays DYNAMIC (no deadline_s pin): a knob tightened
+        # mid-dispatch applies to the call already in flight.
         if self._compiled:
             with telemetry.span("pipeline.launch"):
-                rows_dev = self.watchdog.call(dispatch, op,
-                                              deadline_s=deadline)
+                result = self.watchdog.call(dispatch, op)
         else:
             with telemetry.span("pipeline.compile"):
-                rows_dev = self.watchdog.call(dispatch, op,
-                                              deadline_s=deadline)
+                result = self.watchdog.call(dispatch, op, compile=True)
         self._compiled = True
-        # Start the device->host copy now: the tunneled link has a
+        rows_dev, pool_dev, n_used_dev = result
+        # Start the device->host copies now: the tunneled link has a
         # ~70 ms per-sync fixed cost that fully hides behind the next
         # batch's compute (the worker dispatches N+1 before draining N).
-        try:
-            rows_dev.copy_to_host_async()
-        except Exception:
-            pass  # CPU arrays in tests have no async path
-        return rows_dev, tmpl, ets
+        # The pool cannot start yet — its transfer bucket depends on
+        # the used-slot count — but rows + count cover the bulk.  An
+        # array without an async path (CPU tests, older plugins) falls
+        # back to the synchronous drain, counted instead of swallowed
+        # silently.
+        for arr in (rows_dev, n_used_dev):
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                self.stats.async_copy_fallbacks += 1
+                _M_ASYNC_COPY_FALLBACKS.inc()
+        return (rows_dev, pool_dev, n_used_dev), tmpl, ets
 
-    def _drain(self, launched) -> list[ExecMutant]:
-        rows_dev, tmpl, ets = launched
-        # The one device->host transfer — the blocking sync where a
-        # wedged tunnel stalls, so it runs under the watchdog too.
+    def _fetch(self, launched):
+        """The device->host transfers for one launched batch: the full
+        delta rows + used-slot count (pipeline.drain), then only the
+        pow2-bucketed prefix of the payload pool the batch actually
+        claimed (pipeline.pool_drain) — the compacted D2H.  Blocking
+        syncs where a wedged tunnel stalls, so both run under the
+        watchdog.  Returns (DeltaBatch, template snapshot,
+        exec-template snapshot)."""
+        (rows_dev, pool_dev, n_used_dev), tmpl, ets = launched
         with telemetry.span("pipeline.drain"):
-            buf = self.watchdog.call(lambda: np.asarray(rows_dev),
-                                     "device.drain")
-        # Host assembly + triage-merge bookkeeping, timed separately
-        # from the transfer so a slow link and a slow assembler are
-        # distinguishable in the phase percentiles.
+            rows = self.watchdog.call(lambda: np.asarray(rows_dev),
+                                      "device.drain")
+            n_used = int(self.watchdog.call(
+                lambda: np.asarray(n_used_dev), "device.drain"))
+        with telemetry.span("pipeline.pool_drain"):
+            bucket = pool_bucket(
+                n_used, self.spec.pool_slots(self.batch_size))
+            if bucket:
+                pool = self.watchdog.call(
+                    lambda: np.asarray(pool_dev[:bucket]), "device.drain")
+            else:
+                pool = np.zeros((0, self.spec.P), np.uint8)
+        nbytes = rows.nbytes + pool.nbytes + np.asarray(n_used_dev).nbytes
+        self.stats.d2h_bytes += nbytes
+        self.stats.d2h_batches += 1
+        _M_D2H_BYTES.inc(nbytes)
+        _M_D2H_BATCH_BYTES.set(nbytes)
+        return DeltaBatch(rows, self.spec, pool=pool), tmpl, ets
+
+    def _drain(self, launched) -> "AssembledBatch":
+        """Fetch + assemble one launched batch synchronously (tests
+        and the bench's standalone assembly measurements; the worker
+        loop overlaps the same stages instead)."""
+        batch, tmpl, ets = self._fetch(launched)
+        return self._assemble(batch, tmpl, ets)
+
+    def _assemble(self, batch: DeltaBatch, tmpl, ets) -> "AssembledBatch":
         with telemetry.span("pipeline.assemble"):
-            return self._assemble(buf, tmpl, ets)
+            return self._collect(self._submit_assembly((batch, tmpl, ets)))
 
-    def _assemble(self, buf, tmpl, ets) -> list[ExecMutant]:
-        from syzkaller_tpu.ops.delta import OP_INSERT
-        from syzkaller_tpu.ops.emit import splice_insert
-
-        batch = DeltaBatch(buf, self.spec, self.batch_size)
+    def _submit_assembly(self, fetched):
+        """Fan one fetched batch out over the assembly pool: mutate
+        rows are template-group sharded (groups never split — the
+        vectorized patch pass amortizes per group), insert rows are
+        one splice task.  Returns the pending handle _collect turns
+        into an AssembledBatch."""
+        batch, tmpl, ets = fetched
+        seq = self._seq
+        self._seq += 1
         ok = (batch.flags & FLAG_OVERFLOW) == 0
         overflows = int(np.count_nonzero(~ok))
         self.stats.overflows += overflows
@@ -567,43 +795,144 @@ class DevicePipeline:
         ok &= (batch.template_idx >= 0) & (batch.template_idx < len(tmpl))
         is_ins = batch.op == OP_INSERT
         js = np.flatnonzero(ok & ~is_ins)
-        datas = assemble_batch(ets, batch, js)
-        out: list[ExecMutant] = []
-        for j, data in zip(js, datas):
-            if data is None:
-                self.stats.assemble_errors += 1
-                _M_ASSEMBLE_ERRORS.inc()
-                continue
-            i = int(batch.template_idx[j])
-            t = tmpl[i]
-            if t is None:
-                continue
-            out.append(ExecMutant(data, t, ets[i], batch, int(j)))
-        # Insert mutants: pristine template segments + donor splice
-        # (no patches to apply — zero-copy concat per mutant).
-        for j in np.flatnonzero(ok & is_ins):
-            i = int(batch.template_idx[j])
-            t = tmpl[i]
-            et = ets[i]
-            d_idx = int(batch.donor[j])
-            if t is None or et is None \
-                    or not (0 <= d_idx < len(self.bank.blocks)):
-                continue
-            block = self.bank.blocks[d_idx]
-            alive = batch.call_alive(j, max(et.ncalls, 1))
-            data = splice_insert(et, alive, block, int(batch.pos[j]))
-            if data is None:
-                self.stats.assemble_errors += 1
-                _M_ASSEMBLE_ERRORS.inc()
-                continue
-            out.append(ExecMutant(data, t, et, batch, int(j),
-                                  donor=block, donor_pos=int(batch.pos[j])))
-            self.stats.inserts += 1
+        table = self._template_table(ets)
+        shards = shard_by_template(batch.template_idx, js,
+                                   max(1, self._assemble_workers))
+        tasks = [(s, self._pool.submit(assemble_batch_table, table,
+                                       batch, s))
+                 for s in shards]
+        ins = np.flatnonzero(ok & is_ins)
+        ins_task = None
+        if ins.size:
+            if self._dbank_table is None:
+                self._dbank_table = DonorBankTable(self.bank.blocks)
+            ins_task = self._pool.submit(
+                self._splice_inserts, batch, tmpl, ets, ins, table)
+        return seq, batch, tmpl, ets, tasks, ins_task
+
+    def _template_table(self, ets) -> TemplateTable:
+        """Stacked assembly tables for this snapshot (cached: the
+        tables only change when the template set does, so steady-state
+        batches pay one id-tuple comparison)."""
+        key = tuple(map(id, ets))
+        if self._table_key != key:
+            self._table = TemplateTable(ets)
+            self._table_key = key
+        return self._table
+
+    def _collect(self, pending_batch) -> "AssembledBatch":
+        """Join one batch's assembly shards into delivery order.  The
+        per-shard lists stay js-aligned, so recombining loses nothing;
+        stats run here (the drain thread) so they stay single-writer."""
+        seq, batch, tmpl, ets, tasks, ins_task = pending_batch
+        out = AssembledBatch(seq=seq)
+        for s, task in tasks:
+            if not task.wait(self._stop):
+                return out  # shutting down; partial batch is discarded
+            # tolist() up front: per-row numpy scalar conversions in
+            # this loop were a measurable slice of the assemble stage.
+            for j, i, data in zip(s.tolist(),
+                                  batch.template_idx[s].tolist(),
+                                  task.result):
+                if data is None:
+                    self.stats.assemble_errors += 1
+                    _M_ASSEMBLE_ERRORS.inc()
+                    continue
+                t = tmpl[i]
+                if t is None:
+                    continue
+                out.append(ExecMutant(data, t, ets[i], batch, j))
+        if ins_task is not None:
+            if not ins_task.wait(self._stop):
+                return out
+            mutants, errors = ins_task.result
+            out.extend(mutants)
+            self.stats.inserts += len(mutants)
+            if errors:
+                self.stats.assemble_errors += errors
+                _M_ASSEMBLE_ERRORS.inc(errors)
         self.stats.batches += 1
         self.stats.mutants += len(out)
         _M_BATCHES.inc()
         _M_MUTANTS.inc(len(out))
         return out
+
+    def _splice_inserts(self, batch: DeltaBatch, tmpl, ets,
+                        ins: np.ndarray, table=None):
+        """Insert mutants: pristine template segments + donor splice.
+        The one-pass splicer (emit.splice_batch_table) handles every
+        tiled fully-alive row across ALL templates in four global
+        ragged operations; the remainder (dead calls, budget
+        overflows) goes through the per-template-group splicer.  Runs
+        as one pool task; returns (mutants, error count)."""
+        out: list[ExecMutant] = []
+        errors = 0
+        blocks = self.bank.blocks
+        ins = np.asarray(ins, dtype=np.int64)
+        if table is not None and self._dbank_table is not None:
+            try:
+                datas, fast = splice_batch_table(
+                    table, self._dbank_table, batch, ins)
+            except Exception:
+                datas, fast = [None] * len(ins), np.zeros(len(ins), bool)
+            fidx = np.flatnonzero(fast)
+            fj = ins[fidx]
+            for idx, j, i, dn, po in zip(
+                    fidx.tolist(), fj.tolist(),
+                    batch.template_idx[fj].tolist(),
+                    batch.donor[fj].tolist(), batch.pos[fj].tolist()):
+                out.append(ExecMutant(datas[idx], tmpl[i], ets[i],
+                                      batch, j, donor=blocks[dn],
+                                      donor_pos=po))
+            ins = ins[~fast]
+            if not ins.size:
+                return out, errors
+        donors = batch.donor[ins]
+        d_ok = (donors >= 0) & (donors < len(blocks))
+        tidx = batch.template_idx[ins]
+        order = np.argsort(tidx, kind="stable")
+        bounds = np.flatnonzero(np.diff(tidx[order])) + 1
+        for grp in np.split(order, bounds):
+            ti = int(tidx[grp[0]])
+            t = tmpl[ti] if 0 <= ti < len(tmpl) else None
+            et = ets[ti] if 0 <= ti < len(ets) else None
+            if t is None or et is None:
+                continue
+            sel = grp[d_ok[grp]]
+            if not sel.size:
+                continue
+            rows = ins[sel]
+            table = self._donor_tables.get(et.ncopyouts)
+            if table is None:
+                from syzkaller_tpu.ops.emit import build_donor_table
+
+                table = build_donor_table(et.ncopyouts, blocks)
+                self._donor_tables[et.ncopyouts] = table
+            try:
+                datas = splice_insert_group(
+                    et, batch.alive_bits[rows], donors[sel],
+                    batch.pos[rows], blocks, table)
+            except Exception:
+                # Degrade to the per-mutant splice so one bad row
+                # cannot sink its template group.
+                datas = []
+                for j in rows:
+                    try:
+                        datas.append(splice_insert(
+                            et, batch.call_alive(j, max(et.ncalls, 1)),
+                            blocks[int(batch.donor[j])],
+                            int(batch.pos[j])))
+                    except Exception:
+                        datas.append(None)
+            for j, data in zip(rows, datas):
+                if data is None:
+                    errors += 1
+                    continue
+                out.append(ExecMutant(
+                    data, t, et, batch, int(j),
+                    donor=blocks[int(batch.donor[j])],
+                    donor_pos=int(batch.pos[j])))
+        return out, errors
 
     def _reset_device_state(self) -> None:
         """Drop device buffers and re-stage every live template from
@@ -626,7 +955,8 @@ class DevicePipeline:
         from syzkaller_tpu.health.breaker import HALF_OPEN
         from syzkaller_tpu.utils import log
 
-        pending: deque = deque()
+        pending: deque = deque()  # launched, not yet drained
+        assembling: deque = deque()  # drained, fanned out on the pool
         while not self._stop.is_set():
             if not self._have_corpus.wait(timeout=0.2):
                 continue
@@ -662,22 +992,33 @@ class DevicePipeline:
                              self.breaker.counters.half_opens)
                     self._reset_device_state()
                 # Keep `dispatch_depth` batches in flight before
-                # draining the oldest, so device compute, d2h
-                # transfer, and host assembly overlap as independent
-                # pipeline stages.  A probe window flies a single
-                # batch: the point is a cheap health verdict, not
-                # throughput.
+                # draining the oldest, and `assemble_depth` drained
+                # batches fanned out over the assembly pool before
+                # joining the oldest — device compute, d2h transfer,
+                # and host assembly overlap as independent pipeline
+                # stages, and assembly itself runs template-group
+                # sharded across the pool.  A probe window flies a
+                # single batch end to end: the point is a cheap health
+                # verdict, not throughput.
                 depth = 1 if probing else self._dispatch_depth
+                a_depth = 1 if probing else self._assemble_depth
                 while len(pending) < depth and not self._stop.is_set():
                     launched = self._launch()
                     if launched is None:
                         break
                     pending.append(launched)
-                if not pending:
+                if pending:
+                    fetched = self._fetch(pending.popleft())
+                    assembling.append(self._submit_assembly(fetched))
+                if not assembling:
                     continue
-                batch = self._drain(pending.popleft())
+                if len(assembling) < a_depth and pending:
+                    continue  # keep draining while the pool chews
+                with telemetry.span("pipeline.assemble"):
+                    batch = self._collect(assembling.popleft())
             except Exception as e:
                 pending.clear()
+                assembling.clear()
                 self.stats.worker_errors += 1
                 _M_WORKER_ERRORS.inc()
                 state = self.breaker.record_failure()
@@ -687,6 +1028,8 @@ class DevicePipeline:
                          self.breaker.seconds_until_probe(),
                          str(e)[:200])
                 continue
+            if self._stop.is_set():
+                return
             self.breaker.record_success()
             try:
                 # The delivery seam (one invocation per produced
@@ -730,9 +1073,12 @@ class DevicePipeline:
             except queue.Empty:
                 pass
             self._worker.join(timeout=30)
+        self._pool.stop()
 
-    def next_batch(self, timeout: Optional[float] = None) -> list[ExecMutant]:
-        """One assembled batch (blocks until the worker produces one,
+    def next_batch(self,
+                   timeout: Optional[float] = None) -> "AssembledBatch":
+        """One assembled batch — a list of ExecMutants carrying its
+        drain sequence number (blocks until the worker produces one,
         the timeout expires, or the pipeline is stopped — the last two
         raise queue.Empty)."""
         self.start()

@@ -119,6 +119,25 @@ def wedge_report(snap: dict) -> list[str]:
             f"{k[len('tz_breaker_'):-len('_total')]}={int(v)}"
             for k, v in trans.items()))
     gauges = snap.get("gauges") or {}
+    # Drain->assemble stage health (the perf-PR sub-metrics): the
+    # compacted transfer cost per batch, the assembly pool's shape,
+    # and the realized host-assembly rate derived from the assemble
+    # span — an A/B between snapshots shows where a regression sits.
+    d2h = gauges.get("tz_pipeline_d2h_batch_bytes") or 0
+    if d2h:
+        lines.append(f"d2h per batch: {d2h / 1024:.1f} KiB (compacted)")
+    pool_size = gauges.get("tz_pipeline_assemble_pool_size") or 0
+    if pool_size:
+        depth = gauges.get("tz_pipeline_assemble_queue_depth") or 0
+        lines.append(f"assembly pool: {int(pool_size)} workers, "
+                     f"queue depth {int(depth)}")
+    asm = (snap.get("histograms") or {}).get(
+        "tz_pipeline_assemble_seconds") or {}
+    mutants = counters.get("tz_pipeline_mutants_total") or 0
+    if asm.get("sum") and mutants:
+        lines.append(
+            f"host assembly: {mutants / asm['sum']:.0f} mutants/s "
+            f"over {asm['count']} batches")
     last_wedge = gauges.get("tz_watchdog_last_wedge_ts") or 0
     if last_wedge:
         age = max(0.0, (snap.get("ts") or time.time()) - last_wedge)

@@ -98,6 +98,68 @@ def test_delta_matches_dense_assembly(test_target, iters):
     assert checked >= iters
 
 
+def test_compact_pooler_matches_flat_layout(test_target):
+    """Compacted D2H (ISSUE 3): make_compact_pooler's separate
+    rows/pool/used-count must describe the same batch as make_pooler's
+    flat rows++pool buffer, and the bucketed pool prefix alone must
+    reconstruct an identical DeltaBatch."""
+    from syzkaller_tpu.ops.delta import make_compact_pooler, pool_bucket
+
+    cfg = TensorConfig(max_slots=128, arena=2048, max_blob=768)
+    flags = FlagTables.empty()
+    spec = DeltaSpec()
+    tensors = _encode_some(test_target, 4, cfg, flags, seed0=900)
+    pack = make_packer(spec)
+    B = 4
+    flat_pool = make_pooler(spec, B)
+    compact_pool = make_compact_pooler(spec, B)
+    fv, fc = jnp.asarray(flags.vals), jnp.asarray(flags.counts)
+
+    def both(states, keys, tidx):
+        rows, payloads, needs = jax.vmap(
+            lambda st, k, i: pack(_mutate_one(st, k, fv, fc, 4), i)
+        )(states, keys, tidx)
+        return flat_pool(rows, payloads, needs), \
+            compact_pool(rows, payloads, needs)
+
+    fn = jax.jit(both)
+    states = {k: jnp.stack([jnp.asarray(t.arrays()[k]) for t in tensors])
+              for k in tensors[0].arrays()}
+    for seed in (0, 1, 2):
+        keys = random.split(random.key(seed), B)
+        tidx = jnp.arange(B, dtype=jnp.int32)
+        flat, (rows, pool, n_used) = fn(states, keys, tidx)
+        flat = np.asarray(flat)
+        rows, pool = np.asarray(rows), np.asarray(pool)
+        n_used = int(n_used)
+        ref = DeltaBatch(flat, spec, B)
+        # Full-pool equivalence.
+        np.testing.assert_array_equal(ref.buf, rows)
+        np.testing.assert_array_equal(ref._pool, pool)
+        # The bucketed prefix covers every claimed slot, so the
+        # compacted batch reads identically everywhere.
+        assert n_used == int(np.count_nonzero(ref.pool_idx >= 0))
+        bucket = pool_bucket(n_used, spec.pool_slots(B))
+        assert (ref.pool_idx < bucket).all()
+        got = DeltaBatch(rows, spec, pool=pool[:bucket])
+        np.testing.assert_array_equal(got.payload, ref.payload)
+        np.testing.assert_array_equal(got.template_idx, ref.template_idx)
+        np.testing.assert_array_equal(got.vals, ref.vals)
+
+
+def test_pool_bucket_is_pow2_and_bounded():
+    from syzkaller_tpu.ops.delta import pool_bucket
+
+    assert pool_bucket(0, 256) == 0
+    assert pool_bucket(1, 256) == 1
+    assert pool_bucket(3, 256) == 4
+    assert pool_bucket(129, 256) == 256
+    assert pool_bucket(999, 256) == 256  # clamped to the pool
+    for n in range(1, 300):
+        b = pool_bucket(n, 256)
+        assert b & (b - 1) == 0 and b >= min(n, 256)
+
+
 def test_delta_template_index_roundtrip(test_target):
     cfg = TensorConfig(max_slots=128, arena=2048, max_blob=768)
     flags = FlagTables.empty()

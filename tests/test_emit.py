@@ -128,6 +128,255 @@ def test_parse_stream_rejects_garbage():
         parse_stream(b"\x07\x00\x00\x00\x00\x00\x00\x00" * 3)
 
 
+def _synth_delta_batch(ets, spec, B, rng):
+    """Randomized DeltaBatch straight in numpy — no device, no jit:
+    rows reference real templates in ring-wrap interleaved order,
+    carry random value patches (incl. PROC default/concrete forms),
+    random data spans with pooled payloads (some rows pool-less),
+    dead-call alive masks, and a sprinkle of overflow-flagged rows."""
+    from syzkaller_tpu.ops.delta import (
+        FLAG_OVERFLOW, FLAG_PRESERVE, DeltaBatch)
+
+    K, D, P = spec.K, spec.D, spec.P
+    buf = np.zeros((B, spec.row_bytes), np.uint8)
+    npool = max(1, B // spec.pool_div)
+    pool = rng.randint(0, 256, size=(npool, P)).astype(np.uint8)
+    hdr_i32 = lambda col, v: v.astype("<i4").view(np.uint8)  # noqa: E731
+
+    tidx = rng.randint(0, len(ets), size=B).astype(np.int32)
+    for j in range(B):
+        et = ets[tidx[j]]
+        # value patches: sample real patchable slots (value + PROC)
+        # without replacement, plus -1 padding.
+        cand = np.concatenate([et.value_slots, et.proc_slots])
+        nv = rng.randint(0, min(K, max(len(cand), 1)) + 1)
+        val_idx = np.full(K, -1, np.int16)
+        vals = np.zeros(K, np.uint64)
+        if nv and len(cand):
+            picks = rng.choice(cand, size=min(nv, len(cand)),
+                               replace=False)
+            nv = len(picks)
+            val_idx[:nv] = picks
+            raw = rng.randint(0, 1 << 62, size=nv).astype(np.uint64)
+            for i, s in enumerate(picks):
+                if et.is_proc[s] and rng.rand() < 0.5:
+                    raw[i] = np.uint64(0xFFFFFFFFFFFFFFFF)  # default
+            vals[:nv] = raw
+        else:
+            nv = 0
+        # data spans: real DATA slots, lens occasionally over cap
+        # (clamp path), 8-aligned pool offsets that stay in the slot.
+        data_slot = np.full(D, -1, np.int16)
+        data_len = np.zeros(D, np.int32)
+        data_off = np.zeros(D, np.int32)
+        nd = rng.randint(0, min(D, max(len(et.data_slots), 1)) + 1) \
+            if len(et.data_slots) else 0
+        off = 0
+        kept = 0
+        for s in (rng.choice(et.data_slots, size=nd, replace=False)
+                  if nd else ()):
+            cap = int(et.data_cap[s])
+            ln = rng.randint(0, cap + 3)  # may exceed cap: clamps
+            if off + min(ln, cap) > P:
+                break
+            data_slot[kept] = s
+            data_len[kept] = ln
+            data_off[kept] = off
+            off += (min(ln, cap) + 7) & ~7
+            kept += 1
+        nd = kept
+        pool_idx = -1
+        if nd and rng.rand() < 0.8:
+            pool_idx = int(rng.randint(0, npool))
+        # alive mask: mostly full, sometimes dead calls (even all-dead)
+        alive = np.uint64((1 << max(et.ncalls, 1)) - 1)
+        if rng.rand() < 0.4 and et.ncalls > 0:
+            alive &= np.uint64(rng.randint(0, 1 << et.ncalls))
+        flags = 0
+        if rng.rand() < 0.1:
+            flags |= FLAG_OVERFLOW
+        if rng.rand() < 0.3:
+            flags |= FLAG_PRESERVE
+        buf[j, 0] = nv
+        buf[j, 1] = nd
+        buf[j, 2] = flags
+        buf[j, 3] = 0
+        buf[j, 4:8] = hdr_i32(4, np.array([tidx[j]]))
+        buf[j, 8:16] = np.array([alive], "<u8").view(np.uint8)
+        buf[j, 16:20] = hdr_i32(16, np.array([-1]))
+        buf[j, 20] = 0
+        buf[j, 24:28] = hdr_i32(24, np.array([pool_idx]))
+        o = spec.o_val_idx
+        buf[j, o:o + 2 * K] = val_idx.astype("<i2").view(np.uint8)
+        o = spec.o_vals
+        buf[j, o:o + 8 * K] = vals.astype("<u8").view(np.uint8)
+        o = spec.o_data_slot
+        buf[j, o:o + 2 * D] = data_slot.astype("<i2").view(np.uint8)
+        o = spec.o_data_len
+        buf[j, o:o + 4 * D] = data_len.astype("<i4").view(np.uint8)
+        o = spec.o_data_off
+        buf[j, o:o + 4 * D] = data_off.astype("<i4").view(np.uint8)
+    return DeltaBatch(buf, spec, pool=pool)
+
+
+def test_vectorized_arena_matches_delta_reference(test_target, iters):
+    """ISSUE 3 regression: the vectorized arena fast path is
+    byte-identical to the per-mutant assemble_delta reference on
+    randomized DeltaBatches — ring-wrap template interleaving,
+    overflow rows, dead-call (and all-dead) slicing, over-cap lengths,
+    pool-less payload rows.  Pure numpy, no device step, no compiles
+    (the suite runs at its wall-clock budget)."""
+    from syzkaller_tpu.ops.delta import FLAG_OVERFLOW, DeltaSpec
+    from syzkaller_tpu.ops.emit import (
+        TemplateTable, assemble_batch, assemble_batch_table,
+        assemble_delta)
+
+    cfg = TensorConfig()
+    flags = FlagTables.empty()
+    tensors = _encode_some(test_target, 6, cfg, flags, seed0=700)
+    ets = [build_exec_template(t) for t in tensors] + [None]  # dead slot
+    table = TemplateTable(ets)
+    spec = DeltaSpec()
+    rng = np.random.RandomState(1234)
+    seen_view = seen_dead = seen_poolless = 0
+    for _ in range(max(3, iters // 10)):
+        batch = _synth_delta_batch(ets[:-1], spec, 48, rng)
+        ok = (batch.flags & FLAG_OVERFLOW) == 0
+        js = np.flatnonzero(ok)
+        datas = assemble_batch(ets, batch, js)
+        # The one-pass stacked-table assembler agrees with the
+        # per-group path entry by entry (both bytes-like or both None).
+        tdatas = assemble_batch_table(table, batch, js)
+        assert len(tdatas) == len(datas)
+        for a, b in zip(datas, tdatas):
+            if a is None:
+                assert b is None
+            else:
+                assert b is not None and bytes(a) == bytes(b)
+        for j, got in zip(js, datas):
+            et = ets[int(batch.template_idx[j])]
+            try:
+                want = assemble_delta(et, batch, int(j))
+            except Exception:
+                want = None
+            if want is None:
+                assert got is None
+                continue
+            assert got is not None and bytes(got) == want, \
+                f"row {j} diverged from the delta oracle"
+            if isinstance(got, memoryview):
+                seen_view += 1
+            full = (1 << max(et.ncalls, 1)) - 1
+            if int(batch.alive_bits[j]) & full != full:
+                seen_dead += 1
+            if batch.ndata[j] and int(batch.pool_idx[j]) < 0:
+                seen_poolless += 1
+    # The interesting paths actually ran.
+    assert seen_view > 0, "fast path never produced arena views"
+    assert seen_dead > 0, "no dead-call slicing exercised"
+    assert seen_poolless > 0, "no pool-less payload row exercised"
+
+
+def test_splice_insert_group_matches_per_mutant(test_target):
+    """The vectorized insert splicer (unique-donor rebase + ragged
+    arena copies) is byte-identical to per-mutant splice_insert across
+    random alive masks, positions (incl. past-the-end clamping), and
+    donors — pure numpy, no device step."""
+    from syzkaller_tpu.models.prio import build_choice_table
+    from syzkaller_tpu.ops.emit import splice_insert, splice_insert_group
+    from syzkaller_tpu.ops.insert import DonorBank
+
+    ct = build_choice_table(test_target)
+    bank = DonorBank(test_target, ct, seed=5)
+    assert len(bank.blocks) > 4
+    cfg = TensorConfig()
+    flags = FlagTables.empty()
+    rng = np.random.RandomState(77)
+    checked = 0
+    for t in _encode_some(test_target, 6, cfg, flags, seed0=820):
+        et = build_exec_template(t)
+        m = 24
+        donors = rng.randint(0, len(bank.blocks), size=m)
+        poses = rng.randint(0, et.ncalls + 3, size=m).astype(np.uint8)
+        full = (1 << max(et.ncalls, 1)) - 1
+        alive_bits = np.where(
+            rng.rand(m) < 0.5, full,
+            rng.randint(0, full + 1, size=m)).astype(np.uint64)
+        datas = splice_insert_group(et, alive_bits, donors, poses,
+                                    bank.blocks)
+        for i in range(m):
+            alive = ((alive_bits[i] >> np.arange(
+                max(et.ncalls, 1), dtype=np.uint64)) & 1).astype(bool)
+            want = splice_insert(et, alive, bank.blocks[int(donors[i])],
+                                 int(poses[i]))
+            got = datas[i]
+            if want is None:
+                assert got is None
+            else:
+                assert got is not None and bytes(got) == want, \
+                    f"insert row {i} diverged from splice_insert"
+            checked += 1
+    assert checked >= 48
+
+
+def test_splice_batch_table_matches_per_mutant(test_target):
+    """The one-pass cross-template splicer handles exactly the
+    tiled/full-alive/budget-ok rows (fast mask), byte-identical to
+    splice_insert; dead-call, invalid-donor, and dead-slot rows are
+    declined for the per-group path."""
+    from syzkaller_tpu.models.prio import build_choice_table
+    from syzkaller_tpu.ops.emit import (
+        DonorBankTable, TemplateTable, splice_insert, splice_batch_table)
+    from syzkaller_tpu.ops.insert import DonorBank
+
+    ct = build_choice_table(test_target)
+    bank = DonorBank(test_target, ct, seed=9)
+    cfg = TensorConfig()
+    flags = FlagTables.empty()
+    tensors = _encode_some(test_target, 5, cfg, flags, seed0=860)
+    ets = [build_exec_template(t) for t in tensors] + [None]
+    table = TemplateTable(ets)
+    dtab = DonorBankTable(bank.blocks)
+    rng = np.random.RandomState(31)
+    m = 64
+
+    class _B:
+        template_idx = rng.randint(0, len(ets), size=m)
+        donor = rng.randint(-1, len(bank.blocks), size=m)
+        pos = rng.randint(0, 8, size=m).astype(np.uint8)
+        alive_bits = np.zeros(m, np.uint64)
+
+    b = _B()
+    for i in range(m):
+        et = ets[b.template_idx[i]]
+        nc = et.ncalls if et is not None else 0
+        full = (1 << max(nc, 1)) - 1
+        b.alive_bits[i] = full if rng.rand() < 0.7 \
+            else rng.randint(0, full + 1)
+    datas, fast = splice_batch_table(table, dtab, b, np.arange(m))
+    n_fast = n_declined = 0
+    for i in range(m):
+        et = ets[b.template_idx[i]]
+        if fast[i]:
+            alive = np.ones(max(et.ncalls, 1), bool)
+            want = splice_insert(et, alive, bank.blocks[int(b.donor[i])],
+                                 int(b.pos[i]))
+            assert want is not None
+            assert bytes(datas[i]) == want, f"row {i} diverged"
+            n_fast += 1
+        else:
+            assert datas[i] is None
+            full = (1 << max(et.ncalls if et else 0, 1)) - 1
+            declined_ok = (et is None or b.donor[i] < 0
+                           or (int(b.alive_bits[i]) & full) != full
+                           or et.ncopyouts + bank.blocks[
+                               int(b.donor[i])].ncopyouts > 256
+                           or not et.seg_tiled)
+            assert declined_ok, f"row {i} wrongly declined"
+            n_declined += 1
+    assert n_fast >= 8 and n_declined >= 4
+
+
 def test_assemble_batch_matches_assemble_delta(test_target):
     """The vectorized group assembler is bit-identical to the
     per-mutant delta assembler over a full device batch."""
@@ -143,9 +392,7 @@ def test_assemble_batch_matches_assemble_delta(test_target):
         if pl.add(p):
             added += 1
     assert added >= 5
-    rows_dev, tmpl, ets = pl._launch()
-    buf = np.asarray(rows_dev)
-    batch = DeltaBatch(buf, pl.spec)
+    batch, tmpl, ets = pl._fetch(pl._launch())
     ok = (batch.flags & FLAG_OVERFLOW) == 0
     ok &= (batch.template_idx >= 0) & (batch.template_idx < len(tmpl))
     js = np.flatnonzero(ok)

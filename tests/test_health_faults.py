@@ -220,6 +220,10 @@ def _build_pipeline(target, n_seeds=8, **kw):
 
     kw.setdefault("capacity", 64)
     kw.setdefault("batch_size", 8)
+    # The pool is explicitly on (the cpu-aware default would disable
+    # it on single-core CI hosts, and the concurrency tests exercise
+    # real pool threads).
+    kw.setdefault("assemble_workers", 2)
     pl = DevicePipeline(target, seed=3, **kw)
     added, i = 0, 0
     while added < n_seeds and i < n_seeds * 6:
@@ -400,6 +404,65 @@ def test_watchdog_detects_hung_launch_in_pipeline(device_rig):
     finally:
         pl.watchdog.deadline_s = saved_deadline
         plan.heal("device.launch")  # release the abandoned thread
+
+
+def test_assembly_pool_ordering_backpressure_under_queue_faults(
+        device_rig):
+    """ISSUE 3 concurrency: with the parallel assembly pool active,
+    scripted queue.put faults drop exactly their batches while
+    delivery stays in strict drain order (AssembledBatch.seq
+    monotonic, gaps only at the dropped batches), nothing deadlocks,
+    production halts at the bounded in-flight budget when nobody
+    drains, and the breaker — what PipelineMutator's demote path
+    watches — records no device failure."""
+    from syzkaller_tpu.fuzzer.proc import PipelineMutator
+
+    _target, pl = device_rig
+    assert pl._assemble_workers >= 2, "assembly pool not active"
+    pm = PipelineMutator(pl, drain_timeout=30.0)
+    drops0 = pl.stats.delivery_errors
+    failures0 = pl.breaker.counters.failures
+    install_plan(FaultPlan.parse("queue.put:fail@2,4"))
+    seqs: list[int] = []
+    parsed = 0
+    deadline = time.time() + 120
+    while (pl.stats.delivery_errors < drops0 + 2 or len(seqs) < 6) \
+            and time.time() < deadline:
+        try:
+            b = pl.next_batch(timeout=0.2)
+        except queue.Empty:
+            continue
+        assert len(b) > 0
+        seqs.append(b.seq)
+        for m in b[:2]:  # recombined shards produce sound streams
+            from syzkaller_tpu.ops.emit import parse_stream
+
+            parse_stream(m.exec_bytes)
+            parsed += 1
+    assert pl.stats.delivery_errors == drops0 + 2, \
+        "scripted delivery faults did not fire exactly twice"
+    assert len(seqs) >= 6, "pipeline deadlocked under delivery faults"
+    assert parsed > 0
+    # Strict drain order across the pool; only the two dropped batches
+    # may be missing from the delivered stream.
+    assert all(a < b for a, b in zip(seqs, seqs[1:])), seqs
+    missing = set(range(seqs[0], seqs[-1] + 1)) - set(seqs)
+    assert len(missing) <= 2, (seqs, missing)
+    # Backpressure: with no consumer, the worker saturates the
+    # prefetch queue + assembling deque and stops producing.
+    time.sleep(0.5)
+    b0 = pl.stats.batches
+    time.sleep(1.0)
+    assert pl.stats.batches - b0 <= \
+        pl._queue.maxsize + pl._assemble_depth + 1, \
+        "production did not halt at the in-flight budget"
+    # The delivery seam is not a device failure: breaker closed, no
+    # failures recorded, mutator stays promoted.
+    assert pl.breaker.counters.failures == failures0
+    assert pl.breaker.state == CLOSED
+    assert pm.healthy()
+    snap = pm.health_snapshot()["pipeline"]
+    assert snap["assemble_workers"] >= 2
 
 
 def test_queue_put_seam_drops_batch_without_tripping_breaker(device_rig):

@@ -30,6 +30,11 @@ def _make_pipeline(target, n_seeds=12, **kw):
 
 
 def test_pipeline_produces_wellformed_mutants(test_target):
+    """Well-formedness + the ISSUE 3 hot-path wiring, on one warm
+    pipeline (the jit compile dominates test wall-clock): compacted
+    D2H never exceeds the uncompacted layout, fast-path mutants carry
+    zero-copy arena views, batches carry monotonic drain sequence
+    numbers."""
     pl = _make_pipeline(test_target)
     try:
         batch = pl.next_batch(timeout=120)
@@ -42,6 +47,19 @@ def test_pipeline_produces_wellformed_mutants(test_target):
             p = m.prog()
             assert len(p.calls) == m.num_calls()
             assert serialize_for_exec(p)  # typed path accepts it
+        b2 = pl.next_batch(timeout=120)
+        assert 0 <= batch.seq < b2.seq
+        # rows + bucketed pool prefix + used-slot count <= flat layout
+        full = pl.spec.batch_bytes(pl.batch_size)
+        assert pl.stats.d2h_batches >= 2
+        assert pl.stats.d2h_bytes / pl.stats.d2h_batches <= full + 4
+        views = sum(isinstance(m.exec_bytes, memoryview)
+                    for m in batch if m.donor is None)
+        assert views >= sum(m.donor is None for m in batch) // 2, \
+            "fast path never produced zero-copy arena views"
+        # Views pin their arena and compare/convert like bytes.
+        for m in batch[:4]:
+            assert bytes(m.exec_bytes) == m.exec_bytes
     finally:
         pl.stop()
 
