@@ -21,6 +21,8 @@ Modes:
   python bench.py --host-assembly  # drain->exec-ready stage only:
                                    # pooled arena path vs single-thread
                                    # per-mutant reference
+  python bench.py --triage         # batched device-plane novelty
+                                   # triage vs the CPU Signal path
 """
 
 from __future__ import annotations
@@ -298,6 +300,97 @@ def bench_host_assembly(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
     }
 
 
+def bench_triage(calls_per_check=512, edges_per_call=64, checks=80,
+                 novel_every=20, seen_edges=1 << 16) -> dict:
+    """Batched device-plane novelty triage vs the CPU reference.
+
+    Replays the SAME synthetic signal stream through two Fuzzers: one
+    with the TriageEngine (staged batches -> padded diff_batch against
+    the device plane -> exact CPU confirm only for flagged calls) and
+    one on the pure-CPU path (per-call Signal.diff_raw under the
+    fuzzer lock — today's shape).  The stream models the production
+    distribution: a pre-merged max_signal of `seen_edges` edges, most
+    checks carrying nothing new, every `novel_every`-th check
+    injecting fresh edges.  `triage_calls_per_sec` /
+    `triage_cpu_calls_per_sec` are the two rates;
+    `triage_plane_hit_rate` is the fraction of calls that needed a
+    CPU confirm (the lock-free fast path is its complement)."""
+    import numpy as np
+
+    from syzkaller_tpu.fuzzer import Fuzzer, WorkQueue
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.signal import Signal
+    from syzkaller_tpu.triage import TriageEngine
+
+    class _Info:
+        __slots__ = ("call_index", "errno", "signal")
+
+        def __init__(self, call_index, signal):
+            self.call_index = call_index
+            self.errno = 0
+            self.signal = signal
+
+    target = get_target("test", "64")
+    rng = np.random.RandomState(11)
+    pool = rng.randint(0, 1 << 32, size=seen_edges, dtype=np.uint32)
+    base = Signal(dict.fromkeys(np.unique(pool).tolist(), 3))
+    fresh_iter = iter(
+        rng.randint(0, 1 << 32, size=checks * 8, dtype=np.uint32)
+        .tolist())
+    stream = []
+    for i in range(checks):
+        infos = []
+        for c in range(calls_per_check):
+            edges = pool[rng.randint(0, seen_edges, size=edges_per_call)]
+            if i % novel_every == 0 and c == 0:
+                edges = edges.copy()
+                edges[:4] = [next(fresh_iter) for _ in range(4)]
+            infos.append(_Info(c, edges))
+        stream.append(infos)
+
+    def prio_fn(_errno, _idx):
+        return 3
+
+    fz_dev = Fuzzer(target, wq=WorkQueue())
+    eng = TriageEngine(batch=calls_per_check, max_edges=edges_per_call)
+    fz_dev.set_triage(eng)
+    fz_cpu = Fuzzer(target, wq=WorkQueue())
+    fz_dev.add_max_signal(base.copy())
+    fz_cpu.add_max_signal(base.copy())
+    # Warmup outside the timed window: the plane upload + the jit
+    # compiles of diff_batch/merge at the pinned (B, E) shape.
+    fz_dev.check_new_signal_fn(prio_fn, stream[0])
+    fz_cpu.check_new_signal_fn(prio_fn, stream[0])
+
+    t0 = time.perf_counter()
+    for infos in stream[1:]:
+        fz_dev.check_new_signal_fn(prio_fn, infos)
+    dev_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for infos in stream[1:]:
+        fz_cpu.check_new_signal_fn(prio_fn, infos)
+    cpu_dt = time.perf_counter() - t0
+    ncalls = (checks - 1) * calls_per_check
+    dev_rate = ncalls / dev_dt if dev_dt else 0.0
+    cpu_rate = ncalls / cpu_dt if cpu_dt else 0.0
+    s = eng.stats
+    checked = s.plane_hits + s.plane_misses
+    return {
+        "triage_calls_per_sec": round(dev_rate, 1),
+        "triage_cpu_calls_per_sec": round(cpu_rate, 1),
+        "triage_speedup_x": round(dev_rate / cpu_rate, 2)
+        if cpu_rate else None,
+        "triage_plane_hit_rate": round(s.plane_hits / checked, 4)
+        if checked else None,
+        "triage_fold_fn_rate_est": round(
+            eng.snapshot()["fold_false_negative_rate"], 6),
+        # Fold false negatives are possible on full 32-bit streams;
+        # report the realized divergence instead of asserting it away.
+        "triage_parity_max_signal": len(fz_dev.max_signal)
+        == len(fz_cpu.max_signal),
+    }
+
+
 def bench_device_kernel(batch_size=512, edges_per_prog=128,
                         steps=20) -> float:
     """The fused mutate+triage kernel alone (device steady state)."""
@@ -424,6 +517,15 @@ def _ab_run(engine_on: bool, seconds: Optional[float] = None,
         mutator = PipelineMutator(pl, drain_timeout=120.0)
         mutator.ops_journal = []  # count device vs CPU-op draws
         mutator._sync_corpus(fuzzer)
+        # The full production device path includes the triage plane
+        # (fuzzer/main.py wires the same); TZ_TRIAGE_DEVICE=0 drops it
+        # for a mutation-engine-only A/B.
+        from syzkaller_tpu.health import env_int
+
+        if env_int("TZ_TRIAGE_DEVICE", 1):
+            from syzkaller_tpu.triage import TriageEngine
+
+            fuzzer.set_triage(TriageEngine.for_pipeline(pl))
         # Warm up compile + caches OUTSIDE the timed window.  The
         # first wait is the pool-lease catch window on the tunneled
         # backend (same contract as bench_pipeline's warmup).
@@ -710,6 +812,16 @@ def main() -> None:
         journal_append(res)
         print(json.dumps(res))
         return
+    if "--triage" in argv:
+        res = {"metric": "triage_calls_per_sec", "unit": "calls/sec",
+               **bench_triage()}
+        res["value"] = res["triage_calls_per_sec"]
+        res["vs_baseline"] = res.get("triage_speedup_x")
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
     batch = int(argv[argv.index("--batch") + 1]) \
         if "--batch" in argv else PIPE_BATCH
     secs = float(argv[argv.index("--seconds") + 1]) \
@@ -735,6 +847,14 @@ def main() -> None:
     except Exception as e:
         assemble_sub = {"host_assemble_error":
                         f"{type(e).__name__}: {e}"[:200]}
+    # Triage sub-bench: the batched novelty pre-filter vs the CPU
+    # Signal path (ISSUE 4); rides the flagship journal entry so the
+    # last_healthy mechanism records it even when later attempts find
+    # the accelerator wedged.
+    try:
+        triage_sub = bench_triage()
+    except Exception as e:
+        triage_sub = {"triage_error": f"{type(e).__name__}: {e}"[:200]}
     cpu_rate = bench_cpu()
     result = {
         "metric": "exec_ready_mutants_per_sec_per_chip",
@@ -749,6 +869,7 @@ def main() -> None:
             "pipeline_batch": batch,
             **pipe_sub,
             **assemble_sub,
+            **triage_sub,
         },
         "note": ("value = integrated corpus-tensor->exec-bytes rate off "
                  "ops/pipeline.DevicePipeline (the path fuzzer/proc.py "

@@ -47,6 +47,10 @@ class Stat(IntEnum):
     DEVICE_BREAKER_OPENS = 15
     DEVICE_REBUILDS = 16
     DEVICE_WEDGES = 17
+    # Triage-engine health (syzkaller_tpu/triage): device-plane
+    # novelty checks demoted to / re-promoted from the CPU path.
+    DEVICE_TRIAGE_DEMOTIONS = 18
+    DEVICE_TRIAGE_REPROMOTIONS = 19
 
 
 STAT_NAMES = {
@@ -68,6 +72,8 @@ STAT_NAMES = {
     Stat.DEVICE_BREAKER_OPENS: "device breaker opens",
     Stat.DEVICE_REBUILDS: "device ring rebuilds",
     Stat.DEVICE_WEDGES: "device wedges",
+    Stat.DEVICE_TRIAGE_DEMOTIONS: "device triage demotions",
+    Stat.DEVICE_TRIAGE_REPROMOTIONS: "device triage repromotions",
 }
 
 
@@ -145,7 +151,8 @@ class Fuzzer:
 
     def __init__(self, target, wq, cfg: Optional[FuzzerConfig] = None,
                  ct: Optional[ChoiceTable] = None, conn=None,
-                 on_crash: Optional[Callable[[str, Optional[Prog]], None]] = None):
+                 on_crash: Optional[Callable[[str, Optional[Prog]], None]] = None,
+                 triage=None):
         from syzkaller_tpu.fuzzer.workqueue import WorkQueue
 
         self.target = target
@@ -162,6 +169,11 @@ class Fuzzer:
         self.ct = ct or build_choice_table(target)
         self.stats = [0] * len(Stat)
         self._exec_total = 0
+        # Optional device-plane novelty pre-filter (duck-typed so this
+        # module stays importable without jax; syzkaller_tpu/triage).
+        self.triage = None
+        if triage is not None:
+            self.set_triage(triage)
 
     # -- stats -----------------------------------------------------------
 
@@ -206,6 +218,14 @@ class Fuzzer:
 
     # -- signal bookkeeping ----------------------------------------------
 
+    def set_triage(self, engine) -> None:
+        """Install the device-plane triage engine as the novelty
+        pre-filter (seeded from the current max_signal); from here on
+        check_new_signal_fn routes through it and max-signal merges
+        scatter into its plane."""
+        engine.attach(self)
+        self.triage = engine
+
     def check_new_signal(self, p: Prog, infos) -> list[tuple[int, Signal]]:
         """Per-call novelty test against max_signal; returns calls with
         new signal and updates max/new signal under one lock
@@ -218,7 +238,22 @@ class Fuzzer:
         """check_new_signal with a caller-supplied prio_fn(errno,
         call_index) — lets undecoded device mutants compute edge
         priority from their exec-template flags without a typed
-        decode (ops/pipeline.ExecMutant.signal_prio)."""
+        decode (ops/pipeline.ExecMutant.signal_prio).
+
+        With a TriageEngine installed, the batched device plane
+        pre-filters: only calls flagged possibly-novel reach the
+        exact per-call dict diff below — the common nothing-new case
+        never takes the lock (syzkaller_tpu/triage)."""
+        eng = self.triage
+        if eng is not None:
+            return eng.check(self, prio_fn, infos)
+        return self.cpu_check_new_signal(prio_fn, infos)
+
+    def cpu_check_new_signal(self, prio_fn,
+                             infos) -> list[tuple[int, Signal]]:
+        """The exact CPU novelty check (the reference's shape, and the
+        triage engine's confirm/fallback path): per-call Signal diffs
+        and max/new-signal merges under one lock acquisition."""
         out = []
         with self._lock:
             for info in infos:
@@ -242,9 +277,13 @@ class Fuzzer:
         return sig
 
     def add_max_signal(self, sig: Signal) -> None:
-        """Merge manager-distributed max signal (fuzzer.go:482-486)."""
+        """Merge manager-distributed max signal (fuzzer.go:482-486).
+        The triage plane absorbs the same merge (after the max_signal
+        merge, so the plane never gets ahead of the exact sets)."""
         with self._lock:
             self.max_signal.merge(sig)
+        if self.triage is not None:
+            self.triage.merge_signal(sig)
 
     # -- corpus ----------------------------------------------------------
 

@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Optional
 
+from syzkaller_tpu import telemetry
 from syzkaller_tpu.fuzzer.fuzzer import Fuzzer, FuzzerConfig
 from syzkaller_tpu.fuzzer.host import (check_fault_injection,
                                        detect_supported_syscalls,
@@ -30,6 +31,15 @@ from syzkaller_tpu.signal import Signal
 from syzkaller_tpu.utils import log
 
 POLL_PERIOD_S = 10.0  # reference: fuzzer.go:300-382 poll cadence
+
+
+def _telemetry_payload() -> dict:
+    """The fuzzer's registry snapshot, trimmed for the poll wire:
+    counters/gauges/histograms only (events are per-process operator
+    timelines; the manager merge has no use for them)."""
+    snap = telemetry.snapshot()
+    return {"counters": snap["counters"], "gauges": snap["gauges"],
+            "histograms": snap["histograms"]}
 
 
 class FuzzerProcess:
@@ -107,6 +117,18 @@ class FuzzerProcess:
             # bank cannot splice manager-disabled syscalls.
             self.mutator = PipelineMutator(
                 DevicePipeline(self.target, ct=self.fuzzer.ct))
+            # Device-plane novelty triage co-resident with the corpus
+            # ring (syzkaller_tpu/triage): shares the pipeline's
+            # breaker/watchdog, demotes to the CPU path with it.
+            # TZ_TRIAGE_DEVICE=0 is the kill switch back to the
+            # per-call CPU Signal diffs.
+            from syzkaller_tpu.health import env_int
+
+            if env_int("TZ_TRIAGE_DEVICE", 1):
+                from syzkaller_tpu.triage import TriageEngine
+
+                self.fuzzer.set_triage(
+                    TriageEngine.for_pipeline(self.mutator.pipeline))
 
         self.procs = []
         for pid in range(procs):
@@ -201,6 +223,11 @@ class FuzzerProcess:
                 "need_candidates": bool(need_candidates),
                 "stats": stats,
                 "max_signal": list(new_sig.serialize()),
+                # Cumulative registry snapshot for the manager's
+                # cross-process histogram merge (fixed shared buckets;
+                # latest-wins per fuzzer, so unlike the drained stats
+                # above it needs no restore on a failed RPC).
+                "telemetry": _telemetry_payload(),
             }) or {}
         except Exception:
             # The drained delta must not be lost on a transient RPC

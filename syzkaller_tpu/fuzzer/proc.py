@@ -170,10 +170,12 @@ class PipelineMutator:
 
     def _sync_health_stats(self, fuzzer: Fuzzer) -> None:
         """Drain monotonic health counters (mutator latch + pipeline
-        breaker/watchdog) into the fuzzer's poll-synced Stat deltas."""
+        breaker/watchdog + co-resident triage engine) into the
+        fuzzer's poll-synced Stat deltas."""
         pstats = getattr(self.pipeline, "stats", None)
         br = getattr(self.pipeline, "breaker", None)
         wd = getattr(self.pipeline, "watchdog", None)
+        te = getattr(self.pipeline, "triage_engine", None)
         with self._lock:
             totals = {
                 Stat.DEVICE_DEMOTIONS: self.demotions,
@@ -186,6 +188,10 @@ class PipelineMutator:
                 totals[Stat.DEVICE_REBUILDS] = br.counters.rebuilds
             if wd is not None:
                 totals[Stat.DEVICE_WEDGES] = wd.stats.wedges
+            if te is not None:
+                totals[Stat.DEVICE_TRIAGE_DEMOTIONS] = te.stats.demotions
+                totals[Stat.DEVICE_TRIAGE_REPROMOTIONS] = \
+                    te.stats.repromotions
             deltas = []
             for stat, total in totals.items():
                 seen = self._reported.get(stat.name, 0)

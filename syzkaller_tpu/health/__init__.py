@@ -8,9 +8,9 @@ This package makes every device failure path *detected*, *bounded*,
 and *exercisable deterministically*:
 
   - faultinject: named seams (`device.launch`, `device.compile`,
-    `rpc.send_frame`, `rpc.recv_frame`, `queue.put`) scripted by a
-    TZ_FAULT_PLAN env plan — syzkaller's fail_nth discipline applied
-    to the host side of the TPU engine,
+    `device.triage`, `rpc.send_frame`, `rpc.recv_frame`, `queue.put`)
+    scripted by a TZ_FAULT_PLAN env plan — syzkaller's fail_nth
+    discipline applied to the host side of the TPU engine,
   - watchdog: a heartbeat + deadline wrapper converting a wedged
     device call into a structured DeviceWedged instead of an eternal
     stall,

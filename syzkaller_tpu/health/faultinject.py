@@ -42,6 +42,7 @@ from syzkaller_tpu.utils import log
 SEAMS = (
     "device.launch",
     "device.compile",
+    "device.triage",
     "rpc.send_frame",
     "rpc.recv_frame",
     "queue.put",

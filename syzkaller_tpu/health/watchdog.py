@@ -20,6 +20,7 @@ the thread-per-call overhead.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from dataclasses import dataclass, field
@@ -61,10 +62,39 @@ class WatchdogStats:
     last_wedge_at: float = 0.0  # wallclock; 0.0 = never wedged
 
 
+class _Executor(threading.Thread):
+    """A reusable guarded-call runner.  Spawning a fresh thread per
+    guarded call cost ~0.5 ms — measurable once the triage engine
+    started issuing a guarded call per batch — so the watchdog keeps
+    an idle pool instead.  A wedged executor is `retired`: it finishes
+    (or never finishes) its stuck call in the background and exits
+    instead of pulling new work."""
+
+    def __init__(self):
+        super().__init__(daemon=True, name="watchdog-exec")
+        self.tasks: queue.SimpleQueue = queue.SimpleQueue()
+        self.retired = False
+        self.start()
+
+    def run(self) -> None:
+        while True:
+            fn, box, done = self.tasks.get()
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # delivered to the caller
+                box["error"] = e
+            finally:
+                done.set()
+            if self.retired:
+                return
+
+
 class Watchdog:
     """Deadline-guards blocking device calls; tracks a heartbeat.
 
-    One watchdog per pipeline; call() may be invoked from any thread.
+    One watchdog per pipeline; call() may be invoked from any thread
+    (the pipeline worker and the triage engine share one when
+    co-resident — each concurrent call gets its own executor).
     """
 
     def __init__(self, deadline_s: float = 120.0,
@@ -77,6 +107,7 @@ class Watchdog:
         self.stats = WatchdogStats()
         self._last_beat = clock()
         self._abandoned: list[threading.Thread] = []
+        self._idle: list[_Executor] = []
 
     # -- heartbeat --------------------------------------------------------
 
@@ -121,27 +152,25 @@ class Watchdog:
                 self._note_done(self._clock() - t0)
         box: dict = {}
         done = threading.Event()
-
-        def run():
-            try:
-                box["result"] = fn()
-            except BaseException as e:  # delivered to the caller
-                box["error"] = e
-            finally:
-                done.set()
-
+        with self._lock:
+            ex = self._idle.pop() if self._idle else None
+        if ex is None:
+            ex = _Executor()
         t0 = self._clock()
-        th = threading.Thread(target=run, daemon=True,
-                              name=f"watchdog-{op}")
-        th.start()
+        ex.tasks.put((fn, box, done))
         while not done.wait(timeout=0.2):
             d = current()
             if d and d > 0 and self._clock() - t0 >= d:
                 now = time.time()
+                ex.retired = True  # still owns the stuck call
+                # Poison task: if the call races to completion right
+                # at the deadline, the executor is parked in get() —
+                # the no-op lets it observe `retired` and exit.
+                ex.tasks.put((lambda: None, {}, threading.Event()))
                 with self._lock:
                     self.stats.wedges += 1
                     self.stats.last_wedge_at = now
-                    self._abandoned.append(th)
+                    self._abandoned.append(ex)
                     self.stats.abandoned_live = len(self._abandoned)
                 _M_WEDGES.inc()
                 _M_LAST_WEDGE.set(now)
@@ -149,6 +178,8 @@ class Watchdog:
                     "watchdog.wedge",
                     f"{op} exceeded {d:.1f}s deadline")
                 raise DeviceWedged(op, d)
+        with self._lock:
+            self._idle.append(ex)
         self._note_done(self._clock() - t0)
         if "error" in box:
             raise box["error"]

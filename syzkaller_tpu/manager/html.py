@@ -56,16 +56,24 @@ def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
                                "application/json")
                 elif url.path == "/metrics":
                     # Prometheus text exposition of the process-wide
-                    # telemetry registry (docs/observability.md).
-                    self._send(telemetry.render_prometheus(),
-                               "text/plain; version=0.0.4")
+                    # telemetry registry (docs/observability.md), plus
+                    # the fleet rollup merged from the fuzzers' poll
+                    # telemetry — same names, source="fleet" label.
+                    body = telemetry.render_prometheus()
+                    fleet = mgr.serv.fleet_telemetry()
+                    if fleet.get("sources"):
+                        body += telemetry.render_prometheus_snapshot(
+                            fleet, {"source": "fleet"})
+                    self._send(body, "text/plain; version=0.0.4")
                 elif url.path == "/api/stats":
                     # Machine-readable superset of /stats: the manager
                     # rollup plus the full telemetry snapshot
-                    # (histogram percentiles, transition events).
+                    # (histogram percentiles, transition events) and
+                    # the cross-process fleet merge.
                     self._send(json.dumps({
                         "manager": mgr.stats_snapshot(),
                         "telemetry": telemetry.snapshot(),
+                        "fleet": mgr.serv.fleet_telemetry(),
                     }), "application/json")
                 elif url.path == "/corpus":
                     self._send(_corpus_page(mgr, q.get("call", [""])[0]))

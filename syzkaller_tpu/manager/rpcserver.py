@@ -27,6 +27,10 @@ class FuzzerState:
     name: str
     new_max_signal: Signal = field(default_factory=Signal)
     inputs: list[dict] = field(default_factory=list)  # pending RPCInput dicts
+    # Latest telemetry snapshot from this fuzzer's poll (cumulative
+    # counters/gauges/histograms with fixed shared buckets): the
+    # fleet_telemetry merge is a vector add across these.
+    telemetry: Optional[dict] = None
 
 
 class ManagerRPC:
@@ -135,11 +139,14 @@ class ManagerRPC:
         name = params.get("name", "fuzzer")
         stats = params.get("stats") or {}
         fuzzer_max = params.get("max_signal") or [[], []]
+        telemetry = params.get("telemetry")
         with self._lock:
             f = self.fuzzers.get(name)
             if f is None:  # fuzzer restarted without Connect — re-add
                 f = FuzzerState(name=name)
                 self.fuzzers[name] = f
+            if telemetry:
+                f.telemetry = telemetry
             new_sig = Signal.deserialize(fuzzer_max[0], fuzzer_max[1])
             diff = self.max_signal.diff(new_sig)
             if not diff.empty():
@@ -164,6 +171,19 @@ class ManagerRPC:
                 "max_signal": list(max_out)}
 
     # -- introspection ----------------------------------------------------
+
+    def fleet_telemetry(self) -> dict:
+        """Cross-process rollup of the fuzzers' latest poll telemetry
+        (the ROADMAP PR 2 leftover): counters/gauges sum, histograms
+        vector-add over the fixed shared buckets, percentiles
+        re-estimated from the merged counts.  Rendered on /metrics
+        (source="fleet") and /api/stats."""
+        from syzkaller_tpu.telemetry import merge_snapshots
+
+        with self._lock:
+            snaps = [f.telemetry for f in self.fuzzers.values()
+                     if f.telemetry]
+        return merge_snapshots(snaps)
 
     def snapshot(self) -> dict:
         with self._lock:

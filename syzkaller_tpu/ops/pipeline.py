@@ -550,6 +550,11 @@ class DevicePipeline:
             deadline_s=env_float("TZ_WATCHDOG_DEADLINE_S", 120.0),
             compile_deadline_s=env_float("TZ_WATCHDOG_COMPILE_S", 600.0))
         self._compiled = False  # first dispatch carries the jit compile
+        # Co-resident triage engine (syzkaller_tpu/triage): shares
+        # this pipeline's breaker/watchdog and its device session, so
+        # a half-open ring rebuild must also invalidate the signal
+        # plane (attach_triage wires it).
+        self.triage_engine = None
         self._have_corpus = threading.Event()
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._worker_loop,
@@ -574,9 +579,14 @@ class DevicePipeline:
     def retry_backoff_cap(self, v: float) -> None:
         self.breaker.configure_backoff(cap=v)
 
+    def attach_triage(self, engine) -> None:
+        """Register the co-resident triage engine for plane
+        invalidation on host-snapshot ring rebuilds."""
+        self.triage_engine = engine
+
     def health_snapshot(self) -> dict:
         """Breaker + watchdog state for tests and the status page."""
-        return {
+        out = {
             "breaker": self.breaker.snapshot(),
             "watchdog": self.watchdog.snapshot(),
             "worker_errors": self.stats.worker_errors,
@@ -584,6 +594,9 @@ class DevicePipeline:
             "assemble_workers": self._assemble_workers,
             "assemble_queue_depth": self._pool.queue_depth(),
         }
+        if self.triage_engine is not None:
+            out["triage"] = self.triage_engine.snapshot()
+        return out
 
     # -- corpus management -------------------------------------------------
 
@@ -948,6 +961,11 @@ class DevicePipeline:
             self._pending_rows = [
                 (i, t.arrays()) for i, t in enumerate(self.templates)
                 if t is not None]
+        if self.triage_engine is not None:
+            # The signal plane is co-resident with the corpus ring: a
+            # restarted backend invalidated its buffer too, so it must
+            # re-upload from the host mirror on the same re-entry.
+            self.triage_engine.invalidate_device_plane()
 
     def _worker_loop(self) -> None:
         from collections import deque

@@ -100,6 +100,15 @@ def shard_plane(mesh: Mesh, plane) -> jax.Array:
     return jax.device_put(plane, NamedSharding(mesh, P("cov")))
 
 
+def shard_engine_plane(mesh: Mesh, engine) -> jax.Array:
+    """Place the production TriageEngine's signal plane cov-sharded on
+    the mesh: the sharded fuzz step and the fuzzer's novelty
+    pre-filter share ONE plane instead of duplicating 64 MB per
+    consumer.  Feed step outputs back with engine.absorb_plane (valid
+    only in the standalone mesh form — see its contract)."""
+    return shard_plane(mesh, engine.share_plane())
+
+
 def make_sharded_fuzz_step(mesh: Mesh, rounds: int = 4, plane_size: int = dsig.PLANE_SIZE):
     """Build the jitted, mesh-sharded full fuzz step.
 

@@ -36,6 +36,9 @@ from syzkaller_tpu.telemetry.registry import (
     Gauge,
     Histogram,
     Registry,
+    merge_histogram_snapshots,
+    merge_snapshots,
+    render_prometheus_snapshot,
 )
 from syzkaller_tpu.telemetry.trace import ENV_VAR, TraceWriter
 
@@ -129,8 +132,11 @@ __all__ = [
     "dump_snapshot",
     "gauge",
     "histogram",
+    "merge_histogram_snapshots",
+    "merge_snapshots",
     "record_event",
     "render_prometheus",
+    "render_prometheus_snapshot",
     "reset",
     "set_trace_file",
     "snapshot",

@@ -150,21 +150,9 @@ class Histogram:
             return self._percentile_locked(q)
 
     def _percentile_locked(self, q: float) -> float:
-        if self._count == 0:
-            return 0.0
-        rank = q * self._count
-        cum = 0
-        for i, c in enumerate(self._counts):
-            if c == 0:
-                continue
-            if cum + c >= rank:
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = self.bounds[i] if i < len(self.bounds) else self._max
-                frac = (rank - cum) / c
-                est = lo + (hi - lo) * frac
-                return min(max(est, self._min), self._max)
-            cum += c
-        return self._max
+        return percentile_from_counts(self.bounds, self._counts,
+                                      self._count, self._min,
+                                      self._max, q)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -191,6 +179,129 @@ class Histogram:
             self._count = 0
             self._min = float("inf")
             self._max = float("-inf")
+
+
+def percentile_from_counts(bounds, counts, total: int, mn: float,
+                           mx: float, q: float) -> float:
+    """q-quantile estimate from per-bucket counts: linear
+    interpolation within the owning bucket, clamped to [mn, mx].
+    Shared by live Histograms and merged cross-process snapshots —
+    the fixed shared buckets make both the same computation."""
+    if total == 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else mx
+            est = lo + (hi - lo) * ((rank - cum) / c)
+            return min(max(est, mn), mx)
+        cum += c
+    return mx
+
+
+def merge_histogram_snapshots(snaps: list) -> dict:
+    """Vector-add Histogram.snapshot() dicts from N processes.
+
+    This is the payoff of the fixed-shared-buckets design constraint:
+    cross-process histogram merging is a per-bucket sum, with
+    percentiles re-estimated from the merged counts.  Snapshots whose
+    bucket bounds disagree (a version-skewed fuzzer) are skipped
+    rather than corrupting the merge."""
+    snaps = [s for s in snaps if s and s.get("buckets")]
+    if not snaps:
+        return {}
+    les = [b[0] for b in snaps[0]["buckets"]]
+    per = [0] * len(les)
+    total, ssum = 0, 0.0
+    mn, mx = float("inf"), float("-inf")
+    for s in snaps:
+        if [b[0] for b in s["buckets"]] != les:
+            continue
+        prev = 0
+        for i, (_le, cum) in enumerate(s["buckets"]):
+            per[i] += cum - prev
+            prev = cum
+        total += s.get("count", 0)
+        ssum += s.get("sum", 0.0)
+        if s.get("count"):
+            mn = min(mn, s.get("min", 0.0))
+            mx = max(mx, s.get("max", 0.0))
+    if total == 0:
+        mn = mx = 0.0
+    bounds = tuple(le for le in les if le != "+Inf")
+    cum, buckets = 0, []
+    for i, le in enumerate(les):
+        cum += per[i]
+        buckets.append([le, cum])
+    return {
+        "count": total,
+        "sum": round(ssum, 6),
+        "min": round(mn, 6),
+        "max": round(mx, 6),
+        "p50": round(percentile_from_counts(
+            bounds, per, total, mn, mx, 0.50), 6),
+        "p90": round(percentile_from_counts(
+            bounds, per, total, mn, mx, 0.90), 6),
+        "p99": round(percentile_from_counts(
+            bounds, per, total, mn, mx, 0.99), 6),
+        "buckets": buckets,
+    }
+
+
+def merge_snapshots(snaps: list) -> dict:
+    """Merge N processes' Registry.snapshot() payloads into one fleet
+    rollup: counters and gauges sum (each process contributes its
+    monotonic totals / current depths), histograms vector-add.  The
+    manager runs this over the latest per-fuzzer poll snapshots —
+    cumulative payloads make latest-wins idempotent, so a lost poll
+    costs staleness, never correctness."""
+    out: dict = {"sources": 0, "counters": {}, "gauges": {},
+                 "histograms": {}}
+    hists: dict[str, list] = {}
+    for s in snaps:
+        if not s:
+            continue
+        out["sources"] += 1
+        for name, v in (s.get("counters") or {}).items():
+            out["counters"][name] = out["counters"].get(name, 0) + v
+        for name, v in (s.get("gauges") or {}).items():
+            out["gauges"][name] = out["gauges"].get(name, 0) + v
+        for name, h in (s.get("histograms") or {}).items():
+            hists.setdefault(name, []).append(h)
+    for name, hs in hists.items():
+        merged = merge_histogram_snapshots(hs)
+        if merged:
+            out["histograms"][name] = merged
+    return out
+
+
+def render_prometheus_snapshot(snap: dict,
+                               labels: Optional[dict] = None) -> str:
+    """Prometheus text for a snapshot DICT (e.g. a fleet merge), with
+    optional labels distinguishing it from the process-local series —
+    the manager appends the fleet rollup to /metrics as
+    `...{source="fleet"}` next to its own registry."""
+    pairs = "".join(f'{k}="{v}",' for k, v in (labels or {}).items())
+    lbl = "{" + pairs.rstrip(",") + "}" if pairs else ""
+    lines = []
+    for name, v in sorted((snap.get("counters") or {}).items()):
+        lines.append(f"{name.replace('.', '_')}{lbl} {_fmt(v)}")
+    for name, v in sorted((snap.get("gauges") or {}).items()):
+        lines.append(f"{name.replace('.', '_')}{lbl} {_fmt(v)}")
+    for name, h in sorted((snap.get("histograms") or {}).items()):
+        name = name.replace(".", "_")
+        for le, cum in h.get("buckets") or []:
+            label = le if le == "+Inf" else format(le, ".6g")
+            lines.append(f'{name}_bucket{{le="{label}",'
+                         f'{pairs.rstrip(",")}}} {cum}' if pairs else
+                         f'{name}_bucket{{le="{label}"}} {cum}')
+        lines.append(f"{name}_sum{lbl} {_fmt(h.get('sum', 0))}")
+        lines.append(f"{name}_count{lbl} {h.get('count', 0)}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def _fmt(v: float) -> str:

@@ -138,6 +138,26 @@ def wedge_report(snap: dict) -> list[str]:
         lines.append(
             f"host assembly: {mutants / asm['sum']:.0f} mutants/s "
             f"over {asm['count']} batches")
+    # Triage plane health (ISSUE 4): pre-filter hit rate and the
+    # realized device-checked call rate — next to the demotion count
+    # so a CPU-path regression is visible in the same A/B snapshot.
+    t_hits = counters.get("tz_triage_plane_hits_total") or 0
+    t_miss = counters.get("tz_triage_plane_misses_total") or 0
+    if t_hits + t_miss:
+        tdev = (snap.get("histograms") or {}).get(
+            "tz_triage_device_seconds") or {}
+        line = (f"triage plane: {int(t_hits + t_miss)} calls "
+                f"pre-filtered, hit rate "
+                f"{t_hits / (t_hits + t_miss):.1%}")
+        if tdev.get("sum"):
+            line += f", {(t_hits + t_miss) / tdev['sum']:.0f} calls/s"
+        fn = gauges.get("tz_triage_fold_false_negative_rate") or 0
+        if fn:
+            line += f", fold-FN est {fn:.2%}"
+        demos = counters.get("tz_triage_demotions_total") or 0
+        if demos:
+            line += f", {int(demos)} demotions"
+        lines.append(line)
     last_wedge = gauges.get("tz_watchdog_last_wedge_ts") or 0
     if last_wedge:
         age = max(0.0, (snap.get("ts") or time.time()) - last_wedge)

@@ -32,8 +32,11 @@ import sys
 #: Shapes a metric name can take; a literal matching this anywhere in
 #: the tree must be registered.  Prefix-only literals ("tz_breaker_")
 #: used for startswith() filtering intentionally do not match.
+#: `rate`/`occupancy` cover the triage-plane gauges (ISSUE 4:
+#: fold-false-negative rate, plane bucket occupancy).
 METRIC_SHAPE = re.compile(
-    r"^tz_[a-z0-9_]+_(?:total|seconds|bytes|depth|size|ts)$")
+    r"^tz_[a-z0-9_]+_(?:total|seconds|bytes|depth|size|ts|rate"
+    r"|occupancy)$")
 
 _REG_RE = re.compile(
     r"""(?:counter|gauge|histogram)\(\s*['"]([a-z0-9_.]+)['"]""")

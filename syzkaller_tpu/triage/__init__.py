@@ -1,0 +1,12 @@
+"""Device-side batched coverage triage (ISSUE 4).
+
+The production novelty path: procs hand raw per-call signal arrays to
+one shared TriageEngine, which ships them H2D in padded static-shape
+batches, runs the jitted dense-plane diff (ops/signal.diff_batch),
+and routes only the calls the plane flags as possibly-novel through
+the exact CPU Signal diff.  See engine.py for the contract.
+"""
+
+from syzkaller_tpu.triage.engine import TriageEngine, TriageStats
+
+__all__ = ["TriageEngine", "TriageStats"]

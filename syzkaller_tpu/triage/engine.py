@@ -1,0 +1,537 @@
+"""TriageEngine: the device signal plane as the production novelty path.
+
+The reference runs the per-call novelty test as a Go map walk under
+one mutex (pkg/signal/signal.go:90-102 via syz-fuzzer/fuzzer.go:494);
+this repo's CPU shape was the same — every proc serialized behind
+`Fuzzer._lock` doing Python dict diffs (`Signal.diff_raw`) for every
+executed call, even though >99.9% of calls carry nothing new.  The
+jitted dense-plane kernels in ops/signal.py (diff_batch / merge) were
+until now used only by the experimental mesh step.
+
+This engine makes them the hot path:
+
+  - procs submit raw per-call signal arrays (CallInfo.signal) into a
+    cross-proc staging buffer; whoever reaches the device lock first
+    becomes the flush leader and ships the whole staged batch H2D as
+    ONE padded (B, E) static-shape novel_any call (diff_batch's
+    predicate without the sort-based dedup — the flag is identical,
+    the sort was the dominant cost) — batching across procs amortizes
+    the H2D sync and the dispatch, and the shapes are pinned
+    (B = TZ_TRIAGE_BATCH, E = TZ_TRIAGE_MAX_EDGES) so nothing ever
+    re-jits,
+  - calls the plane flags as possibly-novel (and calls whose signal
+    exceeds the E budget) fall through to the exact CPU Signal diff
+    under the fuzzer lock — max_signal/new_signal bookkeeping and
+    triage-work enqueue are bit-identical to the pure-CPU path; the
+    common "nothing new" verdict never touches the Python sets or the
+    lock,
+  - confirmed diffs and manager-distributed max-signal merges
+    (Fuzzer.add_max_signal) scatter into a host MIRROR of the plane
+    immediately and into the device plane lazily (ops/signal.merge at
+    the same (B, E) shape) at the next flush.  The mirror is the
+    rebuild authority: the device plane is invalidated on any device
+    failure and on every breaker half-open re-entry (the pipeline's
+    host-snapshot rebuild covers the co-resident plane), and is
+    re-uploaded from the mirror in one transfer,
+  - breaker/watchdog semantics mirror the pipeline worker's: an open
+    breaker demotes triage to the CPU path instantly (symmetric with
+    PipelineMutator's fast-demote; the plane mirror keeps absorbing
+    confirmed signal while demoted, so re-promotion carries no
+    hit-rate regression), device calls run under the watchdog and the
+    `device.triage` fault seam, and a device failure confirms the
+    whole staged chunk on CPU — zero lost signal by construction.
+
+The one approximation is the fold: the plane stores 2^FOLD_BITS
+buckets of (max seen prio + 1), so a truly-novel 32-bit edge whose
+fold collides with an occupied bucket is filtered without a CPU
+confirm (a false negative).  Its probability is bounded by the plane
+occupancy fraction, tracked incrementally and exported as
+`tz_triage_fold_false_negative_rate`; at 2^26 buckets a 1M-edge
+max_signal costs ~1.5%.  `TZ_TRIAGE_DEVICE=0` is the kill switch back
+to today's pure-CPU path (docs/perf.md "The triage path").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health import (
+    CircuitBreaker,
+    Watchdog,
+    env_float,
+    env_int,
+    fault_point,
+)
+from syzkaller_tpu.health.breaker import CLOSED
+from syzkaller_tpu.ops import signal as dsig
+from syzkaller_tpu.utils import log
+
+# Triage-path telemetry (docs/observability.md): counts at each fork
+# of the decision tree plus the plane-health gauges.  Span latencies
+# come from span() contexts at the call sites (triage.device wraps
+# one padded batch end to end, triage.confirm the exact CPU diff).
+_M_CALLS = telemetry.counter(
+    "tz_triage_calls_total", "calls checked through the triage engine")
+_M_BATCHES = telemetry.counter(
+    "tz_triage_batches_total", "device pre-filter batches flushed")
+_M_HITS = telemetry.counter(
+    "tz_triage_plane_hits_total",
+    "calls the plane flagged possibly-novel (CPU confirm)")
+_M_MISSES = telemetry.counter(
+    "tz_triage_plane_misses_total",
+    "calls the plane filtered as nothing-new (fast path)")
+_M_OVERFLOWS = telemetry.counter(
+    "tz_triage_overflow_calls_total",
+    "calls over the per-call edge budget (confirmed on CPU directly)")
+_M_CPU_FALLBACK = telemetry.counter(
+    "tz_triage_cpu_fallback_calls_total",
+    "calls checked on the CPU path while demoted")
+_M_ERRORS = telemetry.counter(
+    "tz_triage_device_errors_total",
+    "device failures on the triage call (chunk confirmed on CPU)")
+_M_DEMOTIONS = telemetry.counter(
+    "tz_triage_demotions_total", "device->CPU triage demotions")
+_M_REPROMOTIONS = telemetry.counter(
+    "tz_triage_repromotions_total", "CPU->device triage re-promotions")
+_M_REBUILDS = telemetry.counter(
+    "tz_triage_plane_rebuilds_total",
+    "device plane re-uploads from the host mirror")
+_M_BATCH_SIZE = telemetry.gauge(
+    "tz_triage_batch_size", "calls in the most recent device batch")
+_M_OCCUPANCY = telemetry.gauge(
+    "tz_triage_plane_occupancy", "occupied plane buckets (host mirror)")
+_M_FN_RATE = telemetry.gauge(
+    "tz_triage_fold_false_negative_rate",
+    "estimated probability a novel edge is filtered by a fold "
+    "collision (= plane occupancy fraction)")
+
+
+@dataclass
+class TriageStats:
+    calls: int = 0  # calls entering check()
+    device_batches: int = 0  # padded batches flushed to the device
+    plane_hits: int = 0  # flagged possibly-novel -> CPU confirm
+    plane_misses: int = 0  # filtered nothing-new (no lock, no dicts)
+    overflow_calls: int = 0  # signal over the E budget -> CPU confirm
+    cpu_fallback_calls: int = 0  # checked on CPU while demoted
+    device_errors: int = 0  # failures on the triage device call
+    demotions: int = 0  # device->CPU transitions
+    repromotions: int = 0  # CPU->device transitions
+    plane_rebuilds: int = 0  # mirror re-uploads
+
+
+class _Request:
+    """One proc's check() worth of staged queries: a single completion
+    event + countdown shared by its entries (per-entry Events were a
+    measurable slice of the batch at 64 calls/program).  Only the
+    current flush leader decrements `pending` (the device lock
+    serializes leaders), so the countdown needs no lock of its own."""
+
+    __slots__ = ("pending", "done")
+
+    def __init__(self, n: int):
+        self.pending = n
+        self.done = threading.Event()
+
+
+class _Entry:
+    """One staged per-call novelty query."""
+
+    __slots__ = ("edges", "prio", "flagged", "req")
+
+    def __init__(self, edges: np.ndarray, prio: int, req: _Request):
+        self.edges = edges
+        self.prio = prio
+        self.flagged = True  # conservative until the plane answers
+        self.req = req
+
+
+class TriageEngine:
+    """Shared by every proc of one fuzzer process; see module doc.
+
+    Constructor knobs are overridable by env (health.envsafe — a
+    malformed value falls back to the argument, never kills startup):
+    TZ_TRIAGE_BATCH (calls per padded device batch), TZ_TRIAGE_MAX_EDGES
+    (per-call edge budget; larger signals confirm on CPU directly),
+    TZ_TRIAGE_FLUSH_S (leader linger to gather a fuller batch; 0 =
+    flush immediately).  TZ_TRIAGE_DEVICE=0 disables construction
+    entirely (fuzzer/main.py)."""
+
+    def __init__(self, batch: int = 256, max_edges: int = 512,
+                 flush_s: float = 0.0,
+                 breaker: Optional[CircuitBreaker] = None,
+                 watchdog: Optional[Watchdog] = None,
+                 owns_breaker: Optional[bool] = None):
+        self.B = max(1, env_int("TZ_TRIAGE_BATCH", batch))
+        self.E = max(8, env_int("TZ_TRIAGE_MAX_EDGES", max_edges))
+        self.flush_s = max(0.0, env_float("TZ_TRIAGE_FLUSH_S", flush_s))
+        # Standalone engines own their breaker and drive the full
+        # closed->open->half-open->closed protocol themselves; an
+        # engine sharing a pipeline's breaker (for_pipeline) only
+        # READS it — the pipeline worker owns probing, and triage
+        # stays on CPU until the worker re-closes it.
+        self.owns_breaker = (breaker is None) if owns_breaker is None \
+            else owns_breaker
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            failure_threshold=max(1, env_int("TZ_BREAKER_THRESHOLD", 4)),
+            backoff_initial=env_float("TZ_BREAKER_BACKOFF_S", 1.0),
+            backoff_cap=env_float("TZ_BREAKER_BACKOFF_CAP_S", 60.0))
+        self.watchdog = watchdog if watchdog is not None else Watchdog(
+            deadline_s=env_float("TZ_WATCHDOG_DEADLINE_S", 120.0),
+            compile_deadline_s=env_float("TZ_WATCHDOG_COMPILE_S", 600.0))
+        self.stats = TriageStats()
+        # The host mirror is the plane's rebuild authority: uint8
+        # buckets of (max seen prio + 1), identical layout to the
+        # device plane.  Occupancy is maintained incrementally (a full
+        # count over 2^26 buckets per merge would dwarf the merge).
+        self._mirror = np.zeros(dsig.PLANE_SIZE, dtype=np.uint8)
+        self._occupancy = 0
+        self._plane_dev = None  # device plane; None = rebuild pending
+        self._compiled = False  # first diff carries the jit compile
+        self._pending: list[tuple[np.ndarray, int]] = []  # merge backlog
+        self._staged: list[_Entry] = []
+        self._stage_lock = threading.Lock()
+        self._merge_lock = threading.Lock()
+        self._device_lock = threading.Lock()  # flush-leader mutex
+        self._demoted = False
+
+    @classmethod
+    def for_pipeline(cls, pipeline, **kw) -> "TriageEngine":
+        """Co-resident form: shares the DevicePipeline's breaker and
+        watchdog (one health verdict for the device) and registers for
+        plane invalidation on the pipeline's half-open ring rebuild."""
+        eng = cls(breaker=pipeline.breaker, watchdog=pipeline.watchdog,
+                  owns_breaker=False, **kw)
+        pipeline.attach_triage(eng)
+        return eng
+
+    # -- plane maintenance -------------------------------------------------
+
+    def attach(self, fuzzer) -> None:
+        """Seed the mirror from the fuzzer's current max_signal (the
+        manager's Connect payload lands before the engine exists)."""
+        with fuzzer._lock:
+            sig = fuzzer.max_signal.copy()
+        self.merge_signal(sig)
+
+    def merge_signal(self, sig) -> None:
+        """Fold a Signal into the plane: mirror now, device at the
+        next flush.  Callers guarantee sig is already merged into
+        max_signal — the plane must under-approximate max_signal
+        (staleness only costs extra CPU confirms), never exceed it."""
+        if sig.empty():
+            return
+        by_prio: dict[int, list[int]] = {}
+        for e, p in sig.m.items():
+            by_prio.setdefault(int(p), []).append(int(e))
+        for prio, elems in by_prio.items():
+            self._merge_edges(np.asarray(elems, dtype=np.uint32), prio)
+
+    def _merge_edges(self, edges: np.ndarray, prio: int) -> None:
+        with self._merge_lock:
+            idx = dsig.fold_hash_np(edges)
+            newly = self._mirror[idx] == 0
+            np.maximum.at(self._mirror, idx, np.uint8(prio + 1))
+            if newly.any():
+                self._occupancy += int(np.unique(idx[newly]).size)
+                _M_OCCUPANCY.set(self._occupancy)
+                _M_FN_RATE.set(self._occupancy / dsig.PLANE_SIZE)
+            self._pending.append((edges, prio))
+
+    def invalidate_device_plane(self) -> None:
+        """Drop the device plane; the next flush re-uploads the host
+        mirror.  Called on device failures and by the pipeline's
+        half-open ring rebuild (plane co-residency: a restarted
+        backend invalidated this buffer too)."""
+        self._plane_dev = None
+
+    def _bucket(self, n: int) -> int:
+        """Pow2 row-count bucket in [8, B]: small submissions ship
+        small transfers (the tunneled link charges per byte) while
+        the distinct compiled shapes stay bounded at log2(B/8)+1."""
+        b = 1 << max(0, (max(n, 8) - 1).bit_length())
+        return min(b, self.B)
+
+    def _ensure_plane_locked(self):
+        """Device plane ready for a diff (holds _device_lock): rebuild
+        from the mirror if invalidated, else apply the merge backlog
+        through the jitted scatter at bucketed (rows, E) shapes."""
+        import jax.numpy as jnp
+
+        if self._plane_dev is None:
+            # One 64 MB H2D replaces the backlog entirely (the mirror
+            # already holds every pending merge).  Held under the
+            # merge lock so a concurrent merge cannot land in the
+            # mirror after the snapshot but vanish from the backlog.
+            with self._merge_lock:
+                self._pending.clear()
+                self._plane_dev = jnp.asarray(self._mirror)
+            self.stats.plane_rebuilds += 1
+            _M_REBUILDS.inc()
+            return
+        with self._merge_lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        rows: list[tuple[np.ndarray, int]] = []
+        for edges, prio in pending:
+            for i in range(0, edges.size, self.E):
+                rows.append((edges[i:i + self.E], prio))
+        for start in range(0, len(rows), self.B):
+            chunk = rows[start:start + self.B]
+            b = self._bucket(len(chunk))
+            e = np.zeros((b, self.E), dtype=np.uint32)
+            n = np.zeros(b, dtype=np.int32)
+            pr = np.zeros(b, dtype=np.uint8)
+            for i, (edges, prio) in enumerate(chunk):
+                e[i, :edges.size] = edges
+                n[i] = edges.size
+                pr[i] = prio
+            # Donated: the scatter lands in place — a non-donating
+            # merge copied the 64 MB plane per application.
+            self._plane_dev = dsig.merge_into(
+                self._plane_dev, jnp.asarray(e), jnp.asarray(n),
+                jnp.asarray(pr), jnp.ones(b, dtype=bool))
+
+    # -- plane sharing (parallel/mesh.py) ----------------------------------
+
+    def share_plane(self):
+        """The device plane, current as of every absorbed merge, for
+        co-use by the mesh fuzz step (parallel/mesh.shard_engine_plane)
+        — one 64 MB plane per process instead of one per consumer.
+        The engine's donated merges reassign its own reference, so
+        consumers must re-share after letting the engine run."""
+        with self._device_lock:
+            self._ensure_plane_locked()
+            return self._plane_dev
+
+    def absorb_plane(self, plane) -> None:
+        """Max-merge an externally updated plane (a mesh step's
+        output) back into the mirror.  Only valid when the absorbed
+        signal is the engine's own authority (the standalone mesh
+        form); a fuzzer-attached engine must instead route external
+        signal through Fuzzer.add_max_signal, or the plane would
+        over-approximate max_signal and filter real novelty."""
+        arr = np.asarray(plane, dtype=np.uint8)
+        with self._device_lock, self._merge_lock:
+            np.maximum(self._mirror, arr, out=self._mirror)
+            self._occupancy = int(np.count_nonzero(self._mirror))
+            _M_OCCUPANCY.set(self._occupancy)
+            _M_FN_RATE.set(self._occupancy / dsig.PLANE_SIZE)
+            self._pending.clear()
+            self._plane_dev = None  # rebuilt from the merged mirror
+
+    # -- the check path ----------------------------------------------------
+
+    def check(self, fuzzer, prio_fn, infos) -> list:
+        """Drop-in for Fuzzer.cpu_check_new_signal: same (call_index,
+        diff) list, same order, same max_signal/new_signal effects."""
+        infos = list(infos)
+        if not infos:
+            return []
+        self.stats.calls += len(infos)
+        _M_CALLS.inc(len(infos))
+        if not self._gate():
+            self._note_demoted(f"circuit breaker {self.breaker.state}")
+            return self._cpu_all(fuzzer, prio_fn, infos)
+        entries: dict[int, _Entry] = {}
+        confirm_pos: list[int] = []
+        staged: list[_Entry] = []
+        req = _Request(0)
+        for pos, info in enumerate(infos):
+            edges = np.asarray(info.signal, dtype=np.uint32).ravel()
+            if edges.size == 0:
+                continue  # empty diff either way
+            if edges.size > self.E:
+                # Over the padded-edge budget: exact CPU diff directly
+                # (rare; the budget exists to pin the device shape).
+                self.stats.overflow_calls += 1
+                _M_OVERFLOWS.inc()
+                confirm_pos.append(pos)
+                continue
+            en = _Entry(edges, prio_fn(info.errno, info.call_index),
+                        req)
+            entries[pos] = en
+            staged.append(en)
+        if staged:
+            req.pending = len(staged)
+            self._flush(req, staged)
+            confirm_pos.extend(pos for pos, en in entries.items()
+                               if en.flagged)
+        if not confirm_pos:
+            return []
+        confirm_pos.sort()
+        with telemetry.span("triage.confirm"):
+            news = fuzzer.cpu_check_new_signal(
+                prio_fn, [infos[p] for p in confirm_pos])
+        for _ci, diff in news:
+            self.merge_signal(diff)
+        return news
+
+    def _cpu_all(self, fuzzer, prio_fn, infos) -> list:
+        """The demoted path: today's exact CPU check for every call.
+        Confirmed diffs still land in the mirror so re-promotion
+        starts with a current plane."""
+        self.stats.cpu_fallback_calls += len(infos)
+        _M_CPU_FALLBACK.inc(len(infos))
+        news = fuzzer.cpu_check_new_signal(prio_fn, infos)
+        for _ci, diff in news:
+            self.merge_signal(diff)
+        return news
+
+    def _gate(self) -> bool:
+        if self.owns_breaker:
+            # allow() admits the half-open probe once the backoff
+            # elapses: the next staged batch IS the probe.
+            return self.breaker.allow()
+        return self.breaker.state == CLOSED
+
+    # -- staging + flush ---------------------------------------------------
+
+    def _flush(self, req: _Request, entries: list[_Entry]) -> None:
+        """Stage these queries and drive flushes until they resolve.
+        Whoever wins the device lock flushes EVERYTHING staged (its
+        own entries and every other proc's) in padded B-sized chunks;
+        losers wait on their request — the leader-follower shape that
+        batches across procs without a dedicated thread."""
+        with self._stage_lock:
+            self._staged.extend(entries)
+        while not req.done.is_set():
+            if self._device_lock.acquire(timeout=0.01):
+                try:
+                    self._drain_staged(req)
+                finally:
+                    self._device_lock.release()
+            else:
+                req.done.wait(timeout=0.02)
+
+    def _drain_staged(self, req: _Request) -> None:
+        while not req.done.is_set():
+            if self.flush_s > 0:
+                deadline = time.monotonic() + self.flush_s
+                while time.monotonic() < deadline:
+                    with self._stage_lock:
+                        if len(self._staged) >= self.B:
+                            break
+                    time.sleep(min(0.001, self.flush_s))
+            with self._stage_lock:
+                chunk = self._staged[:self.B]
+                del self._staged[:len(chunk)]
+            if not chunk:
+                return  # a previous leader resolved the rest
+            self._run_chunk(chunk)
+
+    def _run_chunk(self, chunk: list[_Entry]) -> None:
+        """One padded device batch (holds _device_lock).  Any failure
+        marks the whole chunk for exact CPU confirm — degraded
+        throughput, zero lost signal — and feeds the breaker."""
+        import jax.numpy as jnp
+
+        with telemetry.span("triage.device"):
+            try:
+                fault_point("device.triage")
+                if self.owns_breaker and self.breaker.consume_rebuild():
+                    self._plane_dev = None
+                self._ensure_plane_locked()
+                b = self._bucket(len(chunk))
+                k = len(chunk)
+                lens = np.array([en.edges.size for en in chunk],
+                                dtype=np.int32)
+                edges = np.zeros((b, self.E), dtype=np.uint32)
+                # One ragged scatter instead of a per-row copy loop.
+                edges[:k][np.arange(self.E)[None, :] < lens[:, None]] \
+                    = np.concatenate([en.edges for en in chunk])
+                nedges = np.zeros(b, dtype=np.int32)
+                nedges[:k] = lens
+                prios = np.zeros(b, dtype=np.uint8)
+                prios[:k] = [en.prio for en in chunk]
+                plane = self._plane_dev
+                flags = self.watchdog.call(
+                    lambda: np.asarray(dsig.novel_any(
+                        plane, jnp.asarray(edges), jnp.asarray(nedges),
+                        jnp.asarray(prios))),
+                    "device.triage", compile=not self._compiled)
+                self._compiled = True
+            except Exception as e:
+                self._plane_dev = None  # buffers may be invalid now
+                self.stats.device_errors += 1
+                _M_ERRORS.inc()
+                self.breaker.record_failure()
+                log.logf(0, "triage device error (breaker %s): %s",
+                         self.breaker.state, str(e)[:200])
+                for en in chunk:
+                    en.flagged = True  # exact CPU confirm: no loss
+                    self._complete(en)
+                return
+        if self.owns_breaker:
+            self.breaker.record_success()
+        self._note_promoted()
+        hits = 0
+        for en, flagged in zip(chunk, flags[:len(chunk)].tolist()):
+            en.flagged = flagged
+            hits += flagged
+            self._complete(en)
+        self.stats.device_batches += 1
+        self.stats.plane_hits += hits
+        self.stats.plane_misses += len(chunk) - hits
+        _M_BATCHES.inc()
+        _M_BATCH_SIZE.set(len(chunk))
+        _M_HITS.inc(hits)
+        _M_MISSES.inc(len(chunk) - hits)
+
+    @staticmethod
+    def _complete(en: _Entry) -> None:
+        # Leader-only (device lock held), so the countdown is plain.
+        req = en.req
+        req.pending -= 1
+        if req.pending == 0:
+            req.done.set()
+
+    # -- health ------------------------------------------------------------
+
+    def _note_demoted(self, reason: str) -> None:
+        if self._demoted:
+            return
+        self._demoted = True
+        self.stats.demotions += 1
+        _M_DEMOTIONS.inc()
+        telemetry.record_event("triage.demote", reason)
+        log.logf(0, "TRIAGE DEMOTED to CPU path: %s", reason)
+
+    def _note_promoted(self) -> None:
+        if not self._demoted:
+            return
+        self._demoted = False
+        self.stats.repromotions += 1
+        _M_REPROMOTIONS.inc()
+        telemetry.record_event("triage.repromote", "device answering")
+        log.logf(0, "triage re-promoted to the device plane")
+
+    def demoted(self) -> bool:
+        return self._demoted
+
+    def snapshot(self) -> dict:
+        """Engine state for health_snapshot surfaces and tests."""
+        s = self.stats
+        return {
+            "demoted": self._demoted,
+            "calls": s.calls,
+            "device_batches": s.device_batches,
+            "plane_hits": s.plane_hits,
+            "plane_misses": s.plane_misses,
+            "overflow_calls": s.overflow_calls,
+            "cpu_fallback_calls": s.cpu_fallback_calls,
+            "device_errors": s.device_errors,
+            "demotions": s.demotions,
+            "repromotions": s.repromotions,
+            "plane_rebuilds": s.plane_rebuilds,
+            "plane_occupancy": self._occupancy,
+            "fold_false_negative_rate":
+                self._occupancy / dsig.PLANE_SIZE,
+        }

@@ -481,6 +481,104 @@ def test_queue_put_seam_drops_batch_without_tripping_breaker(device_rig):
     assert pl.breaker.counters.failures == failures0
 
 
+# -- triage engine seam ---------------------------------------------------
+
+
+def test_triage_fault_plan_demote_cpu_zero_loss_then_repromote():
+    """ISSUE 4: scripted failures on the `device.triage` seam trip the
+    engine's breaker open (triage demotes to the CPU path), every
+    step's results stay byte-identical to a pure-CPU reference (zero
+    lost signal — a failed chunk confirms exactly on CPU), and once
+    the seam heals a half-open probe re-promotes the device plane and
+    rebuilds it from the host mirror."""
+    import numpy as np
+
+    from syzkaller_tpu.fuzzer import Fuzzer, WorkQueue
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops import signal as dsig
+    from syzkaller_tpu.triage import TriageEngine
+
+    target = get_target("test", "64")
+    br = CircuitBreaker(failure_threshold=2, backoff_initial=0.05,
+                        backoff_cap=0.1, jitter=0.0, seed=1)
+    eng = TriageEngine(batch=8, max_edges=64, breaker=br,
+                       watchdog=Watchdog(deadline_s=0),
+                       owns_breaker=True)
+    fz = Fuzzer(target, wq=WorkQueue())
+    fz.set_triage(eng)
+    ref = Fuzzer(target, wq=WorkQueue())
+    rng = np.random.RandomState(2)
+    prio_fn = (lambda errno, idx: 3)
+
+    class _Info:
+        __slots__ = ("call_index", "errno", "signal")
+
+        def __init__(self, ci, sig):
+            self.call_index = ci
+            self.errno = 0
+            self.signal = sig
+
+    # Invocations 1-2 trip the threshold-2 breaker; 3 is the failed
+    # probe (reopen, doubled backoff); 4+ are clean (the heal).
+    install_plan(FaultPlan.parse("device.triage:fail@1-3"))
+    saw_open = False
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        edges = rng.randint(0, 1 << dsig.FOLD_BITS, size=16,
+                            dtype=np.uint32)
+        infos = [_Info(0, edges)]
+        a = fz.check_new_signal_fn(prio_fn, infos)
+        b = ref.cpu_check_new_signal(prio_fn, infos)
+        assert [(ci, d.m) for ci, d in a] == [(ci, d.m) for ci, d in b]
+        saw_open = saw_open or br.is_open()
+        if br.state == CLOSED and eng.stats.repromotions >= 1:
+            break
+        time.sleep(0.02)
+    assert fz.max_signal.m == ref.max_signal.m  # zero lost signal
+    assert fz.new_signal.m == ref.new_signal.m
+    assert saw_open, "breaker never opened on the scripted streak"
+    snap = eng.snapshot()
+    assert snap["device_errors"] >= 3
+    assert snap["demotions"] >= 1, "engine never demoted to CPU"
+    assert snap["cpu_fallback_calls"] > 0, \
+        "demoted checks did not run the CPU path"
+    assert snap["repromotions"] >= 1, "engine never re-promoted"
+    assert snap["plane_rebuilds"] >= 1, \
+        "device plane not rebuilt from the mirror after the failures"
+    assert br.state == CLOSED and not snap["demoted"]
+    # post-heal: the plane serves filtered verdicts again
+    edges = rng.randint(0, 1 << dsig.FOLD_BITS, size=16,
+                        dtype=np.uint32)
+    infos = [_Info(0, edges)]
+    assert len(fz.check_new_signal_fn(prio_fn, infos)) == 1
+    misses0 = eng.stats.plane_misses
+    assert fz.check_new_signal_fn(prio_fn, infos) == []
+    assert eng.stats.plane_misses == misses0 + 1
+
+
+def test_triage_engine_coresident_with_pipeline_rebuild(device_rig):
+    """Plane co-residency (ISSUE 4): the pipeline's half-open ring
+    rebuild invalidates the attached engine's device plane, and the
+    shared-breaker engine demotes while the pipeline breaker is open
+    — symmetric with PipelineMutator's fast-demote."""
+    from syzkaller_tpu.triage import TriageEngine
+
+    _target, pl = device_rig
+    eng = TriageEngine.for_pipeline(pl, batch=8, max_edges=64)
+    try:
+        assert pl.triage_engine is eng
+        assert eng.breaker is pl.breaker and eng.watchdog is pl.watchdog
+        assert not eng.owns_breaker
+        eng.share_plane()  # materialize the device plane
+        assert eng._plane_dev is not None
+        pl._reset_device_state()
+        assert eng._plane_dev is None, \
+            "ring rebuild did not invalidate the co-resident plane"
+        assert "triage" in pl.health_snapshot()
+    finally:
+        pl.triage_engine = None  # the module-scoped rig lives on
+
+
 # -- rpc seams ------------------------------------------------------------
 
 

@@ -75,6 +75,46 @@ def test_rpc_max_signal_distribution():
     assert res1["max_signal"][0] == []
 
 
+def test_rpc_poll_telemetry_fleet_merge():
+    """ISSUE 4 satellite (ROADMAP PR 2 leftover): fuzzer poll
+    telemetry snapshots merge into one fleet rollup — counters sum,
+    histograms vector-add over the fixed shared buckets, latest
+    snapshot per fuzzer wins."""
+    from syzkaller_tpu.telemetry import Registry
+
+    serv = ManagerRPC()
+    snaps = []
+    for execs, lat in ((5, 0.01), (7, 0.04)):
+        reg = Registry()
+        reg.counter("tz_pipeline_mutants_total").inc(execs)
+        h = reg.histogram("tz_proc_exec_seconds")
+        for _ in range(execs):
+            h.observe(lat)
+        s = reg.snapshot()
+        snaps.append({"counters": s["counters"], "gauges": s["gauges"],
+                      "histograms": s["histograms"]})
+    serv.Poll({"name": "f1", "stats": {}, "max_signal": [[], []],
+               "telemetry": snaps[0]})
+    serv.Poll({"name": "f2", "stats": {}, "max_signal": [[], []],
+               "telemetry": snaps[1]})
+    fleet = serv.fleet_telemetry()
+    assert fleet["sources"] == 2
+    assert fleet["counters"]["tz_pipeline_mutants_total"] == 12
+    merged = fleet["histograms"]["tz_proc_exec_seconds"]
+    assert merged["count"] == 12
+    assert merged["min"] == pytest.approx(0.01)
+    assert merged["max"] == pytest.approx(0.04)
+    # latest-wins: f1 polls again with a fresher cumulative snapshot
+    snaps[0]["counters"]["tz_pipeline_mutants_total"] = 6
+    serv.Poll({"name": "f1", "stats": {}, "max_signal": [[], []],
+               "telemetry": snaps[0]})
+    assert serv.fleet_telemetry()["counters"][
+        "tz_pipeline_mutants_total"] == 13
+    # a poll without telemetry keeps the last snapshot (no regression)
+    serv.Poll({"name": "f1", "stats": {}, "max_signal": [[], []]})
+    assert serv.fleet_telemetry()["sources"] == 2
+
+
 # -- Manager daemon -----------------------------------------------------
 
 
@@ -273,6 +313,22 @@ def test_http_ui_endpoints(tmp_path, test_target):
             assert api["manager"]["corpus"] == 1
             assert "tz_breaker_opens_total" in api["telemetry"]["counters"]
             assert api["telemetry"]["gauges"]["tz_manager_corpus_size"] == 1
+            # cross-process rollup: a fuzzer's poll telemetry lands on
+            # /metrics (source="fleet" label) and /api/stats (ISSUE 4)
+            assert api["fleet"]["sources"] == 0  # nothing polled yet
+            m.serv.Poll({"name": "f", "stats": {},
+                         "max_signal": [[], []],
+                         "telemetry": {
+                             "counters": {"tz_pipeline_mutants_total": 9},
+                             "gauges": {},
+                             "histograms": {}}})
+            api = json_mod.loads(get("/api/stats"))
+            assert api["fleet"]["sources"] == 1
+            assert api["fleet"]["counters"][
+                "tz_pipeline_mutants_total"] == 9
+            metrics = get("/metrics")
+            assert ('tz_pipeline_mutants_total{source="fleet"} 9'
+                    in metrics)
             corpus = get("/corpus")
             assert "/input?sig=" in corpus
             sig = corpus.split("/input?sig=")[1].split("'")[0]

@@ -147,6 +147,61 @@ def test_trace_file_shape(tmp_path):
     assert "wallclock" in events[0]["args"]
 
 
+# -- cross-process merging (ISSUE 4 satellite) --------------------------
+
+
+def test_histogram_snapshot_merge_is_vector_add():
+    """The fixed-shared-buckets payoff: merging N processes'
+    histogram snapshots is a per-bucket sum with percentiles
+    re-estimated from the merged counts."""
+    from syzkaller_tpu.telemetry import merge_histogram_snapshots
+
+    h1, h2 = Histogram("tz_m_seconds"), Histogram("tz_m_seconds")
+    for _ in range(100):
+        h1.observe(0.001)
+    for _ in range(300):
+        h2.observe(0.1)
+    merged = merge_histogram_snapshots([h1.snapshot(), h2.snapshot()])
+    assert merged["count"] == 400
+    assert merged["sum"] == pytest.approx(30.1)
+    assert merged["min"] == pytest.approx(0.001)
+    assert merged["max"] == pytest.approx(0.1)
+    # 75% of mass at 0.1: the median lands in 0.1's bucket
+    assert 0.05 <= merged["p50"] <= 0.1
+    les, cums = zip(*merged["buckets"])
+    assert les[-1] == "+Inf" and cums[-1] == 400
+    assert all(a <= b for a, b in zip(cums, cums[1:]))
+    # a bucket-incompatible snapshot (version skew) is skipped, not
+    # corrupting the merge
+    skewed = {"count": 5, "sum": 1.0, "min": 0.1, "max": 0.3,
+              "buckets": [[1.0, 5], ["+Inf", 5]]}
+    merged2 = merge_histogram_snapshots([h1.snapshot(), skewed])
+    assert merged2["count"] == 100
+
+
+def test_merge_snapshots_fleet_rollup():
+    from syzkaller_tpu.telemetry import (merge_snapshots,
+                                         render_prometheus_snapshot)
+
+    r1, r2 = Registry(), Registry()
+    r1.counter("tz_pipeline_mutants_total").inc(5)
+    r2.counter("tz_pipeline_mutants_total").inc(7)
+    r1.gauge("tz_pipeline_queue_depth").set(2)
+    r2.gauge("tz_pipeline_queue_depth").set(3)
+    r1.histogram("tz_proc_exec_seconds").observe(0.01)
+    r2.histogram("tz_proc_exec_seconds").observe(0.02)
+    fleet = merge_snapshots([r1.snapshot(), r2.snapshot()])
+    assert fleet["sources"] == 2
+    assert fleet["counters"]["tz_pipeline_mutants_total"] == 12
+    assert fleet["gauges"]["tz_pipeline_queue_depth"] == 5
+    assert fleet["histograms"]["tz_proc_exec_seconds"]["count"] == 2
+    text = render_prometheus_snapshot(fleet, {"source": "fleet"})
+    assert 'tz_pipeline_mutants_total{source="fleet"} 12' in text
+    assert ('tz_proc_exec_seconds_bucket{le="+Inf",source="fleet"} 2'
+            in text)
+    assert 'tz_proc_exec_seconds_count{source="fleet"} 2' in text
+
+
 # -- rendering ----------------------------------------------------------
 
 

@@ -1,0 +1,251 @@
+"""Device-plane batched triage (syzkaller_tpu/triage, ISSUE 4).
+
+The contract under test: with the TriageEngine installed, corpus
+bookkeeping — max_signal, new_signal, and the (call_index, diff) work
+items that feed WorkTriage — is byte-identical to the pure-CPU path.
+The randomized streams draw edges below 2^FOLD_BITS, where the xor-
+fold is the identity and therefore injective: the plane's only
+approximation (fold collisions) is switched off by construction, so
+any divergence is an engine bug, not fold noise.  A separate test
+forces a collision to pin the documented false-negative semantics and
+its exported estimate.
+
+All CPU-only and compile-light: the engine is built at batch=8 /
+max_edges=64, so the plane kernels run at the same (8, 64) shapes
+test_ops already warms, and the two new kernels (novel_any,
+merge_into) are small single-fusion compiles.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.fuzzer import Fuzzer, WorkQueue
+from syzkaller_tpu.ops import signal as dsig
+from syzkaller_tpu.signal import Signal
+from syzkaller_tpu.triage import TriageEngine
+
+
+class _Info:
+    """Duck-typed CallInfo: what check_new_signal_fn reads."""
+
+    __slots__ = ("call_index", "errno", "signal")
+
+    def __init__(self, call_index, signal, errno=0):
+        self.call_index = call_index
+        self.errno = errno
+        self.signal = signal
+
+
+def _prio_fn(errno, _idx):
+    return 3 if errno == 0 else 1
+
+
+@pytest.fixture()
+def engine_fuzzer(test_target):
+    fz = Fuzzer(test_target, wq=WorkQueue())
+    eng = TriageEngine(batch=8, max_edges=64)
+    fz.set_triage(eng)
+    return fz, eng
+
+
+def _news_key(news):
+    return [(ci, dict(diff.m)) for ci, diff in news]
+
+
+def test_triage_parity_randomized(test_target, engine_fuzzer):
+    """The acceptance property: identical max_signal / new_signal /
+    triage work items vs the CPU path on random signal streams with
+    interleaved manager max-signal merges."""
+    fz_dev, eng = engine_fuzzer
+    fz_cpu = Fuzzer(test_target, wq=WorkQueue())
+    rng = np.random.RandomState(7)
+    work_dev, work_cpu = [], []
+    for step in range(40):
+        infos = []
+        for c in range(rng.randint(1, 9)):
+            n = rng.randint(0, 65)
+            # < 2^FOLD_BITS: fold-injective, so parity is exact.
+            edges = rng.randint(0, 1 << dsig.FOLD_BITS, size=n,
+                                dtype=np.uint32)
+            # Re-observed edges mixed in so the filtered fast path
+            # actually runs (fresh-only streams always flag).
+            infos.append(_Info(c, edges, errno=int(rng.randint(0, 2))))
+        news_dev = fz_dev.check_new_signal_fn(_prio_fn, infos)
+        news_cpu = fz_cpu.check_new_signal_fn(_prio_fn, infos)
+        assert _news_key(news_dev) == _news_key(news_cpu), step
+        work_dev.extend(_news_key(news_dev))
+        work_cpu.extend(_news_key(news_cpu))
+        if step % 3 == 0:
+            # Replay a prior program: plane-filtered on the device
+            # path, dict-diffed to empty on the CPU path.
+            assert fz_dev.check_new_signal_fn(_prio_fn, infos) == []
+            assert fz_cpu.check_new_signal_fn(_prio_fn, infos) == []
+        if step % 7 == 0:
+            # Manager-distributed max signal scatters into the plane.
+            sig = Signal({int(e): 2 for e in rng.randint(
+                0, 1 << dsig.FOLD_BITS, size=16)})
+            fz_dev.add_max_signal(sig.copy())
+            fz_cpu.add_max_signal(sig.copy())
+    assert work_dev == work_cpu
+    assert fz_dev.max_signal.m == fz_cpu.max_signal.m
+    assert fz_dev.new_signal.m == fz_cpu.new_signal.m
+    s = eng.stats
+    assert s.device_batches > 0 and s.plane_misses > 0, \
+        "the lock-free fast path never ran"
+    assert s.plane_hits > 0 and s.cpu_fallback_calls == 0
+    # The mirror under-approximates max_signal exactly: every exact
+    # element is present at >= its prio, and occupancy is consistent.
+    mirror = eng._mirror
+    for e, p in fz_dev.max_signal.m.items():
+        assert mirror[int(dsig.fold_hash_np(np.uint32(e)))] >= p + 1
+    assert int(np.count_nonzero(mirror)) == eng._occupancy
+
+
+def test_triage_overflow_and_empty_calls(test_target, engine_fuzzer):
+    """Signals over the E budget confirm on the exact CPU path
+    (counted as overflows); empty signals short-circuit — both
+    bit-identical to the CPU fuzzer."""
+    fz_dev, eng = engine_fuzzer
+    fz_cpu = Fuzzer(test_target, wq=WorkQueue())
+    rng = np.random.RandomState(3)
+    big = rng.randint(0, 1 << dsig.FOLD_BITS, size=500, dtype=np.uint32)
+    infos = [_Info(0, np.empty(0, np.uint32)), _Info(1, big)]
+    a = fz_dev.check_new_signal_fn(_prio_fn, infos)
+    b = fz_cpu.check_new_signal_fn(_prio_fn, infos)
+    assert _news_key(a) == _news_key(b) and len(a) == 1
+    assert eng.stats.overflow_calls == 1
+    assert fz_dev.max_signal.m == fz_cpu.max_signal.m
+
+
+def test_triage_fold_false_negative_measured(test_target):
+    """The documented approximation: a novel edge whose fold collides
+    with an occupied bucket is filtered without a CPU confirm, and the
+    exported estimate prices exactly that event."""
+    fz = Fuzzer(test_target, wq=WorkQueue())
+    eng = TriageEngine(batch=8, max_edges=64)
+    fz.set_triage(eng)
+    x = 12345
+    seen = np.asarray([x ^ 1], dtype=np.uint32)  # folds to x^1
+    # (x | 2^26) >> 26 == 1, so its fold is (x ^ 1) masked — the same
+    # bucket as `seen` from a distinct 32-bit edge.
+    collider = np.asarray([x | (1 << dsig.FOLD_BITS)],
+                          dtype=np.uint32)
+    assert int(dsig.fold_hash_np(seen)[0]) \
+        == int(dsig.fold_hash_np(collider)[0])
+    assert len(fz.check_new_signal_fn(_prio_fn, [_Info(0, seen)])) == 1
+    # CPU truth: the collider is new signal.  Plane verdict: filtered.
+    ref = Fuzzer(test_target, wq=WorkQueue())
+    ref.add_max_signal(Signal({int(seen[0]): 3}))
+    assert len(ref.cpu_check_new_signal(
+        _prio_fn, [_Info(0, collider)])) == 1
+    assert fz.check_new_signal_fn(_prio_fn, [_Info(0, collider)]) == []
+    snap = eng.snapshot()
+    assert snap["plane_misses"] >= 1
+    assert 0 < snap["fold_false_negative_rate"] < 1e-3
+    assert snap["plane_occupancy"] == 1
+
+
+def test_triage_kill_switch_and_envsafe_knobs(monkeypatch, test_target):
+    """TZ_TRIAGE_* knobs parse through health.envsafe: malformed
+    values degrade to the constructor defaults instead of killing
+    startup, well-formed values override."""
+    monkeypatch.setenv("TZ_TRIAGE_BATCH", "not-a-number")
+    monkeypatch.setenv("TZ_TRIAGE_MAX_EDGES", "")
+    monkeypatch.setenv("TZ_TRIAGE_FLUSH_S", "1.2.3")
+    eng = TriageEngine(batch=16, max_edges=128, flush_s=0.5)
+    assert eng.B == 16 and eng.E == 128 and eng.flush_s == 0.5
+    monkeypatch.setenv("TZ_TRIAGE_BATCH", "32")
+    monkeypatch.setenv("TZ_TRIAGE_MAX_EDGES", "0x100")
+    monkeypatch.setenv("TZ_TRIAGE_FLUSH_S", "0.25")
+    eng = TriageEngine(batch=16, max_edges=128)
+    assert eng.B == 32 and eng.E == 256 and eng.flush_s == 0.25
+    # The kill switch is read the same hardened way at the wiring
+    # site (fuzzer/main.py): malformed -> default-on.
+    from syzkaller_tpu.health import env_int
+
+    monkeypatch.setenv("TZ_TRIAGE_DEVICE", "maybe")
+    assert env_int("TZ_TRIAGE_DEVICE", 1) == 1
+    monkeypatch.setenv("TZ_TRIAGE_DEVICE", "0")
+    assert env_int("TZ_TRIAGE_DEVICE", 1) == 0
+
+
+def test_triage_plane_shared_with_mesh(test_target):
+    """One plane per process: the mesh step consumes the engine's
+    plane (cov-sharded) instead of allocating its own, and step
+    output merges back through absorb_plane."""
+    import jax
+
+    from syzkaller_tpu.parallel.mesh import make_mesh, shard_engine_plane
+
+    fz = Fuzzer(test_target, wq=WorkQueue())
+    eng = TriageEngine(batch=8, max_edges=64)
+    fz.set_triage(eng)
+    rng = np.random.RandomState(5)
+    edges = rng.randint(0, 1 << dsig.FOLD_BITS, size=32, dtype=np.uint32)
+    fz.check_new_signal_fn(_prio_fn, [_Info(0, edges)])
+    mesh = make_mesh(jax.devices(), cov=2)
+    shared = shard_engine_plane(mesh, eng)
+    assert np.array_equal(np.asarray(shared), eng._mirror)
+    # An externally updated plane (the mesh step's pmax output) folds
+    # back: the mirror covers both sides afterwards.
+    extra = np.zeros_like(eng._mirror)
+    extra_idx = dsig.fold_hash_np(
+        rng.randint(0, 1 << dsig.FOLD_BITS, size=8, dtype=np.uint32))
+    extra[extra_idx] = 4
+    updated = np.maximum(np.asarray(shared), extra)
+    eng.absorb_plane(updated)
+    assert np.array_equal(eng._mirror, updated)
+    assert eng._occupancy == int(np.count_nonzero(updated))
+    # the absorbed signal is authority now: those buckets filter
+    assert eng.snapshot()["plane_occupancy"] == eng._occupancy
+
+
+def test_triage_cross_proc_batching(test_target, engine_fuzzer):
+    """Concurrent procs submitting together resolve through shared
+    flush leaders with exact per-proc results (the staging buffer is
+    cross-proc state; results must not cross wires)."""
+    import threading
+
+    fz, eng = engine_fuzzer
+    rng = np.random.RandomState(9)
+    streams = []
+    for t in range(4):
+        checks = []
+        for _ in range(10):
+            checks.append([
+                _Info(c, rng.randint(0, 1 << dsig.FOLD_BITS, size=24,
+                                     dtype=np.uint32))
+                for c in range(4)])
+        streams.append(checks)
+    results: dict[int, list] = {}
+    errors: list[BaseException] = []
+
+    def worker(t):
+        try:
+            out = []
+            for infos in streams[t]:
+                out.append(_news_key(
+                    fz.check_new_signal_fn(_prio_fn, infos)))
+            results[t] = out
+        except BaseException as e:  # surfaced to the assertion below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert len(results) == 4
+    # Replay the union on a fresh CPU fuzzer: same final max_signal
+    # regardless of interleaving (max-merge is order-independent).
+    ref = Fuzzer(test_target, wq=WorkQueue())
+    for checks in streams:
+        for infos in checks:
+            ref.cpu_check_new_signal(_prio_fn, infos)
+    assert fz.max_signal.m == ref.max_signal.m
+    # every submitted call was answered
+    assert eng.stats.calls == 4 * 10 * 4
