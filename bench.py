@@ -183,6 +183,11 @@ def bench_pipeline(batch_size=PIPE_BATCH, seconds=8.0,
         if sub_out is not None and pl.stats.d2h_batches:
             sub_out["d2h_bytes_per_batch"] = round(
                 pl.stats.d2h_bytes / pl.stats.d2h_batches, 1)
+        if sub_out is not None:
+            # The realized drain->assemble overlap depth at the end of
+            # the run (auto: wherever the DepthController settled;
+            # pinned: the TZ_ASSEMBLE_DEPTH value).
+            sub_out["assemble_depth_effective"] = pl._assemble_depth
     finally:
         pl.stop()
         dump_telemetry()
@@ -314,7 +319,17 @@ def bench_triage(calls_per_check=512, edges_per_call=64, checks=80,
     injecting fresh edges.  `triage_calls_per_sec` /
     `triage_cpu_calls_per_sec` are the two rates;
     `triage_plane_hit_rate` is the fraction of calls that needed a
-    CPU confirm (the lock-free fast path is its complement)."""
+    CPU confirm (the lock-free fast path is its complement).
+
+    The engine runs at the production batch shape (B = half a check,
+    so every check flushes two chunks through the transfer plane):
+    `triage_h2d_overlap_frac` is the fraction of device batches whose
+    upload flew while the previous batch's verdicts were still in
+    flight (0 at TZ_TRIAGE_DISPATCH_DEPTH=1 — the serial fallback),
+    and `triage_h2d_host_ms_per_batch` is the flush leader's measured
+    staging+upload cost per batch (the `triage.h2d_wait` span — the
+    pinned-arena number the ROADMAP's ~0.1 ms/batch re-pad item is
+    judged by)."""
     import numpy as np
 
     from syzkaller_tpu.fuzzer import Fuzzer, WorkQueue
@@ -352,7 +367,8 @@ def bench_triage(calls_per_check=512, edges_per_call=64, checks=80,
         return 3
 
     fz_dev = Fuzzer(target, wq=WorkQueue())
-    eng = TriageEngine(batch=calls_per_check, max_edges=edges_per_call)
+    eng = TriageEngine(batch=max(8, calls_per_check // 2),
+                       max_edges=edges_per_call)
     fz_dev.set_triage(eng)
     fz_cpu = Fuzzer(target, wq=WorkQueue())
     fz_dev.add_max_signal(base.copy())
@@ -362,6 +378,13 @@ def bench_triage(calls_per_check=512, edges_per_call=64, checks=80,
     fz_dev.check_new_signal_fn(prio_fn, stream[0])
     fz_cpu.check_new_signal_fn(prio_fn, stream[0])
 
+    from syzkaller_tpu import telemetry
+
+    h2d_hist = telemetry.REGISTRY.histogram(
+        telemetry.span_metric_name("triage.h2d_wait"))
+    h2d0 = (h2d_hist.count, h2d_hist.sum)
+    batches0 = eng.stats.device_batches
+    overlaps0 = eng.stats.h2d_overlaps
     t0 = time.perf_counter()
     for infos in stream[1:]:
         fz_dev.check_new_signal_fn(prio_fn, infos)
@@ -373,8 +396,51 @@ def bench_triage(calls_per_check=512, edges_per_call=64, checks=80,
     ncalls = (checks - 1) * calls_per_check
     dev_rate = ncalls / dev_dt if dev_dt else 0.0
     cpu_rate = ncalls / cpu_dt if cpu_dt else 0.0
+    # The flush-leader staging micro-comparison (the ROADMAP
+    # "pinned staging buffer ~0.1 ms/batch" item, measured on this
+    # host): time padding one full B-row batch the legacy way (fresh
+    # np.zeros + ragged scatter per flush) vs the transfer-plane way
+    # (in-place writes into a persistent arena slot).  Runs at the
+    # PRODUCTION batch shape (256, 512) — the shape the ROADMAP claim
+    # was made for — not the bench's smaller edge budget.
+    from syzkaller_tpu.ops.staging import StagingArena
+
+    B, E = 256, 512
+    chunk = [pool[rng.randint(0, seen_edges, size=edges_per_call)]
+             for _ in range(B)]
+    lens_l = np.array([c.size for c in chunk], dtype=np.int32)
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        edges = np.zeros((B, E), dtype=np.uint32)
+        edges[np.arange(E)[None, :] < lens_l[:, None]] = \
+            np.concatenate(chunk)
+        nedges = np.zeros(B, dtype=np.int32)
+        nedges[:] = lens_l
+        prios = np.zeros(B, dtype=np.uint8)
+        prios[:] = 3
+    legacy_ms = 1e3 * (time.perf_counter() - t0) / reps
+    arena = StagingArena(slots=2)
+    cols = np.arange(E, dtype=np.int32)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        bufs = arena.acquire(B, {
+            "edges": ((B, E), np.uint32), "nedges": ((B,), np.int32),
+            "prios": ((B,), np.uint8), "mask": ((B, E), np.bool_),
+            "flat": ((B * E,), np.uint32)})
+        bufs["nedges"][:] = lens_l
+        bufs["prios"][:] = 3
+        total = int(lens_l.sum())
+        np.less(cols[None, :], lens_l[:, None], out=bufs["mask"])
+        np.concatenate(chunk, out=bufs["flat"][:total])
+        bufs["edges"][bufs["mask"]] = bufs["flat"][:total]
+    staged_ms = 1e3 * (time.perf_counter() - t0) / reps
+
     s = eng.stats
     checked = s.plane_hits + s.plane_misses
+    timed_batches = s.device_batches - batches0
+    h2d_n = h2d_hist.count - h2d0[0]
+    h2d_ms = (1e3 * (h2d_hist.sum - h2d0[1]) / h2d_n) if h2d_n else None
     return {
         "triage_calls_per_sec": round(dev_rate, 1),
         "triage_cpu_calls_per_sec": round(cpu_rate, 1),
@@ -382,6 +448,14 @@ def bench_triage(calls_per_check=512, edges_per_call=64, checks=80,
         if cpu_rate else None,
         "triage_plane_hit_rate": round(s.plane_hits / checked, 4)
         if checked else None,
+        "triage_h2d_overlap_frac": round(
+            (s.h2d_overlaps - overlaps0) / timed_batches, 4)
+        if timed_batches else None,
+        "triage_h2d_host_ms_per_batch": round(h2d_ms, 4)
+        if h2d_ms is not None else None,
+        "triage_stage_ms_per_batch": round(staged_ms, 4),
+        "triage_stage_legacy_repad_ms_per_batch": round(legacy_ms, 4),
+        "triage_dispatch_depth": eng._dispatch_depth,
         "triage_fold_fn_rate_est": round(
             eng.snapshot()["fold_false_negative_rate"], 6),
         # Fold false negatives are possible on full 32-bit streams;

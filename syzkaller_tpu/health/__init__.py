@@ -8,7 +8,8 @@ This package makes every device failure path *detected*, *bounded*,
 and *exercisable deterministically*:
 
   - faultinject: named seams (`device.launch`, `device.compile`,
-    `device.triage`, `rpc.send_frame`, `rpc.recv_frame`, `queue.put`)
+    `device.triage`, `staging.h2d`, `rpc.send_frame`,
+    `rpc.recv_frame`, `queue.put`)
     scripted by a TZ_FAULT_PLAN env plan — syzkaller's fail_nth
     discipline applied to the host side of the TPU engine,
   - watchdog: a heartbeat + deadline wrapper converting a wedged
@@ -23,7 +24,13 @@ See docs/health.md for the state machine and the plan grammar.
 """
 
 from syzkaller_tpu.health.breaker import BreakerCounters, CircuitBreaker
-from syzkaller_tpu.health.envsafe import env_float, env_int
+from syzkaller_tpu.health.envsafe import (
+    KNOWN_TZ_VARS,
+    env_auto_int,
+    env_float,
+    env_int,
+    warn_unknown_tz_vars,
+)
 from syzkaller_tpu.health.faultinject import (
     SEAMS,
     FaultInjected,
@@ -41,12 +48,15 @@ __all__ = [
     "DeviceWedged",
     "FaultInjected",
     "FaultPlan",
+    "KNOWN_TZ_VARS",
     "SEAMS",
     "Watchdog",
+    "env_auto_int",
     "env_float",
     "env_int",
     "fault_point",
     "install_plan",
     "plan_from_env",
     "reset_plan",
+    "warn_unknown_tz_vars",
 ]

@@ -5,16 +5,56 @@ must degrade to the compiled-in default, not kill fuzzer startup with
 a ValueError half-way through DevicePipeline.__init__ — a fuzzer that
 boots with a default knob finds bugs; one that dies on a typo in a
 supervisor script finds nothing.
+
+The companion failure mode is the knob that parses fine but is spelled
+wrong (`TZ_TRIAGE_DISPACH_DEPTH=1`): it silently does nothing and the
+operator believes the kill path is armed.  `warn_unknown_tz_vars`
+closes that gap — engine start scans the environment for `TZ_*` names
+outside the known-knob registry and logs each once per process.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 
 from syzkaller_tpu.utils import log
 
+#: Every TZ_* variable the engine understands.  env_int/env_float/
+#: env_auto_int self-register the names they parse, but the static
+#: seed below is what makes the typo guard correct at ENGINE START —
+#: a knob whose parse site runs later (bench-only budgets, the trace
+#: exporter) must not be flagged just because nothing read it yet.
+KNOWN_TZ_VARS: set[str] = {
+    "TZ_ASSEMBLE_DEPTH",
+    "TZ_ASSEMBLE_WORKERS",
+    "TZ_BENCH_PLATFORM",
+    "TZ_BENCH_PREFLIGHT_ATTEMPTS",
+    "TZ_BENCH_PREFLIGHT_TIMEOUT",
+    "TZ_BENCH_WARMUP_TIMEOUT_S",
+    "TZ_BREAKER_BACKOFF_CAP_S",
+    "TZ_BREAKER_BACKOFF_S",
+    "TZ_BREAKER_THRESHOLD",
+    "TZ_FAULT_PLAN",
+    "TZ_JAX_PLATFORM",
+    "TZ_PIPELINE_DISPATCH_DEPTH",
+    "TZ_TELEMETRY_SNAPSHOT",
+    "TZ_TRACE_FILE",
+    "TZ_TRIAGE_BATCH",
+    "TZ_TRIAGE_DEVICE",
+    "TZ_TRIAGE_DISPATCH_DEPTH",
+    "TZ_TRIAGE_FLUSH_S",
+    "TZ_TRIAGE_MAX_EDGES",
+    "TZ_WATCHDOG_COMPILE_S",
+    "TZ_WATCHDOG_DEADLINE_S",
+}
+
+_warned: set[str] = set()
+_warn_lock = threading.Lock()
+
 
 def _env_num(name: str, default, conv):
+    KNOWN_TZ_VARS.add(name)
     raw = os.environ.get(name)
     if raw is None or raw == "":
         return default
@@ -32,3 +72,41 @@ def env_int(name: str, default: int) -> int:
 
 def env_float(name: str, default: float) -> float:
     return _env_num(name, default, float)
+
+
+def env_auto_int(name: str, default):
+    """An int knob with an `auto` sentinel (TZ_ASSEMBLE_DEPTH=auto|N):
+    returns None for auto/unset-with-None-default, an int for a
+    numeric value, `default` (logged) for anything malformed."""
+    KNOWN_TZ_VARS.add(name)
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    if raw.strip().lower() == "auto":
+        return None
+    try:
+        return int(raw, 0)
+    except (ValueError, TypeError):
+        log.logf(0, "ignoring malformed %s=%r (using default %r)",
+                 name, raw, default)
+        return default
+
+
+def warn_unknown_tz_vars(environ=None) -> list[str]:
+    """The typo guard: log (once per process per name) every TZ_*
+    variable present in the environment that no knob parses — a
+    misspelled kill switch must be loud, not silently inert.  Returns
+    the names flagged by THIS call (tests), never raises."""
+    env = os.environ if environ is None else environ
+    flagged: list[str] = []
+    with _warn_lock:
+        for name in sorted(env):
+            if not name.startswith("TZ_") or name in KNOWN_TZ_VARS \
+                    or name in _warned:
+                continue
+            _warned.add(name)
+            flagged.append(name)
+    for name in flagged:
+        log.logf(0, "unknown environment knob %s (typo? known TZ_* "
+                    "knobs are catalogued in docs/health.md)", name)
+    return flagged

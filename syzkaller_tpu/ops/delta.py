@@ -273,6 +273,21 @@ def _make_pool_assigner(spec: DeltaSpec, POOL: int):
     return assign
 
 
+def pow2_rows(n: int, lo: int = 1, hi: Optional[int] = None) -> int:
+    """Power-of-two row bucket covering `n`, clamped to [lo, hi].
+
+    The one bucketing rule every transfer on both hot paths follows
+    (the compacted pool fetch below, the triage flush batches, the
+    corpus-flush scatter staging in ops/staging): a pow2 row count
+    keeps each transfer's shape set bounded at log2(hi/lo)+1
+    variants, so arena buffers are reused and nothing ever re-jits on
+    a varying batch size."""
+    b = 1 << max(0, (max(int(n), max(1, lo)) - 1).bit_length())
+    if hi is not None:
+        b = min(b, int(hi))
+    return b
+
+
 def pool_bucket(n_used: int, pool_slots: int) -> int:
     """Power-of-two transfer bucket covering `n_used` claimed payload
     slots (0 = nothing to fetch).  Bucketing keeps the D2H slice-shape
@@ -281,7 +296,7 @@ def pool_bucket(n_used: int, pool_slots: int) -> int:
     n = int(n_used)
     if n <= 0:
         return 0
-    return min(int(pool_slots), 1 << max(0, (n - 1).bit_length()))
+    return pow2_rows(n, lo=1, hi=int(pool_slots))
 
 
 class DeltaBatch:

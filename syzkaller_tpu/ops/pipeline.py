@@ -25,7 +25,11 @@ on varying shapes, and decoded every mutant back to a typed tree
   - assembly runs on a pool of TZ_ASSEMBLE_WORKERS threads, sharded
     by template group so a group's vectorized pass never splits; the
     drain thread keeps `assemble_depth` batches in the pool and
-    delivers them strictly in drain order,
+    delivers them strictly in drain order — the depth self-tunes from
+    the measured pool_drain vs assemble_worker span percentiles
+    (TZ_ASSEMBLE_DEPTH=auto|N, ops/staging.DepthController), and the
+    corpus-flush scatter stages its rows through the same persistent
+    transfer-plane arenas the triage engine uses (ops/staging),
   - a background worker keeps `prefetch` assembled batches queued
     while executors drain the previous one (double buffering,
     SURVEY.md §7 hard part (c)); docs/perf.md covers the stage
@@ -57,6 +61,7 @@ from syzkaller_tpu.health import (
     env_float,
     env_int,
     fault_point,
+    warn_unknown_tz_vars,
 )
 from syzkaller_tpu.models.prog import Prog
 from syzkaller_tpu.ops.delta import (
@@ -67,6 +72,7 @@ from syzkaller_tpu.ops.delta import (
     make_compact_pooler,
     make_packer,
     pool_bucket,
+    pow2_rows,
 )
 from syzkaller_tpu.ops.emit import (
     DonorBankTable,
@@ -80,6 +86,7 @@ from syzkaller_tpu.ops.emit import (
     splice_insert,
     splice_insert_group,
 )
+from syzkaller_tpu.ops.staging import StagingArena, resolve_assemble_depth
 from syzkaller_tpu.ops.tensor import (
     FlagTables,
     ProgTensor,
@@ -519,8 +526,19 @@ class DevicePipeline:
             assemble_workers = min(2, max(0, (os.cpu_count() or 1) - 1))
         self._assemble_workers = max(0, env_int(
             "TZ_ASSEMBLE_WORKERS", assemble_workers))
-        self._assemble_depth = max(1, assemble_depth)
+        # assemble_depth is self-tuning by default (TZ_ASSEMBLE_DEPTH
+        # =auto|N, ops/staging.DepthController): the worker feeds the
+        # measured pool_drain vs assemble_worker span percentiles back
+        # into the depth after each collected batch, so the assembly
+        # pool stops idling behind D2H on hosts where the link is the
+        # slow stage.  A pinned N reproduces the fixed-depth behavior.
+        self._assemble_depth, self._depth_ctrl = \
+            resolve_assemble_depth(max(1, assemble_depth))
         self._pool = AssemblyPool(self._assemble_workers)
+        # Transfer plane (ops/staging): persistent host staging for
+        # the corpus-flush scatter — rows re-stack into rotating pow2
+        # arena slots instead of fresh np.stack allocations per flush.
+        self._staging = StagingArena(slots=2)
         self._seq = 0  # drain sequence: AssembledBatch.seq values
         # Pre-rebased flat donor tables keyed by a template's copyout
         # count (emit.build_donor_table): the insert splicer gathers
@@ -560,6 +578,9 @@ class DevicePipeline:
         self._worker = threading.Thread(target=self._worker_loop,
                                         name="device-pipeline", daemon=True)
         self._started = False
+        # Typo guard: a misspelled TZ_* knob parses as "unset" and
+        # silently changes nothing — flag it once at engine start.
+        warn_unknown_tz_vars()
 
     # Pre-breaker tuning knobs kept as proxies: tests and deployments
     # set these to shrink recovery latency (test_pipeline.py).
@@ -593,6 +614,9 @@ class DevicePipeline:
             "delivery_errors": self.stats.delivery_errors,
             "assemble_workers": self._assemble_workers,
             "assemble_queue_depth": self._pool.queue_depth(),
+            "assemble_depth": self._assemble_depth,
+            "assemble_depth_auto": self._depth_ctrl is not None,
+            "staging_arena_bytes": self._staging.nbytes,
         }
         if self.triage_engine is not None:
             out["triage"] = self.triage_engine.snapshot()
@@ -663,16 +687,37 @@ class DevicePipeline:
                 # on the tunneled chip each re-jit costs more than
                 # the scatter itself).  Duplicating one index with
                 # identical row data is well-defined even under
-                # XLA's unspecified duplicate-index order.
-                pad = (1 << max(0, (len(idx_list) - 1).bit_length())) \
-                    - len(idx_list)
-                idx = np.array(idx_list + idx_list[-1:] * pad,
-                               dtype=np.int32)
+                # XLA's unspecified duplicate-index order.  The
+                # padded rows are staged through the persistent
+                # transfer-plane arena (ops/staging): one rotating
+                # slot per pow2 bucket instead of fresh
+                # np.array/np.stack allocations per flush.
+                n_rows = len(idx_list)
+                bucket = pow2_rows(n_rows)
+                fields = {"idx": ((bucket,), np.int32)}
+                for k, v in self._corpus_dev.items():
+                    fields["row:" + k] = ((bucket,) + v.shape[1:],
+                                          v.dtype)
+                bufs = self._staging.acquire(("corpus", bucket), fields)
+                idx = bufs["idx"]
+                idx[:n_rows] = idx_list
+                idx[n_rows:] = idx_list[-1]
+                rows_by_key = {}
                 for k in self._corpus_dev:
-                    vals = [np.asarray(r[k]) for r in last.values()]
-                    rows = np.stack(vals + vals[-1:] * pad)
-                    self._corpus_dev[k] = \
-                        self._corpus_dev[k].at[idx].set(rows)
+                    rows = bufs["row:" + k]
+                    np.stack([np.asarray(r[k])
+                              for r in last.values()],
+                             out=rows[:n_rows])
+                    rows[n_rows:] = rows[n_rows - 1]
+                    rows_by_key[k] = rows
+                # The H2D edge: every per-field scatter uploads its
+                # staged rows (the span separates transfer cost from
+                # the host-side staging above it).
+                with telemetry.span("pipeline.h2d_wait"):
+                    fault_point("staging.h2d")
+                    for k, rows in rows_by_key.items():
+                        self._corpus_dev[k] = \
+                            self._corpus_dev[k].at[idx].set(rows)
         except Exception:
             # The worker survives device failures and retries
             # (_worker_loop); consumed-but-unapplied rows must go
@@ -1049,6 +1094,14 @@ class DevicePipeline:
             if self._stop.is_set():
                 return
             self.breaker.record_success()
+            # Self-tuning drain->assemble overlap: one controller tick
+            # per collected batch feeds the measured pool_drain vs
+            # assemble_worker percentiles back into assemble_depth
+            # (clamped + hysteretic; a pinned TZ_ASSEMBLE_DEPTH=N has
+            # no controller).  Host-only arithmetic — no device work,
+            # no jits.
+            if self._depth_ctrl is not None:
+                self._assemble_depth = self._depth_ctrl.update()
             try:
                 # The delivery seam (one invocation per produced
                 # batch, so occurrence plans stay deterministic under

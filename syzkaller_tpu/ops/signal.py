@@ -125,6 +125,20 @@ def merge(plane, edges, nedges, prios, accept):
 merge_into = jax.jit(_merge_impl, donate_argnums=0)
 
 
+def stage_batch(edges: np.ndarray, nedges: np.ndarray,
+                prios: np.ndarray):
+    """The H2D edge of one padded novelty batch: upload the staged
+    host buffers and return device arrays ready for novel_any /
+    diff_batch / merge.  One named function so the transfer plane's
+    `staging.h2d` fault seam and `triage.h2d_wait` span wrap exactly
+    the upload (triage/engine._dispatch_chunk), and so the host
+    staging buffers (ops/staging arenas) are free for reuse as soon
+    as this returns — jax copies host literals at device_put time,
+    it never aliases a mutable numpy buffer."""
+    return (jnp.asarray(edges), jnp.asarray(nedges),
+            jnp.asarray(prios))
+
+
 @jax.jit
 def plane_count(plane):
     return (plane > 0).sum()

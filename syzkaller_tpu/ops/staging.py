@@ -1,0 +1,253 @@
+"""The shared transfer plane: pinned staging arenas + depth control.
+
+PRs 3-4 made the device kernels and the host assembler fast enough
+that the remaining per-batch cost on both hot paths is host<->device
+transfer *bookkeeping*: the triage flush leader re-allocated and
+re-padded a (B, E) batch per flush (~0.1 ms/batch at the bench
+shape), the pipeline's corpus flush re-stacked its scatter rows per
+flush, and the per-batch triage H2D was serialized against the
+previous batch's verdict fetch.  This module is the shared fix — the
+same double-buffered pinned-staging discipline large-batch inference
+serving uses, and the transfer-side twin of the pipeline's
+`dispatch_depth` launch overlap:
+
+  StagingArena      persistent pre-padded host buffers per pow2
+                    bucket.  Producers write rows IN PLACE into a
+                    rotating slot pair instead of allocating + zeroing
+                    per batch; a slot is only rewritten after its
+                    in-flight consumer resolved, so an upload can
+                    still be reading slot k-1 while the leader pads
+                    batch k into slot k.  Shapes are pow2-bucketed by
+                    the caller (ops/delta.pow2_rows), so the device
+                    side never sees a new shape and nothing re-jits.
+
+  DepthController   the drain->assemble overlap made self-tuning:
+                    feeds the measured `pipeline.pool_drain` vs
+                    `pipeline.assemble_worker` span percentiles back
+                    into the pipeline's `assemble_depth` (clamped,
+                    hysteretic, with a cooldown between moves) so the
+                    assembly pool stops idling behind D2H on
+                    multi-core hosts — and stops hoarding arenas on
+                    hosts where assembly is the slow stage.
+                    `TZ_ASSEMBLE_DEPTH=auto|N` selects the controller
+                    or pins a fixed depth (health.envsafe parsing: a
+                    malformed value degrades to auto, never kills
+                    startup).
+
+Consumers: ops/pipeline.DevicePipeline (corpus-flush scatter staging,
+assemble-depth control) and triage/engine.TriageEngine (flush-leader
+batch staging + `TZ_TRIAGE_DISPATCH_DEPTH` H2D/verdict overlap).  The
+`staging.h2d` fault seam (health/faultinject) guards the upload edge
+both consumers share; docs/perf.md "The transfer plane" documents the
+buffer lifecycle and tuning.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health.envsafe import env_auto_int
+
+# Transfer-plane telemetry (docs/observability.md): arena footprint +
+# the two live depths.  Gauges are process-wide sums/currents shared
+# by every arena/controller instance.
+_M_ARENA_BYTES = telemetry.gauge(
+    "tz_staging_arena_bytes",
+    "bytes held by persistent host staging arenas")
+_M_ARENA_ALLOCS = telemetry.counter(
+    "tz_staging_arena_allocs_total",
+    "staging-arena buffer allocations (growth events; steady state "
+    "allocates nothing)")
+_M_ASSEMBLE_DEPTH = telemetry.gauge(
+    "tz_staging_assemble_depth",
+    "drained batches the pipeline keeps fanned out over the assembly "
+    "pool (TZ_ASSEMBLE_DEPTH; auto = DepthController)")
+_M_DISPATCH_DEPTH = telemetry.gauge(
+    "tz_staging_h2d_dispatch_depth",
+    "triage H2D uploads kept in flight ahead of the verdict fetch "
+    "(TZ_TRIAGE_DISPATCH_DEPTH; 1 while the breaker is not closed)")
+
+#: Process-wide arena footprint (all instances), guarded by one lock:
+#: growth is rare (log2 buckets x slots), reads go through the gauge.
+_footprint_lock = threading.Lock()
+_footprint_bytes = 0
+
+
+def _account(nbytes: int) -> None:
+    global _footprint_bytes
+    with _footprint_lock:
+        _footprint_bytes += nbytes
+        _M_ARENA_BYTES.set(_footprint_bytes)
+
+
+class StagingArena:
+    """Persistent pow2-bucketed host staging buffers with slot
+    rotation.
+
+    acquire(key, fields) returns a dict of named numpy buffers for
+    one transfer batch.  The first acquire of a (key, shapes) bucket
+    allocates `slots` copies; every later acquire rotates through
+    them and returns the SAME arrays — the caller overwrites the rows
+    it stages and relies on its device kernel's validity masking (a
+    row-count field, not zeroed padding) to ignore stale bytes, so
+    steady state performs zero allocations and zero full-buffer
+    clears.
+
+    Rotation is the double-buffer contract: with `slots` >= the
+    consumer's in-flight depth, a slot is never rewritten before the
+    upload that read it resolved, so batch k can be staged while
+    batch k-1's H2D/verdict round-trip is still in flight.  Buffers
+    are ordinary page-locked-by-the-OS numpy memory ("pinned" in the
+    CUDA sense is not a JAX host API; what matters here is identity —
+    the transfer layer sees a stable address instead of a fresh
+    allocation per batch).
+
+    Not thread-safe by itself: each consumer owns its arena and
+    serializes acquires under its own lock (the triage device lock,
+    the pipeline corpus lock)."""
+
+    __slots__ = ("slots", "_bufs", "_turn", "allocations", "nbytes")
+
+    def __init__(self, slots: int = 2):
+        self.slots = max(1, int(slots))
+        # (key, shape/dtype signature) -> [slot][field] -> ndarray
+        self._bufs: dict = {}
+        self._turn: dict = {}
+        self.allocations = 0  # growth events (tests pin steady state)
+        self.nbytes = 0
+
+    def acquire(self, key, fields: dict) -> dict:
+        """Staging buffers for one batch.  `fields` maps field name ->
+        (shape, dtype); shape[0] is the caller's pow2 row bucket so
+        the signature set stays bounded.  Returns {name: ndarray}."""
+        sig = (key, tuple(sorted(
+            (n, tuple(s), np.dtype(d).str) for n, (s, d) in fields.items())))
+        slots = self._bufs.get(sig)
+        if slots is None:
+            slots = []
+            grew = 0
+            for _ in range(self.slots):
+                bufs = {n: np.zeros(s, dtype=d)
+                        for n, (s, d) in fields.items()}
+                grew += sum(b.nbytes for b in bufs.values())
+                slots.append(bufs)
+            self._bufs[sig] = slots
+            self._turn[sig] = 0
+            self.allocations += 1
+            self.nbytes += grew
+            _M_ARENA_ALLOCS.inc()
+            _account(grew)
+        turn = self._turn[sig]
+        self._turn[sig] = (turn + 1) % len(slots)
+        return slots[turn]
+
+    def bucket_count(self) -> int:
+        return len(self._bufs)
+
+
+class DepthController:
+    """Clamped, hysteretic controller for the pipeline's
+    drain->assemble overlap depth.
+
+    The signal is the measured span ratio D2H : assembly —
+    `pipeline.pool_drain` p50 over `pipeline.assemble_worker` p50
+    from the process registry (the histograms PR 3 already records).
+    When the pool fetch dominates, the assembly pool is idling behind
+    the link: raising `assemble_depth` keeps more drained batches
+    fanned out while the drain thread blocks in the next fetch.  When
+    assembly dominates, extra depth only pins batch arenas in memory:
+    lower it back toward 1.
+
+    Hysteresis (raise above `raise_ratio`, lower below `lower_ratio`,
+    and a `cooldown` of update calls between moves) keeps the depth
+    from flapping on noisy percentiles; `min_samples` keeps it inert
+    until both histograms carry real data, so a fresh pipeline (and
+    the tier-1 suite) runs at the initial depth.  update() allocates
+    nothing and never touches the device — zero jits by
+    construction."""
+
+    __slots__ = ("depth", "lo", "hi", "raise_ratio", "lower_ratio",
+                 "min_samples", "cooldown", "interval", "_calls",
+                 "_cool", "_drain_hist", "_work_hist")
+
+    def __init__(self, initial: int = 2, lo: int = 1, hi: int = 4,
+                 raise_ratio: float = 1.3, lower_ratio: float = 0.6,
+                 min_samples: int = 32, cooldown: int = 4,
+                 interval: int = 8, drain_hist=None, work_hist=None):
+        self.lo = max(1, lo)
+        self.hi = max(self.lo, hi)
+        self.depth = min(self.hi, max(self.lo, initial))
+        self.raise_ratio = raise_ratio
+        self.lower_ratio = lower_ratio
+        self.min_samples = min_samples
+        self.cooldown = max(0, cooldown)
+        self.interval = max(1, interval)
+        self._calls = 0
+        self._cool = 0
+        self._drain_hist = drain_hist if drain_hist is not None else \
+            telemetry.REGISTRY.histogram(
+                telemetry.span_metric_name("pipeline.pool_drain"))
+        self._work_hist = work_hist if work_hist is not None else \
+            telemetry.REGISTRY.histogram(
+                telemetry.span_metric_name("pipeline.assemble_worker"))
+        _M_ASSEMBLE_DEPTH.set(self.depth)
+
+    def update(self) -> int:
+        """One controller tick (the pipeline worker calls this per
+        collected batch; only every `interval`-th tick evaluates).
+        Returns the current depth."""
+        self._calls += 1
+        if self._calls % self.interval:
+            return self.depth
+        if self._cool > 0:
+            self._cool -= 1
+            return self.depth
+        if self._drain_hist.count < self.min_samples or \
+                self._work_hist.count < self.min_samples:
+            return self.depth
+        drain = self._drain_hist.percentile(0.5)
+        work = self._work_hist.percentile(0.5)
+        if work <= 0.0:
+            return self.depth
+        ratio = drain / work
+        moved = None
+        if ratio > self.raise_ratio and self.depth < self.hi:
+            self.depth += 1
+            moved = "raise"
+        elif ratio < self.lower_ratio and self.depth > self.lo:
+            self.depth -= 1
+            moved = "lower"
+        if moved:
+            self._cool = self.cooldown
+            _M_ASSEMBLE_DEPTH.set(self.depth)
+            telemetry.record_event(
+                "staging.assemble_depth",
+                f"{moved} to {self.depth} (d2h/assembly p50 ratio "
+                f"{ratio:.2f})")
+        return self.depth
+
+
+def resolve_assemble_depth(default: int):
+    """Parse TZ_ASSEMBLE_DEPTH=auto|N (health.envsafe discipline):
+    returns (depth, controller) where controller is a DepthController
+    seeded at `depth` for auto mode and None for a pinned depth.
+    Unset and malformed values both resolve to auto at the compiled-in
+    default — self-tuning is the production behavior, a typo must not
+    change it."""
+    v = env_auto_int("TZ_ASSEMBLE_DEPTH", None)
+    if v is None:
+        ctrl = DepthController(initial=max(1, default))
+        return ctrl.depth, ctrl
+    depth = max(1, v)
+    _M_ASSEMBLE_DEPTH.set(depth)
+    return depth, None
+
+
+def note_dispatch_depth(depth: int) -> None:
+    """Record the triage engine's effective H2D dispatch depth (the
+    gauge bench_watch's transfer-plane line renders)."""
+    _M_DISPATCH_DEPTH.set(depth)

@@ -138,6 +138,25 @@ def wedge_report(snap: dict) -> list[str]:
         lines.append(
             f"host assembly: {mutants / asm['sum']:.0f} mutants/s "
             f"over {asm['count']} batches")
+    # Transfer plane (the pinned-staging + overlap PR): arena
+    # footprint, the two live depths, and the realized triage H2D
+    # overlap — next to the d2h/assembly lines so an A/B between
+    # snapshots localizes a transfer-side regression.
+    arena = gauges.get("tz_staging_arena_bytes") or 0
+    a_depth = gauges.get("tz_staging_assemble_depth") or 0
+    d_depth = gauges.get("tz_staging_h2d_dispatch_depth") or 0
+    if arena or a_depth or d_depth:
+        line = (f"transfer plane: arenas {arena / 1024:.1f} KiB, "
+                f"assemble depth {int(a_depth)}, "
+                f"h2d dispatch depth {int(d_depth)}")
+        t_batches = counters.get("tz_triage_batches_total") or 0
+        overlaps = counters.get("tz_triage_h2d_overlap_total") or 0
+        if t_batches:
+            line += f", h2d overlap {overlaps / t_batches:.1%}"
+        stale = counters.get("tz_triage_stale_slots_total") or 0
+        if stale:
+            line += f", {int(stale)} stale slots"
+        lines.append(line)
     # Triage plane health (ISSUE 4): pre-filter hit rate and the
     # realized device-checked call rate — next to the demotion count
     # so a CPU-path regression is visible in the same A/B snapshot.

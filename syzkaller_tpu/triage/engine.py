@@ -19,6 +19,15 @@ This engine makes them the hot path:
     the H2D sync and the dispatch, and the shapes are pinned
     (B = TZ_TRIAGE_BATCH, E = TZ_TRIAGE_MAX_EDGES) so nothing ever
     re-jits,
+  - the flush leader stages rows through the shared transfer plane
+    (ops/staging): padded batches are written IN PLACE into
+    persistent pow2-bucketed arena slots (no per-flush allocation or
+    re-pad), and up to TZ_TRIAGE_DISPATCH_DEPTH uploads fly ahead of
+    the oldest batch's verdict fetch, so batch k's H2D overlaps batch
+    k-1's in-flight novel_any — the triage twin of the pipeline's
+    dispatch_depth.  Verdicts resolve in strict dispatch order; depth
+    1 is the serial fallback, and the effective depth demotes to 1
+    whenever the breaker is not closed,
   - calls the plane flags as possibly-novel (and calls whose signal
     exceeds the E budget) fall through to the exact CPU Signal diff
     under the fuzzer lock — max_signal/new_signal bookkeeping and
@@ -55,6 +64,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Optional
 
@@ -67,9 +77,12 @@ from syzkaller_tpu.health import (
     env_float,
     env_int,
     fault_point,
+    warn_unknown_tz_vars,
 )
 from syzkaller_tpu.health.breaker import CLOSED
 from syzkaller_tpu.ops import signal as dsig
+from syzkaller_tpu.ops.delta import pow2_rows
+from syzkaller_tpu.ops.staging import StagingArena, note_dispatch_depth
 from syzkaller_tpu.utils import log
 
 # Triage-path telemetry (docs/observability.md): counts at each fork
@@ -102,6 +115,14 @@ _M_REPROMOTIONS = telemetry.counter(
 _M_REBUILDS = telemetry.counter(
     "tz_triage_plane_rebuilds_total",
     "device plane re-uploads from the host mirror")
+_M_H2D_OVERLAPS = telemetry.counter(
+    "tz_triage_h2d_overlap_total",
+    "batches whose H2D upload was dispatched while a previous "
+    "batch's verdict fetch was still in flight")
+_M_STALE_SLOTS = telemetry.counter(
+    "tz_triage_stale_slots_total",
+    "in-flight staged batches invalidated by a plane rebuild "
+    "(whole chunk confirmed on CPU; zero lost signal)")
 _M_BATCH_SIZE = telemetry.gauge(
     "tz_triage_batch_size", "calls in the most recent device batch")
 _M_OCCUPANCY = telemetry.gauge(
@@ -124,6 +145,8 @@ class TriageStats:
     demotions: int = 0  # device->CPU transitions
     repromotions: int = 0  # CPU->device transitions
     plane_rebuilds: int = 0  # mirror re-uploads
+    h2d_overlaps: int = 0  # uploads dispatched over an in-flight fetch
+    stale_slots: int = 0  # in-flight batches invalidated by a rebuild
 
 
 class _Request:
@@ -160,17 +183,36 @@ class TriageEngine:
     TZ_TRIAGE_BATCH (calls per padded device batch), TZ_TRIAGE_MAX_EDGES
     (per-call edge budget; larger signals confirm on CPU directly),
     TZ_TRIAGE_FLUSH_S (leader linger to gather a fuller batch; 0 =
-    flush immediately).  TZ_TRIAGE_DEVICE=0 disables construction
+    flush immediately), TZ_TRIAGE_DISPATCH_DEPTH (staged H2D uploads
+    kept in flight ahead of the verdict fetch; 1 = serial, the
+    fallback/kill path).  TZ_TRIAGE_DEVICE=0 disables construction
     entirely (fuzzer/main.py)."""
 
     def __init__(self, batch: int = 256, max_edges: int = 512,
-                 flush_s: float = 0.0,
+                 flush_s: float = 0.0, dispatch_depth: int = 2,
                  breaker: Optional[CircuitBreaker] = None,
                  watchdog: Optional[Watchdog] = None,
                  owns_breaker: Optional[bool] = None):
         self.B = max(1, env_int("TZ_TRIAGE_BATCH", batch))
         self.E = max(8, env_int("TZ_TRIAGE_MAX_EDGES", max_edges))
         self.flush_s = max(0.0, env_float("TZ_TRIAGE_FLUSH_S", flush_s))
+        # Transfer plane (ops/staging, docs/perf.md "The transfer
+        # plane"): batch k's padded rows are written into a persistent
+        # pow2-bucketed arena slot and uploaded while batch k-1's
+        # novel_any verdicts are still in flight — the triage twin of
+        # the pipeline's dispatch_depth.  Depth 1 reproduces the
+        # serial flush (pad -> H2D -> verdict per chunk).  Slot count
+        # = depth, so a slot is never rewritten before its batch's
+        # verdicts resolved.
+        self._dispatch_depth = max(1, env_int(
+            "TZ_TRIAGE_DISPATCH_DEPTH", dispatch_depth))
+        self._arena = StagingArena(slots=self._dispatch_depth)
+        self._cols = np.arange(self.E, dtype=np.int32)
+        self._epoch = 0  # bumped by invalidate: stales in-flight slots
+        self._dispatch_seq = 0  # strict-FIFO verdict delivery order
+        self._resolve_seq = 0
+        note_dispatch_depth(self._dispatch_depth)
+        warn_unknown_tz_vars()
         # Standalone engines own their breaker and drive the full
         # closed->open->half-open->closed protocol themselves; an
         # engine sharing a pipeline's breaker (for_pipeline) only
@@ -248,15 +290,18 @@ class TriageEngine:
         """Drop the device plane; the next flush re-uploads the host
         mirror.  Called on device failures and by the pipeline's
         half-open ring rebuild (plane co-residency: a restarted
-        backend invalidated this buffer too)."""
+        backend invalidated this buffer too).  The epoch bump stales
+        every in-flight staged slot the same way: a batch uploaded
+        against the dead plane resolves as a full CPU confirm instead
+        of trusting verdicts from invalidated buffers."""
         self._plane_dev = None
+        self._epoch += 1
 
     def _bucket(self, n: int) -> int:
         """Pow2 row-count bucket in [8, B]: small submissions ship
         small transfers (the tunneled link charges per byte) while
         the distinct compiled shapes stay bounded at log2(B/8)+1."""
-        b = 1 << max(0, (max(n, 8) - 1).bit_length())
-        return min(b, self.B)
+        return pow2_rows(n, lo=min(8, self.B), hi=self.B)
 
     def _ensure_plane_locked(self):
         """Device plane ready for a diff (holds _device_lock): rebuild
@@ -411,29 +456,63 @@ class TriageEngine:
             else:
                 req.done.wait(timeout=0.02)
 
+    def _effective_depth(self) -> int:
+        """H2D uploads kept in flight ahead of the verdict fetch.
+        Demote-to-serial on anything but a closed breaker — probes
+        and recovering backends fly one batch end to end, symmetric
+        with the pipeline worker's probe depth and PipelineMutator's
+        fast-demote."""
+        depth = self._dispatch_depth \
+            if self.breaker.state == CLOSED else 1
+        note_dispatch_depth(depth)
+        return depth
+
     def _drain_staged(self, req: _Request) -> None:
-        while not req.done.is_set():
-            if self.flush_s > 0:
-                deadline = time.monotonic() + self.flush_s
-                while time.monotonic() < deadline:
-                    with self._stage_lock:
-                        if len(self._staged) >= self.B:
-                            break
-                    time.sleep(min(0.001, self.flush_s))
-            with self._stage_lock:
-                chunk = self._staged[:self.B]
-                del self._staged[:len(chunk)]
-            if not chunk:
+        """Drive staged chunks through the transfer plane (holds
+        _device_lock).  Up to `_effective_depth()` chunks are staged +
+        uploaded + dispatched before the oldest chunk's verdicts are
+        fetched, so batch k's H2D overlaps batch k-1's in-flight
+        novel_any; verdicts always resolve in strict dispatch (seq)
+        order, and every chunk this leader dispatched is resolved by
+        this leader before it returns."""
+        inflight: deque = deque()
+        try:
+            while not req.done.is_set():
+                if self.flush_s > 0 and not inflight:
+                    deadline = time.monotonic() + self.flush_s
+                    while time.monotonic() < deadline:
+                        with self._stage_lock:
+                            if len(self._staged) >= self.B:
+                                break
+                        time.sleep(min(0.001, self.flush_s))
+                with self._stage_lock:
+                    chunk = self._staged[:self.B]
+                    del self._staged[:len(chunk)]
+                if chunk:
+                    while len(inflight) >= self._effective_depth():
+                        self._resolve_chunk(inflight.popleft())
+                    handle = self._dispatch_chunk(
+                        chunk, overlapping=bool(inflight))
+                    if handle is not None:
+                        inflight.append(handle)
+                    continue
+                if inflight:
+                    self._resolve_chunk(inflight.popleft())
+                    continue
                 return  # a previous leader resolved the rest
-            self._run_chunk(chunk)
+        finally:
+            while inflight:
+                self._resolve_chunk(inflight.popleft())
 
-    def _run_chunk(self, chunk: list[_Entry]) -> None:
-        """One padded device batch (holds _device_lock).  Any failure
-        marks the whole chunk for exact CPU confirm — degraded
-        throughput, zero lost signal — and feeds the breaker."""
-        import jax.numpy as jnp
-
-        with telemetry.span("triage.device"):
+    def _dispatch_chunk(self, chunk: list[_Entry], overlapping=False):
+        """Stage one padded batch into a persistent arena slot, upload
+        it, and dispatch novel_any — the non-blocking half of a batch
+        (XLA returns async; the verdict fetch is _resolve_chunk).  Any
+        failure marks the whole chunk for exact CPU confirm — degraded
+        throughput, zero lost signal — and feeds the breaker.  Returns
+        an in-flight handle, or None when the chunk already resolved
+        on the failure path."""
+        with telemetry.span("triage.h2d_wait"):
             try:
                 fault_point("device.triage")
                 if self.owns_breaker and self.breaker.consume_rebuild():
@@ -441,25 +520,45 @@ class TriageEngine:
                 self._ensure_plane_locked()
                 b = self._bucket(len(chunk))
                 k = len(chunk)
-                lens = np.array([en.edges.size for en in chunk],
-                                dtype=np.int32)
-                edges = np.zeros((b, self.E), dtype=np.uint32)
-                # One ragged scatter instead of a per-row copy loop.
-                edges[:k][np.arange(self.E)[None, :] < lens[:, None]] \
-                    = np.concatenate([en.edges for en in chunk])
-                nedges = np.zeros(b, dtype=np.int32)
-                nedges[:k] = lens
-                prios = np.zeros(b, dtype=np.uint8)
-                prios[:k] = [en.prio for en in chunk]
+                # Persistent pre-padded staging (ops/staging): rows
+                # land IN PLACE in the bucket's rotating slot; stale
+                # bytes beyond a row's edge count are masked by the
+                # kernel's validity test, so nothing is re-zeroed and
+                # nothing bucket-sized is allocated per flush.
+                bufs = self._arena.acquire(b, {
+                    "edges": ((b, self.E), np.uint32),
+                    "nedges": ((b,), np.int32),
+                    "prios": ((b,), np.uint8),
+                    "mask": ((b, self.E), np.bool_),
+                    "flat": ((b * self.E,), np.uint32),
+                })
+                edges, nedges = bufs["edges"], bufs["nedges"]
+                nedges[:k] = [en.edges.size for en in chunk]
+                nedges[k:] = 0
+                bufs["prios"][:k] = [en.prio for en in chunk]
+                # One ragged scatter instead of a per-row copy loop,
+                # with the mask and the flattened payload written into
+                # arena scratch instead of fresh temporaries.
+                lens = nedges[:k]
+                total = int(lens.sum())
+                if total:
+                    mask = bufs["mask"][:k]
+                    np.less(self._cols[None, :], lens[:, None],
+                            out=mask)
+                    np.concatenate([en.edges for en in chunk],
+                                   out=bufs["flat"][:total])
+                    edges[:k][mask] = bufs["flat"][:total]
                 plane = self._plane_dev
-                flags = self.watchdog.call(
-                    lambda: np.asarray(dsig.novel_any(
-                        plane, jnp.asarray(edges), jnp.asarray(nedges),
-                        jnp.asarray(prios))),
+                fault_point("staging.h2d")
+                ed, nd, pr = dsig.stage_batch(
+                    edges, nedges, bufs["prios"])
+                flags_dev = self.watchdog.call(
+                    lambda: dsig.novel_any(plane, ed, nd, pr),
                     "device.triage", compile=not self._compiled)
                 self._compiled = True
             except Exception as e:
                 self._plane_dev = None  # buffers may be invalid now
+                self._epoch += 1
                 self.stats.device_errors += 1
                 _M_ERRORS.inc()
                 self.breaker.record_failure()
@@ -467,6 +566,51 @@ class TriageEngine:
                          self.breaker.state, str(e)[:200])
                 for en in chunk:
                     en.flagged = True  # exact CPU confirm: no loss
+                    self._complete(en)
+                return None
+        if overlapping:
+            self.stats.h2d_overlaps += 1
+            _M_H2D_OVERLAPS.inc()
+        seq = self._dispatch_seq
+        self._dispatch_seq += 1
+        return (seq, chunk, flags_dev, self._epoch)
+
+    def _resolve_chunk(self, handle) -> None:
+        """Fetch and deliver one in-flight batch's verdicts (holds
+        _device_lock; strictly FIFO — the deque in _drain_staged and
+        the leader-serializing device lock make seq monotonic).  A
+        handle staled by a plane rebuild resolves as a full CPU
+        confirm without feeding the breaker: invalidation is recovery
+        bookkeeping, not a device failure."""
+        seq, chunk, flags_dev, epoch = handle
+        if seq != self._resolve_seq:  # pragma: no cover - invariant
+            log.logf(0, "triage verdict order broke: resolving seq %d "
+                        "expected %d", seq, self._resolve_seq)
+        self._resolve_seq = seq + 1
+        with telemetry.span("triage.device"):
+            if epoch != self._epoch:
+                # Rebuilt mid-flight (pipeline half-open re-entry or a
+                # failed sibling batch): the verdicts were computed
+                # against an invalidated plane/backend.
+                self.stats.stale_slots += 1
+                _M_STALE_SLOTS.inc()
+                for en in chunk:
+                    en.flagged = True  # exact CPU confirm: no loss
+                    self._complete(en)
+                return
+            try:
+                flags = self.watchdog.call(
+                    lambda: np.asarray(flags_dev), "device.triage")
+            except Exception as e:
+                self._plane_dev = None
+                self._epoch += 1
+                self.stats.device_errors += 1
+                _M_ERRORS.inc()
+                self.breaker.record_failure()
+                log.logf(0, "triage device error (breaker %s): %s",
+                         self.breaker.state, str(e)[:200])
+                for en in chunk:
+                    en.flagged = True
                     self._complete(en)
                 return
         if self.owns_breaker:
@@ -531,6 +675,10 @@ class TriageEngine:
             "demotions": s.demotions,
             "repromotions": s.repromotions,
             "plane_rebuilds": s.plane_rebuilds,
+            "h2d_overlaps": s.h2d_overlaps,
+            "stale_slots": s.stale_slots,
+            "dispatch_depth": self._dispatch_depth,
+            "staging_arena_bytes": self._arena.nbytes,
             "plane_occupancy": self._occupancy,
             "fold_false_negative_rate":
                 self._occupancy / dsig.PLANE_SIZE,

@@ -137,6 +137,31 @@ def test_wedge_report_phase_percentiles_and_timeline():
     assert "watchdog.wedge" in text
 
 
+def test_wedge_report_transfer_plane_line():
+    """The transfer-plane diagnostics (ISSUE 5): arena footprint,
+    both live depths, the realized triage H2D overlap, and stale
+    slots render next to the d2h/assembly lines."""
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    reg.gauge("tz_staging_arena_bytes").set(163840)
+    reg.gauge("tz_staging_assemble_depth").set(3)
+    reg.gauge("tz_staging_h2d_dispatch_depth").set(2)
+    reg.counter("tz_triage_batches_total").inc(40)
+    reg.counter("tz_triage_h2d_overlap_total").inc(20)
+    reg.counter("tz_triage_stale_slots_total").inc(1)
+    lines = bw.wedge_report(reg.snapshot())
+    line = next(ln for ln in lines if ln.startswith("transfer plane"))
+    assert "arenas 160.0 KiB" in line
+    assert "assemble depth 3" in line
+    assert "h2d dispatch depth 2" in line
+    assert "h2d overlap 50.0%" in line
+    assert "1 stale slots" in line
+    # a snapshot without transfer-plane gauges renders no line
+    assert not any(ln.startswith("transfer plane")
+                   for ln in bw.wedge_report(_wedge_snapshot()))
+
+
 def test_wedge_report_empty_snapshot():
     lines = bw.wedge_report({"ts": 0, "counters": {}, "gauges": {},
                              "histograms": {}, "events": []})

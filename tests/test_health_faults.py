@@ -579,6 +579,208 @@ def test_triage_engine_coresident_with_pipeline_rebuild(device_rig):
         pl.triage_engine = None  # the module-scoped rig lives on
 
 
+# -- the transfer plane (ISSUE 5) -----------------------------------------
+
+
+def _mk_infos(rng, n, size=16):
+    import numpy as np
+
+    from syzkaller_tpu.ops import signal as dsig
+
+    class _Info:
+        __slots__ = ("call_index", "errno", "signal")
+
+        def __init__(self, ci, sig):
+            self.call_index = ci
+            self.errno = 0
+            self.signal = sig
+
+    return [_Info(c, rng.randint(0, 1 << dsig.FOLD_BITS, size=size,
+                                 dtype=np.uint32))
+            for c in range(n)]
+
+
+def test_staging_h2d_fault_mid_overlap_strict_delivery():
+    """ISSUE 5: scripted `staging.h2d` faults while uploads overlap
+    the previous batch's in-flight verdict fetch must not reorder or
+    drop verdicts — every staged call resolves exactly once, results
+    stay byte-identical to the CPU path (a failed chunk confirms on
+    CPU — zero lost signal), and the tripped breaker demotes the
+    dispatch depth to serial until a probe re-closes it."""
+    import numpy as np
+
+    from syzkaller_tpu.fuzzer import Fuzzer, WorkQueue
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.triage import TriageEngine
+
+    target = get_target("test", "64")
+    br = CircuitBreaker(failure_threshold=2, backoff_initial=0.05,
+                        backoff_cap=0.1, jitter=0.0, seed=1)
+    eng = TriageEngine(batch=8, max_edges=64, dispatch_depth=2,
+                       breaker=br, watchdog=Watchdog(deadline_s=0),
+                       owns_breaker=True)
+    fz = Fuzzer(target, wq=WorkQueue())
+    fz.set_triage(eng)
+    ref = Fuzzer(target, wq=WorkQueue())
+    rng = np.random.RandomState(4)
+    prio_fn = (lambda errno, idx: 3)
+    # Upload 1 is clean; uploads 2-3 fail MID-OVERLAP (each check
+    # stages 24 calls = 3 chunks at B=8, so chunk 2's upload flies
+    # while chunk 1's verdicts are still in flight).  The failure
+    # streak trips the threshold-2 breaker; later uploads are clean.
+    install_plan(FaultPlan.parse("staging.h2d:fail@2-3"))
+    saw_open = False
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        infos = _mk_infos(rng, 24)
+        a = fz.check_new_signal_fn(prio_fn, infos)
+        b = ref.cpu_check_new_signal(prio_fn, infos)
+        assert [(ci, d.m) for ci, d in a] == [(ci, d.m) for ci, d in b]
+        saw_open = saw_open or br.is_open()
+        if br.state == CLOSED and eng.stats.repromotions >= 1:
+            break
+        time.sleep(0.02)
+    assert saw_open, "breaker never opened on the scripted streak"
+    assert fz.max_signal.m == ref.max_signal.m  # zero lost signal
+    assert fz.new_signal.m == ref.new_signal.m
+    snap = eng.snapshot()
+    assert snap["device_errors"] >= 2
+    assert snap["h2d_overlaps"] >= 1, "faults never hit mid-overlap"
+    # Strict seq delivery: every dispatched batch resolved, in order,
+    # none dropped (failed chunks never got a seq — they resolved on
+    # the CPU-confirm path inside the dispatch).
+    assert eng._resolve_seq == eng._dispatch_seq
+    assert br.state == CLOSED and not snap["demoted"]
+    # Demote-to-serial: a non-closed breaker caps the depth at 1,
+    # symmetric with PipelineMutator/TriageEngine CPU demotion.
+    br.record_failure()
+    br.record_failure()
+    assert br.is_open()
+    assert eng._effective_depth() == 1
+    br.record_success()  # half-open bookkeeping done; restore
+    assert eng._effective_depth() == eng._dispatch_depth == 2
+
+
+def test_plane_rebuild_stales_inflight_staged_slot(device_rig):
+    """ISSUE 5: a pipeline half-open ring rebuild with a batch
+    sitting in the second buffer slot (dispatched, verdicts not yet
+    fetched) must lose zero signal: the epoch bump stales the
+    in-flight handle and it resolves as a full CPU confirm — without
+    counting a device failure against the shared breaker."""
+    import numpy as np
+
+    from syzkaller_tpu.ops import signal as dsig
+    from syzkaller_tpu.triage import TriageEngine
+    from syzkaller_tpu.triage.engine import _Entry, _Request
+
+    _target, pl = device_rig
+    eng = TriageEngine.for_pipeline(pl, batch=8, max_edges=64,
+                                    dispatch_depth=2)
+    try:
+        rng = np.random.RandomState(6)
+        req = _Request(4)
+        entries = [
+            _Entry(rng.randint(0, 1 << dsig.FOLD_BITS, size=12,
+                               dtype=np.uint32), 3, req)
+            for _ in range(4)]
+        failures0 = pl.breaker.counters.failures
+        with eng._device_lock:
+            handle = eng._dispatch_chunk(entries)
+            assert handle is not None  # in flight in its arena slot
+            pl._reset_device_state()  # the half-open rebuild path
+            assert eng._plane_dev is None
+            eng._resolve_chunk(handle)
+        assert req.done.is_set(), "staled batch never resolved"
+        assert all(en.flagged for en in entries), \
+            "staled batch must confirm every call on CPU (zero loss)"
+        assert eng.stats.stale_slots == 1
+        assert eng.stats.device_batches == 0  # not a verdict batch
+        # Invalidation is recovery bookkeeping, not a device failure.
+        assert pl.breaker.counters.failures == failures0
+    finally:
+        pl.triage_engine = None  # the module-scoped rig lives on
+
+
+def test_transfer_plane_zero_new_jits_on_warm_pipeline(device_rig):
+    """ISSUE 5 compile-count guard: staging-arena growth,
+    dispatch-depth changes, and depth-controller adjustments are all
+    host-only — zero new jit compiles on a warm pipeline.  Pinned via
+    the jitted callables' cache sizes (the pow2 bucketing is what
+    keeps every transfer shape inside the already-compiled set)."""
+    import numpy as np
+
+    from syzkaller_tpu.ops import signal as dsig
+    from syzkaller_tpu.ops.staging import DepthController
+    from syzkaller_tpu.telemetry.registry import Histogram
+    from syzkaller_tpu.triage import TriageEngine
+    from syzkaller_tpu.triage.engine import _Entry, _Request
+
+    _target, pl = device_rig
+    eng = TriageEngine.for_pipeline(pl, batch=8, max_edges=64,
+                                    dispatch_depth=2)
+    rng = np.random.RandomState(8)
+
+    def run_chunk():
+        req = _Request(3)
+        entries = [
+            _Entry(rng.randint(0, 1 << dsig.FOLD_BITS, size=10,
+                               dtype=np.uint32), 3, req)
+            for _ in range(3)]
+        with eng._device_lock:
+            h = eng._dispatch_chunk(entries)
+            assert h is not None
+            eng._resolve_chunk(h)
+        assert req.done.is_set()
+
+    saved_depth = pl._dispatch_depth
+    try:
+        run_chunk()  # warm novel_any + the plane upload once
+        caches0 = (pl._step._cache_size(),
+                   dsig.novel_any._cache_size(),
+                   dsig.merge_into._cache_size(),
+                   dsig.diff_batch._cache_size())
+
+        # 1) staging-arena growth: new host buckets on both arenas.
+        pl._staging.acquire(("corpus", 4),
+                            {"idx": ((4,), np.int32)})
+        eng._arena.acquire(16, {"edges": ((16, 64), np.uint32)})
+
+        # 2) dispatch-depth changes on the live engines.
+        eng._dispatch_depth = 1
+        run_chunk()
+        eng._dispatch_depth = 2
+        run_chunk()
+        pl._dispatch_depth = 2
+        batch = pl.next_batch(timeout=300)
+        assert batch
+
+        # 3) depth-controller adjustments (forced moves) + applying a
+        # changed assemble depth to the live worker.
+        drain, work = Histogram("d"), Histogram("w")
+        for _ in range(64):
+            drain.observe(0.1)
+            work.observe(0.01)
+        ctrl = DepthController(initial=1, interval=1, cooldown=0,
+                               drain_hist=drain, work_hist=work)
+        assert ctrl.update() == 2 and ctrl.update() == 3
+        old_depth = pl._assemble_depth
+        pl._assemble_depth = 3
+        batch = pl.next_batch(timeout=300)
+        assert batch
+        pl._assemble_depth = old_depth
+
+        caches = (pl._step._cache_size(),
+                  dsig.novel_any._cache_size(),
+                  dsig.merge_into._cache_size(),
+                  dsig.diff_batch._cache_size())
+        assert caches == caches0, \
+            f"transfer-plane knobs triggered new jits: {caches0} -> " \
+            f"{caches}"
+    finally:
+        pl._dispatch_depth = saved_depth
+        pl.triage_engine = None
+
+
 # -- rpc seams ------------------------------------------------------------
 
 

@@ -1,0 +1,235 @@
+"""The shared transfer plane (ops/staging, ISSUE 5): persistent
+pow2-bucketed staging arenas with slot rotation, the self-tuning
+assemble-depth controller, and the hardened knob parsing + unknown-
+knob typo guard that ride along.
+
+All host-only numpy/stdlib — no device work, no jit compiles, so the
+whole module costs milliseconds inside the tier-1 suite."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.health import envsafe
+from syzkaller_tpu.ops.delta import pow2_rows
+from syzkaller_tpu.ops.staging import (
+    DepthController,
+    StagingArena,
+    resolve_assemble_depth,
+)
+from syzkaller_tpu.telemetry.registry import Histogram
+
+# -- pow2 bucketing (the one rule every transfer follows) -----------------
+
+
+def test_pow2_rows():
+    assert pow2_rows(1) == 1
+    assert pow2_rows(3) == 4
+    assert pow2_rows(8) == 8
+    assert pow2_rows(9) == 16
+    assert pow2_rows(5, lo=8) == 8
+    assert pow2_rows(0, lo=4) == 4
+    assert pow2_rows(9, lo=8, hi=256) == 16
+    assert pow2_rows(999, hi=256) == 256
+
+
+# -- staging arena --------------------------------------------------------
+
+_FIELDS = {"edges": ((8, 64), np.uint32), "n": ((8,), np.int32)}
+
+
+def test_arena_rotates_slots_and_reuses_buffers():
+    """The double-buffer contract: consecutive acquires of one bucket
+    return DIFFERENT slots (batch k stages while batch k-1's upload
+    is in flight), and rotation reuses the same arrays forever — one
+    allocation event per bucket, then flat."""
+    a = StagingArena(slots=2)
+    s0 = a.acquire("k", _FIELDS)
+    s1 = a.acquire("k", _FIELDS)
+    s2 = a.acquire("k", _FIELDS)
+    assert s0["edges"].shape == (8, 64)
+    assert s0["edges"] is not s1["edges"]  # slot pair
+    assert s0["edges"] is s2["edges"]  # rotation wraps
+    assert a.allocations == 1
+    nbytes0 = a.nbytes
+    assert nbytes0 == 2 * sum(
+        np.zeros(s, d).nbytes for s, d in _FIELDS.values())
+    for _ in range(16):
+        a.acquire("k", _FIELDS)
+    assert a.allocations == 1 and a.nbytes == nbytes0
+
+
+def test_arena_growth_and_key_isolation():
+    """A new bucket (or a new consumer key) is one growth event; the
+    buffers never alias across buckets or keys."""
+    a = StagingArena(slots=1)
+    small = a.acquire("k", _FIELDS)
+    big_fields = {"edges": ((16, 64), np.uint32), "n": ((16,), np.int32)}
+    big = a.acquire("k", big_fields)
+    other = a.acquire("other", _FIELDS)
+    assert a.allocations == 3 and a.bucket_count() == 3
+    assert big["edges"].shape == (16, 64)
+    assert other["edges"] is not small["edges"]
+    # in-place writes persist across acquires (slots=1: same buffer)
+    small["n"][:] = 7
+    assert (a.acquire("k", _FIELDS)["n"] == 7).all()
+
+
+def test_arena_single_slot_floor():
+    a = StagingArena(slots=0)  # clamped to 1
+    assert a.acquire("k", _FIELDS)["n"] is a.acquire("k", _FIELDS)["n"]
+
+
+# -- depth controller -----------------------------------------------------
+
+
+def _hist(values):
+    h = Histogram("h")
+    for v in values:
+        h.observe(v)
+    return h
+
+
+def _ctrl(drain, work, **kw):
+    kw.setdefault("initial", 2)
+    kw.setdefault("interval", 1)
+    kw.setdefault("cooldown", 0)
+    kw.setdefault("min_samples", 32)
+    return DepthController(drain_hist=_hist(drain), work_hist=_hist(work),
+                           **kw)
+
+
+def test_depth_controller_raises_when_d2h_dominates():
+    """The pool idling behind D2H (drain p50 >> assembly p50) raises
+    the depth one step per evaluation, clamped at hi."""
+    c = _ctrl([0.1] * 64, [0.01] * 64, lo=1, hi=4)
+    assert c.update() == 3
+    assert c.update() == 4
+    assert c.update() == 4  # clamped
+
+
+def test_depth_controller_lowers_when_assembly_dominates():
+    c = _ctrl([0.01] * 64, [0.1] * 64, initial=3, lo=1, hi=4)
+    assert c.update() == 2
+    assert c.update() == 1
+    assert c.update() == 1  # clamped at lo
+
+
+def test_depth_controller_hysteresis_dead_zone():
+    """A ratio inside (lower_ratio, raise_ratio) never moves the
+    depth — noisy percentiles must not flap it."""
+    c = _ctrl([0.05] * 64, [0.05] * 64)
+    for _ in range(8):
+        assert c.update() == 2
+
+
+def test_depth_controller_inert_without_samples():
+    """A fresh pipeline (and the tier-1 suite) has empty histograms:
+    the controller stays at the seed depth."""
+    c = _ctrl([0.1] * 8, [0.01] * 8, min_samples=32)  # under the bar
+    for _ in range(8):
+        assert c.update() == 2
+
+
+def test_depth_controller_cooldown_and_interval():
+    """Moves are rate-limited: only every `interval`-th update
+    evaluates, and a move starts a cooldown of evaluations."""
+    c = _ctrl([0.1] * 64, [0.01] * 64, interval=2, cooldown=2)
+    assert c.update() == 2  # off-interval tick
+    assert c.update() == 3  # evaluates, raises
+    assert c.update() == 3  # off-interval
+    assert c.update() == 3  # cooling (1)
+    assert c.update() == 3  # off-interval
+    assert c.update() == 3  # cooling (2)
+    assert c.update() == 3  # off-interval
+    assert c.update() == 4  # cooled: raises again
+
+
+# -- knob parsing + typo guard --------------------------------------------
+
+
+def test_env_auto_int(monkeypatch):
+    monkeypatch.delenv("TZ_ASSEMBLE_DEPTH", raising=False)
+    assert envsafe.env_auto_int("TZ_ASSEMBLE_DEPTH", None) is None
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "auto")
+    assert envsafe.env_auto_int("TZ_ASSEMBLE_DEPTH", 3) is None
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "Auto")
+    assert envsafe.env_auto_int("TZ_ASSEMBLE_DEPTH", 3) is None
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "3")
+    assert envsafe.env_auto_int("TZ_ASSEMBLE_DEPTH", None) == 3
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "0x10")
+    assert envsafe.env_auto_int("TZ_ASSEMBLE_DEPTH", None) == 16
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "banana")
+    assert envsafe.env_auto_int("TZ_ASSEMBLE_DEPTH", None) is None
+    assert envsafe.env_auto_int("TZ_ASSEMBLE_DEPTH", 5) == 5
+
+
+def test_resolve_assemble_depth_env(monkeypatch):
+    """TZ_ASSEMBLE_DEPTH=auto|N (health.envsafe discipline): unset
+    and malformed both resolve to the self-tuning controller at the
+    compiled-in default; a pinned N disables it."""
+    monkeypatch.delenv("TZ_ASSEMBLE_DEPTH", raising=False)
+    depth, ctrl = resolve_assemble_depth(2)
+    assert depth == 2 and ctrl is not None and ctrl.depth == 2
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "3")
+    depth, ctrl = resolve_assemble_depth(2)
+    assert depth == 3 and ctrl is None
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "auto")
+    depth, ctrl = resolve_assemble_depth(4)
+    assert ctrl is not None and depth == ctrl.depth == 4
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "two")
+    depth, ctrl = resolve_assemble_depth(2)
+    assert depth == 2 and ctrl is not None  # malformed -> auto
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "0")
+    depth, ctrl = resolve_assemble_depth(2)
+    assert depth == 1 and ctrl is None  # floor at 1
+
+
+def test_unknown_tz_var_warned_once(monkeypatch):
+    """The typo guard: a TZ_* name no knob parses is flagged exactly
+    once per process; known knobs never are."""
+    name = "TZ_DEFINITELY_MISSPELLED_KNOB"
+    monkeypatch.setenv(name, "1")
+    monkeypatch.setenv("TZ_TRIAGE_DISPATCH_DEPTH", "2")  # known
+    with envsafe._warn_lock:
+        envsafe._warned.discard(name)
+    flagged = envsafe.warn_unknown_tz_vars()
+    assert name in flagged
+    assert "TZ_TRIAGE_DISPATCH_DEPTH" not in flagged
+    assert envsafe.warn_unknown_tz_vars() == []  # once per process
+
+
+def test_known_tz_registry_covers_engine_knobs():
+    """Every knob the engines parse is in the static seed — the guard
+    must be correct at engine START, before later parse sites run."""
+    for knob in ("TZ_TRIAGE_DISPATCH_DEPTH", "TZ_ASSEMBLE_DEPTH",
+                 "TZ_PIPELINE_DISPATCH_DEPTH", "TZ_ASSEMBLE_WORKERS",
+                 "TZ_FAULT_PLAN", "TZ_TRACE_FILE",
+                 "TZ_BENCH_WARMUP_TIMEOUT_S"):
+        assert knob in envsafe.KNOWN_TZ_VARS, knob
+
+
+# -- pipeline knob integration (no device: constructor-level) -------------
+
+
+def test_pipeline_assemble_depth_knob(monkeypatch):
+    """The pipeline resolves TZ_ASSEMBLE_DEPTH at construction:
+    pinned N disables the controller, auto enables it."""
+    pytest.importorskip("jax")
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
+
+    target = get_target("test", "64")
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "3")
+    pl = DevicePipeline(target, capacity=8, batch_size=4)
+    assert pl._assemble_depth == 3 and pl._depth_ctrl is None
+    assert pl.health_snapshot()["assemble_depth"] == 3
+    assert pl.health_snapshot()["assemble_depth_auto"] is False
+    pl.stop()
+    monkeypatch.setenv("TZ_ASSEMBLE_DEPTH", "auto")
+    pl = DevicePipeline(target, capacity=8, batch_size=4,
+                        assemble_depth=2)
+    assert pl._assemble_depth == 2 and pl._depth_ctrl is not None
+    assert pl.health_snapshot()["assemble_depth_auto"] is True
+    pl.stop()
