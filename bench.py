@@ -23,6 +23,10 @@ Modes:
                                    # per-mutant reference
   python bench.py --triage         # batched device-plane novelty
                                    # triage vs the CPU Signal path
+  python bench.py --profile        # per-kernel device ms/batch at the
+                                   # flagship shape (mutate,
+                                   # emit-compact, novel_any) — the
+                                   # Pallas-rewrite baseline
 """
 
 from __future__ import annotations
@@ -465,6 +469,115 @@ def bench_triage(calls_per_check=512, edges_per_call=64, checks=80,
     }
 
 
+def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
+                  seeds=64, steps=10, rounds=4,
+                  triage_batch=256, triage_edges=512) -> dict:
+    """Per-kernel device-time attribution at the flagship shape
+    (ISSUE 6; the measurement the ROADMAP's Pallas-rewrite item is
+    judged by).  Two views of the same kernels:
+
+      - isolated: each kernel dispatched alone on a warm pipeline and
+        timed around block_until_ready — `mutate` is the vmapped
+        mutation core by itself, `emit_compact` is the fused
+        step's pack+compact-pool share (fused minus mutate), and
+        `novel_any` is the triage predicate at the production
+        (TZ_TRIAGE_BATCH, TZ_TRIAGE_MAX_EDGES) shape,
+      - always_on: what the in-loop profiler (telemetry/profiler.py)
+        attributed while the warmup batches ran — the EWMA gauges
+        exported as `tz_device_kernel_ms_per_batch{kernel=...}`.
+        Host-observed dispatch→ready latencies, so on an async
+        backend they include queue + transfer residency; the isolated
+        numbers are the pure-kernel baseline to subtract against.
+    """
+    import jax
+    import numpy as np
+    from jax import random
+
+    from syzkaller_tpu import telemetry
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops import signal as dsig
+    from syzkaller_tpu.ops.mutate import _mutate_one
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
+
+    target = get_target("test", "64")
+    pl = DevicePipeline(target, capacity=capacity,
+                        batch_size=batch_size, rounds=rounds, seed=0)
+    added, i = 0, 0
+    while added < seeds and i < seeds * 8:
+        if pl.add(_seed_programs(target, 1, seed0=42 + i)[0]):
+            added += 1
+        i += 1
+    assert added > 0, "no seed programs tensorized"
+    try:
+        # Warm the integrated path INLINE (no worker thread competing
+        # with the timed loops) — this also feeds the always-on
+        # profiler, whose EWMAs are reported alongside.
+        for _ in range(3):
+            pl._drain(pl._launch())
+        corpus, n, _tmpl, _ets = pl._flush_pending()
+        fv, fc = pl._flags_dev
+        key = random.key(123)
+
+        def timed(fn, warm=2):
+            for i in range(warm):
+                jax.block_until_ready(fn(i))
+            t0 = time.perf_counter()
+            for i in range(steps):
+                out = fn(warm + i)
+            jax.block_until_ready(out)
+            return 1e3 * (time.perf_counter() - t0) / steps
+
+        # The full fused step (mutate + delta pack + compact pool).
+        step_ms = timed(lambda i: pl._step(
+            corpus, n, random.fold_in(key, i), fv, fc))
+
+        # The mutation core alone, on the same sampled batch.
+        import jax.numpy as jnp
+
+        idx = (random.bits(random.key(7), (batch_size,),
+                           dtype=jnp.uint32)
+               % jnp.maximum(n, 1).astype(jnp.uint32)).astype(jnp.int32)
+        batch = {k: v[idx] for k, v in corpus.items()}
+
+        @jax.jit
+        def mutate_only(keys):
+            return jax.vmap(
+                lambda st, k: _mutate_one(st, k, fv, fc, rounds))(
+                    batch, keys)
+
+        mutate_ms = timed(lambda i: mutate_only(
+            random.split(random.fold_in(key, 1000 + i), batch_size)))
+
+        # novel_any at the production triage shape.
+        plane = dsig.new_plane()
+        rng = np.random.RandomState(3)
+        edges = rng.randint(0, 1 << 32, size=(triage_batch,
+                                              triage_edges),
+                            dtype=np.uint32)
+        nedges = np.full(triage_batch, triage_edges, dtype=np.int32)
+        prios = np.full(triage_batch, 3, dtype=np.uint8)
+        ed, nd, pr = dsig.stage_batch(edges, nedges, prios)
+        novel_ms = timed(lambda i: dsig.novel_any(plane, ed, nd, pr))
+    finally:
+        pl.stop()
+    return {
+        "device_kernel_ms_per_batch": {
+            "mutate": round(mutate_ms, 4),
+            "emit_compact": round(max(0.0, step_ms - mutate_ms), 4),
+            "novel_any": round(novel_ms, 4),
+        },
+        "fused_step_ms_per_batch": round(step_ms, 4),
+        "profile_batch": batch_size,
+        "profile_triage_shape": [triage_batch, triage_edges],
+        "always_on": telemetry.PROFILER.snapshot(),
+        "note": ("isolated = kernel alone, block_until_ready-timed "
+                 "(emit_compact attributed as fused step minus "
+                 "mutate); always_on = host-observed dispatch->ready "
+                 "EWMAs from the live loop "
+                 "(tz_device_kernel_ms_per_batch gauges)"),
+    }
+
+
 def bench_device_kernel(batch_size=512, edges_per_prog=128,
                         steps=20) -> float:
     """The fused mutate+triage kernel alone (device steady state)."""
@@ -798,6 +911,17 @@ def main() -> None:
     import atexit
 
     atexit.register(dump_telemetry)
+    # Flight recorder (telemetry/flight.py): a bench attempt that
+    # wedges leaves an incident file next to the journal; bench_watch
+    # renders it in diagnose_wedge.  TZ_FLIGHT_DIR overrides.
+    from syzkaller_tpu import telemetry as _telemetry
+
+    if not _telemetry.FLIGHT.armed():
+        _telemetry.FLIGHT.set_dir(
+            os.path.dirname(os.path.abspath(__file__)))
+    from syzkaller_tpu.telemetry import flight as _flight
+
+    _flight.install_signal_handler()
     # TZ_BENCH_PLATFORM (or the shared TZ_JAX_PLATFORM) pins jax to a
     # working backend — used to record functional A/B artifacts while
     # the tunneled device is wedged.  Results are labeled with the
@@ -881,6 +1005,15 @@ def main() -> None:
         res = {"metric": "host_assemble_mutants_per_sec", "unit":
                "mutants/sec", **bench_host_assembly()}
         res["value"] = res["host_assemble_mutants_per_sec"]
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
+    if "--profile" in argv:
+        res = {"metric": "device_kernel_ms_per_batch",
+               "unit": "ms/batch", **bench_profile()}
+        res["value"] = res["device_kernel_ms_per_batch"]["mutate"]
         if platform:
             res["platform"] = platform
         journal_append(res)

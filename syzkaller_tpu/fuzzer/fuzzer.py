@@ -14,6 +14,7 @@ from enum import IntEnum
 from typing import Callable, Optional
 
 from syzkaller_tpu import telemetry
+from syzkaller_tpu.telemetry import lineage
 from syzkaller_tpu.models.any_squash import call_contains_any
 from syzkaller_tpu.models.encoding import serialize_prog
 from syzkaller_tpu.models.prio import ChoiceTable, build_choice_table
@@ -233,8 +234,8 @@ class Fuzzer:
         return self.check_new_signal_fn(
             lambda errno, idx: signal_prio(p, errno, idx), infos)
 
-    def check_new_signal_fn(self, prio_fn,
-                            infos) -> list[tuple[int, Signal]]:
+    def check_new_signal_fn(self, prio_fn, infos,
+                            trace=None) -> list[tuple[int, Signal]]:
         """check_new_signal with a caller-supplied prio_fn(errno,
         call_index) — lets undecoded device mutants compute edge
         priority from their exec-template flags without a typed
@@ -243,11 +244,17 @@ class Fuzzer:
         With a TriageEngine installed, the batched device plane
         pre-filters: only calls flagged possibly-novel reach the
         exact per-call dict diff below — the common nothing-new case
-        never takes the lock (syzkaller_tpu/triage)."""
+        never takes the lock (syzkaller_tpu/triage).
+
+        `trace` is the executed mutant's lineage context: verdict
+        delivery is a hop on its correlated track
+        (telemetry/lineage.py)."""
         eng = self.triage
         if eng is not None:
-            return eng.check(self, prio_fn, infos)
-        return self.cpu_check_new_signal(prio_fn, infos)
+            return eng.check(self, prio_fn, infos, trace=trace)
+        news = self.cpu_check_new_signal(prio_fn, infos)
+        lineage.hop(trace, "triage.verdict")
+        return news
 
     def cpu_check_new_signal(self, prio_fn,
                              infos) -> list[tuple[int, Signal]]:
@@ -317,8 +324,11 @@ class Fuzzer:
 
     # -- manager integration ---------------------------------------------
 
-    def send_input_to_manager(self, item: CorpusItem, call_index: int) -> None:
-        """Report a triaged input (fuzzer.go:423-440); no-op standalone."""
+    def send_input_to_manager(self, item: CorpusItem, call_index: int,
+                              trace=None) -> None:
+        """Report a triaged input (fuzzer.go:423-440); no-op
+        standalone.  `trace` rides the RPC frame header so the
+        manager-side receive joins the mutant's lineage track."""
         if self.conn is None:
             return
         elems, prios = item.signal.serialize()
@@ -331,7 +341,7 @@ class Fuzzer:
                 "signal": [elems, prios],
                 "cover": item.cover.serialize(),
             },
-        })
+        }, trace=trace)
 
     def record_crash(self, console_log: str, last_prog: Optional[Prog]) -> None:
         self.stat_add(Stat.CRASHES)

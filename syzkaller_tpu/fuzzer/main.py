@@ -10,6 +10,7 @@ standalone (no manager) as the syz-stress form.
 from __future__ import annotations
 
 import argparse
+import os
 import threading
 import time
 from typing import Optional
@@ -271,6 +272,15 @@ def main(argv: Optional[list[str]] = None) -> None:
         from syzkaller_tpu.manager.mgrconfig import parse_addr
 
         addr = parse_addr(args.manager)
+    # Flight recorder (telemetry/flight.py): a production fuzzer dumps
+    # incident files on DeviceWedged / breaker-open / SIGTERM.  The
+    # dump dir defaults to the working directory unless TZ_FLIGHT_DIR
+    # already armed it; library/test use stays disarmed.
+    if not telemetry.FLIGHT.armed():
+        telemetry.FLIGHT.set_dir(os.getcwd())
+    from syzkaller_tpu.telemetry import flight as _flight
+
+    _flight.install_signal_handler()
     fp = FuzzerProcess(args.name, (args.target_os, args.arch),
                        manager_addr=addr, procs=args.procs,
                        engine=args.engine)

@@ -16,6 +16,7 @@ import time
 from typing import Optional, Union
 
 from syzkaller_tpu import telemetry
+from syzkaller_tpu.telemetry import lineage
 from syzkaller_tpu.fuzzer.fuzzer import Fuzzer, Stat, signal_prio
 from syzkaller_tpu.fuzzer.workqueue import (
     ProgTypes,
@@ -272,6 +273,12 @@ class PipelineMutator:
                 if self.ops_journal is not None:
                     self.ops_journal.append("device")
                 fuzzer.stat_add(Stat.DEVICE_MUTANTS)
+                # Lineage: the first draw off a sampled batch records
+                # its prefetch-queue wait (one hop per batch — the
+                # context is shared by every mutant of the batch).
+                tr = getattr(m, "trace", None)
+                if tr is not None and tr.last_stage != "proc.draw":
+                    lineage.hop(tr, "proc.draw")
                 return m
             if p is None:
                 p = base.clone()
@@ -427,7 +434,12 @@ class Proc:
         corpus_item = self.fuzzer.add_input_to_corpus(
             item.p, input_signal, input_cover, serialized=data)
         if corpus_item is not None:
-            self.fuzzer.send_input_to_manager(corpus_item, call_index)
+            # Lineage: the mutant's lifecycle terminus — it survived
+            # deflake+minimize and landed in the corpus; the NewInput
+            # frame carries the context to the manager side.
+            lineage.hop(item.trace, "corpus.add")
+            self.fuzzer.send_input_to_manager(corpus_item, call_index,
+                                              trace=item.trace)
         if not item.flags.smashed:
             self.fuzzer.wq.enqueue(WorkSmash(item.p, call_index))
 
@@ -494,9 +506,12 @@ class Proc:
         result = self.execute_raw(opts, p, stat)
         if result is None:
             return None
+        trace = None
         if _is_exec_mutant(p):
+            trace = p.trace
             news = self.fuzzer.check_new_signal_fn(p.signal_prio,
-                                                   result.info)
+                                                   result.info,
+                                                   trace=trace)
             if not news:
                 return result
             decoded = p.prog()  # lazy typed decode for triage
@@ -507,7 +522,7 @@ class Proc:
             self.fuzzer.wq.enqueue(WorkTriage(
                 p=decoded.clone(), call_index=call_index, signal=sig,
                 flags=flags or ProgTypes(minimized=False, smashed=False),
-                from_candidate=flags is not None))
+                from_candidate=flags is not None, trace=trace))
         return result
 
     def execute_raw(self, opts: ExecOpts, p,

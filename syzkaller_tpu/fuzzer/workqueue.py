@@ -24,12 +24,15 @@ class ProgTypes:
 @dataclass
 class WorkTriage:
     """A program that produced new signal: deflake, minimize, add to
-    corpus (reference: workqueue.go:38-48)."""
+    corpus (reference: workqueue.go:38-48).  `trace` carries the
+    originating mutant's lineage context (telemetry/lineage.py) so
+    the corpus-add and manager NewInput hops stay on its track."""
     p: Prog
     call_index: int
     signal: object  # signal.Signal
     flags: ProgTypes = field(default_factory=ProgTypes)
     from_candidate: bool = False
+    trace: Optional[object] = None
 
 
 @dataclass

@@ -184,6 +184,7 @@ class CircuitBreaker:
     def record_failure(self) -> str:
         """Returns the state after accounting the failure."""
         _M_FAILURES.inc()
+        tripped = False
         with self._lock:
             self.counters.failures += 1
             self._consec_failures += 1
@@ -191,13 +192,22 @@ class CircuitBreaker:
                 if self._consec_failures < self.failure_threshold:
                     return self._state
                 self._trip_locked()
+                tripped = True
             elif self._state == HALF_OPEN:
                 # Failed probe: back off harder and reopen.
                 self._backoff = min(self._backoff * 2, self.backoff_cap)
                 self._trip_locked()
+                tripped = True
             else:  # already open (e.g. a straggler in-flight failure)
                 self._next_probe_at = self._clock() + self._jittered()
-            return self._state
+            state = self._state
+        if tripped:
+            # Flight recorder: a trip to open is an incident boundary;
+            # dump outside the breaker lock (file IO), rate-limited.
+            telemetry.FLIGHT.dump(
+                "breaker_open",
+                f"after {self.counters.failures} recorded failures")
+        return state
 
     def record_success(self) -> str:
         _M_SUCCESSES.inc()

@@ -36,10 +36,13 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_BREAKER_BACKOFF_S",
     "TZ_BREAKER_THRESHOLD",
     "TZ_FAULT_PLAN",
+    "TZ_FLIGHT_DIR",
+    "TZ_FLIGHT_RING",
     "TZ_JAX_PLATFORM",
     "TZ_PIPELINE_DISPATCH_DEPTH",
     "TZ_TELEMETRY_SNAPSHOT",
     "TZ_TRACE_FILE",
+    "TZ_TRACE_SAMPLE",
     "TZ_TRIAGE_BATCH",
     "TZ_TRIAGE_DEVICE",
     "TZ_TRIAGE_DISPATCH_DEPTH",

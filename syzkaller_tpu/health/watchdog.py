@@ -177,6 +177,12 @@ class Watchdog:
                 telemetry.record_event(
                     "watchdog.wedge",
                     f"{op} exceeded {d:.1f}s deadline")
+                # Flight recorder: a wedge is THE incident the ring
+                # exists for — dump the black box before anyone acts
+                # on the failure (rate-limited, never raises).
+                telemetry.FLIGHT.dump(
+                    "device_wedged",
+                    f"{op} exceeded {d:.1f}s watchdog deadline")
                 raise DeviceWedged(op, d)
         with self._lock:
             self._idle.append(ex)

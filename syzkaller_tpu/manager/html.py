@@ -65,6 +65,14 @@ def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
                         body += telemetry.render_prometheus_snapshot(
                             fleet, {"source": "fleet"})
                     self._send(body, "text/plain; version=0.0.4")
+                elif url.path == "/api/debug/flight":
+                    # On-demand flight-recorder incident payload
+                    # (telemetry/flight.py): the same structure the
+                    # automatic DeviceWedged/breaker-open/SIGTERM
+                    # dumps write, served live for a wedge-in-progress.
+                    self._send(json.dumps(
+                        telemetry.FLIGHT.snapshot("on_demand")),
+                        "application/json")
                 elif url.path == "/api/stats":
                     # Machine-readable superset of /stats: the manager
                     # rollup plus the full telemetry snapshot

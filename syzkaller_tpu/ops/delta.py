@@ -357,6 +357,11 @@ class DeltaBatch:
         o = spec.o_data_off
         self.data_off = buf[:, o:o + 4 * spec.D].copy().view("<i4")
         self._payload = None
+        # Lineage trace context (telemetry/lineage.py), attached by
+        # the pipeline at fetch time: one per batch, None when the
+        # batch is unsampled.  Every ExecMutant of the batch reads it
+        # through this reference — zero per-mutant storage.
+        self.trace = None
 
     @property
     def payload(self) -> np.ndarray:

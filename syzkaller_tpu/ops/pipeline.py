@@ -54,6 +54,7 @@ from typing import Optional
 import numpy as np
 
 from syzkaller_tpu import telemetry
+from syzkaller_tpu.telemetry import lineage
 from syzkaller_tpu.health import (
     CircuitBreaker,
     FaultInjected,
@@ -188,6 +189,13 @@ class ExecMutant:
     def target(self):
         return self.template.template.target
 
+    @property
+    def trace(self):
+        """The batch's lineage trace context (None = unsampled).  A
+        property over the batch reference, so unsampled mutants carry
+        zero per-mutant allocation overhead (telemetry/lineage.py)."""
+        return self.batch.trace
+
     def _any_flags(self) -> list[bool]:
         """Per-mutant-call squashed-ANY flags, in executor call order
         (template alive calls with the donor block spliced in)."""
@@ -262,13 +270,15 @@ class AssembledBatch(list):
     """One drained batch of ExecMutants.  A plain list to consumers;
     additionally carries the drain sequence number so delivery
     ordering across the assembly pool is observable (tests, and the
-    bench's supply-ordering assertions)."""
+    bench's supply-ordering assertions), and the batch's lineage
+    trace context (None = unsampled)."""
 
-    __slots__ = ("seq",)
+    __slots__ = ("seq", "trace")
 
-    def __init__(self, mutants=(), seq: int = -1):
+    def __init__(self, mutants=(), seq: int = -1, trace=None):
         super().__init__(mutants)
         self.seq = seq
+        self.trace = trace
 
 
 class _AssemblyTask:
@@ -753,6 +763,9 @@ class DevicePipeline:
             corpus, n, tmpl, ets = self._flush_pending()
         if corpus is None:
             return None
+        # Lineage: one trace context per batch, minted at flush time
+        # (TZ_TRACE_SAMPLE; None on the unsampled fast path).
+        trace = lineage.mint()
         self._key, sub = self._random.split(self._key)
         fv, fc = self._flags_dev
         # The first dispatch carries the jit trace + (tunneled) XLA
@@ -794,7 +807,10 @@ class DevicePipeline:
             except Exception:
                 self.stats.async_copy_fallbacks += 1
                 _M_ASYNC_COPY_FALLBACKS.inc()
-        return (rows_dev, pool_dev, n_used_dev), tmpl, ets
+        # t_dispatch anchors the always-on profiler's dispatch→ready
+        # attribution for the fused mutate step (telemetry/profiler).
+        return ((rows_dev, pool_dev, n_used_dev), tmpl, ets,
+                (trace, time.perf_counter()))
 
     def _fetch(self, launched):
         """The device->host transfers for one launched batch: the full
@@ -804,12 +820,21 @@ class DevicePipeline:
         syncs where a wedged tunnel stalls, so both run under the
         watchdog.  Returns (DeltaBatch, template snapshot,
         exec-template snapshot)."""
-        (rows_dev, pool_dev, n_used_dev), tmpl, ets = launched
+        (rows_dev, pool_dev, n_used_dev), tmpl, ets, meta = launched
+        trace, t_dispatch = meta
         with telemetry.span("pipeline.drain"):
             rows = self.watchdog.call(lambda: np.asarray(rows_dev),
                                       "device.drain")
             n_used = int(self.watchdog.call(
                 lambda: np.asarray(n_used_dev), "device.drain"))
+        # Always-on per-kernel attribution (telemetry/profiler.py):
+        # dispatch → delta-rows-ready is the fused mutate step's
+        # host-observed device residency; the compacted pool fetch is
+        # the emit-compact scatter's sync point.  Pure host float
+        # math — no device work, no jits, no allocations.
+        telemetry.PROFILER.note(
+            "mutate", time.perf_counter() - t_dispatch)
+        t_pool = time.perf_counter()
         with telemetry.span("pipeline.pool_drain"):
             bucket = pool_bucket(
                 n_used, self.spec.pool_slots(self.batch_size))
@@ -818,12 +843,16 @@ class DevicePipeline:
                     lambda: np.asarray(pool_dev[:bucket]), "device.drain")
             else:
                 pool = np.zeros((0, self.spec.P), np.uint8)
+        telemetry.PROFILER.note(
+            "emit_compact", time.perf_counter() - t_pool)
         nbytes = rows.nbytes + pool.nbytes + np.asarray(n_used_dev).nbytes
         self.stats.d2h_bytes += nbytes
         self.stats.d2h_batches += 1
         _M_D2H_BYTES.inc(nbytes)
         _M_D2H_BATCH_BYTES.set(nbytes)
-        return DeltaBatch(rows, self.spec, pool=pool), tmpl, ets
+        batch = DeltaBatch(rows, self.spec, pool=pool)
+        batch.trace = trace
+        return batch, tmpl, ets
 
     def _drain(self, launched) -> "AssembledBatch":
         """Fetch + assemble one launched batch synchronously (tests
@@ -883,7 +912,7 @@ class DevicePipeline:
         per-shard lists stay js-aligned, so recombining loses nothing;
         stats run here (the drain thread) so they stay single-writer."""
         seq, batch, tmpl, ets, tasks, ins_task = pending_batch
-        out = AssembledBatch(seq=seq)
+        out = AssembledBatch(seq=seq, trace=batch.trace)
         for s, task in tasks:
             if not task.wait(self._stop):
                 return out  # shutting down; partial batch is discarded
@@ -1119,6 +1148,10 @@ class DevicePipeline:
                 try:
                     self._queue.put(batch, timeout=0.2)
                     _M_QUEUE_DEPTH.set(self._queue.qsize())
+                    # Lineage: the batch reached the prefetch queue —
+                    # flush → delivery is the device+assembly
+                    # residency hop of a sampled mutant's track.
+                    lineage.hop(batch.trace, "pipeline.deliver")
                     break
                 except queue.Full:
                     continue

@@ -23,9 +23,16 @@ from typing import Any, Callable, Optional
 
 from syzkaller_tpu import telemetry
 from syzkaller_tpu.health.faultinject import fault_point
+from syzkaller_tpu.telemetry import lineage
 
 _FRAME = struct.Struct("<IB")  # payload length, flags
 _FLAG_ZLIB = 1
+#: The frame carries a lineage trace context (telemetry/lineage.py):
+#: lineage.WIRE bytes follow the header before the payload.  This is
+#: how a sampled mutant's trace id crosses the process boundary —
+#: the receive side records the `rpc.frame` hop and parks the context
+#: in a thread-local for the dispatched method (Manager.NewInput).
+_FLAG_TRACE = 2
 _COMPRESS_MIN = 4 << 10
 _MAX_FRAME = 512 << 20
 
@@ -46,7 +53,7 @@ class RPCError(Exception):
     pass
 
 
-def _send_frame(sock: socket.socket, obj: Any) -> None:
+def _send_frame(sock: socket.socket, obj: Any, trace=None) -> None:
     # Fault seam: a scripted `fail` here raises FaultInjected (a
     # ConnectionError), driving the client's reconnect/retry path and
     # the server's connection-drop path exactly as a real peer death
@@ -58,9 +65,13 @@ def _send_frame(sock: socket.socket, obj: Any) -> None:
         if len(data) >= _COMPRESS_MIN:
             data = zlib.compress(data, 1)
             flags |= _FLAG_ZLIB
-        sock.sendall(_FRAME.pack(len(data), flags) + data)
+        header = b""
+        if trace is not None and trace.sampled:
+            flags |= _FLAG_TRACE
+            header = lineage.to_wire(trace)
+        sock.sendall(_FRAME.pack(len(data), flags) + header + data)
     _M_FRAMES_SENT.inc()
-    _M_BYTES_SENT.inc(_FRAME.size + len(data))
+    _M_BYTES_SENT.inc(_FRAME.size + len(header) + len(data))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -75,16 +86,24 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_frame(sock: socket.socket) -> Any:
     fault_point("rpc.recv_frame")
+    trace_bytes = 0
     with telemetry.span("rpc.recv"):
         hdr = _recv_exact(sock, _FRAME.size)
         length, flags = _FRAME.unpack(hdr)
         if length > _MAX_FRAME:
             raise RPCError(f"oversized frame ({length} bytes)")
+        ctx = None
+        if flags & _FLAG_TRACE:
+            trace_bytes = lineage.WIRE.size
+            ctx = lineage.from_wire(_recv_exact(sock, trace_bytes))
         data = _recv_exact(sock, length)
         if flags & _FLAG_ZLIB:
             data = zlib.decompress(data)
+    # Park the decoded context (None clears a stale one) so the
+    # dispatched method on this thread can continue the chain.
+    lineage.set_current(ctx)
     _M_FRAMES_RECV.inc()
-    _M_BYTES_RECV.inc(_FRAME.size + length)
+    _M_BYTES_RECV.inc(_FRAME.size + trace_bytes + length)
     return json.loads(data)
 
 
@@ -186,7 +205,11 @@ class RPCClient:
         _setup_keepalive(sock)
         return sock
 
-    def call(self, method: str, params: Optional[dict] = None) -> Any:
+    def call(self, method: str, params: Optional[dict] = None,
+             trace=None) -> Any:
+        """`trace` (a lineage.TraceContext) rides the request frame's
+        header so the server side can correlate this call into the
+        mutant's lifecycle track (telemetry/lineage.py)."""
         with self._lock:
             self._next_id += 1
             req = {"id": self._next_id, "method": method,
@@ -196,7 +219,7 @@ class RPCClient:
                 if not reused:
                     self._sock = self._connect()
                 try:
-                    _send_frame(self._sock, req)
+                    _send_frame(self._sock, req, trace=trace)
                 except (ConnectionError, OSError):
                     # Send on a stale pooled connection may fail without
                     # the server having executed anything — reconnect and

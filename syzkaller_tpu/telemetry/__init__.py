@@ -40,6 +40,7 @@ from syzkaller_tpu.telemetry.registry import (
     merge_snapshots,
     render_prometheus_snapshot,
 )
+from syzkaller_tpu.telemetry.flight import FlightRecorder
 from syzkaller_tpu.telemetry.trace import ENV_VAR, TraceWriter
 
 #: The process-wide registry.  Tests needing isolation construct their
@@ -49,13 +50,19 @@ REGISTRY = Registry()
 #: The process-wide trace writer, armed by TZ_TRACE_FILE.
 TRACE = TraceWriter(os.environ.get(ENV_VAR) or None)
 
+#: The process-wide flight recorder (telemetry/flight.py): every
+#: completed span lands in its bounded ring; incident dumps fire on
+#: DeviceWedged / breaker-open / SIGTERM once a dump dir is armed
+#: (TZ_FLIGHT_DIR or FLIGHT.set_dir()).
+FLIGHT = FlightRecorder(registry=REGISTRY)
+
 
 def counter(name: str, help: str = "") -> Counter:
     return REGISTRY.counter(name, help)
 
 
-def gauge(name: str, help: str = "", fn=None) -> Gauge:
-    return REGISTRY.gauge(name, help, fn)
+def gauge(name: str, help: str = "", fn=None, labels=None) -> Gauge:
+    return REGISTRY.gauge(name, help, fn, labels)
 
 
 def histogram(name: str, help: str = "", bounds=None) -> Histogram:
@@ -93,6 +100,7 @@ class span:
     def __exit__(self, exc_type, exc, tb):
         dur = time.perf_counter() - self._t0
         self._hist.observe(dur)
+        FLIGHT.note_span(self.name, dur)
         if TRACE.enabled():
             TRACE.emit(self.name, self._t0, dur)
         return False
@@ -119,15 +127,34 @@ def reset() -> None:
     REGISTRY.reset_values()
 
 
+# The causal layer on top of the registry (ISSUE 6): lineage trace
+# contexts, the per-kernel device profiler, and the flight recorder.
+# Imported AFTER the module-level handles exist — lineage/profiler
+# resolve the registry lazily through this module.
+from syzkaller_tpu.telemetry import lineage  # noqa: E402
+from syzkaller_tpu.telemetry.profiler import (  # noqa: E402
+    KernelProfiler,
+)
+
+#: Process-wide per-kernel device-time attribution
+#: (tz_device_kernel_ms_per_batch{kernel=...}).
+PROFILER = KernelProfiler()
+
+
 __all__ = [
     "Counter",
     "DEFAULT_LATENCY_BUCKETS",
+    "FLIGHT",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "KernelProfiler",
+    "PROFILER",
     "REGISTRY",
     "Registry",
     "TRACE",
     "TraceWriter",
+    "lineage",
     "counter",
     "dump_snapshot",
     "gauge",

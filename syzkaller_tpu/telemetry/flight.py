@@ -1,0 +1,255 @@
+"""Flight recorder: the wedge evidence that collects itself.
+
+BENCH_r05's wedge diagnosis was hand-collected (thread tables, log
+archaeology — BENCH_WEDGE_DIAGNOSIS.md); the "reading a wedge"
+procedure existed as prose, not as a mechanism that fires at wedge
+time.  This module is that mechanism: a bounded per-process ring of
+recent span samples plus periodic queue-depth/gauge samples, dumped
+as ONE structured incident file the moment something goes wrong —
+
+  - `DeviceWedged` (the watchdog converted a hung PJRT call),
+  - a circuit-breaker trip to open,
+  - SIGTERM (the supervisor is killing a process that may be mid-
+    incident — the dump is the black box it leaves behind),
+  - on demand via the manager's `/api/debug/flight` endpoint.
+
+The incident file carries the breaker/transition timeline, the
+last-N spans with durations, the queue-depth history, and the full
+registry snapshot (per-phase percentiles) — everything the round-5
+diagnosis needed, collected in milliseconds instead of hours.
+`tools/bench_watch.py diagnose_wedge` renders it as its final layer.
+
+Hot-path cost: one deque append per completed span (no allocation
+beyond the tuple), one gauge sample sweep every GAUGE_SAMPLE_EVERY
+spans.  Dump-to-disk is armed only when a dump directory is set
+(`TZ_FLIGHT_DIR`, or set_dir() — bench.py and fuzzer/main arm it;
+test fixtures stay silent), and is rate-limited per reason so a
+failure storm costs one file, not a disk.
+
+The snapshot embedded in a dump comes from `Registry.snapshot()` —
+the same single-lock-acquisition read the PR 2 grab_stats race fix
+mandates — never from iterating live counters mid-mutation
+(tests/test_flight.py pins the conservation property under a
+concurrent increment hammer).
+
+`TZ_FLIGHT_RING` bounds the span ring (default 512, envsafe
+semantics: malformed degrades to the default).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal as _signal
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+ENV_RING = "TZ_FLIGHT_RING"
+ENV_DIR = "TZ_FLIGHT_DIR"
+
+DEFAULT_RING = 512
+GAUGE_SAMPLE_EVERY = 32
+GAUGE_HISTORY = 128
+
+#: The queue/depth gauges sampled into the history ring — the "was
+#: the producer or the consumer stalled?" question a wedge window
+#: always starts with (docs/observability.md "Reading a wedge").
+WATCH_GAUGES = (
+    "tz_pipeline_queue_depth",
+    "tz_pipeline_assemble_queue_depth",
+    "tz_pipeline_batch_size",
+    "tz_triage_batch_size",
+    "tz_staging_assemble_depth",
+    "tz_staging_h2d_dispatch_depth",
+)
+
+
+def _ring_size() -> int:
+    raw = os.environ.get(ENV_RING)
+    try:
+        return max(16, int(raw, 0)) if raw else DEFAULT_RING
+    except (TypeError, ValueError):
+        return DEFAULT_RING
+
+
+class FlightRecorder:
+    """Bounded in-memory recorder + structured incident dumps."""
+
+    def __init__(self, registry=None, size: Optional[int] = None):
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=size or _ring_size())
+        self._gauges: deque = deque(maxlen=GAUGE_HISTORY)
+        self._notes = 0
+        self._last_dump: dict[str, float] = {}
+        self._dir = os.environ.get(ENV_DIR) or None
+        self.min_interval_s = 30.0
+        self.dumps = 0
+
+    def attach_registry(self, registry) -> None:
+        self._registry = registry
+
+    # -- recording ---------------------------------------------------------
+
+    def note_span(self, name: str, dur: float) -> None:
+        """One completed span (called from telemetry.span.__exit__):
+        a deque append, plus a gauge sample sweep every Nth note."""
+        with self._lock:
+            self._spans.append((time.time(), name, round(dur, 6)))
+            self._notes += 1
+            if self._notes % GAUGE_SAMPLE_EVERY == 0:
+                self._sample_gauges_locked()
+
+    def _sample_gauges_locked(self) -> None:
+        if self._registry is None:
+            return
+        sample = {"ts": round(time.time(), 3)}
+        for name in WATCH_GAUGES:
+            m = self._registry._metrics.get(name)
+            if m is not None:
+                # Push-gauge read: one small lock, no pull callbacks
+                # (a pull gauge could re-enter a consumer lock from
+                # the hot loop).
+                sample[name] = m._value
+        self._gauges.append(sample)
+
+    # -- the incident payload ----------------------------------------------
+
+    def snapshot(self, reason: str = "on_demand",
+                 detail: str = "") -> dict:
+        """The structured incident payload: breaker/transition
+        timeline, last-N spans, queue-depth history, and the full
+        registry snapshot (the race-fixed single-acquisition read)."""
+        with self._lock:
+            spans = list(self._spans)
+            gauges = list(self._gauges)
+        reg_snap = self._registry.snapshot() if self._registry else {}
+        events = reg_snap.get("events") or []
+        return {
+            "reason": reason,
+            "detail": detail,
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "spans": [[round(ts, 3), n, d] for ts, n, d in spans],
+            "queue_depths": gauges,
+            "breaker_timeline": [
+                e for e in events
+                if e[1].startswith(("breaker.", "watchdog.",
+                                    "triage.demote",
+                                    "triage.repromote"))],
+            "events": events,
+            "registry": {k: reg_snap.get(k) for k in
+                         ("counters", "gauges", "histograms")},
+        }
+
+    # -- dumping -----------------------------------------------------------
+
+    def set_dir(self, path: Optional[str]) -> None:
+        """Arm (or, with None, disarm) incident dumps to disk."""
+        with self._lock:
+            self._dir = path
+
+    def armed(self) -> bool:
+        with self._lock:
+            return self._dir is not None
+
+    def dump(self, reason: str, detail: str = "") -> Optional[str]:
+        """Write one incident file; returns its path, or None when
+        disarmed / rate-limited / the write failed.  Never raises —
+        forensics must not compound the failure being recorded."""
+        try:
+            now = time.time()
+            with self._lock:
+                if self._dir is None:
+                    return None
+                last = self._last_dump.get(reason, 0.0)
+                if now - last < self.min_interval_s:
+                    return None
+                self._last_dump[reason] = now
+                dirpath = self._dir
+            payload = self.snapshot(reason, detail)
+            path = os.path.join(
+                dirpath, f"tz_flight_{reason}_{os.getpid()}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+                f.write("\n")
+            os.replace(tmp, path)
+            self.dumps += 1
+            _m_dumps().inc()
+            return path
+        except Exception:
+            return None
+
+
+def _m_dumps():
+    from syzkaller_tpu import telemetry
+
+    return telemetry.counter(
+        "tz_flight_dumps_total", "flight-recorder incident dumps")
+
+
+# -- SIGTERM hook ----------------------------------------------------------
+
+_sigterm_installed = False
+_sigterm_lock = threading.Lock()
+
+
+def install_signal_handler(recorder=None) -> bool:
+    """Dump a final incident file on SIGTERM, then deliver the signal
+    to the previous handler (or the default).  Installed once per
+    process, only from the main thread (signal module restriction);
+    returns whether the handler is installed."""
+    global _sigterm_installed
+    with _sigterm_lock:
+        if _sigterm_installed:
+            return True
+        if recorder is None:
+            from syzkaller_tpu import telemetry
+
+            recorder = telemetry.FLIGHT
+        try:
+            prev = _signal.getsignal(_signal.SIGTERM)
+
+            def _on_sigterm(signum, frame):
+                recorder.dump("sigterm", "SIGTERM received")
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+                    os.kill(os.getpid(), _signal.SIGTERM)
+
+            _signal.signal(_signal.SIGTERM, _on_sigterm)
+        except ValueError:  # not the main thread
+            return False
+        _sigterm_installed = True
+        return True
+
+
+def append_attempt(path: str, record: dict) -> None:
+    """Append one measurement/probe attempt to a shared incident file
+    (bench_watch's lease-catching journal: every wedged attempt is
+    recorded instead of failing the round on the first one).  The
+    file holds {"attempts": [...]}; created on first use.  Best
+    effort — never raises."""
+    try:
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            payload = {}
+        attempts = payload.setdefault("attempts", [])
+        record = dict(record)
+        record.setdefault("ts", round(time.time(), 3))
+        attempts.append(record)
+        # Bounded: an unattended watcher must not grow this forever.
+        del attempts[:-256]
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.write("\n")
+        os.replace(tmp, path)
+    except Exception:
+        pass

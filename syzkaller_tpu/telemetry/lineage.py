@@ -1,0 +1,209 @@
+"""Mutant lineage tracing: one trace context per device batch.
+
+PR 2's spans are per-process and per-phase — nobody can follow ONE
+mutant end to end across the four planes of the hot loop (mutate →
+assemble → stage/H2D → novel_any → CPU confirm → exec → corpus add)
+or across the three processes they run in.  This module is the
+causal layer on top of the same registry:
+
+  - a TraceContext (64-bit trace id + sampled flag) is minted at
+    mutation-flush time — one per launched batch, never per mutant,
+    so unsampled batches cost one `None` check and sampled batches
+    one small object shared by every mutant they produce,
+  - the context threads DeltaBatch → AssembledBatch → ExecMutant →
+    the RPC frame header (rpc/rpc.py) → TriageEngine verdict
+    delivery → corpus add.  ExecMutant reads it through its batch
+    reference: zero per-mutant storage, zero per-mutant allocation,
+  - each lifecycle hop records the wait since the previous hop into
+    a fixed per-stage histogram (the cross-process queue-time view
+    the spans cannot give) and, when TZ_TRACE_FILE is armed, emits an
+    async-instant trace event keyed by the trace id — every hop of a
+    sampled mutant renders as ONE correlated Perfetto track spanning
+    the pipeline worker, the proc threads, and the far side of the
+    RPC link.
+
+Sampling: `TZ_TRACE_SAMPLE` (a probability in [0, 1], envsafe
+semantics — malformed degrades to the default 0.0) gates minting.
+Cross-process hops carry a wallclock stamp on the wire because
+perf_counter timebases do not survive a process boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import struct
+import threading
+import time
+from typing import Optional
+
+ENV_SAMPLE = "TZ_TRACE_SAMPLE"
+
+#: Wire form for the RPC frame header: trace id, flags (bit 0 =
+#: sampled), wallclock stamp of the sender's last hop.
+WIRE = struct.Struct("<QBd")
+
+_rng = random.Random()
+_rate_lock = threading.Lock()
+_rate: Optional[float] = None  # None = re-read from the environment
+
+
+class TraceContext:
+    """One mutant batch's lineage identity.  Mutated only from the
+    single thread currently advancing the lifecycle stage, so hops
+    need no lock."""
+
+    __slots__ = ("trace_id", "sampled", "born_wall", "last_ts",
+                 "last_wall", "last_stage")
+
+    def __init__(self, trace_id: int, sampled: bool = True):
+        self.trace_id = trace_id
+        self.sampled = sampled
+        now = time.perf_counter()
+        self.born_wall = time.time()
+        self.last_ts = now
+        self.last_wall = self.born_wall
+        self.last_stage = "lineage.mint"
+
+
+def sample_rate() -> float:
+    """TZ_TRACE_SAMPLE, parsed once per process (envsafe discipline:
+    malformed degrades to 0.0 — tracing off — never an exception)."""
+    global _rate
+    with _rate_lock:
+        if _rate is None:
+            raw = os.environ.get(ENV_SAMPLE)
+            try:
+                _rate = min(1.0, max(0.0, float(raw))) if raw else 0.0
+            except (TypeError, ValueError):
+                _rate = 0.0
+        return _rate
+
+
+def set_sample_rate(rate: Optional[float]) -> None:
+    """Pin (or, with None, re-read from the environment) the sampling
+    rate — tests and tools."""
+    global _rate
+    with _rate_lock:
+        _rate = rate if rate is None else min(1.0, max(0.0, rate))
+
+
+def _telemetry():
+    # Late import: telemetry/__init__ imports this module, and the
+    # registry handles live there.
+    from syzkaller_tpu import telemetry
+
+    return telemetry
+
+
+def _hists():
+    global _STAGE_WAITS, _M_SAMPLED
+    if _STAGE_WAITS is None:
+        t = _telemetry()
+        _M_SAMPLED = t.counter(
+            "tz_lineage_sampled_total",
+            "sampled lineage trace contexts minted")
+        _STAGE_WAITS = {
+            "pipeline.deliver": t.histogram(
+                "tz_lineage_deliver_wait_seconds",
+                "flush -> assembled batch delivered to the prefetch "
+                "queue (device + assembly residency)"),
+            "proc.draw": t.histogram(
+                "tz_lineage_draw_wait_seconds",
+                "batch delivered -> first mutant drawn by a proc "
+                "(prefetch-queue wait)"),
+            "rpc.frame": t.histogram(
+                "tz_lineage_rpc_wait_seconds",
+                "previous hop -> trace context received on the far "
+                "side of an RPC frame (wallclock; cross-process)"),
+            "triage.verdict": t.histogram(
+                "tz_lineage_verdict_wait_seconds",
+                "previous hop -> novelty verdict delivered for a "
+                "sampled mutant's exec result"),
+            "corpus.add": t.histogram(
+                "tz_lineage_corpus_wait_seconds",
+                "previous hop -> triaged input landed in the corpus"),
+        }
+    return _STAGE_WAITS
+
+
+_STAGE_WAITS: Optional[dict] = None
+_M_SAMPLED = None
+
+#: Thread-local carrier for the context decoded off the most recent
+#: RPC frame on this thread — lets a server-side method (e.g.
+#: Manager.NewInput) continue the chain without a signature change in
+#: the dispatch layer.
+_local = threading.local()
+
+
+def mint() -> Optional[TraceContext]:
+    """Mint a trace context at mutation-flush time.  Returns None when
+    the sampling coin says no — the zero-overhead path: nothing is
+    allocated and every downstream hop is one `is None` test."""
+    rate = sample_rate()
+    if rate <= 0.0 or _rng.random() >= rate:
+        return None
+    ctx = TraceContext(_rng.getrandbits(64) or 1)
+    _hists()
+    _M_SAMPLED.inc()
+    t = _telemetry()
+    if t.TRACE.enabled():
+        t.TRACE.point("lineage.mint", ctx.trace_id)
+    return ctx
+
+
+def hop(ctx: Optional[TraceContext], stage: str) -> None:
+    """Record one lifecycle hop: the wait since the previous hop goes
+    into the stage's histogram, and (tracing armed) an async-instant
+    event keyed by the trace id joins the mutant's correlated track."""
+    if ctx is None or not ctx.sampled:
+        return
+    now = time.perf_counter()
+    wait = max(0.0, now - ctx.last_ts)
+    h = _hists().get(stage)
+    if h is not None:
+        h.observe(wait)
+    t = _telemetry()
+    if t.TRACE.enabled():
+        t.TRACE.point(stage, ctx.trace_id,
+                      {"wait_s": round(wait, 6),
+                       "from": ctx.last_stage})
+    ctx.last_ts = now
+    ctx.last_wall = time.time()
+    ctx.last_stage = stage
+
+
+def to_wire(ctx: TraceContext) -> bytes:
+    """Serialize for the RPC frame header (rpc/rpc.py _FLAG_TRACE)."""
+    return WIRE.pack(ctx.trace_id, 1 if ctx.sampled else 0,
+                     ctx.last_wall)
+
+
+def from_wire(data: bytes) -> TraceContext:
+    """Decode a frame-header context and record the `rpc.frame` hop —
+    the cross-process edge.  The wait is wallclock (sender stamp to
+    local receive) because perf_counter timebases are per-process."""
+    trace_id, flags, sent_wall = WIRE.unpack(data)
+    ctx = TraceContext(trace_id, sampled=bool(flags & 1))
+    if ctx.sampled:
+        wait = max(0.0, time.time() - sent_wall)
+        h = _hists().get("rpc.frame")
+        if h is not None:
+            h.observe(wait)
+        t = _telemetry()
+        if t.TRACE.enabled():
+            t.TRACE.point("rpc.frame", ctx.trace_id,
+                          {"wait_s": round(wait, 6)})
+        ctx.last_stage = "rpc.frame"
+    return ctx
+
+
+def set_current(ctx: Optional[TraceContext]) -> None:
+    _local.ctx = ctx
+
+
+def current() -> Optional[TraceContext]:
+    """The context decoded off the most recent RPC frame received on
+    THIS thread (None when the frame carried none)."""
+    return getattr(_local, "ctx", None)

@@ -1,0 +1,87 @@
+"""Per-kernel device-time attribution.
+
+The ROADMAP's top perf item ("device kernel rate is now the
+bottleneck — Pallas the mutation inner loop") gates on a measurement
+that did not exist: the 16.9k mutations/s on-chip number is a
+whole-pipeline residual, not a per-kernel attribution.  Two paths,
+one exported family:
+
+  always-on   the hot loops feed `note(kernel, seconds)` with the
+              host-observed dispatch→ready latency of each kernel's
+              sync point: the pipeline's fused mutate step ("mutate",
+              dispatch to delta-rows-ready), the compacted payload
+              pool fetch ("emit_compact"), and the triage verdict
+              fetch ("novel_any").  Pure host float math — an EWMA
+              per kernel into a labeled gauge — so the steady state
+              adds no jit compiles and no allocations (pinned by a
+              compile-count + container-growth regression test).
+              These are host-observed numbers: on an async backend
+              they include queue + transfer residency, which is
+              exactly the operator question ("where does a batch's
+              wall time go") but NOT a pure kernel microbenchmark.
+
+  bench.py --profile
+              the precise per-kernel numbers: each kernel dispatched
+              alone on a warm pipeline at the flagship shape, timed
+              around block_until_ready — the before/after measurement
+              the Pallas rewrite is judged by.
+
+Exported as `tz_device_kernel_ms_per_batch{kernel=...}` (one family,
+a label per kernel — the registry's labeled-gauge support exists for
+this series).
+"""
+
+from __future__ import annotations
+
+import threading
+
+KERNELS = ("mutate", "emit_compact", "novel_any")
+
+#: EWMA weight for the always-on path: heavy enough to settle within
+#: tens of batches, light enough to ride out a single straggler.
+EWMA_ALPHA = 0.2
+
+
+class KernelProfiler:
+    """Process-wide per-kernel ms/batch EWMAs behind labeled gauges.
+
+    The kernel set is FIXED at construction: note() on a steady-state
+    hot loop touches only pre-allocated slots (no dict growth, no
+    gauge registration) — the zero-allocation contract the regression
+    guard pins."""
+
+    __slots__ = ("_lock", "_ewma", "_counts", "_gauges")
+
+    def __init__(self):
+        from syzkaller_tpu import telemetry
+
+        self._lock = threading.Lock()
+        self._ewma = {k: 0.0 for k in KERNELS}
+        self._counts = {k: 0 for k in KERNELS}
+        self._gauges = {
+            k: telemetry.gauge(
+                "tz_device_kernel_ms_per_batch",
+                "host-observed per-kernel device time per batch "
+                "(EWMA ms; dispatch to ready at each kernel's sync "
+                "point)", labels={"kernel": k})
+            for k in KERNELS}
+
+    def note(self, kernel: str, seconds: float) -> None:
+        """One batch's host-observed device residency for `kernel`.
+        Unknown kernels are ignored (the fixed-slot contract)."""
+        if kernel not in self._ewma:
+            return
+        ms = seconds * 1e3
+        with self._lock:
+            n = self._counts[kernel]
+            self._counts[kernel] = n + 1
+            prev = self._ewma[kernel]
+            cur = ms if n == 0 else prev + EWMA_ALPHA * (ms - prev)
+            self._ewma[kernel] = cur
+        self._gauges[kernel].set(cur)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {k: {"ms_per_batch": round(self._ewma[k], 4),
+                        "batches": self._counts[k]}
+                    for k in KERNELS}

@@ -1,0 +1,145 @@
+"""Prometheus text-exposition validator.
+
+The manager's `/metrics` body is assembled from three generators (the
+process registry, the fleet merge, labeled gauge families), and a
+malformed line fails silently at scrape time — the scraper drops the
+whole body and the operator loses every series at once.  This
+validator is the tier-1 guard: it parses the exposition the way a
+scraper would and returns every violation it finds, so a fleet-merge
+or new-gauge regression fails a fast host-only test instead of a
+production scrape.
+
+Checks:
+  - comment lines are well-formed `# HELP name text` / `# TYPE name
+    kind` with a known kind, at most one TYPE per family,
+  - sample lines parse as `name[{label="value",...}] value`, names
+    and label names legal, label values quote-escaped,
+  - every sample's family agrees with its TYPE declaration
+    (histogram samples use the `_bucket`/`_sum`/`_count` suffixes),
+  - histogram families carry a `+Inf` bucket and cumulative,
+    monotonically non-decreasing bucket counts.
+
+Pure stdlib, no imports from the registry — it must be able to
+condemn output the registry believes is fine.
+"""
+
+from __future__ import annotations
+
+import re
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"      # metric name
+    r"(?:\{(.*)\})?"                     # optional label set
+    r" "                                 # exactly one space
+    r"(-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf)|NaN|[+-]Inf)"
+    r"(?: -?[0-9]+)?$")                  # optional timestamp
+LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+KINDS = {"counter", "gauge", "histogram", "summary", "untyped"}
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _family(name: str, types: dict) -> str:
+    """The TYPE family a sample belongs to: histogram samples carry
+    the _bucket/_sum/_count suffixes of their declared family."""
+    for suf in HIST_SUFFIXES:
+        if name.endswith(suf):
+            base = name[: -len(suf)]
+            if types.get(base) == "histogram":
+                return base
+    return name
+
+
+def _parse_labels(raw: str, lineno: int, problems: list) -> dict:
+    out = {}
+    rest = raw.strip()
+    while rest:
+        m = LABEL_RE.match(rest)
+        if not m:
+            problems.append(
+                f"line {lineno}: malformed label set at {rest[:40]!r}")
+            return out
+        out[m.group(1)] = m.group(2)
+        rest = rest[m.end():]
+        if rest.startswith(","):
+            rest = rest[1:]
+        elif rest:
+            problems.append(
+                f"line {lineno}: expected ',' between labels, got "
+                f"{rest[:20]!r}")
+            return out
+    return out
+
+
+def validate_exposition(text: str) -> list[str]:
+    """Every violation found, as printable strings (empty = valid)."""
+    problems: list[str] = []
+    types: dict[str, str] = {}
+    # family -> list of (labels-without-le, le, cum) for bucket checks
+    buckets: dict[str, list] = {}
+    seen_inf: set[tuple] = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                problems.append(
+                    f"line {lineno}: malformed comment {line[:60]!r}")
+                continue
+            name = parts[2]
+            if not NAME_RE.match(name):
+                problems.append(
+                    f"line {lineno}: illegal metric name {name!r}")
+            if parts[1] == "TYPE":
+                kind = parts[3].strip() if len(parts) > 3 else ""
+                if kind not in KINDS:
+                    problems.append(
+                        f"line {lineno}: unknown TYPE kind {kind!r}")
+                if name in types:
+                    problems.append(
+                        f"line {lineno}: duplicate TYPE for {name}")
+                types[name] = kind
+            continue
+        m = SAMPLE_RE.match(line)
+        if not m:
+            problems.append(
+                f"line {lineno}: malformed sample {line[:60]!r}")
+            continue
+        name, raw_labels, _value = m.group(1), m.group(2), m.group(3)
+        labels = _parse_labels(raw_labels, lineno, problems) \
+            if raw_labels else {}
+        fam = _family(name, types)
+        kind = types.get(fam)
+        if kind == "histogram":
+            if not any(name.endswith(s) for s in HIST_SUFFIXES):
+                problems.append(
+                    f"line {lineno}: histogram family {fam} sample "
+                    f"{name} lacks _bucket/_sum/_count suffix")
+            if name.endswith("_bucket"):
+                if "le" not in labels:
+                    problems.append(
+                        f"line {lineno}: {name} without an le label")
+                else:
+                    key = tuple(sorted((k, v) for k, v in labels.items()
+                                       if k != "le"))
+                    buckets.setdefault(fam, []).append(
+                        (key, labels["le"], float(m.group(3))))
+                    if labels["le"] == "+Inf":
+                        seen_inf.add((fam, key))
+    for fam, rows in buckets.items():
+        series: dict[tuple, list] = {}
+        for key, _le, cum in rows:
+            series.setdefault(key, []).append(cum)
+        for key, cums in series.items():
+            if (fam, key) not in seen_inf:
+                problems.append(
+                    f"{fam}{dict(key)}: histogram without a +Inf "
+                    "bucket")
+            if any(a > b for a, b in zip(cums, cums[1:])):
+                problems.append(
+                    f"{fam}{dict(key)}: bucket counts are not "
+                    "cumulative/monotone")
+    return problems

@@ -68,17 +68,33 @@ class Counter:
 class Gauge:
     """Point-in-time value.  Either set() push-style, or pull-style
     via `fn` (sampled at snapshot/render time — used for corpus size
-    and queue depth owned by other objects)."""
+    and queue depth owned by other objects).
 
-    __slots__ = ("name", "help", "_lock", "_value", "fn")
+    `labels` attaches a fixed label set to the series (ISSUE 6: the
+    per-kernel profiler exports one family across kernels,
+    `tz_device_kernel_ms_per_batch{kernel=...}`).  The registry keys
+    labeled gauges by full_name, so each label combination is its own
+    metric object while the family shares one TYPE/HELP line."""
+
+    __slots__ = ("name", "help", "_lock", "_value", "fn", "labels")
 
     def __init__(self, name: str, help: str = "",
-                 fn: Optional[Callable[[], float]] = None):
+                 fn: Optional[Callable[[], float]] = None,
+                 labels: Optional[dict] = None):
         self.name = name
         self.help = help
         self._lock = threading.Lock()
         self._value = 0.0
         self.fn = fn
+        self.labels = dict(labels) if labels else None
+
+    @property
+    def full_name(self) -> str:
+        if not self.labels:
+            return self.name
+        inner = ",".join(f'{k}="{v}"'
+                         for k, v in sorted(self.labels.items()))
+        return f"{self.name}{{{inner}}}"
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -279,6 +295,19 @@ def merge_snapshots(snaps: list) -> dict:
     return out
 
 
+def _merge_label_suffix(name: str, pairs: str) -> str:
+    """Attach extra `k="v",` pairs to a sample name that may already
+    carry a label set (a labeled gauge riding a fleet merge):
+    `fam{kernel="mutate"}` + `source="fleet",` →
+    `fam{kernel="mutate",source="fleet"}`."""
+    base, brace, rest = name.partition("{")
+    base = base.replace(".", "_")
+    inner = rest[:-1] if brace else ""
+    extra = pairs.rstrip(",")
+    merged = ",".join(p for p in (inner, extra) if p)
+    return f"{base}{{{merged}}}" if merged else base
+
+
 def render_prometheus_snapshot(snap: dict,
                                labels: Optional[dict] = None) -> str:
     """Prometheus text for a snapshot DICT (e.g. a fleet merge), with
@@ -286,21 +315,21 @@ def render_prometheus_snapshot(snap: dict,
     the manager appends the fleet rollup to /metrics as
     `...{source="fleet"}` next to its own registry."""
     pairs = "".join(f'{k}="{v}",' for k, v in (labels or {}).items())
-    lbl = "{" + pairs.rstrip(",") + "}" if pairs else ""
     lines = []
     for name, v in sorted((snap.get("counters") or {}).items()):
-        lines.append(f"{name.replace('.', '_')}{lbl} {_fmt(v)}")
+        lines.append(f"{_merge_label_suffix(name, pairs)} {_fmt(v)}")
     for name, v in sorted((snap.get("gauges") or {}).items()):
-        lines.append(f"{name.replace('.', '_')}{lbl} {_fmt(v)}")
+        lines.append(f"{_merge_label_suffix(name, pairs)} {_fmt(v)}")
     for name, h in sorted((snap.get("histograms") or {}).items()):
         name = name.replace(".", "_")
         for le, cum in h.get("buckets") or []:
             label = le if le == "+Inf" else format(le, ".6g")
-            lines.append(f'{name}_bucket{{le="{label}",'
-                         f'{pairs.rstrip(",")}}} {cum}' if pairs else
-                         f'{name}_bucket{{le="{label}"}} {cum}')
-        lines.append(f"{name}_sum{lbl} {_fmt(h.get('sum', 0))}")
-        lines.append(f"{name}_count{lbl} {h.get('count', 0)}")
+            lines.append(_merge_label_suffix(
+                f'{name}_bucket{{le="{label}"}}', pairs) + f" {cum}")
+        lines.append(f"{_merge_label_suffix(name + '_sum', pairs)} "
+                     f"{_fmt(h.get('sum', 0))}")
+        lines.append(f"{_merge_label_suffix(name + '_count', pairs)} "
+                     f"{h.get('count', 0)}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -343,8 +372,13 @@ class Registry:
                                    lambda: Counter(name, help))
 
     def gauge(self, name: str, help: str = "",
-              fn: Optional[Callable[[], float]] = None) -> Gauge:
-        g = self._get_or_create(name, Gauge, lambda: Gauge(name, help, fn))
+              fn: Optional[Callable[[], float]] = None,
+              labels: Optional[dict] = None) -> Gauge:
+        key = name
+        if labels:
+            key = Gauge(name, labels=labels).full_name
+        g = self._get_or_create(
+            key, Gauge, lambda: Gauge(name, help, fn, labels))
         if fn is not None:
             # Re-registering with a callback rebinds it: a fresh
             # manager in the same process must sample ITS corpus, not
@@ -384,7 +418,7 @@ class Registry:
             if isinstance(m, Counter):
                 out["counters"][m.name] = m.value
             elif isinstance(m, Gauge):
-                out["gauges"][m.name] = m.value
+                out["gauges"][m.full_name] = m.value
             elif isinstance(m, Histogram):
                 out["histograms"][m.name] = m.snapshot()
         return out
@@ -394,18 +428,25 @@ class Registry:
         with self._lock:
             metrics = list(self._metrics.values())
         lines = []
+        # HELP/TYPE are per FAMILY: labeled gauges sharing one family
+        # name must emit the header exactly once (promcheck enforces).
+        seen_families: set[str] = set()
         for m in metrics:
             name = m.name.replace(".", "_")
-            if m.help:
-                lines.append(f"# HELP {name} {m.help}")
+            if name not in seen_families:
+                seen_families.add(name)
+                if m.help:
+                    lines.append(f"# HELP {name} {m.help}")
+                kind = ("counter" if isinstance(m, Counter) else
+                        "gauge" if isinstance(m, Gauge) else "histogram")
+                lines.append(f"# TYPE {name} {kind}")
             if isinstance(m, Counter):
-                lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name} {_fmt(m.value)}")
             elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {_fmt(m.value)}")
+                lines.append(
+                    f"{_merge_label_suffix(m.full_name, '')}"
+                    f" {_fmt(m.value)}")
             elif isinstance(m, Histogram):
-                lines.append(f"# TYPE {name} histogram")
                 snap = m.snapshot()
                 for le, cum in snap["buckets"]:
                     label = le if le == "+Inf" else format(le, ".6g")

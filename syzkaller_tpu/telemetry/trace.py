@@ -82,6 +82,30 @@ class TraceWriter:
         """Instant event ('i') — breaker trips, wedges, demotions."""
         self.emit(name, time.perf_counter(), 0.0, args)
 
+    def point(self, name: str, trace_id: int, args=None) -> None:
+        """Async-instant event ('n') keyed by a lineage trace id: every
+        point sharing an id renders as one correlated track in
+        Perfetto, across threads AND processes — the mechanism behind
+        the per-mutant lifecycle view (telemetry/lineage.py)."""
+        if self._path is None:
+            return
+        ev = {"name": name, "cat": "tz.lineage", "ph": "n",
+              "ts": round((time.perf_counter() - self._t0) * 1e6, 1),
+              "id": format(trace_id & 0xFFFFFFFFFFFFFFFF, "016x"),
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        line = json.dumps(ev) + ",\n"
+        with self._lock:
+            f = self._open_locked()
+            if f is None:
+                return
+            try:
+                f.write(line)
+                f.flush()
+            except OSError:
+                self._close_locked()
+
     def close(self) -> None:
         with self._lock:
             self._close_locked()

@@ -47,6 +47,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 #: the outer timeout leaves per-phase evidence for diagnose_wedge.
 TELEMETRY_SNAP = os.path.join(REPO, "TELEMETRY_SNAPSHOT.json")
 
+#: The watcher's own incident journal (telemetry/flight.py
+#: append_attempt): every wedged/failed measurement attempt is
+#: recorded here — the round's evidence accumulates in one file
+#: instead of failing the round on the first wedge (ROADMAP
+#: lease-catching carry-over from BENCH_r05).
+INCIDENT_PATH = os.path.join(REPO, "tz_flight_bench_watch.json")
+
+#: Bounded in-watcher retries for the lease-starvation signature (the
+#: bench subprocess timing out in PJRT Client_Create): each retry
+#: backs off and re-queues as a standing lease-catcher.
+LEASE_RETRIES = 2
+LEASE_BACKOFF_S = 120.0
+
 
 #: Append-per-write log target (opened fresh each call): shell
 #: redirection pins an inode, and anything that swaps the file on
@@ -214,6 +227,78 @@ def report_telemetry(path: str | None = None) -> None:
         log(f"  {line}")
 
 
+def flight_report(incident: dict) -> list[str]:
+    """Render a flight-recorder incident payload
+    (telemetry/flight.py snapshot/dump shape) into diagnostic lines:
+    the breaker timeline, the last-N spans, the queue-depth history,
+    and any recorded measurement attempts.  Pure function — pinned by
+    tests with no live TPU."""
+    lines: list[str] = []
+    reason = incident.get("reason") or "?"
+    ts = incident.get("ts") or 0
+    stamp = time.strftime("%H:%M:%S", time.localtime(ts)) if ts else "?"
+    lines.append(f"incident: {reason} at {stamp} "
+                 f"(pid {incident.get('pid', '?')})"
+                 + (f" — {incident['detail']}"
+                    if incident.get("detail") else ""))
+    for ets, name, detail in (incident.get("breaker_timeline")
+                              or [])[-12:]:
+        estamp = time.strftime("%H:%M:%S", time.localtime(ets))
+        lines.append(f"  {estamp} {name}"
+                     + (f" ({detail})" if detail else ""))
+    spans = incident.get("spans") or []
+    if spans:
+        per: dict[str, int] = {}
+        for _ts, name, _dur in spans:
+            per[name] = per.get(name, 0) + 1
+        lines.append("last spans: " + " ".join(
+            f"{n}={c}" for n, c in sorted(per.items())))
+        for sts, name, dur in spans[-6:]:
+            sstamp = time.strftime("%H:%M:%S", time.localtime(sts))
+            lines.append(f"  {sstamp} {name} {_ms(dur)}")
+    depths = incident.get("queue_depths") or []
+    for sample in depths[-4:]:
+        vals = " ".join(f"{k.replace('tz_', '')}={v:g}"
+                        for k, v in sorted(sample.items())
+                        if k != "ts")
+        dstamp = time.strftime("%H:%M:%S",
+                               time.localtime(sample.get("ts", 0)))
+        lines.append(f"  depths {dstamp}: {vals}")
+    for att in (incident.get("attempts") or [])[-6:]:
+        astamp = time.strftime("%H:%M:%S",
+                               time.localtime(att.get("ts", 0)))
+        lines.append(f"  attempt {astamp} {att.get('kind')}: "
+                     f"{str(att.get('reason'))[:80]}")
+    if len(lines) == 1:
+        lines.append("  (incident carried no timeline/spans/depths)")
+    return lines
+
+
+def report_flight(paths: list[str] | None = None) -> None:
+    """Log the newest flight-recorder incident file(s): the automatic
+    DeviceWedged/breaker-open dumps from bench subprocesses
+    (TZ_FLIGHT_DIR=REPO, armed by run_bench) plus the watcher's own
+    attempt journal."""
+    import glob
+
+    if paths is None:
+        paths = sorted(glob.glob(os.path.join(REPO, "tz_flight_*.json")),
+                       key=lambda p: os.path.getmtime(p)
+                       if os.path.exists(p) else 0)[-3:]
+    if not paths:
+        log("diagnose: no flight-recorder incident files")
+        return
+    for path in paths:
+        try:
+            with open(path) as f:
+                incident = json.load(f)
+        except (OSError, ValueError):
+            continue
+        log(f"diagnose: flight recorder {os.path.basename(path)}:")
+        for line in flight_report(incident):
+            log(f"  {line}")
+
+
 def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
     """On measurement timeout: capture WHAT hangs, not just that it hangs.
 
@@ -295,6 +380,10 @@ def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
     # per-phase latency percentiles + breaker/wedge timeline from the
     # last attempt's telemetry snapshot.
     report_telemetry()
+    # Layer 6: the flight-recorder incident files — the automated
+    # form of the round-5 hand diagnosis (breaker timeline, last-N
+    # spans, queue-depth history, recorded attempts).
+    report_flight()
 
 
 def flagship_entries() -> int:
@@ -334,7 +423,18 @@ def ab_result_eligible(r: dict) -> bool:
                 or not r.get("engine_on"))
 
 
-def run_bench(args: list[str], timeout_s: float) -> dict | None:
+def record_attempt(kind: str, reason: str, attempt: int = 1) -> None:
+    """One failed/wedged attempt into the round's incident journal
+    (telemetry/flight.py append_attempt; bounded, best-effort)."""
+    from syzkaller_tpu.telemetry import flight
+
+    flight.append_attempt(INCIDENT_PATH, {
+        "kind": kind, "reason": reason, "attempt": attempt})
+
+
+def run_bench(args: list[str], timeout_s: float,
+              lease_retries: int = LEASE_RETRIES,
+              lease_backoff_s: float = LEASE_BACKOFF_S) -> dict | None:
     # Give the pipeline warmup most of the subprocess budget: the
     # warmup's first batch is where a starved PJRT client waits for
     # the pool lease, so a short warmup timeout would abandon the
@@ -347,23 +447,42 @@ def run_bench(args: list[str], timeout_s: float) -> dict | None:
     post_warmup = 900 if "--ab" in args else 300
     warmup = max(60, int(timeout_s - post_warmup))
     env = dict(os.environ, TZ_BENCH_WARMUP_TIMEOUT_S=str(warmup),
-               TZ_TELEMETRY_SNAPSHOT=TELEMETRY_SNAP)
-    try:
-        res = subprocess.run([sys.executable, "bench.py",
-                              "--no-preflight"] + args,
-                             capture_output=True, text=True,
-                             timeout=timeout_s, cwd=REPO, env=env)
-    except subprocess.TimeoutExpired:
-        log(f"bench {args} timed out after {timeout_s:.0f}s")
-        return None
-    if res.returncode != 0:
-        log(f"bench {args} failed: {res.stderr.strip()[-300:]}")
-        return None
-    try:
-        return json.loads(res.stdout.strip().splitlines()[-1])
-    except (ValueError, IndexError):
-        log(f"bench {args} emitted no JSON: {res.stdout[-200:]}")
-        return None
+               TZ_TELEMETRY_SNAPSHOT=TELEMETRY_SNAP,
+               TZ_FLIGHT_DIR=REPO)
+    # Lease-catching (BENCH_r05 carry-over): a subprocess timeout is
+    # the Client_Create starvation signature — retry with backoff a
+    # BOUNDED number of times, recording every attempt in the
+    # incident journal, instead of burning the whole probe interval
+    # on the first wedge.
+    for attempt in range(1 + max(0, lease_retries)):
+        if attempt:
+            log(f"lease-catch retry {attempt}/{lease_retries} for "
+                f"bench {args} after {lease_backoff_s:.0f}s backoff")
+            time.sleep(lease_backoff_s)
+        try:
+            res = subprocess.run([sys.executable, "bench.py",
+                                  "--no-preflight"] + args,
+                                 capture_output=True, text=True,
+                                 timeout=timeout_s, cwd=REPO, env=env)
+        except subprocess.TimeoutExpired:
+            log(f"bench {args} timed out after {timeout_s:.0f}s "
+                f"(attempt {attempt + 1}/{1 + lease_retries})")
+            record_attempt("timeout",
+                           f"bench {args} exceeded {timeout_s:.0f}s "
+                           "(lease never granted?)", attempt + 1)
+            continue
+        if res.returncode != 0:
+            log(f"bench {args} failed: {res.stderr.strip()[-300:]}")
+            record_attempt("error", res.stderr.strip()[-300:],
+                           attempt + 1)
+            return None
+        try:
+            return json.loads(res.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            log(f"bench {args} emitted no JSON: {res.stdout[-200:]}")
+            record_attempt("no_json", res.stdout[-200:], attempt + 1)
+            return None
+    return None
 
 
 def main() -> None:
@@ -378,6 +497,14 @@ def main() -> None:
     ap.add_argument("--diagnose-every", type=int, default=6,
                     help="capture a full wedge diagnostic every N "
                          "failed probes (0 = never)")
+    ap.add_argument("--lease-retries", type=int, default=LEASE_RETRIES,
+                    help="bounded in-attempt retries on a subprocess "
+                         "timeout (the Client_Create starvation "
+                         "signature); each is journaled in "
+                         "tz_flight_bench_watch.json")
+    ap.add_argument("--lease-backoff", type=float,
+                    default=LEASE_BACKOFF_S,
+                    help="seconds between lease-catch retries")
     ap.add_argument("--log-file", default="",
                     help="also append every log line here (inode-swap"
                          "-proof, reopened per write)")
@@ -399,7 +526,9 @@ def main() -> None:
             scaled = os.path.join(REPO,
                                   f"BENCH_AB_SCALED_r{opts.round:02d}.json")
             if not os.path.exists(scaled):
-                r = run_bench(["--ab-scaled"], timeout_s=2700)
+                r = run_bench(["--ab-scaled"], timeout_s=2700,
+                              lease_retries=opts.lease_retries,
+                              lease_backoff_s=opts.lease_backoff)
                 if r is not None and not r.get("error") \
                         and not r.get("platform"):
                     with open(scaled, "w") as f:
@@ -421,7 +550,9 @@ def main() -> None:
                    and (prefer_ab or have >= opts.want))
         if want_ab:
             what = "A/B"
-            r = run_bench(["--ab", str(opts.ab_secs)], timeout_s=2700)
+            r = run_bench(["--ab", str(opts.ab_secs)], timeout_s=2700,
+                          lease_retries=opts.lease_retries,
+                          lease_backoff_s=opts.lease_backoff)
             if r is not None and not ab_result_eligible(r):
                 log(f"A/B attempt produced an ineligible result "
                     f"(error={r.get('error')!r} "
@@ -434,7 +565,9 @@ def main() -> None:
                 log(f"A/B artifact written: {ab_path}")
         else:
             what = "flagship"
-            r = run_bench([], timeout_s=2700)
+            r = run_bench([], timeout_s=2700,
+                          lease_retries=opts.lease_retries,
+                          lease_backoff_s=opts.lease_backoff)
             if r is not None and r.get("value", 0) > 0:
                 log(f"flagship: {r.get('value')} mutants/s "
                     f"(vs_baseline {r.get('vs_baseline')})")

@@ -18,7 +18,13 @@ tests/test_tools.py invokes it):
      `tz_*_seconds`, ...) anywhere in the source must be a registered
      name — catches typos and copy-paste drift at use sites,
   3. catalogue check: the set of registered names and the set of
-     backticked `tz_*` names in docs/observability.md must be equal.
+     backticked `tz_*` names in docs/observability.md must be equal,
+  4. span/event/stage-name check (ISSUE 6): every `span("a.b")`,
+     `record_event("a.b")`, and lineage `hop(ctx, "a.b")` literal —
+     plus the lineage stage table in telemetry/lineage.py — must
+     appear backticked in docs/observability.md, and every backticked
+     dotted name in the doc whose namespace the code uses must exist
+     in code.  Spans added in PRs 3-5 previously had no drift guard.
 
 Usage: python -m syzkaller_tpu.tools.lint_metrics [repo_root]
 """
@@ -41,9 +47,18 @@ METRIC_SHAPE = re.compile(
 _REG_RE = re.compile(
     r"""(?:counter|gauge|histogram)\(\s*['"]([a-z0-9_.]+)['"]""")
 _SPAN_RE = re.compile(r"""span\(\s*['"]([a-z0-9_.]+)['"]""")
+_EVENT_RE = re.compile(
+    r"""record_event\(\s*['"]([a-z0-9_.]+)['"]""")
+_HOP_RE = re.compile(
+    r"""\bhop\(\s*[^,()'"]+,\s*['"]([a-z0-9_.]+)['"]""")
+_DOTTED_LIT_RE = re.compile(r"""['"]([a-z0-9_]+\.[a-z0-9_]+)['"]""")
 _LIT_RE = re.compile(r"""['"](tz_[a-z0-9_]+)['"]""")
 _STAT_NAME_RE = re.compile(r'Stat\.[A-Z_0-9]+:\s*"([a-z ]+)"')
 _DOC_NAME_RE = re.compile(r"`(tz_[a-z0-9_]+)`")
+_DOC_DOTTED_RE = re.compile(r"`([a-z0-9_]+\.[a-z0-9_]+)`")
+#: Backticked dotted names in the doc that end like file paths are
+#: prose, not span/event names.
+_FILEISH = (".py", ".md", ".go", ".json", ".jsonl", ".js", ".txt")
 
 
 def _span_metric_name(span_name: str) -> str:
@@ -66,11 +81,12 @@ def _source_files(root: str) -> list[str]:
 
 
 def scan_sources(root: str):
-    """(registered names, metric-shaped literals as (file, line, name))
-    over syzkaller_tpu/ + bench.py."""
+    """(registered names, metric-shaped literals as (file, line, name),
+    dotted span/event/stage names) over syzkaller_tpu/ + bench.py."""
     self_path = os.path.abspath(__file__)
     registered: set[str] = set()
     literals: list[tuple[str, int, str]] = []
+    dotted: set[str] = set()
     for path in _source_files(root):
         if os.path.abspath(path) == self_path:
             continue
@@ -89,6 +105,19 @@ def scan_sources(root: str):
         for m in _SPAN_RE.finditer(src):
             if "." in m.group(1):
                 registered.add(_span_metric_name(m.group(1)))
+                dotted.add(m.group(1))
+        for m in _EVENT_RE.finditer(src):
+            if "." in m.group(1):
+                dotted.add(m.group(1))
+        for m in _HOP_RE.finditer(src):
+            dotted.add(m.group(1))
+        if rel == os.path.join("syzkaller_tpu", "telemetry",
+                               "lineage.py"):
+            # The lineage stage table: every dotted literal in the
+            # module is a lifecycle stage name (the hop call sites
+            # elsewhere only cover the stages the engine reaches).
+            for m in _DOTTED_LIT_RE.finditer(src):
+                dotted.add(m.group(1))
         for lineno, line in enumerate(src.splitlines(), 1):
             for m in _LIT_RE.finditer(line):
                 if METRIC_SHAPE.match(m.group(1)):
@@ -100,7 +129,7 @@ def scan_sources(root: str):
                 registered.add(
                     "tz_fuzzer_" + m.group(1).replace(" ", "_")
                     + "_total")
-    return registered, literals
+    return registered, literals, dotted
 
 
 def doc_names(docs_path: str) -> set[str]:
@@ -111,11 +140,22 @@ def doc_names(docs_path: str) -> set[str]:
         return set()
 
 
+def doc_dotted_names(docs_path: str) -> set[str]:
+    """Backticked `a.b` names in the doc, minus file-path prose."""
+    try:
+        with open(docs_path) as f:
+            text = f.read()
+    except OSError:
+        return set()
+    return {n for n in _DOC_DOTTED_RE.findall(text)
+            if not n.endswith(_FILEISH)}
+
+
 def lint(root: str, docs_path: str | None = None) -> list[str]:
     """All problems found, as printable strings (empty = clean)."""
     if docs_path is None:
         docs_path = os.path.join(root, "docs", "observability.md")
-    registered, literals = scan_sources(root)
+    registered, literals, dotted = scan_sources(root)
     problems = []
     for rel, lineno, name in literals:
         if name not in registered:
@@ -135,6 +175,22 @@ def lint(root: str, docs_path: str | None = None) -> list[str]:
         problems.append(
             f"{name}: catalogued in {os.path.basename(docs_path)} but "
             "not registered anywhere in the source tree")
+    # Span/event/stage names (ISSUE 6): both directions.  The doc
+    # side is filtered to namespaces the code actually uses, so prose
+    # like `time.perf_counter` never false-positives, while a stale
+    # `pipeline.old_phase` does get flagged.
+    doc_dotted = doc_dotted_names(docs_path)
+    namespaces = {n.split(".", 1)[0] for n in dotted}
+    for name in sorted(dotted - doc_dotted):
+        problems.append(
+            f"{name}: span/event/stage name used in code but missing "
+            f"from {os.path.basename(docs_path)}")
+    for name in sorted(n for n in doc_dotted - dotted
+                       if n.split(".", 1)[0] in namespaces):
+        problems.append(
+            f"{name}: span/event/stage name catalogued in "
+            f"{os.path.basename(docs_path)} but not used anywhere in "
+            "the source tree")
     return problems
 
 

@@ -71,6 +71,7 @@ from typing import Optional
 import numpy as np
 
 from syzkaller_tpu import telemetry
+from syzkaller_tpu.telemetry import lineage
 from syzkaller_tpu.health import (
     CircuitBreaker,
     Watchdog,
@@ -374,9 +375,12 @@ class TriageEngine:
 
     # -- the check path ----------------------------------------------------
 
-    def check(self, fuzzer, prio_fn, infos) -> list:
+    def check(self, fuzzer, prio_fn, infos, trace=None) -> list:
         """Drop-in for Fuzzer.cpu_check_new_signal: same (call_index,
-        diff) list, same order, same max_signal/new_signal effects."""
+        diff) list, same order, same max_signal/new_signal effects.
+        `trace` is the executed mutant's lineage context: verdict
+        delivery (device-filtered or CPU-confirmed) is one hop on its
+        correlated track (telemetry/lineage.py)."""
         infos = list(infos)
         if not infos:
             return []
@@ -384,7 +388,9 @@ class TriageEngine:
         _M_CALLS.inc(len(infos))
         if not self._gate():
             self._note_demoted(f"circuit breaker {self.breaker.state}")
-            return self._cpu_all(fuzzer, prio_fn, infos)
+            news = self._cpu_all(fuzzer, prio_fn, infos)
+            lineage.hop(trace, "triage.verdict")
+            return news
         entries: dict[int, _Entry] = {}
         confirm_pos: list[int] = []
         staged: list[_Entry] = []
@@ -410,6 +416,7 @@ class TriageEngine:
             confirm_pos.extend(pos for pos, en in entries.items()
                                if en.flagged)
         if not confirm_pos:
+            lineage.hop(trace, "triage.verdict")
             return []
         confirm_pos.sort()
         with telemetry.span("triage.confirm"):
@@ -417,6 +424,7 @@ class TriageEngine:
                 prio_fn, [infos[p] for p in confirm_pos])
         for _ci, diff in news:
             self.merge_signal(diff)
+        lineage.hop(trace, "triage.verdict")
         return news
 
     def _cpu_all(self, fuzzer, prio_fn, infos) -> list:
@@ -599,8 +607,13 @@ class TriageEngine:
                     self._complete(en)
                 return
             try:
+                t_fetch = time.perf_counter()
                 flags = self.watchdog.call(
                     lambda: np.asarray(flags_dev), "device.triage")
+                # Always-on per-kernel attribution: the verdict fetch
+                # is novel_any's sync point (telemetry/profiler.py).
+                telemetry.PROFILER.note(
+                    "novel_any", time.perf_counter() - t_fetch)
             except Exception as e:
                 self._plane_dev = None
                 self._epoch += 1

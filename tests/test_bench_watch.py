@@ -169,6 +169,86 @@ def test_wedge_report_empty_snapshot():
                      "or health transitions"]
 
 
+def test_flight_report_renders_incident():
+    """ISSUE 6: the flight-recorder incident payload renders into
+    diagnostic lines — breaker timeline, span summary, queue-depth
+    history, recorded attempts.  Pure function, no live TPU."""
+    incident = {
+        "reason": "device_wedged", "detail": "device.launch hung",
+        "ts": 1e9, "pid": 42,
+        "spans": [[1e9, "pipeline.drain", 0.02],
+                  [1e9, "pipeline.drain", 0.03],
+                  [1e9, "pipeline.launch", 0.001]],
+        "queue_depths": [{"ts": 1e9, "tz_pipeline_queue_depth": 2}],
+        "breaker_timeline": [[1e9, "watchdog.wedge", "0.3s"],
+                             [1e9, "breaker.open", "4 failures"]],
+        "attempts": [{"ts": 1e9, "kind": "timeout",
+                      "reason": "lease never granted"}],
+    }
+    text = "\n".join(bw.flight_report(incident))
+    assert "incident: device_wedged" in text
+    assert "device.launch hung" in text
+    assert "watchdog.wedge" in text and "breaker.open" in text
+    assert "pipeline.drain=2" in text
+    assert "queue_depth=2" in text
+    assert "attempt" in text and "lease never granted" in text
+    # an empty incident degrades to a note, never a crash
+    assert any("no timeline" in ln for ln in bw.flight_report({}))
+
+
+def test_report_flight_reads_files(tmp_path, capsys):
+    path = tmp_path / "tz_flight_breaker_open_1.json"
+    with open(path, "w") as f:
+        json.dump({"reason": "breaker_open", "ts": 1e9, "pid": 1,
+                   "spans": [], "queue_depths": [],
+                   "breaker_timeline": []}, f)
+    bw.report_flight([str(path)])
+    out = capsys.readouterr().out
+    assert "flight recorder" in out and "breaker_open" in out
+    bw.report_flight([])
+    assert "no flight-recorder incident files" \
+        in capsys.readouterr().out
+
+
+def test_run_bench_lease_catching_bounded(tmp_path, monkeypatch):
+    """ISSUE 6 satellite (ROADMAP carry-over from BENCH_r05): a
+    Client_Create-style subprocess timeout retries with backoff a
+    BOUNDED number of times, recording every attempt in the incident
+    journal instead of failing the round on the first wedge."""
+    import subprocess as sp
+
+    calls = {"n": 0}
+
+    def fake_run(*a, **kw):
+        calls["n"] += 1
+        raise sp.TimeoutExpired(cmd="bench.py", timeout=kw["timeout"])
+
+    monkeypatch.setattr(bw.subprocess, "run", fake_run)
+    monkeypatch.setattr(bw, "INCIDENT_PATH",
+                        str(tmp_path / "tz_flight_bench_watch.json"))
+    assert bw.run_bench([], timeout_s=5, lease_retries=2,
+                        lease_backoff_s=0.0) is None
+    assert calls["n"] == 3  # initial + 2 bounded retries
+    payload = json.loads(open(bw.INCIDENT_PATH).read())
+    kinds = [a["kind"] for a in payload["attempts"]]
+    assert kinds == ["timeout"] * 3
+    assert payload["attempts"][0]["attempt"] == 1
+    assert payload["attempts"][-1]["attempt"] == 3
+
+    # a non-timeout failure does NOT retry (the wedge signature is
+    # the subprocess timeout, not an ordinary bench error)
+    def fake_fail(*a, **kw):
+        calls["n"] += 1
+        return sp.CompletedProcess(a[0], returncode=1, stdout="",
+                                   stderr="boom")
+
+    calls["n"] = 0
+    monkeypatch.setattr(bw.subprocess, "run", fake_fail)
+    assert bw.run_bench([], timeout_s=5, lease_retries=2,
+                        lease_backoff_s=0.0) is None
+    assert calls["n"] == 1
+
+
 def test_report_telemetry_reads_dump(tmp_path, monkeypatch, capsys):
     """End-to-end: a telemetry dump on disk (what bench.dump_telemetry
     leaves behind) renders into diagnose_wedge's log output."""

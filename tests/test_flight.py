@@ -1,0 +1,268 @@
+"""Flight recorder (telemetry/flight.py, ISSUE 6): bounded rings,
+incident dumps + triggers (breaker-open, DeviceWedged, SIGTERM),
+the race-fixed snapshot under a concurrent increment hammer, the
+attempt journal, and the Prometheus exposition validator
+(telemetry/promcheck.py).  Host-only; the on-pipeline DeviceWedged
+incident test shares the warm rig in test_health_faults.py."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.telemetry.flight import FlightRecorder, append_attempt
+from syzkaller_tpu.telemetry.registry import Registry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mk(tmp_path, size=64):
+    reg = Registry()
+    fr = FlightRecorder(registry=reg, size=size)
+    fr.set_dir(str(tmp_path))
+    fr.min_interval_s = 0.0
+    return reg, fr
+
+
+# -- rings --------------------------------------------------------------
+
+
+def test_span_ring_is_bounded(tmp_path):
+    _reg, fr = _mk(tmp_path, size=32)
+    for i in range(100):
+        fr.note_span("pipeline.drain", 0.001 * i)
+    snap = fr.snapshot()
+    assert len(snap["spans"]) == 32
+    assert snap["spans"][-1][2] == pytest.approx(0.099)  # newest kept
+
+
+def test_gauge_history_samples_watch_gauges(tmp_path):
+    reg, fr = _mk(tmp_path)
+    reg.gauge("tz_pipeline_queue_depth").set(5)
+    for _ in range(64):
+        fr.note_span("proc.exec", 0.001)
+    snap = fr.snapshot()
+    assert snap["queue_depths"]
+    assert snap["queue_depths"][-1]["tz_pipeline_queue_depth"] == 5
+
+
+# -- dumps --------------------------------------------------------------
+
+
+def test_dump_disarmed_returns_none():
+    fr = FlightRecorder(registry=Registry())
+    fr.min_interval_s = 0.0
+    assert not fr.armed()
+    assert fr.dump("breaker_open") is None
+
+
+def test_dump_writes_structured_incident(tmp_path):
+    reg, fr = _mk(tmp_path)
+    reg.counter("tz_pipeline_batches_total").inc(7)
+    reg.gauge("tz_pipeline_queue_depth").set(2)
+    reg.record_event("breaker.open", "after 4 failures")
+    reg.record_event("watchdog.wedge", "device.launch 0.3s")
+    for _ in range(40):
+        fr.note_span("pipeline.drain", 0.01)
+    path = fr.dump("device_wedged", "device.launch hung")
+    assert path is not None and os.path.exists(path)
+    incident = json.loads(open(path).read())
+    assert incident["reason"] == "device_wedged"
+    assert incident["detail"] == "device.launch hung"
+    assert incident["spans"] and incident["queue_depths"]
+    names = [n for _ts, n, _d in incident["breaker_timeline"]]
+    assert names == ["breaker.open", "watchdog.wedge"]
+    assert incident["registry"]["counters"][
+        "tz_pipeline_batches_total"] == 7
+
+
+def test_dump_rate_limited_per_reason(tmp_path):
+    _reg, fr = _mk(tmp_path)
+    fr.min_interval_s = 60.0
+    assert fr.dump("breaker_open") is not None
+    assert fr.dump("breaker_open") is None  # limited
+    assert fr.dump("device_wedged") is not None  # other reason free
+
+
+def test_dump_uses_race_fixed_snapshot_under_hammer(tmp_path):
+    """ISSUE 6 satellite: the dump path reads the registry through
+    Registry.snapshot() (one lock acquisition for the metric list,
+    per-metric locks for values — the grab_stats race-fix shape), not
+    a live-counter walk.  Hammer a counter from worker threads while
+    dumping continuously: every dump parses, and the recorded values
+    are monotone and conserved."""
+    reg, fr = _mk(tmp_path)
+    c = reg.counter("tz_hammer_total")
+    per_thread, nthreads = 5000, 4
+    stop = threading.Event()
+
+    def worker():
+        for _ in range(per_thread):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    values = []
+    while any(t.is_alive() for t in threads):
+        path = fr.dump("on_demand")
+        if path:
+            values.append(json.loads(open(path).read())
+                          ["registry"]["counters"]["tz_hammer_total"])
+    for t in threads:
+        t.join()
+    final = json.loads(open(fr.dump("on_demand")).read())
+    values.append(final["registry"]["counters"]["tz_hammer_total"])
+    assert values[-1] == per_thread * nthreads  # conserved
+    assert all(a <= b for a, b in zip(values, values[1:]))  # monotone
+
+
+# -- automatic triggers -------------------------------------------------
+
+
+def test_breaker_open_triggers_dump(tmp_path):
+    from syzkaller_tpu.health import CircuitBreaker
+
+    telemetry.FLIGHT.set_dir(str(tmp_path))
+    saved = telemetry.FLIGHT.min_interval_s
+    telemetry.FLIGHT.min_interval_s = 0.0
+    try:
+        br = CircuitBreaker(failure_threshold=1, backoff_initial=60.0)
+        br.record_failure()
+        path = os.path.join(
+            tmp_path, f"tz_flight_breaker_open_{os.getpid()}.json")
+        assert os.path.exists(path)
+        incident = json.loads(open(path).read())
+        assert incident["reason"] == "breaker_open"
+    finally:
+        telemetry.FLIGHT.set_dir(None)
+        telemetry.FLIGHT.min_interval_s = saved
+
+
+def test_device_wedged_triggers_dump(tmp_path):
+    from syzkaller_tpu.health import DeviceWedged, Watchdog
+
+    telemetry.FLIGHT.set_dir(str(tmp_path))
+    saved = telemetry.FLIGHT.min_interval_s
+    telemetry.FLIGHT.min_interval_s = 0.0
+    hang = threading.Event()
+    try:
+        wd = Watchdog(deadline_s=0.05)
+        with pytest.raises(DeviceWedged):
+            wd.call(hang.wait, "device.launch")
+        path = os.path.join(
+            tmp_path, f"tz_flight_device_wedged_{os.getpid()}.json")
+        assert os.path.exists(path)
+        incident = json.loads(open(path).read())
+        assert "device.launch" in incident["detail"]
+        assert any(n == "watchdog.wedge"
+                   for _ts, n, _d in incident["breaker_timeline"])
+    finally:
+        hang.set()
+        telemetry.FLIGHT.set_dir(None)
+        telemetry.FLIGHT.min_interval_s = saved
+
+
+def test_sigterm_dumps_incident(tmp_path):
+    """SIGTERM is the supervisor killing a possibly-mid-incident
+    process: the handler dumps the black box, then delivers the
+    default disposition (the process still dies of SIGTERM)."""
+    code = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {str(REPO_ROOT)!r})\n"
+        "from syzkaller_tpu import telemetry\n"
+        "from syzkaller_tpu.telemetry import flight\n"
+        f"telemetry.FLIGHT.set_dir({str(tmp_path)!r})\n"
+        "telemetry.FLIGHT.min_interval_s = 0.0\n"
+        "telemetry.counter('tz_sig_probe_total').inc(3)\n"
+        "assert flight.install_signal_handler()\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(30)\n")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=20)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == -signal.SIGTERM  # default delivered
+    path = os.path.join(tmp_path,
+                        f"tz_flight_sigterm_{proc.pid}.json")
+    assert os.path.exists(path)
+    incident = json.loads(open(path).read())
+    assert incident["reason"] == "sigterm"
+    assert incident["registry"]["counters"][
+        "tz_sig_probe_total"] == 3
+
+
+# -- the attempt journal ------------------------------------------------
+
+
+def test_append_attempt_accumulates_and_bounds(tmp_path):
+    path = str(tmp_path / "inc.json")
+    for i in range(12):
+        append_attempt(path, {"kind": "timeout", "reason": f"r{i}",
+                              "attempt": i})
+    payload = json.loads(open(path).read())
+    assert len(payload["attempts"]) == 12
+    assert payload["attempts"][-1]["reason"] == "r11"
+    assert payload["attempts"][-1]["ts"] > 0
+    # the bound, without paying 300 JSON rewrites: seed an oversized
+    # journal and append once
+    payload["attempts"] = [{"kind": "timeout", "reason": "old"}] * 400
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    append_attempt(path, {"kind": "timeout", "reason": "new"})
+    payload = json.loads(open(path).read())
+    assert len(payload["attempts"]) == 256  # bounded
+    assert payload["attempts"][-1]["reason"] == "new"
+
+
+# -- the exposition validator (telemetry/promcheck.py) ------------------
+
+
+def test_promcheck_accepts_registry_output():
+    from syzkaller_tpu.telemetry.promcheck import validate_exposition
+
+    reg = Registry()
+    reg.counter("tz_c_total", "a counter").inc(3)
+    reg.gauge("tz_g_depth").set(1.5)
+    reg.gauge("tz_fam_ms_per_batch", labels={"kernel": "mutate"}).set(2)
+    reg.gauge("tz_fam_ms_per_batch", labels={"kernel": "novel"}).set(3)
+    reg.histogram("tz_h_seconds").observe(0.01)
+    assert validate_exposition(reg.render_prometheus()) == []
+
+
+def test_promcheck_flags_malformations():
+    from syzkaller_tpu.telemetry.promcheck import validate_exposition
+
+    assert any("unknown TYPE" in p for p in validate_exposition(
+        "# TYPE tz_x_total banana\ntz_x_total 1\n"))
+    assert any("duplicate TYPE" in p for p in validate_exposition(
+        "# TYPE tz_x_total counter\n# TYPE tz_x_total counter\n"
+        "tz_x_total 1\n"))
+    assert any("malformed sample" in p for p in validate_exposition(
+        "tz x total 1\n"))
+    assert any("malformed label" in p for p in validate_exposition(
+        'tz_x_total{kernel=mutate} 1\n'))
+    assert any("le label" in p for p in validate_exposition(
+        "# TYPE tz_h_seconds histogram\n"
+        'tz_h_seconds_bucket{kernel="x"} 1\n'))
+    assert any("+Inf" in p for p in validate_exposition(
+        "# TYPE tz_h_seconds histogram\n"
+        'tz_h_seconds_bucket{le="1"} 1\n'))
+    assert any("cumulative" in p for p in validate_exposition(
+        "# TYPE tz_h_seconds histogram\n"
+        'tz_h_seconds_bucket{le="1"} 5\n'
+        'tz_h_seconds_bucket{le="2"} 3\n'
+        'tz_h_seconds_bucket{le="+Inf"} 5\n'))

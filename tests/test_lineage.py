@@ -1,0 +1,200 @@
+"""Lineage tracing (telemetry/lineage.py, ISSUE 6): sampling,
+per-stage wait histograms, wire roundtrip through the RPC frame
+header, the correlated Perfetto track, and the zero-per-mutant-
+overhead contract.  All host-only and stdlib-fast — the warm-pipeline
+end-to-end propagation test lives in test_health_faults.py (shares
+the module-scoped device rig, no new jit compiles)."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.telemetry import lineage
+
+
+@pytest.fixture(autouse=True)
+def _restore_rate():
+    yield
+    lineage.set_sample_rate(None)
+
+
+# -- sampling -----------------------------------------------------------
+
+
+def test_mint_respects_sample_rate():
+    lineage.set_sample_rate(0.0)
+    assert lineage.mint() is None  # the zero-overhead path
+    lineage.set_sample_rate(1.0)
+    ctx = lineage.mint()
+    assert ctx is not None and ctx.sampled and ctx.trace_id
+    assert ctx.last_stage == "lineage.mint"
+    # two mints get distinct ids
+    other = lineage.mint()
+    assert other.trace_id != ctx.trace_id
+
+
+def test_sample_rate_env_parse(monkeypatch):
+    lineage.set_sample_rate(None)
+    monkeypatch.setenv(lineage.ENV_SAMPLE, "0.25")
+    assert lineage.sample_rate() == 0.25
+    lineage.set_sample_rate(None)
+    monkeypatch.setenv(lineage.ENV_SAMPLE, "not-a-rate")
+    assert lineage.sample_rate() == 0.0  # envsafe: malformed -> off
+    lineage.set_sample_rate(None)
+    monkeypatch.setenv(lineage.ENV_SAMPLE, "7")
+    assert lineage.sample_rate() == 1.0  # clamped
+
+
+def test_sampled_counter_advances():
+    c = telemetry.REGISTRY.counter("tz_lineage_sampled_total")
+    before = c.value
+    lineage.set_sample_rate(1.0)
+    lineage.mint()
+    assert c.value == before + 1
+
+
+# -- hops ---------------------------------------------------------------
+
+
+def test_hop_records_stage_wait_and_advances_stage():
+    lineage.set_sample_rate(1.0)
+    ctx = lineage.mint()
+    h = telemetry.REGISTRY.histogram("tz_lineage_deliver_wait_seconds")
+    before = h.count
+    lineage.hop(ctx, "pipeline.deliver")
+    assert h.count == before + 1
+    assert ctx.last_stage == "pipeline.deliver"
+    # None context: every hop is one `is None` test, nothing recorded
+    lineage.hop(None, "pipeline.deliver")
+    assert h.count == before + 1
+
+
+# -- the wire form (RPC frame header) -----------------------------------
+
+
+def test_wire_roundtrip_records_rpc_hop():
+    lineage.set_sample_rate(1.0)
+    ctx = lineage.mint()
+    h = telemetry.REGISTRY.histogram("tz_lineage_rpc_wait_seconds")
+    before = h.count
+    data = lineage.to_wire(ctx)
+    assert len(data) == lineage.WIRE.size
+    got = lineage.from_wire(data)
+    assert got.trace_id == ctx.trace_id and got.sampled
+    assert got.last_stage == "rpc.frame"
+    assert h.count == before + 1
+
+
+def test_rpc_frame_carries_trace_to_server_thread():
+    """The cross-process edge: a traced client call parks the decoded
+    context in the server handler thread's thread-local, and an
+    untraced call clears it (no stale context bleeds into the next
+    dispatch on a pooled connection)."""
+    from syzkaller_tpu.rpc import RPCClient, RPCServer
+
+    seen: list = []
+
+    class Svc:
+        def Probe(self, params):
+            ctx = lineage.current()
+            seen.append(None if ctx is None else ctx.trace_id)
+            return {"ok": True}
+
+    srv = RPCServer()
+    srv.register("Svc", Svc())
+    srv.serve_in_background()
+    cli = RPCClient(srv.addr, timeout_s=5.0)
+    try:
+        lineage.set_sample_rate(1.0)
+        ctx = lineage.mint()
+        assert cli.call("Svc.Probe", {}, trace=ctx) == {"ok": True}
+        assert cli.call("Svc.Probe", {}) == {"ok": True}
+        assert seen == [ctx.trace_id, None]
+    finally:
+        cli.close()
+        srv.close()
+
+
+# -- the correlated track -----------------------------------------------
+
+
+def test_trace_file_renders_one_correlated_track(tmp_path):
+    """Every lifecycle hop of a sampled context lands in TZ_TRACE_FILE
+    as an async-instant event keyed by ONE trace id — the Perfetto
+    correlation contract — including the hop recorded on the RPC
+    server's thread (a different tid, standing in for the second
+    process whose pid the production deployment supplies)."""
+    from syzkaller_tpu.rpc import RPCClient, RPCServer
+
+    path = tmp_path / "trace.json"
+    telemetry.set_trace_file(str(path))
+    srv = RPCServer()
+
+    class Svc:
+        def Probe(self, params):
+            return {}
+
+    srv.register("Svc", Svc())
+    srv.serve_in_background()
+    cli = RPCClient(srv.addr, timeout_s=5.0)
+    try:
+        lineage.set_sample_rate(1.0)
+        ctx = lineage.mint()
+        lineage.hop(ctx, "pipeline.deliver")
+        lineage.hop(ctx, "proc.draw")
+        cli.call("Svc.Probe", {}, trace=ctx)
+        lineage.hop(ctx, "triage.verdict")
+        lineage.hop(ctx, "corpus.add")
+    finally:
+        cli.close()
+        srv.close()
+        telemetry.set_trace_file(None)
+    events = [json.loads(ln.rstrip(",")) for ln in
+              path.read_text().splitlines()[1:]]
+    track = [e for e in events if e.get("cat") == "tz.lineage"
+             and e.get("id") == format(ctx.trace_id, "016x")]
+    stages = {e["name"] for e in track}
+    assert {"lineage.mint", "pipeline.deliver", "proc.draw",
+            "rpc.frame", "triage.verdict", "corpus.add"} <= stages
+    assert all(e["ph"] == "n" for e in track)
+    # the rpc.frame hop was emitted from the server handler thread
+    assert len({e["tid"] for e in track}) >= 2
+    # hops after the first carry the measured wait
+    waits = [e["args"]["wait_s"] for e in track
+             if e["name"] != "lineage.mint"]
+    assert all(w >= 0 for w in waits)
+
+
+# -- zero per-mutant overhead -------------------------------------------
+
+
+def test_exec_mutant_has_no_per_mutant_trace_storage():
+    """The context lives on the BATCH; ExecMutant.trace is a property
+    over the batch reference — unsampled mutants allocate nothing."""
+    from syzkaller_tpu.ops.pipeline import AssembledBatch, ExecMutant
+
+    assert "trace" not in ExecMutant.__slots__
+    assert isinstance(ExecMutant.trace, property)
+    ab = AssembledBatch(seq=3)
+    assert ab.trace is None  # unsampled default
+
+
+def test_cpu_check_path_hops_verdict():
+    from syzkaller_tpu.fuzzer import Fuzzer, WorkQueue
+    from syzkaller_tpu.models.target import get_target
+
+    fz = Fuzzer(get_target("test", "64"), wq=WorkQueue())
+    lineage.set_sample_rate(1.0)
+    ctx = lineage.mint()
+    h = telemetry.REGISTRY.histogram("tz_lineage_verdict_wait_seconds")
+    before = h.count
+    assert fz.check_new_signal_fn(lambda e, i: 3, [], trace=ctx) == []
+    assert h.count == before + 1
+    assert ctx.last_stage == "triage.verdict"
+    # and the no-trace call (every unsampled mutant) records nothing
+    assert fz.check_new_signal_fn(lambda e, i: 3, []) == []
+    assert h.count == before + 1

@@ -329,6 +329,28 @@ def test_http_ui_endpoints(tmp_path, test_target):
             metrics = get("/metrics")
             assert ('tz_pipeline_mutants_total{source="fleet"} 9'
                     in metrics)
+            # CI satellite (ISSUE 6): the whole exposition — process
+            # registry + labeled gauge families + the fleet section —
+            # must parse as well-formed Prometheus text, so a
+            # fleet-merge or new-gauge regression fails here instead
+            # of at scrape time.
+            from syzkaller_tpu.telemetry.promcheck import (
+                validate_exposition,
+            )
+
+            assert validate_exposition(metrics) == []
+            # the per-kernel profiler family renders with one TYPE
+            # line and a label per kernel
+            assert ('tz_device_kernel_ms_per_batch{kernel="mutate"}'
+                    in metrics)
+            assert metrics.count(
+                "# TYPE tz_device_kernel_ms_per_batch gauge") == 1
+            # /api/debug/flight: the live flight-recorder payload
+            flight = json_mod.loads(get("/api/debug/flight"))
+            assert flight["reason"] == "on_demand"
+            for key in ("spans", "queue_depths", "breaker_timeline",
+                        "registry"):
+                assert key in flight
             corpus = get("/corpus")
             assert "/input?sig=" in corpus
             sig = corpus.split("/input?sig=")[1].split("'")[0]

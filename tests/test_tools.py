@@ -393,3 +393,26 @@ def test_lint_metrics_flags_docs_drift_both_ways(tmp_path):
     assert any("tz_stale_total" in p and "not registered" in p
                for p in problems)
     assert not any("tz_phase_work_seconds" in p for p in problems)
+
+
+def test_lint_metrics_flags_span_event_name_drift(tmp_path):
+    """ISSUE 6 satellite: span names, timeline-event names, and
+    lineage hop stages are cross-checked against the doc catalogue —
+    both directions, namespace-filtered so prose like
+    `time.perf_counter` never false-positives."""
+    problems = _lint_tree(
+        tmp_path,
+        'with telemetry.span("phase.work"):\n    pass\n'
+        'telemetry.record_event("phase.trip", "detail")\n'
+        'lineage.hop(ctx, "phase.hop")\n',
+        "catalogue: `tz_phase_work_seconds` `tz_phase_trip_x` ok\n"
+        "spans: `phase.work` `phase.trip` `phase.stale`\n"
+        "prose: `time.perf_counter` and `mod.py` stay unflagged\n")
+    assert any(p.startswith("phase.hop:") and "missing from" in p
+               for p in problems)
+    assert any(p.startswith("phase.stale:") and "not used" in p
+               for p in problems)
+    for name in ("phase.work", "phase.trip", "time.perf_counter",
+                 "mod.py"):
+        assert not any(p.startswith(f"{name}:") for p in problems), \
+            (name, problems)
