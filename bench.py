@@ -27,6 +27,10 @@ Modes:
                                    # flagship shape (mutate,
                                    # emit-compact, novel_any) — the
                                    # Pallas-rewrite baseline
+  python bench.py --coverage       # coverage-intelligence analytics:
+                                   # occupancy popcount + heat map +
+                                   # drift audit cost at the full
+                                   # plane shape, novelty-rate EWMA
 """
 
 from __future__ import annotations
@@ -466,6 +470,83 @@ def bench_triage(calls_per_check=512, edges_per_call=64, checks=80,
         # report the realized divergence instead of asserting it away.
         "triage_parity_max_signal": len(fz_dev.max_signal)
         == len(fz_cpu.max_signal),
+    }
+
+
+def bench_coverage(seen_edges=1 << 18, reps=20, novel_checks=40,
+                   edges_per_call=64) -> dict:
+    """Coverage-intelligence analytics at the full plane shape
+    (ISSUE 7, telemetry/coverage.py + ops/signal coverage kernels).
+
+    Seeds a TriageEngine's plane with `seen_edges` random 32-bit
+    edges, then measures the flush-cadence reductions where they run
+    in production: `coverage_analytics_ms_per_flush` is one exact
+    occupancy popcount + 256-region heat histogram over the
+    uint8[2^26] plane (the per-interval cost the flush leader pays),
+    `coverage_drift_audit_ms` adds the 64 MB mirror upload +
+    xor/popcount drift audit.  A short novelty stream through the
+    verdict path then reports the tracker-side sub-metrics: the
+    novelty-rate EWMA and the stall verdict."""
+    import numpy as np
+
+    from syzkaller_tpu import telemetry
+    from syzkaller_tpu.fuzzer import Fuzzer, WorkQueue
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops import signal as dsig
+    from syzkaller_tpu.triage import TriageEngine
+
+    class _Info:
+        __slots__ = ("call_index", "errno", "signal")
+
+        def __init__(self, call_index, signal):
+            self.call_index = call_index
+            self.errno = 0
+            self.signal = signal
+
+    rng = np.random.RandomState(13)
+    eng = TriageEngine(batch=64, max_edges=edges_per_call)
+    eng._merge_edges(
+        rng.randint(0, 1 << 32, size=seen_edges, dtype=np.uint32), 3)
+    eng.share_plane()  # materialize the device plane
+    eng.run_analytics(audit=True)  # compile both kernels (once)
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = eng.run_analytics()
+    stats_ms = 1e3 * (time.perf_counter() - t0) / reps
+    audit_reps = max(1, reps // 4)
+    t0 = time.perf_counter()
+    for _ in range(audit_reps):
+        r = eng.run_analytics(audit=True)
+    audit_ms = 1e3 * (time.perf_counter() - t0) / audit_reps
+
+    # Tracker-side sub-metrics: replay a short stream through the
+    # verdict path so the EWMA/attribution have production inputs.
+    target = get_target("test", "64")
+    fz = Fuzzer(target, wq=WorkQueue())
+    fz.set_triage(eng)
+    for i in range(novel_checks):
+        sig = rng.randint(0, 1 << 32, size=edges_per_call,
+                          dtype=np.uint32)
+        fz.check_new_signal_fn(lambda _e, _i: 3,
+                               [_Info(0, sig)], source="exploration")
+    telemetry.COVERAGE.tick(force=True)  # fold the stream into the EWMA
+    snap = telemetry.COVERAGE.snapshot()
+    occ = r["occupancy"]
+    regions = r["regions"]
+    return {
+        "coverage_plane_occupancy": int(occ),
+        "coverage_occupancy_frac": round(occ / dsig.PLANE_SIZE, 6),
+        "coverage_heat_regions_occupied":
+            int(np.count_nonzero(regions))
+            if regions is not None else None,
+        "coverage_analytics_ms_per_flush": round(stats_ms, 3),
+        "coverage_drift_audit_ms": round(audit_ms, 3),
+        "coverage_drift_buckets": r["drift"],
+        "coverage_novelty_rate_ewma":
+            round(snap["novelty_rate_ewma"], 4),
+        "coverage_novel_edges_total": snap["novel_edges_total"],
+        "coverage_stalled": int(snap["stalled"]),
     }
 
 
@@ -1014,6 +1095,15 @@ def main() -> None:
         res = {"metric": "device_kernel_ms_per_batch",
                "unit": "ms/batch", **bench_profile()}
         res["value"] = res["device_kernel_ms_per_batch"]["mutate"]
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
+    if "--coverage" in argv:
+        res = {"metric": "coverage_analytics_ms_per_flush",
+               "unit": "ms/flush", **bench_coverage()}
+        res["value"] = res["coverage_analytics_ms_per_flush"]
         if platform:
             res["platform"] = platform
         journal_append(res)

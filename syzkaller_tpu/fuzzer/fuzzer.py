@@ -227,15 +227,18 @@ class Fuzzer:
         engine.attach(self)
         self.triage = engine
 
-    def check_new_signal(self, p: Prog, infos) -> list[tuple[int, Signal]]:
+    def check_new_signal(self, p: Prog, infos, source=None,
+                         proc=None) -> list[tuple[int, Signal]]:
         """Per-call novelty test against max_signal; returns calls with
         new signal and updates max/new signal under one lock
         (reference: fuzzer.go:494-511)."""
         return self.check_new_signal_fn(
-            lambda errno, idx: signal_prio(p, errno, idx), infos)
+            lambda errno, idx: signal_prio(p, errno, idx), infos,
+            source=source, proc=proc)
 
-    def check_new_signal_fn(self, prio_fn, infos,
-                            trace=None) -> list[tuple[int, Signal]]:
+    def check_new_signal_fn(self, prio_fn, infos, trace=None,
+                            source=None,
+                            proc=None) -> list[tuple[int, Signal]]:
         """check_new_signal with a caller-supplied prio_fn(errno,
         call_index) — lets undecoded device mutants compute edge
         priority from their exec-template flags without a typed
@@ -248,12 +251,22 @@ class Fuzzer:
 
         `trace` is the executed mutant's lineage context: verdict
         delivery is a hop on its correlated track
-        (telemetry/lineage.py)."""
+        (telemetry/lineage.py).  `source`/`proc` are the executed
+        program's workqueue lane and worker id: confirmed novel edges
+        are attributed to them (telemetry/coverage.py —
+        `tz_coverage_novel_edges_total{source=...}` + the per-proc
+        rollup), and the no-news case ticks the plateau detector."""
         eng = self.triage
         if eng is not None:
-            return eng.check(self, prio_fn, infos, trace=trace)
-        news = self.cpu_check_new_signal(prio_fn, infos)
-        lineage.hop(trace, "triage.verdict")
+            news = eng.check(self, prio_fn, infos, trace=trace)
+        else:
+            news = self.cpu_check_new_signal(prio_fn, infos)
+            lineage.hop(trace, "triage.verdict")
+        if news:
+            telemetry.COVERAGE.note_novel(
+                source, sum(len(d) for _ci, d in news), proc=proc)
+        else:
+            telemetry.COVERAGE.tick()
         return news
 
     def cpu_check_new_signal(self, prio_fn,

@@ -51,6 +51,23 @@ from syzkaller_tpu.utils import log
 _M_LOOP_ITERS = telemetry.counter(
     "tz_proc_loop_iterations_total", "proc fuzz-loop iterations")
 
+#: Workqueue lane per execution Stat, for per-source novelty
+#: attribution (telemetry/coverage.py SOURCES): the generate/mutate
+#: fallback is the "exploration" lane, candidate executions the
+#: "candidate" lane, smash-phase executions (extra mutants, hints,
+#: fault injection seeds) the "smash" lane, and triage re-executions
+#: (deflake/minimize) the "triage"/"triage_candidate" lanes.
+_LANE_BY_STAT = {
+    Stat.GENERATE: "exploration",
+    Stat.FUZZ: "exploration",
+    Stat.CANDIDATE: "candidate",
+    Stat.TRIAGE: "triage",
+    Stat.MINIMIZE: "triage",
+    Stat.SMASH: "smash",
+    Stat.HINT: "smash",
+    Stat.SEED: "smash",
+}
+
 
 class PipelineMutator:
     """Integrated mutation source over a DevicePipeline
@@ -495,28 +512,40 @@ class Proc:
     # -- execution --------------------------------------------------------
 
     def execute(self, opts: ExecOpts, p, stat: Stat,
-                flags: Optional[ProgTypes] = None) -> Optional[ExecResult]:
+                flags: Optional[ProgTypes] = None,
+                source: Optional[str] = None) -> Optional[ExecResult]:
         """Execute + novelty check; new signal enqueues triage work
         (reference: proc.go:230-247).
 
         p is a typed Prog or an exec-ready device mutant (anything with
         .exec_bytes / .signal_prio / .prog()); mutants are decoded to a
         typed program only when they produce new signal — the ~1/1000
-        triage path (syz-fuzzer/proc.go:100)."""
+        triage path (syz-fuzzer/proc.go:100).
+
+        `source` overrides the workqueue-lane attribution of any novel
+        edges this execution confirms; by default the lane is derived
+        from `stat` (_LANE_BY_STAT) and threaded — alongside the
+        lineage context — through the TriageEngine verdict path into
+        `tz_coverage_novel_edges_total{source=...}`."""
         result = self.execute_raw(opts, p, stat)
         if result is None:
             return None
+        source = source or _LANE_BY_STAT.get(stat, "exploration")
         trace = None
         if _is_exec_mutant(p):
             trace = p.trace
             news = self.fuzzer.check_new_signal_fn(p.signal_prio,
                                                    result.info,
-                                                   trace=trace)
+                                                   trace=trace,
+                                                   source=source,
+                                                   proc=self.pid)
             if not news:
                 return result
             decoded = p.prog()  # lazy typed decode for triage
         else:
-            news = self.fuzzer.check_new_signal(p, result.info)
+            news = self.fuzzer.check_new_signal(p, result.info,
+                                                source=source,
+                                                proc=self.pid)
             decoded = p
         for call_index, sig in news:
             self.fuzzer.wq.enqueue(WorkTriage(

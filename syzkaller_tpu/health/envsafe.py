@@ -35,10 +35,16 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_BREAKER_BACKOFF_CAP_S",
     "TZ_BREAKER_BACKOFF_S",
     "TZ_BREAKER_THRESHOLD",
+    "TZ_COVERAGE_AUDIT_S",
+    "TZ_COVERAGE_INTERVAL_S",
+    "TZ_COVERAGE_RING",
+    "TZ_COVERAGE_STALL_EDGES",
+    "TZ_COVERAGE_STALL_WINDOW_S",
     "TZ_FAULT_PLAN",
     "TZ_FLIGHT_DIR",
     "TZ_FLIGHT_RING",
     "TZ_JAX_PLATFORM",
+    "TZ_MANAGER_HTTP",
     "TZ_PIPELINE_DISPATCH_DEPTH",
     "TZ_TELEMETRY_SNAPSHOT",
     "TZ_TRACE_FILE",

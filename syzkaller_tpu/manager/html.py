@@ -73,6 +73,14 @@ def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
                     self._send(json.dumps(
                         telemetry.FLIGHT.snapshot("on_demand")),
                         "application/json")
+                elif url.path == "/api/coverage":
+                    # Coverage intelligence (ISSUE 7,
+                    # telemetry/coverage.py): growth curve, heat
+                    # regions, per-lane attribution, drift status —
+                    # local tracker plus the fleet's tz_coverage_*
+                    # series from poll telemetry.
+                    self._send(json.dumps(_coverage_payload(mgr)),
+                               "application/json")
                 elif url.path == "/api/stats":
                     # Machine-readable superset of /stats: the manager
                     # rollup plus the full telemetry snapshot
@@ -131,6 +139,51 @@ def _page(title: str, body: str) -> str:
             f"<a href='/metrics'>metrics</a></p>{body}</body></html>")
 
 
+def _coverage_payload(mgr) -> dict:
+    """The /api/coverage body: the local tracker's snapshot plus the
+    fleet's tz_coverage_* counters/gauges (poll-telemetry merge), and
+    one top-level stalled flag (local OR any fleet member)."""
+    cov = telemetry.COVERAGE.snapshot()
+    fleet = mgr.serv.fleet_telemetry()
+    fl = {}
+    for kind in ("counters", "gauges"):
+        for name, v in (fleet.get(kind) or {}).items():
+            if name.startswith("tz_coverage_"):
+                fl[name] = v
+    return {
+        "local": cov,
+        "fleet": fl,
+        "stalled": bool(cov["stalled"]
+                        or fl.get("tz_coverage_stalled", 0)),
+    }
+
+
+def _coverage_section(mgr) -> str:
+    """Summary-page rollup of the coverage intelligence plane."""
+    payload = _coverage_payload(mgr)
+    cov = payload["local"]
+    rows = [
+        ("plane occupancy", f"{cov['occupancy']}"),
+        ("novelty rate (EWMA)",
+         f"{cov['novelty_rate_ewma']:.3f} edges/s"),
+        ("novel edges total", f"{cov['novel_edges_total']}"),
+        ("last novel edge", f"{cov['last_novel_age_s']:.0f}s ago"),
+        ("stalled", "YES — plateau detector latched"
+         if payload["stalled"] else "no"),
+        ("stalls", f"{cov['stalls']}"),
+        ("drift audit", f"{cov['drift']['buckets']} buckets "
+                        f"({cov['drift']['audits']} audits)"),
+    ]
+    for src, n in sorted((cov["attribution"]["by_source"]).items(),
+                         key=lambda kv: -kv[1]):
+        rows.append((f"novel via {src}", f"{n}"))
+    body = "".join(f"<tr><td>{html.escape(k)}</td>"
+                   f"<td>{html.escape(str(v))}</td></tr>"
+                   for k, v in rows)
+    return (f"<h3>Coverage intelligence</h3><table>{body}</table>"
+            f"<p><a href='/api/coverage'>coverage.json</a></p>")
+
+
 def _call_name(prog_line: str) -> str:
     """First call name of a serialized program line ('r0 = open(...)'
     or 'open(...)')."""
@@ -172,7 +225,8 @@ def _summary_page(mgr) -> str:
                     f"{html.escape(title)}</a></td><td>{entry.count}</td>"
                     f"<td>{'yes' if entry.repro_done else ''}</td>"
                     f"<td><a href='/report?id={sig}'>report</a></td></tr>")
-    body = (f"<table>{rows}</table>{health}<h3>Crashes</h3>"
+    body = (f"<table>{rows}</table>{health}{_coverage_section(mgr)}"
+            f"<h3>Crashes</h3>"
             f"<table><tr><th>title</th><th>count</th><th>repro</th>"
             f"<th></th></tr>{crashes}</table>")
     return _page(f"{mgr.cfg.name} syz-manager", body)

@@ -282,6 +282,17 @@ class Manager:
             k[len("device "):]: v
             for k, v in (s.get("stats") or {}).items()
             if k.startswith("device ")}
+        # Coverage status flag (ISSUE 7): the plateau detector's
+        # verdict — local tracker OR any fleet member's polled
+        # tz_coverage_stalled gauge — so "is it still learning?" is
+        # answerable from the status page without a metrics scrape.
+        from syzkaller_tpu import telemetry
+
+        fleet = self.serv.fleet_telemetry()
+        s["coverage_stalled"] = bool(
+            telemetry.COVERAGE.stalled()
+            or (fleet.get("gauges") or {}).get(
+                "tz_coverage_stalled", 0))
         return s
 
     def start_bench(self, path: str, period_s: float = 60.0) -> None:

@@ -29,6 +29,12 @@ import numpy as np
 FOLD_BITS = 26
 PLANE_SIZE = 1 << FOLD_BITS
 
+#: Region count for the coverage heat map (ISSUE 7): the plane is
+#: bucketed into 256 contiguous regions of 2^18 buckets each, so the
+#: occupancy histogram is a 1 KB device->host transfer that localizes
+#: WHERE in edge-index space the fuzzer is finding coverage.
+COVERAGE_REGIONS = 256
+
 
 def fold_hash(edges):
     """xor-fold a 32-bit edge hash into FOLD_BITS."""
@@ -142,6 +148,32 @@ def stage_batch(edges: np.ndarray, nedges: np.ndarray,
 @jax.jit
 def plane_count(plane):
     return (plane > 0).sum()
+
+
+@jax.jit
+def coverage_stats(plane):
+    """Flush-cadence coverage analytics (ISSUE 7): the exact plane
+    occupancy popcount plus the region-bucketed occupancy histogram
+    (COVERAGE_REGIONS regions over edge-index space — the heat map).
+    One fused reduction where the data lives: the occupancy is the
+    histogram's sum, so the plane is read once.  The plane shape is
+    pinned (uint8[PLANE_SIZE]), so this compiles exactly ONCE per
+    process and is invoked per flush interval, never per batch."""
+    regions = (plane.reshape(COVERAGE_REGIONS, -1) > 0).sum(
+        axis=1, dtype=jnp.int32)
+    return regions.sum(), regions
+
+
+@jax.jit
+def plane_drift(plane, mirror):
+    """Device-vs-host-mirror drift audit: the number of buckets where
+    the device plane disagrees with the rebuild-authority mirror
+    (triage/engine host mirror).  Zero by construction after every
+    backlog application; a nonzero count means silent plane
+    corruption (a half-open ring rebuild that resurrected stale
+    device memory, a donation bug, bad HBM) and the mirror must be
+    re-uploaded.  Shapes pinned — compiles once."""
+    return (plane != mirror).sum(dtype=jnp.int32)
 
 
 def to_signal(plane_np: np.ndarray):

@@ -57,8 +57,8 @@ TRACE = TraceWriter(os.environ.get(ENV_VAR) or None)
 FLIGHT = FlightRecorder(registry=REGISTRY)
 
 
-def counter(name: str, help: str = "") -> Counter:
-    return REGISTRY.counter(name, help)
+def counter(name: str, help: str = "", labels=None) -> Counter:
+    return REGISTRY.counter(name, help, labels)
 
 
 def gauge(name: str, help: str = "", fn=None, labels=None) -> Gauge:
@@ -140,9 +140,23 @@ from syzkaller_tpu.telemetry.profiler import (  # noqa: E402
 #: (tz_device_kernel_ms_per_batch{kernel=...}).
 PROFILER = KernelProfiler()
 
+# The coverage intelligence layer (ISSUE 7): growth curve, novelty
+# EWMA, plateau detector, per-lane attribution.  Same late-import
+# shape as lineage/profiler.
+from syzkaller_tpu.telemetry.coverage import (  # noqa: E402
+    CoverageTracker,
+)
+
+#: Process-wide coverage growth/attribution tracker, fed by the
+#: novelty-verdict path and the triage engine's flush-cadence
+#: analytics (tz_coverage_*).
+COVERAGE = CoverageTracker()
+
 
 __all__ = [
+    "COVERAGE",
     "Counter",
+    "CoverageTracker",
     "DEFAULT_LATENCY_BUCKETS",
     "FLIGHT",
     "FlightRecorder",

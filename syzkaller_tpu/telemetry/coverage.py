@@ -1,0 +1,312 @@
+"""Coverage intelligence: is the fuzzer still learning?
+
+The runtime-observability spine (spans, lineage, profiler, flight
+recorder) answers "is the engine healthy"; nothing before this module
+answered the top-level question of a coverage-guided fuzzer.  The
+reference tracks coverage as scalar stats (pkg/signal lengths on the
+manager page); the fuzzing-evaluation literature (Klees et al.,
+"Evaluating Fuzz Testing", CCS'18) established coverage-GROWTH
+curves, not point totals, as the meaningful signal.  This module is
+the host-side half of that layer:
+
+  - a bounded growth-curve ring of (wallclock, plane occupancy,
+    novel-edge delta) samples, fed at flush cadence by the triage
+    engine's device reductions (ops/signal.coverage_stats) — the
+    curve /api/coverage and bench_watch render,
+  - an EWMA novelty rate (novel edges/s) — the scheduler-facing
+    scalar the ROADMAP's multi-tenant QoS lanes will consume,
+  - a plateau/stall detector: when a trailing window of
+    TZ_COVERAGE_STALL_WINDOW_S seconds carries fewer than
+    TZ_COVERAGE_STALL_EDGES novel edges, the tracker emits a
+    `coverage.stall` timeline event, a structured flight-recorder
+    incident (growth-curve tail + attribution table riding the
+    payload), and flips the `tz_coverage_stalled` gauge the manager
+    status page surfaces.  The first novel edge after a stall emits
+    `coverage.resume` and clears the flag,
+  - per-source novelty attribution: every novelty verdict carries its
+    workqueue lane (fuzzer/workqueue.py bands + the generate/mutate
+    fallback = "exploration"), counted into the labeled family
+    `tz_coverage_novel_edges_total{lane=...}` plus a per-proc
+    rollup — the demand signal the multi-tenant serving plane
+    schedules on, and the per-source diff input for federated hub
+    sync.  The label name is `lane`, not `source`: `source=` is the
+    fleet merge's provenance label (render_prometheus_snapshot), and
+    a colliding key would emit duplicate label names on /metrics.
+
+Everything here is host-side float/dict math under one small lock —
+no jits, no allocations beyond the bounded ring — and the tracker is
+fed from the novelty-verdict path (Fuzzer.check_new_signal_fn) and
+the triage engine's flush-cadence analytics, never from inside jitted
+code.  `time_fn` is injectable so the stall detector is scriptable in
+tests without sleeping through the window.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+#: The workqueue lanes novelty is attributed to (fuzzer/workqueue.py
+#: priority bands; "exploration" is the generate/mutate fallback the
+#: procs run when the queue is empty).  Fixed at import so the
+#: labeled family renders completely (all-zero series included) on
+#: the first /metrics scrape.
+SOURCES = ("triage_candidate", "candidate", "triage", "smash",
+           "exploration")
+
+DEFAULT_STALL_WINDOW_S = 300.0
+DEFAULT_STALL_EDGES = 1
+DEFAULT_INTERVAL_S = 5.0
+DEFAULT_AUDIT_S = 60.0
+DEFAULT_RING = 512
+
+#: EWMA weight per tick for the novelty rate (telemetry/profiler.py
+#: uses the same settling-vs-straggler tradeoff).
+EWMA_ALPHA = 0.2
+
+
+def _env():
+    # The envsafe SUBMODULE directly: the health package __init__
+    # imports telemetry (watchdog metrics), and telemetry constructs
+    # the COVERAGE singleton at import — going through the package
+    # here would re-enter it half-initialized.
+    from syzkaller_tpu.health.envsafe import env_float, env_int
+
+    return env_float, env_int
+
+
+class CoverageTracker:
+    """Process-wide coverage growth/attribution state; see module doc.
+
+    One tracker per process (`telemetry.COVERAGE`); tests construct
+    their own with an injected clock.  All public methods are cheap
+    and thread-safe: note_novel() runs on the novelty-verdict path
+    (rare — >99.9% of checks carry nothing new) and tick()/sample()
+    at flush cadence."""
+
+    def __init__(self, time_fn: Callable[[], float] = time.time,
+                 stall_window_s: Optional[float] = None,
+                 stall_edges: Optional[int] = None,
+                 interval_s: Optional[float] = None,
+                 ring: Optional[int] = None):
+        from syzkaller_tpu import telemetry
+
+        env_float, env_int = _env()
+        self._time = time_fn
+        self.stall_window_s = max(1.0, env_float(
+            "TZ_COVERAGE_STALL_WINDOW_S",
+            DEFAULT_STALL_WINDOW_S if stall_window_s is None
+            else stall_window_s))
+        self.stall_edges = max(1, env_int(
+            "TZ_COVERAGE_STALL_EDGES",
+            DEFAULT_STALL_EDGES if stall_edges is None else stall_edges))
+        self.interval_s = max(0.0, env_float(
+            "TZ_COVERAGE_INTERVAL_S",
+            DEFAULT_INTERVAL_S if interval_s is None else interval_s))
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(
+            16, env_int("TZ_COVERAGE_RING",
+                        DEFAULT_RING if ring is None else ring)))
+        now = self._time()
+        self._t0 = now  # tracking start: the stall window needs history
+        self._last_tick = now
+        self._last_novel_ts = now
+        self._novel_accum = 0  # novel edges since the last tick
+        self._novel_total = 0
+        self._ewma_rate = 0.0  # novel edges/s
+        self._stalled = False
+        self._stalls = 0
+        self._occupancy = 0
+        self._regions: Optional[list[int]] = None
+        self._drift = {"ts": 0.0, "buckets": 0, "audits": 0}
+        self._by_source: dict[str, int] = dict.fromkeys(SOURCES, 0)
+        self._by_proc: dict[str, int] = {}
+        self._src_counters = {
+            s: telemetry.counter(
+                "tz_coverage_novel_edges_total",
+                "novel coverage edges confirmed, by originating "
+                "workqueue lane", labels={"lane": s})
+            for s in SOURCES}
+        self._m_stalls = telemetry.counter(
+            "tz_coverage_stalls_total",
+            "coverage plateau incidents (the stall detector fired)")
+        self._m_audits = telemetry.counter(
+            "tz_coverage_audits_total",
+            "device-vs-mirror drift audits run")
+        self._g_occ = telemetry.gauge(
+            "tz_coverage_occupancy",
+            "occupied signal-plane buckets (exact device popcount at "
+            "flush cadence)")
+        self._g_rate = telemetry.gauge(
+            "tz_coverage_novelty_rate",
+            "EWMA novel coverage edges per second")
+        self._g_stalled = telemetry.gauge(
+            "tz_coverage_stalled",
+            "1 while the plateau detector holds the fuzzer stalled")
+        self._g_drift = telemetry.gauge(
+            "tz_coverage_plane_drift",
+            "plane buckets disagreeing with the host mirror at the "
+            "last drift audit (nonzero = silent corruption caught)")
+
+    # -- attribution (the novelty-verdict path) ---------------------------
+
+    def note_novel(self, source: Optional[str], nedges: int,
+                   proc=None) -> None:
+        """`nedges` novel edges confirmed for one executed program;
+        `source` is its workqueue lane (unknown/None folds into
+        "exploration" — the label set stays bounded), `proc` the
+        originating worker for the per-proc rollup."""
+        if nedges <= 0:
+            return
+        src = source if source in self._by_source else "exploration"
+        resumed = False
+        with self._lock:
+            self._novel_accum += nedges
+            self._novel_total += nedges
+            self._last_novel_ts = self._time()
+            self._by_source[src] += nedges
+            if proc is not None:
+                key = str(proc)
+                self._by_proc[key] = self._by_proc.get(key, 0) + nedges
+            if self._stalled:
+                self._stalled = False
+                resumed = True
+        self._src_counters[src].inc(nedges)
+        if resumed:
+            from syzkaller_tpu import telemetry
+
+            self._g_stalled.set(0)
+            telemetry.record_event(
+                "coverage.resume",
+                f"{nedges} novel edges via {src} after a stall")
+
+    # -- the growth curve + stall detector --------------------------------
+
+    def sample(self, occupancy: int, regions=None, drift=None) -> None:
+        """One flush-cadence analytics result (triage/engine): the
+        exact plane occupancy, optionally the region heat map and a
+        drift-audit verdict.  Appends a growth-curve point."""
+        with self._lock:
+            self._occupancy = int(occupancy)
+            if regions is not None:
+                self._regions = [int(r) for r in regions]
+            if drift is not None:
+                self._drift = {"ts": round(self._time(), 3),
+                               "buckets": int(drift),
+                               "audits": self._drift["audits"] + 1}
+        self._g_occ.set(int(occupancy))
+        if drift is not None:
+            self._m_audits.inc()
+            self._g_drift.set(int(drift))
+        self.tick(force=True)
+
+    def tick(self, force: bool = False) -> None:
+        """Advance the growth curve / stall detector.  Rate-limited to
+        interval_s unless forced; called from sample() and (cheaply)
+        from the novelty-verdict path so a fuzzer whose engine never
+        flushes still detects its own plateau."""
+        stalled_now = None
+        with self._lock:
+            now = self._time()
+            if not force and now - self._last_tick < self.interval_s:
+                return
+            delta, self._novel_accum = self._novel_accum, 0
+            dt = max(1e-9, now - self._last_tick)
+            self._last_tick = now
+            self._ring.append(
+                (round(now, 3), self._occupancy, delta))
+            rate = delta / dt
+            self._ewma_rate += EWMA_ALPHA * (rate - self._ewma_rate)
+            # Stall: the trailing window carried fewer than
+            # stall_edges novel edges — and only once the tracker has
+            # a full window of history, so startup is never a
+            # false plateau.
+            window = self.stall_window_s
+            in_window = sum(
+                d for ts, _occ, d in self._ring if ts >= now - window)
+            if not self._stalled and now - self._t0 >= window \
+                    and now - self._last_novel_ts >= window \
+                    and in_window < self.stall_edges:
+                self._stalled = True
+                self._stalls += 1
+                stalled_now = (in_window, window)
+            ewma = self._ewma_rate
+        self._g_rate.set(round(ewma, 6))
+        if stalled_now is not None:
+            self._note_stalled(*stalled_now)
+
+    def _note_stalled(self, in_window: int, window: float) -> None:
+        from syzkaller_tpu import telemetry
+
+        detail = (f"{in_window} novel edges in the last {window:.0f}s "
+                  f"(threshold {self.stall_edges})")
+        self._m_stalls.inc()
+        self._g_stalled.set(1)
+        telemetry.record_event("coverage.stall", detail)
+        telemetry.FLIGHT.dump(
+            "coverage_stalled", detail,
+            extra={"growth_curve": self.curve(64),
+                   "attribution": self.attribution()})
+
+    # -- read side ---------------------------------------------------------
+
+    def curve(self, tail: Optional[int] = None) -> list:
+        """The growth curve as [[ts, occupancy, novel_delta], ...]."""
+        with self._lock:
+            pts = list(self._ring)
+        pts = pts[-tail:] if tail else pts
+        return [[ts, occ, d] for ts, occ, d in pts]
+
+    def attribution(self) -> dict:
+        with self._lock:
+            return {
+                "by_source": {s: n for s, n in self._by_source.items()
+                              if n},
+                "by_proc": dict(self._by_proc),
+                "total_novel_edges": self._novel_total,
+            }
+
+    def stalled(self) -> bool:
+        with self._lock:
+            return self._stalled
+
+    def snapshot(self) -> dict:
+        """The /api/coverage payload: growth curve, heat regions,
+        attribution table, drift status, stall semantics."""
+        with self._lock:
+            out = {
+                "occupancy": self._occupancy,
+                "novelty_rate_ewma": round(self._ewma_rate, 6),
+                "novel_edges_total": self._novel_total,
+                "stalled": self._stalled,
+                "stalls": self._stalls,
+                "stall_window_s": self.stall_window_s,
+                "stall_edges": self.stall_edges,
+                "last_novel_age_s": round(
+                    max(0.0, self._time() - self._last_novel_ts), 3),
+                "heat_regions": list(self._regions)
+                if self._regions is not None else None,
+                "drift": dict(self._drift),
+            }
+        out["growth_curve"] = self.curve()
+        out["attribution"] = self.attribution()
+        return out
+
+    def reset(self) -> None:
+        """Back to construction state (tests); registry counters are
+        reset separately via telemetry.reset()."""
+        with self._lock:
+            now = self._time()
+            self._ring.clear()
+            self._t0 = self._last_tick = self._last_novel_ts = now
+            self._novel_accum = self._novel_total = 0
+            self._ewma_rate = 0.0
+            self._stalled = False
+            self._stalls = 0
+            self._occupancy = 0
+            self._regions = None
+            self._drift = {"ts": 0.0, "buckets": 0, "audits": 0}
+            self._by_source = dict.fromkeys(SOURCES, 0)
+            self._by_proc = {}

@@ -118,16 +118,19 @@ class FlightRecorder:
     # -- the incident payload ----------------------------------------------
 
     def snapshot(self, reason: str = "on_demand",
-                 detail: str = "") -> dict:
+                 detail: str = "", extra: Optional[dict] = None) -> dict:
         """The structured incident payload: breaker/transition
         timeline, last-N spans, queue-depth history, and the full
-        registry snapshot (the race-fixed single-acquisition read)."""
+        registry snapshot (the race-fixed single-acquisition read).
+        `extra` keys are merged into the payload — the coverage
+        plateau incident attaches its growth-curve tail and
+        attribution table this way (telemetry/coverage.py)."""
         with self._lock:
             spans = list(self._spans)
             gauges = list(self._gauges)
         reg_snap = self._registry.snapshot() if self._registry else {}
         events = reg_snap.get("events") or []
-        return {
+        payload = {
             "reason": reason,
             "detail": detail,
             "ts": round(time.time(), 3),
@@ -143,6 +146,9 @@ class FlightRecorder:
             "registry": {k: reg_snap.get(k) for k in
                          ("counters", "gauges", "histograms")},
         }
+        if extra:
+            payload.update(extra)
+        return payload
 
     # -- dumping -----------------------------------------------------------
 
@@ -155,7 +161,8 @@ class FlightRecorder:
         with self._lock:
             return self._dir is not None
 
-    def dump(self, reason: str, detail: str = "") -> Optional[str]:
+    def dump(self, reason: str, detail: str = "",
+             extra: Optional[dict] = None) -> Optional[str]:
         """Write one incident file; returns its path, or None when
         disarmed / rate-limited / the write failed.  Never raises —
         forensics must not compound the failure being recorded."""
@@ -169,7 +176,7 @@ class FlightRecorder:
                     return None
                 self._last_dump[reason] = now
                 dirpath = self._dir
-            payload = self.snapshot(reason, detail)
+            payload = self.snapshot(reason, detail, extra)
             path = os.path.join(
                 dirpath, f"tz_flight_{reason}_{os.getpid()}.json")
             tmp = path + ".tmp"

@@ -41,15 +41,27 @@ EVENT_RING_SIZE = 256
 
 class Counter:
     """Monotonic counter (float-valued: backoff-seconds accumulate
-    here too)."""
+    here too).
 
-    __slots__ = ("name", "help", "_lock", "_value")
+    `labels` attaches a fixed label set to the series (ISSUE 7: the
+    coverage plane exports one novelty family across workqueue lanes,
+    `tz_coverage_novel_edges_total{source=...}`), mirroring the
+    labeled-gauge support below: each label combination is its own
+    metric object while the family shares one TYPE/HELP line."""
 
-    def __init__(self, name: str, help: str = ""):
+    __slots__ = ("name", "help", "_lock", "_value", "labels")
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[dict] = None):
         self.name = name
         self.help = help
         self._lock = threading.Lock()
         self._value = 0.0
+        self.labels = dict(labels) if labels else None
+
+    @property
+    def full_name(self) -> str:
+        return _labeled_name(self.name, self.labels)
 
     def inc(self, v: float = 1) -> None:
         with self._lock:
@@ -63,6 +75,13 @@ class Counter:
     def _reset(self) -> None:
         with self._lock:
             self._value = 0.0
+
+
+def _labeled_name(name: str, labels: Optional[dict]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
 
 
 class Gauge:
@@ -90,11 +109,7 @@ class Gauge:
 
     @property
     def full_name(self) -> str:
-        if not self.labels:
-            return self.name
-        inner = ",".join(f'{k}="{v}"'
-                         for k, v in sorted(self.labels.items()))
-        return f"{self.name}{{{inner}}}"
+        return _labeled_name(self.name, self.labels)
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -367,9 +382,11 @@ class Registry:
             self._metrics[name] = m
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(name, Counter,
-                                   lambda: Counter(name, help))
+    def counter(self, name: str, help: str = "",
+                labels: Optional[dict] = None) -> Counter:
+        key = _labeled_name(name, labels)
+        return self._get_or_create(key, Counter,
+                                   lambda: Counter(name, help, labels))
 
     def gauge(self, name: str, help: str = "",
               fn: Optional[Callable[[], float]] = None,
@@ -416,7 +433,7 @@ class Registry:
                "events": [[round(ts, 3), n, d] for ts, n, d in events]}
         for m in metrics:
             if isinstance(m, Counter):
-                out["counters"][m.name] = m.value
+                out["counters"][m.full_name] = m.value
             elif isinstance(m, Gauge):
                 out["gauges"][m.full_name] = m.value
             elif isinstance(m, Histogram):
@@ -441,7 +458,9 @@ class Registry:
                         "gauge" if isinstance(m, Gauge) else "histogram")
                 lines.append(f"# TYPE {name} {kind}")
             if isinstance(m, Counter):
-                lines.append(f"{name} {_fmt(m.value)}")
+                lines.append(
+                    f"{_merge_label_suffix(m.full_name, '')}"
+                    f" {_fmt(m.value)}")
             elif isinstance(m, Gauge):
                 lines.append(
                     f"{_merge_label_suffix(m.full_name, '')}"

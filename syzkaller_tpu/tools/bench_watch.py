@@ -190,6 +190,30 @@ def wedge_report(snap: dict) -> list[str]:
         if demos:
             line += f", {int(demos)} demotions"
         lines.append(line)
+    # Coverage intelligence (ISSUE 7): is the fuzzer still learning?
+    # The stalled-coverage line sits next to the health layers so a
+    # wedge window and a coverage plateau are distinguishable at a
+    # glance (a wedged device stops producing; a plateaued fuzzer
+    # produces plenty and learns nothing).
+    cov_occ = gauges.get("tz_coverage_occupancy") or 0
+    cov_stalled = gauges.get("tz_coverage_stalled") or 0
+    if cov_occ or cov_stalled:
+        cov_rate = gauges.get("tz_coverage_novelty_rate") or 0
+        line = (f"coverage: {int(cov_occ)} plane buckets occupied, "
+                f"novelty {cov_rate:.3f} edges/s")
+        if cov_stalled:
+            line += " — STALLED (plateau detector latched)"
+        drift = gauges.get("tz_coverage_plane_drift") or 0
+        if drift:
+            line += f", plane drift {int(drift)} buckets"
+        lines.append(line)
+    attr = {}
+    for k, v in counters.items():
+        if k.startswith('tz_coverage_novel_edges_total{') and v:
+            attr[k.split('lane="', 1)[1].rstrip('"}')] = v
+    if attr:
+        lines.append("novel edges by lane: " + " ".join(
+            f"{s}={int(v)}" for s, v in sorted(attr.items())))
     last_wedge = gauges.get("tz_watchdog_last_wedge_ts") or 0
     if last_wedge:
         age = max(0.0, (snap.get("ts") or time.time()) - last_wedge)
@@ -299,6 +323,74 @@ def report_flight(paths: list[str] | None = None) -> None:
             log(f"  {line}")
 
 
+def coverage_report(payload: dict) -> list[str]:
+    """Render a /api/coverage payload (manager/html.py
+    `_coverage_payload`, or a bare CoverageTracker.snapshot()) into
+    diagnostic lines: trajectory tail, novelty rate, the stall
+    verdict, per-lane attribution, drift status, heat-map summary.
+    Pure function — pinned by tests with no live manager."""
+    cov = payload.get("local") or payload
+    lines: list[str] = []
+    stalled = payload.get("stalled", cov.get("stalled"))
+    verdict = "STALLED" if stalled else "learning"
+    lines.append(
+        f"coverage: {verdict} — occupancy {cov.get('occupancy', 0)}, "
+        f"novelty {cov.get('novelty_rate_ewma', 0):.3f} edges/s, "
+        f"{cov.get('novel_edges_total', 0)} novel edges total, "
+        f"last novel {cov.get('last_novel_age_s', 0):.0f}s ago")
+    if cov.get("stalls"):
+        lines.append(f"  stalls: {cov['stalls']} (window "
+                     f"{cov.get('stall_window_s', 0):.0f}s, threshold "
+                     f"{cov.get('stall_edges', 0)} edges)")
+    for ts, occ, delta in (cov.get("growth_curve") or [])[-6:]:
+        stamp = time.strftime("%H:%M:%S", time.localtime(ts))
+        lines.append(f"  {stamp} occupancy={occ}"
+                     + (f" +{delta}" if delta else ""))
+    attr = (cov.get("attribution") or {}).get("by_source") or {}
+    if attr:
+        lines.append("  by lane: " + " ".join(
+            f"{s}={n}" for s, n in
+            sorted(attr.items(), key=lambda kv: -kv[1])))
+    drift = cov.get("drift") or {}
+    if drift.get("audits"):
+        state = (f"{drift['buckets']} buckets DRIFTED"
+                 if drift.get("buckets") else "clean")
+        lines.append(f"  drift audit: {state} "
+                     f"({drift['audits']} audits)")
+    regions = cov.get("heat_regions")
+    if regions:
+        occupied = sum(1 for r in regions if r)
+        hot = max(range(len(regions)), key=lambda i: regions[i])
+        lines.append(f"  heat map: {occupied}/{len(regions)} regions "
+                     f"occupied, hottest region {hot} "
+                     f"({regions[hot]} buckets)")
+    return lines
+
+
+def report_coverage(url: str | None = None) -> None:
+    """Fetch and log the manager's /api/coverage rollup (the
+    coverage-trajectory layer of diagnose_wedge).  The manager URL
+    comes from TZ_MANAGER_HTTP; without one, the snapshot-based
+    coverage line in wedge_report already covers the local view."""
+    url = url or os.environ.get("TZ_MANAGER_HTTP", "")
+    if not url:
+        log("diagnose: no TZ_MANAGER_HTTP set — coverage trajectory "
+            "limited to the telemetry-snapshot line above")
+        return
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/api/coverage", timeout=10) as r:
+            payload = json.loads(r.read().decode())
+    except Exception as e:
+        log(f"diagnose: /api/coverage unreachable at {url}: {e}")
+        return
+    log("diagnose: coverage intelligence (/api/coverage):")
+    for line in coverage_report(payload):
+        log(f"  {line}")
+
+
 def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
     """On measurement timeout: capture WHAT hangs, not just that it hangs.
 
@@ -384,6 +476,10 @@ def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
     # form of the round-5 hand diagnosis (breaker timeline, last-N
     # spans, queue-depth history, recorded attempts).
     report_flight()
+    # Layer 7: the coverage trajectory — a wedged chip and a
+    # plateaued fuzzer look identical from the flagship number alone;
+    # the growth curve + stall verdict separates them.
+    report_coverage()
 
 
 def flagship_entries() -> int:

@@ -127,7 +127,8 @@ _M_STALE_SLOTS = telemetry.counter(
 _M_BATCH_SIZE = telemetry.gauge(
     "tz_triage_batch_size", "calls in the most recent device batch")
 _M_OCCUPANCY = telemetry.gauge(
-    "tz_triage_plane_occupancy", "occupied plane buckets (host mirror)")
+    "tz_triage_plane_occupancy",
+    "occupied plane buckets (exact popcount at flush cadence)")
 _M_FN_RATE = telemetry.gauge(
     "tz_triage_fold_false_negative_rate",
     "estimated probability a novel edge is filtered by a fold "
@@ -213,6 +214,19 @@ class TriageEngine:
         self._dispatch_seq = 0  # strict-FIFO verdict delivery order
         self._resolve_seq = 0
         note_dispatch_depth(self._dispatch_depth)
+        # Coverage intelligence cadence (ISSUE 7, telemetry/coverage):
+        # the exact occupancy popcount + region heat map run every
+        # analytics interval, the device-vs-mirror drift audit every
+        # audit interval — per flush interval, never per batch, and
+        # the kernels compile exactly once (pinned plane shape).
+        self._analytics_interval = max(0.0, env_float(
+            "TZ_COVERAGE_INTERVAL_S", 5.0))
+        self._audit_interval = max(0.0, env_float(
+            "TZ_COVERAGE_AUDIT_S", 60.0))
+        now = time.monotonic()
+        self._last_analytics = now
+        self._last_audit = now
+        self._analytics_compiled = False
         warn_unknown_tz_vars()
         # Standalone engines own their breaker and drive the full
         # closed->open->half-open->closed protocol themselves; an
@@ -277,14 +291,14 @@ class TriageEngine:
             self._merge_edges(np.asarray(elems, dtype=np.uint32), prio)
 
     def _merge_edges(self, edges: np.ndarray, prio: int) -> None:
+        # Occupancy is NOT maintained incrementally here any more
+        # (ISSUE 7 satellite): the per-merge np.unique accumulation
+        # could drift from the mirror between rebuilds (absorb_plane,
+        # double-merged diffs).  The exact popcount at flush cadence
+        # (_run_analytics_locked) is now the only occupancy source.
         with self._merge_lock:
             idx = dsig.fold_hash_np(edges)
-            newly = self._mirror[idx] == 0
             np.maximum.at(self._mirror, idx, np.uint8(prio + 1))
-            if newly.any():
-                self._occupancy += int(np.unique(idx[newly]).size)
-                _M_OCCUPANCY.set(self._occupancy)
-                _M_FN_RATE.set(self._occupancy / dsig.PLANE_SIZE)
             self._pending.append((edges, prio))
 
     def invalidate_device_plane(self) -> None:
@@ -367,11 +381,120 @@ class TriageEngine:
         arr = np.asarray(plane, dtype=np.uint8)
         with self._device_lock, self._merge_lock:
             np.maximum(self._mirror, arr, out=self._mirror)
-            self._occupancy = int(np.count_nonzero(self._mirror))
-            _M_OCCUPANCY.set(self._occupancy)
-            _M_FN_RATE.set(self._occupancy / dsig.PLANE_SIZE)
+            self._note_occupancy(int(np.count_nonzero(self._mirror)))
             self._pending.clear()
             self._plane_dev = None  # rebuilt from the merged mirror
+
+    # -- coverage analytics (ISSUE 7) --------------------------------------
+
+    def _note_occupancy(self, occ: int) -> None:
+        self._occupancy = occ
+        _M_OCCUPANCY.set(occ)
+        _M_FN_RATE.set(occ / dsig.PLANE_SIZE)
+
+    def _maybe_analytics_locked(self) -> None:
+        """Flush-cadence gate (holds _device_lock): run the analytics
+        reductions when the interval elapsed; the drift audit rides
+        along at its own (longer) cadence."""
+        now = time.monotonic()
+        if now - self._last_analytics < self._analytics_interval:
+            return
+        audit = now - self._last_audit >= self._audit_interval
+        self._run_analytics_locked(audit=audit)
+
+    def _maybe_analytics_cpu(self) -> None:
+        """Demoted-path cadence: the mirror still answers occupancy,
+        so the growth curve keeps moving while the device is down.
+        Non-blocking — skipped when a flush leader holds the lock."""
+        if time.monotonic() - self._last_analytics \
+                < self._analytics_interval:
+            return
+        if self._device_lock.acquire(blocking=False):
+            try:
+                self._maybe_analytics_locked()
+            finally:
+                self._device_lock.release()
+
+    def run_analytics(self, audit: bool = False) -> dict:
+        """Force one analytics pass (bench.py --coverage, tests);
+        returns {occupancy, regions, drift}."""
+        with self._device_lock:
+            return self._run_analytics_locked(audit=audit)
+
+    def _run_analytics_locked(self, audit: bool = False) -> dict:
+        """The coverage reductions, computed where the data lives
+        (holds _device_lock): exact occupancy popcount + region heat
+        map on the device plane (ops/signal.coverage_stats — compiled
+        once, the plane shape is pinned), and optionally the
+        device-vs-mirror drift audit.  With no device plane (demoted,
+        TZ_TRIAGE_DEVICE path) the mirror answers instead — same
+        numbers, host cost.  A detected drift invalidates the plane so
+        the next flush re-uploads the authority mirror.  Advisory:
+        a failure is logged and skipped, never fed to the breaker."""
+        self._last_analytics = time.monotonic()
+        drift = None
+        try:
+            with telemetry.span("coverage.analytics"):
+                if self._plane_dev is not None:
+                    self._ensure_plane_locked()  # backlog → plane
+                    plane = self._plane_dev
+
+                    def _fetch():
+                        # Blocking value reads INSIDE the guard: the
+                        # int()/asarray sync is where a wedged
+                        # backend would hang.
+                        o, r = dsig.coverage_stats(plane)
+                        return int(o), np.asarray(r)
+
+                    occ, regions = self.watchdog.call(
+                        _fetch, "device.coverage",
+                        compile=not self._analytics_compiled)
+                    self._analytics_compiled = True
+                    if audit:
+                        drift = self._audit_locked(plane)
+                else:
+                    folded = self._mirror.reshape(
+                        dsig.COVERAGE_REGIONS, -1)
+                    regions = np.count_nonzero(folded, axis=1)
+                    occ = int(regions.sum())
+                    if audit:
+                        drift = 0  # nothing co-resident to drift
+                        self._last_audit = time.monotonic()
+        except Exception as e:
+            log.logf(0, "coverage analytics skipped: %s", str(e)[:200])
+            return {"occupancy": self._occupancy, "regions": None,
+                    "drift": None}
+        self._note_occupancy(occ)
+        telemetry.COVERAGE.sample(occ, regions, drift)
+        return {"occupancy": occ, "regions": regions, "drift": drift}
+
+    def _audit_locked(self, plane) -> Optional[int]:
+        """Device-vs-mirror drift audit (holds _device_lock): one
+        64 MB mirror upload + xor/popcount.  Skipped while merges are
+        pending (the plane legitimately lags the mirror then).  A
+        nonzero count is silent plane corruption — e.g. a half-open
+        ring rebuild that resurrected stale device memory — so the
+        plane is dropped and rebuilt from the authority mirror."""
+        import jax.numpy as jnp
+
+        self._last_audit = time.monotonic()
+        with self._merge_lock:
+            if self._pending:
+                return None  # mirror ahead by design; not corruption
+            mirror_dev = jnp.asarray(self._mirror)
+        drift = self.watchdog.call(
+            lambda: int(dsig.plane_drift(plane, mirror_dev)),
+            "device.coverage")
+        if drift:
+            telemetry.record_event(
+                "coverage.drift",
+                f"{drift} plane buckets disagree with the mirror; "
+                "re-uploading")
+            log.logf(0, "COVERAGE DRIFT: %d plane buckets disagree "
+                        "with the host mirror (silent corruption); "
+                        "rebuilding from the mirror", drift)
+            self.invalidate_device_plane()
+        return drift
 
     # -- the check path ----------------------------------------------------
 
@@ -389,6 +512,7 @@ class TriageEngine:
         if not self._gate():
             self._note_demoted(f"circuit breaker {self.breaker.state}")
             news = self._cpu_all(fuzzer, prio_fn, infos)
+            self._maybe_analytics_cpu()
             lineage.hop(trace, "triage.verdict")
             return news
         entries: dict[int, _Entry] = {}
@@ -511,6 +635,10 @@ class TriageEngine:
         finally:
             while inflight:
                 self._resolve_chunk(inflight.popleft())
+            # Flush-cadence coverage analytics: the leader already
+            # holds the device lock and every dispatched verdict is
+            # resolved — the cheapest point to read the plane.
+            self._maybe_analytics_locked()
 
     def _dispatch_chunk(self, chunk: list[_Entry], overlapping=False):
         """Stage one padded batch into a persistent arena slot, upload

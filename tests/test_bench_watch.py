@@ -162,6 +162,71 @@ def test_wedge_report_transfer_plane_line():
                    for ln in bw.wedge_report(_wedge_snapshot()))
 
 
+def test_wedge_report_stalled_coverage_line():
+    """ISSUE 7: the coverage trajectory renders next to the health
+    layers — occupancy + novelty rate, the STALLED verdict, plane
+    drift, and the per-lane attribution breakdown."""
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    reg.gauge("tz_coverage_occupancy").set(123456)
+    reg.gauge("tz_coverage_novelty_rate").set(4.25)
+    reg.gauge("tz_coverage_stalled").set(1)
+    reg.gauge("tz_coverage_plane_drift").set(7)
+    reg.counter("tz_coverage_novel_edges_total",
+                labels={"lane": "smash"}).inc(40)
+    reg.counter("tz_coverage_novel_edges_total",
+                labels={"lane": "exploration"}).inc(9)
+    lines = bw.wedge_report(reg.snapshot())
+    line = next(ln for ln in lines if ln.startswith("coverage:"))
+    assert "123456 plane buckets occupied" in line
+    assert "novelty 4.250 edges/s" in line
+    assert "STALLED" in line
+    assert "plane drift 7 buckets" in line
+    lane = next(ln for ln in lines
+                if ln.startswith("novel edges by lane:"))
+    assert "smash=40" in lane and "exploration=9" in lane
+    # a snapshot without coverage gauges renders no line
+    assert not any(ln.startswith("coverage:")
+                   for ln in bw.wedge_report(_wedge_snapshot()))
+
+
+def test_coverage_report_renders_api_payload():
+    """ISSUE 7: the /api/coverage payload renders into diagnostic
+    lines — verdict, growth-curve tail, attribution, drift, heat map.
+    Pure function, no live manager."""
+    payload = {
+        "local": {
+            "occupancy": 5000, "novelty_rate_ewma": 1.5,
+            "novel_edges_total": 321, "stalled": True, "stalls": 2,
+            "stall_window_s": 300.0, "stall_edges": 1,
+            "last_novel_age_s": 400.0,
+            "growth_curve": [[1e9, 4000, 100], [1e9 + 5, 5000, 221]],
+            "attribution": {"by_source": {"smash": 300,
+                                          "candidate": 21},
+                            "by_proc": {"0": 321},
+                            "total_novel_edges": 321},
+            "drift": {"ts": 1e9, "buckets": 3, "audits": 5},
+            "heat_regions": [0, 10, 2, 0],
+        },
+        "fleet": {},
+        "stalled": True,
+    }
+    text = "\n".join(bw.coverage_report(payload))
+    assert "coverage: STALLED" in text
+    assert "occupancy 5000" in text
+    assert "novelty 1.500 edges/s" in text
+    assert "stalls: 2" in text
+    assert "occupancy=5000 +221" in text
+    assert "by lane: smash=300 candidate=21" in text
+    assert "3 buckets DRIFTED (5 audits)" in text
+    assert "heat map: 2/4 regions occupied" in text
+    assert "hottest region 1 (10 buckets)" in text
+    # a bare tracker snapshot (no local/fleet wrapper) renders too
+    lines = bw.coverage_report(payload["local"])
+    assert any("coverage: STALLED" in ln for ln in lines)
+
+
 def test_wedge_report_empty_snapshot():
     lines = bw.wedge_report({"ts": 0, "counters": {}, "gauges": {},
                              "histograms": {}, "events": []})

@@ -1,0 +1,320 @@
+"""Coverage intelligence plane (ISSUE 7, telemetry/coverage.py +
+ops/signal analytics kernels + triage-engine flush-cadence wiring).
+
+Host-only except the kernel bit-exactness/drift tests, which compile
+the two analytics kernels once on the CPU backend (they are
+flush-cadence reductions, never per-batch — the warm-rig guard in
+test_health_faults pins that).  Stall-detector tests script time via
+the tracker's injectable clock instead of sleeping through windows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.telemetry.coverage import SOURCES, CoverageTracker
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _Info:
+    __slots__ = ("call_index", "errno", "signal")
+
+    def __init__(self, call_index, signal, errno=0):
+        self.call_index = call_index
+        self.errno = errno
+        self.signal = signal
+
+
+def _prio_fn(_errno, _idx):
+    return 3
+
+
+def _counter_value(source: str) -> float:
+    return telemetry.counter("tz_coverage_novel_edges_total",
+                             labels={"lane": source}).value
+
+
+# -- the tracker (growth ring, EWMA, attribution) --------------------------
+
+
+def test_growth_ring_ewma_and_attribution():
+    clock = _Clock()
+    tr = CoverageTracker(time_fn=clock, stall_window_s=1e9,
+                         interval_s=1.0, ring=32)
+    base = {s: _counter_value(s) for s in SOURCES}
+    tr.note_novel("smash", 10, proc=1)
+    tr.note_novel("candidate", 4, proc=2)
+    tr.note_novel("smash", 6, proc=1)
+    tr.note_novel("definitely_not_a_lane", 3, proc=9)  # bounded labels
+    clock.advance(2.0)
+    tr.sample(500, regions=[1, 2, 0, 3])
+    snap = tr.snapshot()
+    assert snap["occupancy"] == 500
+    assert snap["novel_edges_total"] == 23
+    assert snap["novelty_rate_ewma"] > 0
+    assert snap["heat_regions"] == [1, 2, 0, 3]
+    attr = snap["attribution"]
+    assert attr["by_source"] == {"smash": 16, "candidate": 4,
+                                 "exploration": 3}
+    assert attr["by_proc"] == {"1": 16, "2": 4, "9": 3}
+    assert _counter_value("smash") - base["smash"] == 16
+    assert _counter_value("exploration") - base["exploration"] == 3
+    # curve: one point carrying the accumulated delta
+    assert snap["growth_curve"][-1][1:] == [500, 23]
+    # ring is bounded
+    for _ in range(100):
+        clock.advance(2.0)
+        tr.tick(force=True)
+    assert len(tr.curve()) == 32
+
+
+def test_tick_rate_limited_and_forced():
+    clock = _Clock()
+    tr = CoverageTracker(time_fn=clock, stall_window_s=1e9,
+                         interval_s=10.0)
+    tr.tick()
+    assert tr.curve() == []  # inside the interval: no point appended
+    clock.advance(11.0)
+    tr.tick()
+    assert len(tr.curve()) == 1
+    tr.tick(force=True)
+    assert len(tr.curve()) == 2
+
+
+# -- the plateau detector --------------------------------------------------
+
+
+def test_stall_detector_fires_incident_and_resumes(tmp_path):
+    clock = _Clock()
+    tr = CoverageTracker(time_fn=clock, stall_window_s=30.0,
+                         stall_edges=1, interval_s=1.0)
+    telemetry.FLIGHT.set_dir(str(tmp_path))
+    saved = telemetry.FLIGHT.min_interval_s
+    telemetry.FLIGHT.min_interval_s = 0.0
+    try:
+        tr.note_novel("exploration", 5, proc=0)
+        clock.advance(10.0)
+        tr.tick(force=True)
+        assert not tr.stalled()  # window not yet dry
+        # A scripted zero-novelty run: the window passes with nothing.
+        for _ in range(6):
+            clock.advance(10.0)
+            tr.tick(force=True)
+        assert tr.stalled()
+        snap = tr.snapshot()
+        assert snap["stalls"] == 1
+        # The structured incident landed in TZ_FLIGHT_DIR with the
+        # growth-curve tail and attribution table riding the payload.
+        path = os.path.join(
+            tmp_path, f"tz_flight_coverage_stalled_{os.getpid()}.json")
+        assert os.path.exists(path), "plateau incident never dumped"
+        incident = json.loads(open(path).read())
+        assert incident["reason"] == "coverage_stalled"
+        assert incident["growth_curve"], "no growth-curve tail"
+        assert incident["attribution"]["by_source"] == \
+            {"exploration": 5}
+        assert any(n == "coverage.stall"
+                   for _ts, n, _d in incident["events"])
+        # staying dry does not re-fire (one transition, one incident)
+        clock.advance(50.0)
+        tr.tick(force=True)
+        assert tr.snapshot()["stalls"] == 1
+        # the first novel edge resumes
+        tr.note_novel("smash", 2)
+        assert not tr.stalled()
+        assert any(n == "coverage.resume"
+                   for _ts, n, _d in telemetry.REGISTRY.events())
+    finally:
+        telemetry.FLIGHT.set_dir(None)
+        telemetry.FLIGHT.min_interval_s = saved
+
+
+def test_stall_needs_full_window_of_history():
+    """Startup must never read as a plateau: a fresh tracker with no
+    novelty yet stays un-stalled until a whole window has passed."""
+    clock = _Clock()
+    tr = CoverageTracker(time_fn=clock, stall_window_s=60.0,
+                         stall_edges=1, interval_s=1.0)
+    clock.advance(30.0)
+    tr.tick(force=True)
+    assert not tr.stalled()
+    clock.advance(31.0)
+    tr.tick(force=True)
+    assert tr.stalled()
+    # resume before leaving: the stalled gauge is process-shared
+    # registry state, and a latched 1 would leak into later tests
+    # (the live manager test asserts the un-stalled exposition).
+    tr.note_novel("exploration", 1)
+    assert not tr.stalled()
+
+
+# -- knobs (envsafe semantics) ---------------------------------------------
+
+
+def test_coverage_knobs_envsafe_and_registered(monkeypatch):
+    from syzkaller_tpu.health.envsafe import KNOWN_TZ_VARS
+
+    for name in ("TZ_COVERAGE_STALL_WINDOW_S",
+                 "TZ_COVERAGE_STALL_EDGES", "TZ_COVERAGE_INTERVAL_S",
+                 "TZ_COVERAGE_AUDIT_S", "TZ_COVERAGE_RING",
+                 "TZ_MANAGER_HTTP"):
+        assert name in KNOWN_TZ_VARS, name
+    monkeypatch.setenv("TZ_COVERAGE_STALL_WINDOW_S", "42.5")
+    monkeypatch.setenv("TZ_COVERAGE_STALL_EDGES", "nope")  # malformed
+    tr = CoverageTracker(time_fn=_Clock())
+    assert tr.stall_window_s == 42.5
+    assert tr.stall_edges == 1  # degraded to the default, not a crash
+
+
+# -- lane threading through the verdict path -------------------------------
+
+
+def test_verdict_path_attribution_all_lanes(test_target):
+    """check_new_signal_fn attributes confirmed novel edges to the
+    workqueue lane + proc it was handed (the threading Proc.execute
+    does), and ticks the detector on the no-news path."""
+    from syzkaller_tpu.fuzzer import Fuzzer, WorkQueue
+
+    fz = Fuzzer(test_target, wq=WorkQueue())
+    base = {s: _counter_value(s) for s in SOURCES}
+    rng = np.random.RandomState(2)
+    for i, src in enumerate(SOURCES):
+        edges = rng.randint(0, 1 << 26, size=8, dtype=np.uint32)
+        news = fz.check_new_signal_fn(_prio_fn, [_Info(0, edges)],
+                                      source=src, proc=i)
+        assert news
+        got = _counter_value(src) - base[src]
+        assert got == sum(len(d) for _ci, d in news), src
+    # replay: nothing new -> no attribution movement
+    before = _counter_value("smash")
+    assert fz.check_new_signal_fn(
+        _prio_fn, [_Info(0, edges)], source="smash") == []
+    assert _counter_value("smash") == before
+
+
+def test_proc_lane_map_covers_execution_stats():
+    """Every Stat an execution can carry maps into the bounded SOURCES
+    label set (unknown stats fold to exploration in Proc.execute)."""
+    from syzkaller_tpu.fuzzer.proc import _LANE_BY_STAT
+
+    assert set(_LANE_BY_STAT.values()) <= set(SOURCES)
+    from syzkaller_tpu.fuzzer.fuzzer import Stat
+
+    assert _LANE_BY_STAT[Stat.CANDIDATE] == "candidate"
+    assert _LANE_BY_STAT[Stat.SMASH] == "smash"
+    assert _LANE_BY_STAT[Stat.GENERATE] == "exploration"
+
+
+# -- the device analytics kernels ------------------------------------------
+
+
+def test_device_popcount_bitexact_and_heat_regions():
+    """Acceptance: the device occupancy popcount is bit-exact against
+    np.count_nonzero on the host mirror, and the region histogram is
+    the exact per-region breakdown."""
+    jnp = pytest.importorskip("jax.numpy")
+    from syzkaller_tpu.ops import signal as dsig
+
+    rng = np.random.RandomState(11)
+    mirror = np.zeros(dsig.PLANE_SIZE, dtype=np.uint8)
+    idx = rng.randint(0, dsig.PLANE_SIZE, size=200_000)
+    mirror[idx] = rng.randint(1, 5, size=idx.size).astype(np.uint8)
+    occ_dev, regions_dev = dsig.coverage_stats(jnp.asarray(mirror))
+    assert int(occ_dev) == int(np.count_nonzero(mirror))
+    regions_np = np.count_nonzero(
+        mirror.reshape(dsig.COVERAGE_REGIONS, -1), axis=1)
+    assert np.array_equal(np.asarray(regions_dev), regions_np)
+    assert int(occ_dev) == int(regions_np.sum())
+
+
+def test_plane_drift_flags_injected_corruption():
+    jnp = pytest.importorskip("jax.numpy")
+    from syzkaller_tpu.ops import signal as dsig
+
+    rng = np.random.RandomState(12)
+    mirror = np.zeros(dsig.PLANE_SIZE, dtype=np.uint8)
+    mirror[rng.randint(0, dsig.PLANE_SIZE, size=5000)] = 3
+    clean = jnp.asarray(mirror)
+    assert int(dsig.plane_drift(clean, jnp.asarray(mirror))) == 0
+    corrupt = mirror.copy()
+    flips = np.unique(rng.randint(0, dsig.PLANE_SIZE, size=257))
+    corrupt[flips] ^= 1  # silent bit damage
+    assert int(dsig.plane_drift(jnp.asarray(corrupt),
+                                jnp.asarray(mirror))) == flips.size
+
+
+# -- the triage engine's flush-cadence wiring ------------------------------
+
+
+def test_engine_analytics_exact_occupancy_and_drift(test_target):
+    """The exact-popcount satellite: occupancy is no longer tracked
+    incrementally at merge time; one analytics pass makes the gauge
+    bit-exact against the mirror (device or mirror path), and an
+    injected plane corruption is caught by the audit, which drops the
+    plane so the next flush re-uploads the authority mirror."""
+    pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from syzkaller_tpu.triage import TriageEngine
+
+    eng = TriageEngine(batch=8, max_edges=64)
+    rng = np.random.RandomState(4)
+    eng._merge_edges(
+        rng.randint(0, 1 << 32, size=4096, dtype=np.uint32), 3)
+    assert eng._occupancy == 0  # stale by design until the cadence
+    r = eng.run_analytics(audit=True)  # mirror path: no device plane
+    want = int(np.count_nonzero(eng._mirror))
+    assert r["occupancy"] == want == eng._occupancy
+    assert r["drift"] == 0
+    eng.share_plane()  # materialize; backlog applied
+    r = eng.run_analytics(audit=True)  # device path now
+    assert r["occupancy"] == want
+    assert r["drift"] == 0
+    snap = eng.snapshot()
+    assert snap["plane_occupancy"] == want
+    assert snap["fold_false_negative_rate"] == pytest.approx(
+        want / (1 << 26))
+    # Injected corruption: flip buckets the mirror does not hold.
+    events0 = sum(1 for _ts, n, _d in telemetry.REGISTRY.events()
+                  if n == "coverage.drift")
+    eng._plane_dev = eng._plane_dev.at[np.arange(7)].set(
+        jnp.uint8(9))
+    r = eng.run_analytics(audit=True)
+    assert r["drift"] == 7
+    assert eng._plane_dev is None, \
+        "detected drift must drop the plane for a mirror re-upload"
+    assert sum(1 for _ts, n, _d in telemetry.REGISTRY.events()
+               if n == "coverage.drift") == events0 + 1
+    # the rebuild restores a clean plane
+    eng.share_plane()
+    assert eng.run_analytics(audit=True)["drift"] == 0
+
+
+def test_engine_analytics_feeds_tracker(test_target):
+    pytest.importorskip("jax")
+    from syzkaller_tpu.triage import TriageEngine
+
+    eng = TriageEngine(batch=8, max_edges=64)
+    rng = np.random.RandomState(5)
+    eng._merge_edges(
+        rng.randint(0, 1 << 32, size=64, dtype=np.uint32), 2)
+    r = eng.run_analytics()
+    snap = telemetry.COVERAGE.snapshot()
+    assert snap["occupancy"] == r["occupancy"]
+    assert telemetry.REGISTRY.gauge(
+        "tz_coverage_occupancy").value == r["occupancy"]

@@ -781,6 +781,76 @@ def test_transfer_plane_zero_new_jits_on_warm_pipeline(device_rig):
         pl.triage_engine = None
 
 
+def test_coverage_analytics_zero_new_jits_on_warm_rig(device_rig):
+    """ISSUE 7 compile-count guard: the coverage analytics kernels
+    compile exactly ONCE (pinned plane shape) and the per-batch hot
+    path — dispatch/resolve chunks, merges, rebuilds — triggers zero
+    new jits with analytics armed.  Flush-cadence means flush
+    cadence: repeated analytics passes reuse the same executables."""
+    import numpy as np
+
+    from syzkaller_tpu.ops import signal as dsig
+    from syzkaller_tpu.signal import Signal
+    from syzkaller_tpu.triage import TriageEngine
+    from syzkaller_tpu.triage.engine import _Entry, _Request
+
+    _target, pl = device_rig
+    eng = TriageEngine.for_pipeline(pl, batch=8, max_edges=64)
+    rng = np.random.RandomState(21)
+
+    def run_chunk():
+        req = _Request(3)
+        entries = [
+            _Entry(rng.randint(0, 1 << dsig.FOLD_BITS, size=10,
+                               dtype=np.uint32), 3, req)
+            for _ in range(3)]
+        with eng._device_lock:
+            h = eng._dispatch_chunk(entries)
+            assert h is not None
+            eng._resolve_chunk(h)
+        assert req.done.is_set()
+
+    def merge_some():
+        eng.merge_signal(Signal(
+            {int(e): 3 for e in rng.randint(
+                0, 1 << dsig.FOLD_BITS, size=16)}))
+
+    try:
+        run_chunk()  # warm novel_any + the plane upload
+        merge_some()
+        run_chunk()  # warm the backlog scatter (merge_into)
+        eng.run_analytics(audit=True)  # both analytics kernels compile
+        assert dsig.coverage_stats._cache_size() == 1
+        assert dsig.plane_drift._cache_size() == 1
+        caches0 = (pl._step._cache_size(),
+                   dsig.novel_any._cache_size(),
+                   dsig.merge_into._cache_size(),
+                   dsig.coverage_stats._cache_size(),
+                   dsig.plane_drift._cache_size())
+        occ0 = eng._occupancy
+        for _ in range(3):
+            merge_some()
+            run_chunk()
+            eng.run_analytics(audit=True)
+        assert eng._occupancy > occ0  # the popcount tracked the merges
+        # a rebuild (invalidation) + re-analytics also re-jits nothing
+        eng.invalidate_device_plane()
+        run_chunk()
+        eng.run_analytics(audit=True)
+        caches = (pl._step._cache_size(),
+                  dsig.novel_any._cache_size(),
+                  dsig.merge_into._cache_size(),
+                  dsig.coverage_stats._cache_size(),
+                  dsig.plane_drift._cache_size())
+        assert caches == caches0, \
+            f"coverage analytics triggered new jits: {caches0} -> " \
+            f"{caches}"
+        assert dsig.coverage_stats._cache_size() == 1, \
+            "analytics kernels must compile exactly once"
+    finally:
+        pl.triage_engine = None  # the module-scoped rig lives on
+
+
 # -- lineage + flight recorder + profiler on the warm rig (ISSUE 6) -------
 
 

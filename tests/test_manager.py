@@ -351,6 +351,34 @@ def test_http_ui_endpoints(tmp_path, test_target):
             for key in ("spans", "queue_depths", "breaker_timeline",
                         "registry"):
                 assert key in flight
+            # /api/coverage (ISSUE 7): growth curve + heat regions +
+            # attribution + drift status, local and fleet, and the
+            # labeled novelty family validates through promcheck on
+            # the live /metrics exposition.
+            from syzkaller_tpu import telemetry as _telemetry
+
+            _telemetry.COVERAGE.note_novel("candidate", 3, proc=0)
+            cov = json_mod.loads(get("/api/coverage"))
+            assert "stalled" in cov and cov["stalled"] is False
+            local = cov["local"]
+            for key in ("occupancy", "novelty_rate_ewma",
+                        "growth_curve", "attribution", "drift",
+                        "heat_regions", "stalls"):
+                assert key in local
+            assert local["attribution"]["by_source"].get(
+                "candidate", 0) >= 3
+            metrics = get("/metrics")
+            assert ('tz_coverage_novel_edges_total{lane="candidate"}'
+                    in metrics)
+            assert metrics.count(
+                "# TYPE tz_coverage_novel_edges_total counter") == 1
+            assert "tz_coverage_stalled 0" in metrics
+            assert validate_exposition(metrics) == []
+            # the summary page rolls the same plane up, and the
+            # status snapshot carries the manager-level flag
+            assert "Coverage intelligence" in get("/")
+            assert json_mod.loads(
+                get("/stats"))["coverage_stalled"] is False
             corpus = get("/corpus")
             assert "/input?sig=" in corpus
             sig = corpus.split("/input?sig=")[1].split("'")[0]

@@ -96,10 +96,13 @@ def test_triage_parity_randomized(test_target, engine_fuzzer):
         "the lock-free fast path never ran"
     assert s.plane_hits > 0 and s.cpu_fallback_calls == 0
     # The mirror under-approximates max_signal exactly: every exact
-    # element is present at >= its prio, and occupancy is consistent.
+    # element is present at >= its prio, and the flush-cadence device
+    # popcount (ISSUE 7: the only occupancy source now) agrees with
+    # the mirror bit-exactly.
     mirror = eng._mirror
     for e, p in fz_dev.max_signal.m.items():
         assert mirror[int(dsig.fold_hash_np(np.uint32(e)))] >= p + 1
+    eng.run_analytics()
     assert int(np.count_nonzero(mirror)) == eng._occupancy
 
 
@@ -141,6 +144,7 @@ def test_triage_fold_false_negative_measured(test_target):
     assert len(ref.cpu_check_new_signal(
         _prio_fn, [_Info(0, collider)])) == 1
     assert fz.check_new_signal_fn(_prio_fn, [_Info(0, collider)]) == []
+    eng.run_analytics()  # occupancy/FN-rate update at flush cadence
     snap = eng.snapshot()
     assert snap["plane_misses"] >= 1
     assert 0 < snap["fold_false_negative_rate"] < 1e-3
