@@ -345,7 +345,11 @@ class Fuzzer:
         if self.conn is None:
             return
         elems, prios = item.signal.serialize()
-        self.conn.call("Manager.NewInput", {
+        # Session-tagged when the transport supports it: the manager's
+        # reply cache then makes a retried send at-most-once.  Test
+        # doubles without call_session get the plain path.
+        call = getattr(self.conn, "call_session", None) or self.conn.call
+        call("Manager.NewInput", {
             "name": getattr(self.conn, "name", "fuzzer"),
             "call_index": call_index,
             "input": {

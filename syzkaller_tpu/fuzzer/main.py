@@ -67,20 +67,11 @@ class FuzzerProcess:
         for c, reason in disabled.items():
             log.logf(1, "disabled %s: %s", c.name, reason)
 
+        self.backend = backend
+        self.poll_period_s = POLL_PERIOD_S
         connect_res = {}
         if self.conn is not None:
-            connect_res = self.conn.call("Manager.Connect",
-                                         {"name": name}) or {}
-            if connect_res.get("need_check"):
-                from syzkaller_tpu.fuzzer.host import (check_comparisons,
-                                                       check_coverage)
-
-                self.conn.call("Manager.Check", {
-                    "name": name, "kcov": check_coverage(backend),
-                    "comps": check_comparisons(backend),
-                    "fault": check_fault_injection(backend),
-                    "leak": False, "calls": self.enabled,
-                })
+            connect_res = self._connect()
 
         ct_calls = {c: True for c in self.target.syscalls
                     if c.id in set(self.enabled)}
@@ -137,6 +128,56 @@ class FuzzerProcess:
             self.procs.append(Proc(self.fuzzer, pid, env,
                                    mutator=self.mutator,
                                    device_hints=engine == "jax"))
+
+    # -- manager session ---------------------------------------------------
+
+    def _connect(self) -> dict:
+        """Manager.Connect + the capability check, arming the
+        idempotency session from the minted epoch (docs/health.md).
+        The installed on_reconnect hook makes every later
+        call_session self-healing across manager restarts."""
+        res = self.conn.call("Manager.Connect", {"name": self.name}) \
+            or {}
+        if res.get("epoch"):
+            self.conn.set_session(res["epoch"],
+                                  on_reconnect=self._resync)
+        if res.get("need_check"):
+            from syzkaller_tpu.fuzzer.host import (check_comparisons,
+                                                   check_coverage)
+
+            self.conn.call("Manager.Check", {
+                "name": self.name,
+                "kcov": check_coverage(self.backend),
+                "comps": check_comparisons(self.backend),
+                "fault": check_fault_injection(self.backend),
+                "leak": False, "calls": self.enabled,
+            })
+        return res
+
+    def _resync(self) -> None:
+        """Full re-Connect resync after ReconnectRequired: the manager
+        restarted or reaped our lease, so its reply carries the whole
+        corpus + max signal again.  Re-ingesting is idempotent — the
+        corpus dedups by program hash, signal merges are monotonic —
+        and the interrupted call is then re-issued under the fresh
+        epoch by call_session."""
+        log.logf(0, "manager session lost; reconnecting + resyncing")
+        res = self._connect()
+        for inp in res.get("corpus") or []:
+            self._add_corpus_input(inp)
+        ms = res.get("max_signal") or [[], []]
+        self.fuzzer.add_max_signal(Signal.deserialize(ms[0], ms[1]))
+        for cand in res.get("candidates") or []:
+            self._enqueue_candidate(cand)
+
+    def _device_state(self) -> str:
+        """This fuzzer's device health for the manager's admission
+        controller: the pipeline breaker's state, "closed" on the CPU
+        engine (no breaker, nothing to throttle for)."""
+        if self.mutator is None:
+            return "closed"
+        br = getattr(self.mutator.pipeline, "breaker", None)
+        return br.state if br is not None else "closed"
 
     # -- corpus/candidate intake -----------------------------------------
 
@@ -195,7 +236,9 @@ class FuzzerProcess:
         """(reference: fuzzer.go:300-382)"""
         execs_reported = 0
         while not self.stop.is_set():
-            self.stop.wait(POLL_PERIOD_S)
+            # The wait honours the manager's throttle hint: a degraded
+            # chip stretches the cadence (admission control).
+            self.stop.wait(self.poll_period_s)
             if self.stop.is_set():
                 return
             # Keep-alive print doubles as the liveness marker scanned
@@ -219,11 +262,15 @@ class FuzzerProcess:
         if need_candidates is None:
             need_candidates = self.fuzzer.wq.want_candidates()
         try:
-            res = self.conn.call("Manager.Poll", {
+            # call_session retries across connection faults (the
+            # server's reply cache makes the resend idempotent) and
+            # resyncs through _resync on a manager restart.
+            res = self.conn.call_session("Manager.Poll", {
                 "name": self.name,
                 "need_candidates": bool(need_candidates),
                 "stats": stats,
                 "max_signal": list(new_sig.serialize()),
+                "device_state": self._device_state(),
                 # Cumulative registry snapshot for the manager's
                 # cross-process histogram merge (fixed shared buckets;
                 # latest-wins per fuzzer, so unlike the drained stats
@@ -231,8 +278,10 @@ class FuzzerProcess:
                 "telemetry": _telemetry_payload(),
             }) or {}
         except Exception:
-            # The drained delta must not be lost on a transient RPC
-            # failure — put it back for the next poll.
+            # The drained delta must not be lost when even the retry
+            # path gives up — put it back for the next poll.  (A retry
+            # that succeeded via the reply cache needs no restore: the
+            # delta was applied exactly once server-side.)
             self.fuzzer.restore_poll_data(new_sig, stats)
             raise
         ms = res.get("max_signal") or [[], []]
@@ -241,6 +290,13 @@ class FuzzerProcess:
             self._add_corpus_input(inp)
         for cand in res.get("candidates") or []:
             self._enqueue_candidate(cand)
+        th = res.get("throttle") or {}
+        mult = max(1.0, float(th.get("poll_interval_mult") or 1.0))
+        period = min(POLL_PERIOD_S * mult, 120.0)
+        if period != self.poll_period_s:
+            log.logf(0, "manager throttle hint: state=%s, poll period "
+                     "%.0fs", th.get("state", "closed"), period)
+            self.poll_period_s = period
         return res
 
     def shutdown(self) -> None:

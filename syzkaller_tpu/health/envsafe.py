@@ -43,9 +43,15 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_FAULT_PLAN",
     "TZ_FLIGHT_DIR",
     "TZ_FLIGHT_RING",
+    "TZ_FUZZER_LEASE_S",
     "TZ_JAX_PLATFORM",
     "TZ_MANAGER_HTTP",
+    "TZ_MANAGER_INPUTS_CAP",
+    "TZ_MANAGER_SIGNAL_CAP",
     "TZ_PIPELINE_DISPATCH_DEPTH",
+    "TZ_RPC_BACKOFF_S",
+    "TZ_RPC_REPLY_CACHE",
+    "TZ_RPC_RETRIES",
     "TZ_TELEMETRY_SNAPSHOT",
     "TZ_TRACE_FILE",
     "TZ_TRACE_SAMPLE",

@@ -46,6 +46,8 @@ SEAMS = (
     "staging.h2d",
     "rpc.send_frame",
     "rpc.recv_frame",
+    "rpc.reply_cache",
+    "manager.lease_expire",
     "queue.put",
 )
 

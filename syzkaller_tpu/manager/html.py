@@ -213,6 +213,35 @@ def _summary_page(mgr) -> str:
                         for k, v in sorted(dev.items()))
         health = (f"<h3>Device engine health</h3>"
                   f"<table>{hrows}</table>")
+    # Control plane (ISSUE 9): session epoch, admission-control
+    # state, lease ages, candidate custody — the fleet-resilience
+    # block an operator checks after a fuzzer VM dies or the device
+    # side degrades (docs/health.md "control-plane sessions").
+    control = ""
+    cp = s.get("control_plane") or {}
+    if cp:
+        crows = [
+            ("session epoch", cp.get("epoch", "")),
+            ("admission control", cp.get("throttle", "closed")),
+            ("live fuzzers", cp.get("live_fuzzers", 0)),
+            ("reaped leases", cp.get("reaped_fuzzers", 0)),
+            ("replayed from reply cache", cp.get("reply_replays", 0)),
+            ("candidates in custody",
+             cp.get("outstanding_candidates", 0)),
+            ("lease", f"{cp.get('lease_s', 0):.0f}s"),
+        ]
+        for fname, st in sorted((cp.get("fuzzers") or {}).items()):
+            idle = st.get("idle_s")
+            crows.append((
+                f"fuzzer {fname}",
+                f"idle {idle:.0f}s, device {st.get('device_state')}, "
+                f"{st.get('inputs_queued', 0)} inputs queued, "
+                f"{st.get('candidates_held', 0)} candidates held"
+                if idle is not None else "never polled"))
+        cbody = "".join(f"<tr><td>{html.escape(str(k))}</td>"
+                        f"<td>{html.escape(str(v))}</td></tr>"
+                        for k, v in crows)
+        control = f"<h3>Control plane</h3><table>{cbody}</table>"
     crashes = ""
     with mgr._lock:
         items = sorted(mgr.crash_types.items(),
@@ -225,7 +254,8 @@ def _summary_page(mgr) -> str:
                     f"{html.escape(title)}</a></td><td>{entry.count}</td>"
                     f"<td>{'yes' if entry.repro_done else ''}</td>"
                     f"<td><a href='/report?id={sig}'>report</a></td></tr>")
-    body = (f"<table>{rows}</table>{health}{_coverage_section(mgr)}"
+    body = (f"<table>{rows}</table>{health}{control}"
+            f"{_coverage_section(mgr)}"
             f"<h3>Crashes</h3>"
             f"<table><tr><th>title</th><th>count</th><th>repro</th>"
             f"<th></th></tr>{crashes}</table>")

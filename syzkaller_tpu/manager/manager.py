@@ -293,6 +293,10 @@ class Manager:
             telemetry.COVERAGE.stalled()
             or (fleet.get("gauges") or {}).get(
                 "tz_coverage_stalled", 0))
+        # Control-plane rollup (ISSUE 9): session epoch, lease/reap
+        # counts, admission-control state, per-fuzzer custody — the
+        # status page's "is the fleet healthy" block.
+        s["control_plane"] = self.serv.control_snapshot()
         return s
 
     def start_bench(self, path: str, period_s: float = 60.0) -> None:
@@ -364,6 +368,10 @@ class Manager:
                         target=run_instance, args=(i,), daemon=True)
                     threads[i].start()
             self.update_phase()
+            # Lease maintenance: sessioned RPCs reap opportunistically,
+            # but a fleet that stops calling entirely still needs its
+            # dead leases collected (and their work requeued).
+            self.serv.reap_expired()
             self._maybe_run_repro(fuzzer_cmd_fn)
             self.stop_ev.wait(1.0)
         for t in threads:

@@ -5,24 +5,97 @@ Implements Manager.Connect/Check/NewInput/Poll over the rpc transport
 (corpus, signal, candidates, per-fuzzer queues) lives here under one
 lock; the Manager object wires in persistence and crash handling via
 callbacks so this service stays testable standalone.
+
+The fleet-resilience layer (docs/health.md "control-plane sessions"):
+
+  * Connect mints a (session-epoch, fuzzer-lease) pair.  Mutating
+    calls (Poll/NewInput) carry (name, epoch, seq, ack_seq); a
+    bounded per-fuzzer reply cache replays duplicate seqs so the
+    client may retry after a completed send without double-applying
+    stats or corpus inserts.  A stale epoch or reaped lease answers
+    ReconnectRequired, driving the fuzzer's full re-Connect resync.
+  * Leases past TZ_FUZZER_LEASE_S are reaped opportunistically on
+    every sessioned call: the dead fuzzer's undelivered inputs and
+    max-signal delta go to the survivors (receivers dedup corpus
+    inserts by program hash, so redistribution is idempotent) and its
+    unfinished candidates return to the candidate queue — replacing
+    the old blind 2x duplication in add_candidates with lease-tracked
+    reissue.
+  * Candidate custody is a three-stage ledger per fuzzer: issued
+    batches sit in `inflight` keyed by the reply seq until the
+    client's ack_seq confirms delivery, then in `owned` until the
+    drained "exec candidate" stat counts them executed.  A reply the
+    client never processed (ack_seq skipped the seq) is requeued, so
+    candidates survive lost replies, fuzzer death, and retries alike.
+  * Poll replies carry a throttle hint from the breaker-driven
+    admission controller: the worst device breaker state across the
+    fleet (each fuzzer reports its own in PollArgs.device_state, plus
+    an optional manager-local breaker) shrinks the candidate
+    allotment and stretches the poll cadence while a chip is
+    degraded.
 """
 
 from __future__ import annotations
 
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health.envsafe import env_float, env_int
+from syzkaller_tpu.health.faultinject import FaultInjected, fault_point
+from syzkaller_tpu.rpc.rpc import ReconnectRequired
 from syzkaller_tpu.rpc.types import RPCCandidate, RPCInput
 from syzkaller_tpu.signal import Signal
 from syzkaller_tpu.utils import log
 from syzkaller_tpu.utils.hashsig import hash_string
 
+#: Admission-control tiers (docs/health.md): breaker state → per-poll
+#: candidate allotment and poll-cadence stretch.  "open" still hands
+#: out a trickle so a recovering fleet has probe work.
+THROTTLE_QUOTA = {"closed": 100, "half_open": 25, "open": 10}
+THROTTLE_POLL_MULT = {"closed": 1.0, "half_open": 2.0, "open": 4.0}
+_STATE_LEVEL = {"closed": 0, "half_open": 1, "open": 2}
+#: Reaped-fuzzer reply caches kept around (bounded) so a slow retry
+#: of an already-applied seq replays instead of double-applying.
+_MAX_TOMBSTONES = 64
+#: The drained-stats key that acks candidate executions
+#: (fuzzer.py STAT_NAMES[Stat.CANDIDATE]).
+_CANDIDATE_STAT = "exec candidate"
+
+_M_REPLAYS = telemetry.counter(
+    "tz_manager_reply_replays_total",
+    "duplicate (epoch, seq) calls answered from the reply cache")
+_M_STALE = telemetry.counter(
+    "tz_manager_stale_sessions_total",
+    "calls answered ReconnectRequired (stale epoch or reaped lease)")
+_M_REAPED = telemetry.counter(
+    "tz_manager_leases_reaped_total",
+    "fuzzer leases reaped after TZ_FUZZER_LEASE_S without a poll")
+_M_INPUTS_DROPPED = telemetry.counter(
+    "tz_manager_inputs_dropped_total",
+    "pending per-fuzzer inputs trimmed by the queue cap (drop-oldest)")
+_M_INPUTS_REDIST = telemetry.counter(
+    "tz_manager_inputs_redistributed_total",
+    "reaped fuzzers' undelivered inputs requeued to survivors")
+_M_CAND_REISSUED = telemetry.counter(
+    "tz_manager_candidates_reissued_total",
+    "issued candidates returned to the queue (lost reply or reaped "
+    "lease)")
+_M_SIGNAL_OVERFLOWS = telemetry.counter(
+    "tz_manager_signal_overflows_total",
+    "per-fuzzer max-signal deltas that overflowed the cap and "
+    "latched a full resync")
+_G_THROTTLE = telemetry.gauge(
+    "tz_manager_throttle_state",
+    "admission-control state (0 closed, 1 half_open, 2 open)")
+
 
 @dataclass
 class FuzzerState:
-    """Per-connected-fuzzer distribution queues
+    """Per-connected-fuzzer distribution queues + session/lease state
     (reference: manager.go Fuzzer bookkeeping in Connect/Poll)."""
     name: str
     new_max_signal: Signal = field(default_factory=Signal)
@@ -31,6 +104,17 @@ class FuzzerState:
     # counters/gauges/histograms with fixed shared buckets): the
     # fleet_telemetry merge is a vector add across these.
     telemetry: Optional[dict] = None
+    # Session/lease bookkeeping (sessioned fuzzers only; all zero for
+    # legacy unsessioned callers).
+    last_seen: float = 0.0  # manager clock at the last call
+    reply_cache: dict[int, dict] = field(default_factory=dict)
+    inflight: list[tuple[int, list[dict]]] = field(default_factory=list)
+    owned: list[dict] = field(default_factory=list)
+    device_state: str = "closed"
+    signal_resync: bool = False
+
+    def outstanding_candidates(self) -> int:
+        return sum(len(b) for _seq, b in self.inflight) + len(self.owned)
 
 
 class ManagerRPC:
@@ -41,7 +125,13 @@ class ManagerRPC:
                  on_new_input: Optional[Callable[[RPCInput], bool]] = None,
                  on_stats: Optional[Callable[[dict], None]] = None,
                  candidate_source: Optional[Callable[[int],
-                                                     list[dict]]] = None):
+                                                     list[dict]]] = None,
+                 lease_s: Optional[float] = None,
+                 inputs_cap: Optional[int] = None,
+                 signal_cap: Optional[int] = None,
+                 reply_cache_size: Optional[int] = None,
+                 breaker=None,
+                 clock: Callable[[], float] = time.monotonic):
         self._lock = threading.Lock()
         self.prios = prios or []
         self.enabled_calls = enabled_calls or []
@@ -57,29 +147,277 @@ class ManagerRPC:
         self.check_result: Optional[dict] = None
         self.stats_total: dict[str, int] = {}
         self.triaged_candidates = 0
+        # Session/lease plane.  The epoch is re-minted per ManagerRPC
+        # instance, so a manager restart invalidates every fuzzer's
+        # session and forces the re-Connect resync.
+        self.epoch = f"{random.getrandbits(64):016x}"
+        self.lease_s = env_float("TZ_FUZZER_LEASE_S", 60.0) \
+            if lease_s is None else lease_s
+        self.inputs_cap = env_int("TZ_MANAGER_INPUTS_CAP", 1024) \
+            if inputs_cap is None else inputs_cap
+        self.signal_cap = env_int("TZ_MANAGER_SIGNAL_CAP", 1 << 20) \
+            if signal_cap is None else signal_cap
+        self.reply_cache_size = env_int("TZ_RPC_REPLY_CACHE", 128) \
+            if reply_cache_size is None else reply_cache_size
+        self.breaker = breaker  # optional manager-local CircuitBreaker
+        self._clock = clock
+        self.reaped_total = 0
+        self.replays_total = 0
+        self._throttle_state = "closed"
+        # Reply caches of reaped fuzzers, so late retries of applied
+        # seqs still replay (name -> reply_cache), insertion-ordered.
+        self._tombstones: dict[str, dict[int, dict]] = {}
 
     # -- candidate feeding ------------------------------------------------
 
     def add_candidates(self, candidates: list[RPCCandidate]) -> None:
-        """Queue corpus programs for fuzzer-side triage; duplicated and
-        shuffled so inputs lost to a crashing VM get a second chance
-        (reference: manager.go:245-256)."""
+        """Queue corpus programs for fuzzer-side triage, shuffled for
+        distribution spread.  Queued once: inputs lost to a crashing
+        VM come back through lease-tracked reissue (reap/_settle), not
+        the reference's blind 2x duplication (manager.go:245-256)."""
         with self._lock:
-            batch = [c.to_dict() for c in candidates]
-            self.candidates.extend(batch + batch)
+            self.candidates.extend(c.to_dict() for c in candidates)
             random.shuffle(self.candidates)
 
     def candidate_backlog(self) -> int:
+        """Candidates not yet confirmed executed: the queue plus every
+        fuzzer's issued-but-unacked ledger — the phase machine must
+        not declare triage done while work is still in flight."""
         with self._lock:
-            return len(self.candidates)
+            return len(self.candidates) + sum(
+                f.outstanding_candidates() for f in self.fuzzers.values())
+
+    # -- session plumbing --------------------------------------------------
+
+    def _session_precheck(self, params: dict) -> Optional[dict]:
+        """Replay-or-admit gate for a sessioned mutating call: returns
+        the cached reply for a duplicate (epoch, seq), None to execute
+        the call, or raises ReconnectRequired (stale epoch / reaped
+        lease).  Legacy callers (no epoch in params) pass through."""
+        epoch = params.get("epoch")
+        if not epoch:
+            return None
+        name = params.get("name", "fuzzer")
+        seq = int(params.get("seq") or 0)
+        with self._lock:
+            self._reap_locked()
+            if epoch != self.epoch:
+                _M_STALE.inc()
+                raise ReconnectRequired(
+                    f"session epoch {epoch} is stale (manager epoch "
+                    f"{self.epoch}); re-Connect")
+            f = self.fuzzers.get(name)
+            if f is None:
+                cache = self._tombstones.get(name)
+                if cache is not None and seq in cache:
+                    _M_REPLAYS.inc()
+                    self.replays_total += 1
+                    return cache[seq]
+                _M_STALE.inc()
+                raise ReconnectRequired(
+                    f"lease for {name!r} expired; re-Connect")
+            f.last_seen = self._clock()
+            if seq in f.reply_cache:
+                _M_REPLAYS.inc()
+                self.replays_total += 1
+                return f.reply_cache[seq]
+        return None
+
+    def _session_commit(self, params: dict, reply: dict) -> dict:
+        """Cache the reply under the call's seq so a retry replays it.
+        The rpc.reply_cache seam sits AFTER the store: a scripted
+        fault models the server dying post-apply/pre-reply — the
+        recovery the retry+replay path exists for."""
+        seq = int(params.get("seq") or 0)
+        if not params.get("epoch") or not seq:
+            return reply
+        name = params.get("name", "fuzzer")
+        with self._lock:
+            f = self.fuzzers.get(name)
+            if f is not None:
+                f.reply_cache[seq] = reply
+                while len(f.reply_cache) > self.reply_cache_size:
+                    del f.reply_cache[min(f.reply_cache)]
+        fault_point("rpc.reply_cache")
+        return reply
+
+    def _reap_locked(self) -> None:
+        """Reap leases idle past lease_s; requeue their work (caller
+        holds self._lock)."""
+        now = self._clock()
+        expired = [f for f in self.fuzzers.values()
+                   if f.last_seen and now - f.last_seen > self.lease_s]
+        for f in expired:
+            try:
+                # Seam: a scripted fault defers THIS fuzzer's reap to
+                # the next pass — the lease plane must tolerate its
+                # own maintenance failing mid-stride.
+                fault_point("manager.lease_expire")
+            except FaultInjected:
+                continue
+            del self.fuzzers[f.name]
+            self.reaped_total += 1
+            _M_REAPED.inc()
+            self._tombstones[f.name] = f.reply_cache
+            while len(self._tombstones) > _MAX_TOMBSTONES:
+                del self._tombstones[next(iter(self._tombstones))]
+            held = f.outstanding_candidates()
+            self._requeue_candidates_locked(f)
+            # Undelivered inputs + max-signal delta go to survivors:
+            # corpus inserts dedup by program hash fuzzer-side, so
+            # handing every survivor the full backlog is idempotent.
+            survivors = list(self.fuzzers.values())
+            if survivors and f.inputs:
+                _M_INPUTS_REDIST.inc(len(f.inputs))
+                for other in survivors:
+                    for inp in f.inputs:
+                        self._queue_input_locked(other, inp)
+            if survivors and not f.new_max_signal.empty():
+                for other in survivors:
+                    self._queue_signal_locked(other, f.new_max_signal)
+            telemetry.record_event(
+                "manager.lease_expire",
+                f"{f.name} idle {now - f.last_seen:.0f}s; requeued "
+                f"{held} candidates, {len(f.inputs)} inputs")
+            log.logf(0, "reaped fuzzer lease %s (idle %.0fs)",
+                     f.name, now - f.last_seen)
+
+    def _requeue_candidates_locked(self, f: FuzzerState) -> None:
+        """Return every candidate in a fuzzer's custody (undelivered
+        and delivered-but-unexecuted) to the candidate queue."""
+        returned = 0
+        for _seq, batch in f.inflight:
+            self.candidates.extend(batch)
+            returned += len(batch)
+        self.candidates.extend(f.owned)
+        returned += len(f.owned)
+        f.inflight = []
+        f.owned = []
+        if returned:
+            _M_CAND_REISSUED.inc(returned)
+
+    def _settle_candidates_locked(self, f: FuzzerState, seq: int,
+                                  ack_seq: int, executed: int) -> None:
+        """Advance the candidate custody ledger on a sessioned poll:
+        batches the client confirmed receiving (reply seq <= ack_seq)
+        become owned; batches whose reply the client abandoned
+        (seq < current, never acked) are requeued; `executed`
+        executions retire owned candidates FIFO."""
+        keep: list[tuple[int, list[dict]]] = []
+        requeued = 0
+        for bseq, batch in f.inflight:
+            if bseq <= ack_seq:
+                f.owned.extend(batch)
+            elif bseq < seq:
+                # The client moved past this reply without processing
+                # it (retries exhausted, reply lost): the candidates
+                # never arrived — put them back for anyone.
+                self.candidates.extend(batch)
+                requeued += len(batch)
+            else:
+                keep.append((bseq, batch))
+        f.inflight = keep
+        if requeued:
+            _M_CAND_REISSUED.inc(requeued)
+        if executed:
+            del f.owned[:min(executed, len(f.owned))]
+
+    def _queue_input_locked(self, f: FuzzerState, inp: dict) -> None:
+        """Append a pending input under the drop-oldest cap: one
+        never-polling fuzzer must not grow manager memory unboundedly."""
+        f.inputs.append(inp)
+        if len(f.inputs) > self.inputs_cap:
+            drop = len(f.inputs) - self.inputs_cap
+            del f.inputs[:drop]
+            _M_INPUTS_DROPPED.inc(drop)
+
+    def _queue_signal_locked(self, f: FuzzerState, sig: Signal) -> None:
+        """Merge into the fuzzer's pending max-signal delta under the
+        cap; overflow clears the delta and latches a full resync —
+        the next poll serves the complete max_signal (a superset of
+        whatever was dropped), so correctness is preserved."""
+        f.new_max_signal.merge(sig)
+        if len(f.new_max_signal) > self.signal_cap:
+            f.new_max_signal = Signal()
+            f.signal_resync = True
+            _M_SIGNAL_OVERFLOWS.inc()
+
+    def _throttle_locked(self) -> str:
+        """The admission controller's aggregate: worst breaker state
+        across live fuzzers (their reported device_state) and the
+        optional manager-local breaker; transitions hit the timeline."""
+        worst = "closed"
+        if self.breaker is not None:
+            worst = self.breaker.state
+        for f in self.fuzzers.values():
+            if _STATE_LEVEL.get(f.device_state, 0) \
+                    > _STATE_LEVEL[worst]:
+                worst = f.device_state
+        if worst != self._throttle_state:
+            telemetry.record_event(
+                "manager.throttle",
+                f"{self._throttle_state} -> {worst}: candidate "
+                f"allotment {THROTTLE_QUOTA[worst]}, poll x"
+                f"{THROTTLE_POLL_MULT[worst]:g}")
+            log.logf(0, "admission control: %s -> %s",
+                     self._throttle_state, worst)
+            self._throttle_state = worst
+            _G_THROTTLE.set(_STATE_LEVEL[worst])
+        return worst
+
+    def _throttle_hint_locked(self) -> dict:
+        state = self._throttle_locked()
+        return {"state": state,
+                "max_candidates": THROTTLE_QUOTA[state],
+                "poll_interval_mult": THROTTLE_POLL_MULT[state]}
+
+    def reap_expired(self) -> None:
+        """Explicit reap pass (the Manager's periodic loop / tests);
+        sessioned calls also reap opportunistically."""
+        with self._lock:
+            self._reap_locked()
+
+    def control_snapshot(self) -> dict:
+        """Control-plane rollup for the status page / bench snapshots."""
+        with self._lock:
+            now = self._clock()
+            return {
+                "epoch": self.epoch,
+                "throttle": self._throttle_state,
+                "lease_s": self.lease_s,
+                "live_fuzzers": len(self.fuzzers),
+                "reaped_fuzzers": self.reaped_total,
+                "reply_replays": self.replays_total,
+                "outstanding_candidates": sum(
+                    f.outstanding_candidates()
+                    for f in self.fuzzers.values()),
+                "fuzzers": {
+                    name: {
+                        "idle_s": round(now - f.last_seen, 1)
+                        if f.last_seen else None,
+                        "device_state": f.device_state,
+                        "inputs_queued": len(f.inputs),
+                        "candidates_held": f.outstanding_candidates(),
+                    } for name, f in self.fuzzers.items()},
+            }
 
     # -- RPC methods ------------------------------------------------------
 
     def Connect(self, params: dict) -> dict:
-        """(reference: manager.go:862-918)"""
+        """(reference: manager.go:862-918).  Mints the session: the
+        reply carries (epoch, lease_s); a re-Connect under an existing
+        name (fuzzer restart or post-reap resync) returns the old
+        state's candidates to the queue and starts clean — the full
+        corpus in this reply supersedes any queued inputs."""
         name = params.get("name", "fuzzer")
         with self._lock:
-            self.fuzzers[name] = FuzzerState(name=name)
+            self._reap_locked()
+            old = self.fuzzers.get(name)
+            if old is not None:
+                self._requeue_candidates_locked(old)
+            self._tombstones.pop(name, None)
+            f = FuzzerState(name=name, last_seen=self._clock())
+            self.fuzzers[name] = f
             elems, prios = self.max_signal.serialize()
             return {
                 "prios": self.prios,
@@ -87,6 +425,8 @@ class ManagerRPC:
                 "corpus": [inp for inp in self.corpus.values()],
                 "max_signal": [elems, prios],
                 "need_check": self.check_result is None,
+                "epoch": self.epoch,
+                "lease_s": self.lease_s,
             }
 
     def Check(self, params: dict) -> dict:
@@ -104,6 +444,13 @@ class ManagerRPC:
         """A fuzzer triaged a new corpus input: dedup by signal diff,
         persist, broadcast to other fuzzers
         (reference: manager.go:976-1025)."""
+        cached = self._session_precheck(params)
+        if cached is not None:
+            return cached
+        reply = self._new_input(params)
+        return self._session_commit(params, reply)
+
+    def _new_input(self, params: dict) -> dict:
         name = params.get("name", "fuzzer")
         inp = RPCInput.from_dict(params.get("input") or {})
         sig = Signal.deserialize(*inp.signal)
@@ -127,8 +474,8 @@ class ManagerRPC:
             self.cover.update(int(pc) for pc in inp.cover)
             for fname, f in self.fuzzers.items():
                 if fname != name:
-                    f.inputs.append(inp.to_dict())
-                    f.new_max_signal.merge(sig)
+                    self._queue_input_locked(f, inp.to_dict())
+                    self._queue_signal_locked(f, sig)
         if self.on_new_input is not None:
             self.on_new_input(inp)
         return {"accepted": True}
@@ -136,39 +483,66 @@ class ManagerRPC:
     def Poll(self, params: dict) -> dict:
         """Periodic sync: stats up, candidates/new-inputs/max-signal
         down (reference: manager.go:1027-1081)."""
+        cached = self._session_precheck(params)
+        if cached is not None:
+            return cached
+        reply = self._poll(params)
+        return self._session_commit(params, reply)
+
+    def _poll(self, params: dict) -> dict:
         name = params.get("name", "fuzzer")
         stats = params.get("stats") or {}
         fuzzer_max = params.get("max_signal") or [[], []]
-        telemetry = params.get("telemetry")
+        telemetry_snap = params.get("telemetry")
+        seq = int(params.get("seq") or 0)
+        ack_seq = int(params.get("ack_seq") or 0)
         with self._lock:
             f = self.fuzzers.get(name)
-            if f is None:  # fuzzer restarted without Connect — re-add
-                f = FuzzerState(name=name)
+            if f is None:  # legacy fuzzer restarted without Connect
+                f = FuzzerState(name=name, last_seen=self._clock())
                 self.fuzzers[name] = f
-            if telemetry:
-                f.telemetry = telemetry
+            if telemetry_snap:
+                f.telemetry = telemetry_snap
+            f.device_state = str(params.get("device_state")
+                                 or "closed")
+            if seq:
+                self._settle_candidates_locked(
+                    f, seq, ack_seq,
+                    int(stats.get(_CANDIDATE_STAT) or 0))
             new_sig = Signal.deserialize(fuzzer_max[0], fuzzer_max[1])
             diff = self.max_signal.diff(new_sig)
             if not diff.empty():
                 self.max_signal.merge(diff)
                 for fname, other in self.fuzzers.items():
                     if fname != name:
-                        other.new_max_signal.merge(diff)
+                        self._queue_signal_locked(other, diff)
             for k, v in stats.items():
                 self.stats_total[k] = self.stats_total.get(k, 0) + int(v)
+            throttle = self._throttle_hint_locked()
             candidates = []
             if params.get("need_candidates"):
-                n = min(len(self.candidates), 100)
+                n = min(len(self.candidates),
+                        throttle["max_candidates"])
                 candidates = self.candidates[:n]
                 del self.candidates[:n]
                 self.triaged_candidates += n
-            max_out = f.new_max_signal.serialize()
-            f.new_max_signal = Signal()
+                if seq and candidates:
+                    f.inflight.append((seq, list(candidates)))
+            if f.signal_resync:
+                # The pending delta overflowed its cap at some point:
+                # serve the full max signal (a superset of everything
+                # dropped) and clear the latch.
+                max_out = self.max_signal.serialize()
+                f.signal_resync = False
+                f.new_max_signal = Signal()
+            else:
+                max_out = f.new_max_signal.serialize()
+                f.new_max_signal = Signal()
             inputs, f.inputs = f.inputs[:100], f.inputs[100:]
         if self.on_stats is not None:
             self.on_stats(stats)
         return {"candidates": candidates, "new_inputs": inputs,
-                "max_signal": list(max_out)}
+                "max_signal": list(max_out), "throttle": throttle}
 
     # -- introspection ----------------------------------------------------
 

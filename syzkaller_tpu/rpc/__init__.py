@@ -1,3 +1,4 @@
-from syzkaller_tpu.rpc.rpc import RPCClient, RPCServer, RPCError
+from syzkaller_tpu.rpc.rpc import (ReconnectRequired, RPCClient,
+                                   RPCError, RPCServer)
 
-__all__ = ["RPCClient", "RPCServer", "RPCError"]
+__all__ = ["RPCClient", "RPCServer", "RPCError", "ReconnectRequired"]

@@ -15,15 +15,19 @@ syz-fuzzer/fuzzer.go:231-238, syz-manager/manager.go:1115-1124).
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
 import threading
+import time
 import zlib
 from typing import Any, Callable, Optional
 
 from syzkaller_tpu import telemetry
-from syzkaller_tpu.health.faultinject import fault_point
+from syzkaller_tpu.health.envsafe import env_float, env_int
+from syzkaller_tpu.health.faultinject import FaultInjected, fault_point
 from syzkaller_tpu.telemetry import lineage
+from syzkaller_tpu.utils import log
 
 _FRAME = struct.Struct("<IB")  # payload length, flags
 _FLAG_ZLIB = 1
@@ -47,10 +51,49 @@ _M_BYTES_SENT = telemetry.counter(
     "tz_rpc_bytes_sent_total", "RPC wire bytes sent (incl. headers)")
 _M_BYTES_RECV = telemetry.counter(
     "tz_rpc_bytes_recv_total", "RPC wire bytes received (incl. headers)")
+# Peer-churn accounting (docs/observability.md): every server-side
+# connection ends in exactly one of dropped (peer closed between
+# frames — normal fuzzer-VM death/restart) or errored (mid-frame
+# failure, oversized/garbled frame, injected fault).
+_M_CONN_ACCEPTED = telemetry.counter(
+    "tz_rpc_conn_accepted_total", "RPC connections accepted")
+_M_CONN_DROPPED = telemetry.counter(
+    "tz_rpc_conn_dropped_total",
+    "RPC connections closed by the peer at a frame boundary")
+_M_CONN_ERRORS = telemetry.counter(
+    "tz_rpc_conn_errors_total",
+    "RPC connections torn down mid-frame or on a protocol error")
+# Session-retry accounting (client side): resends after a completed
+# send (safe only because the server's reply cache dedups by seq),
+# the cumulative backoff wait, and full re-Connect resyncs driven by
+# ReconnectRequired.
+_M_RETRIES = telemetry.counter(
+    "tz_rpc_retries_total", "session RPC resend attempts")
+_M_RETRY_WAIT = telemetry.counter(
+    "tz_rpc_retry_wait_seconds_total",
+    "time spent in session-retry backoff")
+_M_RECONNECTS = telemetry.counter(
+    "tz_rpc_reconnects_total",
+    "full session resyncs after ReconnectRequired")
 
 
 class RPCError(Exception):
     pass
+
+
+class ReconnectRequired(RPCError):
+    """Structured server verdict: the caller's session epoch is stale
+    (manager restarted) or its lease was reaped — only a full
+    re-Connect resync can make further mutating calls safe.  Carried
+    on the wire as error_kind="reconnect_required" so the client
+    raises this type instead of a generic RPCError."""
+
+
+class _PeerClosed(ConnectionError):
+    """EOF at an exact frame boundary: the peer hung up cleanly
+    between requests, as a dying fuzzer VM does — distinct from a
+    mid-frame failure so the server books it as a drop, not an
+    error."""
 
 
 def _send_frame(sock: socket.socket, obj: Any, trace=None) -> None:
@@ -74,11 +117,14 @@ def _send_frame(sock: socket.socket, obj: Any, trace=None) -> None:
     _M_BYTES_SENT.inc(_FRAME.size + len(header) + len(data))
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int,
+                at_boundary: bool = False) -> bytes:
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
+            if at_boundary and not buf:
+                raise _PeerClosed("connection closed")
             raise ConnectionError("connection closed")
         buf += chunk
     return buf
@@ -88,7 +134,7 @@ def _recv_frame(sock: socket.socket) -> Any:
     fault_point("rpc.recv_frame")
     trace_bytes = 0
     with telemetry.span("rpc.recv"):
-        hdr = _recv_exact(sock, _FRAME.size)
+        hdr = _recv_exact(sock, _FRAME.size, at_boundary=True)
         length, flags = _FRAME.unpack(hdr)
         if length > _MAX_FRAME:
             raise RPCError(f"oversized frame ({length} bytes)")
@@ -132,6 +178,8 @@ class RPCServer:
         self._services: dict[str, object] = {}
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
 
     def register(self, name: str, receiver: object) -> None:
         self._services[name] = receiver
@@ -146,19 +194,35 @@ class RPCServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                if self._stopped.is_set():
+                    conn.close()
+                    return
+                self._conns.add(conn)
             threading.Thread(target=self._handle_conn, args=(conn,),
                              daemon=True).start()
 
     def _handle_conn(self, conn: socket.socket) -> None:
         _setup_keepalive(conn)
+        _M_CONN_ACCEPTED.inc()
         try:
             with conn:
                 while True:
                     req = _recv_frame(conn)
                     resp = self._dispatch(req)
                     _send_frame(conn, resp)
-        except (ConnectionError, OSError, json.JSONDecodeError):
-            pass
+        except _PeerClosed:
+            # Clean hangup between frames: normal peer churn (a
+            # transient call finishing, a fuzzer VM restarting) —
+            # counted but not timeline-worthy.
+            _M_CONN_DROPPED.inc()
+        except (ConnectionError, OSError, json.JSONDecodeError) as e:
+            _M_CONN_ERRORS.inc()
+            telemetry.record_event(
+                "rpc.conn_drop", f"{type(e).__name__}: {e}")
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def _dispatch(self, req: dict) -> dict:
         rid = req.get("id")
@@ -172,15 +236,44 @@ class RPCServer:
                 raise RPCError(f"unknown method {method!r}")
             result = fn(req.get("params") or {})
             return {"id": rid, "result": result}
+        except FaultInjected:
+            # A scripted seam fault inside a handler models the server
+            # dying mid-call: propagate so the connection is torn down
+            # and the client sees a real ConnectionError (its retry
+            # path, not a tidy error reply, is what's under test).
+            raise
+        except ReconnectRequired as e:
+            return {"id": rid, "error": f"{type(e).__name__}: {e}",
+                    "error_kind": "reconnect_required"}
         except Exception as e:  # delivered to the caller, server lives on
             return {"id": rid, "error": f"{type(e).__name__}: {e}"}
 
     def close(self) -> None:
+        """Full shutdown: the listener AND every accepted connection —
+        a restarting manager must be able to rebind its port at once,
+        not wait for stragglers' sockets to drain.  shutdown() (not
+        just close()) on the listener is what unblocks a thread parked
+        in accept(); a blocked accept otherwise keeps the kernel
+        socket alive past close() and the port stays taken."""
         self._stopped.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 class RPCClient:
@@ -192,13 +285,48 @@ class RPCClient:
     """
 
     def __init__(self, addr: tuple[str, int], name: str = "",
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0,
+                 retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None):
         self.addr = tuple(addr)
         self.name = name
         self.timeout_s = timeout_s
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._next_id = 0
+        # Session state (docs/health.md "control-plane sessions"):
+        # minted by Manager.Connect, carried on every mutating call so
+        # the server's reply cache makes post-send retries safe.
+        self.retries = env_int("TZ_RPC_RETRIES", 3) \
+            if retries is None else retries
+        self.backoff_s = env_float("TZ_RPC_BACKOFF_S", 0.2) \
+            if backoff_s is None else backoff_s
+        self.epoch: Optional[str] = None
+        self.on_reconnect: Optional[Callable[[], None]] = None
+        self._seq = 0
+        self._acked = 0
+        self._seq_lock = threading.Lock()
+
+    def set_session(self, epoch: str,
+                    on_reconnect: Optional[Callable[[], None]] = None
+                    ) -> None:
+        """Arm (or re-arm, after a resync) the idempotent-call session:
+        `epoch` comes from the Connect reply; `on_reconnect`, when
+        set, is invoked on a ReconnectRequired verdict and must
+        re-Connect + resync before the call is re-issued."""
+        self.epoch = epoch
+        if on_reconnect is not None:
+            self.on_reconnect = on_reconnect
+
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def _mark_acked(self, seq: int) -> None:
+        with self._seq_lock:
+            if seq > self._acked:
+                self._acked = seq
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self.addr, timeout=self.timeout_s)
@@ -237,8 +365,65 @@ class RPCClient:
                     raise
                 break
             if resp.get("error"):
+                if resp.get("error_kind") == "reconnect_required":
+                    raise ReconnectRequired(resp["error"])
                 raise RPCError(resp["error"])
             return resp.get("result")
+
+    def call_session(self, method: str, params: Optional[dict] = None,
+                     trace=None) -> Any:
+        """A mutating call under the idempotency session: tags the
+        params with (name, epoch, seq, ack_seq) and retries with
+        exponential backoff + jitter across connection failures —
+        including after a completed send, which plain call() must
+        never do.  The server's per-fuzzer reply cache replays the
+        seq's reply if the first attempt did run, so at-most-once
+        mutation holds across every retry.  A ReconnectRequired
+        verdict (manager restart / reaped lease) runs the installed
+        on_reconnect resync and re-issues under the fresh epoch.
+
+        Without a session (epoch unset — standalone tools, tests
+        driving the legacy protocol) this degrades to plain call()."""
+        params = dict(params or {})
+        params.setdefault("name", self.name)
+        if self.epoch is None:
+            return self.call(method, params, trace=trace)
+        seq = self._next_seq()
+        params["seq"] = seq
+        attempts = max(1, self.retries + 1)
+        delay = max(0.001, self.backoff_s)
+        reconnects = 0
+        for attempt in range(attempts):
+            params["epoch"] = self.epoch
+            with self._seq_lock:
+                params["ack_seq"] = self._acked
+            try:
+                result = self.call(method, params, trace=trace)
+            except ReconnectRequired:
+                # Stale epoch or reaped lease: only a full resync can
+                # recover.  Bounded separately from connection retries
+                # so a crash-looping manager can't spin us forever.
+                if self.on_reconnect is None or reconnects >= 2:
+                    raise
+                reconnects += 1
+                _M_RECONNECTS.inc()
+                telemetry.record_event(
+                    "rpc.reconnect", f"{method} seq={seq}")
+                self.on_reconnect()  # re-Connect; updates self.epoch
+                continue
+            except (ConnectionError, OSError) as e:
+                if attempt == attempts - 1:
+                    raise
+                _M_RETRIES.inc()
+                wait = delay * (1.0 + random.random())
+                delay = min(delay * 2, 5.0)
+                log.logf(2, "rpc %s seq=%d failed (%s); retry in %.2fs",
+                         method, seq, e, wait)
+                _M_RETRY_WAIT.inc(wait)
+                time.sleep(wait)
+                continue
+            self._mark_acked(seq)
+            return result
 
     def call_transient(self, method: str,
                        params: Optional[dict] = None) -> Any:

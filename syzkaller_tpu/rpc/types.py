@@ -60,7 +60,12 @@ class ConnectArgs:
 
 @dataclass
 class ConnectRes:
-    """Everything a fresh fuzzer needs (reference: rpctype.go:30-40)."""
+    """Everything a fresh fuzzer needs (reference: rpctype.go:30-40).
+
+    `epoch`/`lease_s` are the session pair minted per Connect
+    (docs/health.md): the epoch namespaces the idempotency seqs and
+    detects manager restarts; the lease is how long the manager keeps
+    this fuzzer's queues alive without a poll."""
     prios: list[list[float]] = field(default_factory=list)
     corpus: list[dict] = field(default_factory=list)  # RPCInput dicts
     max_signal: tuple[list[int], list[int]] = \
@@ -68,6 +73,8 @@ class ConnectRes:
     candidates: list[dict] = field(default_factory=list)
     enabled_calls: list[int] = field(default_factory=list)
     need_check: bool = True
+    epoch: str = ""
+    lease_s: float = 0.0
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -90,10 +97,15 @@ class CheckArgs:
 
 @dataclass
 class NewInputArgs:
-    """(reference: rpctype.go:52-55)"""
+    """(reference: rpctype.go:52-55).  `epoch`/`seq`/`ack_seq` are the
+    idempotency-session tags (zero/empty on the legacy unsessioned
+    path)."""
     name: str = ""
     call_index: int = 0
     input: dict = field(default_factory=dict)  # RPCInput dict
+    epoch: str = ""
+    seq: int = 0
+    ack_seq: int = 0
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -101,12 +113,31 @@ class NewInputArgs:
 
 @dataclass
 class PollArgs:
-    """(reference: rpctype.go:57-62)"""
+    """(reference: rpctype.go:57-62).  The session tags plus
+    `device_state` — the fuzzer's worst pipeline/triage breaker state
+    ("closed"/"half_open"/"open"), the admission controller's input."""
     name: str = ""
     need_candidates: bool = False
     stats: dict[str, int] = field(default_factory=dict)
     max_signal: tuple[list[int], list[int]] = \
         field(default_factory=lambda: ([], []))
+    epoch: str = ""
+    seq: int = 0
+    ack_seq: int = 0
+    device_state: str = "closed"
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class ThrottleHint:
+    """Admission-control verdict riding every Poll reply: the fleet's
+    aggregated breaker state, the shrunk per-poll candidate allotment,
+    and the factor to stretch the poll cadence by while degraded."""
+    state: str = "closed"
+    max_candidates: int = 100
+    poll_interval_mult: float = 1.0
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -119,6 +150,7 @@ class PollRes:
     new_inputs: list[dict] = field(default_factory=list)
     max_signal: tuple[list[int], list[int]] = \
         field(default_factory=lambda: ([], []))
+    throttle: dict = field(default_factory=dict)  # ThrottleHint dict
 
     def to_dict(self) -> dict:
         return asdict(self)

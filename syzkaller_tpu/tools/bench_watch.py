@@ -207,6 +207,30 @@ def wedge_report(snap: dict) -> list[str]:
         if drift:
             line += f", plane drift {int(drift)} buckets"
         lines.append(line)
+    # Control-plane health (ISSUE 9): fleet liveness, retry/replay
+    # volume, and the admission-control state — a wedge that shows up
+    # here first (reaped leases, throttle open) is a fleet problem,
+    # not a kernel-under-test problem.
+    live = gauges.get("tz_manager_connected_fuzzers") or 0
+    reaped = counters.get("tz_manager_leases_reaped_total") or 0
+    retries = counters.get("tz_rpc_retries_total") or 0
+    replays = counters.get("tz_manager_reply_replays_total") or 0
+    throttle = gauges.get("tz_manager_throttle_state") or 0
+    if live or reaped or retries or replays or throttle:
+        state = {0: "closed", 1: "half_open", 2: "open"}.get(
+            int(throttle), "?")
+        line = (f"control plane: {int(live)} live fuzzers, "
+                f"{int(reaped)} reaped, {int(retries)} rpc retries, "
+                f"{int(replays)} replayed from cache, "
+                f"admission {state}")
+        reissued = counters.get(
+            "tz_manager_candidates_reissued_total") or 0
+        if reissued:
+            line += f", {int(reissued)} candidates reissued"
+        dropped = counters.get("tz_manager_inputs_dropped_total") or 0
+        if dropped:
+            line += f", {int(dropped)} inputs dropped"
+        lines.append(line)
     attr = {}
     for k, v in counters.items():
         if k.startswith('tz_coverage_novel_edges_total{') and v:

@@ -162,6 +162,35 @@ def test_wedge_report_transfer_plane_line():
                    for ln in bw.wedge_report(_wedge_snapshot()))
 
 
+def test_wedge_report_control_plane_line():
+    """The control-plane health line (ISSUE 9): fleet liveness,
+    retry/replay volume, and the admission state render in the wedge
+    diagnostics so a fleet problem is distinguishable from a
+    kernel-under-test problem."""
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    reg.gauge("tz_manager_connected_fuzzers").set(3)
+    reg.gauge("tz_manager_throttle_state").set(2)
+    reg.counter("tz_manager_leases_reaped_total").inc(1)
+    reg.counter("tz_rpc_retries_total").inc(7)
+    reg.counter("tz_manager_reply_replays_total").inc(4)
+    reg.counter("tz_manager_candidates_reissued_total").inc(12)
+    reg.counter("tz_manager_inputs_dropped_total").inc(2)
+    lines = bw.wedge_report(reg.snapshot())
+    line = next(ln for ln in lines if ln.startswith("control plane"))
+    assert "3 live fuzzers" in line
+    assert "1 reaped" in line
+    assert "7 rpc retries" in line
+    assert "4 replayed from cache" in line
+    assert "admission open" in line
+    assert "12 candidates reissued" in line
+    assert "2 inputs dropped" in line
+    # a snapshot without control-plane signals renders no line
+    assert not any(ln.startswith("control plane")
+                   for ln in bw.wedge_report(_wedge_snapshot()))
+
+
 def test_wedge_report_stalled_coverage_line():
     """ISSUE 7: the coverage trajectory renders next to the health
     layers — occupancy + novelty rate, the STALLED verdict, plane

@@ -1,0 +1,616 @@
+"""Fleet-resilient control plane (docs/health.md "Control-plane
+sessions, leases, and admission control"): idempotent RPC via the
+per-fuzzer reply cache, lease reaping with work conservation, and
+breaker-driven admission control — capped by a kill/reconnect-storm
+chaos test that asserts zero lost and zero double-counted work across
+scripted connection faults and a manager restart.
+
+Host-only: no jit compiles, no device; everything runs against
+ManagerRPC directly or over the real TCP transport on loopback.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health import (CircuitBreaker, FaultPlan,
+                                  install_plan, reset_plan)
+from syzkaller_tpu.manager.rpcserver import (THROTTLE_QUOTA,
+                                             ManagerRPC)
+from syzkaller_tpu.rpc import (ReconnectRequired, RPCClient, RPCError,
+                               RPCServer)
+from syzkaller_tpu.rpc.types import RPCCandidate
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    reset_plan()
+    yield
+    reset_plan()
+
+
+def _input_dict(prog_text, elems, prio=3, call="c"):
+    return {"call": call, "prog": prog_text,
+            "signal": [elems, [prio] * len(elems)], "cover": []}
+
+
+def _counters():
+    return telemetry.snapshot()["counters"]
+
+
+class _Clock:
+    """Injectable monotonic clock for lease tests.  Starts non-zero:
+    last_seen == 0.0 means "never polled" to the reaper."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- reply-cache idempotency ---------------------------------------------
+
+
+def test_reply_cache_idempotent_poll():
+    """The same (epoch, seq) Poll twice: one mutation, identical
+    replies — the retry-after-completed-send case the session layer
+    exists for."""
+    serv = ManagerRPC()
+    epoch = serv.Connect({"name": "f1"})["epoch"]
+    assert epoch == serv.epoch
+    serv.add_candidates([RPCCandidate(prog=f"p{i}()") for i in range(4)])
+    params = {"name": "f1", "epoch": epoch, "seq": 1, "ack_seq": 0,
+              "need_candidates": True, "stats": {"exec total": 5},
+              "max_signal": [[], []]}
+    r1 = serv.Poll(dict(params))
+    r2 = serv.Poll(dict(params))  # the retry
+    assert r1 == r2
+    assert len(r1["candidates"]) == 4
+    assert serv.stats_total["exec total"] == 5  # applied once
+    assert serv.replays_total == 1
+    # the replay did not double-issue: the batch sits in f1's custody
+    # once, and the queue is empty
+    assert len(serv.candidates) == 0
+    assert serv.fuzzers["f1"].outstanding_candidates() == 4
+
+
+def test_reply_cache_idempotent_new_input():
+    serv = ManagerRPC()
+    epoch = serv.Connect({"name": "f1"})["epoch"]
+    serv.Connect({"name": "f2"})
+    params = {"name": "f1", "epoch": epoch, "seq": 1, "ack_seq": 0,
+              "input": _input_dict("text1()", [1, 2, 3])}
+    r1 = serv.NewInput(dict(params))
+    r2 = serv.NewInput(dict(params))
+    assert r1 == r2 == {"accepted": True}
+    assert len(serv.corpus) == 1
+    # broadcast to f2 happened exactly once
+    assert len(serv.fuzzers["f2"].inputs) == 1
+
+
+def test_reply_cache_bounded():
+    serv = ManagerRPC(reply_cache_size=3)
+    epoch = serv.Connect({"name": "f"})["epoch"]
+    for seq in range(1, 6):
+        serv.Poll({"name": "f", "epoch": epoch, "seq": seq,
+                   "ack_seq": seq - 1, "stats": {},
+                   "max_signal": [[], []]})
+    assert sorted(serv.fuzzers["f"].reply_cache) == [3, 4, 5]
+
+
+def test_stale_epoch_answers_reconnect_required():
+    serv = ManagerRPC()
+    serv.Connect({"name": "f1"})
+    with pytest.raises(ReconnectRequired):
+        serv.Poll({"name": "f1", "epoch": "deadbeef", "seq": 1,
+                   "ack_seq": 0, "stats": {}, "max_signal": [[], []]})
+
+
+def test_legacy_unsessioned_calls_pass_through():
+    """No epoch in params → the pre-session protocol: no reply cache,
+    no custody ledger, duplicate polls double-apply (caller's
+    problem, as before)."""
+    serv = ManagerRPC()
+    serv.Poll({"name": "f", "stats": {"exec total": 1},
+               "max_signal": [[], []]})
+    serv.Poll({"name": "f", "stats": {"exec total": 1},
+               "max_signal": [[], []]})
+    assert serv.stats_total["exec total"] == 2
+    assert serv.fuzzers["f"].reply_cache == {}
+
+
+# -- candidate custody ledger --------------------------------------------
+
+
+def test_abandoned_reply_requeues_candidates():
+    """A reply the client never processed (its ack_seq skipped the
+    seq) returns the batch to the queue — candidates survive lost
+    replies instead of evaporating."""
+    serv = ManagerRPC()
+    epoch = serv.Connect({"name": "f"})["epoch"]
+    serv.add_candidates([RPCCandidate(prog=f"p{i}()") for i in range(3)])
+    r1 = serv.Poll({"name": "f", "epoch": epoch, "seq": 1, "ack_seq": 0,
+                    "need_candidates": True, "stats": {},
+                    "max_signal": [[], []]})
+    assert len(r1["candidates"]) == 3
+    # seq 2 with ack_seq still 0: the client abandoned reply 1
+    r2 = serv.Poll({"name": "f", "epoch": epoch, "seq": 2, "ack_seq": 0,
+                    "need_candidates": True, "stats": {},
+                    "max_signal": [[], []]})
+    assert sorted(c["prog"] for c in r2["candidates"]) == \
+        ["p0()", "p1()", "p2()"]
+    # delivery confirmed + executions reported retires them
+    serv.Poll({"name": "f", "epoch": epoch, "seq": 3, "ack_seq": 2,
+               "stats": {"exec candidate": 3}, "max_signal": [[], []]})
+    assert serv.candidate_backlog() == 0
+
+
+# -- lease reaping + work conservation -----------------------------------
+
+
+def test_lease_reap_redistributes_work():
+    clock = _Clock()
+    serv = ManagerRPC(lease_s=60.0, clock=clock)
+    epoch = serv.Connect({"name": "dead"})["epoch"]
+    serv.Connect({"name": "live"})
+    serv.add_candidates([RPCCandidate(prog=f"p{i}()") for i in range(6)])
+    # dead takes every candidate into its custody...
+    r = serv.Poll({"name": "dead", "epoch": epoch, "seq": 1,
+                   "ack_seq": 0, "need_candidates": True, "stats": {},
+                   "max_signal": [[], []]})
+    assert len(r["candidates"]) == 6
+    assert serv.candidate_backlog() == 6
+    # ...and an input is pending for it (broadcast from live)
+    serv.NewInput({"name": "live", "epoch": epoch, "seq": 1,
+                   "ack_seq": 0, "input": _input_dict("i0()", [9])})
+    # live stays fresh; dead goes silent past the lease
+    clock.advance(30)
+    serv.Poll({"name": "live", "epoch": epoch, "seq": 2, "ack_seq": 1,
+               "stats": {}, "max_signal": [[], []]})
+    clock.advance(31)
+    r = serv.Poll({"name": "live", "epoch": epoch, "seq": 3,
+                   "ack_seq": 2, "need_candidates": True, "stats": {},
+                   "max_signal": [[], []]})
+    # the opportunistic reap ran inside that poll: dead's candidates
+    # were requeued and handed straight to live, its pending input
+    # redistributed — nothing dropped
+    assert "dead" not in serv.fuzzers
+    assert serv.reaped_total == 1
+    assert sorted(c["prog"] for c in r["candidates"]) == \
+        sorted(f"p{i}()" for i in range(6))
+    assert [i["prog"] for i in r["new_inputs"]] == ["i0()"]
+    # a late retry of dead's applied seq replays from the tombstone
+    # instead of double-applying...
+    r_dead = serv.Poll({"name": "dead", "epoch": epoch, "seq": 1,
+                        "ack_seq": 0, "need_candidates": True,
+                        "stats": {}, "max_signal": [[], []]})
+    assert len(r_dead["candidates"]) == 6  # the cached reply, verbatim
+    # ...but NEW work from the reaped name must re-Connect
+    with pytest.raises(ReconnectRequired):
+        serv.Poll({"name": "dead", "epoch": epoch, "seq": 2,
+                   "ack_seq": 1, "stats": {}, "max_signal": [[], []]})
+    # re-Connect clears the tombstone and starts a fresh lease
+    serv.Connect({"name": "dead"})
+    assert "dead" in serv.fuzzers
+
+
+def test_reap_deferred_by_fault_seam():
+    """A scripted manager.lease_expire fault defers that fuzzer's reap
+    to the next pass — the lease plane tolerates its own maintenance
+    failing mid-stride."""
+    clock = _Clock()
+    serv = ManagerRPC(lease_s=10.0, clock=clock)
+    serv.Connect({"name": "dead"})
+    clock.advance(11)
+    install_plan(FaultPlan.parse("manager.lease_expire:fail@1"))
+    serv.reap_expired()
+    assert "dead" in serv.fuzzers  # deferred
+    serv.reap_expired()
+    assert "dead" not in serv.fuzzers  # next pass succeeds
+
+
+# -- bounded queues -------------------------------------------------------
+
+
+def test_input_queue_cap_drops_oldest():
+    before = _counters().get("tz_manager_inputs_dropped_total", 0)
+    serv = ManagerRPC(inputs_cap=5)
+    serv.Connect({"name": "a"})
+    serv.Connect({"name": "b"})
+    for i in range(8):
+        serv.NewInput({"name": "a",
+                       "input": _input_dict(f"t{i}()", [i + 1])})
+    q = serv.fuzzers["b"].inputs
+    assert [i["prog"] for i in q] == [f"t{i}()" for i in range(3, 8)]
+    assert _counters()["tz_manager_inputs_dropped_total"] - before == 3
+
+
+def test_signal_cap_overflow_serves_full_resync():
+    serv = ManagerRPC(signal_cap=4)
+    serv.Connect({"name": "a"})
+    serv.Connect({"name": "b"})
+    serv.Poll({"name": "a", "stats": {},
+               "max_signal": [list(range(1, 8)), [3] * 7]})
+    f = serv.fuzzers["b"]
+    assert f.signal_resync and f.new_max_signal.empty()
+    # the overflow cleared b's delta, but the resync latch serves the
+    # complete max signal — a superset of whatever was dropped
+    r = serv.Poll({"name": "b", "stats": {}, "max_signal": [[], []]})
+    assert sorted(r["max_signal"][0]) == list(range(1, 8))
+    r2 = serv.Poll({"name": "b", "stats": {}, "max_signal": [[], []]})
+    assert r2["max_signal"][0] == []  # latch cleared
+
+
+# -- breaker-driven admission control ------------------------------------
+
+
+def test_admission_control_shrinks_allotment():
+    serv = ManagerRPC()
+    epoch = serv.Connect({"name": "f"})["epoch"]
+    serv.add_candidates([RPCCandidate(prog=f"p{i}()")
+                         for i in range(50)])
+    r = serv.Poll({"name": "f", "epoch": epoch, "seq": 1, "ack_seq": 0,
+                   "need_candidates": True, "device_state": "open",
+                   "stats": {}, "max_signal": [[], []]})
+    assert r["throttle"]["state"] == "open"
+    assert r["throttle"]["poll_interval_mult"] > 1.0
+    # plenty queued, but the open breaker caps the allotment
+    assert len(r["candidates"]) == THROTTLE_QUOTA["open"] == 10
+    assert telemetry.snapshot()["gauges"][
+        "tz_manager_throttle_state"] == 2
+    # recovery: the device closes again → full allotment resumes
+    r2 = serv.Poll({"name": "f", "epoch": epoch, "seq": 2, "ack_seq": 1,
+                    "need_candidates": True, "device_state": "closed",
+                    "stats": {}, "max_signal": [[], []]})
+    assert r2["throttle"]["state"] == "closed"
+    assert len(r2["candidates"]) == 40
+    assert telemetry.snapshot()["gauges"][
+        "tz_manager_throttle_state"] == 0
+
+
+def test_admission_control_manager_local_breaker():
+    br = CircuitBreaker(failure_threshold=2, backoff_initial=600.0)
+    serv = ManagerRPC(breaker=br)
+    serv.Connect({"name": "f"})
+    serv.add_candidates([RPCCandidate(prog=f"p{i}()")
+                         for i in range(30)])
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "open"
+    r = serv.Poll({"name": "f", "need_candidates": True, "stats": {},
+                   "max_signal": [[], []]})
+    assert r["throttle"]["state"] == "open"
+    assert len(r["candidates"]) == 10
+
+
+def test_worst_fuzzer_state_wins():
+    serv = ManagerRPC()
+    serv.Connect({"name": "a"})
+    serv.Connect({"name": "b"})
+    serv.Poll({"name": "a", "stats": {}, "max_signal": [[], []],
+               "device_state": "half_open"})
+    r = serv.Poll({"name": "b", "stats": {}, "max_signal": [[], []],
+                   "device_state": "closed"})
+    assert r["throttle"]["state"] == "half_open"
+    assert r["throttle"]["max_candidates"] == THROTTLE_QUOTA["half_open"]
+
+
+# -- transport accounting -------------------------------------------------
+
+
+class _Echo:
+    def Ping(self, params):
+        return {"pong": params.get("n")}
+
+
+def _wait_counter(name, floor, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if _counters().get(name, 0) >= floor:
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_conn_accounting():
+    import socket
+
+    before = _counters()
+    srv = RPCServer()
+    srv.register("Echo", _Echo())
+    srv.serve_in_background()
+    cli = RPCClient(srv.addr, timeout_s=5.0)
+    try:
+        assert cli.call("Echo.Ping", {"n": 1}) == {"pong": 1}
+        cli.close()  # clean hangup at a frame boundary → a drop
+        assert _wait_counter(
+            "tz_rpc_conn_dropped_total",
+            before.get("tz_rpc_conn_dropped_total", 0) + 1)
+        # a peer dying mid-frame (partial header then EOF) → an error
+        s = socket.create_connection(srv.addr, timeout=5.0)
+        s.sendall(b"\x01\x02\x03")
+        s.close()
+        assert _wait_counter(
+            "tz_rpc_conn_errors_total",
+            before.get("tz_rpc_conn_errors_total", 0) + 1)
+        after = _counters()
+        assert after["tz_rpc_conn_accepted_total"] - \
+            before.get("tz_rpc_conn_accepted_total", 0) >= 2
+    finally:
+        cli.close()
+        srv.close()
+
+
+# -- retry + replay over the real transport ------------------------------
+
+
+def test_retry_replays_after_reply_lost():
+    """The rpc.reply_cache seam kills the connection AFTER the server
+    applied the call but BEFORE the reply went out — the exact window
+    idempotent retry exists for.  The client's resend of the same seq
+    must be answered from the cache: stats applied exactly once."""
+    serv = ManagerRPC()
+    srv = RPCServer()
+    srv.register("Manager", serv)
+    srv.serve_in_background()
+    cli = RPCClient(srv.addr, name="f1", timeout_s=5.0, retries=4,
+                    backoff_s=0.01)
+    try:
+        res = cli.call("Manager.Connect", {"name": "f1"})
+        cli.set_session(res["epoch"])
+        install_plan(FaultPlan.parse("rpc.reply_cache:fail@1"))
+        out = cli.call_session("Manager.Poll", {
+            "stats": {"exec total": 7}, "max_signal": [[], []]})
+        assert out is not None and "throttle" in out
+        assert serv.stats_total["exec total"] == 7
+        assert serv.replays_total == 1
+    finally:
+        cli.close()
+        srv.close()
+
+
+def test_manager_restart_drives_full_resync():
+    """A new ManagerRPC (new epoch) behind the same port: the client's
+    next sessioned call gets ReconnectRequired, runs the installed
+    on_reconnect resync, and re-issues under the fresh epoch."""
+    serv1 = ManagerRPC()
+    srv = RPCServer()
+    srv.register("Manager", serv1)
+    srv.serve_in_background()
+    cli = RPCClient(srv.addr, name="f1", timeout_s=5.0, retries=2,
+                    backoff_s=0.01)
+    resyncs = []
+
+    def resync():
+        res = cli.call("Manager.Connect", {"name": "f1"})
+        cli.set_session(res["epoch"])
+        resyncs.append(res["epoch"])
+
+    try:
+        res = cli.call("Manager.Connect", {"name": "f1"})
+        cli.set_session(res["epoch"], on_reconnect=resync)
+        cli.call_session("Manager.Poll", {"stats": {"exec total": 1},
+                                          "max_signal": [[], []]})
+        # "restart": swap in a fresh ManagerRPC with a new epoch
+        serv2 = ManagerRPC()
+        assert serv2.epoch != serv1.epoch
+        srv.register("Manager", serv2)
+        out = cli.call_session("Manager.Poll", {
+            "stats": {"exec total": 2}, "max_signal": [[], []]})
+        assert out is not None
+        assert resyncs == [serv2.epoch]
+        assert serv2.stats_total["exec total"] == 2  # on the new epoch
+        assert serv1.stats_total["exec total"] == 1  # not double-applied
+    finally:
+        cli.close()
+        srv.close()
+
+
+# -- the kill/reconnect storm --------------------------------------------
+
+
+class _StormClient:
+    """A miniature fuzzer poll loop with ground-truth accounting:
+    `executed` are candidate programs it received (and "ran"),
+    `confirmed_polls` / `inputs_confirmed` only count calls whose
+    reply actually came back — the conservation ledger the final
+    asserts compare the managers against."""
+
+    def __init__(self, idx, addr):
+        self.idx = idx
+        self.name = f"f{idx}"
+        self.cli = RPCClient(addr, name=self.name, timeout_s=10.0,
+                             retries=6, backoff_s=0.01)
+        self.executed: list[str] = []
+        self.pending_exec = 0  # executed, not yet reported upstream
+        self.confirmed_polls = 0
+        self.unconfirmed_polls = 0
+        self.inputs_confirmed: list[str] = []
+        self.reconnects = 0
+        self.connect()
+
+    def connect(self):
+        res = self.cli.call("Manager.Connect", {"name": self.name})
+        self.cli.set_session(res["epoch"], on_reconnect=self._resync)
+
+    def _resync(self):
+        self.reconnects += 1
+        self.connect()
+
+    def poll(self, need_candidates=True):
+        stats = {"exec total": 1, "exec candidate": self.pending_exec}
+        try:
+            res = self.cli.call_session("Manager.Poll", {
+                "need_candidates": need_candidates, "stats": stats,
+                "max_signal": [[], []]}) or {}
+        except (RPCError, ConnectionError, OSError):
+            # Retries exhausted: the fuzzer would restore the drained
+            # delta; here we just record the poll as unconfirmed.
+            self.unconfirmed_polls += 1
+            return
+        self.confirmed_polls += 1
+        self.pending_exec = 0
+        for cand in res.get("candidates") or []:
+            self.executed.append(cand["prog"])
+            self.pending_exec += 1
+
+    def new_input(self, k):
+        prog = f"inp_{self.name}_{k}()"
+        elem = 100000 + self.idx * 1000 + k
+        try:
+            res = self.cli.call_session("Manager.NewInput", {
+                "input": _input_dict(prog, [elem])}) or {}
+        except (RPCError, ConnectionError, OSError):
+            return
+        if res.get("accepted"):
+            self.inputs_confirmed.append(prog)
+
+    def storm_loop(self, polls):
+        for k in range(polls):
+            self.poll()
+            if k % 3 == 0:
+                self.new_input(k)
+            time.sleep(0.005)
+
+    def drain(self):
+        """Fault-free settle: report outstanding executions so the
+        manager's custody ledger retires them."""
+        for _ in range(5):
+            pending = self.pending_exec
+            self.poll(need_candidates=False)
+            if pending == 0 and self.pending_exec == 0:
+                return
+
+
+def _run_storm(clients, polls, fault_plan):
+    install_plan(FaultPlan.parse(fault_plan))
+    threads = [threading.Thread(target=c.storm_loop, args=(polls,),
+                                daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    reset_plan()  # quiesce: the drain/settle phase runs fault-free
+    for c in clients:
+        c.drain()
+
+
+def test_kill_reconnect_storm_conserves_work():
+    """The tentpole end-to-end: three session clients poll through a
+    storm of scripted connection kills (every ~6th frame send dies,
+    client- and server-side alike), then the manager restarts with a
+    fresh epoch behind the same port.  Conservation must hold across
+    all of it: every candidate is executed exactly once or still
+    queued, every confirmed stat delta is applied exactly once in
+    exactly one manager generation, every accepted input is in the
+    carried corpus."""
+    n_cands_p1, n_cands_p2 = 30, 15
+    seeded = [f"cand{i}()" for i in range(n_cands_p1 + n_cands_p2)]
+
+    serv1 = ManagerRPC()
+    srv1 = RPCServer()
+    srv1.register("Manager", serv1)
+    srv1.serve_in_background()
+    addr = srv1.addr
+    clients = [_StormClient(i, addr) for i in range(3)]
+
+    # Feed candidates gradually (as live triage would) so the batches
+    # spread across clients and seqs instead of one taker draining
+    # the queue, then run phase 1 of the storm.
+    def feeder(serv, progs):
+        for i in range(0, len(progs), 3):
+            serv.add_candidates(
+                [RPCCandidate(prog=p) for p in progs[i:i + 3]])
+            time.sleep(0.01)
+
+    f1 = threading.Thread(target=feeder,
+                          args=(serv1, seeded[:n_cands_p1]), daemon=True)
+    f1.start()
+    _run_storm(clients, polls=12,
+               fault_plan="rpc.send_frame:fail@"
+               + ",".join(str(i) for i in range(9, 600, 6)))
+    f1.join(timeout=10)
+
+    # Phase-1 conservation against generation 1.
+    executed_p1 = [p for c in clients for p in c.executed]
+    assert len(executed_p1) == len(set(executed_p1))  # no double-exec
+    snap1 = serv1.snapshot()
+    left_p1 = [c["prog"] for c in serv1.candidates]
+    assert serv1.candidate_backlog() == len(left_p1)  # custody settled
+    assert sorted(executed_p1 + left_p1) == sorted(seeded[:n_cands_p1])
+    confirmed_p1 = sum(c.confirmed_polls for c in clients)
+    unconfirmed_p1 = sum(c.unconfirmed_polls for c in clients)
+    assert confirmed_p1 <= snap1["stats"]["exec total"] \
+        <= confirmed_p1 + unconfirmed_p1
+    if unconfirmed_p1 == 0:  # the common, fully-confirmed run
+        assert snap1["stats"].get("exec candidate", 0) == \
+            len(executed_p1)
+
+    # Scripted manager restart: clients drop their connections, the
+    # server goes away, and a NEW ManagerRPC (fresh epoch) comes up
+    # behind the same port carrying the persisted state — corpus,
+    # corpus signal, and the unexecuted candidate queue.
+    for c in clients:
+        c.cli.close()
+    srv1.close()
+    serv2 = ManagerRPC()
+    assert serv2.epoch != serv1.epoch
+    serv2.candidates = list(serv1.candidates)
+    serv2.corpus = dict(serv1.corpus)
+    serv2.corpus_signal = serv1.corpus_signal
+    serv2.max_signal = serv1.max_signal
+    for _ in range(200):  # the kernel may need a beat to free the port
+        try:
+            srv2 = RPCServer(addr)
+            break
+        except OSError:
+            time.sleep(0.01)
+    else:
+        pytest.fail("could not rebind the manager port after restart")
+    srv2.register("Manager", serv2)
+    srv2.serve_in_background()
+
+    # Phase 2: same storm against the new generation.  Every client's
+    # first sessioned call lands with the stale epoch and must resync
+    # through ReconnectRequired → on_reconnect.
+    f2 = threading.Thread(target=feeder,
+                          args=(serv2, seeded[n_cands_p1:]), daemon=True)
+    f2.start()
+    _run_storm(clients, polls=12,
+               fault_plan="rpc.send_frame:fail@"
+               + ",".join(str(i) for i in range(9, 600, 6)))
+    f2.join(timeout=10)
+    srv2.close()
+
+    assert all(c.reconnects >= 1 for c in clients)
+
+    # Global conservation across both generations.
+    executed = [p for c in clients for p in c.executed]
+    assert len(executed) == len(set(executed))  # zero double-counted
+    left = [c["prog"] for c in serv2.candidates]
+    assert serv2.candidate_backlog() == len(left)
+    assert sorted(executed + left) == sorted(seeded)  # zero lost
+    confirmed = sum(c.confirmed_polls for c in clients)
+    unconfirmed = sum(c.unconfirmed_polls for c in clients)
+    applied = snap1["stats"]["exec total"] + \
+        serv2.stats_total.get("exec total", 0)
+    assert confirmed <= applied <= confirmed + unconfirmed
+    if unconfirmed == 0:
+        assert snap1["stats"].get("exec candidate", 0) + \
+            serv2.stats_total.get("exec candidate", 0) == len(executed)
+    # every input a client saw accepted exists in the carried corpus,
+    # exactly once (the dict is keyed by program hash)
+    corpus_progs = [i["prog"] for i in serv2.corpus.values()]
+    assert len(corpus_progs) == len(set(corpus_progs))
+    for c in clients:
+        for prog in c.inputs_confirmed:
+            assert prog in corpus_progs

@@ -53,13 +53,17 @@ def test_rpc_higher_prio_signal_accepted():
     assert r["accepted"]  # higher prio on the same edge is novel
 
 
-def test_rpc_candidates_duplicated_shuffled():
+def test_rpc_candidates_queued_once_shuffled():
+    # Queued 1x: loss recovery is lease-tracked reissue now, not the
+    # reference's blind 2x duplication.
     serv = ManagerRPC()
     serv.add_candidates([RPCCandidate(prog=f"p{i}()") for i in range(10)])
-    assert serv.candidate_backlog() == 20  # 2x duplication
+    assert serv.candidate_backlog() == 10
     res = serv.Poll({"name": "f", "need_candidates": True,
                      "stats": {}, "max_signal": [[], []]})
-    assert len(res["candidates"]) == 20
+    assert len(res["candidates"]) == 10
+    assert sorted(c["prog"] for c in res["candidates"]) == \
+        sorted(f"p{i}()" for i in range(10))
     assert serv.candidate_backlog() == 0
 
 
@@ -137,9 +141,9 @@ def test_manager_corpus_persistence(tmp_path, test_target):
     m.serv.NewInput({"name": "f",
                      "input": _input_dict(text, [5, 6], call="x")})
     m.shutdown()
-    # restart: corpus comes back as candidates (duplicated+shuffled)
+    # restart: corpus comes back as candidates (queued once)
     m2 = Manager(cfg)
-    assert m2.serv.candidate_backlog() == 2
+    assert m2.serv.candidate_backlog() == 1
     cand = m2.serv.candidates[0]
     assert cand["prog"] == text
     m2.shutdown()
