@@ -137,7 +137,13 @@ def _seed_programs(target, n, length=8, seed0=42):
 #: tunnel's remote-compile service is down (r5 failure mode:
 #: UNAVAILABLE on fresh compiles only).
 PIPE_CAPACITY = 1024
-PIPE_BATCH = 2048
+# 4096 (was 2048): the Pallas mutation core + fused plane drain
+# (ISSUE 10) moved the per-mutant device cost enough that the larger
+# batch amortizes dispatch without starving the assembly pool — the
+# DepthController ceiling and staging-arena buckets scale with it
+# (ops/pipeline, ops/staging).  TZ_PIPELINE_BATCH overrides at run
+# time without re-editing the flagship shape.
+PIPE_BATCH = 4096
 
 def bench_pipeline(batch_size=PIPE_BATCH, seconds=8.0,
                    capacity=PIPE_CAPACITY,
@@ -196,6 +202,14 @@ def bench_pipeline(batch_size=PIPE_BATCH, seconds=8.0,
             # the run (auto: wherever the DepthController settled;
             # pinned: the TZ_ASSEMBLE_DEPTH value).
             sub_out["assemble_depth_effective"] = pl._assemble_depth
+            # Mutation-core shape (ISSUE 10): which backend ran, and
+            # what fraction of emitted rows the mutant plane let
+            # through (1.0 = every row novel; lower = dedup working).
+            sub_out["mutate_backend"] = pl._backend
+            if pl.stats.fused_batches:
+                sub_out["fused_novel_frac"] = round(
+                    pl.stats.fused_novel_rows
+                    / (pl.stats.fused_batches * pl.batch_size), 4)
     finally:
         pl.stop()
         dump_telemetry()
@@ -569,6 +583,12 @@ def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
         Host-observed dispatch→ready latencies, so on an async
         backend they include queue + transfer residency; the isolated
         numbers are the pure-kernel baseline to subtract against.
+
+    Fused-path sub-metrics (ISSUE 10) ride the same dict:
+    device_kernel_mutations_per_sec (batch over the fused-step time —
+    the ROADMAP north-star rate), fused_d2h_bytes_per_batch (wire
+    bytes per drain with the mutant plane dropping non-novel rows on
+    device), and mutate_backend (pallas | vmap as resolved).
     """
     import jax
     import numpy as np
@@ -577,7 +597,6 @@ def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
     from syzkaller_tpu import telemetry
     from syzkaller_tpu.models.target import get_target
     from syzkaller_tpu.ops import signal as dsig
-    from syzkaller_tpu.ops.mutate import _mutate_one
     from syzkaller_tpu.ops.pipeline import DevicePipeline
 
     target = get_target("test", "64")
@@ -608,26 +627,35 @@ def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
             jax.block_until_ready(out)
             return 1e3 * (time.perf_counter() - t0) / steps
 
-        # The full fused step (mutate + delta pack + compact pool).
-        step_ms = timed(lambda i: pl._step(
-            corpus, n, random.fold_in(key, i), fv, fc))
+        # The full fused step: mutate + delta pack + compact pool,
+        # plus (when TZ_PIPELINE_FUSED, the default) the mutant-plane
+        # novelty mask and novel-row compaction — one dispatch.
+        if pl._fused:
+            from syzkaller_tpu.ops.signal import new_mutant_plane
 
-        # The mutation core alone, on the same sampled batch.
+            mplane = pl._mutant_plane if pl._mutant_plane is not None \
+                else new_mutant_plane(pl._plane_bits)
+            step_ms = timed(lambda i: pl._step(
+                corpus, n, random.fold_in(key, i), fv, fc, mplane))
+        else:
+            step_ms = timed(lambda i: pl._step(
+                corpus, n, random.fold_in(key, i), fv, fc))
+
+        # The mutation core alone, on the same sampled batch, through
+        # the backend the pipeline resolved (TZ_MUTATE_BACKEND):
+        # Pallas grid kernels on TPU, the vmap fallback elsewhere.
         import jax.numpy as jnp
+
+        from syzkaller_tpu.ops.mutate import make_mutator
 
         idx = (random.bits(random.key(7), (batch_size,),
                            dtype=jnp.uint32)
                % jnp.maximum(n, 1).astype(jnp.uint32)).astype(jnp.int32)
         batch = {k: v[idx] for k, v in corpus.items()}
-
-        @jax.jit
-        def mutate_only(keys):
-            return jax.vmap(
-                lambda st, k: _mutate_one(st, k, fv, fc, rounds))(
-                    batch, keys)
+        mutate_only = make_mutator(rounds, backend=pl._backend)
 
         mutate_ms = timed(lambda i: mutate_only(
-            random.split(random.fold_in(key, 1000 + i), batch_size)))
+            batch, random.fold_in(key, 1000 + i), fv, fc))
 
         # novel_any at the production triage shape.
         plane = dsig.new_plane()
@@ -641,6 +669,8 @@ def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
         novel_ms = timed(lambda i: dsig.novel_any(plane, ed, nd, pr))
     finally:
         pl.stop()
+    fused_d2h = (pl.stats.d2h_bytes / pl.stats.d2h_batches
+                 if pl._fused and pl.stats.d2h_batches else None)
     return {
         "device_kernel_ms_per_batch": {
             "mutate": round(mutate_ms, 4),
@@ -648,6 +678,17 @@ def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
             "novel_any": round(novel_ms, 4),
         },
         "fused_step_ms_per_batch": round(step_ms, 4),
+        # The ROADMAP north-star rate: mutants through the WHOLE
+        # fused device step per second (mutate + pack + compact +
+        # plane), at this profile's batch shape.
+        "device_kernel_mutations_per_sec": round(
+            batch_size / (step_ms / 1e3), 1) if step_ms else None,
+        # Wire bytes per fused drain (rows prefix + pool prefix +
+        # scalars): with the mutant plane on, non-novel rows never
+        # cross D2H, so this tracks novel yield, not batch size.
+        "fused_d2h_bytes_per_batch": (
+            round(fused_d2h, 1) if fused_d2h is not None else None),
+        "mutate_backend": pl._backend,
         "profile_batch": batch_size,
         "profile_triage_shape": [triage_batch, triage_edges],
         "always_on": telemetry.PROFILER.snapshot(),

@@ -27,6 +27,7 @@ from syzkaller_tpu.health.breaker import BreakerCounters, CircuitBreaker
 from syzkaller_tpu.health.envsafe import (
     KNOWN_TZ_VARS,
     env_auto_int,
+    env_choice,
     env_float,
     env_int,
     warn_unknown_tz_vars,
@@ -52,6 +53,7 @@ __all__ = [
     "SEAMS",
     "Watchdog",
     "env_auto_int",
+    "env_choice",
     "env_float",
     "env_int",
     "fault_point",

@@ -48,7 +48,11 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_MANAGER_HTTP",
     "TZ_MANAGER_INPUTS_CAP",
     "TZ_MANAGER_SIGNAL_CAP",
+    "TZ_MUTANT_PLANE_BITS",
+    "TZ_MUTATE_BACKEND",
+    "TZ_PIPELINE_BATCH",
     "TZ_PIPELINE_DISPATCH_DEPTH",
+    "TZ_PIPELINE_FUSED",
     "TZ_RPC_BACKOFF_S",
     "TZ_RPC_REPLY_CACHE",
     "TZ_RPC_RETRIES",
@@ -105,6 +109,23 @@ def env_auto_int(name: str, default):
         log.logf(0, "ignoring malformed %s=%r (using default %r)",
                  name, raw, default)
         return default
+
+
+def env_choice(name: str, default: str, choices) -> str:
+    """A string knob restricted to an allow-list
+    (TZ_MUTATE_BACKEND=pallas|vmap|auto): case-insensitive match
+    returns the canonical choice; anything else degrades to the
+    default (logged), same discipline as the numeric knobs."""
+    KNOWN_TZ_VARS.add(name)
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    v = raw.strip().lower()
+    if v in choices:
+        return v
+    log.logf(0, "ignoring malformed %s=%r (using default %r; "
+                "choices: %s)", name, raw, default, "|".join(choices))
+    return default
 
 
 def warn_unknown_tz_vars(environ=None) -> list[str]:

@@ -273,6 +273,23 @@ def _make_pool_assigner(spec: DeltaSpec, POOL: int):
     return assign
 
 
+def compact_rows(rows, keep):
+    """Scatter-compact the kept rows to the array front (the fused
+    mutate→emit-compact path, ISSUE 10): row i with keep[i] moves to
+    slot `cumsum(keep)[i]-1`, dropped rows are overwritten by zeros,
+    and the kept count comes back as a device scalar.  The same
+    static-shape discipline as the pool prefix sum above — the host
+    then fetches only the `pow2_rows(n_kept)` row prefix, so a batch
+    where the mutant plane drops 95% of rows ships 1/16th of the
+    bytes without any shape churn.  Returns (rows', n_kept)."""
+    import jax.numpy as jnp
+
+    tgt = jnp.where(keep, jnp.cumsum(keep.astype(jnp.int32)) - 1,
+                    rows.shape[0])
+    out = jnp.zeros_like(rows).at[tgt].set(rows, mode="drop")
+    return out, keep.astype(jnp.int32).sum()
+
+
 def pow2_rows(n: int, lo: int = 1, hi: Optional[int] = None) -> int:
     """Power-of-two row bucket covering `n`, clamped to [lo, hi].
 

@@ -431,12 +431,25 @@ def _mutate_one(state, key, flag_vals, flag_counts, rounds):
     return _fixup_lens(state)
 
 
-def make_mutator(rounds: int = 4):
+def make_mutator(rounds: int = 4, backend: str | None = None):
     """Build the jitted batched mutator.
 
     mutate_batch(batch, key, flag_vals, flag_counts) -> batch
     where batch is a dict of stacked program-tensor arrays.
-    """
+
+    `backend` selects the execution shape, not the math: "vmap" is
+    the batched-switch path below, "pallas" runs the same
+    `_mutate_one` one grid cell per program (ops/pallas_mutate —
+    real branches on TPU, interpret-mode fallback elsewhere), and
+    None resolves TZ_MUTATE_BACKEND=pallas|vmap|auto (auto = Pallas
+    only on TPU).  Both paths are bit-exact over the same key."""
+    from syzkaller_tpu.ops.pallas_mutate import (
+        make_pallas_mutator,
+        resolve_mutate_backend,
+    )
+
+    if resolve_mutate_backend(backend) == "pallas":
+        return make_pallas_mutator(rounds)
 
     @functools.partial(jax.jit, static_argnames=())
     def mutate_batch(batch: dict, key, flag_vals, flag_counts) -> dict:

@@ -1,0 +1,339 @@
+"""Pallas TPU mutation core: grid-over-batch kernels for the
+mutate -> delta-pack -> pool-compact hot loop.
+
+The vmap'd `_mutate_one` executes EVERY mutation-op branch of its
+`lax.switch` for every slot of every program in the batch — on TPU
+the whole 7-op byte engine plus the four value mutators run
+unconditionally per round, and only one result survives the select.
+Pallas changes the execution shape, not the math: the batch becomes
+the GRID (one kernel invocation per program, `BlockSpec((1, ...))`
+row blocks), so each grid cell is an unbatched trace where
+`lax.switch` lowers to a real branch — a cell that drew `op_flip`
+never touches the insert/remove/append roll pyramids at all.  The
+arithmetic inside each branch is unchanged (the kernels call the
+SAME `_mutate_one` / `make_packer` bodies ops/mutate and ops/delta
+export), so the Pallas path is bit-exact with the vmap path by
+construction: same threefry keys in, same bytes out.  That identity
+is what lets `TZ_MUTATE_BACKEND=vmap` stay a drop-in fallback and
+what tests/test_pallas_mutate.py pins over randomized keys.
+
+Three kernels:
+
+  mutate        per-cell `_mutate_one` (the `_mutate_slot` value ops
+                and the `_mutate_data_span` byte-arena engine),
+                returning the full mutated state batch — the
+                `make_mutator(backend="pallas")` path,
+  mutate+pack   the pipeline core: per-cell mutate, insert-class
+                journal masking, and the ops/delta row/payload pack
+                fused into one kernel so the packed 228-byte row is
+                produced where the state already sits in registers,
+  pool assign   the scatter-gather pool compactor as a GRID-SEQUENTIAL
+                kernel: TPU grid cells run in order, so the pool-slot
+                prefix sum is one SMEM scratch counter carried across
+                cells instead of a batch-wide cumsum + scatter.
+
+Mechanics shared by the per-row kernels: PRNG keys cross the
+pallas_call boundary as raw `key_data` words (uint32[B, 2]) and are
+re-wrapped inside the kernel — threefry is ordinary jax arithmetic,
+so the in-kernel stream is identical to the vmap path's — and the
+RNG/mutator module constants (`_INT_ARITH_P`, the interesting-int
+table, ...) are hoisted into explicit kernel inputs via
+`jax.closure_convert`, since a Pallas kernel may not capture array
+constants.  On CPU backends the kernels run in interpret mode (slow,
+grid serialized through the evaluator — correctness fallback only);
+`resolve_mutate_backend` therefore auto-selects vmap off-TPU and
+Pallas on TPU, with `TZ_MUTATE_BACKEND=pallas|vmap` as the override
+(health.envsafe discipline: a typo degrades to auto).  docs/perf.md
+"The mutation core" covers the kernel anatomy and when each backend
+engages.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from syzkaller_tpu.health.envsafe import env_choice
+
+#: Batch fields whose leading axis is the grid (everything
+#: ProgTensor.arrays() stacks); kept sorted so in_spec order is
+#: deterministic across processes.
+_STATE_KEYS = ("arena", "aux0", "aux1", "call", "call_alive",
+               "call_id", "cap", "flag_set", "kind", "len_",
+               "len_target", "ncalls", "off", "val", "width")
+#: _mutate_one adds these journals to its result state.
+_OUT_EXTRA = ("preserve_sizes", "touched")
+
+
+def resolve_mutate_backend(explicit: str | None = None) -> str:
+    """The backend the mutation core should run on: an explicit
+    argument wins, then TZ_MUTATE_BACKEND=pallas|vmap|auto, then
+    auto-detect — Pallas only where it compiles to real kernels
+    (TPU); every other backend gets the bit-exact vmap path so
+    tier-1 never pays the interpret-mode grid serialization."""
+    if explicit in ("pallas", "vmap"):
+        return explicit
+    choice = env_choice("TZ_MUTATE_BACKEND", "auto",
+                        ("auto", "pallas", "vmap"))
+    if choice in ("pallas", "vmap"):
+        return choice
+    import jax
+
+    return "pallas" if jax.default_backend() == "tpu" else "vmap"
+
+
+def _use_interpret() -> bool:
+    """Interpret mode everywhere a Mosaic lowering doesn't exist —
+    the CPU fallback that keeps tier-1 runnable without a TPU."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _row_spec(rest):
+    """BlockSpec((1, *rest)) row block over the grid — grid cell i
+    sees exactly program i's row."""
+    from jax.experimental import pallas as pl
+
+    nd = len(rest)
+    return pl.BlockSpec((1,) + tuple(rest),
+                        lambda i, _nd=nd: (i,) + (0,) * _nd)
+
+
+def _full_spec(shape):
+    """Whole-array block, the same view for every grid cell (shared
+    flag tables, hoisted constants, the payload pool)."""
+    from jax.experimental import pallas as pl
+
+    nd = len(shape)
+    return pl.BlockSpec(tuple(shape), lambda i, _nd=nd: (0,) * _nd)
+
+
+def _grid_apply(per_row, row_arrays, full_arrays, out_shapes,
+                out_dtypes, interpret):
+    """Run `per_row(*rows_i, *full_arrays)` once per grid cell i.
+
+    row_arrays are (B, *rest) — cell i receives the squeezed row i of
+    each; full_arrays are broadcast whole.  Array constants the
+    per-row function closes over (RNG tables) are hoisted into extra
+    kernel inputs via closure_convert — Pallas kernels may not
+    capture non-scalar constants.  Returns one (B, *shape) output per
+    entry of out_shapes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    b = row_arrays[0].shape[0]
+    ex = [jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+          for a in row_arrays]
+    ex += [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in full_arrays]
+    # jax.closure_convert only hoists inexact-dtype constants (it is
+    # built for custom-derivative plumbing), so the uint64 RNG tables
+    # would stay captured; trace to a jaxpr ourselves and hoist EVERY
+    # constant into a kernel input.
+    closed_jaxpr = jax.make_jaxpr(per_row)(*ex)
+    consts = closed_jaxpr.consts
+    n_args = len(ex)
+
+    def closed(*args):
+        return jax.core.eval_jaxpr(
+            closed_jaxpr.jaxpr, args[n_args:], *args[:n_args])
+    # 0-d constants ride as (1,) blocks (Pallas blocks need a dim).
+    const_nd0 = [c.ndim == 0 for c in consts]
+    const_in = [jnp.asarray(c)[None] if nd0 else jnp.asarray(c)
+                for c, nd0 in zip(consts, const_nd0)]
+    n_row, n_full = len(row_arrays), len(full_arrays)
+
+    def kernel(*refs):
+        row_refs = refs[:n_row]
+        full_refs = refs[n_row:n_row + n_full]
+        const_refs = refs[n_row + n_full:n_row + n_full + len(consts)]
+        out_refs = refs[n_row + n_full + len(consts):]
+        args = [r[...][0] for r in row_refs]
+        args += [r[...] for r in full_refs]
+        args += [r[...][0] if nd0 else r[...]
+                 for r, nd0 in zip(const_refs, const_nd0)]
+        outs = closed(*args)
+        for ref, val in zip(out_refs, outs):
+            ref[...] = jnp.asarray(val)[None]
+
+    in_specs = [_row_spec(a.shape[1:]) for a in row_arrays]
+    in_specs += [_full_spec(a.shape) for a in full_arrays]
+    in_specs += [_full_spec(c.shape) for c in const_in]
+    return pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=[_row_spec(tuple(s)) for s in out_shapes],
+        out_shape=[jax.ShapeDtypeStruct((b,) + tuple(s), d)
+                   for s, d in zip(out_shapes, out_dtypes)],
+        interpret=interpret,
+    )(*row_arrays, *full_arrays, *const_in)
+
+
+def make_pallas_mutator(rounds: int = 4,
+                        interpret: bool | None = None):
+    """The Pallas twin of ops.mutate.make_mutator: same signature
+    (batch, key, flag_vals, flag_counts) -> mutated batch, same bits
+    out, but one grid cell per program so the mutation-op switch
+    dispatches a real branch per cell."""
+    import jax
+    import jax.numpy as jnp
+    from jax import random
+
+    from syzkaller_tpu.ops.mutate import _mutate_one
+
+    if interpret is None:
+        interpret = _use_interpret()
+    out_keys = _STATE_KEYS + _OUT_EXTRA
+
+    @functools.partial(jax.jit, static_argnames=())
+    def mutate_batch(batch: dict, key, flag_vals, flag_counts) -> dict:
+        b = batch["kind"].shape[0]
+        kd = jax.random.key_data(random.split(key, b))
+
+        def per_row(*args):
+            state = dict(zip(_STATE_KEYS, args[:len(_STATE_KEYS)]))
+            kd_i, fv, fc = args[len(_STATE_KEYS):]
+            out = _mutate_one(state, jax.random.wrap_key_data(kd_i),
+                              fv, fc, rounds)
+            return tuple(out[k] for k in out_keys)
+
+        out_shapes = [batch[k].shape[1:] for k in _STATE_KEYS]
+        out_shapes += [(), batch["kind"].shape[1:]]
+        out_dtypes = [batch[k].dtype for k in _STATE_KEYS]
+        out_dtypes += [jnp.bool_, jnp.bool_]
+        outs = _grid_apply(
+            per_row,
+            [batch[k] for k in _STATE_KEYS] + [kd],
+            [flag_vals, flag_counts],
+            out_shapes, out_dtypes, interpret)
+        return dict(zip(out_keys, outs))
+
+    return mutate_batch
+
+
+def make_pallas_mutate_pack(spec, rounds: int,
+                            interpret: bool | None = None):
+    """The pipeline's fused per-program core as ONE kernel:
+    mutate, mask the journals for insert-class rows (which keep the
+    template structure), and pack the sparse delta row + pooled
+    payload — all inside the grid cell, so the packed bytes are
+    produced without a second pass over the mutated state.
+
+    Returns pack_batch(batch, key_data, template_idx, op, donor, pos,
+    flag_vals, flag_counts) -> (rows, payloads, needs) with the exact
+    bytes the vmap pack path emits (pool_idx still unassigned)."""
+    import jax
+    import jax.numpy as jnp
+
+    from syzkaller_tpu.ops.delta import make_packer
+    from syzkaller_tpu.ops.mutate import _mutate_one
+
+    if interpret is None:
+        interpret = _use_interpret()
+    pack = make_packer(spec)
+
+    def pack_batch(batch, key_data, template_idx, op, donor, pos,
+                   flag_vals, flag_counts):
+        def per_row(*args):
+            state = dict(zip(_STATE_KEYS, args[:len(_STATE_KEYS)]))
+            kd_i, ti, op_i, donor_i, pos_i, fv, fc = \
+                args[len(_STATE_KEYS):]
+            mutated = _mutate_one(
+                state, jax.random.wrap_key_data(kd_i), fv, fc, rounds)
+            # Insert rows keep the TEMPLATE structure (the packer
+            # masks the value/data journals by op, and the alive
+            # bitmap must be the unmutated one) — same masking as
+            # the pipeline's vmap `one`.
+            mutated["call_alive"] = jnp.where(
+                op_i != 0, state["call_alive"], mutated["call_alive"])
+            return pack(mutated, ti, op=op_i, donor=donor_i, pos=pos_i)
+
+        return _grid_apply(
+            per_row,
+            [batch[k] for k in _STATE_KEYS]
+            + [key_data, template_idx, op, donor, pos],
+            [flag_vals, flag_counts],
+            [(spec.row_bytes,), (spec.P,), ()],
+            [jnp.uint8, jnp.uint8, jnp.bool_],
+            interpret)
+
+    return pack_batch
+
+
+def make_pallas_pool_assigner(spec, POOL: int,
+                              interpret: bool | None = None):
+    """ops.delta._make_pool_assigner as a grid-sequential kernel.
+
+    TPU grid cells execute in order, so the batch-wide prefix sum
+    that claims pool slots degenerates to ONE SMEM scratch counter:
+    cell i reads the running claim count, patches its row's flags +
+    pool_idx bytes in place, and dynamic-stores its payload at the
+    claimed slot — no cumsum materialization, no batch-wide scatter.
+    Same (rows, pool, n_used) contract and bytes as the vmap
+    assigner (losers flagged OVERFLOW, claimed slots packed at the
+    pool front, n_used capped at POOL)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from syzkaller_tpu.ops.delta import FLAG_OVERFLOW
+
+    if interpret is None:
+        interpret = _use_interpret()
+
+    def kernel(row_ref, payload_ref, needs_ref, row_out_ref,
+               pool_ref, n_used_ref, count_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            count_ref[0] = jnp.int32(0)
+            pool_ref[...] = jnp.zeros((POOL, spec.P), jnp.uint8)
+
+        need = needs_ref[...][0]
+        cur = count_ref[0]
+        lost = need & (cur >= POOL)
+        claimed = need & ~lost
+        pool_idx = jnp.where(claimed, cur, jnp.int32(-1))
+        row = row_ref[...][0]
+        row = row.at[2].set(
+            row[2] | jnp.where(lost, jnp.uint8(FLAG_OVERFLOW),
+                               jnp.uint8(0)))
+        row = lax.dynamic_update_slice(
+            row, lax.bitcast_convert_type(
+                pool_idx.astype(jnp.int32)[None], jnp.uint8)[0], (24,))
+        row_out_ref[...] = row[None]
+
+        # Claimed payloads pack at the pool front in claim order.
+        @pl.when(claimed)
+        def _store():
+            pool_ref[pl.ds(jnp.minimum(cur, POOL - 1), 1), :] = \
+                payload_ref[...]
+
+        nxt = cur + need.astype(jnp.int32)
+        count_ref[0] = nxt
+        n_used_ref[...] = jnp.minimum(nxt, jnp.int32(POOL))[None]
+
+    def assign(rows, payloads, needs):
+        b = rows.shape[0]
+        rows_out, pool, n_used = pl.pallas_call(
+            kernel,
+            grid=(b,),
+            in_specs=[_row_spec((spec.row_bytes,)),
+                      _row_spec((spec.P,)), _row_spec(())],
+            out_specs=[_row_spec((spec.row_bytes,)),
+                       _full_spec((POOL, spec.P)), _full_spec((1,))],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, spec.row_bytes), jnp.uint8),
+                jax.ShapeDtypeStruct((POOL, spec.P), jnp.uint8),
+                jax.ShapeDtypeStruct((1,), jnp.int32),
+            ],
+            scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+            interpret=interpret,
+        )(rows, payloads, needs)
+        return rows_out, pool, n_used[0]
+
+    return assign

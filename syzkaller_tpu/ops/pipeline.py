@@ -70,6 +70,7 @@ from syzkaller_tpu.ops.delta import (
     OP_INSERT,
     DeltaBatch,
     DeltaSpec,
+    compact_rows,
     make_compact_pooler,
     make_packer,
     pool_bucket,
@@ -153,6 +154,15 @@ _M_ASSEMBLE_QUEUE_DEPTH = telemetry.gauge(
 _M_ASSEMBLE_POOL_SIZE = telemetry.gauge(
     "tz_pipeline_assemble_pool_size",
     "assembler threads serving the pipeline")
+_M_MUTATE_BACKEND = telemetry.gauge(
+    "tz_mutate_backend",
+    "mutation-core backend in use (0 = vmap, 1 = pallas)")
+_M_FUSED_BATCHES = telemetry.counter(
+    "tz_pipeline_fused_batches_total",
+    "batches drained through the fused mutate->compact->novel path")
+_M_FUSED_NOVEL_ROWS = telemetry.counter(
+    "tz_pipeline_fused_novel_rows_total",
+    "plane-novel delta rows fetched by the fused drain")
 
 
 class ExecMutant:
@@ -264,6 +274,8 @@ class PipelineStats:
     async_copy_fallbacks: int = 0  # copy_to_host_async not available
     d2h_bytes: int = 0  # compacted bytes fetched device->host
     d2h_batches: int = 0  # batches those bytes cover
+    fused_batches: int = 0  # batches drained through the fused path
+    fused_novel_rows: int = 0  # plane-novel rows those batches shipped
 
 
 class AssembledBatch(list):
@@ -403,7 +415,8 @@ class DevicePipeline:
                  spec: Optional[DeltaSpec] = None, ct=None,
                  max_insert_calls: int = 30, dispatch_depth: int = 2,
                  assemble_workers: Optional[int] = None,
-                 assemble_depth: int = 2):
+                 assemble_depth: int = 2,
+                 backend: Optional[str] = None):
         import jax
         import jax.numpy as jnp
         from jax import random
@@ -411,6 +424,14 @@ class DevicePipeline:
         from syzkaller_tpu.ops import rng as d
         from syzkaller_tpu.ops.insert import DonorBank, choice_table_rows
         from syzkaller_tpu.ops.mutate import _mutate_one
+        from syzkaller_tpu.ops.pallas_mutate import (
+            make_pallas_mutate_pack,
+            resolve_mutate_backend,
+        )
+        from syzkaller_tpu.ops.signal import (
+            mutant_novelty,
+            resolve_mutant_plane_bits,
+        )
 
         self._jax = jax
         self._jnp = jnp
@@ -420,6 +441,11 @@ class DevicePipeline:
         self.spec = spec or PIPELINE_DELTA_SPEC
         self.flags = FlagTables.empty()
         self.capacity = capacity
+        # TZ_PIPELINE_BATCH overrides the constructor batch (envsafe:
+        # a malformed value keeps the argument) — the flagship shape
+        # moved past 2048 with the Pallas mutation core (ISSUE 10)
+        # and the knob lets deployments walk it without code changes.
+        batch_size = max(1, env_int("TZ_PIPELINE_BATCH", batch_size))
         self.batch_size = batch_size
         self.stats = PipelineStats()
         _M_BATCH_SIZE.set(batch_size)
@@ -482,33 +508,84 @@ class DevicePipeline:
             ok = n_alive < max_insert_calls
             return donor, pos.astype(jnp.uint8), ok
 
-        def step(corpus: dict, n: int, key, flag_vals, flag_counts):
+        # Mutation-core backend (ISSUE 10, docs/perf.md "The mutation
+        # core"): Pallas grid-over-batch kernels on TPU (real branch
+        # dispatch per grid cell), the bit-exact vmap path everywhere
+        # else or on TZ_MUTATE_BACKEND=vmap.
+        self._backend = resolve_mutate_backend(backend)
+        _M_MUTATE_BACKEND.set(1 if self._backend == "pallas" else 0)
+        pallas_pack = make_pallas_mutate_pack(self.spec, R) \
+            if self._backend == "pallas" else None
+
+        def sample_and_pack(corpus, n, key, flag_vals, flag_counts):
+            """Template sampling + per-row class draws + the mutation
+            core, shared by the fused and unfused step graphs.  The
+            class/donor sampling stays a (tiny) vmap on both backends
+            and splits each row key exactly as the pre-Pallas fused
+            vmap did, so every backend/fusion combination consumes
+            the same threefry stream."""
             k_idx, k_mut = random.split(key)
             idx = (random.bits(k_idx, (B,), dtype=jnp.uint32)
                    % jnp.maximum(n, 1).astype(jnp.uint32)).astype(jnp.int32)
             batch = {k: v[idx] for k, v in corpus.items()}
             keys = random.split(k_mut, B)
 
-            def one(st, k, i):
+            def classes(st, k):
                 k_class, k_ins, k_mut1 = random.split(k, 3)
                 is_insert = d.intn(k_class, 1 << 20) < int(
                     p_insert * (1 << 20))
                 donor, pos, ins_ok = sample_insert(st, k_ins)
                 is_insert = is_insert & ins_ok
-                mutated = _mutate_one(st, k_mut1, flag_vals, flag_counts, R)
+                op = jnp.where(is_insert, jnp.uint8(1), jnp.uint8(0))
+                donor = jnp.where(is_insert, donor, jnp.int32(-1))
+                return op, donor, pos, k_mut1
+
+            op, donor, pos, mut_keys = jax.vmap(classes)(batch, keys)
+            if pallas_pack is not None:
+                return pallas_pack(batch, jax.random.key_data(mut_keys),
+                                   idx, op, donor, pos,
+                                   flag_vals, flag_counts)
+
+            def one(st, k, i, o, dn, po):
+                mutated = _mutate_one(st, k, flag_vals, flag_counts, R)
                 # Insert mutants keep the TEMPLATE structure: the
                 # packer masks the value/data journals by op, and the
                 # alive bitmap must be the unmutated one.
                 mutated["call_alive"] = jnp.where(
-                    is_insert, st["call_alive"], mutated["call_alive"])
-                op = jnp.where(is_insert, jnp.uint8(1), jnp.uint8(0))
-                donor = jnp.where(is_insert, donor, jnp.int32(-1))
-                return pack(mutated, i, op=op, donor=donor, pos=pos)
+                    o != 0, st["call_alive"], mutated["call_alive"])
+                return pack(mutated, i, op=o, donor=dn, pos=po)
 
-            rows, payloads, needs = jax.vmap(one)(batch, keys, idx)
+            return jax.vmap(one)(batch, mut_keys, idx, op, donor, pos)
+
+        def step(corpus: dict, n: int, key, flag_vals, flag_counts):
+            rows, payloads, needs = sample_and_pack(
+                corpus, n, key, flag_vals, flag_counts)
             return pool(rows, payloads, needs)
 
-        self._step = jax.jit(step)
+        def fused_step(corpus: dict, n: int, key, flag_vals,
+                       flag_counts, plane):
+            """mutate -> emit-compact -> novel_any as ONE dispatch
+            (ISSUE 10): the mutant plane drops already-seen rows ON
+            DEVICE — they claim no pool slot and are compacted out of
+            the row prefix, so a non-novel mutant never crosses D2H.
+            Returns (rows compacted novel-first, pool prefix, n_used,
+            n_novel, updated plane)."""
+            rows, payloads, needs = sample_and_pack(
+                corpus, n, key, flag_vals, flag_counts)
+            novel, plane = mutant_novelty(plane, rows)
+            # Pool claims happen on the PRE-compaction row order, so
+            # pool_idx is already embedded in each row's bytes and
+            # survives the reorder below.
+            rows, pool_arr, n_used = pool(rows, payloads, needs & novel)
+            rows, n_novel = compact_rows(rows, novel)
+            return rows, pool_arr, n_used, n_novel, plane
+
+        # TZ_PIPELINE_FUSED=0 is the kill switch back to the
+        # full-batch drain (every row ships, no mutant plane).
+        self._fused = env_int("TZ_PIPELINE_FUSED", 1) != 0
+        self._plane_bits = resolve_mutant_plane_bits()
+        self._mutant_plane = None  # device plane; built at first launch
+        self._step = jax.jit(fused_step if self._fused else step)
 
         self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
         # In-flight device dispatches the worker keeps ahead of the
@@ -542,8 +619,13 @@ class DevicePipeline:
         # into the depth after each collected batch, so the assembly
         # pool stops idling behind D2H on hosts where the link is the
         # slow stage.  A pinned N reproduces the fixed-depth behavior.
+        # The controller's ceiling follows the batch shape: past the
+        # 2048 flagship batch each drained batch carries ~2x the
+        # assembly work, so the pool may hold proportionally more
+        # batches before the drain thread must block on a join.
         self._assemble_depth, self._depth_ctrl = \
-            resolve_assemble_depth(max(1, assemble_depth))
+            resolve_assemble_depth(max(1, assemble_depth),
+                                   hi=max(4, batch_size // 1024))
         self._pool = AssemblyPool(self._assemble_workers)
         # Transfer plane (ops/staging): persistent host staging for
         # the corpus-flush scatter — rows re-stack into rotating pow2
@@ -574,8 +656,14 @@ class DevicePipeline:
             backoff_initial=env_float("TZ_BREAKER_BACKOFF_S", 1.0),
             backoff_cap=env_float("TZ_BREAKER_BACKOFF_CAP_S", 60.0),
             seed=seed)
+        # 30 s steady-state deadline: the flagship batch completes in
+        # well under a second on every measured backend, so 30 s is
+        # >30x the worst observed batch while still converting a
+        # wedged PJRT call into DeviceWedged 4x sooner than the old
+        # 120 s default.  TZ_WATCHDOG_DEADLINE_S restores any value
+        # (docs/health.md "Watchdog deadlines").
         self.watchdog = Watchdog(
-            deadline_s=env_float("TZ_WATCHDOG_DEADLINE_S", 120.0),
+            deadline_s=env_float("TZ_WATCHDOG_DEADLINE_S", 30.0),
             compile_deadline_s=env_float("TZ_WATCHDOG_COMPILE_S", 600.0))
         self._compiled = False  # first dispatch carries the jit compile
         # Co-resident triage engine (syzkaller_tpu/triage): shares
@@ -745,12 +833,21 @@ class DevicePipeline:
         if self._flags_dev is None or self._flags_len != len(self.flags.counts):
             fv_np, fc_np = self.flags.vals, self.flags.counts
             new_len = len(fc_np)
-            rows = 1 << max(0, (len(fc_np) - 1).bit_length())
-            if rows > len(fc_np):
-                fv_np = np.vstack([fv_np, np.zeros(
-                    (rows - len(fc_np), fv_np.shape[1]), dtype=fv_np.dtype)])
-                fc_np = np.append(fc_np, np.zeros(rows - len(fc_np),
-                                                  dtype=fc_np.dtype))
+            rows = pow2_rows(new_len)
+            if rows > new_len:
+                # The padded tables stage through the same rotating
+                # transfer-plane arena as the corpus scatter above
+                # (ops/staging): one allocation per pow2 bucket,
+                # reused across every later growth re-upload, instead
+                # of a fresh np.vstack/np.append pair per flush.
+                bufs = self._staging.acquire(("flags", rows), {
+                    "vals": ((rows, fv_np.shape[1]), fv_np.dtype),
+                    "counts": ((rows,), fc_np.dtype)})
+                bufs["vals"][:new_len] = fv_np
+                bufs["vals"][new_len:] = 0
+                bufs["counts"][:new_len] = fc_np
+                bufs["counts"][new_len:] = 0
+                fv_np, fc_np = bufs["vals"], bufs["counts"]
             self._flags_dev = (self._jnp.asarray(fv_np),
                                self._jnp.asarray(fc_np))
             self._flags_len = new_len
@@ -774,9 +871,22 @@ class DevicePipeline:
         # converted into DeviceWedged by the watchdog instead of
         # hanging the worker forever (BENCH_WEDGE_DIAGNOSIS.md).
         op = "device.launch" if self._compiled else "device.compile"
+        # Capture the plane into a local: a concurrent
+        # _reset_device_state (breaker re-entry) may null the
+        # attribute between this check and the dispatch below, and the
+        # jitted step must never see None.  A stale plane is fine —
+        # dedup history is advisory and the shapes are pinned.
+        plane = self._mutant_plane
+        if self._fused and plane is None:
+            from syzkaller_tpu.ops.signal import new_mutant_plane
+
+            plane = new_mutant_plane(self._plane_bits)
+            self._mutant_plane = plane
 
         def dispatch():
             fault_point(op)
+            if self._fused:
+                return self._step(corpus, n, sub, fv, fc, plane)
             return self._step(corpus, n, sub, fv, fc)
 
         # Spans time the host-observed dispatch (XLA returns async:
@@ -792,16 +902,25 @@ class DevicePipeline:
             with telemetry.span("pipeline.compile"):
                 result = self.watchdog.call(dispatch, op, compile=True)
         self._compiled = True
-        rows_dev, pool_dev, n_used_dev = result
         # Start the device->host copies now: the tunneled link has a
         # ~70 ms per-sync fixed cost that fully hides behind the next
         # batch's compute (the worker dispatches N+1 before draining N).
-        # The pool cannot start yet — its transfer bucket depends on
-        # the used-slot count — but rows + count cover the bulk.  An
-        # array without an async path (CPU tests, older plugins) falls
-        # back to the synchronous drain, counted instead of swallowed
-        # silently.
-        for arr in (rows_dev, n_used_dev):
+        # Unfused, rows + count cover the bulk (the pool bucket waits
+        # on the used-slot count).  FUSED, the rows prefix itself
+        # depends on the novel count, so only the two scalars start
+        # async — the whole point is that the row bulk for non-novel
+        # mutants never transfers at all.  An array without an async
+        # path (CPU tests, older plugins) falls back to the
+        # synchronous drain, counted instead of swallowed silently.
+        if self._fused:
+            rows_dev, pool_dev, n_used_dev, n_novel_dev, plane = result
+            self._mutant_plane = plane
+            async_arrs = (n_used_dev, n_novel_dev)
+        else:
+            rows_dev, pool_dev, n_used_dev = result
+            n_novel_dev = None
+            async_arrs = (rows_dev, n_used_dev)
+        for arr in async_arrs:
             try:
                 arr.copy_to_host_async()
             except Exception:
@@ -809,24 +928,55 @@ class DevicePipeline:
                 _M_ASYNC_COPY_FALLBACKS.inc()
         # t_dispatch anchors the always-on profiler's dispatch→ready
         # attribution for the fused mutate step (telemetry/profiler).
-        return ((rows_dev, pool_dev, n_used_dev), tmpl, ets,
-                (trace, time.perf_counter()))
+        return ((rows_dev, pool_dev, n_used_dev, n_novel_dev), tmpl,
+                ets, (trace, time.perf_counter()))
 
     def _fetch(self, launched):
-        """The device->host transfers for one launched batch: the full
-        delta rows + used-slot count (pipeline.drain), then only the
-        pow2-bucketed prefix of the payload pool the batch actually
-        claimed (pipeline.pool_drain) — the compacted D2H.  Blocking
-        syncs where a wedged tunnel stalls, so both run under the
-        watchdog.  Returns (DeltaBatch, template snapshot,
-        exec-template snapshot)."""
-        (rows_dev, pool_dev, n_used_dev), tmpl, ets, meta = launched
+        """The device->host transfers for one launched batch.
+        Unfused: the full delta rows + used-slot count
+        (pipeline.drain), then only the pow2-bucketed prefix of the
+        payload pool the batch actually claimed (pipeline.pool_drain).
+        Fused (ISSUE 10): the plane-novel row count first
+        (mutate.fused), then only the compacted novel-row prefix —
+        rows the mutant plane already saw never cross D2H at all.
+        Blocking syncs where a wedged tunnel stalls, so every fetch
+        runs under the watchdog.  Returns (DeltaBatch, template
+        snapshot, exec-template snapshot)."""
+        (rows_dev, pool_dev, n_used_dev, n_novel_dev), tmpl, ets, \
+            meta = launched
         trace, t_dispatch = meta
-        with telemetry.span("pipeline.drain"):
-            rows = self.watchdog.call(lambda: np.asarray(rows_dev),
-                                      "device.drain")
-            n_used = int(self.watchdog.call(
-                lambda: np.asarray(n_used_dev), "device.drain"))
+        if n_novel_dev is not None:
+            # Fused drain (ISSUE 10): sync the novel count first —
+            # that scalar is the fusion boundary — then fetch only
+            # the pow2-bucketed row prefix the compaction packed the
+            # plane-novel rows into.  lo=64 keeps the bucket set
+            # bounded below so near-empty batches still reuse one
+            # staging shape.
+            with telemetry.span("mutate.fused"):
+                n_novel = int(self.watchdog.call(
+                    lambda: np.asarray(n_novel_dev), "device.drain"))
+            row_bucket = pow2_rows(max(n_novel, 1), lo=64,
+                                   hi=self.batch_size)
+            with telemetry.span("pipeline.drain"):
+                rows = self.watchdog.call(
+                    lambda: np.asarray(rows_dev[:row_bucket]),
+                    "device.drain")
+            rows_wire_bytes = rows.nbytes  # the bucketed prefix
+            rows = rows[:n_novel]
+            with telemetry.span("pipeline.drain"):
+                n_used = int(self.watchdog.call(
+                    lambda: np.asarray(n_used_dev), "device.drain"))
+            self.stats.fused_batches += 1
+            self.stats.fused_novel_rows += n_novel
+            _M_FUSED_BATCHES.inc()
+            _M_FUSED_NOVEL_ROWS.inc(n_novel)
+        else:
+            with telemetry.span("pipeline.drain"):
+                rows = self.watchdog.call(lambda: np.asarray(rows_dev),
+                                          "device.drain")
+                n_used = int(self.watchdog.call(
+                    lambda: np.asarray(n_used_dev), "device.drain"))
+            rows_wire_bytes = rows.nbytes
         # Always-on per-kernel attribution (telemetry/profiler.py):
         # dispatch → delta-rows-ready is the fused mutate step's
         # host-observed device residency; the compacted pool fetch is
@@ -845,7 +995,8 @@ class DevicePipeline:
                 pool = np.zeros((0, self.spec.P), np.uint8)
         telemetry.PROFILER.note(
             "emit_compact", time.perf_counter() - t_pool)
-        nbytes = rows.nbytes + pool.nbytes + np.asarray(n_used_dev).nbytes
+        nbytes = rows_wire_bytes + pool.nbytes \
+            + np.asarray(n_used_dev).nbytes
         self.stats.d2h_bytes += nbytes
         self.stats.d2h_batches += 1
         _M_D2H_BYTES.inc(nbytes)
@@ -1032,6 +1183,10 @@ class DevicePipeline:
             self._corpus_dev = None
             self._flags_dev = None
             self._flags_len = 0
+            # The mutant dedup plane lived in the same device session;
+            # rebuild it zeroed.  Losing cross-batch dedup history is
+            # safe — previously-seen rows just ship once more.
+            self._mutant_plane = None
             self._pending_rows = [
                 (i, t.arrays()) for i, t in enumerate(self.templates)
                 if t is not None]

@@ -131,6 +131,60 @@ def merge(plane, edges, nedges, prios, accept):
 merge_into = jax.jit(_merge_impl, donate_argnums=0)
 
 
+#: Default size (log2 buckets) of the MUTANT dedup plane — the
+#: signal-plane trick applied one stage earlier.  2^22 uint8 buckets
+#: = 4 MB of HBM marks every packed delta row the device has ever
+#: emitted; a repeat row (remove-call mutants collide constantly:
+#: only ~calls × templates distinct outcomes exist) is dropped ON
+#: DEVICE before the pool claim, so it never crosses D2H at all.
+#: The fold trades a ~B/2^22 false-drop rate per batch for that 4 MB
+#: — same memory/recall bargain as FOLD_BITS above.
+MUTANT_PLANE_BITS_DEFAULT = 22
+
+
+def resolve_mutant_plane_bits() -> int:
+    """TZ_MUTANT_PLANE_BITS (envsafe) clamped to a sane plane size:
+    10 bits (1 KB, tests) .. 28 bits (256 MB)."""
+    from syzkaller_tpu.health.envsafe import env_int
+
+    bits = env_int("TZ_MUTANT_PLANE_BITS", MUTANT_PLANE_BITS_DEFAULT)
+    return min(max(int(bits), 10), 28)
+
+
+def new_mutant_plane(bits: int = MUTANT_PLANE_BITS_DEFAULT) -> jax.Array:
+    return jnp.zeros(1 << bits, dtype=jnp.uint8)
+
+
+def hash_rows(rows):
+    """FNV-1a over each packed delta row's bytes: uint8[B, row_bytes]
+    -> uint32[B].  Runs inside the fused step jit, so the loop over
+    row bytes is a device fori_loop, not B×228 host ops."""
+    h0 = jnp.full(rows.shape[:1], 0x811C9DC5, jnp.uint32)
+
+    def body(j, h):
+        return (h ^ rows[:, j].astype(jnp.uint32)) \
+            * jnp.uint32(0x01000193)
+
+    return jax.lax.fori_loop(0, rows.shape[1], body, h0)
+
+
+def mutant_novelty(plane, rows):
+    """Cross-batch mutant dedup vs the mutant plane: fold each row's
+    FNV hash into the plane, flag rows whose bucket is unseen, mark
+    the buckets.  Returns (novel: bool[B], updated plane).
+
+    Within-batch duplicates BOTH read the pre-update plane, so both
+    pass — the plane is cross-batch dedup only; exact within-batch
+    dedup would cost a sort the fused step doesn't need (a same-batch
+    repeat is rare and harmless, it just ships twice once)."""
+    bits = int(plane.shape[0]).bit_length() - 1
+    h = hash_rows(rows)
+    idx = ((h ^ (h >> jnp.uint32(bits)))
+           & jnp.uint32(plane.shape[0] - 1)).astype(jnp.int32)
+    novel = plane[idx] == 0
+    return novel, plane.at[idx].set(jnp.uint8(1))
+
+
 def stage_batch(edges: np.ndarray, nedges: np.ndarray,
                 prios: np.ndarray):
     """The H2D edge of one padded novelty batch: upload the staged

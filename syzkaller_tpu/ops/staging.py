@@ -231,16 +231,19 @@ class DepthController:
         return self.depth
 
 
-def resolve_assemble_depth(default: int):
+def resolve_assemble_depth(default: int, hi: int = None):
     """Parse TZ_ASSEMBLE_DEPTH=auto|N (health.envsafe discipline):
     returns (depth, controller) where controller is a DepthController
     seeded at `depth` for auto mode and None for a pinned depth.
     Unset and malformed values both resolve to auto at the compiled-in
     default — self-tuning is the production behavior, a typo must not
-    change it."""
+    change it.  `hi` raises the controller's ceiling for callers whose
+    batch shape outgrew the default (the pipeline scales it with
+    TZ_PIPELINE_BATCH past the 2048 flagship shape)."""
     v = env_auto_int("TZ_ASSEMBLE_DEPTH", None)
     if v is None:
-        ctrl = DepthController(initial=max(1, default))
+        ctrl = DepthController(initial=max(1, default),
+                               hi=4 if hi is None else max(1, hi))
         return ctrl.depth, ctrl
     depth = max(1, v)
     _M_ASSEMBLE_DEPTH.set(depth)

@@ -19,6 +19,13 @@ one exported family:
               they include queue + transfer residency, which is
               exactly the operator question ("where does a batch's
               wall time go") but NOT a pure kernel microbenchmark.
+              With the ISSUE 10 fused drain (mutate→emit-compact→
+              novel_any in ONE dispatch, mutant plane on device),
+              "mutate" covers dispatch to novel-rows-prefix-ready —
+              the whole fused graph — and the `mutate.fused` span
+              separately times the novel-count sync that gates the
+              prefix fetch; per-kernel isolation inside the fused
+              graph remains bench.py --profile's job.
 
   bench.py --profile
               the precise per-kernel numbers: each kernel dispatched

@@ -170,6 +170,26 @@ def wedge_report(snap: dict) -> list[str]:
         if stale:
             line += f", {int(stale)} stale slots"
         lines.append(line)
+    # Mutation core (ISSUE 10): backend, batch shape, and the fused
+    # drain's novel fraction — a fused frac of 1.0 with a large
+    # corpus means the mutant plane is undersized (or freshly
+    # rebuilt); a collapsing frac with a stalling mutant rate means
+    # the corpus went stale and mutations are repeating.
+    backend_g = gauges.get("tz_mutate_backend")
+    batch_g = gauges.get("tz_pipeline_batch_size") or 0
+    f_batches = counters.get("tz_pipeline_fused_batches_total") or 0
+    if backend_g is not None or f_batches:
+        backend = "pallas" if backend_g else "vmap"
+        line = f"mutation core: backend {backend}"
+        if batch_g:
+            line += f", batch {int(batch_g)}"
+        if f_batches and batch_g:
+            novel = counters.get(
+                "tz_pipeline_fused_novel_rows_total") or 0
+            line += (f", fused frac "
+                     f"{novel / (f_batches * batch_g):.1%} "
+                     f"over {int(f_batches)} batches")
+        lines.append(line)
     # Triage plane health (ISSUE 4): pre-filter hit rate and the
     # realized device-checked call rate — next to the demotion count
     # so a CPU-path regression is visible in the same A/B snapshot.

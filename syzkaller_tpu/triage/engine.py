@@ -239,8 +239,12 @@ class TriageEngine:
             failure_threshold=max(1, env_int("TZ_BREAKER_THRESHOLD", 4)),
             backoff_initial=env_float("TZ_BREAKER_BACKOFF_S", 1.0),
             backoff_cap=env_float("TZ_BREAKER_BACKOFF_CAP_S", 60.0))
+        # 30 s default (was 120 s): >30x the worst measured batch on
+        # every backend, so a wedge is declared 4x sooner without any
+        # false-positive margin lost — rationale in docs/health.md
+        # "Watchdog deadlines"; the knob restores any value.
         self.watchdog = watchdog if watchdog is not None else Watchdog(
-            deadline_s=env_float("TZ_WATCHDOG_DEADLINE_S", 120.0),
+            deadline_s=env_float("TZ_WATCHDOG_DEADLINE_S", 30.0),
             compile_deadline_s=env_float("TZ_WATCHDOG_COMPILE_S", 600.0))
         self.stats = TriageStats()
         # The host mirror is the plane's rebuild authority: uint8

@@ -781,6 +781,39 @@ def test_transfer_plane_zero_new_jits_on_warm_pipeline(device_rig):
         pl.triage_engine = None
 
 
+def test_fused_mutation_core_zero_new_jits_on_warm_pipeline(device_rig):
+    """ISSUE 10 compile-count guard: the fused mutate->emit-compact->
+    novelty drain is ONE jitted step — steady-state batches (whatever
+    novel count each draws, whatever pow2 row prefix the host then
+    fetches), and a device-state rebuild that drops the mutant plane
+    (the breaker's half-open path) all add ZERO per-batch jit
+    compiles after warmup.  Steady-state drains also may not grow the
+    staging arena (the flags/corpus re-pads rotate existing
+    buckets)."""
+    _target, pl = device_rig
+    assert pl._fused, "device rig must exercise the fused drain"
+    assert pl.next_batch(timeout=300)  # warm the fused step
+    caches0 = pl._step._cache_size()
+    allocs0 = pl._staging.allocations
+    fused0 = pl.stats.fused_batches
+    for _ in range(2):
+        assert pl.next_batch(timeout=300) is not None
+    assert pl.stats.fused_batches > fused0
+    assert pl.stats.fused_novel_rows > 0
+    assert pl._staging.allocations == allocs0, \
+        "steady-state drains grew the staging arena"
+    # The half-open rebuild drops the mutant plane (dedup history is
+    # advisory); the next launch rebuilds it lazily — same shapes, so
+    # the step executable is reused, not retraced.
+    pl._reset_device_state()
+    # No plane-is-None assert here: the worker thread may already be
+    # launching the next batch and rebuild it before we look.
+    assert pl.next_batch(timeout=300)
+    assert pl._mutant_plane is not None
+    assert pl._step._cache_size() == caches0, \
+        "fused drain retraced after warmup"
+
+
 def test_coverage_analytics_zero_new_jits_on_warm_rig(device_rig):
     """ISSUE 7 compile-count guard: the coverage analytics kernels
     compile exactly ONCE (pinned plane shape) and the per-batch hot

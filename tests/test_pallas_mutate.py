@@ -1,0 +1,304 @@
+"""Pallas mutation-core bit-exactness (ISSUE 10): the grid-over-batch
+kernels in ops/pallas_mutate — run in interpret mode on CPU — must be
+byte-identical to the vmap reference over the SAME threefry keys.
+Pinned here: the full-state mutator (every output field), targeted
+coverage of each value-slot kind (INT/FLAGS/PROC/LEN) and of the
+dead-call removal + LEN fixup path, all seven `_mutate_data_span`
+byte-arena ops via host-side key search, the fused mutate+pack
+kernel against the pipeline's vmap `one`, and the grid-sequential
+pool assigner (including the overflow path) against the prefix-sum
+assigner.
+
+Interpret-mode pallas traces are compile-dominated (~10 s each, warm
+calls are free), so the module keeps exactly three expensive traces:
+ONE mutator pair shared by every mutator-level test (module-scoped
+fixture, one fixed batch shape), one fused-pack trace, one data-span
+trace.  ROADMAP budget discipline: everything else reuses them."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax import random  # noqa: E402
+
+from syzkaller_tpu.models.generation import generate_prog  # noqa: E402
+from syzkaller_tpu.models.rand import RandGen  # noqa: E402
+from syzkaller_tpu.models.target import get_target  # noqa: E402
+from syzkaller_tpu.ops import rng as d  # noqa: E402
+from syzkaller_tpu.ops.delta import (  # noqa: E402
+    DeltaSpec,
+    _make_pool_assigner,
+    make_packer,
+)
+from syzkaller_tpu.ops.mutate import (  # noqa: E402
+    _mutate_data_span,
+    _mutate_one,
+    make_mutator,
+)
+from syzkaller_tpu.ops.pallas_mutate import (  # noqa: E402
+    _OUT_EXTRA,
+    _STATE_KEYS,
+    _grid_apply,
+    make_pallas_mutate_pack,
+    make_pallas_mutator,
+    make_pallas_pool_assigner,
+    resolve_mutate_backend,
+)
+from syzkaller_tpu.ops.tensor import (  # noqa: E402
+    DATA,
+    EMPTY,
+    FLAGS,
+    INT,
+    LEN,
+    PROC,
+    FlagTables,
+    TensorConfig,
+    encode_prog,
+)
+
+CFG = TensorConfig(max_slots=128, arena=2048, max_blob=768)
+FLAG_TABLES = FlagTables.empty()
+ROUNDS = 2
+BATCH = 6  # every mutator-level test uses this shape (one trace)
+
+
+@pytest.fixture(scope="module")
+def base_batch():
+    """BATCH stacked program tensors (cycled if generation rejects
+    some) — the ONE shape the shared mutator pair is traced at."""
+    target = get_target("test", "64")
+    arrs = []
+    i = 0
+    while len(arrs) < BATCH and i < BATCH * 8:
+        p = generate_prog(target, RandGen(target, 500 + i), 6)
+        i += 1
+        try:
+            arrs.append(encode_prog(p, CFG, FLAG_TABLES).arrays())
+        except Exception:
+            continue
+    assert arrs
+    return {k: jnp.stack([jnp.asarray(arrs[j % len(arrs)][k])
+                          for j in range(BATCH)])
+            for k in arrs[0]}
+
+
+@pytest.fixture(scope="module")
+def mutators():
+    """The (vmap, pallas-interpret) mutator pair every parity test
+    shares — each is one jitted callable, so all calls at the
+    base_batch shape after the first reuse the same executable."""
+    return (make_mutator(rounds=ROUNDS, backend="vmap"),
+            make_pallas_mutator(rounds=ROUNDS, interpret=True))
+
+
+def _flag_arrays():
+    return jnp.asarray(FLAG_TABLES.vals), jnp.asarray(FLAG_TABLES.counts)
+
+
+def _assert_state_equal(ref, got):
+    for k in _STATE_KEYS + _OUT_EXTRA:
+        np.testing.assert_array_equal(
+            np.asarray(ref[k]), np.asarray(got[k]),
+            err_msg=f"backend divergence in field {k!r}")
+
+
+def test_resolve_backend(monkeypatch):
+    """auto = vmap off-TPU; explicit argument beats the env knob; a
+    typo'd knob degrades to auto (health.envsafe discipline)."""
+    monkeypatch.delenv("TZ_MUTATE_BACKEND", raising=False)
+    assert resolve_mutate_backend() == "vmap"  # CPU test rig
+    assert resolve_mutate_backend("pallas") == "pallas"
+    monkeypatch.setenv("TZ_MUTATE_BACKEND", "pallas")
+    assert resolve_mutate_backend() == "pallas"
+    assert resolve_mutate_backend("vmap") == "vmap"
+    monkeypatch.setenv("TZ_MUTATE_BACKEND", "palas")  # typo -> auto
+    assert resolve_mutate_backend() == "vmap"
+
+
+def test_mutator_parity_randomized(base_batch, mutators):
+    """Full mutate_batch parity over randomized keys: every output
+    field (state + the preserve_sizes/touched journals) bit-equal."""
+    ref_fn, got_fn = mutators
+    fv, fc = _flag_arrays()
+    touched_any = False
+    for trial in range(3):
+        key = random.key(100 + trial)
+        ref = ref_fn(base_batch, key, fv, fc)
+        got = got_fn(base_batch, key, fv, fc)
+        _assert_state_equal(ref, got)
+        touched_any |= bool(np.asarray(ref["touched"]).any())
+    assert touched_any, "no trial mutated any slot — keys too unlucky"
+
+
+def test_slot_kind_parity_per_kind(base_batch, mutators):
+    """Each value-slot mutator (and the DATA byte engine) covered in
+    one batch: row j's slot 0 is forced to kind KINDS[j] and every
+    other slot EMPTY, so masked_choice must pick it and the kind's
+    branch is the one whose output survives — same shape as
+    base_batch, so the shared mutator executable is reused."""
+    KINDS = (INT, FLAGS, PROC, LEN, DATA)
+    kind = np.full(np.asarray(base_batch["kind"]).shape, EMPTY,
+                   dtype=np.asarray(base_batch["kind"]).dtype)
+    for j, kc in enumerate(KINDS):
+        kind[j, 0] = kc
+    kind[len(KINDS):, 0] = INT  # spare rows: more INT coverage
+    kb = dict(base_batch)
+    kb["kind"] = jnp.asarray(kind)
+    kb["call"] = base_batch["call"].at[:, 0].set(0)
+    kb["call_alive"] = base_batch["call_alive"].at[:, 0].set(True)
+    kb["width"] = base_batch["width"].at[:, 0].set(8)
+    kb["flag_set"] = base_batch["flag_set"].at[:, 0].set(0)
+    kb["aux1"] = base_batch["aux1"].at[:, 0].set(64)  # PROC range
+    j_data = KINDS.index(DATA)
+    kb["off"] = base_batch["off"].at[j_data, 0].set(0)
+    kb["cap"] = base_batch["cap"].at[j_data, 0].set(64)
+    kb["len_"] = base_batch["len_"].at[j_data, 0].set(16)
+
+    ref_fn, got_fn = mutators
+    fv, fc = _flag_arrays()
+    # Several keys so the 1/11 remove class can't mask a whole kind
+    # (a removed call leaves its row's forced slot untouched).
+    touched = np.zeros(len(base_batch["kind"]), dtype=bool)
+    for seed in range(4):
+        ref = ref_fn(kb, random.key(7 + seed), fv, fc)
+        got = got_fn(kb, random.key(7 + seed), fv, fc)
+        _assert_state_equal(ref, got)
+        touched |= np.asarray(ref["touched"])[:, 0]
+    for j, kc in enumerate(KINDS):
+        assert touched[j], \
+            f"forced kind {kc} (row {j}) never mutated — not covered"
+
+
+def test_dead_call_removal_parity(base_batch, mutators):
+    """The remove-call class (1/11 per round) + the LEN fixup that
+    follows: search keys on the vmap reference until a batch actually
+    kills a call, then pin Pallas parity on that exact key."""
+    ref_fn, got_fn = mutators
+    fv, fc = _flag_arrays()
+    alive0 = np.asarray(base_batch["call_alive"])
+    key = None
+    for seed in range(40):
+        ref = ref_fn(base_batch, random.key(9000 + seed), fv, fc)
+        if (np.asarray(ref["call_alive"]) != alive0).any():
+            key = random.key(9000 + seed)
+            break
+    assert key is not None, "no key removed a call in 40 tries"
+    got = got_fn(base_batch, key, fv, fc)
+    _assert_state_equal(ref, got)
+
+
+def test_data_span_ops_parity_all_seven():
+    """All seven byte-arena ops (flip/insert/remove/append/replace/
+    addsub/interesting): host-side key search picks one key per op
+    branch (`d.intn(k_op, 7)` over the same split _mutate_data_span
+    performs), then the unbatched reference and the grid kernel must
+    agree byte-for-byte on (arena, length, ok)."""
+    A = 128
+    arena0 = jnp.asarray(
+        np.random.RandomState(3).randint(0, 256, A, dtype=np.uint8))
+    # dtypes as _mutate_slot passes them: off/len/cap int32 arena
+    # spans, aux0/aux1 (min/max length) uint64.
+    off = jnp.int32(16)
+    length = jnp.int32(48)
+    cap = jnp.int32(96)
+    min_len = jnp.uint64(0)
+    max_len = jnp.uint64(96)
+
+    chosen = {}
+    i = 0
+    while len(chosen) < 7 and i < 4000:
+        k = random.key(70_000 + i)
+        i += 1
+        op = int(d.intn(random.split(k, 8)[0], 7))
+        chosen.setdefault(op, k)
+    assert len(chosen) == 7, f"key search only hit ops {sorted(chosen)}"
+    keys = [chosen[op] for op in range(7)]
+
+    refs = [_mutate_data_span(k, arena0, off, length, cap,
+                              min_len, max_len) for k in keys]
+    ref_arena = np.stack([np.asarray(r[0]) for r in refs])
+    ref_len = np.stack([np.asarray(r[1]) for r in refs])
+    ref_ok = np.stack([np.asarray(r[2]) for r in refs])
+
+    kd = jnp.stack([jax.random.key_data(k) for k in keys])
+    arenas = jnp.tile(arena0[None], (7, 1))
+
+    def per_row(arena, kd_i):
+        return _mutate_data_span(
+            jax.random.wrap_key_data(kd_i), arena, off, length,
+            cap, min_len, max_len)
+
+    got = _grid_apply(
+        per_row, [arenas, kd], [],
+        [(A,), (), ()],
+        [ref_arena.dtype, ref_len.dtype, ref_ok.dtype],
+        interpret=True)
+    np.testing.assert_array_equal(ref_arena, np.asarray(got[0]))
+    np.testing.assert_array_equal(ref_len, np.asarray(got[1]))
+    np.testing.assert_array_equal(ref_ok, np.asarray(got[2]))
+
+
+@pytest.mark.slow
+def test_mutate_pack_parity(base_batch):
+    """The fused mutate+pack kernel vs the pipeline's vmap `one`
+    (including the insert-class journal masking): identical 228-byte
+    delta rows, payload slots, and needs flags.
+
+    Marked slow: this traces a third interpret-mode pallas executable
+    (~38 s cold) and the pack path it pins is shared code already
+    exercised end-to-end by the tier-1 pipeline tests; the slot-op,
+    data-span, and dead-call parity tests above stay in tier-1."""
+    spec = DeltaSpec()
+    fv, fc = _flag_arrays()
+    pack = make_packer(spec)
+    mut_keys = random.split(random.key(42), BATCH)
+    idx = jnp.arange(BATCH, dtype=jnp.int32)
+    op = jnp.asarray([0, 1] * (BATCH // 2), dtype=jnp.uint8)
+    donor = jnp.where(op != 0, jnp.int32(0), jnp.int32(-1))
+    pos = jnp.zeros((BATCH,), dtype=jnp.uint8)
+
+    def one(st, k, i, o, dn, po):
+        mutated = _mutate_one(st, k, fv, fc, ROUNDS)
+        mutated["call_alive"] = jnp.where(
+            o != 0, st["call_alive"], mutated["call_alive"])
+        return pack(mutated, i, op=o, donor=dn, pos=po)
+
+    ref_rows, ref_payloads, ref_needs = jax.vmap(one)(
+        base_batch, mut_keys, idx, op, donor, pos)
+    got_rows, got_payloads, got_needs = make_pallas_mutate_pack(
+        spec, rounds=ROUNDS, interpret=True)(
+        base_batch, jax.random.key_data(mut_keys), idx, op, donor,
+        pos, fv, fc)
+    np.testing.assert_array_equal(np.asarray(ref_rows),
+                                  np.asarray(got_rows))
+    np.testing.assert_array_equal(np.asarray(ref_payloads),
+                                  np.asarray(got_payloads))
+    np.testing.assert_array_equal(np.asarray(ref_needs),
+                                  np.asarray(got_needs))
+
+
+@pytest.mark.parametrize("pool_slots", [8, 1], ids=["roomy", "overflow"])
+def test_pool_assigner_parity(pool_slots):
+    """Grid-sequential SMEM-counter pool claims vs the prefix-sum
+    assigner: identical patched rows (flags byte, embedded pool_idx),
+    packed pool prefix, and capped n_used — with pool_slots=1 forcing
+    the FLAG_OVERFLOW loser path."""
+    spec = DeltaSpec()
+    rng = np.random.RandomState(11)
+    b = 12
+    rows = jnp.asarray(rng.randint(0, 256, (b, spec.row_bytes),
+                                   dtype=np.uint8))
+    payloads = jnp.asarray(rng.randint(0, 256, (b, spec.P),
+                                       dtype=np.uint8))
+    needs = jnp.asarray(rng.rand(b) < 0.5)
+    assert int(np.asarray(needs).sum()) > pool_slots or pool_slots == 8
+    ref_rows, ref_pool, ref_used = _make_pool_assigner(
+        spec, pool_slots)(rows, payloads, needs)
+    got_rows, got_pool, got_used = make_pallas_pool_assigner(
+        spec, pool_slots, interpret=True)(rows, payloads, needs)
+    np.testing.assert_array_equal(np.asarray(ref_rows),
+                                  np.asarray(got_rows))
+    np.testing.assert_array_equal(np.asarray(ref_pool),
+                                  np.asarray(got_pool))
+    assert int(ref_used) == int(got_used) <= pool_slots

@@ -233,3 +233,50 @@ def test_pipeline_assemble_depth_knob(monkeypatch):
     assert pl._assemble_depth == 2 and pl._depth_ctrl is not None
     assert pl.health_snapshot()["assemble_depth_auto"] is True
     pl.stop()
+
+
+def test_arena_flags_repad_allocs_regression():
+    """ISSUE 10 `tz_staging_arena_allocs_total` regression: the
+    pipeline's flag-table re-pads (ops/pipeline._flush_pending) route
+    growth re-uploads through pow2_rows + ONE rotating arena bucket
+    per pow2 row count — repeated growth inside a bucket is zero
+    allocation events (rotation only), the counter advances exactly
+    once per new bucket, and an exact-pow2 length skips staging
+    entirely (the tables upload unpadded)."""
+    from syzkaller_tpu.ops.staging import _M_ARENA_ALLOCS
+
+    a = StagingArena(slots=2)
+    c0 = _M_ARENA_ALLOCS.value
+
+    def repad(n_flags):
+        # The _flush_pending staging contract, verbatim: pad to the
+        # pow2 bucket, zero the tail (stale rotated bytes must not
+        # reach the device tables).
+        rows = pow2_rows(n_flags)
+        vals = np.arange(n_flags * 4, dtype=np.uint64).reshape(-1, 4)
+        counts = np.full(n_flags, 2, dtype=np.int32)
+        if rows > n_flags:
+            bufs = a.acquire(("flags", rows), {
+                "vals": ((rows, 4), vals.dtype),
+                "counts": ((rows,), counts.dtype)})
+            bufs["vals"][:n_flags] = vals
+            bufs["vals"][n_flags:] = 0
+            bufs["counts"][:n_flags] = counts
+            bufs["counts"][n_flags:] = 0
+            vals, counts = bufs["vals"], bufs["counts"]
+        assert vals.shape[0] == rows and counts.shape[0] == rows
+        return vals, counts
+
+    v, c = repad(5)  # bucket 8: the one allocation event
+    assert a.allocations == 1
+    assert (v[5:] == 0).all() and (c[5:] == 0).all()
+    repad(6)
+    v7, _ = repad(7)  # same bucket: slot rotation, zero growth
+    assert a.allocations == 1
+    assert _M_ARENA_ALLOCS.value == c0 + 1
+    assert (v7[7:] == 0).all()  # rotated slot's stale tail re-zeroed
+    repad(9)  # crosses into bucket 16: exactly one more event
+    assert a.allocations == 2
+    assert _M_ARENA_ALLOCS.value == c0 + 2
+    repad(16)  # exact pow2: no padding, no staging acquire at all
+    assert a.allocations == 2 and _M_ARENA_ALLOCS.value == c0 + 2
