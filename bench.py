@@ -667,6 +667,28 @@ def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
         prios = np.full(triage_batch, 3, dtype=np.uint8)
         ed, nd, pr = dsig.stage_batch(edges, nedges, prios)
         novel_ms = timed(lambda i: dsig.novel_any(plane, ed, nd, pr))
+
+        # Per-shard kernel ms (ISSUE 11): the mutation core isolated
+        # on EACH device in turn, so a straggling chip shows up as
+        # its own `tz_mesh_shard_ms_per_batch{shard=...}` gauge —
+        # the differentiated view the collective launch can't give
+        # (it completes at the slowest chip's pace).
+        shard_ms = {}
+        devices = jax.devices()
+        if len(devices) > 1:
+            per = max(1, batch_size // len(devices))
+            for si, dev in enumerate(devices):
+                telemetry.SHARD_PROFILER.ensure(si)
+                shard_batch = {
+                    k: jax.device_put(v[:per], dev)
+                    for k, v in batch.items()}
+                sfv = jax.device_put(fv, dev)
+                sfc = jax.device_put(fc, dev)
+                ms = timed(lambda i: mutate_only(
+                    shard_batch, random.fold_in(key, 5000 + i),
+                    sfv, sfc), warm=1)
+                telemetry.SHARD_PROFILER.note(si, ms / 1e3)
+                shard_ms[str(si)] = round(ms, 4)
     finally:
         pl.stop()
     fused_d2h = (pl.stats.d2h_bytes / pl.stats.d2h_batches
@@ -689,6 +711,9 @@ def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
         "fused_d2h_bytes_per_batch": (
             round(fused_d2h, 1) if fused_d2h is not None else None),
         "mutate_backend": pl._backend,
+        # Per-device isolated mutate probes (empty on 1-device rigs);
+        # also exported live as tz_mesh_shard_ms_per_batch gauges.
+        "mesh_shard_ms_per_batch": shard_ms,
         "profile_batch": batch_size,
         "profile_triage_shape": [triage_batch, triage_edges],
         "always_on": telemetry.PROFILER.snapshot(),

@@ -48,6 +48,10 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_MANAGER_HTTP",
     "TZ_MANAGER_INPUTS_CAP",
     "TZ_MANAGER_SIGNAL_CAP",
+    "TZ_MESH_COMPAT",
+    "TZ_MESH_COV",
+    "TZ_MESH_DEVICES",
+    "TZ_MESH_WATCHDOG_DEADLINE_S",
     "TZ_MUTANT_PLANE_BITS",
     "TZ_MUTATE_BACKEND",
     "TZ_PIPELINE_BATCH",

@@ -49,6 +49,7 @@ SEAMS = (
     "rpc.reply_cache",
     "manager.lease_expire",
     "queue.put",
+    "mesh.shard_probe",
 )
 
 MODES = ("fail", "hang")

@@ -162,7 +162,15 @@ def serialize_for_exec(p: Prog, buffer_size: int = EXEC_BUFFER_SIZE,
             if isinstance(arg, (GroupArg, UnionArg)):
                 return
             t = arg.typ
-            if t.dir == Dir.OUT or is_pad(t) or arg.size() == 0:
+            if t.dir == Dir.OUT or is_pad(t):
+                return
+            if arg.size() == 0 and not (
+                    isinstance(arg, DataArg) and data_caps is not None
+                    and data_caps.get(id(arg), 0)):
+                # Zero-size args have nothing to copy in — except a
+                # cap-padded data region, whose stream footprint is
+                # fixed by the template so mutated lengths (including
+                # len 0) never reshape the stream.
                 return
             w.write(EXEC_INSTR_COPYIN)
             w.write(addr)

@@ -45,6 +45,7 @@ integrated op distribution matches the reference weighted loop
 
 from __future__ import annotations
 
+import functools
 import queue
 import threading
 import time
@@ -406,6 +407,130 @@ PIPELINE_TENSOR_CONFIG = TensorConfig(
 PIPELINE_DELTA_SPEC = DeltaSpec()
 
 
+@functools.lru_cache(maxsize=None)
+def _shared_step(spec, B: int, R: int, backend: str, fused: bool,
+                 n_blocks: int, max_insert_calls: int):
+    """The jitted mutate->pack step, shared process-wide.
+
+    The ChoiceTable prefix-sum rows and the donor index enter as
+    TRACED arguments instead of closure constants, so the compiled
+    executable depends only on the static shape key above — a second
+    DevicePipeline at the same (spec, batch, rounds) reuses the first
+    one's compile instead of paying XLA again.  That matters anywhere
+    engines churn: per-Proc pipelines, breaker-driven rebuilds, and
+    every test rig in a shared process.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import random
+
+    from syzkaller_tpu.ops import rng as d
+    from syzkaller_tpu.ops.mutate import _mutate_one
+    from syzkaller_tpu.ops.pallas_mutate import make_pallas_mutate_pack
+    from syzkaller_tpu.ops.signal import mutant_novelty
+
+    pack = make_packer(spec)
+    pool = make_compact_pooler(spec, B)
+    p_insert = P_INSERT_GIVEN_DEVICE if n_blocks > 0 else 0.0
+    pallas_pack = make_pallas_mutate_pack(spec, R) \
+        if backend == "pallas" else None
+
+    def sample_and_pack(corpus, n, key, flag_vals, flag_counts,
+                        runs, by_syscall):
+        """Template sampling + per-row class draws + the mutation
+        core, shared by the fused and unfused step graphs.  The
+        class/donor sampling stays a (tiny) vmap on both backends
+        and splits each row key exactly as the pre-Pallas fused
+        vmap did, so every backend/fusion combination consumes
+        the same threefry stream."""
+        nid = runs.shape[0]
+
+        def sample_insert(st, k):
+            """Donor + position for an insert mutant: ChoiceTable
+            categorical over the context call's prefix-sum prio row
+            (reference: prog/prio.go:230-245) + biased-to-end insert
+            position (reference: prog/mutation.go:79)."""
+            k_ctx, k_x, k_fb, k_pos = random.split(k, 4)
+            alive = st["call_alive"]
+            ctx_slot = d.masked_choice(k_ctx, alive)
+            ctx_id = st["call_id"][jnp.maximum(ctx_slot, 0)]
+            row = runs[jnp.clip(ctx_id, 0, nid - 1)]
+            x = (d.intn(k_x, jnp.maximum(row[-1], 1).astype(jnp.int64))
+                 .astype(jnp.uint32) + 1)
+            sid = jnp.searchsorted(row, x)
+            donor = by_syscall[jnp.clip(sid, 0, nid - 1)]
+            donor = jnp.where(
+                donor < 0,
+                d.intn(k_fb, max(n_blocks, 1)).astype(jnp.int32), donor)
+            n_alive = alive.sum().astype(jnp.int32)
+            pos = d.biased_rand(k_pos, st["call_alive"].shape[0] + 1, 5) \
+                .astype(jnp.int32)
+            pos = jnp.minimum(pos, n_alive)
+            # Respect the program-length budget: a full template
+            # falls back to the mutate class.
+            ok = n_alive < max_insert_calls
+            return donor, pos.astype(jnp.uint8), ok
+
+        k_idx, k_mut = random.split(key)
+        idx = (random.bits(k_idx, (B,), dtype=jnp.uint32)
+               % jnp.maximum(n, 1).astype(jnp.uint32)).astype(jnp.int32)
+        batch = {k: v[idx] for k, v in corpus.items()}
+        keys = random.split(k_mut, B)
+
+        def classes(st, k):
+            k_class, k_ins, k_mut1 = random.split(k, 3)
+            is_insert = d.intn(k_class, 1 << 20) < int(
+                p_insert * (1 << 20))
+            donor, pos, ins_ok = sample_insert(st, k_ins)
+            is_insert = is_insert & ins_ok
+            op = jnp.where(is_insert, jnp.uint8(1), jnp.uint8(0))
+            donor = jnp.where(is_insert, donor, jnp.int32(-1))
+            return op, donor, pos, k_mut1
+
+        op, donor, pos, mut_keys = jax.vmap(classes)(batch, keys)
+        if pallas_pack is not None:
+            return pallas_pack(batch, jax.random.key_data(mut_keys),
+                               idx, op, donor, pos,
+                               flag_vals, flag_counts)
+
+        def one(st, k, i, o, dn, po):
+            mutated = _mutate_one(st, k, flag_vals, flag_counts, R)
+            # Insert mutants keep the TEMPLATE structure: the
+            # packer masks the value/data journals by op, and the
+            # alive bitmap must be the unmutated one.
+            mutated["call_alive"] = jnp.where(
+                o != 0, st["call_alive"], mutated["call_alive"])
+            return pack(mutated, i, op=o, donor=dn, pos=po)
+
+        return jax.vmap(one)(batch, mut_keys, idx, op, donor, pos)
+
+    def step(corpus: dict, n: int, key, flag_vals, flag_counts,
+             runs, by_syscall):
+        rows, payloads, needs = sample_and_pack(
+            corpus, n, key, flag_vals, flag_counts, runs, by_syscall)
+        return pool(rows, payloads, needs)
+
+    def fused_step(corpus: dict, n: int, key, flag_vals,
+                   flag_counts, plane, runs, by_syscall):
+        """mutate -> emit-compact -> novel_any as ONE dispatch
+        (ISSUE 10): the mutant plane drops already-seen rows ON
+        DEVICE — they claim no pool slot and are compacted out of
+        the row prefix, so a non-novel mutant never crosses D2H.
+        Returns (rows compacted novel-first, pool prefix, n_used,
+        n_novel, updated plane)."""
+        rows, payloads, needs = sample_and_pack(
+            corpus, n, key, flag_vals, flag_counts, runs, by_syscall)
+        novel, plane = mutant_novelty(plane, rows)
+        # Pool claims happen on the PRE-compaction row order, so
+        # pool_idx is already embedded in each row's bytes and
+        # survives the reorder below.
+        rows, pool_arr, n_used = pool(rows, payloads, needs & novel)
+        rows, n_novel = compact_rows(rows, novel)
+        return rows, pool_arr, n_used, n_novel, plane
+
+    return jax.jit(fused_step if fused else step)
+
+
 class DevicePipeline:
     """Corpus-on-device mutation engine producing exec-ready bytes."""
 
@@ -421,17 +546,9 @@ class DevicePipeline:
         import jax.numpy as jnp
         from jax import random
 
-        from syzkaller_tpu.ops import rng as d
         from syzkaller_tpu.ops.insert import DonorBank, choice_table_rows
-        from syzkaller_tpu.ops.mutate import _mutate_one
-        from syzkaller_tpu.ops.pallas_mutate import (
-            make_pallas_mutate_pack,
-            resolve_mutate_backend,
-        )
-        from syzkaller_tpu.ops.signal import (
-            mutant_novelty,
-            resolve_mutant_plane_bits,
-        )
+        from syzkaller_tpu.ops.pallas_mutate import resolve_mutate_backend
+        from syzkaller_tpu.ops.signal import resolve_mutant_plane_bits
 
         self._jax = jax
         self._jnp = jnp
@@ -474,118 +591,25 @@ class DevicePipeline:
         self._by_syscall_dev = jnp.asarray(self.bank.by_syscall)
         n_blocks = len(self.bank)
 
-        B, R = batch_size, rounds
-        pack = make_packer(self.spec)
-        pool = make_compact_pooler(self.spec, B)
-        p_insert = P_INSERT_GIVEN_DEVICE if n_blocks > 0 else 0.0
-        runs = self._runs_dev
-        by_syscall = self._by_syscall_dev
-        nid = runs_np.shape[0]
-
-        def sample_insert(st, k):
-            """Donor + position for an insert mutant: ChoiceTable
-            categorical over the context call's prefix-sum prio row
-            (reference: prog/prio.go:230-245) + biased-to-end insert
-            position (reference: prog/mutation.go:79)."""
-            k_ctx, k_x, k_fb, k_pos = random.split(k, 4)
-            alive = st["call_alive"]
-            ctx_slot = d.masked_choice(k_ctx, alive)
-            ctx_id = st["call_id"][jnp.maximum(ctx_slot, 0)]
-            row = runs[jnp.clip(ctx_id, 0, nid - 1)]
-            x = (d.intn(k_x, jnp.maximum(row[-1], 1).astype(jnp.int64))
-                 .astype(jnp.uint32) + 1)
-            sid = jnp.searchsorted(row, x)
-            donor = by_syscall[jnp.clip(sid, 0, nid - 1)]
-            donor = jnp.where(
-                donor < 0,
-                d.intn(k_fb, max(n_blocks, 1)).astype(jnp.int32), donor)
-            n_alive = alive.sum().astype(jnp.int32)
-            pos = d.biased_rand(k_pos, st["call_alive"].shape[0] + 1, 5) \
-                .astype(jnp.int32)
-            pos = jnp.minimum(pos, n_alive)
-            # Respect the program-length budget: a full template
-            # falls back to the mutate class.
-            ok = n_alive < max_insert_calls
-            return donor, pos.astype(jnp.uint8), ok
-
         # Mutation-core backend (ISSUE 10, docs/perf.md "The mutation
         # core"): Pallas grid-over-batch kernels on TPU (real branch
         # dispatch per grid cell), the bit-exact vmap path everywhere
         # else or on TZ_MUTATE_BACKEND=vmap.
         self._backend = resolve_mutate_backend(backend)
         _M_MUTATE_BACKEND.set(1 if self._backend == "pallas" else 0)
-        pallas_pack = make_pallas_mutate_pack(self.spec, R) \
-            if self._backend == "pallas" else None
-
-        def sample_and_pack(corpus, n, key, flag_vals, flag_counts):
-            """Template sampling + per-row class draws + the mutation
-            core, shared by the fused and unfused step graphs.  The
-            class/donor sampling stays a (tiny) vmap on both backends
-            and splits each row key exactly as the pre-Pallas fused
-            vmap did, so every backend/fusion combination consumes
-            the same threefry stream."""
-            k_idx, k_mut = random.split(key)
-            idx = (random.bits(k_idx, (B,), dtype=jnp.uint32)
-                   % jnp.maximum(n, 1).astype(jnp.uint32)).astype(jnp.int32)
-            batch = {k: v[idx] for k, v in corpus.items()}
-            keys = random.split(k_mut, B)
-
-            def classes(st, k):
-                k_class, k_ins, k_mut1 = random.split(k, 3)
-                is_insert = d.intn(k_class, 1 << 20) < int(
-                    p_insert * (1 << 20))
-                donor, pos, ins_ok = sample_insert(st, k_ins)
-                is_insert = is_insert & ins_ok
-                op = jnp.where(is_insert, jnp.uint8(1), jnp.uint8(0))
-                donor = jnp.where(is_insert, donor, jnp.int32(-1))
-                return op, donor, pos, k_mut1
-
-            op, donor, pos, mut_keys = jax.vmap(classes)(batch, keys)
-            if pallas_pack is not None:
-                return pallas_pack(batch, jax.random.key_data(mut_keys),
-                                   idx, op, donor, pos,
-                                   flag_vals, flag_counts)
-
-            def one(st, k, i, o, dn, po):
-                mutated = _mutate_one(st, k, flag_vals, flag_counts, R)
-                # Insert mutants keep the TEMPLATE structure: the
-                # packer masks the value/data journals by op, and the
-                # alive bitmap must be the unmutated one.
-                mutated["call_alive"] = jnp.where(
-                    o != 0, st["call_alive"], mutated["call_alive"])
-                return pack(mutated, i, op=o, donor=dn, pos=po)
-
-            return jax.vmap(one)(batch, mut_keys, idx, op, donor, pos)
-
-        def step(corpus: dict, n: int, key, flag_vals, flag_counts):
-            rows, payloads, needs = sample_and_pack(
-                corpus, n, key, flag_vals, flag_counts)
-            return pool(rows, payloads, needs)
-
-        def fused_step(corpus: dict, n: int, key, flag_vals,
-                       flag_counts, plane):
-            """mutate -> emit-compact -> novel_any as ONE dispatch
-            (ISSUE 10): the mutant plane drops already-seen rows ON
-            DEVICE — they claim no pool slot and are compacted out of
-            the row prefix, so a non-novel mutant never crosses D2H.
-            Returns (rows compacted novel-first, pool prefix, n_used,
-            n_novel, updated plane)."""
-            rows, payloads, needs = sample_and_pack(
-                corpus, n, key, flag_vals, flag_counts)
-            novel, plane = mutant_novelty(plane, rows)
-            # Pool claims happen on the PRE-compaction row order, so
-            # pool_idx is already embedded in each row's bytes and
-            # survives the reorder below.
-            rows, pool_arr, n_used = pool(rows, payloads, needs & novel)
-            rows, n_novel = compact_rows(rows, novel)
-            return rows, pool_arr, n_used, n_novel, plane
 
         # TZ_PIPELINE_FUSED=0 is the kill switch back to the
         # full-batch drain (every row ships, no mutant plane).
         self._fused = env_int("TZ_PIPELINE_FUSED", 1) != 0
         self._plane_bits = resolve_mutant_plane_bits()
         self._mutant_plane = None  # device plane; built at first launch
-        self._step = jax.jit(fused_step if self._fused else step)
+        # The step executable is keyed on the static shape only — the
+        # prio/donor tables ride along as traced arguments at dispatch
+        # (self._runs_dev / self._by_syscall_dev), so engines at the
+        # same shape share one compile (_shared_step).
+        self._step = _shared_step(self.spec, batch_size, rounds,
+                                  self._backend, self._fused,
+                                  n_blocks, max_insert_calls)
 
         self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
         # In-flight device dispatches the worker keeps ahead of the
@@ -671,6 +695,10 @@ class DevicePipeline:
         # a half-open ring rebuild must also invalidate the signal
         # plane (attach_triage wires it).
         self.triage_engine = None
+        # Fault-domain mesh engine (parallel/fault_domain): when
+        # attached, health_snapshot carries the per-shard breaker
+        # states so bench_watch's wedge diagnostics see chip loss.
+        self._mesh_engine = None
         self._have_corpus = threading.Event()
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._worker_loop,
@@ -703,6 +731,16 @@ class DevicePipeline:
         invalidation on host-snapshot ring rebuilds."""
         self.triage_engine = engine
 
+    def attach_mesh(self, engine) -> None:
+        """Register the co-resident fault-domain mesh engine
+        (parallel/fault_domain.MeshEngine): its per-shard health rides
+        this pipeline's health_snapshot, and if a triage engine is
+        also attached the mesh seeds its signal authority from the
+        same host mirror."""
+        self._mesh_engine = engine
+        if self.triage_engine is not None:
+            engine.attach_triage(self.triage_engine)
+
     def health_snapshot(self) -> dict:
         """Breaker + watchdog state for tests and the status page."""
         out = {
@@ -718,6 +756,8 @@ class DevicePipeline:
         }
         if self.triage_engine is not None:
             out["triage"] = self.triage_engine.snapshot()
+        if self._mesh_engine is not None:
+            out["mesh"] = self._mesh_engine.health_snapshot()
         return out
 
     # -- corpus management -------------------------------------------------
@@ -886,8 +926,10 @@ class DevicePipeline:
         def dispatch():
             fault_point(op)
             if self._fused:
-                return self._step(corpus, n, sub, fv, fc, plane)
-            return self._step(corpus, n, sub, fv, fc)
+                return self._step(corpus, n, sub, fv, fc, plane,
+                                  self._runs_dev, self._by_syscall_dev)
+            return self._step(corpus, n, sub, fv, fc,
+                              self._runs_dev, self._by_syscall_dev)
 
         # Spans time the host-observed dispatch (XLA returns async:
         # steady-state launch is enqueue cost; the blocking transfer

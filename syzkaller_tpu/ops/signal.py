@@ -168,6 +168,15 @@ def hash_rows(rows):
     return jax.lax.fori_loop(0, rows.shape[1], body, h0)
 
 
+def fold_mutant_idx(h, bits: int):
+    """Fold a row hash into its mutant-plane bucket index.  Shared by
+    the single-device mutant_novelty and the cov-sharded mesh step so
+    bucket assignment is identical on both paths — a mesh re-shard
+    rebuilt from the host mirror keeps the exact same dedup state."""
+    return ((h ^ (h >> jnp.uint32(bits)))
+            & jnp.uint32((1 << bits) - 1)).astype(jnp.int32)
+
+
 def mutant_novelty(plane, rows):
     """Cross-batch mutant dedup vs the mutant plane: fold each row's
     FNV hash into the plane, flag rows whose bucket is unseen, mark
@@ -179,8 +188,7 @@ def mutant_novelty(plane, rows):
     repeat is rare and harmless, it just ships twice once)."""
     bits = int(plane.shape[0]).bit_length() - 1
     h = hash_rows(rows)
-    idx = ((h ^ (h >> jnp.uint32(bits)))
-           & jnp.uint32(plane.shape[0] - 1)).astype(jnp.int32)
+    idx = fold_mutant_idx(h, bits)
     novel = plane[idx] == 0
     return novel, plane.at[idx].set(jnp.uint8(1))
 

@@ -1,0 +1,515 @@
+"""Fault-domain mesh engine: per-shard health + graceful chip loss.
+
+The single-device pipeline became self-healing in PR 1 (breaker +
+watchdog + host-snapshot rebuild) and the control plane in PR 8; this
+module gives the multi-chip mesh the same discipline.  Every chip of
+the ('batch','cov') mesh is its own FAULT DOMAIN — a per-shard
+`health.CircuitBreaker` and `health.Watchdog` registered per device —
+and the engine degrades gracefully instead of dying with the chip:
+
+  demote      a failed collective launch triggers a per-shard probe
+              sweep (`mesh.shard_probe` seam, shards probed in index
+              order so a fault plan can script exactly which chip is
+              "dead"); a blamed shard's breaker records the failure
+              and, once it OPENS, the shard is demoted.
+  re-shard    the fused mutate→emit-compact→novel_any graph is
+              rebuilt over the surviving N−1 devices, and BOTH device
+              planes are re-uploaded cov-sharded from host authority:
+              the uint8[2^26] signal plane from the exact host mirror
+              (the PR 4 rebuild path, now shard-aware — the mirror is
+              merged on host at every accept, so chip loss loses zero
+              signal), the TZ_MUTANT_PLANE_BITS mutant plane from its
+              cadence-synced mirror (dedup-only state: staleness
+              re-admits a few duplicates, never loses work).
+  conserve    the staged batch is host-owned until its launch
+              completes, so in-flight work on the dead shard simply
+              re-dispatches with the retry onto the survivors — zero
+              lost corpus programs.
+  re-promote  a demoted shard's breaker goes half-open after backoff;
+              a successful probe re-admits the chip and re-shards the
+              planes back up to the full mesh.
+
+Jitted step graphs are cached per live-topology, so the demote →
+serve-from-N−1 → re-promote cycle compiles exactly the two expected
+meshes and steady state adds zero new jits (pinned by the tier-1
+compile-count guard).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import random
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health import CircuitBreaker, Watchdog, fault_point
+from syzkaller_tpu.health.envsafe import env_float, env_int
+from syzkaller_tpu.ops import signal as dsig
+from syzkaller_tpu.parallel import mesh as pmesh
+from syzkaller_tpu.utils import log
+
+_M_LIVE = telemetry.gauge(
+    "tz_mesh_devices_live", "devices currently serving in the mesh")
+_M_DEMOTED = telemetry.gauge(
+    "tz_mesh_devices_demoted", "devices demoted out of the mesh")
+_M_DEMOTE = telemetry.counter(
+    "tz_mesh_demote_total", "shard demotions (breaker-open chip loss)")
+_M_REPROMOTE = telemetry.counter(
+    "tz_mesh_repromote_total", "shard re-admissions after half-open probe")
+_M_RESHARD = telemetry.counter(
+    "tz_mesh_reshard_total", "plane re-shards (topology rebuilds)")
+_M_RESHARD_TS = telemetry.gauge(
+    "tz_mesh_last_reshard_ts", "wallclock of the last plane re-shard")
+_M_STEPS = telemetry.counter(
+    "tz_mesh_steps_total", "fused mesh steps completed")
+
+#: breaker state -> tz_mesh_shard_breaker_state gauge value
+_BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+class ShardDomain:
+    """One chip's fault domain: device + breaker + watchdog."""
+
+    __slots__ = ("index", "device", "breaker", "watchdog", "demoted",
+                 "demote_ts", "last_error", "_state_gauge")
+
+    def __init__(self, index: int, device, breaker: CircuitBreaker,
+                 watchdog: Watchdog):
+        self.index = index
+        self.device = device
+        self.breaker = breaker
+        self.watchdog = watchdog
+        self.demoted = False
+        self.demote_ts: Optional[float] = None
+        self.last_error: Optional[str] = None
+        self._state_gauge = telemetry.gauge(
+            "tz_mesh_shard_breaker_state",
+            "per-shard breaker state (0=closed 1=half_open 2=open)",
+            labels={"shard": str(index)})
+
+    def publish(self) -> None:
+        self._state_gauge.set(
+            _BREAKER_STATE_CODE.get(self.breaker.state, 2))
+
+    def snapshot(self) -> dict:
+        return {
+            "index": self.index,
+            "device": str(self.device),
+            "demoted": self.demoted,
+            "breaker": self.breaker.snapshot(),
+            "last_error": self.last_error,
+        }
+
+
+class MeshEngine:
+    """The fault-domain multi-chip drain (see module docstring).
+
+    step(batch, edges, nedges, prios) runs one fused launch over the
+    live mesh and returns per-shard novel delta rows + the signal
+    verdicts for the incoming edges.  All device state is a cache of
+    host authority, so any subset of chips can die between (or
+    during) steps without losing corpus or signal.
+    """
+
+    def __init__(self, devices=None, cov: Optional[int] = None,
+                 rounds: int = 4, spec=None,
+                 plane_size: int = dsig.PLANE_SIZE,
+                 mutant_bits: Optional[int] = None,
+                 breaker_threshold: Optional[int] = None,
+                 max_retries: int = 3, mutant_sync_every: int = 16,
+                 flags=None, seed: int = 0, clock=time.monotonic):
+        from syzkaller_tpu.ops.delta import DeltaSpec
+        from syzkaller_tpu.ops.tensor import FlagTables
+
+        if flags is None:
+            flags = FlagTables.empty()
+        self._flags = (np.asarray(flags.vals), np.asarray(flags.counts))
+
+        if devices is None:
+            devices = list(jax.devices())
+            want = env_int("TZ_MESH_DEVICES", 0)
+            if want > 0:
+                devices = devices[:want]
+        if not devices:
+            raise ValueError("MeshEngine needs at least one device")
+        self._cov_req = max(1, env_int("TZ_MESH_COV", 1)
+                            if cov is None else cov)
+        self.rounds = rounds
+        self.spec = spec or DeltaSpec()
+        self.plane_size = plane_size
+        self.mutant_bits = (dsig.resolve_mutant_plane_bits()
+                            if mutant_bits is None else int(mutant_bits))
+        self.max_retries = max(1, max_retries)
+        self._clock = clock
+        self._key = random.key(seed)
+        self._step_no = 0
+
+        threshold = max(1, env_int("TZ_BREAKER_THRESHOLD", 4)
+                        if breaker_threshold is None
+                        else breaker_threshold)
+        # Per-shard watchdog deadline: TZ_MESH_WATCHDOG_DEADLINE_S
+        # overrides independently of the single-device pipeline's
+        # TZ_WATCHDOG_DEADLINE_S (a collective launch waits on the
+        # slowest chip, so mesh deployments often want more headroom).
+        deadline = env_float(
+            "TZ_MESH_WATCHDOG_DEADLINE_S",
+            env_float("TZ_WATCHDOG_DEADLINE_S", 30.0))
+        self.domains = [
+            ShardDomain(i, dev,
+                        CircuitBreaker(failure_threshold=threshold,
+                                       seed=seed + i),
+                        Watchdog(deadline_s=deadline))
+            for i, dev in enumerate(devices)]
+        # Leader watchdog bounding the collective launch itself.
+        self.watchdog = Watchdog(
+            deadline_s=deadline,
+            compile_deadline_s=env_float("TZ_WATCHDOG_COMPILE_S", 600.0))
+
+        # Host authority the re-shard rebuilds from: the signal-plane
+        # mirror is EXACT (merged on host at every accept), the
+        # mutant-plane mirror is cadence-synced (dedup-only state).
+        self._mirror = np.zeros(plane_size, dtype=np.uint8)
+        self._mmirror = np.zeros(1 << self.mutant_bits, dtype=np.uint8)
+        self._mutant_sync_every = max(1, mutant_sync_every)
+        self._steps_since_msync = 0
+
+        self._lock = threading.RLock()
+        self._graphs: dict = {}  # live-topology key -> (mesh, step)
+        self._compiled_keys: set = set()
+        self._plane_dev = None
+        self._mplane_dev = None
+        self._last_reshard: Optional[float] = None
+        self.triage = None
+        self._build()
+
+    # -- topology ---------------------------------------------------------
+
+    def _live(self) -> list:
+        return [d for d in self.domains if not d.demoted]
+
+    def _fit_cov(self, n: int) -> int:
+        c = min(self._cov_req, n)
+        while c > 1 and (n % c or self.plane_size % c
+                         or (1 << self.mutant_bits) % c):
+            c -= 1
+        return max(1, c)
+
+    def _build(self) -> None:
+        live = self._live()
+        if not live:
+            raise RuntimeError("mesh engine has no live devices left")
+        key = tuple(d.index for d in live)
+        entry = self._graphs.get(key)
+        if entry is None:
+            devs = [d.device for d in live]
+            m = pmesh.make_mesh(devs, self._fit_cov(len(devs)))
+            step = pmesh.make_fused_mesh_step(
+                m, spec=self.spec, rounds=self.rounds,
+                plane_size=self.plane_size,
+                mutant_bits=self.mutant_bits)
+            entry = self._graphs[key] = (m, step)
+        self._mesh, self._step_fn = entry
+        self._topology_key = key
+        for d in live:
+            telemetry.SHARD_PROFILER.ensure(d.index)
+        # Re-shard both planes from host authority, cov-sharded over
+        # the (possibly shrunken) live mesh.
+        sh = NamedSharding(self._mesh, P("cov"))
+        self._plane_dev = jax.device_put(jnp.asarray(self._mirror), sh)
+        self._mplane_dev = jax.device_put(jnp.asarray(self._mmirror), sh)
+        self._last_reshard = self._clock()
+        _M_RESHARD.inc()
+        _M_RESHARD_TS.set(time.time())
+        _M_LIVE.set(len(live))
+        _M_DEMOTED.set(len(self.domains) - len(live))
+        for d in self.domains:
+            d.publish()
+        telemetry.record_event(
+            "mesh.reshard",
+            f"live={len(live)}/{len(self.domains)} cov="
+            f"{self._mesh.shape['cov']}")
+
+    # -- integration ------------------------------------------------------
+
+    def attach_triage(self, engine) -> None:
+        """Co-use the production TriageEngine's host mirror as this
+        engine's signal authority seed; push local discoveries back
+        with sync_triage()."""
+        self.triage = engine
+        with self._lock:
+            np.maximum(self._mirror, engine.mirror_copy(),
+                       out=self._mirror)
+            self._build()
+
+    def sync_triage(self) -> None:
+        """Merge this engine's signal authority into the attached
+        triage engine (idempotent max-merge)."""
+        if self.triage is not None:
+            self.triage.absorb_plane(self._mirror)
+
+    # -- the fused step ---------------------------------------------------
+
+    def step(self, batch: dict, edges, nedges, prios,
+             template_idx=None) -> dict:
+        """One fused mesh launch over the staged batch; retries over
+        rebuilt (possibly degraded) topologies until it lands.  The
+        batch stays host-owned until a launch succeeds, so a chip
+        death mid-flight conserves all staged work."""
+        with self._lock:
+            self._try_repromote()
+            step_key = random.fold_in(self._key, self._step_no)
+            self._step_no += 1
+            attempts = 0
+            while True:
+                try:
+                    fault_point("device.launch")
+                    with telemetry.span("mesh.step"):
+                        out = self._attempt(batch, edges, nedges,
+                                            prios, template_idx,
+                                            step_key)
+                    break
+                except Exception as e:  # noqa: BLE001 — attributed below
+                    attempts += 1
+                    blamed = self._attribute(e)
+                    resharded = self._demote_opened()
+                    if resharded:
+                        self._build()
+                    if attempts >= self.max_retries + len(self.domains):
+                        raise
+                    if not blamed and not resharded \
+                            and attempts >= self.max_retries:
+                        raise
+                    log.logf(1, "mesh step retry %d after %r "
+                                "(blamed=%s resharded=%s)",
+                             attempts, e,
+                             [d.index for d in blamed], resharded)
+            self._absorb_success(out)
+            return out
+
+    def _pad(self, n_batch: int, batch, edges, nedges, prios,
+             template_idx):
+        B = int(np.asarray(nedges).shape[0])
+        pad = (-B) % n_batch
+        tidx = np.arange(B, dtype=np.int32) if template_idx is None \
+            else np.asarray(template_idx, dtype=np.int32)
+        if pad:
+            def padrow(a):
+                a = np.asarray(a)
+                return np.concatenate(
+                    [a, np.repeat(a[:1], pad, axis=0)], axis=0)
+
+            batch = {k: padrow(v) for k, v in batch.items()}
+            edges = padrow(edges)
+            prios = padrow(prios)
+            tidx = padrow(tidx)
+            # Pad rows carry zero edges, so they can never merge
+            # signal; their mutant rows are sliced off below.
+            nedges = np.concatenate(
+                [np.asarray(nedges),
+                 np.zeros(pad, dtype=np.asarray(nedges).dtype)])
+        return B, batch, edges, nedges, prios, tidx
+
+    def _attempt(self, batch, edges, nedges, prios, template_idx,
+                 step_key) -> dict:
+        m = self._mesh
+        n_batch = m.shape["batch"]
+        B, batch_p, edges_p, nedges_p, prios_p, tidx = self._pad(
+            n_batch, batch, edges, nedges, prios, template_idx)
+        fv = jnp.asarray(self._flags[0])
+        fc = jnp.asarray(self._flags[1])
+
+        def launch():
+            out = self._step_fn(
+                {k: jnp.asarray(v) for k, v in batch_p.items()},
+                self._plane_dev, self._mplane_dev,
+                jnp.asarray(edges_p), jnp.asarray(nedges_p),
+                jnp.asarray(prios_p), step_key, fv, fc,
+                jnp.asarray(tidx))
+            # The sync point: per-shard novel counts gate everything
+            # the host fetches, exactly like the fused pipeline drain.
+            jax.block_until_ready(out[3])
+            return out
+
+        first = self._topology_key not in self._compiled_keys
+        t0 = self._clock()
+        rows, pool_arr, n_used, n_novel, new_counts, plane, mplane = \
+            self.watchdog.call(launch, "mesh.launch", compile=first)
+        if not first:
+            # A collective launch completes at the pace of its
+            # slowest chip, so every live shard shares the batch's
+            # host-observed residency (bench --profile isolates
+            # per-chip probes for the differentiated view).
+            elapsed = self._clock() - t0
+            for d in self._live():
+                telemetry.SHARD_PROFILER.note(d.index, elapsed)
+        self._compiled_keys.add(self._topology_key)
+
+        n_novel_np = np.asarray(n_novel)
+        n_used_np = np.asarray(n_used)
+        Bp = int(np.asarray(nedges_p).shape[0])
+        per = Bp // n_batch
+        pool_slots = self.spec.pool_slots(per)
+        novel_rows, pool_blocks = [], []
+        for s in range(n_batch):
+            k = int(n_novel_np[s])
+            novel_rows.append(np.asarray(rows[s * per:s * per + k]))
+            u = int(n_used_np[s])
+            pool_blocks.append(np.asarray(
+                pool_arr[s * pool_slots:s * pool_slots + u]))
+        return {
+            "novel_rows": novel_rows,
+            "pool_blocks": pool_blocks,
+            "n_novel": n_novel_np,
+            "n_used": n_used_np,
+            "new_counts": np.asarray(new_counts)[:B],
+            "_planes": (plane, mplane),
+            "_inputs": (np.asarray(edges_p)[:B],
+                        np.asarray(nedges_p)[:B],
+                        np.asarray(prios_p)[:B], B),
+        }
+
+    def _absorb_success(self, out: dict) -> None:
+        plane, mplane = out.pop("_planes")
+        self._plane_dev, self._mplane_dev = plane, mplane
+        edges, nedges, prios, B = out.pop("_inputs")
+        # Exact host-mirror merge of the accepted programs' edges —
+        # the merge the device just did, replayed on the authority,
+        # so a later re-shard rebuilds the identical plane.
+        accept = out["new_counts"] > 0
+        if accept.any():
+            E = edges.shape[1]
+            valid = (np.arange(E)[None, :] < nedges[:, None]) \
+                & accept[:, None]
+            idx = dsig.fold_hash_np(edges[valid])
+            np.maximum.at(self._mirror, idx,
+                          (np.repeat(prios, E).reshape(B, E)[valid]
+                           + 1).astype(np.uint8))
+        # Cadence-synced mutant-plane mirror (dedup-only state).
+        self._steps_since_msync += 1
+        if self._steps_since_msync >= self._mutant_sync_every:
+            self.sync_mutant_mirror()
+        for d in self._live():
+            d.breaker.record_success()
+            d.publish()
+        _M_STEPS.inc()
+
+    def sync_mutant_mirror(self) -> None:
+        """Pull the device mutant plane into its host mirror (best
+        effort: a dying chip mid-fetch just leaves the mirror stale,
+        which only re-admits duplicates)."""
+        try:
+            self._mmirror = np.asarray(self._mplane_dev)
+            self._steps_since_msync = 0
+        except Exception as e:  # noqa: BLE001
+            log.logf(1, "mutant-mirror sync failed (stale mirror "
+                        "kept): %r", e)
+
+    # -- failure attribution / demote / re-promote ------------------------
+
+    def _probe(self, dom: ShardDomain) -> None:
+        """Tiny device round-trip pinning liveness of ONE chip.  The
+        `mesh.shard_probe` seam fires once per probed shard in index
+        order, so occurrence-indexed fault plans script exactly which
+        chip is dead."""
+        fault_point("mesh.shard_probe")
+        x = jax.device_put(np.int32(dom.index), dom.device)
+        if int(x) != dom.index:
+            raise RuntimeError(f"probe mismatch on shard {dom.index}")
+
+    def _attribute(self, exc: Exception) -> list:
+        """Per-shard probe sweep after a failed collective launch."""
+        blamed = []
+        for dom in self._live():
+            try:
+                dom.watchdog.call(lambda d=dom: self._probe(d),
+                                  "mesh.shard_probe")
+            except Exception as e:  # noqa: BLE001
+                dom.last_error = repr(e)
+                dom.breaker.record_failure()
+                blamed.append(dom)
+            dom.publish()
+        if not blamed:
+            log.logf(1, "mesh launch failed but every shard probe "
+                        "passed (transient collective failure): %r",
+                     exc)
+        return blamed
+
+    def _demote_opened(self) -> bool:
+        changed = False
+        for dom in self._live():
+            if dom.breaker.is_open():
+                dom.demoted = True
+                dom.demote_ts = self._clock()
+                changed = True
+                _M_DEMOTE.inc()
+                telemetry.record_event(
+                    "mesh.shard_demote",
+                    f"shard={dom.index} device={dom.device} "
+                    f"err={dom.last_error}")
+                log.logf(0, "mesh shard %d demoted (%s)", dom.index,
+                         dom.last_error)
+        return changed
+
+    def _try_repromote(self) -> bool:
+        changed = False
+        for dom in self.domains:
+            if not dom.demoted or not dom.breaker.allow():
+                continue
+            dom.breaker.consume_rebuild()
+            try:
+                dom.watchdog.call(lambda d=dom: self._probe(d),
+                                  "mesh.shard_probe")
+            except Exception as e:  # noqa: BLE001
+                dom.last_error = repr(e)
+                dom.breaker.record_failure()
+                dom.publish()
+                continue
+            dom.breaker.record_success()
+            dom.demoted = False
+            dom.demote_ts = None
+            changed = True
+            _M_REPROMOTE.inc()
+            telemetry.record_event(
+                "mesh.shard_repromote",
+                f"shard={dom.index} device={dom.device}")
+            log.logf(0, "mesh shard %d re-admitted", dom.index)
+        if changed:
+            # Freshen the mutant mirror from the surviving mesh
+            # before re-sharding back up, then rebuild at full width.
+            self.sync_mutant_mirror()
+            self._build()
+        return changed
+
+    # -- introspection ----------------------------------------------------
+
+    def mirror_plane(self) -> np.ndarray:
+        """The signal-plane host authority (read-only view for tests
+        and parity checks)."""
+        return self._mirror
+
+    def health_snapshot(self) -> dict:
+        with self._lock:
+            live = self._live()
+            return {
+                "devices_total": len(self.domains),
+                "devices_live": len(live),
+                "devices_demoted": len(self.domains) - len(live),
+                "cov": int(self._mesh.shape["cov"]),
+                "compat_impl": _compat_impl_name(),
+                "last_reshard_age_s": (
+                    None if self._last_reshard is None
+                    else round(self._clock() - self._last_reshard, 3)),
+                "shards": [d.snapshot() for d in self.domains],
+            }
+
+
+def _compat_impl_name() -> str:
+    from syzkaller_tpu.parallel import compat
+
+    return compat.impl_name()

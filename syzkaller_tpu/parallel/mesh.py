@@ -11,6 +11,11 @@ combined with a psum over 'cov' (each folded bucket lives in exactly
 one shard, so the sum is exact).  Merging accepted edges pmaxes the
 plane over 'batch' so replicas stay identical.  Collectives ride ICI;
 nothing crosses the host.
+
+All sharded steps go through `parallel.compat.shard_map`, which
+probes the running jax build at first use (native jax.shard_map ->
+experimental shard_map -> nested-vmap emulation) — this module never
+imports a shard_map API at load time.
 """
 
 from __future__ import annotations
@@ -26,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from syzkaller_tpu.ops import signal as dsig
 from syzkaller_tpu.ops.mutate import _mutate_one
+from syzkaller_tpu.parallel import compat
 
 
 def _batch_spec(mesh: Mesh):
@@ -84,7 +90,7 @@ def make_plane_host_sync(mesh: Mesh):
     def local(plane_l):
         return lax.pmax(plane_l, "host")
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(compat.shard_map(
         local, mesh=mesh, in_specs=(P("cov"),), out_specs=P("cov"),
         check_vma=False))
 
@@ -169,7 +175,7 @@ def make_sharded_fuzz_step(mesh: Mesh, rounds: int = 4, plane_size: int = dsig.P
 
     batch_spec = _batch_spec(mesh)
     step = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             local_step, mesh=mesh,
             in_specs=(batch_spec, P("cov"), batch_spec, batch_spec,
                       batch_spec, P(), P(), P()),
@@ -207,10 +213,124 @@ def make_sharded_pack_step(mesh: Mesh, spec=None, rounds: int = 4):
         return make_pooler(spec, b)(rows, payloads, needs)
 
     bspec = _batch_spec(mesh)
-    return jax.jit(jax.shard_map(
+    return jax.jit(compat.shard_map(
         local, mesh=mesh,
         in_specs=(bspec, P(), P(), P(), bspec),
         out_specs=bspec, check_vma=False))
+
+
+def make_fused_mesh_step(mesh: Mesh, spec=None, rounds: int = 4,
+                         plane_size: int = dsig.PLANE_SIZE,
+                         mutant_bits: int = dsig.MUTANT_PLANE_BITS_DEFAULT):
+    """The multi-chip fused drain: ONE launch over the mesh runs
+    triage -> mutate -> emit(pack) -> mutant-plane dedup -> compact —
+    the mesh form of DevicePipeline's fused step (ISSUE 10), with the
+    signal plane AND the mutant novelty plane sharded over 'cov'.
+
+    step(batch, plane, mplane, edges, nedges, prios, key,
+         flag_vals, flag_counts, tidx)
+      -> (rows, pool, n_used, n_novel, new_counts, plane, mplane)
+
+    where rows are each shard's delta rows compacted novel-first,
+    pool the claimed payload slots, n_used/n_novel int32[1] per shard
+    (global shape [n_batch_shards]), and new_counts the per-program
+    signal novelty of the INCOMING edges (the executor feedback for
+    the previous batch, reference loop proc.go:66-98).
+
+    Both novelty families ride a single psum over 'cov': the local
+    partial signal counts and the local mutant-plane freshness are
+    stacked into one int32[2, b] operand, so the flush leader feeds N
+    chips with exactly one collective before the merge pmaxes.  Each
+    folded bucket (signal or mutant) is owned by exactly one 'cov'
+    shard, so the sum is exact for both."""
+    from syzkaller_tpu.ops.delta import (
+        DeltaSpec,
+        compact_rows,
+        make_compact_pooler,
+        make_packer,
+    )
+
+    spec = spec or DeltaSpec()
+    pack = make_packer(spec)
+    n_cov = mesh.shape["cov"]
+    shard = plane_size // n_cov
+    msize = 1 << mutant_bits
+    mshard = msize // n_cov
+    has_host = "host" in mesh.axis_names
+
+    def local_step(batch, plane_l, mplane_l, edges, nedges, prios,
+                   key, flag_vals, flag_counts, tidx):
+        # --- triage incoming edges vs my signal-plane shard ---
+        cov_idx = lax.axis_index("cov")
+        base = cov_idx.astype(jnp.int32) * shard
+        idx = dsig.fold_hash(edges)
+        local = (idx >= base) & (idx < base + shard)
+        seen = plane_l[jnp.clip(idx - base, 0, shard - 1)]
+        E = edges.shape[1]
+        valid = jnp.arange(E)[None, :] < nedges[:, None]
+        sentinel = plane_size + jnp.arange(E, dtype=jnp.int32)[None, :]
+        didx = jnp.where(valid, idx, sentinel)
+        uniq = dsig._unique_mask(didx)
+        new_local = (seen < (prios[:, None] + 1)) & valid & local & uniq
+        sig_partial = new_local.sum(axis=1).astype(jnp.int32)
+
+        # --- mutate + pack my batch shard (emit) ---
+        b = batch["kind"].shape[0]
+        key = random.fold_in(key, _global_shard_idx(mesh))
+        keys = random.split(key, b)
+
+        def one(st, k, i):
+            return pack(_mutate_one(st, k, flag_vals, flag_counts,
+                                    rounds), i)
+
+        rows, payloads, needs = jax.vmap(one)(batch, keys, tidx)
+
+        # --- mutant dedup vs my mutant-plane shard ---
+        h = dsig.hash_rows(rows)
+        midx = dsig.fold_mutant_idx(h, mutant_bits)
+        mbase = cov_idx.astype(jnp.int32) * mshard
+        mown = (midx >= mbase) & (midx < mbase + mshard)
+        mfresh = (mplane_l[jnp.clip(midx - mbase, 0, mshard - 1)] == 0) \
+            & mown
+
+        # --- the single collective: both families, one psum ---
+        combined = lax.psum(
+            jnp.stack([sig_partial, mfresh.astype(jnp.int32)]), "cov")
+        new_counts = combined[0]
+        novel = combined[1] > 0
+
+        # --- merge accepted edges into my shard; pmax over 'batch' ---
+        accept = new_counts > 0
+        contrib = valid & local & accept[:, None]
+        val = jnp.where(contrib, prios[:, None] + 1, 0).astype(jnp.uint8)
+        plane_l = plane_l.at[jnp.clip(idx - base, 0, shard - 1)
+                             .reshape(-1)].max(val.reshape(-1))
+        plane_l = lax.pmax(plane_l, "batch")
+        # --- mark novel mutants' buckets; pmax over 'batch' ---
+        mval = (novel & mown).astype(jnp.uint8)
+        mplane_l = mplane_l.at[jnp.clip(midx - mbase, 0, mshard - 1)
+                               ].max(mval)
+        mplane_l = lax.pmax(mplane_l, "batch")
+        if has_host:
+            plane_l = lax.pmax(plane_l, "host")
+            mplane_l = lax.pmax(mplane_l, "host")
+
+        # --- emit-compact: claims on pre-compaction order, then the
+        # novel-first prefix (non-novel rows never cross D2H) ---
+        rows, pool_arr, n_used = make_compact_pooler(spec, b)(
+            rows, payloads, needs & novel)
+        rows, n_novel = compact_rows(rows, novel)
+        return (rows, pool_arr, n_used.reshape(1), n_novel.reshape(1),
+                new_counts, plane_l, mplane_l)
+
+    bspec = _batch_spec(mesh)
+    return jax.jit(compat.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(bspec, P("cov"), P("cov"), bspec, bspec, bspec,
+                  P(), P(), P(), bspec),
+        out_specs=(bspec, bspec, bspec, bspec, bspec,
+                   P("cov"), P("cov")),
+        check_vma=False))
 
 
 def unshard_delta(flat: np.ndarray, mesh: Mesh, spec=None) -> list:

@@ -134,11 +134,16 @@ def reset() -> None:
 from syzkaller_tpu.telemetry import lineage  # noqa: E402
 from syzkaller_tpu.telemetry.profiler import (  # noqa: E402
     KernelProfiler,
+    ShardProfiler,
 )
 
 #: Process-wide per-kernel device-time attribution
 #: (tz_device_kernel_ms_per_batch{kernel=...}).
 PROFILER = KernelProfiler()
+
+#: Process-wide per-shard mesh device-time attribution
+#: (tz_mesh_shard_ms_per_batch{shard=...}, parallel/fault_domain).
+SHARD_PROFILER = ShardProfiler()
 
 # The coverage intelligence layer (ISSUE 7): growth curve, novelty
 # EWMA, plateau detector, per-lane attribution.  Same late-import
@@ -166,6 +171,8 @@ __all__ = [
     "PROFILER",
     "REGISTRY",
     "Registry",
+    "SHARD_PROFILER",
+    "ShardProfiler",
     "TRACE",
     "TraceWriter",
     "lineage",

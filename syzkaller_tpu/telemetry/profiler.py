@@ -92,3 +92,59 @@ class KernelProfiler:
             return {k: {"ms_per_batch": round(self._ewma[k], 4),
                         "batches": self._counts[k]}
                     for k in KERNELS}
+
+
+class ShardProfiler:
+    """Per-SHARD device-time EWMAs for the fault-domain mesh
+    (parallel/fault_domain), exported as
+    `tz_mesh_shard_ms_per_batch{shard=...}` — the same labeled-gauge
+    family pattern as KernelProfiler, keyed by mesh shard index
+    instead of kernel name.
+
+    Slots are created by ensure() when the mesh engine (re)builds its
+    topology — never on the hot path — so note() in steady state
+    touches only pre-allocated slots, keeping the zero-allocation
+    contract the compile/container-growth guards pin."""
+
+    __slots__ = ("_lock", "_ewma", "_counts", "_gauges")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ewma: dict = {}
+        self._counts: dict = {}
+        self._gauges: dict = {}
+
+    def ensure(self, shard: int) -> None:
+        """Pre-allocate the slot for a shard index (topology build
+        time, not the hot path)."""
+        from syzkaller_tpu import telemetry
+
+        with self._lock:
+            if shard in self._ewma:
+                return
+            self._ewma[shard] = 0.0
+            self._counts[shard] = 0
+            self._gauges[shard] = telemetry.gauge(
+                "tz_mesh_shard_ms_per_batch",
+                "host-observed per-shard device time per mesh batch "
+                "(EWMA ms)", labels={"shard": str(shard)})
+
+    def note(self, shard: int, seconds: float) -> None:
+        """One batch's host-observed residency for one shard.
+        Unknown shards are ignored (the fixed-slot contract)."""
+        if shard not in self._ewma:
+            return
+        ms = seconds * 1e3
+        with self._lock:
+            n = self._counts[shard]
+            self._counts[shard] = n + 1
+            prev = self._ewma[shard]
+            cur = ms if n == 0 else prev + EWMA_ALPHA * (ms - prev)
+            self._ewma[shard] = cur
+        self._gauges[shard].set(cur)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {str(s): {"ms_per_batch": round(self._ewma[s], 4),
+                             "batches": self._counts[s]}
+                    for s in sorted(self._ewma)}

@@ -251,6 +251,34 @@ def wedge_report(snap: dict) -> list[str]:
         if dropped:
             line += f", {int(dropped)} inputs dropped"
         lines.append(line)
+    # Fault-domain mesh health (ISSUE 11): topology width, per-shard
+    # breaker states, and the last re-shard age — a demoted shard
+    # shows here as e.g. "3:open" while the engine keeps serving from
+    # N−1, so chip loss and a wedge are distinguishable at a glance.
+    mesh_live = gauges.get("tz_mesh_devices_live") or 0
+    mesh_demoted = gauges.get("tz_mesh_devices_demoted") or 0
+    if mesh_live or mesh_demoted:
+        line = (f"mesh: {int(mesh_live)} live / "
+                f"{int(mesh_demoted)} demoted")
+        states = {}
+        for k, v in gauges.items():
+            if k.startswith('tz_mesh_shard_breaker_state{'):
+                shard = k.split('shard="', 1)[1].rstrip('"}')
+                states[int(shard)] = {0: "closed", 1: "half_open",
+                                      2: "open"}.get(int(v), "?")
+        if states:
+            line += ", shards " + " ".join(
+                f"{s}:{st}" for s, st in sorted(states.items()))
+        reshard_ts = gauges.get("tz_mesh_last_reshard_ts") or 0
+        if reshard_ts:
+            age = max(0.0, (snap.get("ts") or time.time()) - reshard_ts)
+            line += f", last re-shard {age:.0f}s ago"
+        demotes = counters.get("tz_mesh_demote_total") or 0
+        repromotes = counters.get("tz_mesh_repromote_total") or 0
+        if demotes or repromotes:
+            line += (f" ({int(demotes)} demotions, "
+                     f"{int(repromotes)} re-admissions)")
+        lines.append(line)
     attr = {}
     for k, v in counters.items():
         if k.startswith('tz_coverage_novel_edges_total{') and v:

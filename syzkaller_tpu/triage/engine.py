@@ -375,6 +375,28 @@ class TriageEngine:
             self._ensure_plane_locked()
             return self._plane_dev
 
+    def mirror_copy(self) -> np.ndarray:
+        """Copy of the host-mirror rebuild authority.  The fault-domain
+        mesh engine (parallel/fault_domain.MeshEngine) seeds its own
+        re-shard source from this, so a chip-loss re-shard rebuilds
+        from exactly the signal this engine has accepted."""
+        with self._merge_lock:
+            return self._mirror.copy()
+
+    def share_plane_sharded(self, mesh):
+        """The rebuild authority uploaded cov-sharded over a mesh —
+        the shard-aware form of the PR 4 host-mirror rebuild path.
+        Unlike share_plane() this always uploads fresh from the
+        mirror (the caller is re-sharding after a topology change, so
+        any cached single-device plane is the wrong layout)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        with self._merge_lock:
+            mirror = self._mirror.copy()
+        return jax.device_put(
+            mirror, NamedSharding(mesh, PartitionSpec("cov")))
+
     def absorb_plane(self, plane) -> None:
         """Max-merge an externally updated plane (a mesh step's
         output) back into the mirror.  Only valid when the absorbed

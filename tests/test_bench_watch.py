@@ -191,6 +191,35 @@ def test_wedge_report_control_plane_line():
                    for ln in bw.wedge_report(_wedge_snapshot()))
 
 
+def test_wedge_report_mesh_health_line():
+    """The fault-domain mesh line (ISSUE 11): topology width,
+    per-shard breaker states, re-shard age, and the demotion /
+    re-admission totals render so a demoted chip is visible at a
+    glance while the engine keeps serving from N-1."""
+    import time as _time
+
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    reg.gauge("tz_mesh_devices_live").set(7)
+    reg.gauge("tz_mesh_devices_demoted").set(1)
+    for shard, state in ((0, 0), (3, 2), (5, 1)):
+        reg.gauge("tz_mesh_shard_breaker_state",
+                  labels={"shard": str(shard)}).set(state)
+    reg.gauge("tz_mesh_last_reshard_ts").set(_time.time() - 42)
+    reg.counter("tz_mesh_demote_total").inc(2)
+    reg.counter("tz_mesh_repromote_total").inc(1)
+    lines = bw.wedge_report(reg.snapshot())
+    line = next(ln for ln in lines if ln.startswith("mesh:"))
+    assert "7 live / 1 demoted" in line
+    assert "shards 0:closed 3:open 5:half_open" in line
+    assert "last re-shard 42s ago" in line
+    assert "(2 demotions, 1 re-admissions)" in line
+    # a snapshot without mesh gauges renders no line
+    assert not any(ln.startswith("mesh:")
+                   for ln in bw.wedge_report(_wedge_snapshot()))
+
+
 def test_wedge_report_stalled_coverage_line():
     """ISSUE 7: the coverage trajectory renders next to the health
     layers — occupancy + novelty rate, the STALLED verdict, plane

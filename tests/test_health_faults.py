@@ -814,6 +814,66 @@ def test_fused_mutation_core_zero_new_jits_on_warm_pipeline(device_rig):
         "fused drain retraced after warmup"
 
 
+def test_mesh_reshard_topology_cache_compile_guard(monkeypatch):
+    """ISSUE 11 compile-count guard: the fault-domain engine caches
+    jitted step graphs per live-topology, so the demote -> serve-from-
+    N-1 -> re-promote cycle builds exactly the two expected meshes and
+    any topology REVISIT is a pure cache hit (zero new jits).  The
+    graph builder is stubbed with a counter so this pins the caching
+    policy without burning device compiles; the chaos drill in
+    test_mesh_faults asserts the same counts on real jitted graphs."""
+    import jax
+
+    from syzkaller_tpu.parallel import fault_domain as fd
+    from syzkaller_tpu.parallel import mesh as pmesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+    builds = []
+
+    def counting_builder(mesh, **kw):
+        builds.append(int(mesh.devices.size))
+
+        def _stub_step(*a, **k):
+            raise AssertionError("stub step must never launch")
+        return _stub_step
+
+    monkeypatch.setattr(pmesh, "make_fused_mesh_step", counting_builder)
+    eng = fd.MeshEngine(devices=jax.devices()[:8], cov=1, rounds=1,
+                        plane_size=1 << 16, mutant_bits=10,
+                        breaker_threshold=1, seed=3)
+    assert builds == [8]
+    # Zero backoff BEFORE tripping: the probe time is fixed at trip.
+    for d in eng.domains:
+        d.breaker.configure_backoff(initial=0.0, cap=0.0)
+
+    # Chip 5 "dies": its breaker opens, the shard demotes, and the
+    # engine re-shards over the surviving seven.
+    dom = eng.domains[5]
+    dom.breaker.record_failure()
+    assert dom.breaker.is_open()
+    assert eng._demote_opened()
+    eng._build()
+    assert builds == [8, 7]
+    snap = eng.health_snapshot()
+    assert snap["devices_live"] == 7
+    assert snap["shards"][5]["demoted"]
+
+    # Half-open probe re-admits the chip: the full-width topology was
+    # already built, so re-promotion must be a cache hit.
+    assert eng._try_repromote()
+    assert eng.health_snapshot()["devices_live"] == 8
+    assert builds == [8, 7], "re-promote retraced the full mesh"
+
+    # The SAME chip dying again revisits the N-1 graph: cache hit too.
+    dom.breaker.record_failure()
+    assert eng._demote_opened()
+    eng._build()
+    assert builds == [8, 7], "revisited topology retraced"
+    assert len(eng._graphs) == 2
+
+
 def test_coverage_analytics_zero_new_jits_on_warm_rig(device_rig):
     """ISSUE 7 compile-count guard: the coverage analytics kernels
     compile exactly ONCE (pinned plane shape) and the per-batch hot

@@ -45,7 +45,11 @@ def _random_comp_map(rs: np.random.RandomState, nkeys: int,
 
 def test_shrink_expand_parity_random():
     rs = np.random.RandomState(7)
-    for it in range(30):
+    # 12 iterations still sweep small and large key counts plus the
+    # hit/truncation value classes; the batch kernel recompiles per
+    # distinct vals length, so each extra iteration costs a compile
+    # the tier-1 ceiling can't carry.
+    for it in range(12):
         cm = _random_comp_map(rs, nkeys=rs.randint(1, 12))
         dmap = DeviceCompMap.from_comp_map(cm)
         assert dmap.overflow is None
@@ -82,7 +86,10 @@ def test_mutate_with_hints_device_matches_cpu(test_target):
     """Whole-call parity: identical mutant sequence from both engines."""
     rs = np.random.RandomState(3)
     checked = 0
-    for seed in range(40):
+    # 15 seeds clear the checked>50 floor several times over; each
+    # extra seed re-pays ~2.5s of device dispatch for the same parity
+    # property, and the tier-1 ceiling can't carry 40.
+    for seed in range(15):
         p = generate_prog(test_target, RandGen(test_target, 500 + seed), 3)
         cm = _random_comp_map(rs, nkeys=6)
         # Make hits likely: compare some actual arg values.

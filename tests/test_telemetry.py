@@ -351,3 +351,38 @@ def test_grab_stats_conserves_counts_under_concurrency():
     # and the registry mirror holds the same monotonic total
     name = "tz_fuzzer_exec_fuzz_total"
     assert telemetry.REGISTRY.counter(name).value >= drained
+
+
+# -- ShardProfiler (fault-domain mesh, ISSUE 11) ------------------------
+
+
+def test_shard_profiler_fixed_slots_and_ewma():
+    """ShardProfiler mirrors the KernelProfiler contract: slots are
+    pre-allocated by ensure() at topology-build time, note() on an
+    unknown shard is a no-op (zero-allocation hot path), the first
+    sample seeds the EWMA exactly, and the labeled gauge family
+    carries one series per shard."""
+    from syzkaller_tpu.telemetry.profiler import EWMA_ALPHA, ShardProfiler
+
+    prof = ShardProfiler()
+    prof.ensure(0)
+    prof.ensure(3)
+    prof.ensure(3)  # idempotent
+
+    prof.note(0, 0.010)
+    assert prof.snapshot()["0"] == {"ms_per_batch": 10.0, "batches": 1}
+    prof.note(0, 0.020)
+    want = 10.0 + EWMA_ALPHA * (20.0 - 10.0)
+    got = prof.snapshot()["0"]
+    assert got["batches"] == 2
+    assert abs(got["ms_per_batch"] - want) < 1e-6
+
+    # unknown shard: ignored, no slot materializes
+    prof.note(7, 0.5)
+    assert set(prof.snapshot()) == {"0", "3"}
+    assert prof.snapshot()["3"] == {"ms_per_batch": 0.0, "batches": 0}
+
+    # the labeled series exists in the global registry family
+    g = telemetry.REGISTRY.gauge("tz_mesh_shard_ms_per_batch",
+                                 labels={"shard": "0"})
+    assert g.full_name == 'tz_mesh_shard_ms_per_batch{shard="0"}'
