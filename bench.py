@@ -31,6 +31,10 @@ Modes:
                                    # occupancy popcount + heat map +
                                    # drift audit cost at the full
                                    # plane shape, novelty-rate EWMA
+  python bench.py --serve          # serving-plane composer overhead
+                                   # (host-only): ms/batch scheduling
+                                   # tax, tenants-per-chip break-even,
+                                   # per-tenant novelty share
 """
 
 from __future__ import annotations
@@ -561,6 +565,97 @@ def bench_coverage(seen_edges=1 << 18, reps=20, novel_checks=40,
             round(snap["novelty_rate_ewma"], 4),
         "coverage_novel_edges_total": snap["novel_edges_total"],
         "coverage_stalled": int(snap["stalled"]),
+    }
+
+
+def bench_serve(tenants=6, batches=60, batch_rows=4096,
+                row_bytes=64, demand_rows=5020,
+                supply_rate=8947.0) -> dict:
+    """Serving-plane composer bench (ISSUE 12, serve/): host-only —
+    the composer, broker, and per-tenant planes are pure host code,
+    and what this measures is the SCHEDULING overhead the serving
+    plane adds per fused batch, not the drain itself.
+
+    `tenants` session tenants post a fixed per-poll demand
+    (`demand_rows`, the ~5,020 execs/s per-VM demand artifact), a
+    scripted host drain supplies random rows, and the composer fills
+    `batches` batches.  Reports `serve_compose_overhead_ms_per_batch`
+    (compose+distribute wall time minus the drain itself — the tax on
+    the 8,947/s supply), the demand-side tenants-per-chip break-even
+    (supply_rate / demand rate), and the per-tenant novelty share the
+    QoS credits converged to (docs/perf.md "The serving plane")."""
+    import numpy as np
+
+    from syzkaller_tpu.serve import BatchComposer, ServePlane, TenantPlanes
+
+    rng = np.random.RandomState(29)
+    names = [f"vm{i}" for i in range(tenants)]
+    broker = ServePlane(lease_s=3600.0, queue_cap=batch_rows * 4,
+                        max_tenants=tenants)
+    planes = TenantPlanes(bits=18)
+    drain_s = [0.0]
+
+    def drain(n):
+        t0 = time.perf_counter()
+        rows = rng.randint(0, 256, size=(n, row_bytes)).astype(np.uint8)
+        arena = rows.tobytes()
+        payloads = [memoryview(arena)[j * row_bytes:(j + 1) * row_bytes]
+                    for j in range(n)]
+        drain_s[0] += time.perf_counter() - t0
+        return rows, payloads
+
+    comp = BatchComposer(broker, planes, drain, batch_rows=batch_rows,
+                         rebalance_s=0.0, stall_window_s=3600.0)
+    seqs = {}
+    for name in names:
+        broker.Connect({"name": name})
+        seqs[name] = 0
+
+    def poll_all():
+        # Keep demand fresh and queues drained so headroom never
+        # throttles composition (the steady-state serving shape).
+        for name in names:
+            seqs[name] += 1
+            broker.Poll({"name": name, "epoch": broker.epoch,
+                         "seq": seqs[name], "ack_seq": seqs[name] - 1,
+                         "demand": {"backlog": demand_rows,
+                                    "exec_rate": supply_rate / tenants}})
+
+    poll_all()
+    comp.compose_once()  # warm the planes/gauges out of the timing
+    poll_all()
+    total_rows = 0
+    novel_by_tenant = {name: 0 for name in names}
+    drain_s[0] = 0.0
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        report = comp.compose_once()
+        total_rows += report.get("rows", 0)
+        for name, tr in (report.get("tenants") or {}).items():
+            novel_by_tenant[name] += tr["novel"]
+        poll_all()
+    wall_s = time.perf_counter() - t0
+    compose_ms = 1e3 * wall_s / batches
+    overhead_ms = 1e3 * (wall_s - drain_s[0]) / batches
+    total_novel = sum(novel_by_tenant.values()) or 1
+    return {
+        "serve_tenants": tenants,
+        "serve_batches": batches,
+        "serve_rows_total": total_rows,
+        "serve_compose_ms_per_batch": round(compose_ms, 3),
+        "serve_compose_overhead_ms_per_batch": round(overhead_ms, 3),
+        "serve_rows_per_sec": round(total_rows / max(wall_s, 1e-9)),
+        # Demand-side break-even: how many full-demand VMs one chip's
+        # measured supply covers — the number continuous batching is
+        # meant to raise by spending rows only where demand is.
+        "serve_tenants_per_chip_full_demand":
+            round(supply_rate / demand_rows, 2),
+        "serve_novelty_share": {
+            name: round(n / total_novel, 4)
+            for name, n in sorted(novel_by_tenant.items())},
+        "serve_credits": {
+            name: round(t.credit, 4) for name, t in
+            sorted(broker.tenants.items())},
     }
 
 
@@ -1170,6 +1265,15 @@ def main() -> None:
         res = {"metric": "coverage_analytics_ms_per_flush",
                "unit": "ms/flush", **bench_coverage()}
         res["value"] = res["coverage_analytics_ms_per_flush"]
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
+    if "--serve" in argv:
+        res = {"metric": "serve_compose_overhead_ms_per_batch",
+               "unit": "ms/batch", **bench_serve()}
+        res["value"] = res["serve_compose_overhead_ms_per_batch"]
         if platform:
             res["platform"] = platform
         journal_append(res)

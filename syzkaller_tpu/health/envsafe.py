@@ -60,6 +60,15 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_RPC_BACKOFF_S",
     "TZ_RPC_REPLY_CACHE",
     "TZ_RPC_RETRIES",
+    "TZ_SERVE_COMPOSE_INTERVAL_S",
+    "TZ_SERVE_CREDIT_DECAY",
+    "TZ_SERVE_CREDIT_FLOOR",
+    "TZ_SERVE_LEASE_S",
+    "TZ_SERVE_MAX_TENANTS",
+    "TZ_SERVE_PLANE_BITS",
+    "TZ_SERVE_QUEUE_CAP",
+    "TZ_SERVE_REBALANCE_S",
+    "TZ_SERVE_STALL_WINDOW_S",
     "TZ_TELEMETRY_SNAPSHOT",
     "TZ_TRACE_FILE",
     "TZ_TRACE_SAMPLE",

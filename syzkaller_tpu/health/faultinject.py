@@ -50,6 +50,7 @@ SEAMS = (
     "manager.lease_expire",
     "queue.put",
     "mesh.shard_probe",
+    "serve.compose",
 )
 
 MODES = ("fail", "hang")

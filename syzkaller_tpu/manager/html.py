@@ -81,6 +81,13 @@ def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
                     # series from poll telemetry.
                     self._send(json.dumps(_coverage_payload(mgr)),
                                "application/json")
+                elif url.path == "/api/serve":
+                    # Serving plane (ISSUE 12, serve/broker.py):
+                    # tenant leases, demand/queue custody, QoS
+                    # credits, plus the per-tenant novelty-plane
+                    # analytics when planes are wired in.
+                    self._send(json.dumps(_serve_payload(mgr)),
+                               "application/json")
                 elif url.path == "/api/stats":
                     # Machine-readable superset of /stats: the manager
                     # rollup plus the full telemetry snapshot
@@ -184,6 +191,39 @@ def _coverage_section(mgr) -> str:
             f"<p><a href='/api/coverage'>coverage.json</a></p>")
 
 
+def _serve_payload(mgr) -> dict:
+    """The /api/serve body: the broker snapshot plus per-tenant
+    novelty-plane analytics (serve/plane.py) when attached."""
+    payload = {"serve": mgr.serve_plane.snapshot()}
+    planes = getattr(mgr, "serve_planes", None)
+    if planes is not None:
+        payload["planes"] = planes.analytics()
+    return payload
+
+
+def _serve_section(mgr) -> str:
+    """Summary-page rollup of the serving plane: one row per tenant
+    with its demand, queue custody, credit, and plateau verdict."""
+    snap = mgr.serve_plane.snapshot()
+    tenants = snap.get("tenants") or {}
+    if not tenants:
+        return ""
+    rows = "".join(
+        f"<tr><td>{html.escape(name)}</td>"
+        f"<td>{t['demand_rows']}</td><td>{t['queued']}</td>"
+        f"<td>{t['inflight']}</td><td>{t['credit']:.3f}</td>"
+        f"<td>{'stalled' if t['stalled'] else 'ok'}</td>"
+        f"<td>{t['rows_spent']}</td><td>{t['delivered']}</td></tr>"
+        for name, t in sorted(tenants.items()))
+    return (f"<h3>Serving plane</h3>"
+            f"<table><tr><th>tenant</th><th>demand</th><th>queued</th>"
+            f"<th>inflight</th><th>credit</th><th>state</th>"
+            f"<th>rows</th><th>delivered</th></tr>{rows}</table>"
+            f"<p>reaped {snap.get('reaped', 0)}, replays "
+            f"{snap.get('replays', 0)} &middot; "
+            f"<a href='/api/serve'>serve.json</a></p>")
+
+
 def _call_name(prog_line: str) -> str:
     """First call name of a serialized program line ('r0 = open(...)'
     or 'open(...)')."""
@@ -255,6 +295,7 @@ def _summary_page(mgr) -> str:
                     f"<td>{'yes' if entry.repro_done else ''}</td>"
                     f"<td><a href='/report?id={sig}'>report</a></td></tr>")
     body = (f"<table>{rows}</table>{health}{control}"
+            f"{_serve_section(mgr)}"
             f"{_coverage_section(mgr)}"
             f"<h3>Crashes</h3>"
             f"<table><tr><th>title</th><th>count</th><th>repro</th>"

@@ -90,6 +90,14 @@ class Manager:
         self._load_corpus()
         self.rpc_server = RPCServer(parse_addr(cfg.rpc))
         self.rpc_server.register("Manager", self.serv)
+        # Serving plane (ISSUE 12): the multi-tenant request broker
+        # rides the same transport under the "Serve" name; its
+        # per-tenant admission quotas scale off the Manager throttle.
+        from syzkaller_tpu.serve.broker import ServePlane
+
+        self.serve_plane = ServePlane(
+            throttle_fn=self.serv.throttle_state)
+        self.rpc_server.register("Serve", self.serve_plane)
         self.rpc_server.serve_in_background()
         self.rpc_addr = self.rpc_server.addr
 
@@ -297,6 +305,9 @@ class Manager:
         # counts, admission-control state, per-fuzzer custody — the
         # status page's "is the fleet healthy" block.
         s["control_plane"] = self.serv.control_snapshot()
+        # Serving-plane rollup (ISSUE 12): tenant leases, demand,
+        # queue custody, credits — the /api/serve body verbatim.
+        s["serve"] = self.serve_plane.snapshot()
         return s
 
     def start_bench(self, path: str, period_s: float = 60.0) -> None:
@@ -372,6 +383,7 @@ class Manager:
             # but a fleet that stops calling entirely still needs its
             # dead leases collected (and their work requeued).
             self.serv.reap_expired()
+            self.serve_plane.reap_expired()
             self._maybe_run_repro(fuzzer_cmd_fn)
             self.stop_ev.wait(1.0)
         for t in threads:

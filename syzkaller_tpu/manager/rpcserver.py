@@ -377,6 +377,14 @@ class ManagerRPC:
         with self._lock:
             self._reap_locked()
 
+    def throttle_state(self) -> str:
+        """Current admission-control tier — the serving plane's
+        broker (serve/broker.ServePlane) scales per-tenant allotments
+        from this, so individual tenants shrink before the global
+        breaker trips."""
+        with self._lock:
+            return self._throttle_locked()
+
     def control_snapshot(self) -> dict:
         """Control-plane rollup for the status page / bench snapshots."""
         with self._lock:

@@ -283,15 +283,23 @@ class AssembledBatch(list):
     """One drained batch of ExecMutants.  A plain list to consumers;
     additionally carries the drain sequence number so delivery
     ordering across the assembly pool is observable (tests, and the
-    bench's supply-ordering assertions), and the batch's lineage
-    trace context (None = unsampled)."""
+    bench's supply-ordering assertions), the batch's lineage trace
+    context (None = unsampled), and — when the serving plane composed
+    this batch from multiple tenants' demand (serve/composer.py) —
+    the per-row tenant-id column (`tenants`, int32[B] indices into
+    the composer's tenant order; None for single-consumer drains):
+    row j's mutant belongs to tenant tenants[j], and result
+    distribution must honor that or it is the cross-tenant leak the
+    serve conservation test forbids."""
 
-    __slots__ = ("seq", "trace")
+    __slots__ = ("seq", "trace", "tenants")
 
-    def __init__(self, mutants=(), seq: int = -1, trace=None):
+    def __init__(self, mutants=(), seq: int = -1, trace=None,
+                 tenants=None):
         super().__init__(mutants)
         self.seq = seq
         self.trace = trace
+        self.tenants = tenants
 
 
 class _AssemblyTask:

@@ -37,6 +37,15 @@ _FLAG_ZLIB = 1
 #: the receive side records the `rpc.frame` hop and parks the context
 #: in a thread-local for the dispatched method (Manager.NewInput).
 _FLAG_TRACE = 2
+#: The frame carries a binary annex: an 8-byte length follows the
+#: header (after any trace context), and that many raw bytes follow
+#: the JSON payload.  This is the zero-copy result-distribution path
+#: (ISSUE 12): the serving plane ships assembled mutants as arena
+#: memoryviews written straight to the socket — the JSON reply holds
+#: only (offset, length) refs into the annex, and no per-mutant copy
+#: happens on either side of the compress/JSON machinery.
+_FLAG_ANNEX = 4
+_ANNEX = struct.Struct("<Q")
 _COMPRESS_MIN = 4 << 10
 _MAX_FRAME = 512 << 20
 
@@ -96,7 +105,8 @@ class _PeerClosed(ConnectionError):
     error."""
 
 
-def _send_frame(sock: socket.socket, obj: Any, trace=None) -> None:
+def _send_frame(sock: socket.socket, obj: Any, trace=None,
+                annex=None) -> None:
     # Fault seam: a scripted `fail` here raises FaultInjected (a
     # ConnectionError), driving the client's reconnect/retry path and
     # the server's connection-drop path exactly as a real peer death
@@ -112,9 +122,23 @@ def _send_frame(sock: socket.socket, obj: Any, trace=None) -> None:
         if trace is not None and trace.sampled:
             flags |= _FLAG_TRACE
             header = lineage.to_wire(trace)
+        # `annex`: one bytes-like or a sequence of them.  The parts
+        # are sent as-is, one sendall each — memoryviews into batch
+        # arenas go straight to the socket, never joined or copied.
+        parts = []
+        annex_len = 0
+        if annex is not None:
+            parts = [annex] if isinstance(annex, (bytes, bytearray,
+                                                  memoryview)) \
+                else list(annex)
+            annex_len = sum(len(p) for p in parts)
+            flags |= _FLAG_ANNEX
+            header += _ANNEX.pack(annex_len)
         sock.sendall(_FRAME.pack(len(data), flags) + header + data)
+        for part in parts:
+            sock.sendall(part)
     _M_FRAMES_SENT.inc()
-    _M_BYTES_SENT.inc(_FRAME.size + len(header) + len(data))
+    _M_BYTES_SENT.inc(_FRAME.size + len(header) + len(data) + annex_len)
 
 
 def _recv_exact(sock: socket.socket, n: int,
@@ -130,9 +154,11 @@ def _recv_exact(sock: socket.socket, n: int,
     return buf
 
 
-def _recv_frame(sock: socket.socket) -> Any:
+def _recv_frame(sock: socket.socket, want_annex: bool = False) -> Any:
     fault_point("rpc.recv_frame")
     trace_bytes = 0
+    annex = None
+    annex_len = 0
     with telemetry.span("rpc.recv"):
         hdr = _recv_exact(sock, _FRAME.size, at_boundary=True)
         length, flags = _FRAME.unpack(hdr)
@@ -142,15 +168,27 @@ def _recv_frame(sock: socket.socket) -> Any:
         if flags & _FLAG_TRACE:
             trace_bytes = lineage.WIRE.size
             ctx = lineage.from_wire(_recv_exact(sock, trace_bytes))
+        if flags & _FLAG_ANNEX:
+            annex_len, = _ANNEX.unpack(
+                _recv_exact(sock, _ANNEX.size))
+            if annex_len > _MAX_FRAME:
+                raise RPCError(f"oversized annex ({annex_len} bytes)")
         data = _recv_exact(sock, length)
         if flags & _FLAG_ZLIB:
             data = zlib.decompress(data)
+        # The annex is drained even when the caller did not ask for
+        # it — it belongs to this frame and must not bleed into the
+        # next one's header.
+        if annex_len:
+            annex = _recv_exact(sock, annex_len)
     # Park the decoded context (None clears a stale one) so the
     # dispatched method on this thread can continue the chain.
     lineage.set_current(ctx)
     _M_FRAMES_RECV.inc()
-    _M_BYTES_RECV.inc(_FRAME.size + trace_bytes + length)
-    return json.loads(data)
+    _M_BYTES_RECV.inc(_FRAME.size + trace_bytes + length + annex_len
+                      + (_ANNEX.size if flags & _FLAG_ANNEX else 0))
+    obj = json.loads(data)
+    return (obj, annex) if want_annex else obj
 
 
 def _setup_keepalive(sock: socket.socket) -> None:
@@ -210,7 +248,8 @@ class RPCServer:
                 while True:
                     req = _recv_frame(conn)
                     resp = self._dispatch(req)
-                    _send_frame(conn, resp)
+                    annex = resp.pop("_annex", None)
+                    _send_frame(conn, resp, annex=annex)
         except _PeerClosed:
             # Clean hangup between frames: normal peer churn (a
             # transient call finishing, a fuzzer VM restarting) —
@@ -235,6 +274,13 @@ class RPCServer:
             if fn is None:
                 raise RPCError(f"unknown method {method!r}")
             result = fn(req.get("params") or {})
+            # A handler returning (dict, annex) ships the annex as
+            # the reply frame's zero-copy binary tail; "_annex" is an
+            # out-of-band key the connection loop pops before the
+            # JSON encode ever sees it.
+            if isinstance(result, tuple) and len(result) == 2:
+                result, annex = result
+                return {"id": rid, "result": result, "_annex": annex}
             return {"id": rid, "result": result}
         except FaultInjected:
             # A scripted seam fault inside a handler models the server
@@ -334,10 +380,12 @@ class RPCClient:
         return sock
 
     def call(self, method: str, params: Optional[dict] = None,
-             trace=None) -> Any:
+             trace=None, want_annex: bool = False) -> Any:
         """`trace` (a lineage.TraceContext) rides the request frame's
         header so the server side can correlate this call into the
-        mutant's lifecycle track (telemetry/lineage.py)."""
+        mutant's lifecycle track (telemetry/lineage.py).  With
+        `want_annex` the return value is (result, annex_bytes) —
+        annex_bytes is None when the reply carried no binary tail."""
         with self._lock:
             self._next_id += 1
             req = {"id": self._next_id, "method": method,
@@ -359,7 +407,8 @@ class RPCClient:
                         raise
                     continue
                 try:
-                    resp = _recv_frame(self._sock)
+                    resp, annex = _recv_frame(self._sock,
+                                              want_annex=True)
                 except (ConnectionError, OSError):
                     self.close()
                     raise
@@ -368,10 +417,11 @@ class RPCClient:
                 if resp.get("error_kind") == "reconnect_required":
                     raise ReconnectRequired(resp["error"])
                 raise RPCError(resp["error"])
-            return resp.get("result")
+            result = resp.get("result")
+            return (result, annex) if want_annex else result
 
     def call_session(self, method: str, params: Optional[dict] = None,
-                     trace=None) -> Any:
+                     trace=None, want_annex: bool = False) -> Any:
         """A mutating call under the idempotency session: tags the
         params with (name, epoch, seq, ack_seq) and retries with
         exponential backoff + jitter across connection failures —
@@ -387,7 +437,8 @@ class RPCClient:
         params = dict(params or {})
         params.setdefault("name", self.name)
         if self.epoch is None:
-            return self.call(method, params, trace=trace)
+            return self.call(method, params, trace=trace,
+                             want_annex=want_annex)
         seq = self._next_seq()
         params["seq"] = seq
         attempts = max(1, self.retries + 1)
@@ -398,7 +449,8 @@ class RPCClient:
             with self._seq_lock:
                 params["ack_seq"] = self._acked
             try:
-                result = self.call(method, params, trace=trace)
+                result = self.call(method, params, trace=trace,
+                                   want_annex=want_annex)
             except ReconnectRequired:
                 # Stale epoch or reaped lease: only a full resync can
                 # recover.  Bounded separately from connection retries

@@ -1,0 +1,25 @@
+"""Multi-tenant serving plane (ISSUE 12).
+
+Continuous batching of many fuzzer VMs onto the one fused
+mutate→emit-compact→novel_any drain: demand flows up through the
+sessioned "Serve" RPC (broker.ServePlane), QoS credits turn per-tenant
+novelty EWMAs into row shares (composer.BatchComposer), per-tenant
+novelty planes keep one tenant's occupancy from poisoning another's
+verdicts (plane.TenantPlanes), and results ship back zero-copy as
+reply-annex views (client.ServeTenant).  docs/perf.md "The serving
+plane" has the anatomy and the tenants-per-chip math.
+"""
+
+from syzkaller_tpu.serve.broker import SERVE_QUOTA, ServePlane, TenantState
+from syzkaller_tpu.serve.client import ServeTenant
+from syzkaller_tpu.serve.composer import BatchComposer
+from syzkaller_tpu.serve.plane import TenantPlanes
+
+__all__ = [
+    "SERVE_QUOTA",
+    "BatchComposer",
+    "ServePlane",
+    "ServeTenant",
+    "TenantPlanes",
+    "TenantState",
+]

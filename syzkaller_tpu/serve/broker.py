@@ -1,0 +1,423 @@
+"""ServePlane: the multi-tenant request broker of the serving plane.
+
+The "Serve" RPC receiver (registered next to "Manager" on the same
+transport): many fuzzer VMs (tenants) multiplex their mutation demand
+onto one chip's fused drain.  The session discipline is the PR 8
+control plane verbatim — Connect mints a (session-epoch, lease) pair;
+Poll carries (name, epoch, seq, ack_seq); a bounded per-tenant reply
+cache replays duplicate seqs so post-send retries never double-
+deliver; leases idle past TZ_SERVE_LEASE_S are reaped with their
+reply caches tombstoned — because the serving plane inherits the same
+failure modes (VM death, lost replies, manager restarts) and must
+give the same answer: at-most-once delivery, zero lost work.
+
+What is new here is the demand/supply ledger:
+
+  * every Poll carries a demand estimate — the tenant's candidate
+    backlog plus its exec-rate, EWMA-smoothed broker-side — which the
+    batch composer (serve/composer.py) turns into per-tenant row
+    allocations,
+  * produced mutants land in per-tenant bounded queues (the bound
+    shapes COMPOSITION — the composer never produces more than a
+    tenant's queue can hold — so nothing is ever dropped on the
+    floor),
+  * delivery custody mirrors the PR 8 candidate ledger in reverse:
+    results ride a reply keyed by its seq in `inflight` until the
+    tenant's ack_seq confirms receipt; an abandoned reply (ack_seq
+    skipped the seq) returns its results to the FRONT of the queue,
+    so kill/reconnect churn reorders but never loses or duplicates,
+  * admission quotas extend the PR 8 throttle from protect-the-chip
+    to shape-the-fleet: the per-poll allotment is the throttle tier's
+    row budget scaled by the tenant's QoS credit, so individual
+    tenants shrink before the global breaker trips and a plateaued
+    tenant decays to the credit floor instead of starving.
+
+Results ship zero-copy: each pending item's payload is a bytes-like
+view into its batch arena (ops/pipeline ExecMutant custom), and the
+reply's binary annex (rpc.py _FLAG_ANNEX) concatenates those views on
+the socket without a per-mutant copy — the JSON carries only
+(tenant, rid, offset, length) refs into the annex.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health.envsafe import env_float, env_int
+from syzkaller_tpu.rpc.rpc import ReconnectRequired
+from syzkaller_tpu.utils import log
+
+#: Admission tiers (docs/health.md): throttle state -> total result
+#: rows a single poll may carry, BEFORE the per-tenant credit scale.
+#: "open" still trickles so a recovering tenant has probe work.
+SERVE_QUOTA = {"closed": 4096, "half_open": 1024, "open": 256}
+#: Reaped tenants' reply caches kept around (bounded, same rationale
+#: as manager/rpcserver._MAX_TOMBSTONES).
+_MAX_TOMBSTONES = 64
+#: EWMA weight for the exec-rate demand smoother (the same
+#: settling-vs-straggler tradeoff as telemetry/coverage.EWMA_ALPHA).
+EWMA_ALPHA = 0.2
+
+_M_REPLAYS = telemetry.counter(
+    "tz_serve_replays_total",
+    "duplicate (epoch, seq) serve polls answered from the reply cache")
+_M_REAPED = telemetry.counter(
+    "tz_serve_leases_reaped_total",
+    "tenant leases reaped after TZ_SERVE_LEASE_S without a poll")
+_M_REQUEUED = telemetry.counter(
+    "tz_serve_results_requeued_total",
+    "delivered-but-unacked results returned to the tenant queue")
+_M_DROPPED = telemetry.counter(
+    "tz_serve_results_dropped_total",
+    "undelivered results discarded when their tenant's lease was "
+    "reaped")
+_M_ANNEX_BYTES = telemetry.counter(
+    "tz_serve_annex_bytes_total",
+    "zero-copy result payload bytes shipped in reply annexes")
+_G_TENANTS = telemetry.gauge(
+    "tz_serve_tenants", "tenants holding a live serve lease")
+_G_DEMAND = telemetry.gauge(
+    "tz_serve_demand_rows",
+    "aggregate outstanding tenant demand in rows (backlog minus "
+    "queued+inflight results)")
+
+
+class TenantState:
+    """One tenant's queues, session, demand, and QoS bookkeeping."""
+
+    __slots__ = ("name", "last_seen", "reply_cache", "pending",
+                 "inflight", "demand_rows", "exec_rate_ewma",
+                 "novelty_ewma", "last_novel_ts", "stalled", "credit",
+                 "rows_spent", "delivered", "q_gauge", "c_gauge",
+                 "m_rows", "m_results")
+
+    def __init__(self, name: str, now: float):
+        self.name = name
+        self.last_seen = now
+        self.reply_cache: dict[int, tuple] = {}
+        #: Undelivered results: (rid, payload) with payload a
+        #: bytes-like (zero-copy arena view on the device path).
+        self.pending: deque = deque()
+        #: Results riding un-acked replies: [(seq, [(rid, payload)])].
+        self.inflight: list[tuple[int, list[tuple]]] = []
+        self.demand_rows = 0
+        self.exec_rate_ewma = 0.0
+        #: Per-tenant novelty EWMA + plateau latch — the credit
+        #: inputs (serve/composer.py).
+        self.novelty_ewma = 0.0
+        self.last_novel_ts = now
+        self.stalled = False
+        self.credit = 1.0
+        self.rows_spent = 0
+        self.delivered = 0
+        self.q_gauge = telemetry.gauge(
+            "tz_serve_queue_depth",
+            "undelivered results queued for one tenant",
+            labels={"tenant": name})
+        self.c_gauge = telemetry.gauge(
+            "tz_serve_credit",
+            "one tenant's QoS credit share of device rows",
+            labels={"tenant": name})
+        self.m_rows = telemetry.counter(
+            "tz_serve_rows_total",
+            "device rows spent on one tenant's demand",
+            labels={"tenant": name})
+        self.m_results = telemetry.counter(
+            "tz_serve_results_total",
+            "novel mutants delivered to one tenant",
+            labels={"tenant": name})
+
+    def queued(self) -> int:
+        return len(self.pending) + sum(
+            len(items) for _seq, items in self.inflight)
+
+    def outstanding_demand(self) -> int:
+        """Rows the composer should still produce for this tenant:
+        the reported backlog minus what is already queued/in flight."""
+        return max(0, self.demand_rows - self.queued())
+
+
+class ServePlane:
+    """The "Serve" RPC receiver + the composer's demand/supply API."""
+
+    def __init__(self, lease_s: Optional[float] = None,
+                 queue_cap: Optional[int] = None,
+                 reply_cache_size: Optional[int] = None,
+                 max_tenants: Optional[int] = None,
+                 throttle_fn: Optional[Callable[[], str]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._lock = threading.Lock()
+        self.epoch = f"{random.getrandbits(64):016x}"
+        self.lease_s = env_float("TZ_SERVE_LEASE_S", 60.0) \
+            if lease_s is None else lease_s
+        self.queue_cap = max(1, env_int("TZ_SERVE_QUEUE_CAP", 8192)
+                             if queue_cap is None else queue_cap)
+        self.reply_cache_size = env_int("TZ_RPC_REPLY_CACHE", 128) \
+            if reply_cache_size is None else reply_cache_size
+        self.max_tenants = max(1, env_int("TZ_SERVE_MAX_TENANTS", 16)
+                               if max_tenants is None else max_tenants)
+        self.throttle_fn = throttle_fn
+        self._clock = clock
+        self.tenants: dict[str, TenantState] = {}
+        self._tombstones: dict[str, dict[int, tuple]] = {}
+        self._rid = 0
+        self.reaped_total = 0
+        self.replays_total = 0
+
+    # -- session plumbing (the PR 8 discipline) ---------------------------
+
+    def _session_precheck(self, params: dict) -> Optional[tuple]:
+        epoch = params.get("epoch")
+        if not epoch:
+            return None
+        name = params.get("name", "tenant")
+        seq = int(params.get("seq") or 0)
+        with self._lock:
+            self._reap_locked()
+            if epoch != self.epoch:
+                raise ReconnectRequired(
+                    f"serve epoch {epoch} is stale (broker epoch "
+                    f"{self.epoch}); re-Connect")
+            t = self.tenants.get(name)
+            if t is None:
+                cache = self._tombstones.get(name)
+                if cache is not None and seq in cache:
+                    _M_REPLAYS.inc()
+                    self.replays_total += 1
+                    return cache[seq]
+                raise ReconnectRequired(
+                    f"serve lease for {name!r} expired; re-Connect")
+            t.last_seen = self._clock()
+            if seq in t.reply_cache:
+                _M_REPLAYS.inc()
+                self.replays_total += 1
+                return t.reply_cache[seq]
+        return None
+
+    def _session_commit(self, params: dict, reply: tuple) -> tuple:
+        seq = int(params.get("seq") or 0)
+        if not params.get("epoch") or not seq:
+            return reply
+        name = params.get("name", "tenant")
+        with self._lock:
+            t = self.tenants.get(name)
+            if t is not None:
+                t.reply_cache[seq] = reply
+                while len(t.reply_cache) > self.reply_cache_size:
+                    del t.reply_cache[min(t.reply_cache)]
+        return reply
+
+    def _reap_locked(self) -> None:
+        now = self._clock()
+        expired = [t for t in self.tenants.values()
+                   if t.last_seen and now - t.last_seen > self.lease_s]
+        for t in expired:
+            del self.tenants[t.name]
+            self.reaped_total += 1
+            _M_REAPED.inc()
+            self._tombstones[t.name] = t.reply_cache
+            while len(self._tombstones) > _MAX_TOMBSTONES:
+                del self._tombstones[next(iter(self._tombstones))]
+            # Results are tenant-specific: there is no survivor to
+            # hand them to (handing them over WOULD be the cross-
+            # tenant leak the conservation test forbids) — drop and
+            # account.
+            dropped = t.queued()
+            if dropped:
+                _M_DROPPED.inc(dropped)
+            t.q_gauge.set(0)
+            telemetry.record_event(
+                "serve.lease_expire",
+                f"{t.name} idle {now - t.last_seen:.0f}s; dropped "
+                f"{dropped} undelivered results")
+            log.logf(0, "reaped serve tenant %s (idle %.0fs)",
+                     t.name, now - t.last_seen)
+        _G_TENANTS.set(len(self.tenants))
+
+    def _settle_locked(self, t: TenantState, seq: int,
+                       ack_seq: int) -> None:
+        """Advance delivery custody: replies the tenant confirmed
+        (reply seq <= ack_seq) retire their results; replies the
+        tenant abandoned (seq < current, never acked) return their
+        results to the FRONT of the queue so redelivery keeps the
+        original order."""
+        keep: list[tuple[int, list[tuple]]] = []
+        requeued: list[tuple] = []
+        for bseq, items in t.inflight:
+            if bseq <= ack_seq:
+                t.delivered += len(items)
+            elif bseq < seq:
+                requeued.extend(items)
+            else:
+                keep.append((bseq, items))
+        t.inflight = keep
+        if requeued:
+            _M_REQUEUED.inc(len(requeued))
+            t.pending.extendleft(reversed(requeued))
+
+    # -- RPC methods ------------------------------------------------------
+
+    def Connect(self, params: dict) -> dict:
+        """Mint (epoch, lease) for a tenant.  A re-Connect under an
+        existing name (VM restart, post-reap resync) KEEPS the pending
+        result queue — those mutants were produced for this tenant's
+        demand and are still its property — but returns in-flight
+        items to the queue front, since any un-acked reply died with
+        the old connection."""
+        name = params.get("name", "tenant")
+        with self._lock:
+            self._reap_locked()
+            old = self.tenants.get(name)
+            if old is None and len(self.tenants) >= self.max_tenants:
+                raise RuntimeError(
+                    f"serve admission: {self.max_tenants} tenants "
+                    "already hold leases (TZ_SERVE_MAX_TENANTS)")
+            now = self._clock()
+            t = TenantState(name=name, now=now)
+            if old is not None:
+                self._settle_locked(old, 1 << 62, 0)
+                t.pending = old.pending
+                t.novelty_ewma = old.novelty_ewma
+                t.credit = old.credit
+                t.rows_spent = old.rows_spent
+                t.delivered = old.delivered
+            self._tombstones.pop(name, None)
+            self.tenants[name] = t
+            _G_TENANTS.set(len(self.tenants))
+            return {"epoch": self.epoch, "lease_s": self.lease_s,
+                    "queue_cap": self.queue_cap}
+
+    def Poll(self, params: dict):
+        """Demand up, results down.  Returns (reply, annex): the
+        annex is the zero-copy concatenation of every shipped
+        payload; reply["results"] carries (tenant, rid, off, len)
+        refs into it."""
+        cached = self._session_precheck(params)
+        if cached is not None:
+            return cached
+        reply = self._poll(params)
+        return self._session_commit(params, reply)
+
+    def _poll(self, params: dict) -> tuple:
+        name = params.get("name", "tenant")
+        demand = params.get("demand") or {}
+        seq = int(params.get("seq") or 0)
+        ack_seq = int(params.get("ack_seq") or 0)
+        max_results = int(params.get("max_results") or (1 << 30))
+        with self._lock:
+            t = self.tenants.get(name)
+            if t is None:  # legacy unsessioned caller
+                t = TenantState(name=name, now=self._clock())
+                self.tenants[name] = t
+                _G_TENANTS.set(len(self.tenants))
+            if seq:
+                self._settle_locked(t, seq, ack_seq)
+            t.demand_rows = max(0, int(demand.get("backlog") or 0))
+            rate = float(demand.get("exec_rate") or 0.0)
+            t.exec_rate_ewma += EWMA_ALPHA * (rate - t.exec_rate_ewma)
+            # Admission quota: the throttle tier's row budget scaled
+            # by this tenant's QoS credit — allotments shrink per
+            # tenant before the global breaker trips.
+            state = self.throttle_fn() if self.throttle_fn else "closed"
+            allot = max(1, int(SERVE_QUOTA.get(state, 256) * t.credit))
+            n = min(len(t.pending), allot, max_results)
+            items = [t.pending.popleft() for _ in range(n)]
+            if seq and items:
+                t.inflight.append((seq, list(items)))
+            t.q_gauge.set(len(t.pending))
+            _G_DEMAND.set(sum(x.outstanding_demand()
+                              for x in self.tenants.values()))
+            credit = t.credit
+        refs, annex, off = [], [], 0
+        for rid, payload in items:
+            ln = len(payload)
+            refs.append({"tenant": name, "rid": rid,
+                         "off": off, "len": ln})
+            annex.append(payload)
+            off += ln
+        _M_ANNEX_BYTES.inc(off)
+        reply = {"results": refs, "credit": round(credit, 4),
+                 "quota": {"state": state, "max_results": allot},
+                 "queued": len(t.pending)}
+        return reply, annex
+
+    # -- composer-facing supply API ---------------------------------------
+
+    def demands(self) -> dict[str, int]:
+        """Per-tenant rows the composer should produce: outstanding
+        demand capped by queue headroom (the bound shapes composition;
+        nothing is dropped after the fact)."""
+        with self._lock:
+            return {
+                name: min(t.outstanding_demand(),
+                          max(0, self.queue_cap - len(t.pending)))
+                for name, t in self.tenants.items()}
+
+    def offer(self, tenant: str, payloads: list, rows_spent: int,
+              novel: int) -> int:
+        """The composer hands one tenant its batch share: `payloads`
+        are the novel mutants' bytes-like views, `rows_spent` the
+        device rows this tenant's allocation consumed, `novel` the
+        plane-novel count (feeds the QoS novelty EWMA).  Returns the
+        number queued (0 if the tenant vanished mid-compose)."""
+        with self._lock:
+            t = self.tenants.get(tenant)
+            if t is None:
+                return 0
+            for payload in payloads:
+                self._rid += 1
+                t.pending.append((f"{tenant}:{self._rid}", payload))
+            t.rows_spent += rows_spent
+            t.q_gauge.set(len(t.pending))
+        t.m_rows.inc(rows_spent)
+        if payloads:
+            t.m_results.inc(len(payloads))
+        if novel:
+            resumed = False
+            with self._lock:
+                t.last_novel_ts = self._clock()
+                if t.stalled:
+                    t.stalled = False
+                    resumed = True
+            if resumed:
+                telemetry.record_event(
+                    "coverage.resume",
+                    f"serve tenant {tenant}: {novel} novel mutants "
+                    "after a plateau")
+        return len(payloads)
+
+    def reap_expired(self) -> None:
+        with self._lock:
+            self._reap_locked()
+
+    def snapshot(self) -> dict:
+        """The /api/serve body (manager/html.py) and the bench/
+        stats_snapshot serve block."""
+        with self._lock:
+            now = self._clock()
+            return {
+                "epoch": self.epoch,
+                "lease_s": self.lease_s,
+                "queue_cap": self.queue_cap,
+                "tenants": {
+                    name: {
+                        "idle_s": round(now - t.last_seen, 1)
+                        if t.last_seen else None,
+                        "demand_rows": t.demand_rows,
+                        "exec_rate_ewma": round(t.exec_rate_ewma, 2),
+                        "queued": len(t.pending),
+                        "inflight": sum(len(i) for _s, i in t.inflight),
+                        "credit": round(t.credit, 4),
+                        "novelty_ewma": round(t.novelty_ewma, 4),
+                        "stalled": t.stalled,
+                        "rows_spent": t.rows_spent,
+                        "delivered": t.delivered,
+                    } for name, t in self.tenants.items()},
+                "reaped": self.reaped_total,
+                "replays": self.replays_total,
+            }

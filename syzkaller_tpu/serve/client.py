@@ -1,0 +1,87 @@
+"""ServeTenant: the fuzzer-VM side of the serving plane.
+
+Wraps an RPCClient in the PR 8 session discipline against the "Serve"
+receiver: connect() mints the session (and re-arms it transparently on
+ReconnectRequired), poll() reports demand and collects results from
+the reply's zero-copy annex.  The annex arrives as one bytes object;
+each result is sliced out by its (off, len) ref — a memoryview slice,
+so the per-mutant copy the annex path exists to avoid never happens
+client-side either.
+
+Delivery hygiene lives here too: every ref's tenant tag is checked
+against this client's name (a mismatch is the cross-tenant leak the
+conservation test forbids — fail loudly, not quietly), and a bounded
+rid window dedups redeliveries that session replays make possible at
+the application layer even though the transport is at-most-once.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+from syzkaller_tpu.rpc.rpc import RPCClient
+
+#: Remembered delivered rids (dedup window).  Redelivery can only
+#: reorder within a few polls, so a small window is plenty.
+_RID_WINDOW = 4096
+
+
+class CrossTenantLeak(RuntimeError):
+    """A delivered result's tenant tag did not match this client."""
+
+
+class ServeTenant:
+    """One fuzzer VM's handle on the serving plane."""
+
+    def __init__(self, addr: tuple[str, int], name: str, **kw):
+        self.name = name
+        self.client = RPCClient(addr, name=name, **kw)
+        self.lease_s: Optional[float] = None
+        self.queue_cap: Optional[int] = None
+        self.credit: float = 0.0
+        self.quota: dict = {}
+        self._seen: OrderedDict[str, None] = OrderedDict()
+
+    def connect(self) -> dict:
+        """Serve.Connect + arm the idempotent session; installed as
+        the client's on_reconnect so a reaped lease or broker restart
+        resyncs mid-poll without the caller noticing."""
+        reply = self.client.call("Serve.Connect", {"name": self.name})
+        self.lease_s = reply.get("lease_s")
+        self.queue_cap = reply.get("queue_cap")
+        self.client.set_session(reply["epoch"],
+                                on_reconnect=self.connect)
+        return reply
+
+    def poll(self, backlog: int, exec_rate: float = 0.0,
+             max_results: Optional[int] = None) -> list[tuple[str, bytes]]:
+        """One demand/supply exchange: reports (backlog, exec_rate),
+        returns this poll's fresh results as [(rid, payload)] sliced
+        zero-copy out of the reply annex."""
+        params = {"demand": {"backlog": int(backlog),
+                             "exec_rate": float(exec_rate)}}
+        if max_results is not None:
+            params["max_results"] = int(max_results)
+        reply, annex = self.client.call_session(
+            "Serve.Poll", params, want_annex=True)
+        self.credit = reply.get("credit", self.credit)
+        self.quota = reply.get("quota", self.quota)
+        view = memoryview(annex) if annex else memoryview(b"")
+        out: list[tuple[str, bytes]] = []
+        for ref in reply.get("results", []):
+            if ref.get("tenant") != self.name:
+                raise CrossTenantLeak(
+                    f"result {ref.get('rid')!r} for tenant "
+                    f"{ref.get('tenant')!r} delivered to {self.name!r}")
+            rid = ref["rid"]
+            if rid in self._seen:
+                continue
+            self._seen[rid] = None
+            while len(self._seen) > _RID_WINDOW:
+                self._seen.popitem(last=False)
+            out.append((rid, view[ref["off"]:ref["off"] + ref["len"]]))
+        return out
+
+    def close(self) -> None:
+        self.client.close()

@@ -1,0 +1,265 @@
+"""BatchComposer: continuous batching onto the fused device drain.
+
+The inference-serving move applied to the fuzzing hot loop: instead
+of one consumer draining whole 4096-row fused batches, the composer
+fills each batch from MULTIPLE tenants' demand, weighted by QoS
+credits, and carries a per-row tenant-id column through the drain so
+every produced mutant lands in exactly its requester's queue.
+
+Credit formula (docs/perf.md "The serving plane"):
+
+    c_i = floor + (1 - n*floor) * w_i / SUM(w)      (healthy tenants)
+    c_i <- max(floor, c_i * decay)                   (plateaued)
+
+where w_i is the tenant's novelty EWMA (the per-tenant analogue of
+the PR 7 `tz_coverage_novel_edges_total{lane=...}` rate the ROADMAP
+told this scheduler to consume), `floor` = TZ_SERVE_CREDIT_FLOOR and
+`decay` = TZ_SERVE_CREDIT_DECAY.  A tenant with no novel mutant for
+TZ_SERVE_STALL_WINDOW_S latches `stalled` (the per-tenant plateau
+verdict, same detector shape as telemetry/coverage.py) and its credit
+decays geometrically to EXACTLY the floor — never to zero: a starved
+tenant could never produce the novel mutant that would justify
+re-promoting it.  The first novel verdict after a plateau clears the
+latch (the broker emits the `coverage.resume` timeline event) and the
+next rebalance restores the demand-weighted share.
+
+Row allocation is largest-remainder over credit shares, capped by
+per-tenant outstanding demand and queue headroom, with unused rows
+redistributed to tenants that still want them — a batch is only
+smaller than `batch_rows` when aggregate demand is.
+
+The `serve.compose` fault seam sits at the top of compose_once: a
+scripted fault defers the whole batch (demand intact, nothing
+produced) — the composer must tolerate its own scheduling failing
+mid-stride, exactly like the manager's lease reaper.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health.envsafe import env_float
+from syzkaller_tpu.health.faultinject import FaultInjected, fault_point
+from syzkaller_tpu.serve.broker import EWMA_ALPHA, ServePlane
+from syzkaller_tpu.serve.plane import TenantPlanes
+
+_M_BATCHES = telemetry.counter(
+    "tz_serve_batches_total",
+    "fused batches composed from multi-tenant demand")
+_M_DEFERRED = telemetry.counter(
+    "tz_serve_compose_deferred_total",
+    "compose passes deferred by a scripted serve.compose fault")
+
+
+class BatchComposer:
+    """Fills fused batches from tenant queues; see module doc.
+
+    `drain_fn(n_rows) -> (rows, payloads)` produces n_rows exec-ready
+    mutants: `rows` a uint8[n, row_bytes] array (the novelty-verdict
+    input — the packed delta rows on the device path), `payloads` a
+    same-length sequence of bytes-like exec payloads (zero-copy arena
+    views from ops/pipeline on the device path; scripted buffers in
+    tests).  Injectable so the tier-1 suite runs a host drain with no
+    jit compiles."""
+
+    def __init__(self, broker: ServePlane, planes: TenantPlanes,
+                 drain_fn: Callable, batch_rows: int = 4096,
+                 credit_floor: Optional[float] = None,
+                 credit_decay: Optional[float] = None,
+                 rebalance_s: Optional[float] = None,
+                 stall_window_s: Optional[float] = None,
+                 interval_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.broker = broker
+        self.planes = planes
+        self.drain_fn = drain_fn
+        self.batch_rows = max(1, batch_rows)
+        self.credit_floor = min(0.5, max(0.0, env_float(
+            "TZ_SERVE_CREDIT_FLOOR",
+            0.05 if credit_floor is None else credit_floor)))
+        self.credit_decay = min(0.99, max(0.01, env_float(
+            "TZ_SERVE_CREDIT_DECAY",
+            0.5 if credit_decay is None else credit_decay)))
+        self.rebalance_s = max(0.0, env_float(
+            "TZ_SERVE_REBALANCE_S",
+            1.0 if rebalance_s is None else rebalance_s))
+        self.stall_window_s = max(0.1, env_float(
+            "TZ_SERVE_STALL_WINDOW_S",
+            30.0 if stall_window_s is None else stall_window_s))
+        self.interval_s = max(0.0, env_float(
+            "TZ_SERVE_COMPOSE_INTERVAL_S",
+            0.02 if interval_s is None else interval_s))
+        self._clock = clock
+        self._last_rebalance = clock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- QoS credits -------------------------------------------------------
+
+    def rebalance_credits(self, force: bool = False) -> dict[str, float]:
+        """Recompute per-tenant credits from the novelty EWMAs and
+        plateau latches.  Rate-limited to rebalance_s unless forced.
+        Emits a `serve.credits` timeline event when shares move."""
+        now = self._clock()
+        if not force and now - self._last_rebalance < self.rebalance_s:
+            with self.broker._lock:
+                return {n: t.credit
+                        for n, t in self.broker.tenants.items()}
+        self._last_rebalance = now
+        floor, decay = self.credit_floor, self.credit_decay
+        moved = []
+        with self.broker._lock:
+            tenants = list(self.broker.tenants.values())
+            for t in tenants:
+                # Advance the per-tenant novelty EWMA toward its
+                # recent delivery rate; the plateau latch follows the
+                # same trailing-window rule as the PR 7 detector.
+                if not t.stalled and \
+                        now - t.last_novel_ts >= self.stall_window_s:
+                    t.stalled = True
+                    telemetry.record_event(
+                        "coverage.stall",
+                        f"serve tenant {t.name}: no novel mutant in "
+                        f"{self.stall_window_s:.0f}s")
+            healthy = [t for t in tenants if not t.stalled]
+            n = len(tenants)
+            wsum = sum(max(t.novelty_ewma, 0.0) for t in healthy)
+            for t in tenants:
+                old = t.credit
+                if t.stalled:
+                    # Geometric decay to EXACTLY the floor.
+                    t.credit = max(floor, t.credit * decay)
+                    if t.credit - floor < 1e-9:
+                        t.credit = floor
+                elif wsum > 0:
+                    w = max(t.novelty_ewma, 0.0)
+                    t.credit = floor + (1.0 - n * floor) * (w / wsum)
+                else:  # cold start / all-equal: even shares
+                    t.credit = 1.0 / max(1, n) if n else 1.0
+                t.c_gauge.set(round(t.credit, 4))
+                if abs(t.credit - old) > 1e-6:
+                    moved.append(f"{t.name}:{old:.2f}->{t.credit:.2f}")
+            credits = {t.name: t.credit for t in tenants}
+        if moved:
+            telemetry.record_event(
+                "serve.credits", " ".join(sorted(moved)))
+        return credits
+
+    # -- batch composition -------------------------------------------------
+
+    def allocate(self, credits: dict[str, float],
+                 demands: dict[str, int]) -> list[tuple[str, int]]:
+        """Largest-remainder fill of one batch: credit shares capped
+        by demand, leftovers redistributed to tenants that still want
+        rows.  Returns [(tenant, n_rows)] in deterministic (sorted)
+        tenant order; SUM(n) <= batch_rows with equality whenever
+        aggregate demand allows."""
+        want = {t: d for t, d in sorted(demands.items()) if d > 0}
+        if not want:
+            return []
+        total = sum(credits.get(t, 0.0) for t in want) or 1.0
+        quota = {t: self.batch_rows * credits.get(t, 0.0) / total
+                 for t in want}
+        alloc = {t: min(int(quota[t]), want[t]) for t in want}
+        # Hand out remaining rows by descending fractional remainder
+        # (ties broken by tenant name for determinism), respecting
+        # each tenant's demand cap.
+        remaining = self.batch_rows - sum(alloc.values())
+        order = sorted(want, key=lambda t: (-(quota[t] - int(quota[t])),
+                                            t))
+        while remaining > 0:
+            progressed = False
+            for t in order:
+                if remaining <= 0:
+                    break
+                if alloc[t] < want[t]:
+                    alloc[t] += 1
+                    remaining -= 1
+                    progressed = True
+            if not progressed:
+                break  # aggregate demand < batch_rows
+        return [(t, n) for t, n in sorted(alloc.items()) if n > 0]
+
+    def compose_once(self) -> dict:
+        """One compose->drain->distribute pass.  Returns a report:
+        {"rows": total, "tenants": {name: {"rows", "novel",
+        "novel_idx"}}} — empty when there is no demand or the
+        serve.compose seam deferred the pass."""
+        try:
+            fault_point("serve.compose")
+        except FaultInjected:
+            _M_DEFERRED.inc()
+            return {"rows": 0, "tenants": {}, "deferred": True}
+        with telemetry.span("serve.compose"):
+            credits = self.rebalance_credits()
+            demands = self.broker.demands()
+            alloc = self.allocate(credits, demands)
+            total = sum(n for _t, n in alloc)
+            if total == 0:
+                return {"rows": 0, "tenants": {}}
+            # The per-row tenant-id column the drain carries
+            # (ops/pipeline.AssembledBatch.tenants on the device
+            # path): row j belongs to tenant_col[j].
+            tenant_col = np.concatenate([
+                np.full(n, i, np.int32)
+                for i, (_t, n) in enumerate(alloc)])
+        with telemetry.span("serve.dispatch"):
+            rows, payloads = self.drain_fn(total)
+        rows = np.atleast_2d(np.asarray(rows, dtype=np.uint8))
+        report: dict = {"rows": total, "tenants": {},
+                        "tenant_col": tenant_col,
+                        "order": [t for t, _n in alloc]}
+        off = 0
+        for tenant, n in alloc:
+            t_rows = rows[off:off + n]
+            t_payloads = payloads[off:off + n]
+            off += n
+            novel = self.planes.verdict(tenant, t_rows)
+            idx = np.flatnonzero(novel)
+            self.broker.offer(
+                tenant, [t_payloads[int(j)] for j in idx],
+                rows_spent=n, novel=int(idx.size))
+            with self.broker._lock:
+                t = self.broker.tenants.get(tenant)
+                if t is not None:
+                    t.novelty_ewma += EWMA_ALPHA * (
+                        idx.size / max(1, n) - t.novelty_ewma)
+            report["tenants"][tenant] = {
+                "rows": n, "novel": int(idx.size),
+                "novel_idx": [int(j) for j in idx]}
+        _M_BATCHES.inc()
+        return report
+
+    # -- the serving loop --------------------------------------------------
+
+    def start(self) -> None:
+        """Continuous serving: compose whenever there is demand, idle
+        at interval_s otherwise.  Daemon thread; stop() joins it."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="tz-serve-compose")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                report = self.compose_once()
+            except Exception as e:  # the loop survives drain failures
+                telemetry.record_event(
+                    "serve.compose_error", f"{type(e).__name__}: {e}")
+                report = {"rows": 0}
+            if report.get("rows", 0) == 0:
+                self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

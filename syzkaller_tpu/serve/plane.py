@@ -1,0 +1,165 @@
+"""Per-tenant mutant-novelty planes for the serving plane.
+
+One tenant's plane occupancy must not poison another tenant's novelty
+verdicts: the fused drain's shared mutant plane (ops/signal) dedups
+*production*, but a mutant that is old news to tenant A may be brand
+new to tenant B.  Each tenant therefore gets its OWN host-side plane,
+sized by TZ_SERVE_PLANE_BITS (2^bits bytes of uint8 — the per-tenant
+memory knob; docs/perf.md "The serving plane" has the cost model),
+with its own epoch counter so an invalidation (tenant reconnect after
+a wedge, an operator reset) is scoped to that tenant alone.
+
+Bucket assignment reuses the EXACT fold rules of the device path
+(ops/signal.hash_rows FNV-1a + fold_mutant_idx), reimplemented in
+numpy so verdicts here are bit-identical to what a fresh single-
+tenant device plane would say — the property the multi-tenant
+conservation test pins (ISSUE 12 acceptance: per-tenant verdicts
+bit-exact vs running each tenant alone on a fresh plane).
+
+Per-tenant occupancy and fold-false-negative-rate accounting rides
+the same discipline as the PR 7 coverage analytics (triage/engine
+threads these into its run_analytics() rollup when attached):
+labeled gauges `tz_serve_plane_occupancy{tenant=...}` /
+`tz_serve_plane_fn_rate{tenant=...}` plus an analytics() dict for
+/api/serve.  Everything is host-side numpy under one lock — no jits.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from syzkaller_tpu import telemetry
+
+#: Default per-tenant plane size: 2^20 buckets = 1 MB per tenant —
+#: a ~B/2^20 false-drop rate per 4096-row batch, the same
+#: memory/recall bargain as the shared mutant plane's 2^22 default
+#: scaled down because a tenant sees only its credit share of rows.
+PLANE_BITS_DEFAULT = 20
+
+_FNV_OFFSET = np.uint32(0x811C9DC5)
+_FNV_PRIME = np.uint32(0x01000193)
+
+
+def resolve_serve_plane_bits() -> int:
+    """TZ_SERVE_PLANE_BITS (envsafe) clamped to the same sane range
+    as the shared mutant plane: 10 bits (1 KB, tests) .. 28 bits."""
+    from syzkaller_tpu.health.envsafe import env_int
+
+    bits = env_int("TZ_SERVE_PLANE_BITS", PLANE_BITS_DEFAULT)
+    return min(max(int(bits), 10), 28)
+
+
+def hash_rows_np(rows: np.ndarray) -> np.ndarray:
+    """FNV-1a over each row's bytes, vectorized across the batch:
+    uint8[B, row_bytes] -> uint32[B].  Bit-identical to the device
+    fori_loop in ops/signal.hash_rows (numpy uint32 arithmetic wraps
+    mod 2^32 exactly as the jitted path does)."""
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    h = np.full(rows.shape[0], _FNV_OFFSET, np.uint32)
+    with np.errstate(over="ignore"):
+        for j in range(rows.shape[1]):
+            h = (h ^ rows[:, j].astype(np.uint32)) * _FNV_PRIME
+    return h
+
+
+def fold_idx_np(h: np.ndarray, bits: int) -> np.ndarray:
+    """ops/signal.fold_mutant_idx on the host: identical fold, so a
+    tenant plane and a device plane at the same bits agree bucket-
+    for-bucket."""
+    return ((h ^ (h >> np.uint32(bits)))
+            & np.uint32((1 << bits) - 1)).astype(np.int64)
+
+
+class TenantPlanes:
+    """Per-tenant novelty planes + epoch/occupancy accounting."""
+
+    def __init__(self, bits: int | None = None):
+        self.bits = resolve_serve_plane_bits() if bits is None \
+            else min(max(int(bits), 10), 28)
+        self.size = 1 << self.bits
+        self._lock = threading.Lock()
+        self._planes: dict[str, np.ndarray] = {}
+        self._epochs: dict[str, int] = {}
+        self._occupancy: dict[str, int] = {}
+        self._g_occ: dict[str, object] = {}
+        self._g_fn: dict[str, object] = {}
+
+    def _ensure_locked(self, tenant: str) -> np.ndarray:
+        plane = self._planes.get(tenant)
+        if plane is None:
+            plane = np.zeros(self.size, np.uint8)
+            self._planes[tenant] = plane
+            self._epochs[tenant] = 0
+            self._occupancy[tenant] = 0
+            self._g_occ[tenant] = telemetry.gauge(
+                "tz_serve_plane_occupancy",
+                "occupied buckets in one tenant's novelty plane",
+                labels={"tenant": tenant})
+            self._g_fn[tenant] = telemetry.gauge(
+                "tz_serve_plane_fn_rate",
+                "estimated false-drop rate of one tenant's plane "
+                "(occupancy / plane size)",
+                labels={"tenant": tenant})
+        return plane
+
+    def verdict(self, tenant: str, rows: np.ndarray) -> np.ndarray:
+        """Cross-batch novelty verdicts for one tenant's rows:
+        bool[B], marking the buckets.  Same within-batch semantics as
+        ops/signal.mutant_novelty (duplicates in one batch all read
+        the pre-update plane, so all pass) — required for the
+        bit-exactness property."""
+        rows = np.atleast_2d(np.asarray(rows, dtype=np.uint8))
+        idx = fold_idx_np(hash_rows_np(rows), self.bits)
+        with self._lock:
+            plane = self._ensure_locked(tenant)
+            novel = plane[idx] == 0
+            plane[idx] = 1
+            occ = self._occupancy[tenant] + int(
+                np.unique(idx[novel]).size)
+            self._occupancy[tenant] = occ
+            g_occ, g_fn = self._g_occ[tenant], self._g_fn[tenant]
+        g_occ.set(occ)
+        g_fn.set(round(occ / self.size, 6))
+        return novel
+
+    def invalidate(self, tenant: str) -> int:
+        """Zero one tenant's plane and bump its epoch — scoped: no
+        other tenant's verdicts change.  Returns the new epoch."""
+        with self._lock:
+            if tenant not in self._planes:
+                self._ensure_locked(tenant)
+            self._planes[tenant].fill(0)
+            self._occupancy[tenant] = 0
+            self._epochs[tenant] += 1
+            epoch = self._epochs[tenant]
+            g_occ, g_fn = self._g_occ[tenant], self._g_fn[tenant]
+        g_occ.set(0)
+        g_fn.set(0.0)
+        return epoch
+
+    def drop(self, tenant: str) -> None:
+        """Forget a reaped tenant's plane (its gauges stay registered
+        at their last value; the label set is bounded by the broker's
+        admission cap)."""
+        with self._lock:
+            self._planes.pop(tenant, None)
+            self._occupancy.pop(tenant, None)
+
+    def epoch(self, tenant: str) -> int:
+        with self._lock:
+            return self._epochs.get(tenant, 0)
+
+    def analytics(self) -> dict:
+        """Per-tenant occupancy/FN-rate rollup — threaded through the
+        triage engine's run_analytics() when attached, and the
+        /api/serve payload."""
+        with self._lock:
+            return {
+                tenant: {
+                    "occupancy": occ,
+                    "fn_rate": round(occ / self.size, 6),
+                    "epoch": self._epochs.get(tenant, 0),
+                }
+                for tenant, occ in self._occupancy.items()}

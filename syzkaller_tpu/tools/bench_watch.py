@@ -251,6 +251,38 @@ def wedge_report(snap: dict) -> list[str]:
         if dropped:
             line += f", {int(dropped)} inputs dropped"
         lines.append(line)
+    # Serving-plane health (ISSUE 12): tenant count, queue custody,
+    # and the QoS credit distribution — a starved or runaway tenant
+    # shows here (credit pinned at the floor, queue deep) before it
+    # shows anywhere device-side.
+    serve_tenants = gauges.get("tz_serve_tenants") or 0
+    serve_reaped = counters.get("tz_serve_leases_reaped_total") or 0
+    if serve_tenants or serve_reaped:
+        line = f"serving plane: {int(serve_tenants)} tenants"
+        depths = {}
+        credits = {}
+        for k, v in gauges.items():
+            if k.startswith('tz_serve_queue_depth{'):
+                depths[k.split('tenant="', 1)[1].rstrip('"}')] = v
+            elif k.startswith('tz_serve_credit{'):
+                credits[k.split('tenant="', 1)[1].rstrip('"}')] = v
+        if depths:
+            line += ", queues " + " ".join(
+                f"{t}:{int(v)}" for t, v in sorted(depths.items()))
+        if credits:
+            line += ", credits " + " ".join(
+                f"{t}:{v:.2f}" for t, v in sorted(credits.items()))
+        demand = gauges.get("tz_serve_demand_rows") or 0
+        if demand:
+            line += f", demand {int(demand)} rows"
+        if serve_reaped:
+            line += f", {int(serve_reaped)} leases reaped"
+        requeued = counters.get("tz_serve_results_requeued_total") or 0
+        dropped = counters.get("tz_serve_results_dropped_total") or 0
+        if requeued or dropped:
+            line += (f" ({int(requeued)} results requeued, "
+                     f"{int(dropped)} dropped with reaped leases)")
+        lines.append(line)
     # Fault-domain mesh health (ISSUE 11): topology width, per-shard
     # breaker states, and the last re-shard age — a demoted shard
     # shows here as e.g. "3:open" while the engine keeps serving from

@@ -261,6 +261,9 @@ class TriageEngine:
         self._merge_lock = threading.Lock()
         self._device_lock = threading.Lock()  # flush-leader mutex
         self._demoted = False
+        # Serving plane (serve/plane.py): when attached, per-tenant
+        # novelty-plane occupancy/FN-rate rides the analytics rollup.
+        self._tenant_planes = None
 
     @classmethod
     def for_pipeline(cls, pipeline, **kw) -> "TriageEngine":
@@ -441,11 +444,23 @@ class TriageEngine:
             finally:
                 self._device_lock.release()
 
+    def attach_tenant_planes(self, planes) -> None:
+        """Thread the serving plane's per-tenant novelty planes
+        (serve/plane.TenantPlanes) into this engine's analytics
+        rollup: run_analytics() and snapshot() gain a "tenants" key
+        with per-tenant {occupancy, fn_rate, epoch} — the multi-
+        tenant extension of the PR 7 coverage accounting."""
+        self._tenant_planes = planes
+
     def run_analytics(self, audit: bool = False) -> dict:
         """Force one analytics pass (bench.py --coverage, tests);
-        returns {occupancy, regions, drift}."""
+        returns {occupancy, regions, drift} plus a per-tenant
+        "tenants" rollup when serving-plane planes are attached."""
         with self._device_lock:
-            return self._run_analytics_locked(audit=audit)
+            res = self._run_analytics_locked(audit=audit)
+        if self._tenant_planes is not None:
+            res["tenants"] = self._tenant_planes.analytics()
+        return res
 
     def _run_analytics_locked(self, audit: bool = False) -> dict:
         """The coverage reductions, computed where the data lives
@@ -829,6 +844,12 @@ class TriageEngine:
 
     def snapshot(self) -> dict:
         """Engine state for health_snapshot surfaces and tests."""
+        if self._tenant_planes is not None:
+            return dict(self._snapshot_base(),
+                        tenants=self._tenant_planes.analytics())
+        return self._snapshot_base()
+
+    def _snapshot_base(self) -> dict:
         s = self.stats
         return {
             "demoted": self._demoted,
