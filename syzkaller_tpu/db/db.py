@@ -15,12 +15,23 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 import zlib
 from dataclasses import dataclass
 from typing import Optional
 
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health.envsafe import env_int
+from syzkaller_tpu.health.faultinject import fault_point
+
 MAGIC = 0x745A6462  # "tzdb"
 CUR_VERSION = 1
+
+#: fsync latency on the append path — the price of "a record
+#: acknowledged to a fuzzer survives a crash" (TZ_DB_FSYNC=0 trades
+#: it back for throughput on expendable corpora).
+_H_FSYNC = telemetry.histogram(
+    "tz_db_fsync_seconds", "corpus DB fsync latency on flush")
 
 _HDR = struct.Struct("<II")  # magic, version
 _REC = struct.Struct("<I")  # compressed record length
@@ -70,7 +81,15 @@ class DB:
 
     def flush(self) -> None:
         """Append pending records; compact if the file has grown past
-        10x the live record count (reference: db.go:83-104)."""
+        10x the live record count (reference: db.go:83-104).
+
+        The append is durable: flush + fsync before pending clears
+        (TZ_DB_FSYNC=0 opts out), so a record acknowledged to a
+        fuzzer (manager NewInput calls save+flush before replying)
+        survives a crash.  The db.append seam fires per record; a
+        scripted fault propagates with `pending` intact, so the
+        records written so far are simply re-appended by the next
+        flush (supersede-by-key makes the duplicates harmless)."""
         with self._lock:
             if self._uncompacted >= 10 * max(len(self.records), 1) + 10:
                 self._compact()
@@ -79,7 +98,13 @@ class DB:
                 return
             with open(self.filename, "ab") as f:
                 for key, rec in self.pending.items():
+                    fault_point("db.append")
                     f.write(_serialize_record(key, rec))
+                f.flush()
+                if env_int("TZ_DB_FSYNC", 1):
+                    t0 = time.monotonic()
+                    os.fsync(f.fileno())
+                    _H_FSYNC.observe(time.monotonic() - t0)
             self._uncompacted += len(self.pending)
             self.pending.clear()
 
@@ -97,6 +122,10 @@ class DB:
                 f.write(_serialize_record(key, rec))
             f.flush()
             os.fsync(f.fileno())
+        # Seam between the complete tmp and the publish: a scripted
+        # fault models a crash mid-compaction — the old file stays
+        # authoritative and open_db unlinks the orphaned tmp.
+        fault_point("db.compact")
         os.replace(tmp, self.filename)
         self._uncompacted = len(self.records)
         self.pending.clear()
@@ -118,6 +147,15 @@ def open_db(filename: str, version: int = CUR_VERSION) -> DB:
     records: dict[str, Record] = {}
     file_version = version
     uncompacted = 0
+    # A crash between _compact's fsync and its rename orphans the tmp;
+    # left in place it would shadow disk space forever (and a partial
+    # one must never be mistaken for the real DB).
+    stale_tmp = filename + ".tmp"
+    if os.path.exists(stale_tmp):
+        try:
+            os.unlink(stale_tmp)
+        except OSError:
+            pass
     if os.path.exists(filename) and os.path.getsize(filename) >= _HDR.size:
         with open(filename, "rb") as f:
             data = f.read()

@@ -1,0 +1,32 @@
+"""Durable state & warm restart (docs/health.md "Durability &
+recovery").
+
+Manager process death used to be a cold-start catastrophe: corpus.db
+survived, but the uint8[2^26] signal-plane mirror, the mutant plane,
+per-tenant serve planes + QoS credits, the coverage growth ring, and
+the PR 8 candidate-custody / serve delivery ledgers all rebuilt from
+nothing, paying a full corpus re-triage.  This package makes that
+death a warm restart:
+
+  * checkpoint.py — atomic, versioned, checksummed on-disk images
+    (temp-file + fsync + rename, the db._compact discipline),
+  * wal.py — a compact write-ahead log journaling plane merges,
+    custody transitions, and credit updates between checkpoints,
+  * recovery.py — checksum validation, torn-tail truncation, and
+    jax-free replay that converges to the pre-crash state,
+  * store.py — the DurableStore orchestrator: checkpoint cadence
+    (TZ_CKPT_INTERVAL_S), WAL size cap (TZ_CKPT_WAL_MAX_MB), the
+    journal fan-in the subsystems write through, and open-time
+    recovery.
+"""
+
+from syzkaller_tpu.durable.checkpoint import (CheckpointError,
+                                              read_checkpoint,
+                                              write_checkpoint)
+from syzkaller_tpu.durable.store import DurableStore, RecoveredState
+from syzkaller_tpu.durable.wal import WriteAheadLog, read_wal
+
+__all__ = [
+    "CheckpointError", "DurableStore", "RecoveredState",
+    "WriteAheadLog", "read_checkpoint", "read_wal", "write_checkpoint",
+]

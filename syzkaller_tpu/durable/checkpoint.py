@@ -1,0 +1,173 @@
+"""Atomic, versioned, checksummed checkpoint images.
+
+One file holds the whole warm-restart image:
+
+    <I magic> <I version> <Q json_len> json <Q blob_len> blob
+    <I crc32(json + blob)>
+
+The json carries per-section metadata plus (offset, length) slices
+into the blob for each section's bulk bytes (zlib-packed planes,
+result payloads).  The write is temp-file + flush + fsync + rename —
+the exact db._compact discipline — so a reader sees either the old
+complete image or the new complete image, never a torn one.  The
+`durable.ckpt_write` fault seam sits between the fsync and the
+rename: a scripted fault models dying with the image fully written
+but not yet published, which must leave the previous checkpoint (and
+the WAL) authoritative.
+
+Readers raise CheckpointError on any structural or checksum problem;
+the store falls back to WAL-only (or cold) recovery and quarantines
+the bad file as `<path>.corrupt` for the operator.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health.faultinject import fault_point
+
+try:
+    import json
+except ImportError:  # pragma: no cover
+    json = None
+
+MAGIC = 0x745A636B  # "tzck"
+CUR_VERSION = 1
+
+_HDR = struct.Struct("<II")  # magic, version
+_LEN = struct.Struct("<Q")
+_CRC = struct.Struct("<I")
+
+_M_CKPTS = telemetry.counter(
+    "tz_durable_ckpts_total", "checkpoint images written")
+_M_ERRORS = telemetry.counter(
+    "tz_durable_ckpt_errors_total",
+    "checkpoint writes that failed (scripted seam or I/O error); "
+    "the previous image and the WAL stay authoritative")
+_G_LAST_TS = telemetry.gauge(
+    "tz_durable_ckpt_last_ts",
+    "wallclock of the last successful checkpoint (0 = never)")
+_G_BYTES = telemetry.gauge(
+    "tz_durable_ckpt_bytes", "size of the last checkpoint image")
+
+
+class CheckpointError(Exception):
+    """Structural/checksum failure reading a checkpoint image."""
+
+
+def pack_section(arr) -> bytes:
+    """zlib-pack a uint8 plane for the image blob (planes are mostly
+    zeros early in a campaign; level 1 keeps the cadence write cheap)."""
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(arr, dtype=np.uint8))
+    return zlib.compress(a.tobytes(), 1)
+
+
+def unpack_section(blob: bytes, size: int):
+    """Inverse of pack_section — numpy only, safe on the jax-free
+    recovery path."""
+    import numpy as np
+
+    raw = zlib.decompress(bytes(blob))
+    if len(raw) != size:
+        raise CheckpointError(
+            f"plane section is {len(raw)} bytes, expected {size}")
+    return np.frombuffer(raw, dtype=np.uint8).copy()
+
+
+def write_checkpoint(path: str, sections: dict, ts: float) -> int:
+    """Publish `sections` ({name: (meta_dict, blob_bytes)}) atomically
+    at `path`; returns the image size.  Raises on seam faults and I/O
+    errors — the caller (DurableStore.checkpoint_now) accounts the
+    failure and leaves the WAL intact."""
+    blob_parts: list[bytes] = []
+    meta: dict = {"ts": round(float(ts), 3), "sections": {}}
+    off = 0
+    for name, (sec_meta, sec_blob) in sections.items():
+        sec_blob = bytes(sec_blob)
+        meta["sections"][name] = {
+            "meta": sec_meta, "off": off, "len": len(sec_blob)}
+        blob_parts.append(sec_blob)
+        off += len(sec_blob)
+    jb = json.dumps(meta, separators=(",", ":"),
+                    sort_keys=True).encode()
+    blob = b"".join(blob_parts)
+    crc = zlib.crc32(jb)
+    crc = zlib.crc32(blob, crc)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(_HDR.pack(MAGIC, CUR_VERSION))
+            f.write(_LEN.pack(len(jb)))
+            f.write(jb)
+            f.write(_LEN.pack(len(blob)))
+            f.write(blob)
+            f.write(_CRC.pack(crc))
+            f.flush()
+            os.fsync(f.fileno())
+        # Seam between fsync and publish: a scripted fault dies with
+        # the new image complete but unrenamed — the previous image
+        # must stay authoritative and the stale tmp must be cleaned
+        # on the next open.
+        fault_point("durable.ckpt_write")
+        os.replace(tmp, path)
+    except BaseException:
+        _M_ERRORS.inc()
+        raise
+    size = os.path.getsize(path)
+    _M_CKPTS.inc()
+    _G_LAST_TS.set(round(float(ts), 3))
+    _G_BYTES.set(size)
+    return size
+
+
+def read_checkpoint(path: str) -> dict:
+    """Validate and decode an image into {name: (meta, blob_bytes)}
+    plus the "__ts__" stamp; raises CheckpointError on anything
+    structurally or cryptographically wrong."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise CheckpointError(f"unreadable checkpoint: {e}") from e
+    if len(data) < _HDR.size + 2 * _LEN.size + _CRC.size:
+        raise CheckpointError(f"checkpoint too short ({len(data)}B)")
+    magic, ver = _HDR.unpack_from(data, 0)
+    if magic != MAGIC:
+        raise CheckpointError(f"bad magic {magic:#x}")
+    if ver != CUR_VERSION:
+        raise CheckpointError(f"unsupported version {ver}")
+    pos = _HDR.size
+    (jlen,) = _LEN.unpack_from(data, pos)
+    pos += _LEN.size
+    if pos + jlen + _LEN.size + _CRC.size > len(data):
+        raise CheckpointError("truncated json section")
+    jb = data[pos:pos + jlen]
+    pos += jlen
+    (blen,) = _LEN.unpack_from(data, pos)
+    pos += _LEN.size
+    if pos + blen + _CRC.size > len(data):
+        raise CheckpointError("truncated blob section")
+    blob = data[pos:pos + blen]
+    pos += blen
+    (want_crc,) = _CRC.unpack_from(data, pos)
+    crc = zlib.crc32(jb)
+    crc = zlib.crc32(blob, crc)
+    if crc != want_crc:
+        raise CheckpointError(
+            f"checksum mismatch ({crc:#x} != {want_crc:#x})")
+    try:
+        meta = json.loads(jb.decode())
+    except Exception as e:
+        raise CheckpointError(f"undecodable meta: {e}") from e
+    out: dict = {"__ts__": meta.get("ts", 0.0)}
+    for name, sec in (meta.get("sections") or {}).items():
+        o, ln = int(sec["off"]), int(sec["len"])
+        if o < 0 or o + ln > len(blob):
+            raise CheckpointError(f"section {name} slice out of range")
+        out[name] = (sec.get("meta") or {}, blob[o:o + ln])
+    return out

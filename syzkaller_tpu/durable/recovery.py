@@ -1,0 +1,329 @@
+"""WAL replay: checkpoint image + journal -> pre-crash state.
+
+Replay is deliberately jax-free (numpy + the pure-python Signal):
+recovery runs before any device work, and the recovered signal mirror
+is re-uploaded through the triage engine's existing
+`_ensure_plane_locked` rebuild path (one H2D, zero new jit compiles)
+rather than through any device code here.
+
+Replay rules (docs/health.md "Durability & recovery"):
+
+  * plane records ("merge", "tplane") are idempotent max/set-merges —
+    journaled after the in-memory mutation, so a checkpoint racing an
+    append at worst double-applies them harmlessly,
+  * ledger records (cand_*/serve_*) are exact transitions journaled
+    under the store's barrier — replay reproduces the custody ledgers
+    bit-for-bit, then COLLAPSES them: a restarted manager re-mints
+    its session epoch, so every fuzzer/tenant re-Connects, which
+    returns in-flight custody to the queues anyway.  Collapsing at
+    recovery (inflight/owned -> candidate queue; serve inflight ->
+    queue front) conserves the multisets with zero loss and zero
+    double-count,
+  * "corpus_add" carries the post-merge input dict and the signal
+    diff, so replaying it is idempotent and order-independent with
+    respect to the checkpoint,
+  * unknown kinds are skipped (forward compatibility: a newer writer
+    journals kinds an older reader ignores rather than dying on).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from syzkaller_tpu.durable.checkpoint import unpack_section
+from syzkaller_tpu.signal import Signal
+from syzkaller_tpu.utils import log
+
+
+def _sig(serialized) -> Signal:
+    if not serialized:
+        return Signal()
+    return Signal.deserialize(serialized[0], serialized[1])
+
+
+def _idx(blob: bytes) -> np.ndarray:
+    return np.frombuffer(bytes(blob), dtype=np.uint32).astype(np.int64)
+
+
+class _Ledger:
+    """One fuzzer's custody during replay (mirrors FuzzerState's
+    inflight/owned without importing the manager)."""
+
+    __slots__ = ("inflight", "owned")
+
+    def __init__(self, inflight=None, owned=None):
+        self.inflight: list = [list(b) for b in (inflight or [])]
+        self.owned: list = list(owned or [])
+
+
+class _Tenant:
+    """One serve tenant's delivery ledger + QoS state during replay."""
+
+    __slots__ = ("pending", "inflight", "credit", "novelty_ewma",
+                 "stalled", "rows_spent", "delivered", "demand_rows")
+
+    def __init__(self, meta=None, payloads=None):
+        meta = meta or {}
+        self.pending: list = list(payloads or [])  # [(rid, bytes)]
+        self.inflight: list = []  # [(seq, [(rid, bytes)])]
+        self.credit = float(meta.get("credit", 1.0))
+        self.novelty_ewma = float(meta.get("novelty_ewma", 0.0))
+        self.stalled = bool(meta.get("stalled", False))
+        self.rows_spent = int(meta.get("rows_spent", 0))
+        self.delivered = int(meta.get("delivered", 0))
+        self.demand_rows = int(meta.get("demand_rows", 0))
+
+    def settle(self, seq: int, ack_seq: int) -> None:
+        keep, requeued = [], []
+        for bseq, items in self.inflight:
+            if bseq <= ack_seq:
+                self.delivered += len(items)
+            elif bseq < seq:
+                requeued.extend(items)
+            else:
+                keep.append((bseq, items))
+        self.inflight = keep
+        if requeued:
+            self.pending[:0] = requeued
+
+
+def replay(ckpt: dict, records: list) -> dict:
+    """Apply `records` (wal.WalRecord list) on top of a decoded
+    checkpoint image (checkpoint.read_checkpoint output, or {} for
+    WAL-only recovery).  Returns the recovered-state dict the domain
+    objects restore from (store.RecoveredState wraps it)."""
+    out: dict = {"ckpt_ts": ckpt.get("__ts__", 0.0),
+                 "wal_records": len(records)}
+
+    # -- seed from the checkpoint image ------------------------------------
+    control = None
+    if "control" in ckpt:
+        meta, _blob = ckpt["control"]
+        control = {
+            "queue": [dict(c) for c in meta.get("queue") or []],
+            "corpus": {k: dict(v)
+                       for k, v in (meta.get("corpus") or {}).items()},
+            "corpus_signal": _sig(meta.get("corpus_signal")),
+            "max_signal": _sig(meta.get("max_signal")),
+            "cover": set(int(pc) for pc in meta.get("cover") or []),
+            "triaged": int(meta.get("triaged") or 0),
+        }
+        fuzzers = {name: _Ledger(st.get("inflight"), st.get("owned"))
+                   for name, st in (meta.get("fuzzers") or {}).items()}
+    else:
+        fuzzers = {}
+
+    mirror = None
+    if "signal_plane" in ckpt:
+        meta, blob = ckpt["signal_plane"]
+        mirror = unpack_section(blob, int(meta["size"]))
+
+    mutant = None
+    if "mutant_plane" in ckpt:
+        meta, blob = ckpt["mutant_plane"]
+        mutant = {"bits": int(meta["bits"]),
+                  "plane": unpack_section(blob, int(meta["size"]))}
+
+    tplanes: dict = {}
+    tp_bits = None
+    tp_epochs: dict = {}
+    if "tenant_planes" in ckpt:
+        meta, blob = ckpt["tenant_planes"]
+        tp_bits = int(meta["bits"])
+        for name, sec in (meta.get("tenants") or {}).items():
+            o, ln = int(sec["off"]), int(sec["len"])
+            tplanes[name] = unpack_section(blob[o:o + ln], 1 << tp_bits)
+            tp_epochs[name] = int(sec.get("epoch") or 0)
+
+    serve = None
+    tenants: dict = {}
+    if "serve" in ckpt:
+        meta, blob = ckpt["serve"]
+        serve = {"rid": int(meta.get("rid") or 0)}
+        for name, tm in (meta.get("tenants") or {}).items():
+            payloads = []
+            for rid, off, ln in tm.get("items") or []:
+                payloads.append((rid, bytes(blob[off:off + ln])))
+            tenants[name] = _Tenant(tm, payloads)
+
+    coverage = None
+    if "coverage" in ckpt:
+        meta, _blob = ckpt["coverage"]
+        coverage = dict(meta)
+
+    # -- replay the journal ------------------------------------------------
+    for rec in records:
+        kind, meta, blob = rec.kind, rec.meta, rec.blob
+        if kind == "merge":
+            size = int(meta.get("size") or 0)
+            if mirror is None:
+                mirror = np.zeros(size, np.uint8)
+            if size and mirror.size != size:
+                log.logf(0, "durable: merge record size %d != mirror "
+                         "%d; skipped", size, mirror.size)
+                continue
+            np.maximum.at(mirror, _idx(blob),
+                          np.uint8(int(meta.get("prio") or 0) + 1))
+        elif kind == "tplane":
+            bits = int(meta.get("bits") or 0)
+            if tp_bits is None:
+                tp_bits = bits
+            name = meta.get("tenant") or "tenant"
+            plane = tplanes.get(name)
+            if plane is None:
+                plane = tplanes[name] = np.zeros(1 << tp_bits, np.uint8)
+            idx = _idx(blob)
+            if idx.size and idx.max() < plane.size:
+                plane[idx] = 1
+        elif kind == "cand_add":
+            if control is None:
+                control = _empty_control()
+            control["queue"].extend(
+                dict(c) for c in meta.get("cands") or [])
+        elif kind == "cand_issue":
+            if control is None:
+                control = _empty_control()
+            cands = [dict(c) for c in meta.get("cands") or []]
+            queue = control["queue"]
+            for c in cands:
+                try:
+                    queue.remove(c)
+                except ValueError:
+                    pass  # pre-checkpoint issue raced the snapshot
+            f = fuzzers.setdefault(meta.get("name") or "fuzzer",
+                                   _Ledger())
+            f.inflight.append([int(meta.get("seq") or 0), cands])
+            control["triaged"] += len(cands)
+        elif kind == "cand_settle":
+            f = fuzzers.setdefault(meta.get("name") or "fuzzer",
+                                   _Ledger())
+            seq = int(meta.get("seq") or 0)
+            ack = int(meta.get("ack_seq") or 0)
+            executed = int(meta.get("executed") or 0)
+            keep = []
+            for bseq, batch in f.inflight:
+                if bseq <= ack:
+                    f.owned.extend(batch)
+                elif bseq < seq:
+                    if control is None:
+                        control = _empty_control()
+                    control["queue"].extend(batch)
+                else:
+                    keep.append([bseq, batch])
+            f.inflight = keep
+            if executed:
+                del f.owned[:min(executed, len(f.owned))]
+        elif kind == "cand_requeue":
+            f = fuzzers.pop(meta.get("name") or "fuzzer", None)
+            if f is not None:
+                if control is None:
+                    control = _empty_control()
+                for _bseq, batch in f.inflight:
+                    control["queue"].extend(batch)
+                control["queue"].extend(f.owned)
+        elif kind == "corpus_add":
+            if control is None:
+                control = _empty_control()
+            inp = dict(meta.get("input") or {})
+            control["corpus"][meta.get("key")] = inp
+            diff = _sig(meta.get("diff"))
+            control["corpus_signal"].merge(diff)
+            control["max_signal"].merge(diff)
+            control["cover"].update(
+                int(pc) for pc in inp.get("cover") or [])
+        elif kind == "max_sig":
+            if control is None:
+                control = _empty_control()
+            control["max_signal"].merge(_sig(meta.get("sig")))
+        elif kind == "serve_offer":
+            if serve is None:
+                serve = {"rid": 0}
+            t = tenants.setdefault(meta.get("tenant") or "tenant",
+                                   _Tenant())
+            rids = meta.get("rids") or []
+            lens = meta.get("lens") or []
+            off = 0
+            for rid, ln in zip(rids, lens):
+                t.pending.append((rid, bytes(blob[off:off + ln])))
+                off += ln
+            t.rows_spent += int(meta.get("rows_spent") or 0)
+            serve["rid"] = max(int(serve.get("rid") or 0),
+                               int(meta.get("rid_after") or 0))
+        elif kind == "serve_issue":
+            t = tenants.setdefault(meta.get("tenant") or "tenant",
+                                   _Tenant())
+            n = min(int(meta.get("n") or 0), len(t.pending))
+            items, t.pending = t.pending[:n], t.pending[n:]
+            t.inflight.append((int(meta.get("seq") or 0), items))
+        elif kind == "serve_settle":
+            t = tenants.setdefault(meta.get("tenant") or "tenant",
+                                   _Tenant())
+            t.settle(int(meta.get("seq") or 0),
+                     int(meta.get("ack_seq") or 0))
+        elif kind == "serve_connect":
+            t = tenants.get(meta.get("tenant") or "tenant")
+            if t is not None:
+                t.settle(1 << 62, 0)
+                t.demand_rows = 0
+        elif kind == "serve_reap":
+            tenants.pop(meta.get("tenant") or "tenant", None)
+        elif kind == "credit":
+            for name, c in (meta.get("credits") or {}).items():
+                tenants.setdefault(name, _Tenant()).credit = float(c)
+            for name, w in (meta.get("ewma") or {}).items():
+                t = tenants.get(name)
+                if t is not None:
+                    t.novelty_ewma = float(w)
+            for name, s in (meta.get("stalled") or {}).items():
+                t = tenants.get(name)
+                if t is not None:
+                    t.stalled = bool(s)
+        elif kind == "cov":
+            if coverage is None:
+                coverage = {"ring": []}
+            coverage.setdefault("ring", []).append(
+                [float(meta.get("ts") or 0.0),
+                 int(meta.get("occ") or 0),
+                 int(meta.get("delta") or 0)])
+            coverage["ewma_rate"] = float(meta.get("ewma") or 0.0)
+            coverage["novel_total"] = int(
+                meta.get("total") or coverage.get("novel_total") or 0)
+            coverage["occupancy"] = int(meta.get("occ") or 0)
+        # unknown kinds: skipped (see module doc)
+
+    # -- collapse custody (the restart re-Connect does this anyway) --------
+    if control is not None:
+        for f in fuzzers.values():
+            for _bseq, batch in f.inflight:
+                control["queue"].extend(batch)
+            control["queue"].extend(f.owned)
+        out["control"] = control
+    if serve is not None or tenants:
+        serve = serve or {"rid": 0}
+        serve["tenants"] = {}
+        for name, t in tenants.items():
+            t.settle(1 << 62, 0)  # inflight -> queue front
+            serve["tenants"][name] = {
+                "pending": t.pending,
+                "credit": t.credit,
+                "novelty_ewma": t.novelty_ewma,
+                "stalled": t.stalled,
+                "rows_spent": t.rows_spent,
+                "delivered": t.delivered,
+            }
+        out["serve"] = serve
+    if mirror is not None:
+        out["signal_mirror"] = mirror
+    if mutant is not None:
+        out["mutant_plane"] = mutant
+    if tplanes:
+        out["tenant_planes"] = {"bits": tp_bits, "planes": tplanes,
+                                "epochs": tp_epochs}
+    if coverage is not None:
+        out["coverage"] = coverage
+    return out
+
+
+def _empty_control() -> dict:
+    return {"queue": [], "corpus": {}, "corpus_signal": Signal(),
+            "max_signal": Signal(), "cover": set(), "triaged": 0}

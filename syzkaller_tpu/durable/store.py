@@ -1,0 +1,311 @@
+"""DurableStore: checkpoint cadence + journal fan-in + recovery.
+
+One store per manager workdir (`<workdir>/durable/`): `state.ckpt` is
+the atomic image, `state.wal` the journal since that image.  The
+subsystems (ManagerRPC, ServePlane, TenantPlanes, TriageEngine,
+CoverageTracker) hold a reference and write through `journal()`;
+checkpoint providers are registered as callables returning
+`(meta_dict, blob_bytes)` per section.
+
+Locking: `barrier()` (an RLock) is the OUTERMOST lock in the process.
+Ledger mutations (manager custody, serve delivery) acquire it around
+their domain lock + journal so a checkpoint can never land between a
+mutation and its journal record — the non-idempotent transitions are
+exactly-once across the snapshot boundary.  Plane/coverage records
+journal OUTSIDE their domain locks instead (their replays are
+idempotent max/set-merges, so a rare double-apply across the boundary
+is harmless); this keeps the lock order barrier -> domain -> wal
+acyclic in both styles.
+
+A failed WAL append (scripted `durable.wal_append` fault, disk error)
+is swallowed and counted — losing one journal record regresses
+durability to the previous record, never correctness.  A failed
+checkpoint (`durable.ckpt_write` fault) leaves the WAL un-reset, so
+the previous image + journal stay authoritative.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.durable import recovery as _recovery
+from syzkaller_tpu.durable.checkpoint import (CheckpointError,
+                                              read_checkpoint,
+                                              write_checkpoint)
+from syzkaller_tpu.durable.wal import (WriteAheadLog, _M_ERRORS,
+                                       read_wal)
+from syzkaller_tpu.health.envsafe import env_float, env_int
+from syzkaller_tpu.utils import log
+
+#: Recovery outcomes for tz_durable_recovery_state.
+RECOVERY_NONE = 0  # cold start: no image, no journal
+RECOVERY_WARM = 1  # image and/or journal replayed
+RECOVERY_FAILED = 2  # corrupt image quarantined; degraded/cold start
+
+_M_RECOVERIES = telemetry.counter(
+    "tz_durable_recoveries_total",
+    "warm recoveries completed (checkpoint and/or WAL replayed)")
+_G_RECOVERY = telemetry.gauge(
+    "tz_durable_recovery_state",
+    "last recovery outcome (0 cold/none, 1 warm, 2 corrupt image -> "
+    "degraded)")
+
+
+class RecoveredState(dict):
+    """The recovery.replay() output: a dict of per-subsystem state
+    ("control", "serve", "signal_mirror", "mutant_plane",
+    "tenant_planes", "coverage") plus bookkeeping keys."""
+
+    def summary(self) -> str:
+        parts = []
+        c = self.get("control")
+        if c is not None:
+            parts.append(f"corpus={len(c['corpus'])} "
+                         f"queue={len(c['queue'])}")
+        if "signal_mirror" in self:
+            parts.append("signal_plane")
+        if "mutant_plane" in self:
+            parts.append("mutant_plane")
+        if "tenant_planes" in self:
+            parts.append(
+                f"tenant_planes={len(self['tenant_planes']['planes'])}")
+        s = self.get("serve")
+        if s is not None:
+            parts.append(f"tenants={len(s.get('tenants') or {})}")
+        if "coverage" in self:
+            parts.append("coverage")
+        parts.append(f"wal_records={self.get('wal_records', 0)}")
+        return " ".join(parts)
+
+
+class DurableStore:
+    """See module doc.  Construct directly (tests) or via open()
+    (honors the TZ_CKPT_* knobs and returns None when disabled)."""
+
+    def __init__(self, dirpath: str,
+                 interval_s: Optional[float] = None,
+                 wal_fsync: Optional[bool] = None,
+                 wal_cap_mb: Optional[float] = None,
+                 clock: Callable[[], float] = time.time):
+        self.dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        self.ckpt_path = os.path.join(dirpath, "state.ckpt")
+        self.wal_path = os.path.join(dirpath, "state.wal")
+        self.interval_s = env_float("TZ_CKPT_INTERVAL_S", 60.0) \
+            if interval_s is None else float(interval_s)
+        self.wal_cap_bytes = int(max(1.0, (
+            env_float("TZ_CKPT_WAL_MAX_MB", 64.0)
+            if wal_cap_mb is None else float(wal_cap_mb))) * (1 << 20))
+        fsync = bool(env_int("TZ_CKPT_WAL_FSYNC", 1)) \
+            if wal_fsync is None else bool(wal_fsync)
+        self._clock = clock
+        #: The process-wide journal barrier (see module doc): public —
+        #: ledger owners wrap mutation+journal in `with store.barrier:`.
+        self.barrier = threading.RLock()
+        self._providers: dict[str, Callable[[], tuple]] = {}
+        self._ckpt_due = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_ckpt_ts = 0.0
+        self.last_ckpt_error: Optional[str] = None
+        self.ckpts_written = 0
+        self.wal_errors = 0
+        self.recovered: Optional[RecoveredState] = None
+        self.recovery_state = RECOVERY_NONE
+        self.closed = False
+        self._recover()
+        self.wal = WriteAheadLog(self.wal_path, fsync=fsync)
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def open(cls, workdir: str, **kw) -> Optional["DurableStore"]:
+        """The manager entry point: `<workdir>/durable/`, disabled
+        entirely by TZ_CKPT_INTERVAL_S=0 (returns None)."""
+        interval = kw.pop("interval_s", None)
+        if interval is None:
+            interval = env_float("TZ_CKPT_INTERVAL_S", 60.0)
+        if interval <= 0:
+            return None
+        return cls(os.path.join(workdir, "durable"),
+                   interval_s=interval, **kw)
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recover(self) -> None:
+        # A crash between the image fsync and the rename leaves a
+        # stale tmp that would otherwise sit forever.
+        tmp = self.ckpt_path + ".tmp"
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+                log.logf(0, "durable: removed stale %s", tmp)
+            except OSError:
+                pass
+        ckpt: dict = {}
+        failed = False
+        if os.path.exists(self.ckpt_path):
+            try:
+                ckpt = read_checkpoint(self.ckpt_path)
+            except CheckpointError as e:
+                failed = True
+                quarantine = self.ckpt_path + ".corrupt"
+                try:
+                    os.replace(self.ckpt_path, quarantine)
+                except OSError:
+                    quarantine = "<unlinkable>"
+                log.logf(0, "durable: corrupt checkpoint (%s); "
+                         "quarantined to %s", e, quarantine)
+                telemetry.FLIGHT.dump(
+                    "durable_recovery_degraded",
+                    f"corrupt checkpoint: {e}",
+                    extra={"quarantined": quarantine})
+        records = read_wal(self.wal_path)
+        if not ckpt and not records:
+            self.recovery_state = \
+                RECOVERY_FAILED if failed else RECOVERY_NONE
+            _G_RECOVERY.set(self.recovery_state)
+            return
+        with telemetry.span("durable.wal_replay"):
+            state = RecoveredState(_recovery.replay(ckpt, records))
+        self.recovered = state
+        self.recovery_state = RECOVERY_FAILED if failed \
+            else RECOVERY_WARM
+        _G_RECOVERY.set(self.recovery_state)
+        _M_RECOVERIES.inc()
+        telemetry.record_event("durable.recover", state.summary())
+        log.logf(0, "durable: warm recovery (%s)%s", state.summary(),
+                 " [image was corrupt; WAL-only]" if failed else "")
+
+    # -- journal -----------------------------------------------------------
+
+    def journal(self, kind: str, meta: Optional[dict] = None,
+                blob: bytes = b"") -> None:
+        """Append one record; never raises (a lost record costs
+        durability back to the previous record, not correctness)."""
+        with self.barrier:
+            if self.closed:
+                # A holder journaling after close (e.g. an analytics
+                # tick racing shutdown) is a no-op, not an error.
+                return
+            try:
+                self.wal.append(kind, meta, blob)
+            except (OSError, ConnectionError, ValueError) as e:
+                self.wal_errors += 1
+                _M_ERRORS.inc()
+                telemetry.record_event(
+                    "durable.wal_error", f"{kind}: {e}")
+                return
+            if self.wal.bytes_since_ckpt >= self.wal_cap_bytes:
+                self._ckpt_due.set()
+
+    # -- checkpointing -----------------------------------------------------
+
+    def register(self, name: str,
+                 provider: Callable[[], tuple]) -> None:
+        """Register a section provider: () -> (meta_dict, blob)."""
+        self._providers[name] = provider
+
+    def checkpoint_now(self) -> bool:
+        """Snapshot every provider and publish atomically; reset the
+        WAL only on success.  Returns True when the image published."""
+        with self.barrier:
+            sections = {}
+            for name, provider in self._providers.items():
+                try:
+                    meta, blob = provider()
+                except Exception as e:
+                    # One broken provider must not block the rest of
+                    # the image (a missing section degrades to colder
+                    # recovery for that subsystem only).
+                    log.logf(0, "durable: provider %s failed: %s",
+                             name, e)
+                    continue
+                sections[name] = (meta, blob)
+            ts = self._clock()
+            try:
+                with telemetry.span("durable.ckpt_write"):
+                    size = write_checkpoint(
+                        self.ckpt_path, sections, ts)
+            except (OSError, ConnectionError) as e:
+                self.last_ckpt_error = str(e)
+                telemetry.record_event("durable.ckpt_error", str(e))
+                log.logf(0, "durable: checkpoint failed: %s", e)
+                return False
+            self.wal.reset()
+            self.last_ckpt_ts = ts
+            self.last_ckpt_error = None
+            self.ckpts_written += 1
+            telemetry.record_event(
+                "durable.ckpt",
+                f"{len(sections)} sections, {size} bytes")
+        return True
+
+    # -- cadence -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Begin the checkpoint cadence (TZ_CKPT_INTERVAL_S), with
+        early wakeups when the WAL passes TZ_CKPT_WAL_MAX_MB."""
+        if self._thread is not None or self.interval_s <= 0:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="tz-durable-ckpt")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._ckpt_due.wait(self.interval_s)
+            if self._stop.is_set():
+                return
+            self._ckpt_due.clear()
+            try:
+                self.checkpoint_now()
+            except Exception as e:  # the cadence survives anything
+                log.logf(0, "durable: cadence checkpoint error: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._ckpt_due.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def close(self, final_checkpoint: bool = True) -> None:
+        """Clean shutdown: stop the cadence, publish a final image
+        (making the next start an exact warm restart), release the
+        WAL handle."""
+        self.stop()
+        if final_checkpoint:
+            try:
+                self.checkpoint_now()
+            except Exception as e:
+                log.logf(0, "durable: final checkpoint failed: %s", e)
+        with self.barrier:
+            self.closed = True
+            self.wal.close()
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /api + bench rollup block."""
+        return {
+            "dir": self.dir,
+            "interval_s": self.interval_s,
+            "checkpoints": self.ckpts_written,
+            "last_ckpt_ts": round(self.last_ckpt_ts, 3),
+            "last_ckpt_age_s": round(
+                self._clock() - self.last_ckpt_ts, 1)
+            if self.last_ckpt_ts else None,
+            "last_ckpt_error": self.last_ckpt_error,
+            "wal_bytes": self.wal.bytes_since_ckpt,
+            "wal_records": self.wal.records_appended,
+            "wal_errors": self.wal_errors,
+            "recovery_state": self.recovery_state,
+            "recovered": self.recovered.summary()
+            if self.recovered is not None else None,
+        }

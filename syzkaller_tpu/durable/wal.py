@@ -1,0 +1,198 @@
+"""Write-ahead log: the between-checkpoints half of durability.
+
+Every record is a self-validating frame
+
+    <I payload_len> <I crc32(payload)> payload
+    payload = <H kind_len> kind <I meta_len> meta_json blob
+
+appended + flushed (+ fsync'd unless TZ_CKPT_WAL_FSYNC=0) under the
+store's journal barrier.  A crash mid-append leaves a torn tail; the
+reader validates length + crc per frame and physically truncates the
+file to the last whole record (counted, `durable.wal_truncate` on the
+timeline) — replay then converges to exactly the state as of the last
+durable record, which is the contract the SIGKILL drill pins.
+
+A successful checkpoint resets the log to its header (the checkpoint
+image subsumes every journaled record); a FAILED checkpoint must
+leave the log intact, which is why reset() lives here as an explicit
+call and not inside append().
+
+The `durable.wal_append` fault seam sits before the write so the
+crash-consistency tests can script an append failing mid-stride.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Optional
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health.faultinject import fault_point
+from syzkaller_tpu.utils import log
+
+try:
+    import json
+except ImportError:  # pragma: no cover
+    json = None
+
+MAGIC = 0x745A774C  # "tzwL"
+CUR_VERSION = 1
+
+_HDR = struct.Struct("<II")  # magic, version
+_REC = struct.Struct("<II")  # payload length, crc32(payload)
+_KIND = struct.Struct("<H")  # kind length
+_META = struct.Struct("<I")  # meta-json length
+
+_M_RECORDS = telemetry.counter(
+    "tz_durable_wal_records_total",
+    "records appended to the write-ahead log")
+_M_TRUNCS = telemetry.counter(
+    "tz_durable_wal_truncations_total",
+    "torn WAL tails physically truncated on open")
+_M_ERRORS = telemetry.counter(
+    "tz_durable_wal_errors_total",
+    "WAL appends that failed (scripted seam or I/O error) — the "
+    "record is lost; recovery converges to the last durable one")
+_G_BYTES = telemetry.gauge(
+    "tz_durable_wal_bytes",
+    "WAL bytes accumulated since the last checkpoint")
+
+
+class WalRecord:
+    """One journaled operation: a kind tag, a small JSON meta dict,
+    and an optional raw blob (plane indices, result payloads)."""
+
+    __slots__ = ("kind", "meta", "blob")
+
+    def __init__(self, kind: str, meta: dict, blob: bytes = b""):
+        self.kind = kind
+        self.meta = meta
+        self.blob = blob
+
+    def __repr__(self) -> str:  # tests / debugging
+        return (f"WalRecord({self.kind!r}, {self.meta!r}, "
+                f"blob[{len(self.blob)}])")
+
+
+def _encode(kind: str, meta: dict, blob: bytes) -> bytes:
+    kb = kind.encode()
+    mb = json.dumps(meta, separators=(",", ":"),
+                    sort_keys=True).encode()
+    payload = _KIND.pack(len(kb)) + kb + _META.pack(len(mb)) + mb \
+        + bytes(blob)
+    return _REC.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def _decode_payload(payload: bytes) -> WalRecord:
+    (klen,) = _KIND.unpack_from(payload, 0)
+    pos = _KIND.size
+    kind = payload[pos:pos + klen].decode()
+    pos += klen
+    (mlen,) = _META.unpack_from(payload, pos)
+    pos += _META.size
+    meta = json.loads(payload[pos:pos + mlen].decode())
+    return WalRecord(kind, meta, payload[pos + mlen:])
+
+
+class WriteAheadLog:
+    """Append side.  Not thread-safe by itself — the DurableStore's
+    journal barrier serializes every append and the checkpoint reset."""
+
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = path
+        self.fsync = fsync
+        fresh = not os.path.exists(path) \
+            or os.path.getsize(path) < _HDR.size
+        self._f = open(path, "ab")
+        if fresh:
+            self._f.write(_HDR.pack(MAGIC, CUR_VERSION))
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        self.bytes_since_ckpt = max(
+            0, os.path.getsize(path) - _HDR.size)
+        self.records_appended = 0
+        _G_BYTES.set(self.bytes_since_ckpt)
+
+    def append(self, kind: str, meta: Optional[dict] = None,
+               blob: bytes = b"") -> None:
+        """Journal one record durably; raises on scripted seam faults
+        and I/O errors (the store decides whether to swallow)."""
+        frame = _encode(kind, meta or {}, blob)
+        with telemetry.span("durable.wal_append"):
+            fault_point("durable.wal_append")
+            self._f.write(frame)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+        self.bytes_since_ckpt += len(frame)
+        self.records_appended += 1
+        _M_RECORDS.inc()
+        _G_BYTES.set(self.bytes_since_ckpt)
+
+    def reset(self) -> None:
+        """Truncate back to the header after a successful checkpoint
+        (the image subsumes every journaled record)."""
+        self._f.truncate(_HDR.size)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self.bytes_since_ckpt = 0
+        _G_BYTES.set(0)
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+def read_wal(path: str) -> list[WalRecord]:
+    """Validate + decode every whole record; physically truncate the
+    file to the last good frame when the tail is torn or corrupt, so
+    post-recovery appends land after valid bytes (the same discipline
+    db.open_db applies to corpus.db)."""
+    if not os.path.exists(path):
+        return []
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < _HDR.size:
+        return []
+    magic, _ver = _HDR.unpack_from(data, 0)
+    if magic != MAGIC:
+        log.logf(0, "WAL %s: bad magic %#x; discarding", path, magic)
+        _M_TRUNCS.inc()
+        telemetry.record_event(
+            "durable.wal_truncate", f"{path}: bad magic, discarded")
+        with open(path, "r+b") as f:
+            f.truncate(0)
+        return []
+    records: list[WalRecord] = []
+    pos = _HDR.size
+    good = pos
+    while pos + _REC.size <= len(data):
+        plen, crc = _REC.unpack_from(data, pos)
+        end = pos + _REC.size + plen
+        if end > len(data):
+            break
+        payload = data[pos + _REC.size:end]
+        if zlib.crc32(payload) != crc:
+            break
+        try:
+            records.append(_decode_payload(payload))
+        except Exception:
+            break
+        pos = end
+        good = pos
+    if good < len(data):
+        torn = len(data) - good
+        _M_TRUNCS.inc()
+        telemetry.record_event(
+            "durable.wal_truncate",
+            f"{path}: {torn} torn tail bytes after "
+            f"{len(records)} good records")
+        log.logf(0, "WAL %s: truncating %d torn tail bytes "
+                 "(%d records recovered)", path, torn, len(records))
+        with open(path, "r+b") as f:
+            f.truncate(good)
+    return records

@@ -35,11 +35,15 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_BREAKER_BACKOFF_CAP_S",
     "TZ_BREAKER_BACKOFF_S",
     "TZ_BREAKER_THRESHOLD",
+    "TZ_CKPT_INTERVAL_S",
+    "TZ_CKPT_WAL_FSYNC",
+    "TZ_CKPT_WAL_MAX_MB",
     "TZ_COVERAGE_AUDIT_S",
     "TZ_COVERAGE_INTERVAL_S",
     "TZ_COVERAGE_RING",
     "TZ_COVERAGE_STALL_EDGES",
     "TZ_COVERAGE_STALL_WINDOW_S",
+    "TZ_DB_FSYNC",
     "TZ_FAULT_PLAN",
     "TZ_FLIGHT_DIR",
     "TZ_FLIGHT_RING",

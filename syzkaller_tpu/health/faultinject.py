@@ -51,6 +51,10 @@ SEAMS = (
     "queue.put",
     "mesh.shard_probe",
     "serve.compose",
+    "durable.ckpt_write",
+    "durable.wal_append",
+    "db.append",
+    "db.compact",
 )
 
 MODES = ("fail", "hang")

@@ -85,9 +85,23 @@ class Manager:
         self.serv = ManagerRPC(
             prios=[list(map(float, row)) for row in prios],
             on_new_input=self._on_new_input)
+        # Durable state (ISSUE 13): checkpoint + WAL under
+        # workdir/durable.  Opening the store runs recovery (checksum
+        # validation, torn-tail truncation, WAL replay) BEFORE the
+        # corpus load so a warm image can skip the full re-triage.
+        # TZ_CKPT_INTERVAL_S=0 disables the whole plane (cold starts
+        # only, exactly the pre-ISSUE-13 behavior).
+        from syzkaller_tpu.durable import DurableStore
+
+        self.durable = DurableStore.open(cfg.workdir)
+        recovered = self.durable.recovered \
+            if self.durable is not None else None
         self.corpus_db = open_db(os.path.join(cfg.workdir, "corpus.db"),
                                  version=CURRENT_DB_VERSION)
-        self._load_corpus()
+        if recovered and recovered.get("control"):
+            self._warm_restore(recovered)
+        else:
+            self._load_corpus()
         self.rpc_server = RPCServer(parse_addr(cfg.rpc))
         self.rpc_server.register("Manager", self.serv)
         # Serving plane (ISSUE 12): the multi-tenant request broker
@@ -98,6 +112,25 @@ class Manager:
         self.serve_plane = ServePlane(
             throttle_fn=self.serv.throttle_state)
         self.rpc_server.register("Serve", self.serve_plane)
+        if self.durable is not None:
+            from syzkaller_tpu import telemetry
+
+            if recovered and recovered.get("serve"):
+                self.serve_plane.durable_restore(recovered["serve"])
+            if recovered and recovered.get("coverage"):
+                telemetry.COVERAGE.restore_state(recovered["coverage"])
+            # Journal hooks + checkpoint providers, wired only after
+            # every restore so recovery itself never journals.
+            self.serv.durable = self.durable
+            self.serve_plane.durable = self.durable
+            telemetry.COVERAGE.journal = self.durable.journal
+            self.durable.register("control", self.serv.durable_export)
+            self.durable.register("serve",
+                                  self.serve_plane.durable_provider)
+            self.durable.register(
+                "coverage",
+                lambda: (telemetry.COVERAGE.export_state(), b""))
+            self.durable.start()
         self.rpc_server.serve_in_background()
         self.rpc_addr = self.rpc_server.addr
 
@@ -159,6 +192,49 @@ class Manager:
             log.logf(0, "dropped %d broken corpus programs", broken)
         self.serv.add_candidates(candidates)
         log.logf(0, "loaded %d corpus programs", len(candidates))
+        self.phase = PHASE_LOADED_CORPUS
+
+    def _warm_restore(self, recovered) -> None:
+        """Warm restart (ISSUE 13): install the recovered control
+        plane instead of re-queueing the whole corpus for triage,
+        then reconcile against corpus.db in both directions — DB
+        records the image never saw become cold-triage candidates
+        (just the delta, not the corpus), and recovered corpus
+        entries missing from the DB (a corpus_add journaled after the
+        last db flush the crash outran) are re-persisted."""
+        self.serv.durable_restore(recovered["control"])
+        known = set(self.serv.corpus)
+        with self.serv._lock:
+            known.update(
+                hash_string((c.get("prog") or "").encode())
+                for c in self.serv.candidates)
+        delta, broken = [], 0
+        for key, rec in list(self.corpus_db.records.items()):
+            if key in known:
+                continue
+            try:
+                deserialize_prog(self.target, rec.val)
+            except ParseError:
+                self.corpus_db.delete(key)
+                broken += 1
+                continue
+            delta.append(RPCCandidate(prog=rec.val.decode(),
+                                      minimized=True, smashed=True))
+        repersisted = 0
+        for key, art in list(self.serv.corpus.items()):
+            if key not in self.corpus_db.records:
+                prog = (art.get("prog") or "").encode()
+                if prog:
+                    self.corpus_db.save(key, prog, 0)
+                    repersisted += 1
+        self.corpus_db.flush()
+        if delta:
+            self.serv.add_candidates(delta)
+        log.logf(0, "warm restart: %d corpus programs restored, %d "
+                 "candidates queued (%d db-only), %d re-persisted, "
+                 "%d broken dropped",
+                 len(self.serv.corpus), len(self.serv.candidates),
+                 len(delta), repersisted, broken)
         self.phase = PHASE_LOADED_CORPUS
 
     def _on_new_input(self, inp: RPCInput) -> bool:
@@ -427,4 +503,14 @@ class Manager:
         self.rpc_server.close()
         if self.http_server is not None:
             self.http_server.shutdown()
+        if self.durable is not None:
+            from syzkaller_tpu import telemetry
+
+            # Detach the process-global coverage hook before releasing
+            # the WAL handle: the tracker outlives this manager.
+            if telemetry.COVERAGE.journal == self.durable.journal:
+                telemetry.COVERAGE.journal = None
+            # Final checkpoint + WAL reset: a clean shutdown leaves a
+            # complete image, so the next start is warm by default.
+            self.durable.close()
         self.corpus_db.flush()

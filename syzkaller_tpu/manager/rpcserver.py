@@ -37,6 +37,7 @@ The fleet-resilience layer (docs/health.md "control-plane sessions"):
 
 from __future__ import annotations
 
+import contextlib
 import random
 import threading
 import time
@@ -167,6 +168,22 @@ class ManagerRPC:
         # Reply caches of reaped fuzzers, so late retries of applied
         # seqs still replay (name -> reply_cache), insertion-ordered.
         self._tombstones: dict[str, dict[int, dict]] = {}
+        # Durability (syzkaller_tpu/durable): when attached, custody-
+        # ledger transitions journal under the store barrier and the
+        # corpus/queue/ledgers become the "control" checkpoint section.
+        self.durable = None
+
+    def _barrier(self):
+        """The store's journal barrier, or a no-op: ledger mutation +
+        its WAL record must be atomic w.r.t. checkpoint snapshots
+        (durable/store.py module doc)."""
+        d = self.durable
+        return d.barrier if d is not None else contextlib.nullcontext()
+
+    def _journal(self, kind: str, meta: dict, blob: bytes = b"") -> None:
+        d = self.durable
+        if d is not None:
+            d.journal(kind, meta, blob)
 
     # -- candidate feeding ------------------------------------------------
 
@@ -175,9 +192,12 @@ class ManagerRPC:
         distribution spread.  Queued once: inputs lost to a crashing
         VM come back through lease-tracked reissue (reap/_settle), not
         the reference's blind 2x duplication (manager.go:245-256)."""
-        with self._lock:
-            self.candidates.extend(c.to_dict() for c in candidates)
+        cands = [c.to_dict() for c in candidates]
+        with self._barrier(), self._lock:
+            self.candidates.extend(cands)
             random.shuffle(self.candidates)
+            if cands:
+                self._journal("cand_add", {"cands": cands})
 
     def candidate_backlog(self) -> int:
         """Candidates not yet confirmed executed: the queue plus every
@@ -258,6 +278,7 @@ class ManagerRPC:
             del self.fuzzers[f.name]
             self.reaped_total += 1
             _M_REAPED.inc()
+            self._journal("cand_requeue", {"name": f.name})
             self._tombstones[f.name] = f.reply_cache
             while len(self._tombstones) > _MAX_TOMBSTONES:
                 del self._tombstones[next(iter(self._tombstones))]
@@ -374,7 +395,7 @@ class ManagerRPC:
     def reap_expired(self) -> None:
         """Explicit reap pass (the Manager's periodic loop / tests);
         sessioned calls also reap opportunistically."""
-        with self._lock:
+        with self._barrier(), self._lock:
             self._reap_locked()
 
     def throttle_state(self) -> str:
@@ -418,11 +439,12 @@ class ManagerRPC:
         state's candidates to the queue and starts clean — the full
         corpus in this reply supersedes any queued inputs."""
         name = params.get("name", "fuzzer")
-        with self._lock:
+        with self._barrier(), self._lock:
             self._reap_locked()
             old = self.fuzzers.get(name)
             if old is not None:
                 self._requeue_candidates_locked(old)
+                self._journal("cand_requeue", {"name": name})
             self._tombstones.pop(name, None)
             f = FuzzerState(name=name, last_seen=self._clock())
             self.fuzzers[name] = f
@@ -452,11 +474,12 @@ class ManagerRPC:
         """A fuzzer triaged a new corpus input: dedup by signal diff,
         persist, broadcast to other fuzzers
         (reference: manager.go:976-1025)."""
-        cached = self._session_precheck(params)
-        if cached is not None:
-            return cached
-        reply = self._new_input(params)
-        return self._session_commit(params, reply)
+        with self._barrier():
+            cached = self._session_precheck(params)
+            if cached is not None:
+                return cached
+            reply = self._new_input(params)
+            return self._session_commit(params, reply)
 
     def _new_input(self, params: dict) -> dict:
         name = params.get("name", "fuzzer")
@@ -476,10 +499,16 @@ class ManagerRPC:
                 old.merge(sig)
                 art["signal"] = list(old.serialize())
             else:
-                self.corpus[key] = inp.to_dict()
+                art = self.corpus[key] = inp.to_dict()
             self.corpus_signal.merge(sig)
             self.max_signal.merge(sig)
             self.cover.update(int(pc) for pc in inp.cover)
+            # The record carries the POST-merge artifact + the signal
+            # diff, so replay is idempotent and order-independent
+            # w.r.t. the checkpoint (durable/recovery.py module doc).
+            self._journal("corpus_add",
+                          {"key": key, "input": dict(art),
+                           "diff": list(diff.serialize())})
             for fname, f in self.fuzzers.items():
                 if fname != name:
                     self._queue_input_locked(f, inp.to_dict())
@@ -491,11 +520,12 @@ class ManagerRPC:
     def Poll(self, params: dict) -> dict:
         """Periodic sync: stats up, candidates/new-inputs/max-signal
         down (reference: manager.go:1027-1081)."""
-        cached = self._session_precheck(params)
-        if cached is not None:
-            return cached
-        reply = self._poll(params)
-        return self._session_commit(params, reply)
+        with self._barrier():
+            cached = self._session_precheck(params)
+            if cached is not None:
+                return cached
+            reply = self._poll(params)
+            return self._session_commit(params, reply)
 
     def _poll(self, params: dict) -> dict:
         name = params.get("name", "fuzzer")
@@ -514,13 +544,19 @@ class ManagerRPC:
             f.device_state = str(params.get("device_state")
                                  or "closed")
             if seq:
-                self._settle_candidates_locked(
-                    f, seq, ack_seq,
-                    int(stats.get(_CANDIDATE_STAT) or 0))
+                executed = int(stats.get(_CANDIDATE_STAT) or 0)
+                self._settle_candidates_locked(f, seq, ack_seq,
+                                               executed)
+                self._journal("cand_settle",
+                              {"name": name, "seq": seq,
+                               "ack_seq": ack_seq,
+                               "executed": executed})
             new_sig = Signal.deserialize(fuzzer_max[0], fuzzer_max[1])
             diff = self.max_signal.diff(new_sig)
             if not diff.empty():
                 self.max_signal.merge(diff)
+                self._journal("max_sig",
+                              {"sig": list(diff.serialize())})
                 for fname, other in self.fuzzers.items():
                     if fname != name:
                         self._queue_signal_locked(other, diff)
@@ -536,6 +572,9 @@ class ManagerRPC:
                 self.triaged_candidates += n
                 if seq and candidates:
                     f.inflight.append((seq, list(candidates)))
+                    self._journal("cand_issue",
+                                  {"name": name, "seq": seq,
+                                   "cands": candidates})
             if f.signal_resync:
                 # The pending delta overflowed its cap at some point:
                 # serve the full max signal (a superset of everything
@@ -551,6 +590,54 @@ class ManagerRPC:
             self.on_stats(stats)
         return {"candidates": candidates, "new_inputs": inputs,
                 "max_signal": list(max_out), "throttle": throttle}
+
+    # -- durability (syzkaller_tpu/durable) --------------------------------
+
+    def durable_export(self) -> tuple:
+        """The "control" checkpoint section: candidate queue, corpus,
+        signal aggregates, and the per-fuzzer custody ledgers — all
+        JSON meta, no blob.  Called by DurableStore.checkpoint_now
+        under the store barrier; taking self._lock here respects the
+        barrier -> domain lock order."""
+        with self._lock:
+            meta = {
+                "queue": [dict(c) for c in self.candidates],
+                "corpus": {k: dict(v)
+                           for k, v in self.corpus.items()},
+                "corpus_signal": list(self.corpus_signal.serialize()),
+                "max_signal": list(self.max_signal.serialize()),
+                "cover": sorted(self.cover),
+                "triaged": self.triaged_candidates,
+                "fuzzers": {
+                    name: {
+                        "inflight": [[seq, [dict(c) for c in batch]]
+                                     for seq, batch in f.inflight],
+                        "owned": [dict(c) for c in f.owned],
+                    } for name, f in self.fuzzers.items()},
+            }
+        return meta, b""
+
+    def durable_restore(self, state: dict) -> None:
+        """Install a recovered control plane (recovery.replay's
+        "control" value).  Custody is already collapsed into the
+        queue; fuzzer sessions are NOT restored — this instance's
+        fresh epoch forces every fuzzer to re-Connect."""
+
+        def _as_sig(v):
+            if isinstance(v, Signal):
+                return v
+            return Signal.deserialize(v[0], v[1]) if v else Signal()
+
+        with self._lock:
+            self.candidates = [dict(c)
+                               for c in state.get("queue") or []]
+            self.corpus = {k: dict(v) for k, v
+                           in (state.get("corpus") or {}).items()}
+            self.corpus_signal = _as_sig(state.get("corpus_signal"))
+            self.max_signal = _as_sig(state.get("max_signal"))
+            self.cover = set(int(pc)
+                             for pc in state.get("cover") or ())
+            self.triaged_candidates = int(state.get("triaged") or 0)
 
     # -- introspection ----------------------------------------------------
 

@@ -749,6 +749,63 @@ class DevicePipeline:
         if self.triage_engine is not None:
             engine.attach_triage(self.triage_engine)
 
+    def attach_durable(self, store, recovered=None) -> None:
+        """Wire the device-side durable sections (ISSUE 13): the
+        triage engine's signal-plane mirror journals/checkpoints
+        through `store`, the fused drain's mutant plane becomes a
+        checkpoint section, and a recovered image re-installs through
+        the existing host-mirror paths — one H2D re-upload each via
+        `_ensure_plane_locked`/`jnp.asarray`, zero new jit compiles
+        (the warm-rig compile guard in test_health_faults pins this).
+        Call after attach_triage; `recovered` is the store's
+        RecoveredState (or None on a cold start)."""
+        rec = recovered or {}
+        if self.triage_engine is not None:
+            self.triage_engine.durable = store
+            store.register("signal_plane",
+                           self.triage_engine.durable_provider)
+            mirror = rec.get("signal_mirror")
+            if mirror is not None:
+                try:
+                    self.triage_engine.restore_mirror(mirror)
+                except ValueError:
+                    pass  # plane size changed across the restart
+        store.register("mutant_plane", self.durable_mutant_plane)
+        mp = rec.get("mutant_plane")
+        if mp is not None:
+            self.restore_mutant_plane(mp.get("plane"),
+                                      bits=mp.get("bits"))
+
+    def durable_mutant_plane(self) -> tuple:
+        """Checkpoint section: the fused drain's device mutant plane,
+        pulled D2H at checkpoint cadence (one blocking transfer; the
+        plane is 2^bits bytes)."""
+        from syzkaller_tpu.ops.signal import pack_plane
+
+        plane = self._mutant_plane
+        if plane is None:
+            arr = np.zeros(1 << self._plane_bits, np.uint8)
+        else:
+            arr = np.asarray(plane, dtype=np.uint8)
+        return ({"bits": int(self._plane_bits),
+                 "size": int(arr.size)}, pack_plane(arr))
+
+    def restore_mutant_plane(self, plane, bits=None) -> None:
+        """Install a recovered mutant plane: one H2D upload through
+        the same jnp.asarray path _launch would otherwise use to
+        build a zero plane — no new jit.  A bits mismatch (operator
+        changed TZ_MUTANT_PLANE_BITS) discards the recovered plane;
+        dedup history is advisory, so a cold plane only re-ships old
+        mutants once."""
+        if plane is None:
+            return
+        if bits is not None and int(bits) != self._plane_bits:
+            return
+        arr = np.asarray(plane, dtype=np.uint8)
+        if arr.size != (1 << self._plane_bits):
+            return
+        self._mutant_plane = self._jnp.asarray(arr)
+
     def health_snapshot(self) -> dict:
         """Breaker + watchdog state for tests and the status page."""
         out = {

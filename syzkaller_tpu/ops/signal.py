@@ -155,6 +155,26 @@ def new_mutant_plane(bits: int = MUTANT_PLANE_BITS_DEFAULT) -> jax.Array:
     return jnp.zeros(1 << bits, dtype=jnp.uint8)
 
 
+def pack_plane(arr) -> bytes:
+    """Host-side codec for checkpointing a plane (signal mirror or a
+    mutant plane pulled D2H): the durable checkpoint's zlib section
+    format (durable/checkpoint.pack_section) — one codec everywhere,
+    so a plane packed by any owner unpacks on the jax-free recovery
+    path bit-for-bit."""
+    from syzkaller_tpu.durable.checkpoint import pack_section
+
+    return pack_section(arr)
+
+
+def unpack_plane(blob: bytes, size: int):
+    """Inverse of pack_plane: uint8[size] numpy (never a device
+    array — recovery re-uploads through the owner's existing H2D
+    path, not through device code here)."""
+    from syzkaller_tpu.durable.checkpoint import unpack_section
+
+    return unpack_section(blob, size)
+
+
 def hash_rows(rows):
     """FNV-1a over each packed delta row's bytes: uint8[B, row_bytes]
     -> uint32[B].  Runs inside the fused step jit, so the loop over

@@ -41,6 +41,7 @@ the socket without a per-mutant copy — the JSON carries only
 
 from __future__ import annotations
 
+import contextlib
 import random
 import threading
 import time
@@ -168,6 +169,22 @@ class ServePlane:
         self._rid = 0
         self.reaped_total = 0
         self.replays_total = 0
+        # Durability (syzkaller_tpu/durable): when attached, delivery-
+        # ledger transitions journal under the store barrier and the
+        # tenant queues/credits become a checkpoint section.
+        self.durable = None
+
+    def _barrier(self):
+        """The store's journal barrier, or a no-op: ledger mutation +
+        its WAL record must be atomic w.r.t. checkpoint snapshots
+        (durable/store.py module doc)."""
+        d = self.durable
+        return d.barrier if d is not None else contextlib.nullcontext()
+
+    def _journal(self, kind: str, meta: dict, blob: bytes = b"") -> None:
+        d = self.durable
+        if d is not None:
+            d.journal(kind, meta, blob)
 
     # -- session plumbing (the PR 8 discipline) ---------------------------
 
@@ -220,6 +237,7 @@ class ServePlane:
             del self.tenants[t.name]
             self.reaped_total += 1
             _M_REAPED.inc()
+            self._journal("serve_reap", {"tenant": t.name})
             self._tombstones[t.name] = t.reply_cache
             while len(self._tombstones) > _MAX_TOMBSTONES:
                 del self._tombstones[next(iter(self._tombstones))]
@@ -270,7 +288,7 @@ class ServePlane:
         items to the queue front, since any un-acked reply died with
         the old connection."""
         name = params.get("name", "tenant")
-        with self._lock:
+        with self._barrier(), self._lock:
             self._reap_locked()
             old = self.tenants.get(name)
             if old is None and len(self.tenants) >= self.max_tenants:
@@ -289,6 +307,7 @@ class ServePlane:
             self._tombstones.pop(name, None)
             self.tenants[name] = t
             _G_TENANTS.set(len(self.tenants))
+            self._journal("serve_connect", {"tenant": name})
             return {"epoch": self.epoch, "lease_s": self.lease_s,
                     "queue_cap": self.queue_cap}
 
@@ -297,11 +316,12 @@ class ServePlane:
         annex is the zero-copy concatenation of every shipped
         payload; reply["results"] carries (tenant, rid, off, len)
         refs into it."""
-        cached = self._session_precheck(params)
-        if cached is not None:
-            return cached
-        reply = self._poll(params)
-        return self._session_commit(params, reply)
+        with self._barrier():
+            cached = self._session_precheck(params)
+            if cached is not None:
+                return cached
+            reply = self._poll(params)
+            return self._session_commit(params, reply)
 
     def _poll(self, params: dict) -> tuple:
         name = params.get("name", "tenant")
@@ -317,6 +337,9 @@ class ServePlane:
                 _G_TENANTS.set(len(self.tenants))
             if seq:
                 self._settle_locked(t, seq, ack_seq)
+                self._journal("serve_settle",
+                              {"tenant": name, "seq": seq,
+                               "ack_seq": ack_seq})
             t.demand_rows = max(0, int(demand.get("backlog") or 0))
             rate = float(demand.get("exec_rate") or 0.0)
             t.exec_rate_ewma += EWMA_ALPHA * (rate - t.exec_rate_ewma)
@@ -329,6 +352,9 @@ class ServePlane:
             items = [t.pending.popleft() for _ in range(n)]
             if seq and items:
                 t.inflight.append((seq, list(items)))
+                self._journal("serve_issue",
+                              {"tenant": name, "seq": seq,
+                               "n": len(items)})
             t.q_gauge.set(len(t.pending))
             _G_DEMAND.set(sum(x.outstanding_demand()
                               for x in self.tenants.values()))
@@ -365,15 +391,26 @@ class ServePlane:
         device rows this tenant's allocation consumed, `novel` the
         plane-novel count (feeds the QoS novelty EWMA).  Returns the
         number queued (0 if the tenant vanished mid-compose)."""
-        with self._lock:
+        with self._barrier(), self._lock:
             t = self.tenants.get(tenant)
             if t is None:
                 return 0
+            rids = []
             for payload in payloads:
                 self._rid += 1
-                t.pending.append((f"{tenant}:{self._rid}", payload))
+                rid = f"{tenant}:{self._rid}"
+                rids.append(rid)
+                t.pending.append((rid, payload))
             t.rows_spent += rows_spent
             t.q_gauge.set(len(t.pending))
+            if payloads or rows_spent:
+                self._journal(
+                    "serve_offer",
+                    {"tenant": tenant, "rids": rids,
+                     "lens": [len(p) for p in payloads],
+                     "rows_spent": int(rows_spent),
+                     "novel": int(novel), "rid_after": self._rid},
+                    b"".join(bytes(p) for p in payloads))
         t.m_rows.inc(rows_spent)
         if payloads:
             t.m_results.inc(len(payloads))
@@ -392,8 +429,71 @@ class ServePlane:
         return len(payloads)
 
     def reap_expired(self) -> None:
-        with self._lock:
+        with self._barrier(), self._lock:
             self._reap_locked()
+
+    # -- durability (syzkaller_tpu/durable) --------------------------------
+
+    def durable_provider(self) -> tuple:
+        """Checkpoint section: every tenant's delivery queue + QoS
+        state.  In-flight custody is collapsed to the queue front at
+        EXPORT (same order _settle_locked would restore), because a
+        restarted broker re-mints its epoch and every tenant
+        re-Connects — there is no session for the in-flight seqs to
+        settle against."""
+        with self._lock:
+            parts: list[bytes] = []
+            tenants: dict = {}
+            off = 0
+            for name, t in self.tenants.items():
+                items = []
+                entries = [it for _seq, its in t.inflight
+                           for it in its] + list(t.pending)
+                for rid, payload in entries:
+                    b = bytes(payload)
+                    items.append([rid, off, len(b)])
+                    parts.append(b)
+                    off += len(b)
+                tenants[name] = {
+                    "credit": t.credit,
+                    "novelty_ewma": t.novelty_ewma,
+                    "stalled": t.stalled,
+                    "rows_spent": t.rows_spent,
+                    "delivered": t.delivered,
+                    "demand_rows": t.demand_rows,
+                    "items": items,
+                }
+            return ({"rid": self._rid, "tenants": tenants},
+                    b"".join(parts))
+
+    def durable_restore(self, state: dict) -> None:
+        """Install recovered tenant ledgers (recovery.replay's "serve"
+        value).  Recovered tenants get `last_seen = 0` — no live
+        lease, so they are never reaped for idling before their VM
+        re-Connects, and Connect keeps their pending queue."""
+        gauges = []
+        with self._lock:
+            self._rid = max(self._rid, int(state.get("rid") or 0))
+            now = self._clock()
+            for name, st in (state.get("tenants") or {}).items():
+                t = self.tenants.get(name)
+                if t is None:
+                    t = TenantState(name=name, now=now)
+                    t.last_seen = 0.0
+                    self.tenants[name] = t
+                t.pending = deque(
+                    (rid, bytes(payload))
+                    for rid, payload in st.get("pending") or [])
+                t.credit = float(st.get("credit", 1.0))
+                t.novelty_ewma = float(st.get("novelty_ewma", 0.0))
+                t.stalled = bool(st.get("stalled", False))
+                t.rows_spent = int(st.get("rows_spent", 0))
+                t.delivered = int(st.get("delivered", 0))
+                gauges.append((t, len(t.pending)))
+            _G_TENANTS.set(len(self.tenants))
+        for t, depth in gauges:
+            t.q_gauge.set(depth)
+            t.c_gauge.set(round(t.credit, 4))
 
     def snapshot(self) -> dict:
         """The /api/serve body (manager/html.py) and the bench/

@@ -145,9 +145,17 @@ class BatchComposer:
                 if abs(t.credit - old) > 1e-6:
                     moved.append(f"{t.name}:{old:.2f}->{t.credit:.2f}")
             credits = {t.name: t.credit for t in tenants}
+            stalled = {t.name: t.stalled for t in tenants}
+            ewma = {t.name: t.novelty_ewma for t in tenants}
         if moved:
             telemetry.record_event(
                 "serve.credits", " ".join(sorted(moved)))
+        if credits:
+            # Idempotent overwrite record, journaled after the broker
+            # lock is released (durable/store.py lock-order rule).
+            self.broker._journal("credit", {"credits": credits,
+                                            "ewma": ewma,
+                                            "stalled": stalled})
         return credits
 
     # -- batch composition -------------------------------------------------
@@ -215,6 +223,7 @@ class BatchComposer:
                         "tenant_col": tenant_col,
                         "order": [t for t, _n in alloc]}
         off = 0
+        ewmas: dict[str, float] = {}
         for tenant, n in alloc:
             t_rows = rows[off:off + n]
             t_payloads = payloads[off:off + n]
@@ -229,9 +238,12 @@ class BatchComposer:
                 if t is not None:
                     t.novelty_ewma += EWMA_ALPHA * (
                         idx.size / max(1, n) - t.novelty_ewma)
+                    ewmas[tenant] = t.novelty_ewma
             report["tenants"][tenant] = {
                 "rows": n, "novel": int(idx.size),
                 "novel_idx": [int(j) for j in idx]}
+        if ewmas:
+            self.broker._journal("credit", {"ewma": ewmas})
         _M_BATCHES.inc()
         return report
 

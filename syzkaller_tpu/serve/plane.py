@@ -85,6 +85,10 @@ class TenantPlanes:
         self._occupancy: dict[str, int] = {}
         self._g_occ: dict[str, object] = {}
         self._g_fn: dict[str, object] = {}
+        # Durability (syzkaller_tpu/durable): a DurableStore.journal
+        # callable; verdicts journal their folded bucket indices so
+        # replay reproduces each tenant's plane without re-hashing.
+        self.journal = None
 
     def _ensure_locked(self, tenant: str) -> np.ndarray:
         plane = self._planes.get(tenant)
@@ -122,6 +126,13 @@ class TenantPlanes:
             g_occ, g_fn = self._g_occ[tenant], self._g_fn[tenant]
         g_occ.set(occ)
         g_fn.set(round(occ / self.size, 6))
+        if self.journal is not None:
+            # After the mutation, outside the lock: replay is an
+            # idempotent set-to-1, so racing a checkpoint is harmless
+            # (durable/store.py module doc has the lock-order rule).
+            self.journal("tplane", {"tenant": tenant,
+                                    "bits": int(self.bits)},
+                         idx.astype(np.uint32).tobytes())
         return novel
 
     def invalidate(self, tenant: str) -> int:
@@ -150,6 +161,51 @@ class TenantPlanes:
     def epoch(self, tenant: str) -> int:
         with self._lock:
             return self._epochs.get(tenant, 0)
+
+    def durable_provider(self) -> tuple:
+        """Checkpoint section: every tenant's plane, zlib-packed with
+        per-tenant slices in the meta (DurableStore.register)."""
+        from syzkaller_tpu.durable.checkpoint import pack_section
+
+        with self._lock:
+            parts: list[bytes] = []
+            tenants: dict = {}
+            off = 0
+            for name, plane in self._planes.items():
+                b = pack_section(plane)
+                tenants[name] = {"off": off, "len": len(b),
+                                 "epoch": self._epochs.get(name, 0)}
+                parts.append(b)
+                off += len(b)
+        return ({"bits": int(self.bits), "tenants": tenants},
+                b"".join(parts))
+
+    def durable_restore(self, state: dict) -> None:
+        """Install recovered tenant planes (recovery.replay's
+        "tenant_planes" value).  A bits mismatch (operator changed
+        TZ_SERVE_PLANE_BITS across the restart) discards the recovered
+        planes — novelty verdicts then cold-start, which only costs
+        re-serving old news, never correctness."""
+        bits = int(state.get("bits") or self.bits)
+        if bits != self.bits:
+            return
+        gauges = []
+        with self._lock:
+            for name, arr in (state.get("planes") or {}).items():
+                arr = np.asarray(arr, dtype=np.uint8)
+                if arr.size != self.size:
+                    continue
+                plane = self._ensure_locked(name)
+                plane[:] = arr
+                occ = int(np.count_nonzero(plane))
+                self._occupancy[name] = occ
+                self._epochs[name] = int(
+                    (state.get("epochs") or {}).get(name, 0))
+                gauges.append((self._g_occ[name], self._g_fn[name],
+                               occ))
+        for g_occ, g_fn, occ in gauges:
+            g_occ.set(occ)
+            g_fn.set(round(occ / self.size, 6))
 
     def analytics(self) -> dict:
         """Per-tenant occupancy/FN-rate rollup — threaded through the

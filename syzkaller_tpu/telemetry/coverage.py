@@ -123,6 +123,10 @@ class CoverageTracker:
         self._drift = {"ts": 0.0, "buckets": 0, "audits": 0}
         self._by_source: dict[str, int] = dict.fromkeys(SOURCES, 0)
         self._by_proc: dict[str, int] = {}
+        # Durability (syzkaller_tpu/durable): a DurableStore.journal
+        # callable; each growth-curve point journals a "cov" record so
+        # the curve/EWMA survive a manager crash between checkpoints.
+        self.journal = None
         self._src_counters = {
             s: telemetry.counter(
                 "tz_coverage_novel_edges_total",
@@ -233,7 +237,17 @@ class CoverageTracker:
                 self._stalls += 1
                 stalled_now = (in_window, window)
             ewma = self._ewma_rate
+            point = (round(now, 3), self._occupancy, delta,
+                     ewma, self._novel_total)
         self._g_rate.set(round(ewma, 6))
+        journal = self.journal
+        if journal is not None:
+            # After the mutation, outside the lock: the "cov" record
+            # is an idempotent overwrite+append (durable/recovery.py),
+            # so racing a checkpoint is harmless.
+            ts, occ, delta, ewma, total = point
+            journal("cov", {"ts": ts, "occ": occ, "delta": delta,
+                            "ewma": round(ewma, 9), "total": total})
         if stalled_now is not None:
             self._note_stalled(*stalled_now)
 
@@ -293,6 +307,56 @@ class CoverageTracker:
         out["growth_curve"] = self.curve()
         out["attribution"] = self.attribution()
         return out
+
+    def export_state(self) -> dict:
+        """The durable checkpoint's "coverage" section meta (all-JSON,
+        no blob): growth ring, EWMA, attribution, stall bookkeeping.
+        Timestamps are absolute (the tracker's time_fn is wallclock in
+        production), so a warm restart keeps the curve continuous."""
+        with self._lock:
+            return {
+                "ring": [[ts, occ, d] for ts, occ, d in self._ring],
+                "t0": self._t0,
+                "last_tick": self._last_tick,
+                "last_novel_ts": self._last_novel_ts,
+                "novel_total": self._novel_total,
+                "ewma_rate": self._ewma_rate,
+                "stalled": self._stalled,
+                "stalls": self._stalls,
+                "occupancy": self._occupancy,
+                "by_source": dict(self._by_source),
+                "by_proc": dict(self._by_proc),
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Install a recovered curve (recovery.replay's "coverage"
+        value — export_state() plus any journaled "cov" points)."""
+        with self._lock:
+            self._ring.clear()
+            for pt in state.get("ring") or []:
+                self._ring.append((float(pt[0]), int(pt[1]),
+                                   int(pt[2])))
+            now = self._time()
+            self._t0 = float(state.get("t0") or self._t0)
+            self._last_tick = min(
+                now, float(state.get("last_tick") or now))
+            self._last_novel_ts = min(
+                now, float(state.get("last_novel_ts") or now))
+            self._novel_total = int(state.get("novel_total") or 0)
+            self._ewma_rate = float(state.get("ewma_rate") or 0.0)
+            self._stalled = bool(state.get("stalled", False))
+            self._stalls = int(state.get("stalls") or 0)
+            self._occupancy = int(state.get("occupancy") or 0)
+            for s, n in (state.get("by_source") or {}).items():
+                if s in self._by_source:
+                    self._by_source[s] = int(n)
+            self._by_proc = {str(k): int(v) for k, v
+                             in (state.get("by_proc") or {}).items()}
+            ewma, occ, stalled = (self._ewma_rate, self._occupancy,
+                                  self._stalled)
+        self._g_rate.set(round(ewma, 6))
+        self._g_occ.set(occ)
+        self._g_stalled.set(1 if stalled else 0)
 
     def reset(self) -> None:
         """Back to construction state (tests); registry counters are

@@ -283,6 +283,33 @@ def wedge_report(snap: dict) -> list[str]:
             line += (f" ({int(requeued)} results requeued, "
                      f"{int(dropped)} dropped with reaped leases)")
         lines.append(line)
+    # Durability plane (ISSUE 13): checkpoint freshness, WAL growth,
+    # and the recovery verdict — a manager that died and warm-started
+    # announces it here, and a stale checkpoint age next to a fat WAL
+    # means the snapshot thread is wedged while the journal absorbs
+    # every mutation (replay cost is growing unbounded).
+    ckpts = counters.get("tz_durable_ckpts_total") or 0
+    rec_state = gauges.get("tz_durable_recovery_state")
+    if ckpts or rec_state is not None:
+        verdict = {0: "cold start", 1: "warm restart",
+                   2: "recovery FAILED -> cold"}.get(
+            int(rec_state or 0), "?")
+        line = f"durability: {verdict}, {int(ckpts)} checkpoints"
+        last_ts = gauges.get("tz_durable_ckpt_last_ts") or 0
+        if last_ts:
+            age = max(0.0, (snap.get("ts") or time.time()) - last_ts)
+            line += f", last {age:.0f}s ago"
+        wal = gauges.get("tz_durable_wal_bytes") or 0
+        if wal:
+            line += f", WAL {wal / 1024:.1f} KiB"
+        trunc = counters.get("tz_durable_wal_truncations_total") or 0
+        werr = counters.get("tz_durable_wal_errors_total") or 0
+        cerr = counters.get("tz_durable_ckpt_errors_total") or 0
+        if trunc or werr or cerr:
+            line += (f" ({int(trunc)} torn tails truncated, "
+                     f"{int(werr)} wal errors, "
+                     f"{int(cerr)} ckpt errors)")
+        lines.append(line)
     # Fault-domain mesh health (ISSUE 11): topology width, per-shard
     # breaker states, and the last re-shard age — a demoted shard
     # shows here as e.g. "3:open" while the engine keeps serving from

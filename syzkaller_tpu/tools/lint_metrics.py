@@ -39,10 +39,11 @@ import sys
 #: the tree must be registered.  Prefix-only literals ("tz_breaker_")
 #: used for startswith() filtering intentionally do not match.
 #: `rate`/`occupancy` cover the triage-plane gauges (ISSUE 4:
-#: fold-false-negative rate, plane bucket occupancy).
+#: fold-false-negative rate, plane bucket occupancy); `state` covers
+#: the durable-recovery outcome gauge (ISSUE 13).
 METRIC_SHAPE = re.compile(
     r"^tz_[a-z0-9_]+_(?:total|seconds|bytes|depth|size|ts|rate"
-    r"|occupancy)$")
+    r"|occupancy|state)$")
 
 _REG_RE = re.compile(
     r"""(?:counter|gauge|histogram)\(\s*['"]([a-z0-9_.]+)['"]""")

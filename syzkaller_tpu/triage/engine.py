@@ -264,6 +264,10 @@ class TriageEngine:
         # Serving plane (serve/plane.py): when attached, per-tenant
         # novelty-plane occupancy/FN-rate rides the analytics rollup.
         self._tenant_planes = None
+        # Durability (syzkaller_tpu/durable): when attached, merges
+        # journal their folded indices and the mirror becomes a
+        # checkpoint section (durable_provider / restore_mirror).
+        self.durable = None
 
     @classmethod
     def for_pipeline(cls, pipeline, **kw) -> "TriageEngine":
@@ -307,6 +311,16 @@ class TriageEngine:
             idx = dsig.fold_hash_np(edges)
             np.maximum.at(self._mirror, idx, np.uint8(prio + 1))
             self._pending.append((edges, prio))
+        if self.durable is not None:
+            # Journaled AFTER the mutation and OUTSIDE the merge lock
+            # (lock order: barrier -> domain; replay is an idempotent
+            # max-merge, so a checkpoint racing this append at worst
+            # double-applies the indices harmlessly).  The folded
+            # indices — not the raw edges — keep replay jax-free.
+            self.durable.journal(
+                "merge",
+                {"prio": int(prio), "size": int(self._mirror.size)},
+                idx.astype(np.uint32).tobytes())
 
     def invalidate_device_plane(self) -> None:
         """Drop the device plane; the next flush re-uploads the host
@@ -385,6 +399,36 @@ class TriageEngine:
         from exactly the signal this engine has accepted."""
         with self._merge_lock:
             return self._mirror.copy()
+
+    def durable_provider(self) -> tuple:
+        """Checkpoint section for the signal plane: the host mirror,
+        zlib-packed (DurableStore.register("signal_plane", ...))."""
+        from syzkaller_tpu.durable.checkpoint import pack_section
+
+        with self._merge_lock:
+            blob = pack_section(self._mirror)
+            size = self._mirror.size
+        return {"size": int(size)}, blob
+
+    def restore_mirror(self, mirror) -> None:
+        """Install a recovered host mirror as the rebuild authority.
+        The device plane is dropped, NOT uploaded here: the next flush
+        re-uploads through the existing _ensure_plane_locked rebuild
+        (one H2D via the same jnp.asarray path — zero new jit
+        compiles, the property the warm-rig guard pins), and the epoch
+        bump stales any in-flight staged slot exactly like
+        invalidate_device_plane."""
+        arr = np.asarray(mirror, dtype=np.uint8)
+        if arr.size != self._mirror.size:
+            raise ValueError(
+                f"recovered mirror has {arr.size} buckets; this "
+                f"engine's plane is {self._mirror.size}")
+        with self._device_lock, self._merge_lock:
+            self._mirror = arr.copy()
+            self._note_occupancy(int(np.count_nonzero(self._mirror)))
+            self._pending.clear()
+            self._plane_dev = None
+            self._epoch += 1
 
     def share_plane_sharded(self, mesh):
         """The rebuild authority uploaded cov-sharded over a mesh —

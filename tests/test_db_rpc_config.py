@@ -95,6 +95,74 @@ def test_db_version_bump(tmp_path):
     assert open_db(path).version == 4
 
 
+def test_db_append_fault_keeps_pending(tmp_path):
+    """ISSUE 13 satellite: a flush dying mid-append (db.append seam)
+    must leave `pending` intact so the next flush re-appends — the
+    partially-written records are superseded by key, never lost."""
+    from syzkaller_tpu.health.faultinject import (FaultPlan,
+                                                  install_plan,
+                                                  reset_plan)
+
+    path = str(tmp_path / "corpus.db")
+    db = open_db(path)
+    db.save("a", b"va", 1)
+    db.save("b", b"vb", 2)
+    db.save("c", b"vc", 3)
+    install_plan(FaultPlan.parse("db.append:fail@2"))
+    try:
+        with pytest.raises(ConnectionError):
+            db.flush()
+        assert set(db.pending) == {"a", "b", "c"}
+        # the interrupted file still opens (zero or more whole
+        # records; never a torn one surviving)
+        assert set(open_db(path).records) <= {"a", "b", "c"}
+    finally:
+        reset_plan()
+    db.flush()
+    db2 = open_db(path)
+    assert {k: r.val for k, r in db2.records.items()} == {
+        "a": b"va", "b": b"vb", "c": b"vc"}
+
+
+def test_db_compact_fault_old_file_authoritative(tmp_path):
+    """A crash between the compaction tmp's fsync and its rename
+    (db.compact seam) leaves the old file authoritative; the next
+    open unlinks the orphaned tmp instead of mistaking it for data."""
+    import os
+
+    from syzkaller_tpu.health.faultinject import (FaultPlan,
+                                                  install_plan,
+                                                  reset_plan)
+
+    path = str(tmp_path / "corpus.db")
+    db = open_db(path)
+    for i in range(4):
+        db.save(f"k{i}", bytes([i]) * 8, i)
+    db.flush()
+    install_plan(FaultPlan.parse("db.compact:fail@1"))
+    try:
+        with pytest.raises(ConnectionError):
+            db.bump_version(9)
+        assert os.path.exists(path + ".tmp")
+    finally:
+        reset_plan()
+    db2 = open_db(path)
+    assert not os.path.exists(path + ".tmp")  # stale tmp cleaned
+    assert len(db2.records) == 4
+    assert db2.version != 9  # the rename never published
+
+
+def test_db_fsync_escape_hatch(tmp_path, monkeypatch):
+    """TZ_DB_FSYNC=0 trades the append-path fsync for throughput; the
+    flush still lands records (just without the durability barrier)."""
+    monkeypatch.setenv("TZ_DB_FSYNC", "0")
+    path = str(tmp_path / "corpus.db")
+    db = open_db(path)
+    db.save("k", b"v", 1)
+    db.flush()
+    assert open_db(path).records["k"].val == b"v"
+
+
 # -- rpc -----------------------------------------------------------------
 
 

@@ -944,6 +944,62 @@ def test_coverage_analytics_zero_new_jits_on_warm_rig(device_rig):
         pl.triage_engine = None  # the module-scoped rig lives on
 
 
+def test_warm_restart_zero_new_jits(device_rig):
+    """ISSUE 13 compile-count guard: restoring a recovered signal
+    mirror (restore_mirror) and mutant plane (restore_mutant_plane)
+    re-uploads through the EXISTING host-mirror/jnp.asarray paths —
+    one H2D each, zero new jit compiles on a warm rig.  Recovery must
+    never pay a compile storm on top of a crash."""
+    import numpy as np
+
+    from syzkaller_tpu.ops import signal as dsig
+    from syzkaller_tpu.triage import TriageEngine
+    from syzkaller_tpu.triage.engine import _Entry, _Request
+
+    _target, pl = device_rig
+    eng = TriageEngine.for_pipeline(pl, batch=8, max_edges=64)
+    rng = np.random.RandomState(31)
+
+    def run_chunk():
+        req = _Request(2)
+        entries = [
+            _Entry(rng.randint(0, 1 << dsig.FOLD_BITS, size=10,
+                               dtype=np.uint32), 3, req)
+            for _ in range(2)]
+        with eng._device_lock:
+            h = eng._dispatch_chunk(entries)
+            assert h is not None
+            eng._resolve_chunk(h)
+        assert req.done.is_set()
+
+    try:
+        run_chunk()  # warm novel_any + the plane upload
+        caches0 = (pl._step._cache_size(),
+                   dsig.novel_any._cache_size(),
+                   dsig.merge_into._cache_size())
+        # the checkpoint/restore round trip, as recovery performs it:
+        # provider packs the mirror, restore installs it and drops
+        # the device plane
+        meta, blob = eng.durable_provider()
+        mirror = dsig.unpack_plane(blob, meta["size"])
+        rebuilds0 = eng.stats.plane_rebuilds
+        eng.restore_mirror(mirror)
+        run_chunk()  # forces the rebuild H2D through the normal path
+        assert eng.stats.plane_rebuilds == rebuilds0 + 1
+        # mutant-plane restore rides the same discipline
+        mmeta, mblob = pl.durable_mutant_plane()
+        pl.restore_mutant_plane(
+            dsig.unpack_plane(mblob, mmeta["size"]),
+            bits=mmeta["bits"])
+        caches = (pl._step._cache_size(),
+                  dsig.novel_any._cache_size(),
+                  dsig.merge_into._cache_size())
+        assert caches == caches0, \
+            f"warm restart triggered new jits: {caches0} -> {caches}"
+    finally:
+        pl.triage_engine = None  # the module-scoped rig lives on
+
+
 # -- lineage + flight recorder + profiler on the warm rig (ISSUE 6) -------
 
 

@@ -133,6 +133,9 @@ def mgr(tmp_path):
 
 
 def test_manager_corpus_persistence(tmp_path, test_target):
+    # Warm restart (ISSUE 13): the durable checkpoint restores the
+    # corpus WITH its triaged signal, so nothing is re-queued for
+    # re-triage — the record is immediately servable to a fuzzer.
     cfg = load_config({"workdir": str(tmp_path / "work"),
                        "target": "test/64", "http": ""})
     m = Manager(cfg)
@@ -141,11 +144,41 @@ def test_manager_corpus_persistence(tmp_path, test_target):
     m.serv.NewInput({"name": "f",
                      "input": _input_dict(text, [5, 6], call="x")})
     m.shutdown()
-    # restart: corpus comes back as candidates (queued once)
+    # Shutdown must detach the journal hook it installed on the
+    # process-global coverage tracker: the tracker outlives the
+    # manager, and a later analytics tick journaling into the closed
+    # WAL would poison unrelated rigs in the same process.
+    from syzkaller_tpu import telemetry
+
+    assert telemetry.COVERAGE.journal is None
+    m2 = Manager(cfg)
+    assert m2.serv.candidate_backlog() == 0
+    assert [i["prog"] for i in m2.serv.corpus.values()] == [text]
+    # a fresh fuzzer is served the restored corpus on Connect
+    conn = m2.serv.Connect({"name": "g"})
+    assert [i["prog"] for i in conn["corpus"]] == [text]
+    m2.shutdown()
+
+
+def test_manager_corpus_persistence_cold(tmp_path, test_target,
+                                         monkeypatch):
+    # TZ_CKPT_INTERVAL_S=0 is the durability escape hatch: no durable
+    # store, and a restart falls back to the cold path — the corpus DB
+    # is re-queued as candidates for full re-triage (the seed's
+    # original semantics, reference: syz-manager loadCorpus).
+    monkeypatch.setenv("TZ_CKPT_INTERVAL_S", "0")
+    cfg = load_config({"workdir": str(tmp_path / "work"),
+                       "target": "test/64", "http": ""})
+    m = Manager(cfg)
+    assert m.durable is None
+    p = generate_prog(test_target, RandGen(test_target, 1), 4)
+    text = serialize_prog(p).decode()
+    m.serv.NewInput({"name": "f",
+                     "input": _input_dict(text, [5, 6], call="x")})
+    m.shutdown()
     m2 = Manager(cfg)
     assert m2.serv.candidate_backlog() == 1
-    cand = m2.serv.candidates[0]
-    assert cand["prog"] == text
+    assert m2.serv.candidates[0]["prog"] == text
     m2.shutdown()
 
 
