@@ -35,6 +35,10 @@ Modes:
                                    # (host-only): ms/batch scheduling
                                    # tax, tenants-per-chip break-even,
                                    # per-tenant novelty share
+  python bench.py --accounting     # accounting & SLO plane (host-only):
+                                   # device-time ledger metering tax
+                                   # us/batch, conservation error, SLO
+                                   # burn-evaluation us/tick
 """
 
 from __future__ import annotations
@@ -659,6 +663,64 @@ def bench_serve(tenants=6, batches=60, batch_rows=4096,
     }
 
 
+def bench_accounting(batches=5000, tenants=3, lanes=3, shards=4,
+                     ticks=2000) -> dict:
+    """Accounting & SLO plane bench (ISSUE 14): host-only — the ledger
+    and the burn-rate engine sit on the fused-drain hot path (every
+    batch pays one `note_batch`, every analytics flush one SLO tick),
+    so what this measures is that tax, not the drain.
+
+    A private DeviceTimeLedger takes `batches` fully-attributed
+    batches (tenant+lane+shard row maps, the worst-case split), then
+    `batches` unattributed ones (the default-key fast path); a private
+    SloEngine with an injected clock evaluates the full SLO table for
+    `ticks` ticks.  Reports the per-batch metering tax in µs, the
+    worst conservation error the split accumulated (the ≤1e-6
+    invariant under load), and the per-tick burn-evaluation cost."""
+    from syzkaller_tpu.telemetry.accounting import DeviceTimeLedger
+    from syzkaller_tpu.telemetry.slo import SloEngine
+
+    ledger = DeviceTimeLedger()
+    tenant_rows = {f"vm{i}": 100 + 7 * i for i in range(tenants)}
+    lane_rows = {"exploration": 64, "candidate": 96, "smash": 128}
+    lane_rows = dict(list(lane_rows.items())[:lanes])
+    shard_rows = {str(i): 1 for i in range(shards)}
+    for name in tenant_rows:          # novelty so the yield EWMAs move
+        ledger.note_novel("tenant", name, 3)
+
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        ledger.note_batch(0.004, tenant_rows=tenant_rows,
+                          lane_rows=lane_rows, shard_rows=shard_rows)
+    attributed_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        ledger.note_batch(0.004)
+    default_s = time.perf_counter() - t0
+
+    clk = [1000.0]
+    eng = SloEngine(time_fn=lambda: clk[0], fast_s=300.0, slow_s=3600.0,
+                    interval_s=0.0, ledger=ledger)
+    # Warm one tick out of the timing (lazy gauge/prev-state setup).
+    eng.tick()
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        clk[0] += 5.0
+        eng.tick()
+    tick_s = time.perf_counter() - t0
+
+    return {
+        "acct_batches": batches,
+        "acct_keys": tenants + len(lane_rows) + shards,
+        "acct_note_batch_us": round(1e6 * attributed_s / batches, 3),
+        "acct_note_batch_default_us":
+            round(1e6 * default_s / batches, 3),
+        "acct_conservation_error": ledger.conservation_error(),
+        "slo_objectives": len(eng.snapshot()["objectives"]),
+        "slo_tick_us": round(1e6 * tick_s / ticks, 3),
+    }
+
+
 def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
                   seeds=64, steps=10, rounds=4,
                   triage_batch=256, triage_edges=512) -> dict:
@@ -1274,6 +1336,15 @@ def main() -> None:
         res = {"metric": "serve_compose_overhead_ms_per_batch",
                "unit": "ms/batch", **bench_serve()}
         res["value"] = res["serve_compose_overhead_ms_per_batch"]
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
+    if "--accounting" in argv:
+        res = {"metric": "acct_note_batch_us", "unit": "us/batch",
+               **bench_accounting()}
+        res["value"] = res["acct_note_batch_us"]
         if platform:
             res["platform"] = platform
         journal_append(res)

@@ -151,6 +151,18 @@ def replay(ckpt: dict, records: list) -> dict:
         meta, _blob = ckpt["coverage"]
         coverage = dict(meta)
 
+    # Accounting ledger + SLO latches (ISSUE 14): checkpoint-only
+    # sections (no journal records — the ledger tolerates losing one
+    # cadence interval of metering), passed through verbatim.
+    accounting = None
+    if "accounting" in ckpt:
+        meta, _blob = ckpt["accounting"]
+        accounting = dict(meta)
+    slo = None
+    if "slo" in ckpt:
+        meta, _blob = ckpt["slo"]
+        slo = dict(meta)
+
     # -- replay the journal ------------------------------------------------
     for rec in records:
         kind, meta, blob = rec.kind, rec.meta, rec.blob
@@ -321,6 +333,10 @@ def replay(ckpt: dict, records: list) -> dict:
                                 "epochs": tp_epochs}
     if coverage is not None:
         out["coverage"] = coverage
+    if accounting is not None:
+        out["accounting"] = accounting
+    if slo is not None:
+        out["slo"] = slo
     return out
 
 

@@ -258,7 +258,8 @@ class Fuzzer:
         rollup), and the no-news case ticks the plateau detector."""
         eng = self.triage
         if eng is not None:
-            news = eng.check(self, prio_fn, infos, trace=trace)
+            news = eng.check(self, prio_fn, infos, trace=trace,
+                             source=source)
         else:
             news = self.cpu_check_new_signal(prio_fn, infos)
             lineage.hop(trace, "triage.verdict")

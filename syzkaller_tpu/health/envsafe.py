@@ -70,9 +70,19 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_SERVE_LEASE_S",
     "TZ_SERVE_MAX_TENANTS",
     "TZ_SERVE_PLANE_BITS",
+    "TZ_SERVE_PRICE",
     "TZ_SERVE_QUEUE_CAP",
     "TZ_SERVE_REBALANCE_S",
     "TZ_SERVE_STALL_WINDOW_S",
+    "TZ_SLO_BREAKER_RATIO",
+    "TZ_SLO_BURN",
+    "TZ_SLO_DELIVERY_P99_S",
+    "TZ_SLO_FAST_S",
+    "TZ_SLO_INTERVAL_S",
+    "TZ_SLO_MUTANT_RATE",
+    "TZ_SLO_SLOW_S",
+    "TZ_SLO_TRIAGE_P99_S",
+    "TZ_SLO_UTIL_FLOOR",
     "TZ_TELEMETRY_SNAPSHOT",
     "TZ_TRACE_FILE",
     "TZ_TRACE_SAMPLE",

@@ -88,6 +88,13 @@ def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
                     # analytics when planes are wired in.
                     self._send(json.dumps(_serve_payload(mgr)),
                                "application/json")
+                elif url.path == "/api/accounting":
+                    # Accounting & SLO plane (ISSUE 14,
+                    # telemetry/accounting.py + slo.py): the
+                    # device-time ledger, the top-consumers table,
+                    # and the SLO scorecard with burn rates.
+                    self._send(json.dumps(_accounting_payload(mgr)),
+                               "application/json")
                 elif url.path == "/api/stats":
                     # Machine-readable superset of /stats: the manager
                     # rollup plus the full telemetry snapshot
@@ -224,6 +231,53 @@ def _serve_section(mgr) -> str:
             f"<a href='/api/serve'>serve.json</a></p>")
 
 
+def _accounting_payload(mgr) -> dict:
+    """The /api/accounting body: ledger + top consumers + SLO
+    scorecard (ISSUE 14)."""
+    from syzkaller_tpu import telemetry
+
+    telemetry.SLO.tick()
+    return {"ledger": telemetry.ACCOUNTING.snapshot(),
+            "top_consumers": telemetry.ACCOUNTING.top_consumers(),
+            "slo": telemetry.SLO.snapshot()}
+
+
+def _accounting_section(mgr) -> str:
+    """Summary-page scorecard: one row per SLO objective (value vs
+    target, fast/slow burn, state) and the ledger's top device-time
+    consumers per dimension."""
+    from syzkaller_tpu import telemetry
+
+    slo = telemetry.SLO.snapshot()
+    top = telemetry.ACCOUNTING.top_consumers(5)
+    srows = "".join(
+        f"<tr><td>{html.escape(o['name'])}</td>"
+        f"<td>{o['kind']}</td>"
+        f"<td>{o['value'] if o['value'] is not None else '—'}</td>"
+        f"<td>{o['target']:g}</td>"
+        f"<td>{o['fast_burn']:.2f}x</td>"
+        f"<td>{o['slow_burn']:.2f}x</td>"
+        f"<td>{'BURNING' if o['burning'] else 'ok'}</td></tr>"
+        for o in slo.get("objectives") or [])
+    crows = ""
+    for dim in ("tenant", "lane", "shard"):
+        for row in top.get(dim) or []:
+            crows += (f"<tr><td>{dim}</td>"
+                      f"<td>{html.escape(str(row['key']))}</td>"
+                      f"<td>{row['device_ms']:.1f}</td>"
+                      f"<td>{row['share']:.1%}</td>"
+                      f"<td>{row['yield']:g}</td></tr>")
+    total = top.get("total_device_ms", 0)
+    return (f"<h3>Accounting &amp; SLOs</h3>"
+            f"<table><tr><th>objective</th><th>kind</th><th>value</th>"
+            f"<th>target</th><th>fast burn</th><th>slow burn</th>"
+            f"<th>state</th></tr>{srows}</table>"
+            f"<table><tr><th>dim</th><th>key</th><th>device ms</th>"
+            f"<th>share</th><th>yield</th></tr>{crows}</table>"
+            f"<p>{total:.1f} device-ms metered &middot; "
+            f"<a href='/api/accounting'>accounting.json</a></p>")
+
+
 def _call_name(prog_line: str) -> str:
     """First call name of a serialized program line ('r0 = open(...)'
     or 'open(...)')."""
@@ -297,6 +351,7 @@ def _summary_page(mgr) -> str:
     body = (f"<table>{rows}</table>{health}{control}"
             f"{_serve_section(mgr)}"
             f"{_coverage_section(mgr)}"
+            f"{_accounting_section(mgr)}"
             f"<h3>Crashes</h3>"
             f"<table><tr><th>title</th><th>count</th><th>repro</th>"
             f"<th></th></tr>{crashes}</table>")

@@ -119,6 +119,14 @@ class Manager:
                 self.serve_plane.durable_restore(recovered["serve"])
             if recovered and recovered.get("coverage"):
                 telemetry.COVERAGE.restore_state(recovered["coverage"])
+            # Accounting & SLO plane (ISSUE 14): per-tenant cumulative
+            # device-ms survives the restart, and a burning objective
+            # stays latched instead of false-firing "clear".
+            if recovered and recovered.get("accounting"):
+                telemetry.ACCOUNTING.restore_state(
+                    recovered["accounting"])
+            if recovered and recovered.get("slo"):
+                telemetry.SLO.restore_state(recovered["slo"])
             # Journal hooks + checkpoint providers, wired only after
             # every restore so recovery itself never journals.
             self.serv.durable = self.durable
@@ -130,6 +138,11 @@ class Manager:
             self.durable.register(
                 "coverage",
                 lambda: (telemetry.COVERAGE.export_state(), b""))
+            self.durable.register(
+                "accounting",
+                lambda: (telemetry.ACCOUNTING.export_state(), b""))
+            self.durable.register(
+                "slo", lambda: (telemetry.SLO.export_state(), b""))
             self.durable.start()
         self.rpc_server.serve_in_background()
         self.rpc_addr = self.rpc_server.addr
@@ -384,6 +397,12 @@ class Manager:
         # Serving-plane rollup (ISSUE 12): tenant leases, demand,
         # queue custody, credits — the /api/serve body verbatim.
         s["serve"] = self.serve_plane.snapshot()
+        # Accounting & SLO scorecard (ISSUE 14).  The stats path also
+        # drives the SLO cadence on manager-only deployments (no
+        # triage flush leader in-process); tick() self-rate-limits.
+        telemetry.SLO.tick()
+        s["accounting"] = telemetry.ACCOUNTING.snapshot()
+        s["slo"] = telemetry.SLO.snapshot()
         return s
 
     def start_bench(self, path: str, period_s: float = 60.0) -> None:

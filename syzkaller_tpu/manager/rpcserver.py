@@ -85,6 +85,10 @@ _M_CAND_REISSUED = telemetry.counter(
     "tz_manager_candidates_reissued_total",
     "issued candidates returned to the queue (lost reply or reaped "
     "lease)")
+_M_MERGE_RESETS = telemetry.counter(
+    "tz_telemetry_merge_resets_total",
+    "per-fuzzer counter regressions absorbed by the fleet merge (a "
+    "restarted fuzzer reset its process-local counters)")
 _M_SIGNAL_OVERFLOWS = telemetry.counter(
     "tz_manager_signal_overflows_total",
     "per-fuzzer max-signal deltas that overflowed the cap and "
@@ -168,6 +172,12 @@ class ManagerRPC:
         # Reply caches of reaped fuzzers, so late retries of applied
         # seqs still replay (name -> reply_cache), insertion-ordered.
         self._tombstones: dict[str, dict[int, dict]] = {}
+        # Fleet-merge monotonicity (ISSUE 14): per-fuzzer counter
+        # high-water marks plus a retired accumulator, so a restarted
+        # fuzzer resetting its process-local counters (or a reaped
+        # one vanishing) never regresses the source="fleet" families.
+        self._fleet_high: dict[str, dict[str, float]] = {}
+        self._fleet_retired: dict[str, float] = {}
         # Durability (syzkaller_tpu/durable): when attached, custody-
         # ledger transitions journal under the store barrier and the
         # corpus/queue/ledgers become the "control" checkpoint section.
@@ -541,6 +551,10 @@ class ManagerRPC:
                 self.fuzzers[name] = f
             if telemetry_snap:
                 f.telemetry = telemetry_snap
+                # High-water the counters NOW, not at scrape time: a
+                # restart between two fleet reads would otherwise
+                # overwrite the pre-restart life before anyone saw it.
+                self._note_counters_locked(name, telemetry_snap)
             f.device_state = str(params.get("device_state")
                                  or "closed")
             if seq:
@@ -641,18 +655,64 @@ class ManagerRPC:
 
     # -- introspection ----------------------------------------------------
 
+    def _note_counters_locked(self, name: str, snap: dict) -> None:
+        """Absorb one fuzzer's cumulative counters into the fleet
+        high-water marks (caller holds self._lock).  A value below
+        its mark means the process restarted: the old life's total
+        retires into the monotonic accumulator and the mark restarts.
+        Idempotent for already-seen values (max-merge)."""
+        high = self._fleet_high.setdefault(name, {})
+        for cname, v in (snap.get("counters") or {}).items():
+            v = float(v)
+            hi = high.get(cname)
+            if hi is not None and v < hi - 1e-9:
+                self._fleet_retired[cname] = \
+                    self._fleet_retired.get(cname, 0.0) + hi
+                _M_MERGE_RESETS.inc()
+                high[cname] = v
+            else:
+                high[cname] = v if hi is None else max(hi, v)
+
     def fleet_telemetry(self) -> dict:
         """Cross-process rollup of the fuzzers' latest poll telemetry
         (the ROADMAP PR 2 leftover): counters/gauges sum, histograms
         vector-add over the fixed shared buckets, percentiles
         re-estimated from the merged counts.  Rendered on /metrics
-        (source="fleet") and /api/stats."""
+        (source="fleet") and /api/stats.
+
+        Monotonicity audit (ISSUE 14): merge_snapshots sums the
+        LATEST cumulative snapshot per fuzzer, so a fuzzer restart
+        (counters back to ~0) or a lease reap would regress the
+        fleet counters.  The fleet counter families are instead
+        derived from per-fuzzer high-water marks plus a retired
+        accumulator: a counter seen BELOW its high-water means the
+        process restarted — the old life's total retires (counted by
+        tz_telemetry_merge_resets_total) and the mark restarts; a
+        reaped fuzzer keeps its mark (so its work never leaves the
+        sum, and a same-process re-Connect continues it without
+        double-counting).  Gauges and histograms still merge from
+        the live snapshots — they are legitimately non-monotonic."""
         from syzkaller_tpu.telemetry import merge_snapshots
 
         with self._lock:
-            snaps = [f.telemetry for f in self.fuzzers.values()
-                     if f.telemetry]
-        return merge_snapshots(snaps)
+            snaps = []
+            for name, f in self.fuzzers.items():
+                if not f.telemetry:
+                    continue
+                snaps.append(f.telemetry)
+                # Legacy path: a snapshot that arrived outside _poll
+                # (tests poking f.telemetry directly) still high-waters
+                # here; _note_counters_locked is idempotent for values
+                # already absorbed at poll time.
+                self._note_counters_locked(name, f.telemetry)
+            counters: dict[str, float] = dict(self._fleet_retired)
+            for high in self._fleet_high.values():
+                for cname, hi in high.items():
+                    counters[cname] = counters.get(cname, 0.0) + hi
+        merged = merge_snapshots(snaps)
+        if counters:
+            merged["counters"] = counters
+        return merged
 
     def snapshot(self) -> dict:
         with self._lock:

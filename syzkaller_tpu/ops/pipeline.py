@@ -1089,9 +1089,9 @@ class DevicePipeline:
         # host-observed device residency; the compacted pool fetch is
         # the emit-compact scatter's sync point.  Pure host float
         # math — no device work, no jits, no allocations.
-        telemetry.PROFILER.note(
-            "mutate", time.perf_counter() - t_dispatch)
         t_pool = time.perf_counter()
+        mutate_s = t_pool - t_dispatch
+        telemetry.PROFILER.note("mutate", mutate_s)
         with telemetry.span("pipeline.pool_drain"):
             bucket = pool_bucket(
                 n_used, self.spec.pool_slots(self.batch_size))
@@ -1100,8 +1100,13 @@ class DevicePipeline:
                     lambda: np.asarray(pool_dev[:bucket]), "device.drain")
             else:
                 pool = np.zeros((0, self.spec.P), np.uint8)
-        telemetry.PROFILER.note(
-            "emit_compact", time.perf_counter() - t_pool)
+        pool_s = time.perf_counter() - t_pool
+        telemetry.PROFILER.note("emit_compact", pool_s)
+        # Accounting ledger (ISSUE 14): the same sync-point deltas,
+        # booked as device time under the default keys — the composer
+        # and triage engine meter their own tenant/lane-attributed
+        # residency separately.
+        telemetry.ACCOUNTING.note_batch(mutate_s + pool_s)
         nbytes = rows_wire_bytes + pool.nbytes \
             + np.asarray(n_used_dev).nbytes
         self.stats.d2h_bytes += nbytes

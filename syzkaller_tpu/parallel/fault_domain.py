@@ -346,8 +346,15 @@ class MeshEngine:
             # host-observed residency (bench --profile isolates
             # per-chip probes for the differentiated view).
             elapsed = self._clock() - t0
-            for d in self._live():
+            live = self._live()
+            for d in live:
                 telemetry.SHARD_PROFILER.note(d.index, elapsed)
+            # Accounting ledger (ISSUE 14): a collective consumes
+            # `elapsed` on EVERY live chip — total chip-time is
+            # elapsed * width, split evenly across the shards.
+            telemetry.ACCOUNTING.note_batch(
+                elapsed * len(live),
+                shard_rows={str(d.index): 1 for d in live})
         self._compiled_keys.add(self._topology_key)
 
         n_novel_np = np.asarray(n_novel)

@@ -497,10 +497,14 @@ class ServePlane:
 
     def snapshot(self) -> dict:
         """The /api/serve body (manager/html.py) and the bench/
-        stats_snapshot serve block."""
+        stats_snapshot serve block.  The "accounting" key joins the
+        device-time ledger's tenant dimension (ISSUE 14) so one fetch
+        answers both custody and chargeback."""
+        acct = telemetry.ACCOUNTING.dimension_snapshot("tenant")
         with self._lock:
             now = self._clock()
             return {
+                "accounting": acct,
                 "epoch": self.epoch,
                 "lease_s": self.lease_s,
                 "queue_cap": self.queue_cap,

@@ -43,7 +43,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from syzkaller_tpu import telemetry
-from syzkaller_tpu.health.envsafe import env_float
+from syzkaller_tpu.health.envsafe import env_choice, env_float
 from syzkaller_tpu.health.faultinject import FaultInjected, fault_point
 from syzkaller_tpu.serve.broker import EWMA_ALPHA, ServePlane
 from syzkaller_tpu.serve.plane import TenantPlanes
@@ -94,6 +94,14 @@ class BatchComposer:
         self.interval_s = max(0.0, env_float(
             "TZ_SERVE_COMPOSE_INTERVAL_S",
             0.02 if interval_s is None else interval_s))
+        # Credit pricing (ISSUE 14): "novelty" weights healthy
+        # tenants by their raw novelty EWMA (bit-exact PR 11
+        # behavior); "yield" weights by the accounting ledger's
+        # novel-edges-per-device-second EWMA, so a tenant burning
+        # chip time without discovering anything decays even while
+        # technically novel.
+        self.price = env_choice("TZ_SERVE_PRICE", "novelty",
+                                ("novelty", "yield"))
         self._clock = clock
         self._last_rebalance = clock()
         self._stop = threading.Event()
@@ -128,7 +136,16 @@ class BatchComposer:
                         f"{self.stall_window_s:.0f}s")
             healthy = [t for t in tenants if not t.stalled]
             n = len(tenants)
-            wsum = sum(max(t.novelty_ewma, 0.0) for t in healthy)
+            if self.price == "yield":
+                # Yield pricing: weight by the ledger's novelty-per-
+                # device-second EWMA.  A tenant the ledger has never
+                # seen (or that found nothing per chip-second) weighs
+                # zero and lands exactly on the floor.
+                yields = telemetry.ACCOUNTING.yield_ewmas("tenant")
+                _w = lambda t: max(yields.get(t.name, 0.0), 0.0)
+            else:
+                _w = lambda t: max(t.novelty_ewma, 0.0)
+            wsum = sum(_w(t) for t in healthy)
             for t in tenants:
                 old = t.credit
                 if t.stalled:
@@ -137,7 +154,7 @@ class BatchComposer:
                     if t.credit - floor < 1e-9:
                         t.credit = floor
                 elif wsum > 0:
-                    w = max(t.novelty_ewma, 0.0)
+                    w = _w(t)
                     t.credit = floor + (1.0 - n * floor) * (w / wsum)
                 else:  # cold start / all-equal: even shares
                     t.credit = 1.0 / max(1, n) if n else 1.0
@@ -217,7 +234,15 @@ class BatchComposer:
                 np.full(n, i, np.int32)
                 for i, (_t, n) in enumerate(alloc)])
         with telemetry.span("serve.dispatch"):
+            t_drain = time.perf_counter()
             rows, payloads = self.drain_fn(total)
+            drain_s = time.perf_counter() - t_drain
+        # Accounting ledger (ISSUE 14): the drain's host-observed
+        # residency is the batch's device time, row-weighted over the
+        # allocation — including rows allotted to a tenant reaped
+        # mid-compose (it consumed them; conservation holds).
+        telemetry.ACCOUNTING.note_batch(
+            drain_s, tenant_rows={t: n for t, n in alloc})
         rows = np.atleast_2d(np.asarray(rows, dtype=np.uint8))
         report: dict = {"rows": total, "tenants": {},
                         "tenant_col": tenant_col,
@@ -230,6 +255,10 @@ class BatchComposer:
             off += n
             novel = self.planes.verdict(tenant, t_rows)
             idx = np.flatnonzero(novel)
+            # Per-tenant plane novelty joins the ledger's yield EWMA
+            # (tz_acct_novel_edges_per_device_sec{tenant=...}).
+            telemetry.ACCOUNTING.note_novel(
+                "tenant", tenant, int(idx.size))
             self.broker.offer(
                 tenant, [t_payloads[int(j)] for j in idx],
                 rows_spent=n, novel=int(idx.size))

@@ -157,12 +157,29 @@ from syzkaller_tpu.telemetry.coverage import (  # noqa: E402
 #: analytics (tz_coverage_*).
 COVERAGE = CoverageTracker()
 
+# The accounting & SLO plane (ISSUE 14): the device-time chargeback
+# ledger and the burn-rate objective engine.  Same late-import shape.
+from syzkaller_tpu.telemetry.accounting import (  # noqa: E402
+    DeviceTimeLedger,
+)
+from syzkaller_tpu.telemetry.slo import SloEngine  # noqa: E402
+
+#: Process-wide device-time ledger: per-tenant/lane/shard chargeback
+#: (tz_acct_*), fed by the pipeline/triage/mesh/serve sync points.
+ACCOUNTING = DeviceTimeLedger()
+
+#: Process-wide SLO engine (tz_slo_*), ticked from the triage flush
+#: leader and the manager stats path.
+SLO = SloEngine()
+
 
 __all__ = [
+    "ACCOUNTING",
     "COVERAGE",
     "Counter",
     "CoverageTracker",
     "DEFAULT_LATENCY_BUCKETS",
+    "DeviceTimeLedger",
     "FLIGHT",
     "FlightRecorder",
     "Gauge",
@@ -172,7 +189,9 @@ __all__ = [
     "REGISTRY",
     "Registry",
     "SHARD_PROFILER",
+    "SLO",
     "ShardProfiler",
+    "SloEngine",
     "TRACE",
     "TraceWriter",
     "lineage",

@@ -1,0 +1,311 @@
+"""Device-time accounting ledger (ISSUE 14, tentpole part 1).
+
+Answers the first question a fleet operator asks: *who is consuming
+the device-seconds*.  The ledger consumes the instrumentation that
+already exists — the KernelProfiler sync points (ops/pipeline.py),
+the triage novel_any fetch (triage/engine.py), the mesh collective
+elapsed (parallel/fault_domain.py), and the serving drain
+(serve/composer.py) — and attributes each batch's device
+milliseconds to three independent dimensions:
+
+  * ``tenant`` — which serving-plane tenant the rows belonged to
+    (row-weighted over the composer's allocation; the manager's own
+    work books under "local"),
+  * ``lane``   — which workqueue lane produced the work (the
+    _LANE_BY_STAT tags from fuzzer/proc.py; default "exploration"),
+  * ``shard``  — which mesh shard executed it (fault_domain indices;
+    default "0" on single-chip).
+
+Every dimension conserves: the per-key splits of one batch sum to
+the batch's milliseconds EXACTLY (largest-share key absorbs the
+float residual), so Σ tz_acct_device_ms_total{tenant=...} ==
+Σ tz_acct_device_ms_total{lane=...} == total metered ms.  The
+conservation error is exported for tests and the scorecard.
+
+Novelty joins the ledger through `note_novel` (fed by
+CoverageTracker per lane and the composer per tenant); each
+attribution of device time folds the novelty accumulated since the
+key's last attribution into a yield EWMA — novel edges per device
+second — exported as `tz_acct_novel_edges_per_device_sec{tenant|lane}`
+and consumed by `TZ_SERVE_PRICE=yield` credit pricing
+(serve/composer.py) and the SLO top-consumers incident table
+(telemetry/slo.py).
+
+Label cardinality is bounded: at most MAX_KEYS live keys per
+dimension; later keys fold into "overflow" (lanes are a fixed set of
+five; tenants are capped by TZ_SERVE_MAX_TENANTS; shards by the
+mesh width — the cap is a leak backstop, not a working limit).
+
+Import-cycle note: like coverage.py, this module is constructed at
+telemetry import time, so all telemetry access is late
+(`from syzkaller_tpu import telemetry` inside methods).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+#: Same smoothing as the KernelProfiler: ~5-batch memory.
+EWMA_ALPHA = 0.2
+
+DIMENSIONS = ("tenant", "lane", "shard")
+
+#: Where a batch books when the caller has no attribution for a
+#: dimension (single-tenant pipeline work, single-chip, no lane tag).
+DEFAULT_KEY = {"tenant": "local", "lane": "exploration", "shard": "0"}
+
+#: Per-dimension live-key cap; past it, new keys fold into
+#: OVERFLOW_KEY so a label leak can't grow /metrics unboundedly.
+MAX_KEYS = 64
+OVERFLOW_KEY = "overflow"
+
+#: Dimensions that carry a novelty join (shards discover nothing on
+#: their own — novelty is a property of the work, not the chip).
+YIELD_DIMS = ("tenant", "lane")
+
+
+class _Slot:
+    """One (dimension, key) accumulator.  Fixed slots, mutated in
+    place — the hot path allocates nothing after first touch."""
+
+    __slots__ = ("ms", "novel", "pending_novel", "ewma", "seen",
+                 "counter", "gauge")
+
+    def __init__(self, counter, gauge):
+        self.ms = 0.0              # cumulative attributed device ms
+        self.novel = 0             # cumulative novel edges joined
+        self.pending_novel = 0     # novelty since the last attribution
+        self.ewma = 0.0            # novel edges per device second
+        self.seen = False          # first attribution sets the EWMA
+        self.counter = counter     # tz_acct_device_ms_total{dim=key}
+        self.gauge = gauge         # yield gauge, or None (shard)
+
+
+class DeviceTimeLedger:
+    """See module doc.  Singleton lives at `telemetry.ACCOUNTING`;
+    tests construct private instances (the registry families are
+    shared get-or-create, so a private ledger re-uses the same
+    metric objects)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dims: Dict[str, Dict[str, _Slot]] = \
+            {d: {} for d in DIMENSIONS}
+        self._dim_ms: Dict[str, float] = {d: 0.0 for d in DIMENSIONS}
+        self.total_ms = 0.0
+        self.batches = 0
+        # Pre-create the default slots so the unattributed hot path
+        # (pipeline _fetch on a single-tenant manager) never grows a
+        # container after construction (test_health_faults guard).
+        with self._lock:
+            for d in DIMENSIONS:
+                self._slot_locked(d, DEFAULT_KEY[d])
+
+    # -- slots -------------------------------------------------------------
+
+    def _slot_locked(self, dim: str, key: str) -> _Slot:
+        slots = self._dims[dim]
+        s = slots.get(key)
+        if s is None:
+            if len(slots) >= MAX_KEYS and key != OVERFLOW_KEY:
+                return self._slot_locked(dim, OVERFLOW_KEY)
+            from syzkaller_tpu import telemetry
+            counter = telemetry.counter(
+                "tz_acct_device_ms_total",
+                "device milliseconds attributed by the accounting "
+                "ledger (conserving row-weighted split per dimension)",
+                labels={dim: key})
+            gauge = None
+            if dim in YIELD_DIMS:
+                gauge = telemetry.gauge(
+                    "tz_acct_novel_edges_per_device_sec",
+                    "novelty yield: novel edges discovered per device "
+                    "second, EWMA per ledger key",
+                    labels={dim: key})
+            s = slots[key] = _Slot(counter, gauge)
+        return s
+
+    # -- metering ----------------------------------------------------------
+
+    def note_batch(self, seconds: float,
+                   tenant_rows: Optional[dict] = None,
+                   lane_rows: Optional[dict] = None,
+                   shard_rows: Optional[dict] = None) -> None:
+        """Attribute one batch's device time.  Each `*_rows` dict is
+        an independent row-weighted split ({key: row_count}); a
+        missing/empty dimension books the whole batch to its default
+        key.  Never raises past bad input — metering must not break
+        the drain it measures."""
+        if seconds is None or seconds <= 0.0:
+            return
+        ms = seconds * 1e3
+        with self._lock:
+            self.total_ms += ms
+            self.batches += 1
+            self._accrue_locked("tenant", tenant_rows, ms)
+            self._accrue_locked("lane", lane_rows, ms)
+            self._accrue_locked("shard", shard_rows, ms)
+
+    def _accrue_locked(self, dim: str, rows: Optional[dict],
+                       ms: float) -> None:
+        items = None
+        if rows:
+            items = [(str(k), r) for k, r in rows.items()
+                     if r and r > 0]
+        if not items:
+            items = [(DEFAULT_KEY[dim], 1)]
+        total = 0
+        best_i, best_r = 0, -1
+        for i, (_k, r) in enumerate(items):
+            total += r
+            if r > best_r:
+                best_i, best_r = i, r
+        # Largest-remainder conservation: every key but the biggest
+        # takes its proportional share; the biggest takes the exact
+        # remainder, so the splits sum to `ms` bit-for-bit.
+        acc = 0.0
+        for i, (key, r) in enumerate(items):
+            if i == best_i:
+                continue
+            share = ms * (r / total)
+            acc += share
+            self._credit_locked(dim, key, share)
+        self._credit_locked(dim, items[best_i][0], ms - acc)
+
+    def _credit_locked(self, dim: str, key: str, share: float) -> None:
+        if share <= 0.0:
+            return
+        s = self._slot_locked(dim, key)
+        s.ms += share
+        self._dim_ms[dim] += share
+        s.counter.inc(share)
+        if s.gauge is not None:
+            # Fold the novelty accumulated since this key last held
+            # the device into an instantaneous yield, then EWMA it
+            # (profiler idiom: the first observation sets the value).
+            inst = s.pending_novel / (share / 1e3)
+            s.pending_novel = 0
+            s.ewma = inst if not s.seen \
+                else s.ewma + EWMA_ALPHA * (inst - s.ewma)
+            s.seen = True
+            s.gauge.set(round(s.ewma, 6))
+
+    def note_novel(self, dim: str, key: str, nedges: int) -> None:
+        """Join `nedges` novel edges to a ledger key; they price into
+        the yield EWMA when the key next accrues device time."""
+        if nedges is None or nedges <= 0 or dim not in YIELD_DIMS:
+            return
+        with self._lock:
+            s = self._slot_locked(dim, str(key))
+            s.pending_novel += int(nedges)
+            s.novel += int(nedges)
+
+    # -- reads -------------------------------------------------------------
+
+    def yield_ewmas(self, dim: str) -> Dict[str, float]:
+        """{key: novel-edges-per-device-sec EWMA} for one dimension —
+        the TZ_SERVE_PRICE=yield weight source."""
+        with self._lock:
+            return {k: s.ewma for k, s in self._dims[dim].items()}
+
+    def dimension_snapshot(self, dim: str) -> dict:
+        with self._lock:
+            return {k: {"device_ms": round(s.ms, 3),
+                        "novel": s.novel,
+                        "yield_ewma": round(s.ewma, 4)}
+                    for k, s in self._dims[dim].items()}
+
+    def conservation_error(self) -> float:
+        """Max relative |Σ per-key ms − metered ms| across dimensions
+        (the acceptance invariant: ≤ 1e-6)."""
+        with self._lock:
+            if self.total_ms <= 0.0:
+                return 0.0
+            return max(abs(self._dim_ms[d] - self.total_ms)
+                       for d in DIMENSIONS) / self.total_ms
+
+    def top_consumers(self, n: int = 8) -> dict:
+        """The self-diagnosing incident table: per-dimension top keys
+        by cumulative device ms, with share and yield.  Attached to
+        every `slo_burn` flight dump and the /api scorecard."""
+        with self._lock:
+            total = self.total_ms or 1.0
+            out: dict = {"total_device_ms": round(self.total_ms, 3)}
+            for d in DIMENSIONS:
+                ranked = sorted(self._dims[d].items(),
+                                key=lambda kv: kv[1].ms, reverse=True)
+                out[d] = [{"key": k,
+                           "device_ms": round(s.ms, 3),
+                           "share": round(s.ms / total, 4),
+                           "yield": round(s.ewma, 4)}
+                          for k, s in ranked[:n] if s.ms > 0.0]
+            return out
+
+    def snapshot(self) -> dict:
+        """The /api/accounting ledger block."""
+        out = {"device_ms_total": round(self.total_ms, 3),
+               "batches": self.batches,
+               "conservation_error": self.conservation_error()}
+        for d in DIMENSIONS:
+            out[d] = self.dimension_snapshot(d)
+        return out
+
+    # -- durability (ISSUE 14 satellite; manager/manager.py wires it) ------
+
+    def export_state(self) -> dict:
+        """Checkpoint section meta: the cumulative ledger (per-key
+        ms/novel/EWMA) a warm restart restores from."""
+        with self._lock:
+            return {
+                "total_ms": self.total_ms,
+                "batches": self.batches,
+                "dims": {d: {k: [s.ms, s.novel, s.ewma]
+                             for k, s in self._dims[d].items()}
+                         for d in DIMENSIONS},
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Warm restart: re-seed cumulative per-key device-ms (the
+        counters re-climb to their pre-crash values, preserving
+        chargeback continuity) and the yield EWMAs."""
+        if not state:
+            return
+        with self._lock:
+            self.total_ms = float(state.get("total_ms") or 0.0)
+            self.batches = int(state.get("batches") or 0)
+            for d in DIMENSIONS:
+                self._dim_ms[d] = 0.0
+                for k, rec in (state.get("dims") or {}).get(
+                        d, {}).items():
+                    s = self._slot_locked(d, str(k))
+                    ms, novel, ewma = (float(rec[0]), int(rec[1]),
+                                       float(rec[2]))
+                    delta = ms - s.ms
+                    if delta > 0:
+                        s.counter.inc(delta)
+                    s.ms = ms
+                    s.novel = novel
+                    s.ewma = ewma
+                    s.seen = s.seen or ms > 0.0
+                    self._dim_ms[d] += ms
+                    if s.gauge is not None:
+                        s.gauge.set(round(s.ewma, 6))
+
+    def reset(self) -> None:
+        """Zero the ledger state (tests).  The registry counter
+        families stay monotonic — only the ledger's own accumulators
+        reset."""
+        with self._lock:
+            for d in DIMENSIONS:
+                for s in self._dims[d].values():
+                    s.ms = 0.0
+                    s.novel = 0
+                    s.pending_novel = 0
+                    s.ewma = 0.0
+                    s.seen = False
+                    if s.gauge is not None:
+                        s.gauge.set(0.0)
+                self._dim_ms[d] = 0.0
+            self.total_ms = 0.0
+            self.batches = 0

@@ -165,6 +165,13 @@ class CoverageTracker:
         if nedges <= 0:
             return
         src = source if source in self._by_source else "exploration"
+        # Lane novelty joins the accounting ledger's yield EWMA
+        # (ISSUE 14).  getattr: the ledger is constructed after this
+        # tracker during telemetry import.
+        from syzkaller_tpu import telemetry
+        ledger = getattr(telemetry, "ACCOUNTING", None)
+        if ledger is not None:
+            ledger.note_novel("lane", src, nedges)
         resumed = False
         with self._lock:
             self._novel_accum += nedges

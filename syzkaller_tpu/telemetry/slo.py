@@ -1,0 +1,357 @@
+"""SLO engine: declarative objectives + multi-window burn-rate
+alerting (ISSUE 14, tentpole part 3).
+
+Answers the second fleet-operator question: *is the fleet meeting
+its service objectives*.  A small declarative table (SLO_TABLE)
+defines floors and ceilings over metrics the registry already
+carries; the engine samples each objective at flush cadence
+(TZ_SLO_INTERVAL_S), keeps a ring of (ts, bad) verdicts per
+objective, and computes error-budget burn over two windows in the
+SRE multi-window style:
+
+    burn(window) = breach_fraction(window) / budget
+
+An objective FIRES when both the fast window (TZ_SLO_FAST_S,
+page-grade signal) and the slow window (TZ_SLO_SLOW_S, sustained
+confirmation) burn at ≥ TZ_SLO_BURN — the fast window alone reacting
+to a blip never pages, and a window only votes once its ring spans
+it (a freshly started manager can't fire on thirty seconds of
+history).  Firing emits ONE `slo.burn` timeline event, latches
+`tz_slo_burn{slo=...}` to 1, increments `tz_slo_burns_total`, and
+dumps a `slo_burn` flight-recorder incident carrying the accounting
+ledger's top-consumers table, so the page is self-diagnosing: the
+alert names the objective, the attachment names who was eating the
+device when it burned.  The latch clears with hysteresis (fast burn
+back under TZ_SLO_BURN/2) and emits `slo.clear`.
+
+Objectives (targets are env-tunable; tools/lint_slo.py validates the
+table shape in tier-1):
+
+  * device_util       — floor on device-seconds metered per wall
+                        second (accounting ledger rate),
+  * mutant_rate       — floor on exec-ready mutants per second
+                        (tz_pipeline_mutants_total rate),
+  * triage_p99        — ceiling on the novel_any verdict p99
+                        (tz_triage_device_seconds),
+  * breaker_open_ratio— ceiling on breaker opens per device batch
+                        (tz_breaker_opens_total over triage+pipeline
+                        batches),
+  * delivery_p99      — ceiling on the serving drain p99
+                        (tz_serve_dispatch_seconds, the per-tenant
+                        delivery path).
+
+Warm restart (durable provider in manager/manager.py) restores the
+rings and latches: a burning objective stays latched through the
+restart instead of false-firing `slo.clear` on recovery.
+
+Import-cycle note: constructed at telemetry import; all telemetry
+and envsafe access is late, like coverage.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+FAST_S_DEFAULT = 300.0
+SLOW_S_DEFAULT = 3600.0
+BURN_DEFAULT = 2.0
+INTERVAL_S_DEFAULT = 5.0
+BUDGET_DEFAULT = 0.1
+#: Clear hysteresis: a latched burn clears only when the fast-window
+#: burn drops under threshold * CLEAR_FRACTION.
+CLEAR_FRACTION = 0.5
+
+#: The declarative objective table.  `env`/`default` set the target,
+#: `lo`/`hi` bound it (lint_slo), `metric` names the registry family
+#: the value derives from (lint_slo checks it exists), `budget` is
+#: the tolerated breach fraction.
+SLO_TABLE = (
+    {"name": "device_util", "kind": "floor",
+     "env": "TZ_SLO_UTIL_FLOOR", "default": 0.001,
+     "lo": 0.0, "hi": 1.0, "budget": BUDGET_DEFAULT,
+     "metric": "tz_acct_device_ms_total",
+     "help": "device-seconds metered per wall second"},
+    {"name": "mutant_rate", "kind": "floor",
+     "env": "TZ_SLO_MUTANT_RATE", "default": 1.0,
+     "lo": 0.0, "hi": 1e9, "budget": BUDGET_DEFAULT,
+     "metric": "tz_pipeline_mutants_total",
+     "help": "exec-ready mutants produced per second"},
+    {"name": "triage_p99", "kind": "ceiling",
+     "env": "TZ_SLO_TRIAGE_P99_S", "default": 1.0,
+     "lo": 1e-4, "hi": 60.0, "budget": BUDGET_DEFAULT,
+     "metric": "tz_triage_device_seconds",
+     "help": "novel_any verdict latency p99 (seconds)"},
+    {"name": "breaker_open_ratio", "kind": "ceiling",
+     "env": "TZ_SLO_BREAKER_RATIO", "default": 0.1,
+     "lo": 0.0, "hi": 1.0, "budget": BUDGET_DEFAULT,
+     "metric": "tz_breaker_opens_total",
+     "help": "breaker opens per device batch"},
+    {"name": "delivery_p99", "kind": "ceiling",
+     "env": "TZ_SLO_DELIVERY_P99_S", "default": 1.0,
+     "lo": 1e-4, "hi": 60.0, "budget": BUDGET_DEFAULT,
+     "metric": "tz_serve_dispatch_seconds",
+     "help": "serving-drain delivery latency p99 (seconds)"},
+)
+
+
+def _env():
+    # Late import: health imports telemetry, and this module is
+    # constructed at telemetry import time (coverage.py idiom).
+    from syzkaller_tpu.health import envsafe
+    return envsafe
+
+
+class _SloState:
+    __slots__ = ("obj", "target", "ring", "burning", "fired_ts",
+                 "value", "fast_burn", "slow_burn", "gauge")
+
+    def __init__(self, obj: dict, target: float, gauge):
+        self.obj = obj
+        self.target = target
+        self.ring: list = []      # [(ts, bad)] pruned to the slow window
+        self.burning = False
+        self.fired_ts = 0.0
+        self.value: Optional[float] = None
+        self.fast_burn = 0.0
+        self.slow_burn = 0.0
+        self.gauge = gauge        # tz_slo_burn{slo=name}
+
+
+class SloEngine:
+    """See module doc.  Singleton lives at `telemetry.SLO`; ticked
+    from the triage flush leader (_maybe_analytics_locked) and the
+    manager stats path.  Tests construct private engines with
+    injected `time_fn`, shrunk windows, and `value_overrides`."""
+
+    def __init__(self, time_fn: Optional[Callable[[], float]] = None,
+                 fast_s: Optional[float] = None,
+                 slow_s: Optional[float] = None,
+                 burn: Optional[float] = None,
+                 interval_s: Optional[float] = None,
+                 table=None, value_overrides: Optional[dict] = None,
+                 ledger=None):
+        env = _env()
+        import time as _time
+        self._time = time_fn or _time.time
+        self.fast_s = env.env_float("TZ_SLO_FAST_S", FAST_S_DEFAULT) \
+            if fast_s is None else float(fast_s)
+        self.slow_s = env.env_float("TZ_SLO_SLOW_S", SLOW_S_DEFAULT) \
+            if slow_s is None else float(slow_s)
+        self.burn_threshold = env.env_float("TZ_SLO_BURN", BURN_DEFAULT) \
+            if burn is None else float(burn)
+        self.interval_s = env.env_float(
+            "TZ_SLO_INTERVAL_S", INTERVAL_S_DEFAULT) \
+            if interval_s is None else float(interval_s)
+        self._overrides = value_overrides or {}
+        self._ledger = ledger
+        self._lock = threading.Lock()
+        self._last_tick = 0.0
+        self._prev: dict = {}     # counter/ledger values at last tick
+        from syzkaller_tpu import telemetry
+        self._m_burns = telemetry.counter(
+            "tz_slo_burns_total", "SLO burn alerts fired")
+        self._slos: dict[str, _SloState] = {}
+        for obj in (SLO_TABLE if table is None else table):
+            target = env.env_float(obj["env"], obj["default"])
+            gauge = telemetry.gauge(
+                "tz_slo_burn",
+                "1 while the objective's error budget is burning "
+                "(fast AND slow window over TZ_SLO_BURN)",
+                labels={"slo": obj["name"]})
+            self._slos[obj["name"]] = _SloState(obj, target, gauge)
+
+    # -- ledger resolution -------------------------------------------------
+
+    def _acct(self):
+        if self._ledger is not None:
+            return self._ledger
+        from syzkaller_tpu import telemetry
+        return getattr(telemetry, "ACCOUNTING", None)
+
+    # -- values ------------------------------------------------------------
+
+    def _values(self, now: float, dt: float, snap: dict) -> dict:
+        """One sample per objective; None means "not evaluable this
+        tick" (no traffic on a latency ceiling) and appends nothing."""
+        counters = snap.get("counters") or {}
+        hists = snap.get("histograms") or {}
+
+        def rate(name: str) -> float:
+            cur = float(counters.get(name) or 0.0)
+            prev = self._prev.get(name, cur)
+            self._prev[name] = cur
+            return max(0.0, cur - prev) / dt
+
+        def p99(name: str) -> Optional[float]:
+            h = hists.get(name)
+            if not h or not h.get("count"):
+                return None
+            return float(h.get("p99") or 0.0)
+
+        vals: dict = {}
+        ledger = self._acct()
+        ms = float(ledger.total_ms) if ledger is not None else 0.0
+        prev_ms = self._prev.get("__ledger_ms__", ms)
+        self._prev["__ledger_ms__"] = ms
+        vals["device_util"] = max(0.0, ms - prev_ms) / 1e3 / dt
+        vals["mutant_rate"] = rate("tz_pipeline_mutants_total")
+        vals["triage_p99"] = p99("tz_triage_device_seconds")
+        opens = rate("tz_breaker_opens_total") * dt
+        batches = (rate("tz_triage_batches_total")
+                   + rate("tz_pipeline_batches_total")) * dt
+        vals["breaker_open_ratio"] = opens / max(1.0, batches)
+        vals["delivery_p99"] = p99("tz_serve_dispatch_seconds")
+        for name, fn in self._overrides.items():
+            vals[name] = fn()
+        return vals
+
+    # -- burn math ---------------------------------------------------------
+
+    def _window_burn(self, st: _SloState, now: float,
+                     window: float) -> float:
+        ring = st.ring
+        if not ring or now - ring[0][0] < window * 0.9:
+            # The ring doesn't span the window yet: no vote.  A
+            # window must see its own history before it can page.
+            return 0.0
+        lo = now - window
+        bad = n = 0
+        for ts, b in ring:
+            if ts >= lo:
+                n += 1
+                bad += b
+        if n == 0:
+            return 0.0
+        budget = float(st.obj.get("budget") or BUDGET_DEFAULT)
+        return (bad / n) / max(budget, 1e-9)
+
+    # -- the tick ----------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> bool:
+        """Sample + evaluate every objective; rate-limited to
+        TZ_SLO_INTERVAL_S.  Never raises — alerting must not break
+        the flush path that hosts it.  Returns True when a sample
+        round ran."""
+        try:
+            return self._tick(now)
+        except Exception as e:
+            from syzkaller_tpu.utils import log
+            log.logf(0, "slo: tick error: %s", e)
+            return False
+
+    def _tick(self, now: Optional[float]) -> bool:
+        from syzkaller_tpu import telemetry
+        with self._lock:
+            t = self._time() if now is None else now
+            if self._last_tick and t - self._last_tick \
+                    < self.interval_s:
+                return False
+            dt = max(t - self._last_tick, 1e-9) \
+                if self._last_tick else self.interval_s or 1.0
+            self._last_tick = t
+            snap = telemetry.REGISTRY.snapshot()
+            vals = self._values(t, dt, snap)
+            horizon = t - self.slow_s
+            fired = []
+            for name, st in self._slos.items():
+                v = vals.get(name)
+                st.value = v
+                if v is not None:
+                    bad = (v < st.target) \
+                        if st.obj["kind"] == "floor" else \
+                        (v > st.target)
+                    st.ring.append((t, 1 if bad else 0))
+                while st.ring and st.ring[0][0] < horizon:
+                    st.ring.pop(0)
+                st.fast_burn = self._window_burn(st, t, self.fast_s)
+                st.slow_burn = self._window_burn(st, t, self.slow_s)
+                if not st.burning and \
+                        st.fast_burn >= self.burn_threshold and \
+                        st.slow_burn >= self.burn_threshold:
+                    st.burning = True
+                    st.fired_ts = t
+                    st.gauge.set(1)
+                    self._m_burns.inc()
+                    fired.append(st)
+                elif st.burning and st.fast_burn <= \
+                        self.burn_threshold * CLEAR_FRACTION:
+                    st.burning = False
+                    st.gauge.set(0)
+                    telemetry.record_event(
+                        "slo.clear",
+                        f"{name} fast_burn={st.fast_burn:.2f}x")
+        # Fire outside the lock: record_event and FLIGHT.dump take
+        # their own locks, and the incident snapshot reads the whole
+        # registry.
+        for st in fired:
+            val_s = f"{st.value:.4g}" if st.value is not None else "n/a"
+            detail = (f"{st.obj['name']} value={val_s} "
+                      f"target={st.target:.4g} "
+                      f"fast={st.fast_burn:.2f}x "
+                      f"slow={st.slow_burn:.2f}x")
+            telemetry.record_event("slo.burn", detail)
+            ledger = self._acct()
+            telemetry.FLIGHT.dump(
+                "slo_burn", detail,
+                extra={"slo": {"name": st.obj["name"],
+                               "kind": st.obj["kind"],
+                               "target": st.target,
+                               "value": st.value,
+                               "fast_burn": round(st.fast_burn, 3),
+                               "slow_burn": round(st.slow_burn, 3)},
+                       "top_consumers": ledger.top_consumers()
+                       if ledger is not None else {}})
+        return True
+
+    # -- reads -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /api/accounting scorecard block."""
+        with self._lock:
+            return {
+                "fast_s": self.fast_s,
+                "slow_s": self.slow_s,
+                "burn_threshold": self.burn_threshold,
+                "interval_s": self.interval_s,
+                "last_tick_ts": round(self._last_tick, 3),
+                "objectives": [
+                    {"name": st.obj["name"],
+                     "kind": st.obj["kind"],
+                     "target": st.target,
+                     "value": round(st.value, 6)
+                     if st.value is not None else None,
+                     "fast_burn": round(st.fast_burn, 3),
+                     "slow_burn": round(st.slow_burn, 3),
+                     "burning": st.burning,
+                     "samples": len(st.ring)}
+                    for st in self._slos.values()],
+            }
+
+    # -- durability --------------------------------------------------------
+
+    def export_state(self) -> dict:
+        with self._lock:
+            return {"slos": {name: {"burning": st.burning,
+                                    "fired_ts": st.fired_ts,
+                                    "ring": [[ts, b]
+                                             for ts, b in st.ring]}
+                             for name, st in self._slos.items()}}
+
+    def restore_state(self, state: dict) -> None:
+        """Warm restart: re-latch burning objectives and re-seed the
+        sample rings SILENTLY — no `slo.clear` (or re-`slo.burn`)
+        events fire from recovery itself; the next real tick
+        re-evaluates against the restored history."""
+        if not state:
+            return
+        with self._lock:
+            for name, rec in (state.get("slos") or {}).items():
+                st = self._slos.get(name)
+                if st is None:
+                    continue
+                st.burning = bool(rec.get("burning"))
+                st.fired_ts = float(rec.get("fired_ts") or 0.0)
+                st.ring = [(float(ts), int(b))
+                           for ts, b in rec.get("ring") or []]
+                st.gauge.set(1 if st.burning else 0)

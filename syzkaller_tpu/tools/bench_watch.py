@@ -310,6 +310,33 @@ def wedge_report(snap: dict) -> list[str]:
                      f"{int(werr)} wal errors, "
                      f"{int(cerr)} ckpt errors)")
         lines.append(line)
+    # Accounting & SLO plane (ISSUE 14): the device-time ledger and
+    # the burn-rate scorecard — a burning SLO names itself here, and
+    # the top device-ms consumer says WHO is eating the chip while the
+    # objective degrades (the first question of any wedge triage).
+    acct_tenant = {}
+    for k, v in counters.items():
+        if k.startswith('tz_acct_device_ms_total{tenant="') and v:
+            acct_tenant[k.split('tenant="', 1)[1].rstrip('"}')] = v
+    burning = []
+    for k, v in gauges.items():
+        if k.startswith('tz_slo_burn{') and v:
+            burning.append(k.split('slo="', 1)[1].rstrip('"}'))
+    if acct_tenant or burning:
+        line = ("slo: BURNING " + " ".join(sorted(burning))
+                if burning else "slo: ok")
+        burns = counters.get("tz_slo_burns_total") or 0
+        if burns:
+            line += f" ({int(burns)} burns total)"
+        if acct_tenant:
+            total = sum(acct_tenant.values()) or 1.0
+            top, top_ms = max(acct_tenant.items(), key=lambda kv: kv[1])
+            line += (f", device-ms ledger {total:.0f} ms, top tenant "
+                     f"{top} ({100.0 * top_ms / total:.0f}%)")
+        resets = counters.get("tz_telemetry_merge_resets_total") or 0
+        if resets:
+            line += f", {int(resets)} fuzzer counter resets absorbed"
+        lines.append(line)
     # Fault-domain mesh health (ISSUE 11): topology width, per-shard
     # breaker states, and the last re-shard age — a demoted shard
     # shows here as e.g. "3:open" while the engine keeps serving from

@@ -1,0 +1,109 @@
+"""tz-lint-slo: keep the SLO table internally consistent and honest.
+
+The burn-rate engine (telemetry/slo.py) is declarative on purpose:
+`SLO_TABLE` is the single place an objective's target, bounds, budget,
+and source metric live.  That makes the table the thing that rots — a
+target default drifting outside its clamp range, a budget of 0 (burn
+divides by it), fast/slow windows inverted so the "fast" confirmation
+never beats the "slow" one, or an objective wired to a metric that was
+renamed out from under it.  Each of those fails silently at runtime
+(the engine clamps, skips, or just never fires); this linter fails
+loudly in tier-1 instead (tests/test_tools.py invokes it).
+
+Checks, per objective and globally:
+
+  1. window order: FAST_S_DEFAULT < SLOW_S_DEFAULT — multi-window
+     burn alerting is meaningless if the confirmation window is not
+     the longer one,
+  2. table shape: unique names, kind in {floor, ceiling}, budget in
+     (0, 1], lo < hi, and the default target inside [lo, hi],
+  3. metric existence: every `metric` an objective reads must be a
+     name registered through the telemetry API or derived from a span
+     (reuses lint_metrics' source scan, so renames are caught even
+     when the SLO module still imports cleanly).
+
+Unlike lint_metrics this linter DOES import the slo module — the
+table is data, and re-parsing it from source would just be a second,
+worse parser.  Usage: python -m syzkaller_tpu.tools.lint_slo [root]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from syzkaller_tpu.tools import lint_metrics
+
+
+def lint(root: str, table=None, fast_s=None, slow_s=None) -> list[str]:
+    """All problems found, as printable strings (empty = clean).
+    `table`/`fast_s`/`slow_s` override the live module values so tests
+    can exercise the failure modes without editing the real table."""
+    from syzkaller_tpu.telemetry import slo
+
+    if table is None:
+        table = slo.SLO_TABLE
+    if fast_s is None:
+        fast_s = slo.FAST_S_DEFAULT
+    if slow_s is None:
+        slow_s = slo.SLOW_S_DEFAULT
+    problems: list[str] = []
+    if not fast_s < slow_s:
+        problems.append(
+            f"burn windows inverted: FAST_S_DEFAULT ({fast_s}) must be "
+            f"< SLOW_S_DEFAULT ({slow_s})")
+    registered, _literals, _dotted = lint_metrics.scan_sources(root)
+    seen: set[str] = set()
+    for obj in table:
+        name = obj.get("name", "<unnamed>")
+        where = f"slo table [{name}]"
+        if name in seen:
+            problems.append(f"{where}: duplicate objective name")
+        seen.add(name)
+        kind = obj.get("kind")
+        if kind not in ("floor", "ceiling"):
+            problems.append(
+                f"{where}: kind {kind!r} is not floor|ceiling")
+        budget = obj.get("budget")
+        if not isinstance(budget, (int, float)) or not 0 < budget <= 1:
+            problems.append(
+                f"{where}: error budget {budget!r} must be in (0, 1]")
+        lo, hi = obj.get("lo"), obj.get("hi")
+        default = obj.get("default")
+        if lo is None or hi is None or not lo < hi:
+            problems.append(
+                f"{where}: clamp range [{lo!r}, {hi!r}] is not "
+                "a valid lo < hi interval")
+        elif default is None or not lo <= default <= hi:
+            problems.append(
+                f"{where}: default target {default!r} outside its own "
+                f"clamp range [{lo}, {hi}] — the env knob "
+                f"{obj.get('env')} could never reach it")
+        env = obj.get("env", "")
+        if not env.startswith("TZ_SLO_"):
+            problems.append(
+                f"{where}: env knob {env!r} must be TZ_SLO_*")
+        metric = obj.get("metric")
+        if metric and metric not in registered:
+            problems.append(
+                f"{where}: reads metric {metric!r} which is not "
+                "registered anywhere in the source tree")
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    problems = lint(root)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"lint_slo: {len(problems)} problem(s)")
+        return 1
+    print("lint_slo: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

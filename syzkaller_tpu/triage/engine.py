@@ -168,13 +168,15 @@ class _Request:
 class _Entry:
     """One staged per-call novelty query."""
 
-    __slots__ = ("edges", "prio", "flagged", "req")
+    __slots__ = ("edges", "prio", "flagged", "req", "lane")
 
-    def __init__(self, edges: np.ndarray, prio: int, req: _Request):
+    def __init__(self, edges: np.ndarray, prio: int, req: _Request,
+                 lane: str = "exploration"):
         self.edges = edges
         self.prio = prio
         self.flagged = True  # conservative until the plane answers
         self.req = req
+        self.lane = lane  # workqueue lane for the accounting ledger
 
 
 class TriageEngine:
@@ -551,6 +553,10 @@ class TriageEngine:
                     "drift": None}
         self._note_occupancy(occ)
         telemetry.COVERAGE.sample(occ, regions, drift)
+        # SLO evaluation rides the flush-leader cadence (ISSUE 14):
+        # the engine rate-limits itself (TZ_SLO_INTERVAL_S) and never
+        # raises, so the analytics path stays advisory.
+        telemetry.SLO.tick()
         return {"occupancy": occ, "regions": regions, "drift": drift}
 
     def _audit_locked(self, plane) -> Optional[int]:
@@ -583,12 +589,16 @@ class TriageEngine:
 
     # -- the check path ----------------------------------------------------
 
-    def check(self, fuzzer, prio_fn, infos, trace=None) -> list:
+    def check(self, fuzzer, prio_fn, infos, trace=None,
+              source=None) -> list:
         """Drop-in for Fuzzer.cpu_check_new_signal: same (call_index,
         diff) list, same order, same max_signal/new_signal effects.
         `trace` is the executed mutant's lineage context: verdict
         delivery (device-filtered or CPU-confirmed) is one hop on its
-        correlated track (telemetry/lineage.py)."""
+        correlated track (telemetry/lineage.py).  `source` is the
+        workqueue lane (fuzzer/proc.py _LANE_BY_STAT) — it rides the
+        staged entries so the accounting ledger can attribute the
+        novel_any device residency per lane (ISSUE 14)."""
         infos = list(infos)
         if not infos:
             return []
@@ -616,7 +626,7 @@ class TriageEngine:
                 confirm_pos.append(pos)
                 continue
             en = _Entry(edges, prio_fn(info.errno, info.call_index),
-                        req)
+                        req, lane=source or "exploration")
             entries[pos] = en
             staged.append(en)
         if staged:
@@ -825,8 +835,15 @@ class TriageEngine:
                     lambda: np.asarray(flags_dev), "device.triage")
                 # Always-on per-kernel attribution: the verdict fetch
                 # is novel_any's sync point (telemetry/profiler.py).
-                telemetry.PROFILER.note(
-                    "novel_any", time.perf_counter() - t_fetch)
+                fetch_s = time.perf_counter() - t_fetch
+                telemetry.PROFILER.note("novel_any", fetch_s)
+                # Accounting ledger (ISSUE 14): the same residency,
+                # row-weighted over the chunk's workqueue lanes.
+                lanes: dict = {}
+                for en in chunk:
+                    lanes[en.lane] = lanes.get(en.lane, 0) + 1
+                telemetry.ACCOUNTING.note_batch(fetch_s,
+                                                lane_rows=lanes)
             except Exception as e:
                 self._plane_dev = None
                 self._epoch += 1

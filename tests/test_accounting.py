@@ -1,0 +1,368 @@
+"""Accounting & SLO plane (ISSUE 14, telemetry/accounting.py +
+telemetry/slo.py): the conservation invariant on the device-time
+ledger's row-weighted splits, novelty-yield pricing through the
+serving composer's credit rebalance, multi-window burn-rate alerting
+with injected clocks (fast-fire / slow-hold / clear-hysteresis), the
+self-diagnosing `slo_burn` flight incident, and the durable-state
+round trips that make a warm restart neither zero the meter nor
+false-clear a burning alert.
+
+Host-only: ledger and engine are pure host code — private instances,
+injected time, zero jit compiles.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.telemetry.accounting import (DEFAULT_KEY, MAX_KEYS,
+                                                OVERFLOW_KEY,
+                                                DeviceTimeLedger)
+from syzkaller_tpu.telemetry.slo import SloEngine
+
+# The acceptance invariant: per-key splits of every dimension sum to
+# the metered total within this relative error.
+CONSERVE_EPS = 1e-6
+
+
+def _dim_sum(ledger, dim):
+    return sum(v["device_ms"]
+               for v in ledger.dimension_snapshot(dim).values())
+
+
+# -- the ledger: conservation --------------------------------------------
+
+
+def test_conservation_mixed_three_tenant_batches():
+    """Mixed 3-tenant batches with awkward row ratios — including a
+    tenant that disappears mid-stream (reaped lease: its rows stop
+    arriving but its accumulated ms must stay on the books) — hold
+    the conservation invariant on every dimension."""
+    ledger = DeviceTimeLedger()
+    # Ratios chosen to be unrepresentable in binary (1/3, 1/7, ...):
+    # the naive proportional split would leak ulps every batch.
+    for i in range(500):
+        tenants = {"vmA": 1, "vmB": 3, "vmC": 7}
+        if i >= 300:
+            tenants.pop("vmC")  # reaped after batch 300
+        ledger.note_batch(0.0037 + 1e-5 * i,
+                          tenant_rows=tenants,
+                          lane_rows={"exploration": 11,
+                                     "candidate": 5, "smash": 1},
+                          shard_rows={str(i % 3): 1,
+                                      str((i + 1) % 3): 1})
+    assert ledger.batches == 500
+    assert ledger.conservation_error() <= CONSERVE_EPS
+    for dim in ("tenant", "lane", "shard"):
+        assert _dim_sum(ledger, dim) == \
+            pytest.approx(ledger.total_ms, rel=CONSERVE_EPS)
+    # The reaped tenant's cumulative ms survives its disappearance.
+    snap = ledger.dimension_snapshot("tenant")
+    assert snap["vmC"]["device_ms"] > 0
+    # Largest-remainder exactness: the two-key split is bit-exact.
+    two = DeviceTimeLedger()
+    two.note_batch(0.001, tenant_rows={"a": 1, "b": 2})
+    assert _dim_sum(two, "tenant") == two.total_ms  # ==, not approx
+
+
+def test_unattributed_batches_book_to_defaults_and_overflow_caps():
+    ledger = DeviceTimeLedger()
+    ledger.note_batch(0.002)
+    snap = ledger.snapshot()
+    for dim in ("tenant", "lane", "shard"):
+        assert snap[dim][DEFAULT_KEY[dim]]["device_ms"] == \
+            pytest.approx(2.0)
+    # Garbage in, metering out: non-positive batches are ignored.
+    ledger.note_batch(0.0)
+    ledger.note_batch(-1.0)
+    assert ledger.batches == 1
+    # A label leak folds into "overflow" past MAX_KEYS but still
+    # conserves (the cap bounds /metrics cardinality, not the books).
+    for i in range(MAX_KEYS + 20):
+        ledger.note_batch(0.001, tenant_rows={f"leak{i}": 1})
+    tsnap = ledger.dimension_snapshot("tenant")
+    assert len(tsnap) <= MAX_KEYS + 1
+    assert tsnap[OVERFLOW_KEY]["device_ms"] > 0
+    assert ledger.conservation_error() <= CONSERVE_EPS
+
+
+def test_yield_ewma_joins_novelty_to_device_time():
+    """`note_novel` prices in at the key's NEXT device-time accrual:
+    the first observation sets the EWMA (profiler idiom), later
+    zero-novelty accruals decay it toward zero."""
+    ledger = DeviceTimeLedger()
+    ledger.note_novel("tenant", "a", 7)
+    ledger.note_batch(0.020, tenant_rows={"a": 1})  # 7 / 0.02s
+    assert ledger.yield_ewmas("tenant")["a"] == pytest.approx(350.0)
+    before = ledger.yield_ewmas("tenant")["a"]
+    for _ in range(10):
+        ledger.note_batch(0.020, tenant_rows={"a": 1})
+    after = ledger.yield_ewmas("tenant")["a"]
+    assert 0.0 < after < before * 0.2
+    # Shards carry no novelty join (a chip discovers nothing).
+    ledger.note_novel("shard", "0", 5)
+    assert "shard" not in ledger.snapshot()["tenant"]
+    assert ledger.dimension_snapshot("shard")["0"]["novel"] == 0
+
+
+def test_top_consumers_ranked_table():
+    ledger = DeviceTimeLedger()
+    ledger.note_novel("tenant", "big", 10)
+    ledger.note_batch(0.010, tenant_rows={"big": 9, "small": 1})
+    top = ledger.top_consumers(n=2)
+    assert top["total_device_ms"] == pytest.approx(10.0)
+    assert top["tenant"][0]["key"] == "big"
+    assert top["tenant"][0]["share"] == pytest.approx(0.9)
+    assert top["tenant"][0]["yield"] > 0
+
+
+# -- yield pricing through the composer ----------------------------------
+
+
+def _mk_composer(clock):
+    from syzkaller_tpu.serve import (BatchComposer, ServePlane,
+                                     TenantPlanes)
+    broker = ServePlane(lease_s=3600.0, queue_cap=1000, max_tenants=8,
+                        clock=clock)
+    comp = BatchComposer(broker, TenantPlanes(bits=12), None,
+                         batch_rows=100, credit_floor=0.05,
+                         credit_decay=0.5, rebalance_s=0.0,
+                         stall_window_s=3600.0, clock=clock)
+    return broker, comp
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_serve_price_yield_zero_yield_tenant_lands_on_floor(
+        monkeypatch):
+    """ISSUE 14 acceptance: under TZ_SERVE_PRICE=yield a scripted
+    zero-yield tenant's credit decays to EXACTLY the credit floor
+    while the productive tenant takes the rest — even though the
+    zero-yield tenant is healthy by the novelty-delivery latch."""
+    monkeypatch.setenv("TZ_SERVE_PRICE", "yield")
+    ledger = DeviceTimeLedger()
+    monkeypatch.setattr(telemetry, "ACCOUNTING", ledger)
+    broker, comp = _mk_composer(_Clock())
+    assert comp.price == "yield"
+    for name in ("hot", "idle"):
+        broker.Connect({"name": name})
+        # Keep both tenants delivery-healthy: yield pricing, not the
+        # plateau latch, must be what floors the idle one.
+        broker.tenants[name].last_novel_ts = 1000.0
+        broker.tenants[name].novelty_ewma = 5.0
+    # The ledger's story: both burned device time, only hot yielded.
+    ledger.note_novel("tenant", "hot", 50)
+    ledger.note_batch(0.010, tenant_rows={"hot": 1, "idle": 1})
+    credits = comp.rebalance_credits(force=True)
+    assert credits["idle"] == 0.05  # exactly the floor, not approx
+    assert credits["hot"] == pytest.approx(0.95)
+
+
+def test_serve_price_default_novelty_ignores_ledger(monkeypatch):
+    """Default pricing is bit-exact pre-accounting behaviour: the
+    credit weights come from the delivery-novelty EWMAs and a skewed
+    ledger moves nothing."""
+    ledger = DeviceTimeLedger()
+    monkeypatch.setattr(telemetry, "ACCOUNTING", ledger)
+    broker, comp = _mk_composer(_Clock())
+    assert comp.price == "novelty"
+    for name, ewma in (("a", 3.0), ("b", 1.0)):
+        broker.Connect({"name": name})
+        broker.tenants[name].last_novel_ts = 1000.0
+        broker.tenants[name].novelty_ewma = ewma
+    # Ledger says "b" is the only yielder; novelty pricing ignores it.
+    ledger.note_novel("tenant", "b", 100)
+    ledger.note_batch(0.010, tenant_rows={"b": 1})
+    credits = comp.rebalance_credits(force=True)
+    assert credits["a"] == pytest.approx(0.05 + 0.9 * 0.75)
+    assert credits["b"] == pytest.approx(0.05 + 0.9 * 0.25)
+
+
+# -- the SLO engine: multi-window burn -----------------------------------
+
+
+UTIL_OBJ = {"name": "util", "kind": "floor", "env": "TZ_SLO_UTIL_FLOOR",
+            "default": 1.0, "lo": 0.0, "hi": 10.0, "budget": 0.1,
+            "metric": "tz_acct_device_ms_total", "help": "test floor"}
+
+
+def _mk_engine(value, ledger=None, fast_s=60.0, slow_s=300.0,
+               burn=1.0):
+    clk = [10_000.0]
+    eng = SloEngine(time_fn=lambda: clk[0], fast_s=fast_s,
+                    slow_s=slow_s, burn=burn, interval_s=0.0,
+                    table=[UTIL_OBJ],
+                    value_overrides={"util": lambda: value[0]},
+                    ledger=ledger or DeviceTimeLedger())
+    return clk, eng
+
+
+def _events_since(mark):
+    return [(n, d) for _ts, n, d in telemetry.REGISTRY.events()[mark:]]
+
+
+def test_burn_fires_only_after_slow_window_confirms(tmp_path):
+    """A breach must burn BOTH windows: the fast window alone (a
+    blip, or a freshly started engine with 60s of history) never
+    pages; once the slow window spans and agrees, the alert fires
+    ONCE with a `slo.burn` event and a `slo_burn` flight incident
+    carrying the top-consumers table."""
+    ledger = DeviceTimeLedger()
+    ledger.note_novel("tenant", "culprit", 3)
+    ledger.note_batch(0.050, tenant_rows={"culprit": 9, "minor": 1})
+    value = [0.2]  # floor target 1.0 -> every sample breaches
+    clk, eng = _mk_engine(value, ledger=ledger)
+    telemetry.FLIGHT.set_dir(str(tmp_path))
+    saved = telemetry.FLIGHT.min_interval_s
+    telemetry.FLIGHT.min_interval_s = 0.0
+    mark = len(telemetry.REGISTRY.events())
+    try:
+        # 20 ticks x 5s = 95s of all-bad history: the fast window
+        # (60s) is saturated, the slow window (300s) can't vote yet.
+        for _ in range(20):
+            eng.tick()
+            clk[0] += 5.0
+        st = eng.snapshot()["objectives"][0]
+        assert st["fast_burn"] >= 1.0 and st["slow_burn"] == 0.0
+        assert not st["burning"]
+        assert not any(n == "slo.burn" for n, _ in _events_since(mark))
+        # Keep breaching past the slow window: exactly one fire.
+        for _ in range(45):
+            eng.tick()
+            clk[0] += 5.0
+        st = eng.snapshot()["objectives"][0]
+        assert st["burning"] and st["slow_burn"] >= 1.0
+        burns = [d for n, d in _events_since(mark) if n == "slo.burn"]
+        assert len(burns) == 1 and "util" in burns[0]
+        assert telemetry.REGISTRY.snapshot()["gauges"][
+            'tz_slo_burn{slo="util"}'] == 1
+        # The incident is self-diagnosing: the attached ledger table
+        # names who was eating the device when the objective burned.
+        dumps = glob.glob(os.path.join(str(tmp_path),
+                                       "tz_flight_slo_burn_*.json"))
+        assert len(dumps) == 1
+        with open(dumps[0]) as f:
+            incident = json.load(f)
+        assert incident["slo"]["name"] == "util"
+        consumers = incident["top_consumers"]
+        assert consumers["tenant"][0]["key"] == "culprit"
+        assert consumers["tenant"][0]["share"] == pytest.approx(0.9)
+    finally:
+        telemetry.FLIGHT.set_dir(None)
+        telemetry.FLIGHT.min_interval_s = saved
+
+
+def test_burn_clears_with_hysteresis():
+    """Recovery flaps are absorbed: a latched burn survives the first
+    good samples and clears only when the fast-window burn falls
+    under half the firing threshold — then emits `slo.clear`."""
+    value = [0.2]
+    clk, eng = _mk_engine(value)
+    for _ in range(65):  # latch it
+        eng.tick()
+        clk[0] += 5.0
+    assert eng.snapshot()["objectives"][0]["burning"]
+    mark = len(telemetry.REGISTRY.events())
+    value[0] = 5.0  # healthy again
+    for _ in range(3):
+        eng.tick()
+        clk[0] += 5.0
+    st = eng.snapshot()["objectives"][0]
+    assert st["burning"]  # hysteresis holds through early recovery
+    assert not any(n == "slo.clear" for n, _ in _events_since(mark))
+    for _ in range(15):  # flush the fast window with good samples
+        eng.tick()
+        clk[0] += 5.0
+    st = eng.snapshot()["objectives"][0]
+    assert not st["burning"] and st["fast_burn"] <= 0.5
+    assert any(n == "slo.clear" and "util" in d
+               for n, d in _events_since(mark))
+    assert telemetry.REGISTRY.snapshot()["gauges"][
+        'tz_slo_burn{slo="util"}'] == 0
+
+
+def test_interval_rate_limit_and_tick_never_raises():
+    clk = [10_000.0]
+    eng = SloEngine(time_fn=lambda: clk[0], fast_s=60.0, slow_s=300.0,
+                    burn=1.0, interval_s=5.0, table=[UTIL_OBJ],
+                    value_overrides={"util": lambda: 2.0},
+                    ledger=DeviceTimeLedger())
+    assert eng.tick() is True
+    clk[0] += 1.0
+    assert eng.tick() is False  # inside the interval: no sample
+    clk[0] += 5.0
+    assert eng.tick() is True
+    # A broken override must not break the flush path hosting us.
+    def boom():
+        raise RuntimeError("scripted")
+    bad = SloEngine(time_fn=lambda: clk[0], interval_s=0.0,
+                    table=[UTIL_OBJ],
+                    value_overrides={"util": boom},
+                    ledger=DeviceTimeLedger())
+    assert bad.tick() is False
+
+
+# -- durable round trips -------------------------------------------------
+
+
+def test_ledger_state_round_trip_preserves_meter():
+    ledger = DeviceTimeLedger()
+    ledger.note_novel("tenant", "a", 12)
+    for _ in range(20):
+        ledger.note_batch(0.003, tenant_rows={"a": 2, "b": 1},
+                          lane_rows={"candidate": 1})
+    state = json.loads(json.dumps(ledger.export_state()))  # WAL trip
+    warm = DeviceTimeLedger()
+    warm.restore_state(state)
+    assert warm.total_ms == pytest.approx(ledger.total_ms)
+    assert warm.batches == ledger.batches
+    assert warm.conservation_error() <= CONSERVE_EPS
+    assert warm.dimension_snapshot("tenant") == \
+        ledger.dimension_snapshot("tenant")
+    assert warm.yield_ewmas("tenant")["a"] == \
+        pytest.approx(ledger.yield_ewmas("tenant")["a"])
+    # The meter keeps climbing from where it left off, not from zero.
+    warm.note_batch(0.001, tenant_rows={"a": 1})
+    assert warm.total_ms == pytest.approx(ledger.total_ms + 1.0)
+
+
+def test_slo_restore_relatches_silently():
+    """Warm restart must not flap the alert: a burning objective
+    comes back latched (gauge up, ring intact) with NO `slo.burn` or
+    `slo.clear` event fired by recovery itself."""
+    value = [0.2]
+    clk, eng = _mk_engine(value)
+    for _ in range(65):
+        eng.tick()
+        clk[0] += 5.0
+    assert eng.snapshot()["objectives"][0]["burning"]
+    state = json.loads(json.dumps(eng.export_state()))
+    mark = len(telemetry.REGISTRY.events())
+    clk2, warm = _mk_engine(value)
+    clk2[0] = clk[0]
+    warm.restore_state(state)
+    st = warm.snapshot()["objectives"][0]
+    assert st["burning"] and st["samples"] > 0
+    assert _events_since(mark) == []  # silent re-latch
+    assert telemetry.REGISTRY.snapshot()["gauges"][
+        'tz_slo_burn{slo="util"}'] == 1
+    # The restored ring is live history: continued breaches keep the
+    # latch without re-firing, recovery clears it normally.
+    warm.tick()
+    assert not any(n == "slo.burn" for n, _ in _events_since(mark))
+    value[0] = 5.0
+    for _ in range(15):
+        clk2[0] += 5.0
+        warm.tick()
+    assert not warm.snapshot()["objectives"][0]["burning"]

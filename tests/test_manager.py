@@ -117,6 +117,16 @@ def test_rpc_poll_telemetry_fleet_merge():
     # a poll without telemetry keeps the last snapshot (no regression)
     serv.Poll({"name": "f1", "stats": {}, "max_signal": [[], []]})
     assert serv.fleet_telemetry()["sources"] == 2
+    # ISSUE 14 monotonicity: f1 restarts (counters back near zero)
+    # with NO fleet read between the last pre-crash poll and the
+    # first post-crash one — the reset must be absorbed at poll time,
+    # so the fleet sees retired life + new life (6 + 1 + 7), never a
+    # negative delta.
+    snaps[0]["counters"]["tz_pipeline_mutants_total"] = 1
+    serv.Poll({"name": "f1", "stats": {}, "max_signal": [[], []],
+               "telemetry": snaps[0]})
+    assert serv.fleet_telemetry()["counters"][
+        "tz_pipeline_mutants_total"] == 14
 
 
 # -- Manager daemon -----------------------------------------------------
@@ -410,6 +420,47 @@ def test_http_ui_endpoints(tmp_path, test_target):
             assert metrics.count(
                 "# TYPE tz_coverage_novel_edges_total counter") == 1
             assert "tz_coverage_stalled 0" in metrics
+            assert validate_exposition(metrics) == []
+            # Accounting & SLO plane (ISSUE 14): the ledger's labeled
+            # device-ms family, the SLO scorecard gauge, and the
+            # /api/accounting surface all land on the exposition and
+            # validate through promcheck.
+            _telemetry.ACCOUNTING.note_batch(
+                0.004, tenant_rows={"vmA": 3, "vmB": 1})
+            _telemetry.SLO.tick()
+            metrics = get("/metrics")
+            assert 'tz_acct_device_ms_total{tenant="vmA"}' in metrics
+            assert metrics.count(
+                "# TYPE tz_acct_device_ms_total counter") == 1
+            assert 'tz_slo_burn{slo="device_util"}' in metrics
+            assert metrics.count("# TYPE tz_slo_burn gauge") == 1
+            assert validate_exposition(metrics) == []
+            acct = json_mod.loads(get("/api/accounting"))
+            assert acct["ledger"]["batches"] >= 1
+            assert acct["ledger"]["conservation_error"] <= 1e-6
+            assert acct["ledger"]["tenant"]["vmA"]["device_ms"] > 0
+            assert {o["name"] for o in acct["slo"]["objectives"]} >= {
+                "device_util", "mutant_rate", "triage_p99"}
+            assert "total_device_ms" in acct["top_consumers"]
+            assert "Accounting" in get("/")
+            # Fleet-merge monotonicity (ISSUE 14 satellite): a fuzzer
+            # restart resets its process-local counters; the fleet
+            # rollup must absorb the regression (retired life + new
+            # high-water = 9 + 2), never step backwards.
+            m.serv.Poll({"name": "f", "stats": {},
+                         "max_signal": [[], []],
+                         "telemetry": {
+                             "counters": {"tz_pipeline_mutants_total": 2},
+                             "gauges": {},
+                             "histograms": {}}})
+            api = json_mod.loads(get("/api/stats"))
+            assert api["fleet"]["counters"][
+                "tz_pipeline_mutants_total"] == 11
+            assert api["telemetry"]["counters"][
+                "tz_telemetry_merge_resets_total"] >= 1
+            metrics = get("/metrics")
+            assert ('tz_pipeline_mutants_total{source="fleet"} 11'
+                    in metrics)
             assert validate_exposition(metrics) == []
             # the summary page rolls the same plane up, and the
             # status snapshot carries the manager-level flag

@@ -416,3 +416,45 @@ def test_lint_metrics_flags_span_event_name_drift(tmp_path):
                  "mod.py"):
         assert not any(p.startswith(f"{name}:") for p in problems), \
             (name, problems)
+
+
+# -- SLO-table linter (tools/lint_slo, ISSUE 14) ------------------------
+
+
+def test_lint_slo_repo_is_clean(capsys):
+    """Tier-1 wrapper: the live SLO_TABLE must be internally
+    consistent and every objective's source metric must exist."""
+    from syzkaller_tpu.tools.lint_slo import lint, main
+
+    assert lint(REPO_ROOT) == []
+    assert main([REPO_ROOT]) == 0
+    assert "lint_slo: ok" in capsys.readouterr().out
+
+
+def test_lint_slo_flags_broken_table():
+    from syzkaller_tpu.tools.lint_slo import lint
+
+    bad = [
+        # default outside the clamp range: the knob could never set it
+        {"name": "a", "kind": "floor", "env": "TZ_SLO_A",
+         "default": 5.0, "lo": 0.0, "hi": 1.0, "budget": 0.1,
+         "metric": "tz_pipeline_mutants_total", "help": "x"},
+        # zero budget (burn would divide by it) + unknown metric
+        {"name": "b", "kind": "sideways", "env": "TZ_SLO_B",
+         "default": 0.5, "lo": 0.0, "hi": 1.0, "budget": 0.0,
+         "metric": "tz_never_registered_total", "help": "x"},
+        {"name": "b", "kind": "ceiling", "env": "TZ_B",
+         "default": 0.5, "lo": 0.0, "hi": 1.0, "budget": 0.1,
+         "metric": None, "help": "x"},
+    ]
+    problems = lint(REPO_ROOT, table=bad, fast_s=600.0, slow_s=300.0)
+    assert any("windows inverted" in p for p in problems)
+    assert any("[a]" in p and "outside its own clamp range" in p
+               for p in problems)
+    assert any("[b]" in p and "sideways" in p for p in problems)
+    assert any("[b]" in p and "budget" in p for p in problems)
+    assert any("[b]" in p and "tz_never_registered_total" in p
+               for p in problems)
+    assert any("[b]" in p and "duplicate" in p for p in problems)
+    assert any("'TZ_B'" in p and "must be TZ_SLO_" in p
+               for p in problems)
