@@ -971,6 +971,147 @@ def bench_cpu(seconds=3.0) -> float:
     return n / (time.time() - t0)
 
 
+def bench_sim(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
+              seconds=6.0, loop_iters=20, seeds=64) -> dict:
+    """The speculative sim-exec prescore (ISSUE 15), two measurements:
+
+      - the PRESCORED DRAIN: the normal pipeline loop with the
+        sim-exec stage fused in (TZ_SIM_PRESCORE path) — every mutant
+        is simulated on device, so sim_execs_per_sec is the drained
+        batch volume over the timed window,
+        prescore_suppressed_frac is the fraction of each batch the
+        speculation plane held back from D2H, and
+        prescore_suppressed_of_candidates is the same count relative
+        to the rows that survived signature dedup — the acceptance
+        target (>= 0.5 once the plane warms) reads on the latter.
+      - the PURE-DEVICE LOOP: mutate -> sim-exec -> triage-fold
+        chained entirely on device (the step's plane outputs feed the
+        next dispatch; ZERO host transfers inside the loop, one
+        block_until_ready at the end) — the zero-host-transfer loop
+        rate the acceptance criteria ask the report to carry."""
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
+
+    target = get_target("test", "64")
+    pl = DevicePipeline(target, capacity=capacity,
+                        batch_size=batch_size, seed=0)
+    pl.enable_sim_prescore()
+    added, i = 0, 0
+    while added < seeds and i < seeds * 8:
+        if pl.add(_seed_programs(target, 1, seed0=42 + i)[0]):
+            added += 1
+        i += 1
+    assert added > 0, "no seed programs tensorized"
+    out: dict = {"sim_backend": pl._sim.backend,
+                 "pipeline_batch": batch_size}
+    try:
+        from syzkaller_tpu.health import env_float
+
+        warmup_to = env_float("TZ_BENCH_WARMUP_TIMEOUT_S", 600.0)
+        fast = 0
+        for attempt in range(12):
+            tw = time.time()
+            pl.next_batch(timeout=warmup_to if attempt == 0 else 600)
+            fast = fast + 1 if time.time() - tw < 5.0 else 0
+            if fast >= 2:
+                break
+        base_b, base_sup = pl.stats.sim_batches, pl.stats.sim_suppressed
+        base_adm = pl.stats.fused_novel_rows
+        n = 0
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            n += len(pl.next_batch(timeout=600))
+        dt = time.time() - t0
+        d_batches = pl.stats.sim_batches - base_b
+        d_sup = pl.stats.sim_suppressed - base_sup
+        d_adm = pl.stats.fused_novel_rows - base_adm
+        out["exec_ready_mutants_per_sec"] = round(n / dt, 1)
+        out["sim_execs_per_sec"] = round(
+            d_batches * batch_size / dt, 1)
+        out["prescore_suppressed_frac"] = round(
+            d_sup / max(1, d_batches * batch_size), 4)
+        # The acceptance-relevant rate: of the rows that survived
+        # signature dedup (the only rows that would have crossed D2H
+        # without the prescore), how many did the speculation plane
+        # hold back?  Signature-dup rows never were D2H candidates, so
+        # the whole-batch fraction above understates the filter.
+        out["prescore_suppressed_of_candidates"] = round(
+            d_sup / max(1, d_sup + d_adm), 4)
+        # -- the pure-device loop -------------------------------------
+        # Reuse the warm pipeline's device state but drive the
+        # prescored step directly: no fetch, no assembly — the only
+        # sync is the final block_until_ready.
+        import jax
+
+        pl.stop()
+        corpus, cn, _tmpl, ets = pl._flush_pending()
+        if corpus is None:
+            corpus, cn = pl._corpus_dev, pl._n
+        sim = pl._sim
+        sim_tables = sim.device_tables(ets)
+        sim_plane = sim.ensure_plane()
+        plane = pl._mutant_plane
+        if plane is None:
+            from syzkaller_tpu.ops.signal import new_mutant_plane
+
+            plane = new_mutant_plane(pl._plane_bits)
+        fv, fc = pl._flags_dev
+        key = pl._key
+        rows = None
+        # One untimed iteration absorbs any residual compile.
+        for timed in (False, True):
+            iters = loop_iters if timed else 1
+            t0 = time.time()
+            for _ in range(iters):
+                key, sub = pl._random.split(key)
+                (rows, _pool, _n_used, _n_novel, plane, sim_plane,
+                 _n_sup) = pl._step_sim(
+                    corpus, cn, sub, fv, fc, plane, sim_plane,
+                    sim_tables, pl._runs_dev, pl._by_syscall_dev)
+            jax.block_until_ready((rows, plane, sim_plane))
+            loop_dt = time.time() - t0
+        out["sim_loop_mutants_per_sec"] = round(
+            loop_iters * batch_size / loop_dt, 1)
+        out["sim_loop_batches_per_sec"] = round(
+            loop_iters / loop_dt, 2)
+    finally:
+        pl.stop()
+        dump_telemetry()
+    return out
+
+
+def bench_ab_prescore(seconds=20.0) -> dict:
+    """Prescore efficacy A/B (ISSUE 15 satellite): new-coverage edges
+    on the sim-kernel executor at EQUAL WALL TIME, device engine on in
+    both arms, speculative prescore on vs off.  The prescore spends
+    device time simulating mutants to save D2H/assembly/exec time on
+    stale ones — this measures whether that trade nets out on this
+    platform."""
+    prev = os.environ.get("TZ_SIM_PRESCORE")
+    try:
+        os.environ["TZ_SIM_PRESCORE"] = "1"
+        on = _ab_run(True, seconds=seconds)
+        os.environ["TZ_SIM_PRESCORE"] = "0"
+        off = _ab_run(True, seconds=seconds)
+    finally:
+        if prev is None:
+            os.environ.pop("TZ_SIM_PRESCORE", None)
+        else:
+            os.environ["TZ_SIM_PRESCORE"] = prev
+    edges_pct = round(
+        100.0 * (on["edges"] / off["edges"] - 1.0), 2) \
+        if off["edges"] else 0.0
+    return {
+        "seconds": seconds, "mode": "prescore",
+        "prescore_on": on, "prescore_off": off,
+        "edges_pct_equal_wall": edges_pct,
+        "note": ("both arms run the device engine; the A/B isolates "
+                 "the speculative sim-exec stage (TZ_SIM_PRESCORE). "
+                 "positive edges_pct = prescore-on found more new "
+                 "edges at equal wall time"),
+    }
+
+
 def _ab_run(engine_on: bool, seconds: Optional[float] = None,
             max_execs: Optional[int] = None) -> dict:
     """One fuzzing run on the sim-kernel executor: either fixed wall
@@ -1295,11 +1436,30 @@ def main() -> None:
         journal_append(res)
         print(json.dumps(res))
         return
+    if "--ab-prescore" in argv:
+        i = argv.index("--ab-prescore")
+        secs = float(argv[i + 1]) if len(argv) > i + 1 else 20.0
+        res = bench_ab_prescore(secs)
+        res["metric"] = "new_edges_sim_kernel_ab"
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
     if "--ab" in argv:
         secs = float(argv[argv.index("--ab") + 1]) \
             if len(argv) > argv.index("--ab") + 1 else 20.0
         res = bench_ab_edges(secs)
         res["metric"] = "new_edges_sim_kernel_ab"
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
+    if "--sim" in argv:
+        res = {"metric": "sim_execs_per_sec", "unit": "sim execs/sec",
+               **bench_sim()}
+        res["value"] = res["sim_execs_per_sec"]
         if platform:
             res["platform"] = platform
         journal_append(res)
@@ -1393,6 +1553,14 @@ def main() -> None:
         triage_sub = bench_triage()
     except Exception as e:
         triage_sub = {"triage_error": f"{type(e).__name__}: {e}"[:200]}
+    # Sim-prescore sub-bench (ISSUE 15): the speculative drain's
+    # suppression fraction + pure-device loop rate ride the flagship
+    # journal entry; a prescore failure never discards the flagship.
+    try:
+        sim_sub = {"sim": bench_sim(batch_size=batch, seconds=4.0,
+                                    loop_iters=10, seeds=32)}
+    except Exception as e:
+        sim_sub = {"sim_error": f"{type(e).__name__}: {e}"[:200]}
     cpu_rate = bench_cpu()
     result = {
         "metric": "exec_ready_mutants_per_sec_per_chip",
@@ -1408,6 +1576,7 @@ def main() -> None:
             **pipe_sub,
             **assemble_sub,
             **triage_sub,
+            **sim_sub,
         },
         "note": ("value = integrated corpus-tensor->exec-bytes rate off "
                  "ops/pipeline.DevicePipeline (the path fuzzer/proc.py "

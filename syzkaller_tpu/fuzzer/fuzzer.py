@@ -52,6 +52,10 @@ class Stat(IntEnum):
     # novelty checks demoted to / re-promoted from the CPU path.
     DEVICE_TRIAGE_DEMOTIONS = 18
     DEVICE_TRIAGE_REPROMOTIONS = 19
+    # Sim-exec prescore (syzkaller_tpu/sim): batches drained through
+    # the speculative stage and plane-novel rows it held back.
+    DEVICE_SIM_BATCHES = 20
+    DEVICE_SIM_SUPPRESSED = 21
 
 
 STAT_NAMES = {
@@ -75,6 +79,8 @@ STAT_NAMES = {
     Stat.DEVICE_WEDGES: "device wedges",
     Stat.DEVICE_TRIAGE_DEMOTIONS: "device triage demotions",
     Stat.DEVICE_TRIAGE_REPROMOTIONS: "device triage repromotions",
+    Stat.DEVICE_SIM_BATCHES: "device sim prescored batches",
+    Stat.DEVICE_SIM_SUPPRESSED: "device sim suppressed rows",
 }
 
 

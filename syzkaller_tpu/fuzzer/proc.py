@@ -210,6 +210,11 @@ class PipelineMutator:
                 totals[Stat.DEVICE_TRIAGE_DEMOTIONS] = te.stats.demotions
                 totals[Stat.DEVICE_TRIAGE_REPROMOTIONS] = \
                     te.stats.repromotions
+            if pstats is not None and getattr(
+                    pstats, "sim_batches", 0):
+                totals[Stat.DEVICE_SIM_BATCHES] = pstats.sim_batches
+                totals[Stat.DEVICE_SIM_SUPPRESSED] = \
+                    pstats.sim_suppressed
             deltas = []
             for stat, total in totals.items():
                 seen = self._reported.get(stat.name, 0)

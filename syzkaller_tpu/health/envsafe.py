@@ -74,6 +74,10 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_SERVE_QUEUE_CAP",
     "TZ_SERVE_REBALANCE_S",
     "TZ_SERVE_STALL_WINDOW_S",
+    "TZ_SIM_BACKEND",
+    "TZ_SIM_EPOCH_BATCHES",
+    "TZ_SIM_PLANE_BITS",
+    "TZ_SIM_PRESCORE",
     "TZ_SLO_BREAKER_RATIO",
     "TZ_SLO_BURN",
     "TZ_SLO_DELIVERY_P99_S",

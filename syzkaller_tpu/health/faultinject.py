@@ -43,6 +43,7 @@ SEAMS = (
     "device.launch",
     "device.compile",
     "device.triage",
+    "device.sim",
     "staging.h2d",
     "rpc.send_frame",
     "rpc.recv_frame",

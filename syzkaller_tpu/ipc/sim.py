@@ -4,6 +4,17 @@ Mirrors executor/sim_kernel.h so tests and the repro pipeline can
 predict which (call_id, args) combinations unlock magic edges or the
 two-stage crash — the executable ground truth the reference only has
 against a live kernel.
+
+The lower half of the module is the FIXED-SLOT execution model the
+on-device simulated executor (syzkaller_tpu/sim) is parity-tested
+against: every call's possible edges are laid out in a static
+SIM_EDGE_SLOTS-wide vector (entry, per-arg bucket, per-arg magic
+pair, per-arg handle, the two combo edges, the crash-arm edge) with a
+validity mask instead of the C++ append-order cov buffer.  The slot
+layout is a pure re-indexing of sim_kernel.h's emit() sequence — the
+same (pc, emitted?) pairs, order-independent — which is what lets a
+batched device kernel with static shapes be bit-exact with the host
+model edge for edge.
 """
 
 from __future__ import annotations
@@ -57,3 +68,147 @@ def is_race_prepare(call_id: int) -> bool:
 
 def is_race_trigger(call_id: int) -> bool:
     return race_tag(call_id) == RACE_TRIGGER_TAG
+
+
+def is_lockless(call_id: int) -> bool:
+    """Calls the executor routes through exec_lockless (the race
+    families): entry edge only, never touch the handle set."""
+    t = race_tag(call_id)
+    return t == RACE_PREPARE_TAG or t == RACE_TRIGGER_TAG
+
+
+# ---- fixed-slot edge layout (the device sim-exec contract) -----------
+
+#: executor cap (wire nargs > 8 is failf'd, executor.cc:712).
+SIM_MAX_ARGS = 8
+
+#: Slot indices into a call's SIM_EDGE_SLOTS-wide edge vector.  The
+#: layout is static so a batched kernel needs no compaction: slot 0
+#: is the unconditional entry edge, 1..8 the per-arg value-bucket
+#: edges, 9..24 the per-arg magic-unlock PAIRS (two consecutive slots
+#: per arg), 25..32 the per-arg valid-handle edges, 33/34 the two
+#: state-combo edges, 35 the crash-ARM edge (arg0 hit its crash magic
+#: but arg1 did not complete the crash).
+SIM_SLOT_ENTRY = 0
+SIM_SLOT_BUCKET0 = 1
+SIM_SLOT_MAGIC0 = SIM_SLOT_BUCKET0 + SIM_MAX_ARGS  # 9
+SIM_SLOT_HANDLE0 = SIM_SLOT_MAGIC0 + 2 * SIM_MAX_ARGS  # 25
+SIM_SLOT_COMBO_HANDLES = SIM_SLOT_HANDLE0 + SIM_MAX_ARGS  # 33
+SIM_SLOT_COMBO_MIXED = SIM_SLOT_COMBO_HANDLES + 1  # 34
+SIM_SLOT_CRASH_ARM = SIM_SLOT_COMBO_MIXED + 1  # 35
+SIM_EDGE_SLOTS = SIM_SLOT_CRASH_ARM + 1  # 36
+
+
+def value_bucket(v: int) -> int:
+    """Coarse value bucket (sim_kernel.h value_bucket): log2 magnitude
+    in the high bits, the low nibble verbatim."""
+    v &= MASK64
+    log2 = 0
+    while log2 < 63 and (v >> (log2 + 1)):
+        log2 += 1
+    return (log2 << 4) | (v & 0xF)
+
+
+def edge_pc(seed: int) -> int:
+    """One emitted edge PC: the low 32 bits of splitmix64(seed)
+    (sim_kernel.h emit())."""
+    return splitmix64(seed & MASK64) & 0xFFFFFFFF
+
+
+class SimCallResult:
+    """One executed call in the fixed-slot layout.
+
+    edges[k] is slot k's PC (always computed), valid[k] whether the
+    simulated kernel actually emitted it.  A fully-crashed call
+    reports NO edges (valid all False): the executor _exits before
+    copying the call's coverage out (executor.cc run loop), so the
+    real pipeline never sees them either."""
+
+    __slots__ = ("edges", "valid", "ret", "errno", "crashed")
+
+    def __init__(self, edges, valid, ret, errno, crashed):
+        self.edges = edges
+        self.valid = valid
+        self.ret = ret
+        self.errno = errno
+        self.crashed = crashed
+
+    def emitted(self) -> list[int]:
+        """The valid edge PCs (order = slot order)."""
+        return [pc for pc, ok in zip(self.edges, self.valid) if ok]
+
+
+class SimKernelModel:
+    """Stateful host mirror of sim_kernel.h's SimKernel for SEQUENTIAL
+    execution: the handle set accumulates across exec() calls exactly
+    like the C++ std::set, the race families run the lockless path
+    (which sequentially can never crash — prepare closes its window
+    before returning), and fault injection is never armed (the
+    prescore path does not model it)."""
+
+    def __init__(self, pid: int = 0):
+        self.pid = pid
+        self.handles: set[int] = set()
+
+    def exec(self, call_id: int, args) -> SimCallResult:
+        call_id &= 0xFFFFFFFF
+        args = [a & MASK64 for a in args[:SIM_MAX_ARGS]]
+        nargs = len(args)
+        h = call_hash(call_id)
+        edges = [0] * SIM_EDGE_SLOTS
+        valid = [False] * SIM_EDGE_SLOTS
+        edges[SIM_SLOT_ENTRY] = edge_pc(h)
+        valid[SIM_SLOT_ENTRY] = True
+        for i in range(SIM_MAX_ARGS):
+            a = args[i] if i < nargs else 0
+            edges[SIM_SLOT_BUCKET0 + i] = edge_pc(
+                h ^ splitmix64((i << 32) | value_bucket(a)))
+            edges[SIM_SLOT_MAGIC0 + 2 * i] = edge_pc(
+                h ^ splitmix64(0xABCD0000 + i))
+            edges[SIM_SLOT_MAGIC0 + 2 * i + 1] = edge_pc(
+                h ^ splitmix64(0xABCD1000 + i
+                               + (arg_magic(call_id, i) & 0xFF)))
+            edges[SIM_SLOT_HANDLE0 + i] = edge_pc(
+                h ^ splitmix64(0xFEED0000 + i))
+        edges[SIM_SLOT_COMBO_HANDLES] = edge_pc(h ^ 0x10)
+        edges[SIM_SLOT_COMBO_MIXED] = edge_pc(h ^ 0x11)
+        edges[SIM_SLOT_CRASH_ARM] = edge_pc(h ^ 0xDEAD0)
+
+        if is_lockless(call_id):
+            # exec_lockless: entry edge only, the handle set is never
+            # touched, and a sequential trigger finds the window
+            # closed — ret 0, errno 0, no crash.
+            return SimCallResult(edges, valid, 0, 0, False)
+
+        magic_hits = 0
+        handle_hits = 0
+        for i, a in enumerate(args):
+            valid[SIM_SLOT_BUCKET0 + i] = True
+            if a == arg_magic(call_id, i):
+                magic_hits += 1
+                valid[SIM_SLOT_MAGIC0 + 2 * i] = True
+                valid[SIM_SLOT_MAGIC0 + 2 * i + 1] = True
+            if a in self.handles:
+                handle_hits += 1
+                valid[SIM_SLOT_HANDLE0 + i] = True
+        valid[SIM_SLOT_COMBO_HANDLES] = handle_hits >= 2
+        valid[SIM_SLOT_COMBO_MIXED] = handle_hits >= 1 and magic_hits >= 1
+
+        if (h & 7) == 3 and nargs >= 2:
+            c0, c1 = crash_magics(call_id)
+            if args[0] == c0:
+                valid[SIM_SLOT_CRASH_ARM] = True
+                if args[1] == c1:
+                    # Full crash: the executor _exits before copyout,
+                    # so neither the edges nor the ret survive.
+                    return SimCallResult(edges,
+                                         [False] * SIM_EDGE_SLOTS,
+                                         0, 0, True)
+
+        if (h & 3) == 1:
+            handle = 0x1000 + (len(self.handles) * 4 + self.pid) % 0xFFFFF
+            self.handles.add(handle)
+            return SimCallResult(edges, valid, handle, 0, False)
+        wants_handle = (h & 3) == 2 and nargs > 0
+        errno = 9 if (wants_handle and handle_hits == 0) else 0
+        return SimCallResult(edges, valid, 0, errno, False)

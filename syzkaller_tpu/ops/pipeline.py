@@ -277,6 +277,8 @@ class PipelineStats:
     d2h_batches: int = 0  # batches those bytes cover
     fused_batches: int = 0  # batches drained through the fused path
     fused_novel_rows: int = 0  # plane-novel rows those batches shipped
+    sim_batches: int = 0  # batches drained through the sim prescore
+    sim_suppressed: int = 0  # plane-novel rows the prescore held back
 
 
 class AssembledBatch(list):
@@ -417,7 +419,8 @@ PIPELINE_DELTA_SPEC = DeltaSpec()
 
 @functools.lru_cache(maxsize=None)
 def _shared_step(spec, B: int, R: int, backend: str, fused: bool,
-                 n_blocks: int, max_insert_calls: int):
+                 n_blocks: int, max_insert_calls: int,
+                 prescore: bool = False, sim_backend: str = ""):
     """The jitted mutate->pack step, shared process-wide.
 
     The ChoiceTable prefix-sum rows and the donor index enter as
@@ -536,6 +539,56 @@ def _shared_step(spec, B: int, R: int, backend: str, fused: bool,
         rows, n_novel = compact_rows(rows, novel)
         return rows, pool_arr, n_used, n_novel, plane
 
+    def fused_prescore_step(corpus: dict, n: int, key, flag_vals,
+                            flag_counts, plane, sim_plane, sim_tables,
+                            runs, by_syscall):
+        """The fused drain with the ISSUE 15 sim-exec prescore fused
+        in: mutate -> plane dedup -> SIMULATED execution of every
+        plane-novel mutant (syzkaller_tpu/sim) -> predicted-edge fold
+        into the speculation plane -> novel_any-style admit verdict.
+        Only rows whose PREDICTED edges hit a fresh speculation-plane
+        bucket cross D2H; the rest are suppressed on device (counted,
+        and re-admissible after the plane's decay epoch — see
+        sim/prescore.py for the no-starvation argument).  Insert-class
+        mutants are force-admitted: their donor splice happens host-
+        side, so simulating the base template alone would mispredict
+        them wholesale."""
+        from syzkaller_tpu.ops.pallas_mutate import _use_interpret
+        from syzkaller_tpu.sim.kernel import (
+            TABLE_FIELDS,
+            apply_deltas,
+            decode_rows,
+            predict_and_mark,
+            sim_exec_batch,
+        )
+
+        rows, payloads, needs = sample_and_pack(
+            corpus, n, key, flag_vals, flag_counts, runs, by_syscall)
+        novel, plane = mutant_novelty(plane, rows)
+        # Reconstruct each mutant's value slots from its delta row
+        # and gather its template's lowered sim table — the sim-exec
+        # kernel then runs the WHOLE batch in one dispatch.
+        op, tidx, alive, val_idx, vals_j = decode_rows(rows, spec.K)
+        vals = apply_deltas(corpus["val"], tidx, val_idx, vals_j)
+        cap = corpus["val"].shape[0]
+        ti = jnp.clip(tidx, 0, cap - 1)
+        table_rows = {k: sim_tables[k][ti] for k in TABLE_FIELDS}
+        ncalls = sim_tables["ncalls"][ti]
+        edges, valid, _ret, _errno, _status = sim_exec_batch(
+            table_rows, ncalls, alive, vals, sim_backend,
+            interpret=_use_interpret())
+        bits = int(sim_plane.shape[0]).bit_length() - 1
+        pred, sim_plane = predict_and_mark(edges, valid, sim_plane,
+                                           bits)
+        admit = novel & (pred | (op == OP_INSERT))
+        rows, pool_arr, n_used = pool(rows, payloads, needs & admit)
+        n_suppressed = (novel & ~admit).sum().astype(jnp.int32)
+        rows, n_novel = compact_rows(rows, admit)
+        return (rows, pool_arr, n_used, n_novel, plane, sim_plane,
+                n_suppressed)
+
+    if prescore:
+        return jax.jit(fused_prescore_step)
     return jax.jit(fused_step if fused else step)
 
 
@@ -615,9 +668,19 @@ class DevicePipeline:
         # prio/donor tables ride along as traced arguments at dispatch
         # (self._runs_dev / self._by_syscall_dev), so engines at the
         # same shape share one compile (_shared_step).
+        self._rounds = rounds
+        self._n_blocks = n_blocks
+        self._max_insert_calls = max_insert_calls
+        self._seed = seed
         self._step = _shared_step(self.spec, batch_size, rounds,
                                   self._backend, self._fused,
                                   n_blocks, max_insert_calls)
+        # Speculative sim-exec prescore (ISSUE 15, syzkaller_tpu/sim):
+        # OFF by default; TZ_SIM_PRESCORE=1 (or enable_sim_prescore())
+        # fuses a simulated-execution stage after the mutant plane so
+        # only predicted-novel rows cross D2H.
+        self._sim = None
+        self._step_sim = None
 
         self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
         # In-flight device dispatches the worker keeps ahead of the
@@ -712,6 +775,8 @@ class DevicePipeline:
         self._worker = threading.Thread(target=self._worker_loop,
                                         name="device-pipeline", daemon=True)
         self._started = False
+        if env_int("TZ_SIM_PRESCORE", 0) != 0 and self._fused:
+            self.enable_sim_prescore()
         # Typo guard: a misspelled TZ_* knob parses as "unset" and
         # silently changes nothing — flag it once at engine start.
         warn_unknown_tz_vars()
@@ -738,6 +803,39 @@ class DevicePipeline:
         """Register the co-resident triage engine for plane
         invalidation on host-snapshot ring rebuilds."""
         self.triage_engine = engine
+        if self._sim is not None:
+            engine.attach_sim(self._sim)
+
+    def enable_sim_prescore(self, backend=None) -> None:
+        """Turn on the speculative sim-exec prescore stage (ISSUE 15).
+        Builds the per-pipeline SimPrescore state and the prescored
+        step executable; the plain fused step stays compiled as the
+        demotion target.  Requires the fused drain (the prescore IS a
+        fusion stage); idempotent."""
+        if not self._fused:
+            raise RuntimeError(
+                "sim prescore requires the fused drain "
+                "(TZ_PIPELINE_FUSED=1)")
+        if self._sim is not None:
+            return
+        from syzkaller_tpu.sim.prescore import SimPrescore
+
+        self._sim = SimPrescore(
+            capacity=self.capacity, max_calls=self.cfg.max_calls,
+            backend=backend, seed=self._seed)
+        self._step_sim = _shared_step(
+            self.spec, self.batch_size, self._rounds, self._backend,
+            True, self._n_blocks, self._max_insert_calls,
+            True, self._sim.backend)
+        if self.triage_engine is not None:
+            self.triage_engine.attach_sim(self._sim)
+
+    def disable_sim_prescore(self) -> None:
+        """Back to the plain fused drain (kill switch / test
+        teardown).  The shared step cache keeps the prescored
+        executable for a later re-enable."""
+        self._sim = None
+        self._step_sim = None
 
     def attach_mesh(self, engine) -> None:
         """Register the co-resident fault-domain mesh engine
@@ -823,6 +921,8 @@ class DevicePipeline:
             out["triage"] = self.triage_engine.snapshot()
         if self._mesh_engine is not None:
             out["mesh"] = self._mesh_engine.health_snapshot()
+        if self._sim is not None:
+            out["sim"] = self._sim.snapshot()
         return out
 
     # -- corpus management -------------------------------------------------
@@ -987,9 +1087,36 @@ class DevicePipeline:
 
             plane = new_mutant_plane(self._plane_bits)
             self._mutant_plane = plane
+        # Speculative prescore (ISSUE 15): stage the sim tables +
+        # speculation plane OUTSIDE the dispatch, behind the sim's own
+        # breaker and the device.sim fault seam.  ANY failure here
+        # demotes to the plain fused step — pass-through, zero lost
+        # mutants (the plain path still ships every plane-novel row).
+        sim = self._sim
+        use_sim = False
+        sim_tables = sim_plane = None
+        if sim is not None and self._step_sim is not None \
+                and sim.breaker.allow():
+            try:
+                fault_point("device.sim")
+                sim_tables = sim.device_tables(ets)
+                sim_plane = sim.ensure_plane()
+                use_sim = True
+            except Exception as e:
+                sim.note_failure(e)
 
         def dispatch():
             fault_point(op)
+            if use_sim:
+                try:
+                    return self._step_sim(
+                        corpus, n, sub, fv, fc, plane, sim_plane,
+                        sim_tables, self._runs_dev,
+                        self._by_syscall_dev)
+                except FaultInjected:
+                    raise
+                except Exception as e:
+                    sim.note_failure(e)
             if self._fused:
                 return self._step(corpus, n, sub, fv, fc, plane,
                                   self._runs_dev, self._by_syscall_dev)
@@ -1019,7 +1146,16 @@ class DevicePipeline:
         # mutants never transfers at all.  An array without an async
         # path (CPU tests, older plugins) falls back to the
         # synchronous drain, counted instead of swallowed silently.
-        if self._fused:
+        n_suppr_dev = None
+        if len(result) == 7:
+            # Prescored fused drain (ISSUE 15): also carry the updated
+            # speculation plane and the suppressed-row count.
+            (rows_dev, pool_dev, n_used_dev, n_novel_dev, plane,
+             sim_plane_new, n_suppr_dev) = result
+            self._mutant_plane = plane
+            sim.commit(sim_plane_new)
+            async_arrs = (n_used_dev, n_novel_dev, n_suppr_dev)
+        elif self._fused:
             rows_dev, pool_dev, n_used_dev, n_novel_dev, plane = result
             self._mutant_plane = plane
             async_arrs = (n_used_dev, n_novel_dev)
@@ -1035,8 +1171,8 @@ class DevicePipeline:
                 _M_ASYNC_COPY_FALLBACKS.inc()
         # t_dispatch anchors the always-on profiler's dispatch→ready
         # attribution for the fused mutate step (telemetry/profiler).
-        return ((rows_dev, pool_dev, n_used_dev, n_novel_dev), tmpl,
-                ets, (trace, time.perf_counter()))
+        return ((rows_dev, pool_dev, n_used_dev, n_novel_dev,
+                 n_suppr_dev), tmpl, ets, (trace, time.perf_counter()))
 
     def _fetch(self, launched):
         """The device->host transfers for one launched batch.
@@ -1049,9 +1185,21 @@ class DevicePipeline:
         Blocking syncs where a wedged tunnel stalls, so every fetch
         runs under the watchdog.  Returns (DeltaBatch, template
         snapshot, exec-template snapshot)."""
-        (rows_dev, pool_dev, n_used_dev, n_novel_dev), tmpl, ets, \
-            meta = launched
+        (rows_dev, pool_dev, n_used_dev, n_novel_dev, n_suppr_dev), \
+            tmpl, ets, meta = launched
         trace, t_dispatch = meta
+        if n_suppr_dev is not None:
+            # Prescored batch (ISSUE 15): sync the suppression count
+            # under its own span so the speculation stage's cost and
+            # yield are separately attributable.
+            with telemetry.span("sim.prescore"):
+                n_sup = int(self.watchdog.call(
+                    lambda: np.asarray(n_suppr_dev), "device.drain"))
+            sim = self._sim
+            if sim is not None:
+                sim.note_batch(n_sup, self.batch_size)
+            self.stats.sim_batches += 1
+            self.stats.sim_suppressed += n_sup
         if n_novel_dev is not None:
             # Fused drain (ISSUE 10): sync the novel count first —
             # that scalar is the fusion boundary — then fetch only
@@ -1307,6 +1455,10 @@ class DevicePipeline:
             # restarted backend invalidated its buffer too, so it must
             # re-upload from the host mirror on the same re-entry.
             self.triage_engine.invalidate_device_plane()
+        if self._sim is not None:
+            # Same session: the stacked sim tables and speculation
+            # plane re-upload from host state on the next launch.
+            self._sim.invalidate_device_state()
 
     def _worker_loop(self) -> None:
         from collections import deque

@@ -1,0 +1,49 @@
+"""On-device simulated executor (ISSUE 15).
+
+A batched device implementation of the sim-kernel semantics the C++
+executor stub (executor/sim_kernel.h) and its Python twin
+(ipc/sim.SimKernelModel) define — run directly on the packed delta
+rows the mutator emits, BEFORE any byte crosses D2H:
+
+  table.py    — lowers a serialized exec word stream / ExecTemplate
+                into fixed-shape per-call argument tables
+                (build_sim_table), plus the host parity oracle
+                (sim_exec_host) the bit-exactness tests pin.
+  kernel.py   — the vmap / Pallas grid-over-batch device kernel
+                (sim_exec_batch) + the prescore plumbing
+                (decode_rows, apply_deltas, predict_and_mark).
+  prescore.py — per-pipeline speculation state: stacked tables,
+                decaying speculation plane, breaker (SimPrescore).
+  loadgen.py  — the VM-free serving-plane load generator
+                (SimLoadGenerator) built on the same host model.
+
+Wired into the fused drain by ops/pipeline (TZ_SIM_PRESCORE=1) and
+benchable end-to-end via `python -m syzkaller_tpu.bench --sim`.
+"""
+
+from syzkaller_tpu.sim.kernel import (
+    TABLE_FIELDS,
+    resolve_sim_backend,
+    sim_exec_batch,
+)
+from syzkaller_tpu.sim.loadgen import SimLoadGenerator
+from syzkaller_tpu.sim.prescore import SimPrescore, resolve_sim_plane_bits
+from syzkaller_tpu.sim.table import (
+    SimTable,
+    build_sim_table,
+    build_sim_table_from_words,
+    sim_exec_host,
+)
+
+__all__ = [
+    "TABLE_FIELDS",
+    "SimLoadGenerator",
+    "SimPrescore",
+    "SimTable",
+    "build_sim_table",
+    "build_sim_table_from_words",
+    "resolve_sim_backend",
+    "resolve_sim_plane_bits",
+    "sim_exec_batch",
+    "sim_exec_host",
+]

@@ -1,0 +1,388 @@
+"""Batched device sim-exec: executor/sim_kernel.h as a JAX kernel.
+
+One grid cell (Pallas) or vmap lane executes one mutant's lowered
+SimTable program (sim/table.py) end to end on device: resolve every
+call arg (slot gather, proc encode, copyout-chain result refs, the
+executor's pid-stride + big-endian const transform), run the
+simulated kernel's deterministic edge map (splitmix64 hash chain,
+value buckets, magic comparands, handle set, combo edges, two-stage
+crash, lockless race families), and emit the fixed-slot edge/validity
+layout ipc/sim.SimKernelModel defines.  The host model is the parity
+oracle: for identical inputs every output array here must match
+sim_exec_host bit for bit.
+
+Like the mutation core (ops/pallas_mutate), the per-call loop is a
+lax.fori_loop whose carry is the simulated kernel state (handle set,
+copyout window, crash latch), arg handling is vectorized across the
+8-arg window, and the Pallas path reuses _grid_apply so TPU gets a
+grid-over-batch kernel while every other backend runs the bit-exact
+vmap twin (`TZ_SIM_BACKEND` override, auto elsewhere).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from syzkaller_tpu.health.envsafe import env_choice
+from syzkaller_tpu.ipc.sim import (
+    SIM_EDGE_SLOTS,
+    SIM_MAX_ARGS,
+    SIM_SLOT_BUCKET0,
+    SIM_SLOT_COMBO_HANDLES,
+    SIM_SLOT_COMBO_MIXED,
+    SIM_SLOT_CRASH_ARM,
+    SIM_SLOT_ENTRY,
+    SIM_SLOT_HANDLE0,
+    SIM_SLOT_MAGIC0,
+)
+from syzkaller_tpu.sim.table import (
+    MODE_CONST,
+    MODE_PROC,
+    MODE_RESULT,
+    MODE_SLOT,
+    SIM_MAX_COPYOUT,
+    STATUS_CRASHED,
+    STATUS_RAN,
+)
+
+#: Stacked-table array fields, in the argument order the kernel takes.
+TABLE_FIELDS = ("call_id", "nargs", "ret_idx", "amode", "aslot",
+                "aconst", "ameta", "aaux")
+
+
+def resolve_sim_backend(explicit: str | None = None) -> str:
+    """Same discipline as ops/pallas_mutate.resolve_mutate_backend:
+    explicit argument wins, then TZ_SIM_BACKEND=pallas|vmap|auto,
+    then Pallas only on TPU."""
+    if explicit in ("pallas", "vmap"):
+        return explicit
+    choice = env_choice("TZ_SIM_BACKEND", "auto",
+                        ("auto", "pallas", "vmap"))
+    if choice in ("pallas", "vmap"):
+        return choice
+    import jax
+
+    return "pallas" if jax.default_backend() == "tpu" else "vmap"
+
+
+def _u64(v):
+    return np.uint64(v)
+
+
+def _sm64(x):
+    """splitmix64 on uint64 arrays (executor/sim_kernel.h)."""
+    import jax.numpy as jnp
+
+    x = x + _u64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> _u64(30))) * _u64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> _u64(27))) * _u64(0x94D049BB133111EB)
+    return x ^ (x >> _u64(31))
+
+
+def _pc(seed):
+    """emit(): the low 32 bits of splitmix64(seed)."""
+    return (_sm64(seed) & _u64(0xFFFFFFFF)).astype("uint32")
+
+
+def _bswap64(v):
+    import jax.numpy as jnp
+
+    r = jnp.zeros_like(v)
+    for k in range(8):
+        r = r | (((v >> _u64(8 * k)) & _u64(0xFF)) << _u64(8 * (7 - k)))
+    return r
+
+
+def _value_bucket(v):
+    """sim_kernel.h value_bucket as a branch-free binary search:
+    floor(log2(v)) (0 for v in {0,1}) in the high bits, the low
+    nibble verbatim."""
+    import jax.numpy as jnp
+
+    x = v
+    r = jnp.zeros_like(v)
+    for sh in (32, 16, 8, 4, 2, 1):
+        m = x >> _u64(sh)
+        t = m > _u64(0)
+        x = jnp.where(t, m, x)
+        r = r + jnp.where(t, _u64(sh), _u64(0))
+    return (r << _u64(4)) | (v & _u64(0xF))
+
+
+def _transform_const(raw, meta):
+    """executor read_arg const transform minus the pid stride (the
+    kernel runs as pid 0, the prescore contract): big-endian args are
+    bswap64'd then shifted down to their byte size."""
+    import jax.numpy as jnp
+
+    be = ((meta >> _u64(8)) & _u64(1)) != _u64(0)
+    sz = jnp.clip(meta & _u64(0xFF), _u64(1), _u64(8))
+    swapped = _bswap64(raw) >> (_u64(64) - _u64(8) * sz)
+    return jnp.where(be, swapped, raw)
+
+
+def make_sim_exec_one(C: int, S: int, pid: int = 0):
+    """Build the per-mutant sim-exec function.
+
+    one(call_id i32[C], nargs i32[C], ret_idx i32[C],
+        amode i32[C,A], aslot i32[C,A], aconst u64[C,A],
+        ameta u64[C,A], aaux u64[C,A],
+        ncalls i32, alive_bits u64, vals u64[S])
+      -> (edges u32[C,E], valid bool[C,E], ret u64[C],
+          errno i32[C], status i32[C])
+
+    Pure jnp — composable under vmap, _grid_apply and the fused
+    pipeline step."""
+    import jax
+    import jax.numpy as jnp
+
+    A = SIM_MAX_ARGS
+    E = SIM_EDGE_SLOTS
+    CO = SIM_MAX_COPYOUT
+    pid_u = _u64(pid)
+
+    def one(call_id, nargs, ret_idx, amode, aslot, aconst, ameta,
+            aaux, ncalls, alive_bits, vals):
+        edges0 = jnp.zeros((C, E), dtype=jnp.uint32)
+        valid0 = jnp.zeros((C, E), dtype=bool)
+        ret0 = jnp.zeros(C, dtype=jnp.uint64)
+        errno0 = jnp.zeros(C, dtype=jnp.int32)
+        status0 = jnp.zeros(C, dtype=jnp.int32)
+        handles0 = jnp.zeros(C, dtype=jnp.uint64)
+        covals0 = jnp.zeros(CO, dtype=jnp.uint64)
+        codone0 = jnp.zeros(CO, dtype=bool)
+
+        def body(c, carry):
+            (edges, valid, ret, errno, status, handles, nh, covals,
+             codone, crashed) = carry
+            run = (c < ncalls) \
+                & (((alive_bits >> c.astype(jnp.uint64)) & _u64(1))
+                   != _u64(0)) \
+                & ~crashed
+            na = nargs[c]
+            h = _sm64(call_id[c].astype(jnp.uint64)
+                      * _u64(0x10001) + _u64(1))
+
+            # ---- resolve the 8-arg window (vectorized over A) ----
+            mode = amode[c]
+            slot = aslot[c]
+            cst = aconst[c]
+            meta = ameta[c]
+            aux = aaux[c]
+            sv = vals[jnp.clip(slot, 0, S - 1)]
+            is_def = sv == _u64(0xFFFFFFFFFFFFFFFF)
+            raw = jnp.where(
+                mode == MODE_SLOT, sv,
+                jnp.where(mode == MODE_PROC,
+                          jnp.where(is_def, _u64(0), cst + sv),
+                          cst))
+            m = jnp.where((mode == MODE_PROC) & is_def, aux, meta)
+            # pid stride (meta>>32 per pid) — static pid, u64 wrap.
+            strided = raw + (m >> _u64(32)) * pid_u
+            direct = _transform_const(strided, m)
+            # MODE_RESULT: covals chain, untransformed.
+            ridx = jnp.clip(slot, 0, CO - 1)
+            rdone = (slot >= 0) & codone[ridx]
+            rv = jnp.where(rdone, covals[ridx], cst)
+            div = meta
+            rv = jnp.where(div != _u64(0),
+                           rv // jnp.maximum(div, _u64(1)), rv)
+            rv = rv + aux
+            arg = jnp.where(mode == MODE_RESULT, rv,
+                            jnp.where(mode == MODE_CONST,
+                                      direct,
+                                      jnp.where((mode == MODE_SLOT)
+                                                | (mode == MODE_PROC),
+                                                direct, _u64(0))))
+            argmask = jnp.arange(A) < na
+
+            # ---- the simulated kernel's edge map ----
+            iu = jnp.arange(A, dtype=jnp.uint64)
+            entry_pc = _pc(h)
+            bucket_pc = _pc(h ^ _sm64((iu << _u64(32))
+                                      | _value_bucket(arg)))
+            magic = _sm64(h + _u64(0x1111) * (iu + _u64(1))) \
+                & _u64(0xFFFFFFFF)
+            magic_hit = (arg == magic) & argmask
+            magic_pc0 = _pc(h ^ _sm64(_u64(0xABCD0000) + iu))
+            magic_pc1 = _pc(h ^ _sm64(_u64(0xABCD1000) + iu
+                                      + (magic & _u64(0xFF))))
+            handle_pc = _pc(h ^ _sm64(_u64(0xFEED0000) + iu))
+            # Membership is checked BEFORE this call's own insert
+            # (sim_kernel.h: handle test precedes the ctor).
+            known = (jnp.arange(C) < nh)[None, :]
+            handle_hit = ((arg[:, None] == handles[None, :]) & known) \
+                .any(axis=1) & argmask
+            magic_hits = magic_hit.sum()
+            handle_hits = handle_hit.sum()
+
+            rtag = h & _u64(31)
+            lockless = (rtag == _u64(5)) | (rtag == _u64(9))
+            crashy = ((h & _u64(7)) == _u64(3)) & (na >= 2) & ~lockless
+            c0 = _sm64(h ^ _u64(0xC0DE0000)) & _u64(0xFFFFFFFF)
+            c1 = _sm64(h ^ _u64(0xC0DE0001)) & _u64(0xFFFFFFFF)
+            armed = crashy & (arg[0] == c0)
+            full_crash = armed & (arg[1] == c1)
+
+            pcs = jnp.zeros(E, dtype=jnp.uint32)
+            ok = jnp.zeros(E, dtype=bool)
+            pcs = pcs.at[SIM_SLOT_ENTRY].set(entry_pc)
+            ok = ok.at[SIM_SLOT_ENTRY].set(True)
+            sl = jnp.arange(A)
+            pcs = pcs.at[SIM_SLOT_BUCKET0 + sl].set(bucket_pc)
+            ok = ok.at[SIM_SLOT_BUCKET0 + sl].set(argmask & ~lockless)
+            pair = jnp.stack([magic_pc0, magic_pc1], axis=1).reshape(-1)
+            pcs = pcs.at[SIM_SLOT_MAGIC0 + jnp.arange(2 * A)].set(pair)
+            mok = jnp.stack([magic_hit, magic_hit], axis=1).reshape(-1)
+            ok = ok.at[SIM_SLOT_MAGIC0 + jnp.arange(2 * A)] \
+                .set(mok & ~lockless)
+            pcs = pcs.at[SIM_SLOT_HANDLE0 + sl].set(handle_pc)
+            ok = ok.at[SIM_SLOT_HANDLE0 + sl] \
+                .set(handle_hit & ~lockless)
+            pcs = pcs.at[SIM_SLOT_COMBO_HANDLES].set(_pc(h ^ _u64(0x10)))
+            ok = ok.at[SIM_SLOT_COMBO_HANDLES] \
+                .set((handle_hits >= 2) & ~lockless)
+            pcs = pcs.at[SIM_SLOT_COMBO_MIXED].set(_pc(h ^ _u64(0x11)))
+            ok = ok.at[SIM_SLOT_COMBO_MIXED] \
+                .set((handle_hits >= 1) & (magic_hits >= 1) & ~lockless)
+            pcs = pcs.at[SIM_SLOT_CRASH_ARM].set(_pc(h ^ _u64(0xDEAD0)))
+            ok = ok.at[SIM_SLOT_CRASH_ARM].set(armed)
+            # A full crash _exits before copyout: nothing survives.
+            ok = ok & run & ~full_crash
+
+            # ---- ctor / errno / copyout state ----
+            is_ctor = ((h & _u64(3)) == _u64(1)) & ~lockless \
+                & ~full_crash
+            new_handle = _u64(0x1000) \
+                + (nh.astype(jnp.uint64) * _u64(4) + pid_u) \
+                % _u64(0xFFFFF)
+            hidx = jnp.where(run & is_ctor, nh, C)
+            handles = handles.at[hidx].set(new_handle, mode="drop")
+            nh = nh + (run & is_ctor).astype(jnp.int32)
+            wants = ((h & _u64(3)) == _u64(2)) & (na > 0) & ~lockless
+            errno_c = jnp.where(wants & (handle_hits == 0) & ~is_ctor
+                                & ~full_crash,
+                                jnp.int32(9), jnp.int32(0))
+            ret_c = jnp.where(is_ctor, new_handle, _u64(0))
+            status_c = jnp.where(
+                full_crash, jnp.int32(STATUS_CRASHED),
+                jnp.int32(STATUS_RAN))
+
+            do_co = run & ~full_crash & (ret_idx[c] >= 0) \
+                & (errno_c == 0)
+            cidx = jnp.where(do_co, ret_idx[c], CO)
+            covals = covals.at[cidx].set(ret_c, mode="drop")
+            codone = codone.at[cidx].set(True, mode="drop")
+
+            edges = edges.at[c].set(jnp.where(run, pcs, 0))
+            valid = valid.at[c].set(ok)
+            ret = ret.at[c].set(jnp.where(run & ~full_crash,
+                                          ret_c, _u64(0)))
+            errno = errno.at[c].set(jnp.where(run & ~full_crash,
+                                              errno_c, 0))
+            status = status.at[c].set(
+                jnp.where(run, status_c, jnp.int32(0)))
+            crashed = crashed | (run & full_crash)
+            return (edges, valid, ret, errno, status, handles, nh,
+                    covals, codone, crashed)
+
+        out = jax.lax.fori_loop(
+            0, C, body,
+            (edges0, valid0, ret0, errno0, status0, handles0,
+             jnp.int32(0), covals0, codone0, jnp.bool_(False)))
+        return out[0], out[1], out[2], out[3], out[4]
+
+    return one
+
+
+def sim_exec_batch(table_rows: dict, ncalls, alive_bits, vals,
+                   backend: str, interpret: bool = True,
+                   pid: int = 0):
+    """Run the sim-exec kernel over a batch.
+
+    table_rows: dict of TABLE_FIELDS arrays, each (B, C[, A]) — the
+    stacked tables already gathered by template index.  ncalls (B,)
+    i32, alive_bits (B,) u64, vals (B, S) u64.  backend "pallas"
+    routes through ops/pallas_mutate._grid_apply (grid-over-batch),
+    anything else through vmap.  Traceable: call inside a jit."""
+    import jax
+    import jax.numpy as jnp
+
+    C = table_rows["call_id"].shape[1]
+    S = vals.shape[1]
+    one = make_sim_exec_one(C, S, pid=pid)
+    row_arrays = [table_rows[k] for k in TABLE_FIELDS] \
+        + [jnp.asarray(ncalls, dtype=jnp.int32),
+           jnp.asarray(alive_bits, dtype=jnp.uint64), vals]
+    if backend == "pallas":
+        from syzkaller_tpu.ops.pallas_mutate import _grid_apply
+
+        E = SIM_EDGE_SLOTS
+        return tuple(_grid_apply(
+            one, row_arrays, [],
+            out_shapes=[(C, E), (C, E), (C,), (C,), (C,)],
+            out_dtypes=[jnp.uint32, jnp.bool_, jnp.uint64,
+                        jnp.int32, jnp.int32],
+            interpret=interpret))
+    return jax.vmap(one)(*row_arrays)
+
+
+def decode_rows(rows, K: int):
+    """Pull the sim-relevant fields out of packed delta rows
+    (ops/delta row layout): op u8 (B,), template_idx i32 (B,),
+    alive_bits u64 (B,), val_idx i32 (B,K), vals u64 (B,K).
+    Traceable; bitcasts match the packer's on-device row writes."""
+    import jax
+    import jax.numpy as jnp
+
+    op = rows[:, 3]
+    tidx = jax.lax.bitcast_convert_type(rows[:, 4:8], jnp.int32)
+    alive = jax.lax.bitcast_convert_type(rows[:, 8:16], jnp.uint64)
+    B = rows.shape[0]
+    o = 28  # delta.HDR_BYTES == o_val_idx
+    vi16 = jax.lax.bitcast_convert_type(
+        rows[:, o:o + 2 * K].reshape(B, K, 2), jnp.int16)
+    val_idx = vi16.astype(jnp.int32)
+    vals = jax.lax.bitcast_convert_type(
+        rows[:, o + 2 * K:o + 10 * K].reshape(B, K, 8), jnp.uint64)
+    return op, tidx, alive, val_idx, vals
+
+
+def apply_deltas(corpus_val, tidx, val_idx, vals_j):
+    """Materialize each mutant's full slot vector: gather the base
+    template's slots, scatter the K changed (slot, value) pairs
+    (negative slots dropped).  Returns (B, S) u64."""
+    import jax.numpy as jnp
+
+    cap = corpus_val.shape[0]
+    S = corpus_val.shape[1]
+    B = tidx.shape[0]
+    ti = jnp.clip(tidx, 0, cap - 1)
+    base = corpus_val[ti]
+    sidx = jnp.where(val_idx >= 0, val_idx, S)
+    return base.at[jnp.arange(B)[:, None], sidx] \
+        .set(vals_j, mode="drop")
+
+
+def fold_edge_idx(edges, bits: int):
+    """Edge PC -> speculation-plane index, the same xor-fold as
+    ops/signal.fold_mutant_idx so plane statistics are comparable."""
+    mask = np.uint32((1 << bits) - 1)
+    return ((edges ^ (edges >> np.uint32(bits))) & mask) \
+        .astype("int32")
+
+
+def predict_and_mark(edges, valid, plane, bits: int):
+    """The prescore: a mutant is predicted-novel iff ANY of its valid
+    sim edges folds to an unmarked plane cell.  Marks every valid
+    edge (predicted-novel or not) so repeats are suppressed next
+    batch.  Returns (pred bool (B,), plane')."""
+    import jax.numpy as jnp
+
+    size = 1 << bits
+    idx = fold_edge_idx(edges, bits)
+    fresh = (plane[idx] == 0) & valid
+    pred = fresh.reshape(fresh.shape[0], -1).any(axis=1)
+    mark = jnp.where(valid, idx, size)
+    plane = plane.at[mark.reshape(-1)].set(jnp.uint8(1), mode="drop")
+    return pred, plane

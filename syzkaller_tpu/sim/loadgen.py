@@ -1,0 +1,213 @@
+"""VM-free load generator for serving/control-plane stress tests.
+
+The serving plane's tests (and any control-plane soak) need a drain
+that produces a REALISTIC multi-tenant verdict mix — novel rows,
+stale repeats, crashy programs, EBADF returns — without spawning a
+single executor subprocess or touching a device.  This module drives
+the sim-kernel host model (ipc/sim.SimKernelModel, the same semantics
+the device prescore kernel mirrors bit-exactly) over deterministically
+generated programs and emits composer-compatible batches:
+
+    gen = SimLoadGenerator(spec, seed=7)
+    composer = BatchComposer(broker, planes, drain_fn=gen.drain, ...)
+
+`drain(n)` returns `(rows, payloads)` in exactly the shape
+serve/composer.BatchComposer expects from the device drain: `rows`
+uint8[n, spec.row_bytes] packed delta rows (the novelty-verdict
+input — a repeated program re-emits byte-identical rows, so the
+tenant planes see genuine staleness, not synthetic flags), `payloads`
+a same-length list of bytes (the program's (call_id, args) words).
+
+Everything is derived from splitmix64 chains on (seed, program
+index): no RNG module, no wall clock, no global state — two
+generators with the same seed produce the same byte stream, which is
+what the serving tests pin.  docs/perf.md "The speculation path"
+covers where this slots into the stress story.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from syzkaller_tpu.ipc.sim import (
+    MASK64,
+    SimKernelModel,
+    arg_magic,
+    call_hash,
+    crash_magics,
+    is_crashy,
+    is_lockless,
+    splitmix64,
+)
+from syzkaller_tpu.ops.delta import OP_MUTATE, DeltaSpec
+
+#: How many distinct (call_id) values the generator draws from.  Small
+#: on purpose: entry edges repeat across programs, so the verdict mix
+#: has genuine overlap instead of every row being trivially novel.
+CALL_ID_SPACE = 24
+
+#: Probability denominators (1-in-N per splitmix64 draw).
+_P_MAGIC = 4       # arg hits its magic comparand
+_P_CRASH_ARM = 6   # crashy call gets its first crash comparand
+_P_CRASH_FULL = 3  # ... and the second (given armed)
+_P_HANDLE = 3      # arg reuses a live handle
+
+
+class SimLoadGenerator:
+    """Deterministic composer-compatible drain over the sim kernel."""
+
+    def __init__(self, spec: DeltaSpec | None = None, seed: int = 1,
+                 max_calls: int = 4, repeat_every: int = 4,
+                 pid: int = 0):
+        self.spec = spec if spec is not None else DeltaSpec()
+        self.seed = int(seed) & MASK64
+        self.max_calls = max(1, max_calls)
+        #: Every `repeat_every`-th row re-emits a recently generated
+        #: program byte-for-byte (0 disables repeats entirely).
+        self.repeat_every = max(0, repeat_every)
+        self.pid = pid
+        self._i = 0  # program counter across drain() calls
+        self._recent: list[tuple[np.ndarray, bytes]] = []
+        self.stats = {
+            "programs": 0, "calls": 0, "repeats": 0, "crashes": 0,
+            "ebadf": 0, "magic_hits": 0, "handle_hits": 0,
+            "lockless_calls": 0,
+        }
+
+    # -- deterministic draws ----------------------------------------------
+
+    def _chain(self, i: int):
+        """A per-program splitmix64 draw stream: same (seed, i) ->
+        same program, independent of drain() batching."""
+        x = splitmix64(self.seed ^ ((i * 0x9E3779B97F4A7C15) & MASK64))
+
+        def nxt() -> int:
+            nonlocal x
+            x = splitmix64(x)
+            return x
+        return nxt
+
+    # -- program generation ------------------------------------------------
+
+    def _program(self, i: int) -> list[tuple[int, list[int]]]:
+        """Program i: a short call sequence with probability-weighted
+        magic / crash-comparand / handle-reuse hits, so executing it
+        through the sim kernel yields the full verdict zoo."""
+        nxt = self._chain(i)
+        ncalls = 1 + nxt() % self.max_calls
+        handles: list[int] = []
+        prog: list[tuple[int, list[int]]] = []
+        # A shadow of the model's ctor rule, just to know which handle
+        # values exist for reuse draws (exactness does not matter — a
+        # stale guess simply misses, like a real fuzzer's would).
+        n_handles = 0
+        for _c in range(ncalls):
+            call_id = nxt() % CALL_ID_SPACE
+            h = call_hash(call_id)
+            nargs = nxt() % 5
+            args: list[int] = []
+            for j in range(nargs):
+                if nxt() % _P_MAGIC == 0:
+                    args.append(arg_magic(call_id, j))
+                elif handles and nxt() % _P_HANDLE == 0:
+                    args.append(handles[nxt() % len(handles)])
+                else:
+                    args.append(nxt() % 0x10000)
+            if is_crashy(call_id) and nargs >= 2 \
+                    and nxt() % _P_CRASH_ARM == 0:
+                c0, c1 = crash_magics(call_id)
+                args[0] = c0
+                if nxt() % _P_CRASH_FULL == 0:
+                    args[1] = c1
+                    prog.append((call_id, args))
+                    break  # a full crash ends the program
+            prog.append((call_id, args))
+            if (h & 3) == 1 and not is_lockless(call_id):
+                handles.append(
+                    0x1000 + ((n_handles * 4 + self.pid) % 0xFFFFF))
+                n_handles += 1
+        return prog
+
+    def _emit(self, i: int) -> tuple[np.ndarray, bytes]:
+        """Execute program i through the host sim kernel (for the
+        verdict-mix stats) and pack one delta row + payload."""
+        prog = self._program(i)
+        model = SimKernelModel(pid=self.pid)
+        st = self.stats
+        st["programs"] += 1
+        for call_id, args in prog:
+            st["calls"] += 1
+            if is_lockless(call_id):
+                st["lockless_calls"] += 1
+            res = model.exec(call_id, args)
+            if res.crashed:
+                st["crashes"] += 1
+                break
+            if res.errno == 9:
+                st["ebadf"] += 1
+            st["magic_hits"] += sum(
+                1 for j, a in enumerate(args)
+                if a == arg_magic(call_id, j))
+        st["handle_hits"] += len(model.handles)
+        # The payload is the program's words; the row embeds a digest
+        # of those words in its value slots, so byte-identical rows
+        # <=> identical programs (the tenant-plane novelty input).
+        words: list[int] = []
+        for call_id, args in prog:
+            words.append((len(args) << 32) | call_id)
+            words.extend(a & MASK64 for a in args)
+        payload = np.asarray(words, np.uint64).tobytes()
+        row = np.zeros(self.spec.row_bytes, np.uint8)
+        row[3] = OP_MUTATE
+        row[4:8] = np.frombuffer(
+            np.int32(i & 0x3FF).tobytes(), np.uint8)
+        row[8:16] = 0xFF  # alive_bits: all calls live
+        digest = np.zeros(self.spec.K, np.uint64)
+        acc = splitmix64(self.seed ^ i)
+        for w in words[:self.spec.K]:
+            acc = splitmix64(acc ^ w)
+        for k in range(self.spec.K):
+            acc = splitmix64(acc)
+            digest[k] = acc
+        o_vals = self.spec.o_vals
+        row[o_vals:o_vals + 8 * self.spec.K] = np.frombuffer(
+            digest.tobytes(), np.uint8)
+        return row, payload
+
+    # -- the composer-facing drain -----------------------------------------
+
+    def drain(self, n: int) -> tuple[np.ndarray, list[bytes]]:
+        """Produce n composer rows: mostly fresh programs, with every
+        `repeat_every`-th row a byte-identical replay of a recent one
+        (a genuinely stale row for the tenant planes)."""
+        rows = np.zeros((n, self.spec.row_bytes), np.uint8)
+        payloads: list[bytes] = []
+        for j in range(n):
+            self._i += 1
+            if (self.repeat_every and self._recent
+                    and self._i % self.repeat_every == 0):
+                k = splitmix64(self.seed ^ self._i) % len(self._recent)
+                row, payload = self._recent[k]
+                self.stats["repeats"] += 1
+            else:
+                row, payload = self._emit(self._i)
+                self._recent.append((row, payload))
+                if len(self._recent) > 64:
+                    self._recent.pop(0)
+            rows[j] = row
+            payloads.append(payload)
+        return rows, payloads
+
+    def verdict_mix(self) -> dict:
+        """Fractions for tests/docs: what the generated load looked
+        like (crash / EBADF / lockless / repeat rates)."""
+        st = self.stats
+        progs = max(1, st["programs"])
+        calls = max(1, st["calls"])
+        emitted = max(1, st["programs"] + st["repeats"])
+        return {
+            "crash_frac": st["crashes"] / progs,
+            "ebadf_frac": st["ebadf"] / calls,
+            "lockless_frac": st["lockless_calls"] / calls,
+            "repeat_frac": st["repeats"] / emitted,
+        }

@@ -1,0 +1,230 @@
+"""Speculative prescore state: tables, plane, breaker, accounting.
+
+The fused drain's optional sim-exec stage (TZ_SIM_PRESCORE=1) needs
+per-pipeline state that outlives any single batch:
+
+  - the STACKED sim tables: every live exec template lowered
+    (sim/table.py) into capacity-sized arrays the kernel gathers by
+    template index.  Rebuilt incrementally — only slots whose
+    template object changed re-lower — and re-uploaded whole when
+    anything did (the upload is small next to one batch).
+  - the SPECULATION PLANE: a 2^TZ_SIM_PLANE_BITS byte device bitmap
+    of predicted-edge folds.  Decayed by FULL RESET every
+    TZ_SIM_EPOCH_BATCHES batches: a mutant suppressed because its
+    edges looked stale becomes admissible again next epoch, so the
+    filter can delay true discovery by at most one epoch, never
+    starve it (the re-admission guarantee the acceptance criteria
+    pin).
+  - its own CircuitBreaker: prescore failures demote to PASS-THROUGH
+    (the plain fused step still ships every plane-novel mutant — zero
+    lost mutants), symmetric with PipelineMutator's health latch.
+    Probes re-enter via the shared TZ_BREAKER_* pacing knobs.
+
+docs/perf.md "The speculation path" covers when the filter pays off;
+docs/observability.md catalogues the tz_sim_* metrics and the
+sim.demote / sim.repromote / sim.readmit / sim.suppress timeline
+events emitted here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health import (
+    CircuitBreaker,
+    env_float,
+    env_int,
+)
+from syzkaller_tpu.ipc.sim import SIM_MAX_ARGS
+from syzkaller_tpu.sim.kernel import TABLE_FIELDS, resolve_sim_backend
+from syzkaller_tpu.sim.table import build_sim_table
+from syzkaller_tpu.utils import log
+
+_M_SIM_BATCHES = telemetry.counter(
+    "tz_sim_prescore_batches_total",
+    "batches drained through the sim-exec prescore stage")
+_M_SIM_SUPPRESSED = telemetry.counter(
+    "tz_sim_suppressed_rows_total",
+    "plane-novel rows the prescore predicted stale and held back")
+_M_SIM_READMITS = telemetry.counter(
+    "tz_sim_readmit_epochs_total",
+    "speculation-plane decay epochs (suppressed rows re-admissible)")
+_M_SIM_DEMOTIONS = telemetry.counter(
+    "tz_sim_demotions_total",
+    "prescore demotions to the pass-through drain")
+_M_SIM_REPROMOTIONS = telemetry.counter(
+    "tz_sim_repromotions_total",
+    "prescore re-promotions after a successful probe")
+_M_SIM_BACKEND = telemetry.gauge(
+    "tz_sim_backend", "sim-exec backend in use (0 = vmap, 1 = pallas)")
+_M_SIM_SUPPRESSION = telemetry.gauge(
+    "tz_sim_suppression_rate",
+    "suppressed fraction of the most recent prescored batch")
+
+
+def resolve_sim_plane_bits() -> int:
+    """TZ_SIM_PLANE_BITS with the same clamp discipline as the mutant
+    plane (ops/signal.resolve_mutant_plane_bits): 2^20 = 1 MB default,
+    bounded to [10, 28] so a typo cannot allocate a 4 GB plane."""
+    bits = env_int("TZ_SIM_PLANE_BITS", 20)
+    return min(28, max(10, bits))
+
+
+class SimPrescore:
+    """Per-pipeline prescore state (single worker-thread writer, same
+    threading contract as the pipeline's own device attributes)."""
+
+    def __init__(self, capacity: int, max_calls: int = 32,
+                 backend: str | None = None, seed: int = 0):
+        self.capacity = capacity
+        self.max_calls = max_calls
+        self.backend = resolve_sim_backend(backend)
+        _M_SIM_BACKEND.set(1 if self.backend == "pallas" else 0)
+        self.plane_bits = resolve_sim_plane_bits()
+        self.epoch_batches = max(0, env_int("TZ_SIM_EPOCH_BATCHES", 64))
+        self.breaker = CircuitBreaker(
+            failure_threshold=max(1, env_int("TZ_BREAKER_THRESHOLD", 4)),
+            backoff_initial=env_float("TZ_BREAKER_BACKOFF_S", 1.0),
+            backoff_cap=env_float("TZ_BREAKER_BACKOFF_CAP_S", 60.0),
+            seed=seed)
+        C, A = max_calls, SIM_MAX_ARGS
+        self._host = {
+            "call_id": np.zeros((capacity, C), np.int32),
+            "nargs": np.zeros((capacity, C), np.int32),
+            "ret_idx": np.full((capacity, C), -1, np.int32),
+            "amode": np.zeros((capacity, C, A), np.int32),
+            "aslot": np.full((capacity, C, A), -1, np.int32),
+            "aconst": np.zeros((capacity, C, A), np.uint64),
+            "ameta": np.zeros((capacity, C, A), np.uint64),
+            "aaux": np.zeros((capacity, C, A), np.uint64),
+        }
+        self._host_ncalls = np.zeros(capacity, np.int32)
+        self._et_ids: list = [None] * capacity
+        self._tables_dev = None
+        self._plane = None
+        # Accounting (drained into proc stats / bench via snapshot()).
+        self.batches = 0
+        self.suppressed = 0
+        self.epochs = 0
+        self.demotions = 0
+        self.repromotions = 0
+        self._demoted = False
+        self._epoch_evented = False
+
+    # -- device state ------------------------------------------------------
+
+    def device_tables(self, ets) -> dict:
+        """The stacked device tables for this exec-template snapshot.
+        Incremental: only changed slots re-lower; any change (or an
+        invalidated device copy) re-uploads the stack."""
+        import jax.numpy as jnp
+
+        dirty = False
+        for i, et in enumerate(ets[:self.capacity]):
+            key = None if et is None else id(et)
+            if self._et_ids[i] == key:
+                continue  # unchanged slot (identity, _template_table)
+            if et is None:
+                self._et_ids[i] = None
+                self._host_ncalls[i] = 0
+                dirty = True
+                continue
+            t = build_sim_table(et, self.max_calls)
+            for k in TABLE_FIELDS:
+                self._host[k][i] = getattr(t, k)
+            self._host_ncalls[i] = t.ncalls
+            self._et_ids[i] = id(et)
+            dirty = True
+        if dirty or self._tables_dev is None:
+            dev = {k: jnp.asarray(v) for k, v in self._host.items()}
+            dev["ncalls"] = jnp.asarray(self._host_ncalls)
+            self._tables_dev = dev
+        return self._tables_dev
+
+    def ensure_plane(self):
+        """The device speculation plane, zero-built lazily (and after
+        each decay epoch / device-state invalidation)."""
+        if self._plane is None:
+            import jax.numpy as jnp
+
+            self._plane = jnp.zeros(1 << self.plane_bits, jnp.uint8)
+        return self._plane
+
+    def invalidate_device_state(self) -> None:
+        """Breaker re-entry / backend restart: device buffers are
+        gone; host tables persist and re-upload on the next launch."""
+        self._tables_dev = None
+        self._et_ids = [None] * self.capacity
+        self._plane = None
+
+    # -- per-batch bookkeeping ---------------------------------------------
+
+    def commit(self, plane) -> None:
+        """A prescored batch dispatched: store the updated plane,
+        advance the epoch clock (decay = full plane reset, making
+        every previously-suppressed fold admissible again), and let
+        the breaker see the success."""
+        self._plane = plane
+        self.batches += 1
+        if self.epoch_batches and self.batches % self.epoch_batches == 0:
+            self._plane = None
+            self.epochs += 1
+            self._epoch_evented = False
+            _M_SIM_READMITS.inc()
+            telemetry.record_event(
+                "sim.readmit",
+                f"speculation plane decayed (epoch {self.epochs})")
+        self.breaker.record_success()
+        if self._demoted:
+            self._demoted = False
+            self.repromotions += 1
+            _M_SIM_REPROMOTIONS.inc()
+            telemetry.record_event("sim.repromote",
+                                   "prescore answering again")
+            log.logf(0, "sim prescore re-promoted (device answering)")
+
+    def note_batch(self, n_suppressed: int, batch_size: int) -> None:
+        """Drain-side accounting for one prescored batch (called with
+        the synced suppression count)."""
+        self.suppressed += n_suppressed
+        _M_SIM_BATCHES.inc()
+        _M_SIM_SUPPRESSED.inc(n_suppressed)
+        _M_SIM_SUPPRESSION.set(n_suppressed / max(1, batch_size))
+        if n_suppressed and not self._epoch_evented:
+            # One timeline entry per epoch, not per batch — the
+            # timeline is for transitions, the counters carry volume.
+            self._epoch_evented = True
+            telemetry.record_event(
+                "sim.suppress",
+                f"{n_suppressed} rows held back this batch")
+
+    def note_failure(self, exc: BaseException) -> None:
+        """A prescore failure (fault seam, table lowering, dispatch):
+        breaker bookkeeping + demotion to pass-through.  The caller
+        falls back to the plain fused step, so no mutant is lost."""
+        self.breaker.record_failure()
+        if not self._demoted:
+            self._demoted = True
+            self.demotions += 1
+            _M_SIM_DEMOTIONS.inc()
+            telemetry.record_event("sim.demote", str(exc)[:120])
+            log.logf(0, "sim prescore DEMOTED to pass-through: %s",
+                     str(exc)[:200])
+
+    def demoted(self) -> bool:
+        return self._demoted
+
+    def snapshot(self) -> dict:
+        return {
+            "backend": self.backend,
+            "plane_bits": self.plane_bits,
+            "epoch_batches": self.epoch_batches,
+            "demoted": self._demoted,
+            "batches": self.batches,
+            "suppressed": self.suppressed,
+            "epochs": self.epochs,
+            "demotions": self.demotions,
+            "repromotions": self.repromotions,
+            "breaker": self.breaker.snapshot(),
+        }

@@ -1,0 +1,314 @@
+"""Sim-exec tables: exec wire streams lowered to fixed-shape arrays.
+
+The on-device simulated executor cannot walk the variable-length exec
+word stream (data regions, csum chunks and per-call arg counts make
+the layout data-dependent), so this module walks it ONCE per template
+on the host and lowers every call-position argument to a fixed
+(mode, slot, const, meta, aux) quintuple:
+
+  MODE_ZERO    data/csum at a call position — the executor's read_arg
+               yields 0 for these
+  MODE_CONST   static const (incl. pointer args and result args with
+               no referenced result): value/meta straight from the
+               template words, subject to the executor's pid-stride +
+               big-endian transform
+  MODE_SLOT    a device-mutable value slot (INT/FLAGS/LEN): the value
+               comes from the mutant's slot vector, the meta word is
+               the template's static meta
+  MODE_RESULT  a resolved result reference: covals[idx] if the
+               producing call copied out, else the type default, then
+               op_div / op_add
+  MODE_PROC    a device-mutable PROC slot: the 0xFF..F default
+               serializes as 0 with the default meta, concrete values
+               as aux0+v with the concrete meta (ops/emit.assemble)
+
+The same walk with no template attached (build_sim_table_from_words)
+lowers ANY assembled exec stream — every arg becomes static — which
+is how parity tests check an assembled mutant byte stream against the
+device kernel, and how the VM-free load generator scores programs.
+
+sim_exec_host() is the bit-exactness oracle: it runs a lowered table
+through ipc/sim.SimKernelModel with the executor's sequencing rules
+(skip dead calls, stop at a full crash, persist a ret-backed copyout
+only when errno == 0) and the SAME bounded copyout window the device
+kernel uses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from syzkaller_tpu.ipc.sim import (
+    SIM_EDGE_SLOTS,
+    SIM_MAX_ARGS,
+    SimKernelModel,
+)
+from syzkaller_tpu.models.encodingexec import (
+    EXEC_ARG_CONST,
+    EXEC_ARG_CSUM,
+    EXEC_ARG_DATA,
+    EXEC_ARG_RESULT,
+    EXEC_INSTR_COPYIN,
+    EXEC_INSTR_COPYOUT,
+    EXEC_INSTR_EOF,
+    EXEC_NO_COPYOUT,
+)
+
+MASK64 = (1 << 64) - 1
+
+MODE_ZERO = 0
+MODE_CONST = 1
+MODE_SLOT = 2
+MODE_RESULT = 3
+MODE_PROC = 4
+
+#: Device copyout window.  The executor's table is MAX_COPYOUT=256,
+#: but ret-backed indices (the only ones the sim models — memory-
+#: backed copyouts read guest memory the sim does not have) are
+#: assigned first-come per call, so a small dense window covers real
+#: templates.  An index outside the window resolves as never-done
+#: (type default) on BOTH the device kernel and the host oracle, so
+#: parity holds by construction.
+SIM_MAX_COPYOUT = 64
+
+#: Default call capacity for standalone tables (alive_bits is u64, so
+#: 64 is the hard ceiling; the prescore stacker sizes its own).
+SIM_MAX_CALLS = 32
+
+#: Sim-call run status (the device kernel's status output).
+STATUS_SKIPPED = 0
+STATUS_RAN = 1
+STATUS_CRASHED = 2
+
+
+@dataclass
+class SimTable:
+    """One template's lowered sim-exec program (host arrays)."""
+
+    ncalls: int
+    call_id: np.ndarray  # int32[C]
+    nargs: np.ndarray  # int32[C]
+    ret_idx: np.ndarray  # int32[C], -1 = no modelled copyout
+    amode: np.ndarray  # int32[C, A]
+    aslot: np.ndarray  # int32[C, A]  slot / copyout idx, mode-dependent
+    aconst: np.ndarray  # uint64[C, A]  const val / proc aux0 / default
+    ameta: np.ndarray  # uint64[C, A]  meta word / op_div / concrete meta
+    aaux: np.ndarray  # uint64[C, A]  op_add / default proc meta
+
+
+def _skip_arg(words: np.ndarray, p: int) -> int:
+    """Advance p past one serialized arg (models/encodingexec layout)."""
+    kind = int(words[p])
+    if kind == EXEC_ARG_CONST:
+        return p + 3
+    if kind == EXEC_ARG_RESULT:
+        return p + 6
+    if kind == EXEC_ARG_DATA:
+        lenword = int(words[p + 1])
+        region = max(lenword & 0xFFFFFFFF, lenword >> 32)
+        padded = region + (-region) % 8
+        return p + 2 + padded // 8
+    if kind == EXEC_ARG_CSUM:
+        nchunks = int(words[p + 3])
+        return p + 4 + 3 * nchunks
+    raise ValueError(f"unknown exec arg kind {kind} at word {p}")
+
+
+def _walk_calls(words: np.ndarray):
+    """Yield (call_word_pos,) for every call instruction, skipping
+    copyin/csum/copyout instructions — the same dispatch the executor's
+    run loop performs."""
+    p = 0
+    while True:
+        w = int(words[p])
+        if w == EXEC_INSTR_EOF:
+            return
+        if w == EXEC_INSTR_COPYIN:
+            p = _skip_arg(words, p + 2)
+        elif w == EXEC_INSTR_COPYOUT:
+            p += 4
+        else:
+            yield p
+            p += 2  # call word + copyout word
+            nargs = int(words[p])
+            p += 1
+            for _ in range(nargs):
+                p = _skip_arg(words, p)
+
+
+def _lower(words: np.ndarray, word2slot: dict, et,
+           max_calls: int) -> SimTable:
+    call_id = np.zeros(max_calls, dtype=np.int32)
+    nargs_a = np.zeros(max_calls, dtype=np.int32)
+    ret_idx = np.full(max_calls, -1, dtype=np.int32)
+    amode = np.zeros((max_calls, SIM_MAX_ARGS), dtype=np.int32)
+    aslot = np.full((max_calls, SIM_MAX_ARGS), -1, dtype=np.int32)
+    aconst = np.zeros((max_calls, SIM_MAX_ARGS), dtype=np.uint64)
+    ameta = np.zeros((max_calls, SIM_MAX_ARGS), dtype=np.uint64)
+    aaux = np.zeros((max_calls, SIM_MAX_ARGS), dtype=np.uint64)
+
+    # Pass 1: the set of ret-backed copyout indices.  Memory-backed
+    # indices (COPYOUT instructions) are deliberately absent — the sim
+    # has no guest memory to read, so results routed through memory
+    # degrade to the arg default, on device and oracle alike.
+    ret_backed: set[int] = set()
+    for p in _walk_calls(words):
+        co = int(words[p + 1])
+        if co != EXEC_NO_COPYOUT:
+            ret_backed.add(co)
+
+    c = -1
+    for p in _walk_calls(words):
+        c += 1
+        if c >= max_calls:
+            raise ValueError(
+                f"template has more than {max_calls} calls")
+        call_id[c] = int(words[p]) & 0xFFFFFFFF
+        co = int(words[p + 1])
+        if co != EXEC_NO_COPYOUT and co < SIM_MAX_COPYOUT:
+            ret_idx[c] = co
+        na = int(words[p + 2])
+        if na > SIM_MAX_ARGS:
+            raise ValueError(f"call {c} has {na} args (max "
+                             f"{SIM_MAX_ARGS}, executor failf's these)")
+        nargs_a[c] = na
+        q = p + 3
+        for i in range(na):
+            kind = int(words[q])
+            if kind == EXEC_ARG_CONST:
+                s = word2slot.get(q + 2)
+                if s is None:
+                    amode[c, i] = MODE_CONST
+                    aconst[c, i] = words[q + 2]
+                    ameta[c, i] = words[q + 1]
+                elif et is not None and bool(et.is_proc[s]):
+                    amode[c, i] = MODE_PROC
+                    aslot[c, i] = s
+                    aconst[c, i] = et.aux0[s]
+                    ameta[c, i] = et.proc_meta_concrete[s]
+                    aaux[c, i] = et.proc_meta_default[s]
+                else:
+                    amode[c, i] = MODE_SLOT
+                    aslot[c, i] = s
+                    ameta[c, i] = words[q + 1]
+            elif kind == EXEC_ARG_RESULT:
+                amode[c, i] = MODE_RESULT
+                idx = int(words[q + 2])
+                if idx in ret_backed and idx < SIM_MAX_COPYOUT:
+                    aslot[c, i] = idx
+                aconst[c, i] = words[q + 5]  # type default
+                ameta[c, i] = words[q + 3]  # op_div
+                aaux[c, i] = words[q + 4]  # op_add
+            else:
+                amode[c, i] = MODE_ZERO  # data/csum read as 0
+            q = _skip_arg(words, q)
+
+    return SimTable(ncalls=c + 1, call_id=call_id, nargs=nargs_a,
+                    ret_idx=ret_idx, amode=amode, aslot=aslot,
+                    aconst=aconst, ameta=ameta, aaux=aaux)
+
+
+def build_sim_table(et, max_calls: int = SIM_MAX_CALLS) -> SimTable:
+    """Lower an ops/emit.ExecTemplate: device-mutable slots become
+    MODE_SLOT/MODE_PROC references into the mutant's value vector."""
+    vw = np.asarray(et.val_word)
+    word2slot = {int(vw[s]): s for s in range(vw.shape[0]) if vw[s] >= 0}
+    return _lower(np.asarray(et.words), word2slot, et, max_calls)
+
+
+def build_sim_table_from_words(words,
+                               max_calls: int = SIM_MAX_CALLS
+                               ) -> SimTable:
+    """Lower a raw assembled exec stream (no template): every arg is
+    static, so sim_exec_host needs no value vector."""
+    return _lower(np.asarray(words, dtype=np.uint64), {}, None, max_calls)
+
+
+def _bswap64(v: int) -> int:
+    return int.from_bytes((v & MASK64).to_bytes(8, "little"), "big")
+
+
+def transform_const(v: int, meta: int, pid: int) -> int:
+    """The executor's read_arg const-path transform: pid stride, then
+    the big-endian swap of the low `size` bytes (executor swap_bytes:
+    bswap64 then shift down).  Bitfields are NOT applied at call-arg
+    positions."""
+    v = (v + (meta >> 32) * pid) & MASK64
+    if (meta >> 8) & 1:
+        sz = meta & 0xFF
+        sz = 1 if sz < 1 else (8 if sz > 8 else sz)
+        v = _bswap64(v) >> (64 - 8 * sz)
+    return v
+
+
+def resolve_arg(table: SimTable, c: int, i: int, vals, covals,
+                codone, pid: int) -> int:
+    """Resolve call c's arg i to the u64 the executor would pass."""
+    mode = int(table.amode[c, i])
+    if mode == MODE_ZERO:
+        return 0
+    if mode == MODE_CONST:
+        return transform_const(int(table.aconst[c, i]),
+                               int(table.ameta[c, i]), pid)
+    if mode == MODE_SLOT:
+        return transform_const(int(vals[table.aslot[c, i]]) & MASK64,
+                               int(table.ameta[c, i]), pid)
+    if mode == MODE_PROC:
+        pv = int(vals[table.aslot[c, i]]) & MASK64
+        if pv == MASK64:
+            raw, meta = 0, int(table.aaux[c, i])
+        else:
+            raw = (int(table.aconst[c, i]) + pv) & MASK64
+            meta = int(table.ameta[c, i])
+        return transform_const(raw, meta, pid)
+    # MODE_RESULT
+    idx = int(table.aslot[c, i])
+    if idx >= 0 and codone[idx]:
+        v = int(covals[idx])
+    else:
+        v = int(table.aconst[c, i])
+    div = int(table.ameta[c, i])
+    if div:
+        v //= div
+    return (v + int(table.aaux[c, i])) & MASK64
+
+
+def sim_exec_host(table: SimTable, vals=None,
+                  alive_bits: int = MASK64, pid: int = 0):
+    """Run a lowered table through the host SimKernelModel with the
+    executor's sequencing (skip dead calls, _exit on a full crash so
+    later calls never run, persist ret-backed copyouts only on
+    errno == 0).  Returns (edges u32[C,E], valid bool[C,E],
+    ret u64[C], errno i32[C], status i32[C]) — the exact outputs of
+    the device kernel, which is what makes this the parity oracle."""
+    C = table.call_id.shape[0]
+    edges = np.zeros((C, SIM_EDGE_SLOTS), dtype=np.uint32)
+    valid = np.zeros((C, SIM_EDGE_SLOTS), dtype=bool)
+    ret = np.zeros(C, dtype=np.uint64)
+    errno = np.zeros(C, dtype=np.int32)
+    status = np.zeros(C, dtype=np.int32)
+
+    model = SimKernelModel(pid)
+    covals = [0] * SIM_MAX_COPYOUT
+    codone = [False] * SIM_MAX_COPYOUT
+    for c in range(int(table.ncalls)):
+        if not (alive_bits >> c) & 1:
+            continue
+        args = [resolve_arg(table, c, i, vals, covals, codone, pid)
+                for i in range(int(table.nargs[c]))]
+        r = model.exec(int(table.call_id[c]), args)
+        edges[c] = np.asarray(r.edges, dtype=np.uint64).astype(np.uint32)
+        valid[c] = r.valid
+        ret[c] = r.ret
+        errno[c] = r.errno
+        if r.crashed:
+            status[c] = STATUS_CRASHED
+            break  # the executor _exits: later calls never run
+        status[c] = STATUS_RAN
+        ri = int(table.ret_idx[c])
+        if ri >= 0 and r.errno == 0:
+            covals[ri] = r.ret
+            codone[ri] = True
+    return edges, valid, ret, errno, status

@@ -190,6 +190,28 @@ def wedge_report(snap: dict) -> list[str]:
                      f"{novel / (f_batches * batch_g):.1%} "
                      f"over {int(f_batches)} batches")
         lines.append(line)
+    # Sim prescore (ISSUE 15): the speculative drain's suppression
+    # fraction and demotion state — suppression collapsing to 0% with
+    # batches still flowing means the speculation plane just decayed
+    # (an epoch boundary, not a wedge); a demoted prescore means the
+    # drain fell back to pass-through and ships every plane-novel row.
+    s_batches = counters.get("tz_sim_prescore_batches_total") or 0
+    if s_batches:
+        s_backend = gauges.get("tz_sim_backend")
+        line = (f"sim prescore: backend "
+                f"{'pallas' if s_backend else 'vmap'}, "
+                f"{int(s_batches)} batches")
+        s_sup = counters.get("tz_sim_suppressed_rows_total") or 0
+        if batch_g:
+            line += (f", suppressed "
+                     f"{s_sup / (s_batches * batch_g):.1%}")
+        s_epochs = counters.get("tz_sim_readmit_epochs_total") or 0
+        if s_epochs:
+            line += f", {int(s_epochs)} readmit epochs"
+        s_demos = counters.get("tz_sim_demotions_total") or 0
+        if s_demos:
+            line += f", {int(s_demos)} demotions"
+        lines.append(line)
     # Triage plane health (ISSUE 4): pre-filter hit rate and the
     # realized device-checked call rate — next to the demotion count
     # so a CPU-path regression is visible in the same A/B snapshot.

@@ -266,6 +266,10 @@ class TriageEngine:
         # Serving plane (serve/plane.py): when attached, per-tenant
         # novelty-plane occupancy/FN-rate rides the analytics rollup.
         self._tenant_planes = None
+        # Speculative prescore (syzkaller_tpu/sim): when attached,
+        # snapshot() carries the prescore verdict-path state so the
+        # triage surface shows what the filter upstream of it did.
+        self._sim_prescore = None
         # Durability (syzkaller_tpu/durable): when attached, merges
         # journal their folded indices and the mirror becomes a
         # checkpoint section (durable_provider / restore_mirror).
@@ -497,6 +501,14 @@ class TriageEngine:
         with per-tenant {occupancy, fn_rate, epoch} — the multi-
         tenant extension of the PR 7 coverage accounting."""
         self._tenant_planes = planes
+
+    def attach_sim(self, sim) -> None:
+        """Register the pipeline's speculative prescore
+        (sim/prescore.SimPrescore): snapshot() gains a "sim_prescore"
+        key — suppression totals, re-admission epochs and the
+        prescore breaker — so the triage surface reports the filter
+        that decides which mutants ever reach its verdict path."""
+        self._sim_prescore = sim
 
     def run_analytics(self, audit: bool = False) -> dict:
         """Force one analytics pass (bench.py --coverage, tests);
@@ -905,10 +917,12 @@ class TriageEngine:
 
     def snapshot(self) -> dict:
         """Engine state for health_snapshot surfaces and tests."""
+        out = self._snapshot_base()
         if self._tenant_planes is not None:
-            return dict(self._snapshot_base(),
-                        tenants=self._tenant_planes.analytics())
-        return self._snapshot_base()
+            out["tenants"] = self._tenant_planes.analytics()
+        if self._sim_prescore is not None:
+            out["sim_prescore"] = self._sim_prescore.snapshot()
+        return out
 
     def _snapshot_base(self) -> dict:
         s = self.stats

@@ -162,6 +162,35 @@ def test_wedge_report_transfer_plane_line():
                    for ln in bw.wedge_report(_wedge_snapshot()))
 
 
+def test_wedge_report_sim_prescore_line():
+    """The speculative prescore diagnostics (ISSUE 15): backend,
+    batch count, the suppressed fraction against the pipeline batch
+    size, re-admission epochs and demotions render as one line."""
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    reg.gauge("tz_pipeline_batch_size").set(4096)
+    reg.gauge("tz_sim_backend").set(0)
+    reg.counter("tz_sim_prescore_batches_total").inc(10)
+    reg.counter("tz_sim_suppressed_rows_total").inc(24576)
+    reg.counter("tz_sim_readmit_epochs_total").inc(2)
+    reg.counter("tz_sim_demotions_total").inc(1)
+    lines = bw.wedge_report(reg.snapshot())
+    line = next(ln for ln in lines if ln.startswith("sim prescore"))
+    assert "backend vmap" in line
+    assert "10 batches" in line
+    assert "suppressed 60.0%" in line  # 24576 of 10 x 4096 rows
+    assert "2 readmit epochs" in line
+    assert "1 demotions" in line
+    # the pallas backend renders by name
+    reg.gauge("tz_sim_backend").set(1)
+    lines = bw.wedge_report(reg.snapshot())
+    assert any("sim prescore: backend pallas" in ln for ln in lines)
+    # a snapshot without prescore counters renders no line
+    assert not any(ln.startswith("sim prescore")
+                   for ln in bw.wedge_report(_wedge_snapshot()))
+
+
 def test_wedge_report_control_plane_line():
     """The control-plane health line (ISSUE 9): fleet liveness,
     retry/replay volume, and the admission state render in the wedge

@@ -814,6 +814,57 @@ def test_fused_mutation_core_zero_new_jits_on_warm_pipeline(device_rig):
         "fused drain retraced after warmup"
 
 
+def test_sim_prescore_fault_demotes_to_passthrough_zero_loss(device_rig):
+    """ISSUE 15: scripted `device.sim` failures demote the prescore
+    stage to PASS-THROUGH — the faulted launches still deliver their
+    batches through the plain fused step (zero lost mutants) and the
+    pipeline breaker never hears about it — and once the seam heals
+    the next prescored commit re-promotes.  Steady-state prescored
+    batches plus the whole demote/heal cycle add zero jit compiles
+    after the one-time _step_sim warm-up."""
+    _target, pl = device_rig
+    assert pl._fused, "prescore requires the fused drain"
+    pl.enable_sim_prescore(backend="vmap")
+    sim = pl._sim
+    sim.breaker.configure_backoff(initial=0.05, cap=0.1)
+    try:
+        # Warm the prescored step: drain until a prescored batch lands.
+        _drain_until(pl, lambda: pl.stats.sim_batches >= 1, timeout=300)
+        assert pl.stats.sim_batches >= 1, "no prescored batch arrived"
+        caches0 = (pl._step._cache_size(), pl._step_sim._cache_size())
+
+        batches0 = sim.batches
+        install_plan(FaultPlan.parse("device.sim:fail@1-2"))
+        batch = _drain_until(pl, sim.demoted, timeout=60)
+        assert sim.demoted(), "prescore never demoted"
+        if batch is None:
+            batch = pl.next_batch(timeout=300)
+        assert batch, "demoted prescore lost a batch"
+        # The prescore seam is the sim's OWN breaker's problem: the
+        # pipeline breaker stays closed, nothing device-demotes.
+        assert pl.breaker.state == CLOSED
+
+        # Heal (only occurrences 1-2 were scripted): the next
+        # prescored commit re-promotes.
+        reset_plan()
+        _drain_until(pl, lambda: sim.repromotions >= 1, timeout=120)
+        assert sim.repromotions >= 1, "prescore never re-promoted"
+        assert not sim.demoted()
+        assert sim.batches > batches0
+        snap = pl.health_snapshot()["sim"]
+        assert snap["demotions"] >= 1 and snap["repromotions"] >= 1
+        assert snap["breaker"]["state"] == CLOSED
+        assert (pl._step._cache_size(),
+                pl._step_sim._cache_size()) == caches0, \
+            "prescore demote/heal cycle triggered new jits"
+    finally:
+        reset_plan()
+        pl.disable_sim_prescore()
+    assert pl._sim is None and pl._step_sim is None
+    # Pass-through forever after: the plain fused step still drains.
+    assert pl.next_batch(timeout=300)
+
+
 def test_mesh_reshard_topology_cache_compile_guard(monkeypatch):
     """ISSUE 11 compile-count guard: the fault-domain engine caches
     jitted step graphs per live-topology, so the demote -> serve-from-

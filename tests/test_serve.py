@@ -280,6 +280,65 @@ def test_compose_fault_seam_defers_batch():
     assert r["rows"] == 32 and calls[0] == 1
 
 
+def test_sim_loadgen_drives_composer_multi_tenant():
+    """ISSUE 15: the VM-free load generator (syzkaller_tpu/sim) stands
+    in for the fused drain — byte-realistic rows with a deterministic
+    verdict mix (crashes, EBADF, lockless races, repeated/stale rows)
+    — so the multi-tenant composer is stress-tested at full batch
+    shape with no executor subprocess anywhere."""
+    from syzkaller_tpu.sim import SimLoadGenerator
+
+    clock = _Clock()
+    gen = SimLoadGenerator(seed=11, repeat_every=4)
+    broker, _planes, comp = _mk_serving(clock, batch_rows=128,
+                                        drain=gen.drain, bits=16)
+    for name in ("a", "b"):
+        broker.Connect({"name": name})
+    seqs = {"a": 0, "b": 0}
+    delivered = 0
+
+    def poll(name, backlog):
+        seqs[name] += 1
+        resp, _annex = broker.Poll(
+            {"name": name, "epoch": broker.epoch, "seq": seqs[name],
+             "ack_seq": seqs[name] - 1,
+             "demand": {"backlog": backlog}})
+        return len(resp["results"])
+
+    poll("a", 96)
+    poll("b", 32)
+    r = comp.compose_once()
+    # Demand-exact composition off the generator's rows.
+    assert r["rows"] == 128
+    assert r["tenants"]["a"]["rows"] == 96
+    assert r["tenants"]["b"]["rows"] == 32
+    total_rows = r["rows"]
+    total_novel = sum(t["novel"] for t in r["tenants"].values())
+    for _ in range(6):
+        delivered += poll("a", 96)
+        delivered += poll("b", 32)
+        r = comp.compose_once()
+        for t in r["tenants"].values():
+            total_rows += t["rows"]
+            total_novel += t["novel"]
+    # The generator's replayed rows are byte-identical, so per-tenant
+    # planes mark some of the stream stale across batches — the
+    # verdict mix a real corpus produces, without a single VM.
+    assert total_rows > 256, "the generator never sustained supply"
+    assert 0 < total_novel < total_rows
+    # Conservation: every novel row is delivered or still queued
+    # (queued() includes unacked inflight, which `delivered` already
+    # counted — at-least-once delivery, so back them out).
+    queued = broker.tenants["a"].queued() + broker.tenants["b"].queued()
+    inflight = sum(len(items) for t in ("a", "b")
+                   for _seq, items in broker.tenants[t].inflight)
+    assert delivered + queued - inflight == total_novel
+    mix = gen.verdict_mix()
+    assert 0.2 < mix["repeat_frac"] < 0.3
+    assert mix["crash_frac"] > 0 and mix["ebadf_frac"] > 0
+    assert gen.stats["programs"] > 0 and gen.stats["repeats"] > 0
+
+
 # -- admission quotas ----------------------------------------------------
 
 
