@@ -663,6 +663,99 @@ def bench_serve(tenants=6, batches=60, batch_rows=4096,
     }
 
 
+def bench_hub(managers=4, progs_per_manager=300, prog_bytes=160,
+              shared_frac=0.6, sig_per_prog=24) -> dict:
+    """Hub federation bench (ISSUE 16, hub/): host-only — measures
+    what the plane-indexed novelty diff keeps off the wire.
+
+    `managers` managers each contribute `progs_per_manager` programs;
+    a `shared_frac` fraction exercise only shared-pool signal (the
+    common kernel behaviors every pod member finds on its own), the
+    rest carry manager-unique signal.  Each manager syncs against the
+    same populated hub twice — once blind, once presenting the digest
+    of its own corpus signal — and the delta is the reply bytes the
+    digest predicted the receiver didn't need (plus the per-sync wall
+    time, to show the diff costs host-side microseconds)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from syzkaller_tpu.hub.state import HubState
+
+    rng = np.random.RandomState(31)
+    # A small hot pool: the common kernel behaviors every manager
+    # rediscovers — small enough that each corpus covers essentially
+    # all of it, which is exactly when the digest diff pays.
+    shared_pool = rng.randint(0, 1 << 31, size=256).astype(np.int64)
+
+    def make_corpus(mi):
+        progs, sigs = [], []
+        unique = rng.randint(0, 1 << 31,
+                             size=4096).astype(np.int64) + (mi << 40)
+        for pi in range(progs_per_manager):
+            body = rng.bytes(prog_bytes - 16)
+            progs.append(b"m%02d-p%04d:" % (mi, pi) + body)
+            pool = shared_pool if pi < shared_frac * progs_per_manager \
+                else unique
+            sigs.append([int(x) for x in
+                         rng.choice(pool, size=sig_per_prog)])
+        return progs, sigs
+
+    corpora = [make_corpus(mi) for mi in range(managers)]
+    results = {}
+    for use_digest in (False, True):
+        tmp = tempfile.mkdtemp(prefix="tz-bench-hub-")
+        try:
+            st = HubState(tmp, lease_s=3600.0)
+            for mi, (progs, sigs) in enumerate(corpora):
+                st.connect(f"m{mi}", True, progs, sigs=sigs)
+            total_bytes = 0
+            total_progs = 0
+            wall_s = 0.0
+            for mi, (_progs, sigs) in enumerate(corpora):
+                digest = None
+                if use_digest:
+                    from syzkaller_tpu.ops.signal import (
+                        digest_from_folds, fold_hash_np)
+                    elems = np.asarray(
+                        [e for s in sigs for e in s],
+                        dtype=np.int64).astype(np.uint32)
+                    digest = digest_from_folds(
+                        fold_hash_np(elems), st.digest_bits)
+                t0 = time.perf_counter()
+                while True:
+                    progs, _repros, more = st.sync(
+                        f"m{mi}", [], [], [], False, digest=digest)
+                    total_bytes += sum(len(p) for p in progs)
+                    total_progs += len(progs)
+                    if not more:
+                        break
+                wall_s += time.perf_counter() - t0
+            results[use_digest] = {
+                "bytes": total_bytes, "progs": total_progs,
+                "sync_us": 1e6 * wall_s / managers,
+                "skipped": st.digest_skipped_total,
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    blind, diffed = results[False], results[True]
+    saved = blind["bytes"] - diffed["bytes"]
+    return {
+        "hub_managers": managers,
+        "hub_progs_per_manager": progs_per_manager,
+        "hub_shared_frac": shared_frac,
+        "hub_reply_bytes_blind": blind["bytes"],
+        "hub_reply_bytes_digest": diffed["bytes"],
+        "hub_sync_saved_bytes": saved,
+        "hub_sync_reply_bytes_saved_pct":
+            round(100.0 * saved / max(blind["bytes"], 1), 2),
+        "hub_digest_skipped_progs": diffed["skipped"],
+        "hub_sync_us_blind": round(blind["sync_us"], 1),
+        "hub_sync_us_digest": round(diffed["sync_us"], 1),
+    }
+
+
 def bench_accounting(batches=5000, tenants=3, lanes=3, shards=4,
                      ticks=2000) -> dict:
     """Accounting & SLO plane bench (ISSUE 14): host-only — the ledger
@@ -1487,6 +1580,15 @@ def main() -> None:
         res = {"metric": "coverage_analytics_ms_per_flush",
                "unit": "ms/flush", **bench_coverage()}
         res["value"] = res["coverage_analytics_ms_per_flush"]
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
+    if "--hub" in argv:
+        res = {"metric": "hub_sync_reply_bytes_saved_pct",
+               "unit": "% reply bytes", **bench_hub()}
+        res["value"] = res["hub_sync_reply_bytes_saved_pct"]
         if platform:
             res["platform"] = platform
         journal_append(res)

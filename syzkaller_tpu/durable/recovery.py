@@ -87,6 +87,54 @@ class _Tenant:
             self.pending[:0] = requeued
 
 
+class _HubMgr:
+    """One hub-side manager's delivery custody during replay.  The hub
+    ships programs by cursor (last_seq into the global seq index), so
+    inflight entries carry (reply seq, cursor start, cursor end) plus
+    the repro payloads actually handed out; rollback = the min start
+    of the abandoned suffix (acks are a high-water mark, so abandoned
+    batches always form a suffix of the cursor range)."""
+
+    __slots__ = ("last_seq", "inflight", "pending", "seen")
+
+    def __init__(self, meta=None, blob: bytes = b""):
+        meta = meta or {}
+        self.last_seq = int(meta.get("last_seq") or 0)
+        self.inflight: list = []  # [rseq, start, end, [payloads]]
+        for rseq, start, end, off, lens in meta.get("inflight") or []:
+            payloads, o = [], int(off)
+            for ln in lens:
+                payloads.append(bytes(blob[o:o + ln]))
+                o += ln
+            self.inflight.append([int(rseq), int(start), int(end),
+                                  payloads])
+        self.pending: list = []
+        o = int(meta.get("pending_off") or 0)
+        for ln in meta.get("pending_lens") or []:
+            self.pending.append(bytes(blob[o:o + ln]))
+            o += ln
+        self.seen = set(meta.get("seen") or [])
+
+    def settle(self, seq: int, ack_seq: int) -> None:
+        keep, requeued = [], []
+        rollback = None
+        for entry in self.inflight:
+            rseq, start, _end, payloads = entry
+            if rseq <= ack_seq:
+                continue  # delivered
+            if rseq < seq:
+                rollback = start if rollback is None \
+                    else min(rollback, start)
+                requeued.extend(payloads)
+            else:
+                keep.append(entry)
+        self.inflight = keep
+        if rollback is not None:
+            self.last_seq = min(self.last_seq, rollback)
+        if requeued:
+            self.pending[:0] = requeued
+
+
 def replay(ckpt: dict, records: list) -> dict:
     """Apply `records` (wal.WalRecord list) on top of a decoded
     checkpoint image (checkpoint.read_checkpoint output, or {} for
@@ -162,6 +210,14 @@ def replay(ckpt: dict, records: list) -> dict:
     if "slo" in ckpt:
         meta, _blob = ckpt["slo"]
         slo = dict(meta)
+
+    hub = None
+    hub_mgrs: dict = {}
+    if "hub" in ckpt:
+        meta, blob = ckpt["hub"]
+        hub = {"next_seq": int(meta.get("next_seq") or 1)}
+        for name, hm in (meta.get("managers") or {}).items():
+            hub_mgrs[name] = _HubMgr(hm, blob)
 
     # -- replay the journal ------------------------------------------------
     for rec in records:
@@ -290,6 +346,47 @@ def replay(ckpt: dict, records: list) -> dict:
                 t = tenants.get(name)
                 if t is not None:
                     t.stalled = bool(s)
+        elif kind == "hub_connect":
+            if hub is None:
+                hub = {"next_seq": 1}
+            m = hub_mgrs.setdefault(meta.get("name") or "manager",
+                                    _HubMgr())
+            # Un-acked replies died with the old session; the fresh
+            # lease starts from the cursor the hub persisted.
+            m.settle(1 << 62, 0)
+            m.last_seq = int(meta.get("last_seq") or 0)
+        elif kind == "hub_issue":
+            m = hub_mgrs.setdefault(meta.get("name") or "manager",
+                                    _HubMgr())
+            lens = meta.get("repro_lens") or []
+            payloads, off = [], 0
+            for ln in lens:
+                payloads.append(bytes(blob[off:off + ln]))
+                off += ln
+            # The issued repros left the pending queue at issue time.
+            del m.pending[:len(payloads)]
+            m.inflight.append([int(meta.get("rseq") or 0),
+                               int(meta.get("start") or 0),
+                               int(meta.get("end") or 0), payloads])
+            m.last_seq = max(m.last_seq, int(meta.get("end") or 0))
+        elif kind == "hub_settle":
+            m = hub_mgrs.setdefault(meta.get("name") or "manager",
+                                    _HubMgr())
+            m.settle(int(meta.get("seq") or 0),
+                     int(meta.get("ack_seq") or 0))
+        elif kind == "hub_reap":
+            m = hub_mgrs.get(meta.get("name") or "manager")
+            if m is not None:
+                m.settle(1 << 62, 0)
+        elif kind == "hub_repro":
+            m = hub_mgrs.setdefault(meta.get("to") or "manager",
+                                    _HubMgr())
+            lens = meta.get("lens") or []
+            off = 0
+            for ln in lens:
+                m.pending.append(bytes(blob[off:off + ln]))
+                off += ln
+            m.seen.update(meta.get("hashes") or [])
         elif kind == "cov":
             if coverage is None:
                 coverage = {"ring": []}
@@ -331,6 +428,17 @@ def replay(ckpt: dict, records: list) -> dict:
     if tplanes:
         out["tenant_planes"] = {"bits": tp_bits, "planes": tplanes,
                                 "epochs": tp_epochs}
+    if hub is not None or hub_mgrs:
+        hub = hub or {"next_seq": 1}
+        hub["managers"] = {}
+        for name, m in hub_mgrs.items():
+            m.settle(1 << 62, 0)  # collapse: un-acked -> redeliver
+            hub["managers"][name] = {
+                "last_seq": m.last_seq,
+                "pending_repros": m.pending,
+                "seen": sorted(m.seen),
+            }
+        out["hub"] = hub
     if coverage is not None:
         out["coverage"] = coverage
     if accounting is not None:

@@ -48,6 +48,8 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_FLIGHT_DIR",
     "TZ_FLIGHT_RING",
     "TZ_FUZZER_LEASE_S",
+    "TZ_HUB_DIGEST_BITS",
+    "TZ_HUB_LEASE_S",
     "TZ_JAX_PLATFORM",
     "TZ_MANAGER_HTTP",
     "TZ_MANAGER_INPUTS_CAP",
@@ -63,6 +65,7 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_PIPELINE_FUSED",
     "TZ_RPC_BACKOFF_S",
     "TZ_RPC_REPLY_CACHE",
+    "TZ_RPC_REPLY_CACHE_MB",
     "TZ_RPC_RETRIES",
     "TZ_SERVE_COMPOSE_INTERVAL_S",
     "TZ_SERVE_CREDIT_DECAY",

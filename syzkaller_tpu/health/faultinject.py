@@ -49,6 +49,7 @@ SEAMS = (
     "rpc.recv_frame",
     "rpc.reply_cache",
     "manager.lease_expire",
+    "hub.sync",
     "queue.put",
     "mesh.shard_probe",
     "serve.compose",

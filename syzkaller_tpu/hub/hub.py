@@ -1,16 +1,46 @@
-"""Hub RPC service: corpus exchange between managers.
+"""Hub RPC service: partition-tolerant corpus exchange between managers.
 
 Serves Hub.Connect/Hub.Sync with client/key auth over the shared RPC
 transport (reference: syz-hub/hub.go:22-60 + pkg/rpctype Hub protocol
-rpctype.go:75-114).
+rpctype.go:75-114).  ISSUE 16 makes the service a federation plane:
+
+  * Connect mints (epoch, lease_s) so managers drive `call_session`
+    against the hub exactly like fuzzers against the manager — a
+    duplicate Sync replays its cached (reply, annex) byte-for-byte,
+    a stale epoch or expired lease answers ReconnectRequired.
+  * Sessioned Sync replies ship program payloads in the frame annex
+    (`progs` becomes [[offset, len], ...] refs) — no JSON/zlib pass
+    over corpus bytes.  Legacy unsessioned calls keep the inline
+    string shape for old clients.
+  * A Sync may carry the manager's packed novelty digest; HubState
+    withholds programs the digest says the receiver already has.
+  * The state body runs inside the `hub.sync` fault seam + span; a
+    failure feeds that manager's circuit breaker, and while the
+    breaker is open the hub answers cheap throttle replies carrying a
+    `backoff_s` hint instead of scanning the corpus — one flapping
+    manager degrades alone, the pod keeps syncing.
+  * serve_hub attaches a DurableStore (checkpoint + WAL) so a leader
+    SIGKILL is a warm restart: the successor redelivers exactly the
+    un-acked batches.  main() turns SIGTERM into a graceful drain:
+    flight-recorder dump, RPC close, final checkpoint.
 """
 
 from __future__ import annotations
 
+import base64
+import binascii
+import threading
 from typing import Optional
 
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health import FaultInjected, fault_point
 from syzkaller_tpu.hub.state import HubState
+from syzkaller_tpu.ops.signal import unpack_plane
 from syzkaller_tpu.rpc import RPCServer
+
+_M_ANNEX_BYTES = telemetry.counter(
+    "tz_hub_annex_bytes_total",
+    "program payload bytes shipped in sync reply annexes")
 
 
 class Hub:
@@ -33,28 +63,121 @@ class Hub:
     def Connect(self, params: dict) -> dict:
         name = self._auth(params)
         corpus = [p.encode() for p in params.get("corpus") or []]
-        self.state.connect(name, bool(params.get("fresh")), corpus)
-        return {}
+        self.state.connect(name, bool(params.get("fresh")), corpus,
+                           sigs=params.get("corpus_sigs"))
+        if not params.get("session"):
+            return {}  # legacy shape
+        mgr = self.state.managers[name]
+        return {"epoch": self.state.epoch,
+                "lease_s": self.state.lease_s,
+                "last_seq": mgr.last_seq,
+                "digest_bits": self.state.digest_bits}
 
-    def Sync(self, params: dict) -> dict:
+    def Stats(self, params: dict) -> dict:
+        """Introspection for operators and the chaos drill: the pod's
+        cursors, custody depths, and breaker states."""
+        self._auth(params)
+        return self.state.stats()
+
+    def Sync(self, params: dict):
         name = self._auth(params)
-        progs, repros, more = self.state.sync(
-            name,
-            add=[p.encode() for p in params.get("add") or []],
-            delete=list(params.get("delete") or []),
-            repros=[p.encode() for p in params.get("repros") or []],
-            need_repros=bool(params.get("need_repros")),
-        )
-        return {"progs": [p.decode() for p in progs],
-                "repros": [p.decode() for p in repros],
-                "more": more}
+        st = self.state
+        sessioned = bool(params.get("epoch"))
+        cached = st.session_precheck(name, params)
+        if cached is not None:
+            return cached  # (reply, annex) replayed byte-for-byte
+
+        # Breaker gate: an open breaker answers a cheap backoff hint
+        # instead of scanning the corpus.  The throttle reply is
+        # session-committed too — its retry must replay, not re-gate.
+        br = st.breaker_for(name) if sessioned else None
+        if br is not None and not br.allow():
+            reply = ({"progs": [], "repros": [], "more": 0,
+                      "throttled": True,
+                      "backoff_s": round(br.seconds_until_probe(), 3)},
+                     None)
+            return st.session_commit(name, params, reply)
+
+        digest = None
+        blob64 = params.get("digest")
+        if blob64:
+            try:
+                bits = int(params.get("digest_bits")
+                           or st.digest_bits)
+                digest = unpack_plane(
+                    base64.b64decode(blob64), 1 << bits)
+            except (binascii.Error, ValueError):
+                digest = None  # garbled digest: sync without diffing
+
+        try:
+            with telemetry.span("hub.sync"):
+                fault_point("hub.sync")
+                progs, repros, more = st.sync(
+                    name,
+                    add=[p.encode() for p in params.get("add") or []],
+                    delete=list(params.get("delete") or []),
+                    repros=[p.encode()
+                            for p in params.get("repros") or []],
+                    need_repros=bool(params.get("need_repros")),
+                    add_sigs=params.get("add_sigs"),
+                    digest=digest,
+                    rseq=int(params.get("seq") or 0) if sessioned
+                    else 0,
+                    ack_seq=int(params.get("ack_seq") or 0),
+                )
+        except FaultInjected:
+            st.record_sync_result(name, ok=False)
+            raise
+        st.record_sync_result(name, ok=True)
+
+        if not sessioned:
+            return {"progs": [p.decode() for p in progs],
+                    "repros": [p.decode() for p in repros],
+                    "more": more}
+
+        # Sessioned reply: progs ride the annex as (offset, len) refs.
+        refs = []
+        off = 0
+        for p in progs:
+            refs.append([off, len(p)])
+            off += len(p)
+        annex = b"".join(progs) if progs else None
+        if annex:
+            _M_ANNEX_BYTES.inc(len(annex))
+        reply = ({"progs": refs,
+                  "repros": [p.decode() for p in repros],
+                  "more": more}, annex)
+        return st.session_commit(name, params, reply)
+
+
+def _register_gauges(state: HubState) -> None:
+    """Pull gauges over live hub state.  Re-registration rebinds fn,
+    so a fresh serve_hub (tests, restart-in-process) never leaves a
+    gauge reading a dead HubState."""
+    telemetry.gauge(
+        "tz_hub_managers_size",
+        "managers holding a live hub session",
+        fn=state.connected_managers)
+    telemetry.gauge(
+        "tz_hub_corpus_size", "programs in the global hub corpus",
+        fn=lambda: len(state.corpus_db.records))
+    telemetry.gauge(
+        "tz_hub_pending_repros_depth",
+        "repro payloads queued for delivery across all managers",
+        fn=state.pending_repro_depth)
 
 
 def serve_hub(workdir: str, addr: tuple[str, int] = ("127.0.0.1", 0),
-              clients: Optional[dict] = None, target=None
-              ) -> tuple[RPCServer, Hub]:
-    state = HubState(workdir, target=target)
+              clients: Optional[dict] = None, target=None,
+              durable=None) -> tuple[RPCServer, Hub]:
+    if durable is None:
+        from syzkaller_tpu.durable import DurableStore
+        durable = DurableStore.open(workdir)
+    state = HubState(workdir, target=target, durable=durable)
+    if durable is not None:
+        durable.start()
     hub = Hub(state, clients)
+    _register_gauges(state)
     srv = RPCServer(addr)
     srv.register("Hub", hub)
     srv.serve_in_background()
@@ -63,7 +186,7 @@ def serve_hub(workdir: str, addr: tuple[str, int] = ("127.0.0.1", 0),
 
 def main(argv=None) -> None:
     import argparse
-    import time
+    import signal as _signal
 
     ap = argparse.ArgumentParser(prog="tz-hub")
     ap.add_argument("-workdir", required=True)
@@ -78,10 +201,25 @@ def main(argv=None) -> None:
         if ":" in pair:
             c, _, k = pair.partition(":")
             clients[c] = k
-    srv, _hub = serve_hub(args.workdir, parse_addr(args.addr), clients)
-    print(f"hub serving on {srv.addr[0]}:{srv.addr[1]}")
-    while True:
-        time.sleep(60)
+    srv, hub = serve_hub(args.workdir, parse_addr(args.addr), clients)
+    print(f"hub serving on {srv.addr[0]}:{srv.addr[1]}", flush=True)
+
+    # Graceful drain: SIGTERM/SIGINT stop the wait loop; shutdown
+    # dumps the flight recorder (post-mortem context beats a silent
+    # exit), closes the RPC listener, and takes a final checkpoint so
+    # the successor warm-restarts instead of replaying the whole WAL.
+    stop = threading.Event()
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        _signal.signal(sig, lambda _s, _f: stop.set())
+    while not stop.wait(1.0):
+        pass
+    telemetry.record_event("hub.shutdown", "signal received; draining")
+    telemetry.FLIGHT.dump("hub_shutdown",
+                          "graceful shutdown on signal",
+                          extra=hub.state.stats())
+    srv.close()
+    if hub.state.durable is not None:
+        hub.state.durable.close()
 
 
 if __name__ == "__main__":

@@ -1,28 +1,124 @@
-"""Hub corpus-exchange state.
+"""Hub corpus-exchange state: the fault-domain federation plane.
 
 The hub federates corpora across managers: every synced program gets a
 monotonic sequence number in a global corpus; each manager tracks the
 last sequence it has consumed, so a sync streams it everything new
 from *other* managers (its own programs are filtered by hash).  Repro
-requests fan out to every other connected manager's pending queue.
-All state is durable: global corpus + per-manager metadata live in
-append-only DBs under the workdir (reference: syz-hub/state/state.go:54
-Make, 144 Connect, 178 Sync, 200/228 repro queues, 341 purgeCorpus).
+requests fan out to every other connected manager's pending queue
+(reference: syz-hub/state/state.go:54 Make, 144 Connect, 178 Sync,
+200/228 repro queues, 341 purgeCorpus).
+
+ISSUE 16 layers the pod-survival machinery on top of that exchange:
+
+  * Sessions (the PR 8 discipline): Connect mints (epoch, lease);
+    Sync carries (epoch, seq, ack_seq) with a byte-bounded per-manager
+    ReplyCache so `call_session` retries are at-most-once.  A stale
+    epoch or reaped lease answers ReconnectRequired and the manager
+    resyncs from its durable `last_seq` — corpus adds dedup by
+    program hash, so the resync re-upload is idempotent.
+  * Delivery custody: a sessioned sync's cursor advance rides
+    `inflight` as (reply seq, start, end, repros) until the manager's
+    ack_seq confirms receipt.  An abandoned reply (ack skipped the
+    seq: lost reply, dead manager) rolls the cursor back to the
+    batch's start and returns its repros to the queue front — the
+    selection scan is deterministic from the cursor, so rollback IS
+    redelivery, with zero loss and zero duplication (acks are a
+    monotonic high-water mark, so abandonment is suffix-shaped).
+  * Plane-indexed novelty diffs: a Sync may carry the manager's
+    packed signal digest (ops/signal.digest_* at TZ_HUB_DIGEST_BITS);
+    the hub diffs each candidate program's stored folds (sig.db)
+    against it and withholds predicted-known programs, cutting reply
+    bytes.  Withheld programs still advance the cursor — the digest
+    said the receiver has that signal already.
+  * Leader failover (the PR 12 treatment): when a DurableStore is
+    attached, cursor advances / settles / repro custody journal under
+    the store barrier and the whole session plane is a checkpoint
+    section; recovery COLLAPSES un-acked inflight back into the
+    cursors (durable/recovery.py), so a SIGKILLed hub restarted
+    behind the same port redelivers exactly the unconfirmed batches.
+    The corpus itself (corpus.db / sig.db / per-manager own dbs) is
+    already crash-safe through the fsynced db layer.
+  * Per-manager circuit breakers: sync failures (the `hub.sync`
+    fault seam) trip a per-manager breaker whose open state degrades
+    THAT manager to backoff-hint replies without stalling the pod.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
+import random
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+import numpy as np
+
+from syzkaller_tpu import telemetry
 from syzkaller_tpu.db import open_db
+from syzkaller_tpu.health import CircuitBreaker
+from syzkaller_tpu.health.envsafe import env_float
 from syzkaller_tpu.models.encoding import ParseError, deserialize_prog
+from syzkaller_tpu.ops.signal import (digest_covers, fold_hash_np,
+                                      resolve_digest_bits)
+from syzkaller_tpu.rpc.replycache import ReplyCache
+from syzkaller_tpu.rpc.rpc import ReconnectRequired
 from syzkaller_tpu.utils import log
 from syzkaller_tpu.utils.hashsig import hash_string
 
 SYNC_BATCH = 1000  # progs per Sync response (state.go pendingBatch)
+REPRO_BATCH = 100
+#: Reaped managers' reply caches kept around (bounded, same rationale
+#: as manager/rpcserver._MAX_TOMBSTONES).
+_MAX_TOMBSTONES = 64
+#: Settle sentinel: "every outstanding reply is abandoned" (reap,
+#: re-Connect, recovery collapse).
+SETTLE_ALL = 1 << 62
+
+_M_SENT = telemetry.counter(
+    "tz_hub_progs_sent_total", "programs shipped in sync replies")
+_M_RECV = telemetry.counter(
+    "tz_hub_progs_recv_total", "programs received from managers")
+_M_REJECTED = telemetry.counter(
+    "tz_hub_progs_rejected_total",
+    "incoming programs refused by deserialize_prog (counted and "
+    "skipped; the seq index never advances for them)")
+_M_DIGEST_SKIPPED = telemetry.counter(
+    "tz_hub_digest_skipped_total",
+    "programs withheld from a sync reply as predicted-known by the "
+    "receiver's novelty digest")
+_M_SAVED_BYTES = telemetry.counter(
+    "tz_hub_sync_saved_bytes_total",
+    "reply payload bytes NOT shipped thanks to digest-diff sync")
+_M_REPLAYS = telemetry.counter(
+    "tz_hub_replays_total",
+    "duplicate (epoch, seq) hub syncs answered from the reply cache")
+_M_STALE = telemetry.counter(
+    "tz_hub_stale_sessions_total",
+    "hub calls answered ReconnectRequired (stale epoch or reaped "
+    "lease)")
+_M_REAPED = telemetry.counter(
+    "tz_hub_leases_reaped_total",
+    "manager leases reaped after TZ_HUB_LEASE_S without a sync")
+_M_REQUEUED = telemetry.counter(
+    "tz_hub_requeued_total",
+    "abandoned sync batches rolled back into manager cursors for "
+    "redelivery")
+_G_FAILOVER = telemetry.gauge(
+    "tz_hub_last_failover_ts",
+    "wallclock of the last warm recovery from a previous hub "
+    "generation (0 = never)")
+
+
+def _breaker_gauge(name: str) -> object:
+    return telemetry.gauge(
+        "tz_hub_breaker_state",
+        "one manager's hub-sync breaker (0 closed, 1 half_open, "
+        "2 open)", labels={"manager": name})
+
+
+_BREAKER_LEVEL = {"closed": 0, "half_open": 1, "open": 2}
 
 
 @dataclass
@@ -34,15 +130,30 @@ class ManagerState:
     seen_repros: set[str] = field(default_factory=set)
     connected: bool = False
     own_db: object = None  # cached open DB handle
+    # Session/lease plane (sessioned managers only; legacy callers
+    # leave these untouched).
+    last_seen: float = 0.0
+    reply_cache: ReplyCache = field(default_factory=ReplyCache)
+    #: Un-acked sync custody: [reply seq, cursor start, cursor end,
+    #: [repro payloads]].
+    inflight: list[list] = field(default_factory=list)
+    digest: Optional[np.ndarray] = None
+    breaker: Optional[CircuitBreaker] = None
 
 
 class HubState:
-    def __init__(self, workdir: str, target=None):
+    def __init__(self, workdir: str, target=None, durable=None,
+                 lease_s: Optional[float] = None,
+                 clock=time.monotonic):
         os.makedirs(workdir, exist_ok=True)
         self.workdir = workdir
         self.target = target  # optional: validates incoming programs
         self._lock = threading.Lock()
         self.corpus_db = open_db(os.path.join(workdir, "corpus.db"))
+        #: Sidecar fold index: program hash -> packed uint32 plane
+        #: folds of its signal, the digest-diff input.  Programs with
+        #: no stored folds always ship (never silently withheld).
+        self.sig_db = open_db(os.path.join(workdir, "sig.db"))
         self.managers: dict[str, ManagerState] = {}
         self.next_seq = 1
         # seq-ordered (seq, key) index so Sync streams deltas without
@@ -53,7 +164,101 @@ class HubState:
             self.next_seq = max(self.next_seq, rec.seq + 1)
             self._seq_order.append((rec.seq, key))
         self._seq_order.sort()
+        # Session plane: the epoch is re-minted per HubState instance,
+        # so a hub restart (planned or SIGKILL) invalidates every
+        # manager's session and forces the re-Connect resync.
+        self.epoch = f"{random.getrandbits(64):016x}"
+        self.lease_s = env_float("TZ_HUB_LEASE_S", 120.0) \
+            if lease_s is None else lease_s
+        self.digest_bits = resolve_digest_bits()
+        self._clock = clock
+        self.reaped_total = 0
+        self.replays_total = 0
+        self.rejected_total = 0
+        self.digest_skipped_total = 0
+        self.sync_saved_bytes = 0
+        self.last_failover_ts = 0.0
+        self._tombstones: dict[str, ReplyCache] = {}
         self._load_managers()
+        # Durability (syzkaller_tpu/durable): cursor/custody records
+        # journal under the store barrier; recovery overlays collapsed
+        # custody onto the file/db-loaded baseline above.
+        self.durable = durable
+        if durable is not None:
+            rec = (durable.recovered or {}).get("hub") \
+                if durable.recovered is not None else None
+            if rec:
+                self._restore_locked(rec)
+            durable.register("hub", self._provider)
+
+    # -- durable plumbing --------------------------------------------------
+
+    def _barrier(self):
+        d = self.durable
+        return d.barrier if d is not None else contextlib.nullcontext()
+
+    def _journal(self, kind: str, meta: dict, blob: bytes = b"") -> None:
+        d = self.durable
+        if d is not None:
+            d.journal(kind, meta, blob)
+
+    def _provider(self) -> tuple[dict, bytes]:
+        """The "hub" checkpoint section: per-manager cursors + custody
+        (inflight batches, pending repros) with repro payloads packed
+        into the blob.  The corpus dbs are NOT here — they are their
+        own fsynced files; the section covers exactly the state a
+        crash would otherwise lose: which deliveries were confirmed."""
+        with self._lock:
+            managers: dict[str, dict] = {}
+            parts: list[bytes] = []
+            off = 0
+            for name, m in self.managers.items():
+                infl = []
+                for rseq, start, end, repros in m.inflight:
+                    lens = [len(r) for r in repros]
+                    parts.extend(repros)
+                    infl.append([rseq, start, end, off, lens])
+                    off += sum(lens)
+                pend_lens = [len(r) for r in m.pending_repros]
+                parts.extend(m.pending_repros)
+                managers[name] = {
+                    "last_seq": m.last_seq,
+                    "inflight": infl,
+                    "pending_off": off,
+                    "pending_lens": pend_lens,
+                    "seen": sorted(m.seen_repros),
+                }
+                off += sum(pend_lens)
+            meta = {"next_seq": self.next_seq, "managers": managers}
+            return meta, b"".join(parts)
+
+    def _restore_locked(self, rec: dict) -> None:
+        """Overlay recovered custody on the file/db baseline.  The WAL
+        cursors are authoritative: they carry the rollback the seq
+        files cannot (a file-persisted cursor may point past batches
+        no manager ever confirmed)."""
+        for name, st in (rec.get("managers") or {}).items():
+            m = self.managers.get(name)
+            if m is None:
+                m = self.managers[name] = ManagerState(name=name)
+            m.last_seq = int(st.get("last_seq") or 0)
+            m.pending_repros = [bytes(b) for b in
+                                st.get("pending_repros") or []]
+            m.seen_repros = set(st.get("seen") or [])
+            m.connected = False
+            self._persist_manager(m)
+        self.next_seq = max(self.next_seq,
+                            int(rec.get("next_seq") or 1))
+        self.last_failover_ts = time.time()
+        _G_FAILOVER.set(self.last_failover_ts)
+        telemetry.record_event(
+            "hub.failover",
+            f"{len(rec.get('managers') or {})} manager cursors "
+            "recovered; un-acked batches collapsed for redelivery")
+        log.logf(0, "hub: warm failover recovery (%d managers)",
+                 len(rec.get("managers") or {}))
+
+    # -- manager persistence (legacy files; durable-free baseline) ---------
 
     def _manager_dir(self, name: str) -> str:
         safe = hash_string(name.encode())[:16]
@@ -70,10 +275,20 @@ class HubState:
                 name = open(os.path.join(d, "name")).read().strip()
                 seq = int(open(os.path.join(d, "seq")).read().strip() or 0)
             except (OSError, ValueError):
+                # Torn manager dir (half-written name/seq): skipped —
+                # the manager re-Connects and re-uploads; dedup by
+                # hash makes that idempotent.
+                continue
+            if not name:
                 continue
             mgr = ManagerState(name=name, last_seq=seq)
-            own = open_db(os.path.join(d, "corpus.db"))
-            mgr.own_hashes = set(own.records)
+            try:
+                own = open_db(os.path.join(d, "corpus.db"))
+                mgr.own_hashes = set(own.records)
+            except OSError:
+                # Stale dir with a missing/unreadable own-db: the
+                # cursor survives; ownership rebuilds on re-upload.
+                mgr.own_hashes = set()
             self.managers[name] = mgr
 
     def _persist_manager(self, mgr: ManagerState) -> None:
@@ -84,8 +299,6 @@ class HubState:
         with open(os.path.join(d, "seq"), "w") as f:
             f.write(str(mgr.last_seq))
 
-    # -- protocol ---------------------------------------------------------
-
     def _own_db(self, mgr: ManagerState):
         """Cached per-manager DB handle — Sync runs every minute per
         manager and must not re-parse the whole file each time."""
@@ -94,48 +307,215 @@ class HubState:
                 self._manager_dir(mgr.name), "corpus.db"))
         return mgr.own_db
 
-    def connect(self, name: str, fresh: bool,
-                corpus: list[bytes]) -> None:
-        """(reference: state.go:144-176)"""
+    # -- session plumbing (the PR 8 discipline) ----------------------------
+
+    def session_precheck(self, name: str,
+                         params: dict) -> Optional[tuple]:
+        """Replay-or-admit gate for a sessioned Sync: the cached
+        (reply, annex) for a duplicate (epoch, seq), None to execute,
+        or ReconnectRequired (stale epoch / reaped lease).  Legacy
+        callers (no epoch) pass through."""
+        epoch = params.get("epoch")
+        if not epoch:
+            return None
+        seq = int(params.get("seq") or 0)
         with self._lock:
+            self._reap_locked()
+            if epoch != self.epoch:
+                _M_STALE.inc()
+                raise ReconnectRequired(
+                    f"hub epoch {epoch} is stale (hub epoch "
+                    f"{self.epoch}); re-Connect")
+            m = self.managers.get(name)
+            if m is None or not m.connected:
+                cache = self._tombstones.get(name)
+                cached = cache.get(seq) if cache is not None else None
+                if cached is not None:
+                    _M_REPLAYS.inc()
+                    self.replays_total += 1
+                    return cached
+                _M_STALE.inc()
+                raise ReconnectRequired(
+                    f"hub lease for {name!r} expired; re-Connect")
+            m.last_seen = self._clock()
+            cached = m.reply_cache.get(seq)
+            if cached is not None:
+                _M_REPLAYS.inc()
+                self.replays_total += 1
+                return cached
+        return None
+
+    def session_commit(self, name: str, params: dict,
+                       reply: tuple) -> tuple:
+        seq = int(params.get("seq") or 0)
+        if not params.get("epoch") or not seq:
+            return reply
+        with self._lock:
+            m = self.managers.get(name)
+            if m is not None:
+                m.reply_cache.put(seq, reply)
+        return reply
+
+    def breaker_for(self, name: str) -> Optional[CircuitBreaker]:
+        with self._lock:
+            m = self.managers.get(name)
+            return m.breaker if m is not None else None
+
+    def record_sync_result(self, name: str, ok: bool) -> None:
+        """Feed one manager's sync outcome into its breaker (and the
+        labeled state gauge) — hub/hub.py calls this around the
+        `hub.sync` fault seam."""
+        with self._lock:
+            m = self.managers.get(name)
+            if m is None or m.breaker is None:
+                return
+            br = m.breaker
+        if ok:
+            br.record_success()
+        else:
+            br.record_failure()
+        _breaker_gauge(name).set(_BREAKER_LEVEL.get(br.state, 0))
+
+    def _reap_locked(self) -> None:
+        """Reap sessions idle past lease_s (caller holds self._lock).
+        Unlike the manager's fuzzer reap, the ManagerState survives —
+        cursors and corpus ownership are durable facts about the pod;
+        only the SESSION dies: un-acked custody rolls back into the
+        cursor, the reply cache is tombstoned."""
+        now = self._clock()
+        for m in list(self.managers.values()):
+            if not (m.connected and m.last_seen
+                    and now - m.last_seen > self.lease_s):
+                continue
+            m.connected = False
+            self.reaped_total += 1
+            _M_REAPED.inc()
+            self._settle_locked(m, SETTLE_ALL, 0)
+            self._journal("hub_reap", {"name": m.name})
+            self._tombstones[m.name] = m.reply_cache
+            m.reply_cache = ReplyCache()
+            while len(self._tombstones) > _MAX_TOMBSTONES:
+                del self._tombstones[next(iter(self._tombstones))]
+            self._persist_manager(m)
+            telemetry.record_event(
+                "hub.lease_expire",
+                f"{m.name} idle {now - m.last_seen:.0f}s; cursor "
+                f"rolled back to {m.last_seq}")
+            log.logf(0, "hub: reaped manager %s (idle %.0fs)",
+                     m.name, now - m.last_seen)
+
+    def _settle_locked(self, m: ManagerState, seq: int,
+                       ack_seq: int) -> None:
+        """Advance delivery custody: replies the manager confirmed
+        (reply seq <= ack_seq) retire; abandoned replies (reply seq <
+        current seq, never acked) roll the cursor back to their batch
+        start — redelivery happens by re-scanning, not by caching
+        payloads — and return their repros to the queue front."""
+        keep: list[list] = []
+        rollback: Optional[int] = None
+        requeued: list[bytes] = []
+        abandoned = 0
+        for entry in m.inflight:
+            rseq, start, _end, repros = entry
+            if rseq <= ack_seq:
+                continue  # delivered
+            if rseq < seq:
+                abandoned += 1
+                rollback = start if rollback is None \
+                    else min(rollback, start)
+                requeued.extend(repros)
+            else:
+                keep.append(entry)
+        m.inflight = keep
+        if rollback is not None:
+            m.last_seq = min(m.last_seq, rollback)
+        if requeued:
+            m.pending_repros[:0] = requeued
+        if abandoned:
+            _M_REQUEUED.inc(abandoned)
+
+    # -- protocol ---------------------------------------------------------
+
+    def connect(self, name: str, fresh: bool, corpus: list[bytes],
+                sigs: Optional[list] = None) -> ManagerState:
+        """(reference: state.go:144-176) + session arm: un-acked
+        replies died with the old session, so custody settles (cursor
+        rollback) before the fresh lease starts."""
+        with self._barrier(), self._lock:
+            self._reap_locked()
             mgr = self.managers.get(name)
             if mgr is None or fresh:
                 prev = mgr
                 mgr = ManagerState(name=name)
                 if prev is not None:
                     mgr.own_db = prev.own_db
+                    mgr.breaker = prev.breaker
                 self.managers[name] = mgr
+            else:
+                self._settle_locked(mgr, SETTLE_ALL, 0)
+                mgr.reply_cache = ReplyCache()
+            self._tombstones.pop(name, None)
             mgr.connected = True
+            mgr.last_seen = self._clock()
+            if mgr.breaker is None:
+                mgr.breaker = CircuitBreaker(failure_threshold=3,
+                                             clock=self._clock)
             own_db = self._own_db(mgr)
             if fresh:
                 for key in list(own_db.records):
                     own_db.delete(key)
                 mgr.last_seq = 0
-            for prog in corpus:
-                key = self._add_prog(name, mgr, prog, own_db)
+            for i, prog in enumerate(corpus):
+                sig = sigs[i] if sigs and i < len(sigs) else None
+                self._add_prog(name, mgr, prog, own_db, sig)
             own_db.flush()
+            self.corpus_db.flush()
+            self.sig_db.flush()
             mgr.own_hashes = set(own_db.records)
             self._persist_manager(mgr)
-            log.logf(0, "hub: manager %s connected (%d corpus, fresh=%s)",
-                     name, len(corpus), fresh)
+            self._journal("hub_connect",
+                          {"name": name, "last_seq": mgr.last_seq})
+            log.logf(0, "hub: manager %s connected (%d corpus, "
+                     "fresh=%s)", name, len(corpus), fresh)
+            return mgr
 
     def sync(self, name: str, add: list[bytes], delete: list[str],
-             repros: list[bytes], need_repros: bool
+             repros: list[bytes], need_repros: bool,
+             add_sigs: Optional[list] = None,
+             digest: Optional[np.ndarray] = None,
+             rseq: int = 0, ack_seq: int = 0
              ) -> tuple[list[bytes], list[bytes], int]:
-        """Returns (progs, repros, more) (reference: state.go:178-339)."""
-        with self._lock:
+        """Returns (progs, repros, more) (reference: state.go:178-339).
+        `rseq`/`ack_seq` arm the custody ledger (sessioned callers);
+        legacy callers (rseq=0) get immediate-delivery semantics, as
+        before sessions existed."""
+        with self._barrier(), self._lock:
             mgr = self.managers.get(name)
             if mgr is None:
                 raise KeyError(f"manager {name!r} never connected")
+            if digest is not None:
+                mgr.digest = digest
+            if rseq:
+                self._settle_locked(mgr, rseq, ack_seq)
+                if ack_seq or mgr.inflight:
+                    self._journal("hub_settle",
+                                  {"name": name, "seq": rseq,
+                                   "ack_seq": ack_seq})
             own_db = self._own_db(mgr)
-            for prog in add:
-                self._add_prog(name, mgr, prog, own_db)
+            for i, prog in enumerate(add):
+                sig = add_sigs[i] if add_sigs and i < len(add_sigs) \
+                    else None
+                self._add_prog(name, mgr, prog, own_db, sig)
+            if add:
+                _M_RECV.inc(len(add))
             for h in delete:
                 own_db.delete(h)
                 mgr.own_hashes.discard(h)
                 self.corpus_db.delete(h)
+                self.sig_db.delete(h)
             own_db.flush()
             self.corpus_db.flush()
+            self.sig_db.flush()
 
             # repro fan-out to every other manager
             for rp in repros:
@@ -145,45 +525,98 @@ class HubState:
                         continue
                     other.seen_repros.add(h)
                     other.pending_repros.append(rp)
+                    self._journal("hub_repro",
+                                  {"to": other.name, "lens": [len(rp)],
+                                   "hashes": [h]}, rp)
 
             # stream new progs from other managers (seq index walk;
-            # bisect to the cursor instead of scanning from 0)
+            # bisect to the cursor instead of scanning from 0).  The
+            # cursor also advances past own and digest-covered
+            # entries — both are conscious non-deliveries, not work
+            # left behind.
             import bisect as _bisect
 
             progs: list[bytes] = []
+            start_cursor = mgr.last_seq
             max_seq = mgr.last_seq
             remaining = 0
+            skipped = 0
+            saved = 0
             start = _bisect.bisect_right(self._seq_order,
                                          (mgr.last_seq, "\xff"))
             for seq, key in self._seq_order[start:]:
                 rec = self.corpus_db.records.get(key)
-                if rec is None or rec.seq != seq \
-                        or key in mgr.own_hashes:
-                    continue
+                if rec is None or rec.seq != seq:
+                    continue  # stale index entry
                 if len(progs) >= SYNC_BATCH:
-                    remaining += 1
+                    if key not in mgr.own_hashes:
+                        remaining += 1
+                    continue
+                if key in mgr.own_hashes:
+                    max_seq = seq
+                    continue
+                if mgr.digest is not None and digest_covers(
+                        mgr.digest, self._folds(key)):
+                    skipped += 1
+                    saved += len(rec.val)
+                    max_seq = seq
                     continue
                 progs.append(rec.val)
-                max_seq = max(max_seq, seq)
+                max_seq = seq
             mgr.last_seq = max_seq
-            self._persist_manager(mgr)
 
             out_repros: list[bytes] = []
             if need_repros:
-                out_repros = mgr.pending_repros[:100]
-                del mgr.pending_repros[:100]
+                out_repros = mgr.pending_repros[:REPRO_BATCH]
+                del mgr.pending_repros[:REPRO_BATCH]
+
+            if rseq and (progs or out_repros
+                         or max_seq != start_cursor):
+                mgr.inflight.append(
+                    [rseq, start_cursor, max_seq, list(out_repros)])
+                self._journal(
+                    "hub_issue",
+                    {"name": name, "rseq": rseq,
+                     "start": start_cursor, "end": max_seq,
+                     "repro_lens": [len(r) for r in out_repros]},
+                    b"".join(out_repros))
+            self._persist_manager(mgr)
+            if progs:
+                _M_SENT.inc(len(progs))
+            if skipped:
+                self.digest_skipped_total += skipped
+                self.sync_saved_bytes += saved
+                _M_DIGEST_SKIPPED.inc(skipped)
+                _M_SAVED_BYTES.inc(saved)
             return progs, out_repros, remaining
 
+    def _folds(self, key: str) -> np.ndarray:
+        rec = self.sig_db.records.get(key)
+        if rec is None or not rec.val:
+            return np.empty(0, np.int64)
+        return np.frombuffer(bytes(rec.val),
+                             dtype=np.uint32).astype(np.int64)
+
     def _add_prog(self, name: str, mgr: ManagerState, prog: bytes,
-                  own_db) -> Optional[str]:
+                  own_db, sig=None) -> Optional[str]:
         if self.target is not None:
             try:
                 deserialize_prog(self.target, prog)
             except ParseError:
-                return None  # refuse broken programs into the corpus
+                # Count + skip; the seq index never advances for a
+                # refused program, so one corrupt upload can't poison
+                # every other manager's cursor.
+                self.rejected_total += 1
+                _M_REJECTED.inc()
+                return None
         key = hash_string(prog)
         mgr.own_hashes.add(key)
         own_db.save(key, b"", 0)
+        if sig and key not in self.sig_db.records:
+            folds = np.unique(fold_hash_np(
+                np.asarray(list(sig), dtype=np.int64)
+                .astype(np.uint32)))
+            self.sig_db.save(key, folds.astype(np.uint32).tobytes(), 0)
         if key not in self.corpus_db.records:
             self.corpus_db.save(key, prog, self.next_seq)
             self._seq_order.append((self.next_seq, key))
@@ -200,16 +633,40 @@ class HubState:
             for key in list(self.corpus_db.records):
                 if key not in owned:
                     self.corpus_db.delete(key)
+                    self.sig_db.delete(key)
             self.corpus_db.flush()
+            self.sig_db.flush()
+
+    # -- introspection -----------------------------------------------------
+
+    def connected_managers(self) -> int:
+        with self._lock:
+            return sum(1 for m in self.managers.values() if m.connected)
+
+    def pending_repro_depth(self) -> int:
+        with self._lock:
+            return sum(len(m.pending_repros)
+                       for m in self.managers.values())
 
     def stats(self) -> dict:
         with self._lock:
             return {
                 "corpus": len(self.corpus_db.records),
+                "next_seq": self.next_seq,
+                "epoch": self.epoch,
+                "reaped": self.reaped_total,
+                "replays": self.replays_total,
+                "rejected": self.rejected_total,
+                "digest_skipped": self.digest_skipped_total,
+                "sync_saved_bytes": self.sync_saved_bytes,
+                "last_failover_ts": self.last_failover_ts,
                 "managers": {
                     n: {"connected": m.connected, "seq": m.last_seq,
                         "own": len(m.own_hashes),
-                        "pending_repros": len(m.pending_repros)}
+                        "pending_repros": len(m.pending_repros),
+                        "inflight": len(m.inflight),
+                        "breaker": m.breaker.state
+                        if m.breaker is not None else "closed"}
                     for n, m in self.managers.items()
                 },
             }

@@ -6,20 +6,48 @@ programs as candidates, and forwards crash repro programs both ways
 (reference: syz-manager/manager.go:1083-1227 hubSync; gated on the
 phase machine so hub inputs only arrive after the local corpus is
 triaged, manager.go:92-103).
+
+ISSUE 16 drives the exchange over the session discipline the fuzzers
+already use against the manager:
+
+  * Hub.Connect mints (epoch, lease) and the syncer arms
+    `call_session` with it — a retried Sync replays the hub's cached
+    reply instead of double-applying, and a ReconnectRequired verdict
+    (hub restart, reaped lease) runs `_connect` as the on_reconnect
+    hook: re-upload (idempotent, the hub dedups by hash) and resume.
+  * Each Sync carries a packed occupancy digest of this manager's
+    corpus signal (ops/signal.digest_from_folds at the hub's
+    advertised resolution) so the hub withholds programs predicted
+    already-known here.
+  * Sessioned replies ship program payloads in the frame annex as
+    (offset, len) refs — decoded here with zero-copy memoryview
+    slices; the legacy inline-strings shape still parses (old hubs).
+  * A `backoff_s` hint in a throttled reply (the hub's per-manager
+    circuit breaker is open) stretches this manager's next sync —
+    the degraded manager slows down alone instead of hammering.
 """
 
 from __future__ import annotations
 
+import base64
 import threading
-import time
 from typing import Optional
 
+import numpy as np
+
 from syzkaller_tpu.manager.mgrconfig import parse_addr
+from syzkaller_tpu.ops.signal import (digest_from_folds, fold_hash_np,
+                                      pack_plane)
 from syzkaller_tpu.rpc import RPCClient
 from syzkaller_tpu.rpc.types import RPCCandidate
 from syzkaller_tpu.utils import log
 
 SYNC_PERIOD_S = 60.0
+
+
+def _sig_elems(inp: dict) -> list:
+    sig = inp.get("signal")
+    return list(sig[0]) if sig else []
 
 
 class HubSyncer:
@@ -33,6 +61,9 @@ class HubSyncer:
         self._connected = False
         self._uploaded: set[str] = set()
         self._thread: Optional[threading.Thread] = None
+        self.digest_bits = 0  # advertised by the hub's Connect reply
+        self.backoff_s = 0.0  # hub throttle hint, added to the period
+        self.last_sync: dict = {}
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -41,7 +72,7 @@ class HubSyncer:
     def _loop(self) -> None:
         from syzkaller_tpu.manager.manager import PHASE_TRIAGED_CORPUS
 
-        while not self.mgr.stop_ev.wait(self.period_s):
+        while not self.mgr.stop_ev.wait(self.period_s + self.backoff_s):
             if self.mgr.phase < PHASE_TRIAGED_CORPUS:
                 continue
             try:
@@ -55,39 +86,77 @@ class HubSyncer:
                 "key": self.mgr.cfg.hub_key,
                 "manager": self.mgr.cfg.name}
 
+    def _connect(self) -> None:
+        """Connect (or re-Connect after ReconnectRequired): upload the
+        whole corpus — the hub dedups by hash, so this is idempotent —
+        and arm the client session with the minted epoch."""
+        with self.mgr.serv._lock:
+            items = dict(self.mgr.serv.corpus)
+        res = self.client.call_transient("Hub.Connect", {
+            **self._ident(), "fresh": self.fresh, "session": True,
+            "corpus": [inp["prog"] for inp in items.values()],
+            "corpus_sigs": [_sig_elems(inp) for inp in items.values()],
+        }) or {}
+        epoch = res.get("epoch")
+        if epoch:
+            self.client.set_session(epoch, on_reconnect=self._connect)
+            self.digest_bits = int(res.get("digest_bits") or 0)
+        self._uploaded = set(items)
+        self._connected = True
+
+    def _digest_b64(self, items: dict) -> Optional[str]:
+        if not self.digest_bits:
+            return None
+        elems: list = []
+        for inp in items.values():
+            elems.extend(_sig_elems(inp))
+        folds = fold_hash_np(np.asarray(elems, dtype=np.int64)
+                             .astype(np.uint32)) \
+            if elems else np.empty(0, np.int64)
+        digest = digest_from_folds(folds, self.digest_bits)
+        return base64.b64encode(pack_plane(digest)).decode()
+
     def sync_once(self) -> dict:
         from syzkaller_tpu.manager.manager import (PHASE_QUERIED_HUB,
                                                    PHASE_TRIAGED_HUB)
 
         if not self._connected:
-            with self.mgr.serv._lock:
-                items = dict(self.mgr.serv.corpus)
-            self.client.call_transient("Hub.Connect", {
-                **self._ident(), "fresh": self.fresh,
-                "corpus": [inp["prog"] for inp in items.values()],
-            })
-            self._uploaded = set(items)
-            self._connected = True
+            self._connect()
 
         # new local inputs since the last sync
         with self.mgr.serv._lock:
             items = dict(self.mgr.serv.corpus)
-        add = [inp["prog"] for h, inp in items.items()
-               if h not in self._uploaded]
+        new = {h: inp for h, inp in items.items()
+               if h not in self._uploaded}
+        add = [inp["prog"] for inp in new.values()]
+        add_sigs = [_sig_elems(inp) for inp in new.values()]
 
         # pending crash repro programs from the manager's repro
         # pipeline; acked only after a successful RPC so a failed
         # sync retransmits them
         repros = self.mgr.peek_hub_repros()
 
-        res = self.client.call_transient("Hub.Sync", {
-            **self._ident(), "need_repros": True,
-            "repros": repros, "add": add, "delete": [],
-        }) or {}
+        params = {**self._ident(), "need_repros": True,
+                  "repros": repros, "add": add,
+                  "add_sigs": add_sigs, "delete": []}
+        digest = self._digest_b64(items)
+        if digest is not None:
+            params["digest"] = digest
+            params["digest_bits"] = self.digest_bits
+        res, annex = self.client.call_session(
+            "Hub.Sync", params, want_annex=True)
+        res = res or {}
         self._uploaded |= set(items)
         self.mgr.ack_hub_repros(len(repros))
+        self.backoff_s = float(res.get("backoff_s") or 0.0)
+        if res.get("throttled"):
+            log.logf(0, "hub sync throttled; backoff %.1fs",
+                     self.backoff_s)
+            self.last_sync = {"sent": 0, "received": 0,
+                              "throttled": True}
+            return self.last_sync
 
-        progs = res.get("progs") or []
+        progs = self._decode_progs(res.get("progs") or [], annex)
         if progs:
             self.mgr.serv.add_candidates(
                 [RPCCandidate(prog=p, minimized=False) for p in progs])
@@ -103,4 +172,18 @@ class HubSyncer:
         if not progs and self.mgr.phase < PHASE_TRIAGED_HUB \
                 and self.mgr.serv.candidate_backlog() == 0:
             self.mgr.phase = PHASE_TRIAGED_HUB
-        return {"sent": len(add), "received": len(progs)}
+        self.last_sync = {"sent": len(add), "received": len(progs)}
+        return self.last_sync
+
+    @staticmethod
+    def _decode_progs(refs: list, annex) -> list[str]:
+        """Sessioned replies carry [[offset, len], ...] refs into the
+        frame annex; legacy hubs send inline strings.  Either way the
+        result is program text."""
+        if not refs:
+            return []
+        if isinstance(refs[0], (list, tuple)):
+            view = memoryview(annex or b"")
+            return [bytes(view[off:off + ln]).decode()
+                    for off, ln in refs]
+        return [str(p) for p in refs]

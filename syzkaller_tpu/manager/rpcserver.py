@@ -47,6 +47,7 @@ from typing import Callable, Optional
 from syzkaller_tpu import telemetry
 from syzkaller_tpu.health.envsafe import env_float, env_int
 from syzkaller_tpu.health.faultinject import FaultInjected, fault_point
+from syzkaller_tpu.rpc.replycache import ReplyCache
 from syzkaller_tpu.rpc.rpc import ReconnectRequired
 from syzkaller_tpu.rpc.types import RPCCandidate, RPCInput
 from syzkaller_tpu.signal import Signal
@@ -112,7 +113,7 @@ class FuzzerState:
     # Session/lease bookkeeping (sessioned fuzzers only; all zero for
     # legacy unsessioned callers).
     last_seen: float = 0.0  # manager clock at the last call
-    reply_cache: dict[int, dict] = field(default_factory=dict)
+    reply_cache: ReplyCache = field(default_factory=ReplyCache)
     inflight: list[tuple[int, list[dict]]] = field(default_factory=list)
     owned: list[dict] = field(default_factory=list)
     device_state: str = "closed"
@@ -171,7 +172,7 @@ class ManagerRPC:
         self._throttle_state = "closed"
         # Reply caches of reaped fuzzers, so late retries of applied
         # seqs still replay (name -> reply_cache), insertion-ordered.
-        self._tombstones: dict[str, dict[int, dict]] = {}
+        self._tombstones: dict[str, ReplyCache] = {}
         # Fleet-merge monotonicity (ISSUE 14): per-fuzzer counter
         # high-water marks plus a retired accumulator, so a restarted
         # fuzzer resetting its process-local counters (or a reaped
@@ -239,18 +240,20 @@ class ManagerRPC:
             f = self.fuzzers.get(name)
             if f is None:
                 cache = self._tombstones.get(name)
-                if cache is not None and seq in cache:
+                cached = cache.get(seq) if cache is not None else None
+                if cached is not None:
                     _M_REPLAYS.inc()
                     self.replays_total += 1
-                    return cache[seq]
+                    return cached
                 _M_STALE.inc()
                 raise ReconnectRequired(
                     f"lease for {name!r} expired; re-Connect")
             f.last_seen = self._clock()
-            if seq in f.reply_cache:
+            cached = f.reply_cache.get(seq)
+            if cached is not None:
                 _M_REPLAYS.inc()
                 self.replays_total += 1
-                return f.reply_cache[seq]
+                return cached
         return None
 
     def _session_commit(self, params: dict, reply: dict) -> dict:
@@ -265,9 +268,9 @@ class ManagerRPC:
         with self._lock:
             f = self.fuzzers.get(name)
             if f is not None:
-                f.reply_cache[seq] = reply
-                while len(f.reply_cache) > self.reply_cache_size:
-                    del f.reply_cache[min(f.reply_cache)]
+                # Entry + byte bounds live inside ReplyCache
+                # (TZ_RPC_REPLY_CACHE / TZ_RPC_REPLY_CACHE_MB).
+                f.reply_cache.put(seq, reply)
         fault_point("rpc.reply_cache")
         return reply
 
@@ -456,7 +459,9 @@ class ManagerRPC:
                 self._requeue_candidates_locked(old)
                 self._journal("cand_requeue", {"name": name})
             self._tombstones.pop(name, None)
-            f = FuzzerState(name=name, last_seen=self._clock())
+            f = FuzzerState(
+                name=name, last_seen=self._clock(),
+                reply_cache=ReplyCache(entries=self.reply_cache_size))
             self.fuzzers[name] = f
             elems, prios = self.max_signal.serialize()
             return {
@@ -547,7 +552,9 @@ class ManagerRPC:
         with self._lock:
             f = self.fuzzers.get(name)
             if f is None:  # legacy fuzzer restarted without Connect
-                f = FuzzerState(name=name, last_seen=self._clock())
+                f = FuzzerState(
+                    name=name, last_seen=self._clock(),
+                    reply_cache=ReplyCache(entries=self.reply_cache_size))
                 self.fuzzers[name] = f
             if telemetry_snap:
                 f.telemetry = telemetry_snap

@@ -175,6 +175,69 @@ def unpack_plane(blob: bytes, size: int):
     return unpack_section(blob, size)
 
 
+#: Default resolution (log2 buckets) of the hub novelty digest: a
+#: 2^16-bucket uint8 digest packs to a few KB per Sync while still
+#: splitting the 2^26 plane 1024-ways — enough selectivity to withhold
+#: most already-known programs from a sync reply (hub/state.py).
+DIGEST_BITS_DEFAULT = 16
+
+
+def resolve_digest_bits() -> int:
+    """TZ_HUB_DIGEST_BITS (envsafe) clamped to 8..FOLD_BITS."""
+    from syzkaller_tpu.health.envsafe import env_int
+
+    bits = env_int("TZ_HUB_DIGEST_BITS", DIGEST_BITS_DEFAULT)
+    return min(max(int(bits), 8), FOLD_BITS)
+
+
+def digest_fold(folds, bits: int) -> np.ndarray:
+    """Plane bucket index -> digest bucket index: the digest bucket is
+    the TOP `bits` of the FOLD_BITS fold, so a digest built from the
+    dense plane (digest_plane) and one built from a fold list
+    (digest_from_folds) agree bucket-for-bucket."""
+    return np.asarray(folds, dtype=np.int64) >> (FOLD_BITS - bits)
+
+
+def digest_plane(plane_np: np.ndarray, bits: int) -> np.ndarray:
+    """Export a uint8 occupancy digest (2^bits buckets) of a dense
+    2^FOLD_BITS plane: bucket b is 1 iff any plane bucket whose fold
+    index has top bits b is occupied.  Host-only numpy (one reshape +
+    max reduction) — never jitted; the federation index rides the
+    same plane the device merges into, at sync-sized resolution."""
+    plane = np.asarray(plane_np)
+    group = plane.size >> bits
+    if group <= 0 or plane.size != (group << bits):
+        raise ValueError(
+            f"plane size {plane.size} not divisible into 2^{bits} "
+            "digest buckets")
+    return (plane.reshape(1 << bits, group).max(axis=1) > 0) \
+        .astype(np.uint8)
+
+
+def digest_from_folds(folds, bits: int) -> np.ndarray:
+    """Digest from a sparse fold list (a manager's known signal as
+    folded edge hashes) — the hub-client export path."""
+    d = np.zeros(1 << bits, np.uint8)
+    f = np.asarray(folds, dtype=np.int64)
+    if f.size:
+        d[digest_fold(f, bits)] = 1
+    return d
+
+
+def digest_covers(digest: np.ndarray, folds) -> bool:
+    """True when every fold's digest bucket is already occupied — the
+    program is predicted-known to the digest's owner, so the hub can
+    withhold it from the sync reply.  An empty fold list is never
+    covered (no signal info -> always ship); fold collisions make
+    this a false-positive-prone predicate by design, trading a rare
+    withheld-but-novel program for the sync bytes saved."""
+    f = np.asarray(folds, dtype=np.int64)
+    if f.size == 0:
+        return False
+    bits = int(np.asarray(digest).size).bit_length() - 1
+    return bool(np.all(np.asarray(digest)[digest_fold(f, bits)] != 0))
+
+
 def hash_rows(rows):
     """FNV-1a over each packed delta row's bytes: uint8[B, row_bytes]
     -> uint32[B].  Runs inside the fused step jit, so the loop over

@@ -1,4 +1,6 @@
+from syzkaller_tpu.rpc.replycache import ReplyCache
 from syzkaller_tpu.rpc.rpc import (ReconnectRequired, RPCClient,
                                    RPCError, RPCServer)
 
-__all__ = ["RPCClient", "RPCServer", "RPCError", "ReconnectRequired"]
+__all__ = ["RPCClient", "RPCServer", "RPCError", "ReconnectRequired",
+           "ReplyCache"]

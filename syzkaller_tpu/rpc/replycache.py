@@ -1,0 +1,133 @@
+"""Byte-and-entry-bounded reply cache for idempotent RPC sessions.
+
+PR 8 gave every session peer (manager fuzzers, serve tenants, hub
+managers) a per-name reply cache so a retried `(epoch, seq)` replays
+instead of double-applying.  The original bound was entry-count only —
+fine for the manager's small JSON replies, but the serving plane and
+the hub cache `(reply, annex)` tuples whose annex tails are arena
+slices: 128 entries of multi-MB annexes pin hundreds of MB of arena
+memory alive long after the tenant acked them (the ROADMAP's first
+`_FLAG_ANNEX` caveat).  This cache bounds both dimensions:
+
+  * TZ_RPC_REPLY_CACHE     — max entries (default 128), as before,
+  * TZ_RPC_REPLY_CACHE_MB  — max approximate bytes across cached
+    replies + annexes (default 64 MB),
+
+evicting oldest-seq first.  The newest entry is NEVER evicted even if
+it alone exceeds the byte cap: dropping the reply that the in-flight
+retry may be about to ask for would break at-most-once and re-apply
+the mutation — a correctness bug traded for a transient memory spike.
+
+Sizes are estimates (exact for bytes-likes, JSON-shaped guess for the
+reply dict) — the bound exists to stop arena pinning, not to account
+bytes to the byte.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health.envsafe import env_float, env_int
+
+_M_EVICTED_BYTES = telemetry.counter(
+    "tz_rpc_reply_cache_evicted_bytes_total",
+    "approximate bytes freed by reply-cache eviction (entry or byte "
+    "bound) — annex payloads pinned by cached replies are released "
+    "here")
+
+
+def approx_size(obj: Any) -> int:
+    """Cheap recursive wire-size estimate of a cached reply: exact for
+    bytes-likes (the annex tails this bound exists for), JSON-shaped
+    for containers/scalars.  Never raises on odd types — an unknown
+    object just costs a flat guess."""
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return len(obj)
+    if isinstance(obj, str):
+        return len(obj) + 2
+    if obj is None or isinstance(obj, bool):
+        return 4
+    if isinstance(obj, (int, float)):
+        return 8
+    if isinstance(obj, dict):
+        return 2 + sum(approx_size(k) + approx_size(v) + 2
+                       for k, v in obj.items())
+    if isinstance(obj, (list, tuple)):
+        return 2 + sum(approx_size(v) + 1 for v in obj)
+    return 16
+
+
+class ReplyCache:
+    """seq -> cached reply (any JSON-able value, or a (reply, annex)
+    tuple on annex-carrying services), bounded by entries AND bytes."""
+
+    def __init__(self, entries: Optional[int] = None,
+                 max_mb: Optional[float] = None):
+        self.max_entries = max(1, env_int("TZ_RPC_REPLY_CACHE", 128)
+                               if entries is None else int(entries))
+        mb = env_float("TZ_RPC_REPLY_CACHE_MB", 64.0) \
+            if max_mb is None else float(max_mb)
+        self.max_bytes = max(1, int(mb * (1 << 20)))
+        self._lock = threading.Lock()
+        self._items: dict[int, tuple[Any, int]] = {}
+        self.bytes = 0
+        self.evicted_bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, seq: int) -> bool:
+        with self._lock:
+            return seq in self._items
+
+    def __iter__(self):
+        with self._lock:
+            return iter(sorted(self._items))
+
+    def __getitem__(self, seq: int) -> Any:
+        with self._lock:
+            return self._items[seq][0]
+
+    def __eq__(self, other: Any) -> bool:
+        """Equality against a plain {seq: reply} dict — the shape the
+        session planes used before the byte bound existed; keeps the
+        dict-era assertions meaningful."""
+        if isinstance(other, dict):
+            with self._lock:
+                return {k: v[0] for k, v in self._items.items()} == other
+        return NotImplemented
+
+    __hash__ = None  # mutable container
+
+    def get(self, seq: int) -> Any:
+        """The cached reply for seq, or None (replies are dicts/tuples
+        by protocol, never None, so the sentinel is unambiguous)."""
+        with self._lock:
+            item = self._items.get(seq)
+            return item[0] if item is not None else None
+
+    def put(self, seq: int, value: Any) -> None:
+        size = approx_size(value)
+        with self._lock:
+            old = self._items.pop(seq, None)
+            if old is not None:
+                self.bytes -= old[1]
+            self._items[seq] = (value, size)
+            self.bytes += size
+            while len(self._items) > 1 and (
+                    len(self._items) > self.max_entries
+                    or self.bytes > self.max_bytes):
+                oldest = min(self._items)
+                if oldest == seq:
+                    break  # never evict the just-cached reply
+                _val, osize = self._items.pop(oldest)
+                self.bytes -= osize
+                self.evicted_bytes += osize
+                _M_EVICTED_BYTES.inc(osize)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._items), "bytes": self.bytes,
+                    "evicted_bytes": self.evicted_bytes}

@@ -174,13 +174,18 @@ def _recv_frame(sock: socket.socket, want_annex: bool = False) -> Any:
             if annex_len > _MAX_FRAME:
                 raise RPCError(f"oversized annex ({annex_len} bytes)")
         data = _recv_exact(sock, length)
-        if flags & _FLAG_ZLIB:
-            data = zlib.decompress(data)
         # The annex is drained even when the caller did not ask for
         # it — it belongs to this frame and must not bleed into the
-        # next one's header.
+        # next one's header.  Drained BEFORE the payload is decoded:
+        # a zlib/json failure below leaves this socket at an exact
+        # frame boundary, so a pooled client connection (RPCClient
+        # only tears the socket down on ConnectionError/OSError) can
+        # carry the next call instead of reading annex bytes as a
+        # frame header (the ROADMAP's drain-on-error annex caveat).
         if annex_len:
             annex = _recv_exact(sock, annex_len)
+        if flags & _FLAG_ZLIB:
+            data = zlib.decompress(data)
     # Park the decoded context (None clears a stale one) so the
     # dispatched method on this thread can continue the chain.
     lineage.set_current(ctx)
@@ -255,7 +260,8 @@ class RPCServer:
             # transient call finishing, a fuzzer VM restarting) —
             # counted but not timeline-worthy.
             _M_CONN_DROPPED.inc()
-        except (ConnectionError, OSError, json.JSONDecodeError) as e:
+        except (ConnectionError, OSError, json.JSONDecodeError,
+                zlib.error) as e:
             _M_CONN_ERRORS.inc()
             telemetry.record_event(
                 "rpc.conn_drop", f"{type(e).__name__}: {e}")

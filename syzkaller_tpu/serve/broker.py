@@ -50,6 +50,7 @@ from typing import Callable, Optional
 
 from syzkaller_tpu import telemetry
 from syzkaller_tpu.health.envsafe import env_float, env_int
+from syzkaller_tpu.rpc.replycache import ReplyCache
 from syzkaller_tpu.rpc.rpc import ReconnectRequired
 from syzkaller_tpu.utils import log
 
@@ -97,10 +98,14 @@ class TenantState:
                  "rows_spent", "delivered", "q_gauge", "c_gauge",
                  "m_rows", "m_results")
 
-    def __init__(self, name: str, now: float):
+    def __init__(self, name: str, now: float,
+                 cache_entries: Optional[int] = None):
         self.name = name
         self.last_seen = now
-        self.reply_cache: dict[int, tuple] = {}
+        #: (reply, annex) tuples; bounded by entries AND bytes — the
+        #: annex tails are arena slices a cached reply pins alive
+        #: (rpc/replycache.py).
+        self.reply_cache = ReplyCache(entries=cache_entries)
         #: Undelivered results: (rid, payload) with payload a
         #: bytes-like (zero-copy arena view on the device path).
         self.pending: deque = deque()
@@ -165,7 +170,7 @@ class ServePlane:
         self.throttle_fn = throttle_fn
         self._clock = clock
         self.tenants: dict[str, TenantState] = {}
-        self._tombstones: dict[str, dict[int, tuple]] = {}
+        self._tombstones: dict[str, ReplyCache] = {}
         self._rid = 0
         self.reaped_total = 0
         self.replays_total = 0
@@ -210,10 +215,11 @@ class ServePlane:
                 raise ReconnectRequired(
                     f"serve lease for {name!r} expired; re-Connect")
             t.last_seen = self._clock()
-            if seq in t.reply_cache:
+            cached = t.reply_cache.get(seq)
+            if cached is not None:
                 _M_REPLAYS.inc()
                 self.replays_total += 1
-                return t.reply_cache[seq]
+                return cached
         return None
 
     def _session_commit(self, params: dict, reply: tuple) -> tuple:
@@ -224,9 +230,11 @@ class ServePlane:
         with self._lock:
             t = self.tenants.get(name)
             if t is not None:
-                t.reply_cache[seq] = reply
-                while len(t.reply_cache) > self.reply_cache_size:
-                    del t.reply_cache[min(t.reply_cache)]
+                # Entry + byte bounds live inside ReplyCache
+                # (TZ_RPC_REPLY_CACHE / TZ_RPC_REPLY_CACHE_MB) — the
+                # byte bound matters most HERE, where cached annex
+                # tails pin arena slices.
+                t.reply_cache.put(seq, reply)
         return reply
 
     def _reap_locked(self) -> None:
@@ -296,7 +304,8 @@ class ServePlane:
                     f"serve admission: {self.max_tenants} tenants "
                     "already hold leases (TZ_SERVE_MAX_TENANTS)")
             now = self._clock()
-            t = TenantState(name=name, now=now)
+            t = TenantState(name=name, now=now,
+                            cache_entries=self.reply_cache_size)
             if old is not None:
                 self._settle_locked(old, 1 << 62, 0)
                 t.pending = old.pending
@@ -332,7 +341,8 @@ class ServePlane:
         with self._lock:
             t = self.tenants.get(name)
             if t is None:  # legacy unsessioned caller
-                t = TenantState(name=name, now=self._clock())
+                t = TenantState(name=name, now=self._clock(),
+                                cache_entries=self.reply_cache_size)
                 self.tenants[name] = t
                 _G_TENANTS.set(len(self.tenants))
             if seq:
@@ -478,7 +488,8 @@ class ServePlane:
             for name, st in (state.get("tenants") or {}).items():
                 t = self.tenants.get(name)
                 if t is None:
-                    t = TenantState(name=name, now=now)
+                    t = TenantState(name=name, now=now,
+                                    cache_entries=self.reply_cache_size)
                     t.last_seen = 0.0
                     self.tenants[name] = t
                 t.pending = deque(

@@ -65,6 +65,13 @@ class ServeTenant:
             params["max_results"] = int(max_results)
         reply, annex = self.client.call_session(
             "Serve.Poll", params, want_annex=True)
+        # Annex-safety audit (ISSUE 16 S1): by the time this returns,
+        # the transport has already drained the ENTIRE annex off the
+        # socket — rpc._recv_frame reads header, payload, and annex
+        # before any decompress/decode can raise — so a malformed ref
+        # below (or a raise in this loop) can never leave the pooled
+        # connection mid-frame.  App-level decode errors here are
+        # therefore safe to propagate without closing the socket.
         self.credit = reply.get("credit", self.credit)
         self.quota = reply.get("quota", self.quota)
         view = memoryview(annex) if annex else memoryview(b"")

@@ -387,6 +387,35 @@ def wedge_report(snap: dict) -> list[str]:
             line += (f" ({int(demotes)} demotions, "
                      f"{int(repromotes)} re-admissions)")
         lines.append(line)
+    # Hub federation health (ISSUE 16): live vs reaped manager
+    # sessions, the bytes digest-diff sync kept off the wire, each
+    # manager's sync breaker, and the last leader-failover age — a
+    # flapping manager shows as e.g. "mB:open" while the rest of the
+    # pod keeps exchanging, and a recent failover timestamp says the
+    # hub you are watching is a warm-restarted successor.
+    hub_live = gauges.get("tz_hub_managers_size") or 0
+    hub_reaped = counters.get("tz_hub_leases_reaped_total") or 0
+    hub_saved = counters.get("tz_hub_sync_saved_bytes_total") or 0
+    hub_failover = gauges.get("tz_hub_last_failover_ts") or 0
+    if hub_live or hub_reaped or hub_saved or hub_failover:
+        line = (f"hub: {int(hub_live)} managers live / "
+                f"{int(hub_reaped)} reaped")
+        if hub_saved:
+            line += f", sync saved {hub_saved / 1024:.1f} KiB"
+        hub_states = {}
+        for k, v in gauges.items():
+            if k.startswith('tz_hub_breaker_state{'):
+                mgr = k.split('manager="', 1)[1].rstrip('"}')
+                hub_states[mgr] = {0: "closed", 1: "half_open",
+                                   2: "open"}.get(int(v), "?")
+        if hub_states:
+            line += ", breakers " + " ".join(
+                f"{m}:{st}" for m, st in sorted(hub_states.items()))
+        if hub_failover:
+            age = max(0.0, (snap.get("ts") or time.time())
+                      - hub_failover)
+            line += f", last failover {age:.0f}s ago"
+        lines.append(line)
     attr = {}
     for k, v in counters.items():
         if k.startswith('tz_coverage_novel_edges_total{') and v:

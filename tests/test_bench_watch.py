@@ -413,3 +413,30 @@ def test_report_telemetry_reads_dump(tmp_path, monkeypatch, capsys):
     # a missing snapshot degrades to a note, never a crash
     bw.report_telemetry(str(tmp_path / "absent.json"))
     assert "no telemetry snapshot" in capsys.readouterr().out
+
+
+def test_wedge_report_hub_federation_line():
+    """The hub federation line (ISSUE 16): live vs reaped manager
+    sessions, digest-diff byte savings, per-manager sync breakers,
+    and the last leader-failover age render so a flapping manager or
+    a warm-restarted hub is visible from the bench watch."""
+    import time as _time
+
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    reg.gauge("tz_hub_managers_size").set(3)
+    reg.counter("tz_hub_leases_reaped_total").inc(1)
+    reg.counter("tz_hub_sync_saved_bytes_total").inc(2048)
+    reg.gauge("tz_hub_breaker_state", labels={"manager": "mA"}).set(0)
+    reg.gauge("tz_hub_breaker_state", labels={"manager": "mB"}).set(2)
+    reg.gauge("tz_hub_last_failover_ts").set(_time.time() - 42)
+    lines = bw.wedge_report(reg.snapshot())
+    line = next(ln for ln in lines if ln.startswith("hub:"))
+    assert "3 managers live / 1 reaped" in line
+    assert "sync saved 2.0 KiB" in line
+    assert "breakers mA:closed mB:open" in line
+    assert "last failover 42s ago" in line
+    # a snapshot without hub signals renders no line
+    assert not any(ln.startswith("hub:")
+                   for ln in bw.wedge_report(_wedge_snapshot()))

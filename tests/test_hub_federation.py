@@ -1,0 +1,562 @@
+"""Partition-tolerant hub federation tests (ISSUE 16).
+
+Covers the session plane (replay, stale-epoch/lease verdicts, custody
+rollback), the plane-indexed novelty diff (counter-asserted byte
+reduction), cold-open edge cases (torn db tail, stale manager dirs,
+ParseError quarantine), warm leader failover over the durable store,
+annex-safe transport regressions, the byte-bounded reply cache, and
+the scripted SIGKILL-mid-Sync + same-port-restart chaos drill.
+
+All tests are host-only: direct receiver calls where the wire adds
+nothing, raw sockets where the wire IS the subject, and one
+subprocess drill (slow-marked, like the manager's) where process
+death is the subject.
+"""
+
+import collections
+import os
+import signal as _signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.durable.store import DurableStore
+from syzkaller_tpu.hub.hub import Hub, serve_hub
+from syzkaller_tpu.hub.state import HubState
+from syzkaller_tpu.ops.signal import digest_from_folds, fold_hash_np
+from syzkaller_tpu.rpc import RPCClient
+from syzkaller_tpu.rpc.replycache import ReplyCache, approx_size
+from syzkaller_tpu.rpc.rpc import (ReconnectRequired, _recv_frame,
+                                   _send_frame)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- reply cache (S2) ----------------------------------------------------
+
+
+def test_reply_cache_byte_bound():
+    """The cache evicts oldest-seq once the byte bound is crossed,
+    counts the freed bytes, and never evicts the newest entry."""
+    # each ({"progs": []}, 50B blob) entry approx_sizes to 67 bytes:
+    # two fit under 150, the third forces an oldest-first eviction
+    cache = ReplyCache(entries=100, max_mb=150.0 / (1 << 20))
+    blob = b"x" * 50
+    cache.put(1, ({"progs": []}, blob))
+    cache.put(2, ({"progs": []}, blob))
+    assert len(cache) == 2
+    cache.put(3, ({"progs": []}, blob))
+    assert cache.get(1) is None
+    assert cache.get(2) is not None and cache.get(3) is not None
+    assert cache.evicted_bytes >= approx_size(({"progs": []}, blob))
+    # the just-cached reply survives even when it alone busts the cap:
+    # dropping it would break at-most-once for its in-flight retry
+    cache.put(4, ({"progs": []}, b"y" * 4096))
+    assert cache.get(4) is not None
+    assert cache.get(2) is None and cache.get(3) is None
+    snap = cache.snapshot()
+    assert snap["entries"] == 1 and snap["evicted_bytes"] > 0
+
+
+def test_reply_cache_entry_bound_still_holds():
+    cache = ReplyCache(entries=3, max_mb=64.0)
+    for seq in range(1, 6):
+        cache.put(seq, {"seq": seq})
+    assert sorted(cache) == [3, 4, 5]
+    assert cache == {3: {"seq": 3}, 4: {"seq": 4}, 5: {"seq": 5}}
+
+
+# -- sessioned sync: replay + verdicts -----------------------------------
+
+
+def _ident(name):
+    # empty client -> canonical name is just the manager name
+    return {"client": "", "key": "", "manager": name}
+
+
+def _connect(hub, name, corpus=(), sigs=None, fresh=True):
+    return hub.Connect({**_ident(name), "session": True, "fresh": fresh,
+                        "corpus": list(corpus), "corpus_sigs": sigs})
+
+
+def test_sessioned_sync_replays_from_cache(tmp_path):
+    """A duplicate (epoch, seq) Sync replays the cached (reply, annex)
+    byte-for-byte and re-applies nothing."""
+    hub = Hub(HubState(str(tmp_path / "hub"), lease_s=3600.0))
+    _connect(hub, "mA", ["a1()", "a2()"])
+    res = _connect(hub, "mB", [])
+    epoch = res["epoch"]
+    params = {**_ident("mB"), "epoch": epoch, "seq": 1, "ack_seq": 0,
+              "add": ["b1()"], "add_sigs": [], "delete": [],
+              "repros": [], "need_repros": True}
+    reply1, annex1 = hub.Sync(dict(params))
+    assert [bytes(memoryview(annex1)[o:o + ln]).decode()
+            for o, ln in reply1["progs"]] == ["a1()", "a2()"]
+    seq_after = hub.state.next_seq
+    reply2, annex2 = hub.Sync(dict(params))  # the retry
+    assert reply2 == reply1 and annex2 == annex1
+    assert hub.state.next_seq == seq_after  # b1() not re-added
+    assert hub.state.replays_total == 1
+
+
+def test_stale_epoch_and_reaped_lease_verdicts(tmp_path):
+    clock = [1000.0]
+    st = HubState(str(tmp_path / "hub"), lease_s=5.0,
+                  clock=lambda: clock[0])
+    hub = Hub(st)
+    epoch = _connect(hub, "mA", ["a()"])["epoch"]
+    with pytest.raises(ReconnectRequired, match="stale"):
+        hub.Sync({**_ident("mA"), "epoch": "deadbeef", "seq": 1,
+                  "ack_seq": 0})
+    clock[0] += 60.0  # idle past the lease
+    with pytest.raises(ReconnectRequired, match="expired"):
+        hub.Sync({**_ident("mA"), "epoch": epoch, "seq": 1,
+                  "ack_seq": 0})
+    assert st.reaped_total == 1
+    # the ManagerState survived the reap — only the session died
+    assert "mA" in st.managers and not st.managers["mA"].connected
+    # re-Connect re-uploads the same corpus: zero duplicate adds
+    seq_before = st.next_seq
+    _connect(hub, "mA", ["a()"], fresh=False)
+    assert st.next_seq == seq_before
+
+
+def test_custody_rollback_redelivers_exactly(tmp_path):
+    """An un-acked sync reply rolls the cursor back to the batch start
+    and requeues its repros; an acked one retires.  Redelivery is by
+    re-scan, so nothing is lost and nothing double-delivered."""
+    st = HubState(str(tmp_path / "hub"), lease_s=3600.0)
+    st.connect("mA", True, [b"a1()", b"a2()"])
+    st.connect("mB", True, [])
+    st.sync("mA", [], [], [b"crash()"], False)
+    progs, repros, _ = st.sync("mB", [], [], [], True, rseq=1,
+                               ack_seq=0)
+    assert sorted(progs) == [b"a1()", b"a2()"]
+    assert repros == [b"crash()"]
+    # seq 2 abandoned seq 1 (ack still 0): same batch redelivered
+    progs2, repros2, _ = st.sync("mB", [], [], [], True, rseq=3,
+                                 ack_seq=0)
+    assert sorted(progs2) == [b"a1()", b"a2()"]
+    assert repros2 == [b"crash()"]
+    # acking seq 3 retires it: nothing left to deliver
+    progs3, repros3, _ = st.sync("mB", [], [], [], True, rseq=4,
+                                 ack_seq=3)
+    assert progs3 == [] and repros3 == []
+    assert st.managers["mB"].last_seq == st.next_seq - 1
+
+
+def test_breaker_throttles_single_manager(tmp_path):
+    """An open breaker degrades one manager to backoff-hint replies;
+    the rest of the pod keeps syncing."""
+    hub = Hub(HubState(str(tmp_path / "hub"), lease_s=3600.0))
+    _connect(hub, "mA", ["a()"])
+    epoch = _connect(hub, "mB", [])["epoch"]
+    for _ in range(4):
+        hub.state.record_sync_result("mB", ok=False)
+    assert hub.state.managers["mB"].breaker.state == "open"
+    reply, annex = hub.Sync({**_ident("mB"), "epoch": epoch, "seq": 1,
+                             "ack_seq": 0})
+    assert reply["throttled"] and reply["backoff_s"] > 0
+    assert reply["progs"] == [] and annex is None
+    # the throttle reply is cached too: its retry replays
+    reply2, _ = hub.Sync({**_ident("mB"), "epoch": epoch, "seq": 1,
+                          "ack_seq": 0})
+    assert reply2 == reply
+    # mA is unaffected
+    epoch_a = hub.state.epoch
+    replyA, _ = hub.Sync({**_ident("mA"), "epoch": epoch_a, "seq": 1,
+                          "ack_seq": 0})
+    assert "throttled" not in replyA
+
+
+# -- plane-indexed novelty diffs -----------------------------------------
+
+
+def test_digest_diff_reduces_reply_bytes(tmp_path):
+    """Counter-asserted: a sync presenting a digest that covers mA's
+    signal receives fewer bytes, tz_hub_sync_saved_bytes_total grows
+    by exactly the withheld payload, and a program with no stored
+    folds always ships."""
+    from syzkaller_tpu.hub import state as hub_state
+
+    st = HubState(str(tmp_path / "hub"), lease_s=3600.0)
+    known_sig = [11, 22, 33]
+    st.connect("mA", True, [b"known_prog()", b"unsigned_prog()"],
+               sigs=[known_sig, None])
+    st.connect("mB", True, [])
+    folds = fold_hash_np(np.asarray(known_sig, dtype=np.int64)
+                         .astype(np.uint32))
+    digest = digest_from_folds(folds, st.digest_bits)
+    before = hub_state._M_SAVED_BYTES.value
+    progs, _, _ = st.sync("mB", [], [], [], False, digest=digest)
+    # known_prog withheld (digest covers its folds); unsigned_prog has
+    # no stored folds -> never withheld
+    assert progs == [b"unsigned_prog()"]
+    assert st.digest_skipped_total == 1
+    assert st.sync_saved_bytes == len(b"known_prog()")
+    assert hub_state._M_SAVED_BYTES.value - before \
+        == len(b"known_prog()")
+    # the withheld program's seq was consumed: no redelivery later
+    progs2, _, _ = st.sync("mB", [], [], [], False)
+    assert progs2 == []
+
+
+def test_digest_without_coverage_ships_everything(tmp_path):
+    st = HubState(str(tmp_path / "hub"), lease_s=3600.0)
+    st.connect("mA", True, [b"p()"], sigs=[[77]])
+    st.connect("mB", True, [])
+    empty = digest_from_folds(np.empty(0, np.int64), st.digest_bits)
+    progs, _, _ = st.sync("mB", [], [], [], False, digest=empty)
+    assert progs == [b"p()"]
+
+
+# -- cold-open edge cases (S4) -------------------------------------------
+
+
+def test_cold_open_torn_corpus_tail(tmp_path):
+    wd = str(tmp_path / "hub")
+    st = HubState(wd)
+    st.connect("mA", True, [b"a1()", b"a2()"])
+    next_seq = st.next_seq
+    with open(os.path.join(wd, "corpus.db"), "ab") as f:
+        f.write(b"\x13torn-half-record\xff")
+    st2 = HubState(wd)
+    assert len(st2.corpus_db.records) == 2
+    assert st2.next_seq == next_seq
+    # new adds still get fresh, unique seqs
+    st2.connect("mB", True, [b"b1()"])
+    seqs = [rec.seq for rec in st2.corpus_db.records.values()]
+    assert len(set(seqs)) == 3
+
+
+def test_cold_open_stale_manager_dirs(tmp_path):
+    wd = str(tmp_path / "hub")
+    os.makedirs(wd, exist_ok=True)
+    # a manager dir with cursor files but no own corpus.db: the cursor
+    # survives, ownership rebuilds on re-upload
+    ghost = os.path.join(wd, "manager-" + "0" * 16)
+    os.makedirs(ghost)
+    open(os.path.join(ghost, "name"), "w").write("ghost")
+    open(os.path.join(ghost, "seq"), "w").write("7")
+    # a torn dir (no name) and a garbled seq: both skipped, not fatal
+    torn = os.path.join(wd, "manager-" + "1" * 16)
+    os.makedirs(torn)
+    bad = os.path.join(wd, "manager-" + "2" * 16)
+    os.makedirs(bad)
+    open(os.path.join(bad, "name"), "w").write("bad")
+    open(os.path.join(bad, "seq"), "w").write("not-a-number")
+    st = HubState(wd)
+    assert st.managers["ghost"].last_seq == 7
+    assert st.managers["ghost"].own_hashes == set()
+    assert "bad" not in st.managers and len(st.managers) == 1
+
+
+def test_parse_errors_counted_and_skipped(tmp_path, test_target):
+    """A corrupt upload is counted and refused; the seq index never
+    advances for it, so other managers' cursors are not poisoned."""
+    from syzkaller_tpu.models.encoding import serialize_prog
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.rand import RandGen
+
+    text = serialize_prog(
+        generate_prog(test_target, RandGen(test_target, 5), 3))
+    st = HubState(str(tmp_path / "hub"), target=test_target)
+    st.connect("mA", True, [text, b"garbage(((", b"nope)"])
+    assert st.rejected_total == 2
+    assert len(st.corpus_db.records) == 1
+    assert st.next_seq == 2
+    st.connect("mB", True, [])
+    progs, _, _ = st.sync("mB", [], [], [], False)
+    assert progs == [text]
+
+
+# -- warm leader failover (in-process) -----------------------------------
+
+
+def test_warm_failover_redelivers_unacked_only(tmp_path):
+    """Kill the hub (by abandoning it un-closed) with one acked and
+    one un-acked sync batch outstanding: the successor redelivers
+    exactly the un-acked batch, with zero duplicate corpus adds."""
+    wd, dd = str(tmp_path / "hub"), str(tmp_path / "dur")
+    store = DurableStore(dd, interval_s=3600.0)
+    st = HubState(wd, durable=store)
+    st.connect("mA", True, [b"a1()", b"a2()"])
+    st.connect("mB", True, [])
+    st.sync("mA", [], [], [b"crash()"], False)
+    # batch 1: delivered AND acked (by batch 2's ack_seq)
+    progs, _, _ = st.sync("mB", [], [], [], False, rseq=1, ack_seq=0)
+    assert sorted(progs) == [b"a1()", b"a2()"]
+    st.sync("mA", [b"a3()"], [], [], False)
+    # batch 2: delivered, never acked — dies with the leader
+    progs2, repros2, _ = st.sync("mB", [], [], [], True, rseq=2,
+                                 ack_seq=1)
+    assert progs2 == [b"a3()"] and repros2 == [b"crash()"]
+    next_seq = st.next_seq
+    acked_cursor = 2  # a1,a2 confirmed by ack_seq=1
+
+    # SIGKILL-equivalent: no close(), no final checkpoint — the WAL is
+    # the only survivor.  The successor opens the same dirs.
+    store2 = DurableStore(dd, interval_s=3600.0)
+    assert store2.recovered is not None and "hub" in store2.recovered
+    st2 = HubState(wd, durable=store2)
+    assert st2.last_failover_ts > 0
+    assert st2.next_seq == next_seq  # zero lost, zero re-added
+    # cursor monotonic vs acked progress, rolled back past un-acked
+    assert acked_cursor <= st2.managers["mB"].last_seq < next_seq - 1
+    # the successor redelivers exactly batch 2 (session re-mint means
+    # the manager re-Connects first, as it would through RPC)
+    st2.connect("mB", False, [])
+    progs3, repros3, _ = st2.sync("mB", [], [], [], True, rseq=1,
+                                  ack_seq=0)
+    assert progs3 == [b"a3()"] and repros3 == [b"crash()"]
+    store2.close(final_checkpoint=False)
+
+
+# -- annex-safe transport (S1) -------------------------------------------
+
+
+class _Boom:
+    def Ok(self, params):
+        return {"ok": params.get("n")}
+
+    def Boom(self, params):
+        raise ValueError("handler exploded")
+
+
+def test_server_drains_request_annex_on_handler_error(tmp_path):
+    """A request carrying an annex to a raising handler must not
+    desync the connection: the error reply arrives and the NEXT frame
+    on the same socket parses cleanly."""
+    from syzkaller_tpu.rpc import RPCServer
+
+    srv = RPCServer(("127.0.0.1", 0))
+    srv.register("T", _Boom())
+    srv.serve_in_background()
+    try:
+        sock = socket.create_connection(srv.addr, timeout=10)
+        try:
+            _send_frame(sock, {"id": 1, "method": "T.Boom",
+                               "params": {}}, annex=b"A" * 4096)
+            resp = _recv_frame(sock)
+            assert "handler exploded" in resp["error"]
+            _send_frame(sock, {"id": 2, "method": "T.Ok",
+                               "params": {"n": 7}}, annex=b"B" * 512)
+            resp2 = _recv_frame(sock)
+            assert resp2["result"] == {"ok": 7}
+        finally:
+            sock.close()
+    finally:
+        srv.close()
+
+
+def test_client_socket_survives_garbled_compressed_reply():
+    """A reply whose zlib payload is garbled (but whose annex length
+    is honest) must leave the pooled client socket at an exact frame
+    boundary: the decode error propagates, the next call succeeds."""
+    import zlib
+
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    addr = lst.getsockname()
+    _FRAME = struct.Struct("<IB")
+    _ANNEX = struct.Struct("<Q")
+
+    def serve():
+        conn, _ = lst.accept()
+        with conn:
+            # request 1 -> garbled-zlib reply with a real annex tail
+            _recv_frame(conn)
+            bad = b"this is not zlib data"
+            conn.sendall(_FRAME.pack(len(bad), 1 | 4)
+                         + _ANNEX.pack(8) + bad + b"ANNEXTAIL"[:8])
+            # request 2 -> honest reply
+            req = _recv_frame(conn)
+            _send_frame(conn, {"id": req["id"], "result": {"ok": 1}},
+                        annex=b"payload")
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    client = RPCClient(addr, timeout_s=10.0)
+    try:
+        with pytest.raises(zlib.error):
+            client.call("X.Y", {})
+        res, annex = client.call("X.Z", {}, want_annex=True)
+        assert res == {"ok": 1} and bytes(annex) == b"payload"
+    finally:
+        client.close()
+        lst.close()
+    t.join(timeout=10)
+
+
+# -- the SIGKILL-mid-Sync + same-port-restart chaos drill ----------------
+
+_HUB_CHILD = r"""
+import sys, time
+from syzkaller_tpu.hub.hub import serve_hub
+workdir, port = sys.argv[1], int(sys.argv[2])
+srv, hub = serve_hub(workdir, ("127.0.0.1", port))
+print("READY", flush=True)
+while True:
+    time.sleep(0.5)
+"""
+
+
+def _spawn_hub(workdir, port, fault_plan=""):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    if fault_plan:
+        env["TZ_FAULT_PLAN"] = fault_plan
+    else:
+        env.pop("TZ_FAULT_PLAN", None)
+    child = subprocess.Popen(
+        [sys.executable, "-c", _HUB_CHILD, workdir, str(port)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE)
+    line = child.stdout.readline()
+    if b"READY" not in line:
+        err = child.stderr.read().decode()[-2000:]
+        child.kill()
+        raise AssertionError(f"hub child failed to start: {err}")
+    return child
+
+
+class _DrillMgr:
+    """A session manager as the drill drives it: corpus upload at
+    (re-)Connect, incremental adds, annex-decoded receives."""
+
+    def __init__(self, name, addr):
+        self.name = name
+        self.progs: list[str] = [f"{name}_p{i}()" for i in range(2)]
+        self.received = collections.Counter()
+        self.client = RPCClient(addr, name=name, timeout_s=30.0,
+                                retries=12, backoff_s=0.3)
+        self.reconnects = 0
+
+    def _ident(self):
+        return {"client": "", "key": "", "manager": self.name}
+
+    def connect(self):
+        res = self.client.call_transient("Hub.Connect", {
+            **self._ident(), "session": True, "fresh": False,
+            "corpus": list(self.progs),
+            "corpus_sigs": [[] for _ in self.progs]}) or {}
+        self.client.set_session(res["epoch"],
+                                on_reconnect=self._reconnect)
+
+    def _reconnect(self):
+        self.reconnects += 1
+        self.connect()
+
+    def sync(self, add=()):
+        self.progs.extend(add)
+        res, annex = self.client.call_session("Hub.Sync", {
+            **self._ident(), "add": list(add),
+            "add_sigs": [[] for _ in add], "delete": [],
+            "repros": [], "need_repros": True}, want_annex=True)
+        view = memoryview(annex or b"")
+        for off, ln in res.get("progs") or []:
+            self.received[bytes(view[off:off + ln]).decode()] += 1
+        return res
+
+    def stats(self):
+        return self.client.call_transient("Hub.Stats", self._ident())
+
+
+@pytest.mark.slow
+def test_hub_sigkill_chaos_drill(tmp_path):
+    """SIGKILL the hub while a Sync is executing (a scripted hang
+    holds it mid-call), restart a successor behind the SAME port, and
+    let 3 live session managers ride their retry/reconnect paths
+    through the failover.  Pins: zero lost programs, zero
+    double-counted corpus adds, per-manager cursors monotonic vs
+    acked progress across generations."""
+    wd = str(tmp_path / "hub")
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    port = lst.getsockname()[1]
+    lst.close()
+    # syncs run A,B,C per round; occurrence 7 = A's round-3 sync
+    gen1 = _spawn_hub(wd, port, fault_plan="hub.sync:hang@7")
+    gen2_box = {}
+    mgrs = [_DrillMgr(n, ("127.0.0.1", port))
+            for n in ("mA", "mB", "mC")]
+    try:
+        for m in mgrs:
+            m.connect()
+        for rnd in (1, 2):
+            for m in mgrs:
+                m.sync(add=[f"{m.name}_r{rnd}()"])
+        seqs_g1 = {n: s["seq"] for n, s in
+                   mgrs[0].stats()["managers"].items()}
+
+        def kill_and_restart():
+            time.sleep(1.0)  # let A's sync reach the scripted hang
+            os.kill(gen1.pid, _signal.SIGKILL)
+            gen1.wait(timeout=30)
+            gen2_box["child"] = _spawn_hub(wd, port)
+
+        killer = threading.Thread(target=kill_and_restart)
+        killer.start()
+        # This sync hangs in gen-1, dies with it, retries against the
+        # refused port, then hits gen-2's fresh epoch: the
+        # ReconnectRequired verdict drives the re-Connect resync.
+        for m in mgrs:
+            m.sync()
+        killer.join(timeout=120)
+        assert "child" in gen2_box, "hub successor never started"
+        assert any(m.reconnects for m in mgrs)
+        # converge: everyone drains everything
+        for _ in range(3):
+            for m in mgrs:
+                m.sync()
+
+        expected = {p for m in mgrs for p in m.progs}
+        assert len(expected) == 12  # 3 managers x (2 connect + 2 adds)
+        stats = mgrs[0].stats()
+        # zero lost, zero double-counted: every program exactly one
+        # corpus entry / one seq, despite re-uploads and redelivery
+        assert stats["corpus"] == len(expected)
+        assert stats["next_seq"] == len(expected) + 1
+        for m in mgrs:
+            others = {p for o in mgrs if o is not m for p in o.progs}
+            assert set(m.received) == others, m.name
+        # cursors: monotonic vs gen-1 acked progress, fully converged
+        for name, s in stats["managers"].items():
+            assert s["seq"] == stats["next_seq"] - 1
+            assert s["seq"] >= seqs_g1[name] - 3  # rollback bounded
+    finally:
+        for proc in (gen1, gen2_box.get("child")):
+            if proc is None:
+                continue
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            proc.wait(timeout=30)
+            proc.stdout.close()
+            proc.stderr.close()
+
+
+# -- serve_hub wiring ----------------------------------------------------
+
+
+def test_serve_hub_registers_gauges_and_durable(tmp_path):
+    from syzkaller_tpu import telemetry
+
+    srv, hub = serve_hub(str(tmp_path / "hub"))
+    try:
+        assert hub.state.durable is not None
+        _connect(hub, "mA", ["a()"])
+        snap = telemetry.REGISTRY.snapshot()
+        assert snap["gauges"]["tz_hub_managers_size"] == 1
+        assert snap["gauges"]["tz_hub_corpus_size"] == 1
+        assert snap["gauges"]["tz_hub_pending_repros_depth"] == 0
+    finally:
+        srv.close()
+        hub.state.durable.close()
