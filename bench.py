@@ -39,6 +39,10 @@ Modes:
                                    # device-time ledger metering tax
                                    # us/batch, conservation error, SLO
                                    # burn-evaluation us/tick
+  python bench.py --device         # device residency observatory:
+                                   # HBM-ledger handle-update tax
+                                   # us/batch, full reconcile ms at the
+                                   # 64 MB plane shape, headroom
 """
 
 from __future__ import annotations
@@ -811,6 +815,67 @@ def bench_accounting(batches=5000, tenants=3, lanes=3, shards=4,
         "acct_conservation_error": ledger.conservation_error(),
         "slo_objectives": len(eng.snapshot()["objectives"]),
         "slo_tick_us": round(1e6 * tick_s / ticks, 3),
+    }
+
+
+def bench_device(updates=20000, reconciles=20) -> dict:
+    """Device residency observatory bench (ISSUE 17): the two costs
+    the ledger adds to a running rig, measured on PRIVATE instances so
+    nothing leaks into the process registry.
+
+      - ledger tax: one `BufferHandle.update()` per drained batch is
+        what the pipeline hot path pays (the mutant-plane handle swap
+        in `_launch`).  Timed over `updates` update calls against a
+        device-resident 64 MB plane — the acceptance bar is
+        <= 50 us/batch, noise next to the ~ms-scale drain.
+      - reconcile: the audit-cadence pass that sweeps every handle's
+        weakrefs and id-matches them against the backend's live-buffer
+        report.  Timed at the flagship residency shape (the 64 MB
+        signal plane + the mutant plane registered alongside a crowd
+        of small host buffers) with the REAL `jax.live_arrays()` set,
+        so the ms number includes the backend enumeration cost.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from syzkaller_tpu.telemetry.hbm import DeviceBufferLedger
+    from syzkaller_tpu.telemetry.registry import Registry
+
+    class _Flight:
+        def dump(self, *a, **k):
+            return None
+
+    ledger = DeviceBufferLedger(registry=Registry(), flight=_Flight())
+    plane = jnp.zeros(1 << 26, jnp.uint8)      # the 64 MB signal plane
+    mplane = jnp.zeros(1 << 22, jnp.uint8)     # the mutant plane
+    h_plane = ledger.register("pipeline", "plane", plane)
+    ledger.register("triage", "plane", mplane)
+    for i in range(8):                          # small-buffer crowd
+        ledger.register("serve", f"t{i}",
+                        np.zeros(1 << 16, np.uint8), device="host")
+
+    h_plane.update(plane)                       # warm the label path
+    t0 = time.perf_counter()
+    for _ in range(updates):
+        h_plane.update(plane)
+    tax_s = time.perf_counter() - t0
+
+    rec = ledger.reconcile()                    # warm (gauge setup)
+    t0 = time.perf_counter()
+    for _ in range(reconciles):
+        rec = ledger.reconcile()
+    rec_s = time.perf_counter() - t0
+
+    return {
+        "device_ledger_updates": updates,
+        "device_ledger_tax_us": round(1e6 * tax_s / updates, 3),
+        "device_reconcile_ms":
+            round(1e3 * rec_s / max(1, reconciles), 3),
+        "device_reconcile_entries": rec["entries"],
+        "device_reconcile_drift_bytes": rec["drift_bytes"],
+        "device_tracked_bytes": rec["tracked_bytes"],
+        "device_headroom_gb":
+            round(ledger.headroom() / (1 << 30), 3),
     }
 
 
@@ -1607,6 +1672,15 @@ def main() -> None:
         res = {"metric": "acct_note_batch_us", "unit": "us/batch",
                **bench_accounting()}
         res["value"] = res["acct_note_batch_us"]
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
+    if "--device" in argv:
+        res = {"metric": "device_ledger_tax_us", "unit": "us/batch",
+               **bench_device()}
+        res["value"] = res["device_ledger_tax_us"]
         if platform:
             res["platform"] = platform
         journal_append(res)

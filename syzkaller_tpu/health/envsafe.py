@@ -38,6 +38,8 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_CKPT_INTERVAL_S",
     "TZ_CKPT_WAL_FSYNC",
     "TZ_CKPT_WAL_MAX_MB",
+    "TZ_COMPILE_STORM_N",
+    "TZ_COMPILE_STORM_WINDOW_S",
     "TZ_COVERAGE_AUDIT_S",
     "TZ_COVERAGE_INTERVAL_S",
     "TZ_COVERAGE_RING",
@@ -48,6 +50,9 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_FLIGHT_DIR",
     "TZ_FLIGHT_RING",
     "TZ_FUZZER_LEASE_S",
+    "TZ_HBM_CAPACITY_BYTES",
+    "TZ_HBM_DRIFT_TOLERANCE_BYTES",
+    "TZ_HBM_RECONCILE",
     "TZ_HUB_DIGEST_BITS",
     "TZ_HUB_LEASE_S",
     "TZ_JAX_PLATFORM",
@@ -92,6 +97,7 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_SLO_UTIL_FLOOR",
     "TZ_TELEMETRY_SNAPSHOT",
     "TZ_TRACE_FILE",
+    "TZ_TRACE_PROCESS",
     "TZ_TRACE_SAMPLE",
     "TZ_TRIAGE_BATCH",
     "TZ_TRIAGE_DEVICE",

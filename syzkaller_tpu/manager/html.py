@@ -95,6 +95,14 @@ def serve_http(mgr, addr: tuple[str, int]) -> ThreadingHTTPServer:
                     # and the SLO scorecard with burn rates.
                     self._send(json.dumps(_accounting_payload(mgr)),
                                "application/json")
+                elif url.path == "/api/device":
+                    # Device residency observatory (ISSUE 17,
+                    # telemetry/hbm.py + compiles.py): the HBM buffer
+                    # ledger (per-owner live bytes, headroom forecast,
+                    # last reconcile) and the compile-cache build
+                    # ledger per graph family.
+                    self._send(json.dumps(_device_payload(mgr)),
+                               "application/json")
                 elif url.path == "/api/stats":
                     # Machine-readable superset of /stats: the manager
                     # rollup plus the full telemetry snapshot
@@ -278,6 +286,53 @@ def _accounting_section(mgr) -> str:
             f"<a href='/api/accounting'>accounting.json</a></p>")
 
 
+def _device_payload(mgr) -> dict:
+    """The /api/device body: the HBM buffer ledger and the compile
+    observatory (ISSUE 17).  A fresh reconcile is NOT run here — the
+    payload reports the last audit-cadence pass so a dashboard poll
+    never syncs the device."""
+    from syzkaller_tpu import telemetry
+
+    return {"hbm": telemetry.HBM.snapshot(),
+            "compiles": telemetry.COMPILES.snapshot()}
+
+
+def _device_section(mgr) -> str:
+    """Summary-page residency block: one row per registered buffer
+    group (owner/kind@device, MB), the capacity/headroom line with
+    the last reconcile verdict, and the per-family compile ledger."""
+    from syzkaller_tpu import telemetry
+
+    hbm = telemetry.HBM.snapshot()
+    comp = telemetry.COMPILES.snapshot()
+    brows = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td>{v / 1e6:.1f}</td></tr>"
+        for k, v in (hbm.get("buffers") or {}).items())
+    rec = hbm.get("last_reconcile") or {}
+    recline = ("never reconciled" if not rec else
+               f"last reconcile drift {rec.get('drift_bytes', 0)} B "
+               f"over {rec.get('entries', 0)} entries "
+               f"({rec.get('seconds', 0) * 1e3:.1f} ms)")
+    grows = "".join(
+        f"<tr><td>{html.escape(g)}</td><td>{f['builds']}</td>"
+        f"<td>{f['shapes']}</td></tr>"
+        for g, f in (comp.get("graphs") or {}).items())
+    return (f"<h3>Device residency</h3>"
+            f"<table><tr><th>buffer (owner/kind@device)</th>"
+            f"<th>MB</th></tr>{brows}</table>"
+            f"<p>{hbm.get('device_resident_bytes', 0) / 1e6:.1f} MB "
+            f"device-resident of "
+            f"{hbm.get('capacity_bytes', 0) / 1e9:.1f} GB "
+            f"(headroom {hbm.get('headroom_bytes', 0) / 1e9:.2f} GB) "
+            f"&middot; {html.escape(recline)}</p>"
+            f"<table><tr><th>graph</th><th>builds</th><th>shapes</th>"
+            f"</tr>{grows}</table>"
+            f"<p>{comp.get('total_builds', 0)} builds, "
+            f"{comp.get('storms', 0)} storms &middot; "
+            f"<a href='/api/device'>device.json</a></p>")
+
+
 def _call_name(prog_line: str) -> str:
     """First call name of a serialized program line ('r0 = open(...)'
     or 'open(...)')."""
@@ -352,6 +407,7 @@ def _summary_page(mgr) -> str:
             f"{_serve_section(mgr)}"
             f"{_coverage_section(mgr)}"
             f"{_accounting_section(mgr)}"
+            f"{_device_section(mgr)}"
             f"<h3>Crashes</h3>"
             f"<table><tr><th>title</th><th>count</th><th>repro</th>"
             f"<th></th></tr>{crashes}</table>")

@@ -187,7 +187,7 @@ def make_pallas_mutator(rounds: int = 4,
     out_keys = _STATE_KEYS + _OUT_EXTRA
 
     @functools.partial(jax.jit, static_argnames=())
-    def mutate_batch(batch: dict, key, flag_vals, flag_counts) -> dict:
+    def _mutate_batch(batch: dict, key, flag_vals, flag_counts) -> dict:
         b = batch["kind"].shape[0]
         kd = jax.random.key_data(random.split(key, b))
 
@@ -209,6 +209,21 @@ def make_pallas_mutator(rounds: int = 4,
             out_shapes, out_dtypes, interpret)
         return dict(zip(out_keys, outs))
 
+    def mutate_batch(batch: dict, key, flag_vals, flag_counts) -> dict:
+        # CompileObservatory point (ISSUE 17): the standalone mutator
+        # is its own jit entry (tests, bench --mutate), so its first
+        # dispatch is a build the process ledger should see.  The
+        # sizer gates on real jit-cache growth — warm calls add one
+        # cheap host check, no note.
+        from syzkaller_tpu import telemetry
+
+        with telemetry.COMPILES.observe(
+                "mutate.core",
+                {"rounds": rounds, "interpret": interpret},
+                sizer=_mutate_batch._cache_size):
+            return _mutate_batch(batch, key, flag_vals, flag_counts)
+
+    mutate_batch._cache_size = _mutate_batch._cache_size
     return mutate_batch
 
 

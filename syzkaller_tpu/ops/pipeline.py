@@ -417,7 +417,6 @@ PIPELINE_TENSOR_CONFIG = TensorConfig(
 PIPELINE_DELTA_SPEC = DeltaSpec()
 
 
-@functools.lru_cache(maxsize=None)
 def _shared_step(spec, B: int, R: int, backend: str, fused: bool,
                  n_blocks: int, max_insert_calls: int,
                  prescore: bool = False, sim_backend: str = ""):
@@ -430,7 +429,24 @@ def _shared_step(spec, B: int, R: int, backend: str, fused: bool,
     one's compile instead of paying XLA again.  That matters anywhere
     engines churn: per-Proc pipelines, breaker-driven rebuilds, and
     every test rig in a shared process.
+
+    This is THE process compile point, so its cache occupancy is
+    published to the CompileObservatory (ISSUE 17) — the actual XLA
+    build is observed at first dispatch in `_launch`, where the wall
+    time is real.
     """
+    fn = _shared_step_cached(spec, B, R, backend, fused, n_blocks,
+                             max_insert_calls, prescore, sim_backend)
+    telemetry.COMPILES.set_cache_size(
+        "pipeline.step", _shared_step_cached.cache_info().currsize)
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _shared_step_cached(spec, B: int, R: int, backend: str,
+                        fused: bool, n_blocks: int,
+                        max_insert_calls: int, prescore: bool = False,
+                        sim_backend: str = ""):
     import jax
     import jax.numpy as jnp
     from jax import random
@@ -651,6 +667,22 @@ class DevicePipeline:
         self._runs_dev = jnp.asarray(runs_np)
         self._by_syscall_dev = jnp.asarray(self.bank.by_syscall)
         n_blocks = len(self.bank)
+        # Device-residency ledger (ISSUE 17, telemetry/hbm.py): every
+        # long-lived device buffer this pipeline owns registers under
+        # owner="pipeline".  The prio/donor tables live for the
+        # pipeline's lifetime; corpus/flags/plane handles start empty
+        # and track the rebuild cycle (_flush_pending, _launch,
+        # _reset_device_state) so a half-open ring rebuild REPLACES
+        # entries instead of leaking them.
+        self._hbm_prio = telemetry.HBM.register(
+            "pipeline", "prio",
+            [self._runs_dev, self._by_syscall_dev], bound_to=self)
+        self._hbm_corpus = telemetry.HBM.register(
+            "pipeline", "corpus", bound_to=self)
+        self._hbm_flags = telemetry.HBM.register(
+            "pipeline", "flags", bound_to=self)
+        self._hbm_plane = telemetry.HBM.register(
+            "pipeline", "plane", bound_to=self)
 
         # Mutation-core backend (ISSUE 10, docs/perf.md "The mutation
         # core"): Pallas grid-over-batch kernels on TPU (real branch
@@ -903,6 +935,33 @@ class DevicePipeline:
         if arr.size != (1 << self._plane_bits):
             return
         self._mutant_plane = self._jnp.asarray(arr)
+        self._hbm_plane.update(self._mutant_plane)
+
+    def _compile_key(self, prescore: bool) -> dict:
+        """The static shape key of the step executable, as the
+        CompileObservatory records it — a storm incident diffs two of
+        these to name the churning field."""
+        return {
+            "B": self.batch_size, "R": self._rounds,
+            "backend": self._backend, "fused": self._fused,
+            "n_blocks": self._n_blocks,
+            "max_insert_calls": self._max_insert_calls,
+            "prescore": prescore,
+        }
+
+    def _step_cache_size(self) -> int:
+        """Summed jit-cache size of this pipeline's step executables
+        (the observatory's build sizer; also what the shared warm-rig
+        compile guard watches).  A step swapped for a plain wrapper
+        (fault-injection tests, the health latch's host fallback) has
+        no jit cache and contributes 0 — the sizer must never be the
+        thing that kills the worker."""
+        n = 0
+        for fn in (self._step, self._step_sim):
+            sizer = getattr(fn, "_cache_size", None)
+            if sizer is not None:
+                n += sizer()
+        return n
 
     def health_snapshot(self) -> dict:
         """Breaker + watchdog state for tests and the status page."""
@@ -916,6 +975,8 @@ class DevicePipeline:
             "assemble_depth": self._assemble_depth,
             "assemble_depth_auto": self._depth_ctrl is not None,
             "staging_arena_bytes": self._staging.nbytes,
+            "hbm": telemetry.HBM.snapshot(),
+            "compiles": telemetry.COMPILES.snapshot(),
         }
         if self.triage_engine is not None:
             out["triage"] = self.triage_engine.snapshot()
@@ -969,6 +1030,7 @@ class DevicePipeline:
             ets = list(self.exec_templates)
         if n == 0:
             return None, 0, tmpl, ets
+        corpus_was_live = self._corpus_dev is not None
         try:
             if self._corpus_dev is None:
                 proto = pending[0][1] if pending else tmpl[0].arrays()
@@ -1029,6 +1091,11 @@ class DevicePipeline:
             with self._lock:
                 self._pending_rows = pending + self._pending_rows
             raise
+        if pending or not corpus_was_live:
+            # The scatter replaced the per-field arrays (functional
+            # .at[].set), so the ledger entry re-points at the live
+            # buffers — reconcile identity follows the rebuild.
+            self._hbm_corpus.update(self._corpus_dev)
         # Flag tables grow as new sets are interned; pad the row count
         # to a power of two so growth doesn't re-jit the step, and
         # re-upload only on growth (the host link is latency-bound).
@@ -1056,6 +1123,7 @@ class DevicePipeline:
             self._flags_dev = (self._jnp.asarray(fv_np),
                                self._jnp.asarray(fc_np))
             self._flags_len = new_len
+            self._hbm_flags.update(list(self._flags_dev))
         return self._corpus_dev, n, tmpl, ets
 
     # -- the device loop ---------------------------------------------------
@@ -1133,8 +1201,18 @@ class DevicePipeline:
             with telemetry.span("pipeline.launch"):
                 result = self.watchdog.call(dispatch, op)
         else:
+            # First dispatch: the jit trace + XLA build happen here,
+            # so this is where the CompileObservatory gets the real
+            # wall time.  The sizer gates the note on actual jit-cache
+            # growth — a warm rig reusing the shared executable
+            # records nothing (no storm false-positives, and the
+            # `assert_no_new_compiles` guards stay exact).
             with telemetry.span("pipeline.compile"):
-                result = self.watchdog.call(dispatch, op, compile=True)
+                with telemetry.COMPILES.observe(
+                        "pipeline.step", self._compile_key(use_sim),
+                        sizer=self._step_cache_size):
+                    result = self.watchdog.call(dispatch, op,
+                                                compile=True)
         self._compiled = True
         # Start the device->host copies now: the tunneled link has a
         # ~70 ms per-sync fixed cost that fully hides behind the next
@@ -1163,6 +1241,13 @@ class DevicePipeline:
             rows_dev, pool_dev, n_used_dev = result
             n_novel_dev = None
             async_arrs = (rows_dev, n_used_dev)
+        if self._fused:
+            # The fused step returns a NEW plane array every batch
+            # (functional update): re-point the ledger entry at it so
+            # the reconcile identity check follows the live buffer.
+            # This handle update is the steady-state ledger tax —
+            # bench.py --device pins it ≤ 50 µs/batch.
+            self._hbm_plane.update(self._mutant_plane)
         for arr in async_arrs:
             try:
                 arr.copy_to_host_async()
@@ -1261,6 +1346,11 @@ class DevicePipeline:
         self.stats.d2h_batches += 1
         _M_D2H_BYTES.inc(nbytes)
         _M_D2H_BATCH_BYTES.set(nbytes)
+        # Headroom forecast input (ISSUE 17): the observed per-batch
+        # working set at the CURRENT (flagship) batch shape — what
+        # one in-flight batch needs on top of the resident set.
+        telemetry.HBM.note_transient(
+            "pipeline", nbytes * self._dispatch_depth)
         batch = DeltaBatch(rows, self.spec, pool=pool)
         batch.trace = trace
         return batch, tmpl, ets
@@ -1450,6 +1540,12 @@ class DevicePipeline:
             self._pending_rows = [
                 (i, t.arrays()) for i, t in enumerate(self.templates)
                 if t is not None]
+            # The ledger must drop the dead buffers with them: a
+            # half-open rebuild that left stale entries would read as
+            # an hbm.drift leak at the next reconcile.
+            self._hbm_corpus.update(None)
+            self._hbm_flags.update(None)
+            self._hbm_plane.update(None)
         if self.triage_engine is not None:
             # The signal plane is co-resident with the corpus ring: a
             # restarted backend invalidated its buffer too, so it must

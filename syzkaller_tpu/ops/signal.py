@@ -321,6 +321,17 @@ def plane_drift(plane, mirror):
     return (plane != mirror).sum(dtype=jnp.int32)
 
 
+def analytics_cache_size() -> int:
+    """Summed jit-cache size of this module's compile-once analytics
+    kernels — the sizer the CompileObservatory watches around the
+    triage analytics pass (telemetry/compiles.py), and what the
+    warm-rig `assert_no_new_compiles` guards pin.  Each kernel's
+    plane shape is static, so a warm process holds exactly one
+    executable per kernel and this sum never moves again."""
+    return (coverage_stats._cache_size() + plane_drift._cache_size()
+            + plane_count._cache_size())
+
+
 def to_signal(plane_np: np.ndarray):
     """Host conversion of the plane into a models Signal (folded)."""
     from syzkaller_tpu.signal import Signal

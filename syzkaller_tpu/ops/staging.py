@@ -75,13 +75,23 @@ _M_DISPATCH_DEPTH = telemetry.gauge(
 #: growth is rare (log2 buckets x slots), reads go through the gauge.
 _footprint_lock = threading.Lock()
 _footprint_bytes = 0
+_hbm_handle = None
 
 
 def _account(nbytes: int) -> None:
-    global _footprint_bytes
+    global _footprint_bytes, _hbm_handle
     with _footprint_lock:
         _footprint_bytes += nbytes
         _M_ARENA_BYTES.set(_footprint_bytes)
+        # Residency ledger (ISSUE 17): the pinned staging buffers are
+        # long-lived host memory — one opaque byte-count entry for
+        # the process-wide footprint (per-bucket identity lives in
+        # the arenas; the ledger answers "how much, whose?").
+        if _hbm_handle is None:
+            _hbm_handle = telemetry.HBM.register(
+                "staging", "arena", _footprint_bytes, device="host")
+        else:
+            _hbm_handle.update(_footprint_bytes, device="host")
 
 
 class StagingArena:

@@ -186,6 +186,14 @@ class MeshEngine:
         self._mplane_dev = None
         self._last_reshard: Optional[float] = None
         self.triage = None
+        # Residency ledger (ISSUE 17): the cov-sharded device planes
+        # and their host-authority mirrors are the mesh's long-lived
+        # footprint; updated at every re-shard / step absorb.
+        self._hbm_planes = telemetry.HBM.register(
+            "mesh", "planes", bound_to=self)
+        self._hbm_mirrors = telemetry.HBM.register(
+            "mesh", "mirrors", [self._mirror, self._mmirror],
+            device="host", bound_to=self)
         self._build()
 
     # -- topology ---------------------------------------------------------
@@ -209,11 +217,22 @@ class MeshEngine:
         if entry is None:
             devs = [d.device for d in live]
             m = pmesh.make_mesh(devs, self._fit_cov(len(devs)))
-            step = pmesh.make_fused_mesh_step(
-                m, spec=self.spec, rounds=self.rounds,
-                plane_size=self.plane_size,
-                mutant_bits=self.mutant_bits)
+            # Observatory compile point (ISSUE 17): a _graphs miss IS
+            # a build of this topology's fused step — noted here (not
+            # in parallel/mesh.py) so fault drills that stub the
+            # builder still land in the ledger.
+            with telemetry.COMPILES.observe(
+                    "mesh.fused_step",
+                    pmesh.graph_cache_key(
+                        m, self.rounds, self.plane_size,
+                        self.mutant_bits)):
+                step = pmesh.make_fused_mesh_step(
+                    m, spec=self.spec, rounds=self.rounds,
+                    plane_size=self.plane_size,
+                    mutant_bits=self.mutant_bits)
             entry = self._graphs[key] = (m, step)
+            telemetry.COMPILES.set_cache_size(
+                "mesh.fused_step", len(self._graphs))
         self._mesh, self._step_fn = entry
         self._topology_key = key
         for d in live:
@@ -223,6 +242,7 @@ class MeshEngine:
         sh = NamedSharding(self._mesh, P("cov"))
         self._plane_dev = jax.device_put(jnp.asarray(self._mirror), sh)
         self._mplane_dev = jax.device_put(jnp.asarray(self._mmirror), sh)
+        self._hbm_planes.update([self._plane_dev, self._mplane_dev])
         self._last_reshard = self._clock()
         _M_RESHARD.inc()
         _M_RESHARD_TS.set(time.time())
@@ -384,6 +404,7 @@ class MeshEngine:
     def _absorb_success(self, out: dict) -> None:
         plane, mplane = out.pop("_planes")
         self._plane_dev, self._mplane_dev = plane, mplane
+        self._hbm_planes.update([plane, mplane])
         edges, nedges, prios, B = out.pop("_inputs")
         # Exact host-mirror merge of the accepted programs' edges —
         # the merge the device just did, replayed on the authority,
@@ -413,6 +434,8 @@ class MeshEngine:
         try:
             self._mmirror = np.asarray(self._mplane_dev)
             self._steps_since_msync = 0
+            self._hbm_mirrors.update([self._mirror, self._mmirror],
+                                     device="host")
         except Exception as e:  # noqa: BLE001
             log.logf(1, "mutant-mirror sync failed (stale mirror "
                         "kept): %r", e)

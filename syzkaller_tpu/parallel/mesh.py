@@ -60,6 +60,23 @@ def make_mesh(devices: Optional[list] = None, cov: int = 1) -> Mesh:
     return Mesh(arr, ("batch", "cov"))
 
 
+def graph_cache_key(mesh: Mesh, rounds: int, plane_size: int,
+                    mutant_bits: int) -> dict:
+    """The static shape fields that determine a fused-step executable
+    — the compile-cache key the CompileObservatory records for the
+    `mesh.fused_step` family.  Defined next to the builder so the key
+    and the traced shapes cannot drift apart: two calls with equal
+    keys MUST reuse one executable; a rebuild at an equal key means
+    the cache itself was lost (the storm detector's worst case)."""
+    return {
+        "devices": int(np.prod(list(mesh.shape.values()))),
+        "axes": "x".join(f"{a}={n}" for a, n in mesh.shape.items()),
+        "rounds": int(rounds),
+        "plane_size": int(plane_size),
+        "mutant_bits": int(mutant_bits),
+    }
+
+
 def make_host_mesh(devices: Optional[list] = None, hosts: int = 2,
                    cov: int = 1) -> Mesh:
     """Mesh with ('host', 'batch', 'cov') axes: the multi-host form.

@@ -89,6 +89,11 @@ class TenantPlanes:
         # callable; verdicts journal their folded bucket indices so
         # replay reproduces each tenant's plane without re-hashing.
         self.journal = None
+        # Residency ledger (ISSUE 17): one handle for the whole
+        # tenant-plane set — host memory, sized by admission cap x
+        # 2^bits, the serving plane's only long-lived footprint.
+        self._hbm = telemetry.HBM.register(
+            "serve", "tenant_planes", device="host", bound_to=self)
 
     def _ensure_locked(self, tenant: str) -> np.ndarray:
         plane = self._planes.get(tenant)
@@ -106,6 +111,8 @@ class TenantPlanes:
                 "estimated false-drop rate of one tenant's plane "
                 "(occupancy / plane size)",
                 labels={"tenant": tenant})
+            self._hbm.update(list(self._planes.values()),
+                             device="host")
         return plane
 
     def verdict(self, tenant: str, rows: np.ndarray) -> np.ndarray:
@@ -157,6 +164,8 @@ class TenantPlanes:
         with self._lock:
             self._planes.pop(tenant, None)
             self._occupancy.pop(tenant, None)
+            self._hbm.update(list(self._planes.values()),
+                             device="host")
 
     def epoch(self, tenant: str) -> int:
         with self._lock:

@@ -103,6 +103,12 @@ class SimPrescore:
         self._et_ids: list = [None] * capacity
         self._tables_dev = None
         self._plane = None
+        # Residency ledger (ISSUE 17): the stacked sim tables and the
+        # speculation plane are the sim path's long-lived device state.
+        self._hbm_tables = telemetry.HBM.register(
+            "sim", "tables", bound_to=self)
+        self._hbm_plane = telemetry.HBM.register(
+            "sim", "plane", bound_to=self)
         # Accounting (drained into proc stats / bench via snapshot()).
         self.batches = 0
         self.suppressed = 0
@@ -140,6 +146,7 @@ class SimPrescore:
             dev = {k: jnp.asarray(v) for k, v in self._host.items()}
             dev["ncalls"] = jnp.asarray(self._host_ncalls)
             self._tables_dev = dev
+            self._hbm_tables.update(self._tables_dev)
         return self._tables_dev
 
     def ensure_plane(self):
@@ -149,6 +156,7 @@ class SimPrescore:
             import jax.numpy as jnp
 
             self._plane = jnp.zeros(1 << self.plane_bits, jnp.uint8)
+            self._hbm_plane.update(self._plane)
         return self._plane
 
     def invalidate_device_state(self) -> None:
@@ -157,6 +165,8 @@ class SimPrescore:
         self._tables_dev = None
         self._et_ids = [None] * self.capacity
         self._plane = None
+        self._hbm_tables.update(None)
+        self._hbm_plane.update(None)
 
     # -- per-batch bookkeeping ---------------------------------------------
 
@@ -166,9 +176,11 @@ class SimPrescore:
         every previously-suppressed fold admissible again), and let
         the breaker see the success."""
         self._plane = plane
+        self._hbm_plane.update(plane)
         self.batches += 1
         if self.epoch_batches and self.batches % self.epoch_batches == 0:
             self._plane = None
+            self._hbm_plane.update(None)
             self.epochs += 1
             self._epoch_evented = False
             _M_SIM_READMITS.inc()

@@ -172,17 +172,46 @@ ACCOUNTING = DeviceTimeLedger()
 #: leader and the manager stats path.
 SLO = SloEngine()
 
+# The device-residency plane (ISSUE 17): the HBM buffer ledger and
+# the compile-cache observatory.  Same late-import shape.
+from syzkaller_tpu.telemetry.compiles import (  # noqa: E402
+    CompileObservatory,
+    assert_no_new_compiles,
+)
+from syzkaller_tpu.telemetry.hbm import DeviceBufferLedger  # noqa: E402
+
+#: Process-wide HBM residency ledger (tz_hbm_*): every long-lived
+#: device buffer registers here under {owner, device, kind}; the
+#: triage analytics cadence reconciles it against the backend's
+#: live-buffer report.
+HBM = DeviceBufferLedger(registry=REGISTRY, flight=FLIGHT)
+
+#: Process-wide compile observatory (tz_compile_*): every XLA build
+#: at the shared compile points, with storm detection — and the
+#: single authority the warm-rig jit-count guards assert against.
+COMPILES = CompileObservatory(registry=REGISTRY, flight=FLIGHT)
+
+# Both residency tables ride EVERY flight incident (wedge / SIGTERM /
+# slo-burn / plateau): a dump always answers "what was resident and
+# what was compiling when this happened?".
+FLIGHT.add_context("hbm", HBM.snapshot)
+FLIGHT.add_context("compiles", COMPILES.snapshot)
+
 
 __all__ = [
     "ACCOUNTING",
+    "COMPILES",
     "COVERAGE",
+    "CompileObservatory",
     "Counter",
     "CoverageTracker",
     "DEFAULT_LATENCY_BUCKETS",
+    "DeviceBufferLedger",
     "DeviceTimeLedger",
     "FLIGHT",
     "FlightRecorder",
     "Gauge",
+    "HBM",
     "Histogram",
     "KernelProfiler",
     "PROFILER",
@@ -194,6 +223,7 @@ __all__ = [
     "SloEngine",
     "TRACE",
     "TraceWriter",
+    "assert_no_new_compiles",
     "lineage",
     "counter",
     "dump_snapshot",

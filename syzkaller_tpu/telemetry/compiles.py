@@ -1,0 +1,304 @@
+"""Compile-cache observatory: every XLA build, counted and timed.
+
+The tier-1 suite has twice brushed its 870 s ceiling on silent
+re-compiles that only ad-hoc per-test jit-count guards caught after
+the fact (PR 9/10/12/14 each grew its own).  This module (ISSUE 17)
+makes the compile cache a first-class observable plane: the
+process-wide compile points — `ops/pipeline._shared_step`'s first
+dispatch, the mesh topology graphs (`parallel/fault_domain._build`),
+and the triage/sim analytics builders — report every build here,
+with the cache key that produced it.
+
+Exports:
+  - `tz_compile_builds_total{graph=}`  — builds per graph family
+  - `tz_compile_seconds_total{graph=}` — wall seconds spent building
+  - `tz_compile_cache_size{graph=}`    — live executables per family
+  - `tz_compile_storms_total`          — storm incidents fired
+
+Storm detection: TZ_COMPILE_STORM_N builds of the SAME graph family
+at the SAME cache key inside TZ_COMPILE_STORM_WINDOW_S means the
+executable cache is being lost and rebuilt — the exact failure mode
+that ate the tier-1 budget.  The incident (`compile_storm` flight
+dump + `compile.storm` event) is self-diagnosing: it carries the
+storming key and its diff against the family's previous distinct key,
+so "what shape keeps changing?" (or "nothing — the cache itself was
+dropped") is in the payload, not in an afternoon of log archaeology.
+One incident per storm episode, not one per build.
+
+This observatory is also the single authority the warm-rig jit-count
+guards assert against: `assert_no_new_compiles` replaces the
+scattered `_cache_size()` tuple snapshots in tests/test_health_faults
+— it watches both the caller's jit caches AND the process build
+ledger, and a failure names the graphs that built instead of leaving
+a bare tuple mismatch.
+
+Host-side only; nothing here runs inside jitted code.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+ENV_STORM_N = "TZ_COMPILE_STORM_N"
+ENV_STORM_WINDOW = "TZ_COMPILE_STORM_WINDOW_S"
+
+DEFAULT_STORM_N = 2
+DEFAULT_STORM_WINDOW_S = 600.0
+
+#: Bounded recent-build ring (diagnosis payloads; guards read deltas).
+BUILD_RING = 128
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    try:
+        return max(2, int(raw, 0)) if raw else default
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    try:
+        return float(raw) if raw else default
+    except (TypeError, ValueError):
+        return default
+
+
+def _canon_key(key) -> tuple:
+    """Canonical hashable form of a cache key: dicts sort into
+    (field, value) pairs so equal shapes compare equal regardless of
+    construction order; everything else is wrapped as given."""
+    if isinstance(key, dict):
+        return tuple(sorted((str(k), str(v)) for k, v in key.items()))
+    if isinstance(key, tuple):
+        return tuple(str(v) for v in key)
+    return (str(key),)
+
+
+def key_diff(a: tuple, b: tuple) -> dict:
+    """Field-wise diff of two canonical cache keys.  {} means the
+    keys are identical — for a storm that reads "same shape rebuilt:
+    the executable cache was dropped", the worst of the two causes."""
+    da = dict(a) if a and all(isinstance(p, tuple) and len(p) == 2
+                              for p in a) else {"key": a}
+    db = dict(b) if b and all(isinstance(p, tuple) and len(p) == 2
+                              for p in b) else {"key": b}
+    out = {}
+    for f in sorted(set(da) | set(db)):
+        if da.get(f) != db.get(f):
+            out[f] = [da.get(f), db.get(f)]
+    return out
+
+
+class CompileObservatory:
+    """The process-wide build ledger + storm detector."""
+
+    def __init__(self, registry=None, flight=None):
+        self._lock = threading.Lock()
+        self._registry = registry
+        self._flight = flight
+        self._total = 0
+        self._storms = 0
+        self._counts: dict[tuple, int] = {}  # (graph, key) -> builds
+        self._recent: deque = deque(maxlen=BUILD_RING)
+        self._stamps: dict[tuple, deque] = {}
+        self._storm_mute: dict[tuple, float] = {}
+        self._last_key: dict[str, tuple] = {}
+        self._metrics: dict = {}
+
+    def _reg(self):
+        if self._registry is None:
+            from syzkaller_tpu import telemetry
+
+            self._registry = telemetry.REGISTRY
+        return self._registry
+
+    def _flt(self):
+        if self._flight is None:
+            from syzkaller_tpu import telemetry
+
+            self._flight = telemetry.FLIGHT
+        return self._flight
+
+    def _counter(self, name: str, help: str, graph: str):
+        key = (name, graph)
+        m = self._metrics.get(key)
+        if m is None:
+            m = self._reg().counter(name, help,
+                                    labels={"graph": graph})
+            self._metrics[key] = m
+        return m
+
+    # -- recording ---------------------------------------------------------
+
+    def note(self, graph: str, key=None, seconds: float = 0.0) -> None:
+        """One build of `graph` at cache key `key` (a dict of the
+        static shape fields), taking `seconds` of wall time.  Called
+        from the compile points only — a warm dispatch that reuses an
+        executable must NOT note."""
+        ck = _canon_key(key)
+        now = time.monotonic()
+        storm = None
+        with self._lock:
+            self._total += 1
+            self._counts[(graph, ck)] = \
+                self._counts.get((graph, ck), 0) + 1
+            self._recent.append((round(time.time(), 3), graph, ck,
+                                 round(seconds, 4)))
+            # The family's previous DISTINCT key: the storm payload
+            # diffs the storming shape against it, so "what field
+            # keeps churning?" is answerable from the incident alone.
+            cur = self._last_key.get(graph)
+            if cur is not None and cur[1] != ck:
+                prev = cur[1]
+                self._last_key[graph] = (prev, ck)
+            elif cur is None:
+                prev = None
+                self._last_key[graph] = (None, ck)
+            else:
+                prev = cur[0]
+            stamps = self._stamps.setdefault(
+                (graph, ck), deque(maxlen=_env_int(
+                    ENV_STORM_N, DEFAULT_STORM_N)))
+            stamps.append(now)
+            window = _env_float(ENV_STORM_WINDOW,
+                                DEFAULT_STORM_WINDOW_S)
+            n = _env_int(ENV_STORM_N, DEFAULT_STORM_N)
+            if (len(stamps) >= n and now - stamps[0] <= window
+                    and now >= self._storm_mute.get((graph, ck), 0.0)):
+                # One incident per episode: mute this (graph, key)
+                # until the window drains past the storming builds.
+                self._storm_mute[(graph, ck)] = now + window
+                self._storms += 1
+                storm = (len(stamps), now - stamps[0], prev)
+        self._counter("tz_compile_builds_total",
+                      "executable builds per graph family", graph).inc()
+        self._counter("tz_compile_seconds_total",
+                      "wall seconds spent building executables",
+                      graph).inc(seconds)
+        if storm is not None:
+            self._fire_storm(graph, ck, *storm)
+
+    def _fire_storm(self, graph: str, ck: tuple, n: int,
+                    span_s: float, prev: Optional[tuple]) -> None:
+        from syzkaller_tpu import telemetry
+
+        diff = key_diff(prev, ck) if prev is not None else {}
+        cause = ("identical cache key — the executable cache was "
+                 "dropped" if not diff else
+                 f"key churn on {sorted(diff)}")
+        detail = (f"{graph}: {n} builds of one shape in "
+                  f"{span_s:.1f}s ({cause})")
+        telemetry.counter("tz_compile_storms_total",
+                          "compile-storm incidents").inc()
+        telemetry.record_event("compile.storm", detail)
+        self._flt().dump("compile_storm", detail, extra={
+            "compile_storm": {
+                "graph": graph,
+                "key": list(ck),
+                "builds": n,
+                "span_s": round(span_s, 3),
+                "key_diff": diff,
+            },
+            "compiles": self.snapshot(),
+        })
+
+    def set_cache_size(self, graph: str, size: int) -> None:
+        """Live executable count for one family (the `_shared_step`
+        lru and the mesh `_graphs` dict publish theirs here)."""
+        key = ("tz_compile_cache_size", graph)
+        g = self._metrics.get(key)
+        if g is None:
+            g = self._reg().gauge("tz_compile_cache_size",
+                                  "live executables per graph family",
+                                  labels={"graph": graph})
+            self._metrics[key] = g
+        g.set(size)
+
+    @contextlib.contextmanager
+    def observe(self, graph: str, key=None, sizer=None):
+        """Time a potential compile point: notes a build only when
+        `sizer()` (a jit `_cache_size` callable) grew across the body
+        — a warm dispatch that reuses the executable records nothing,
+        so warm rigs stay storm-silent.  With no sizer the body IS
+        the build (a cache-miss branch)."""
+        before = sizer() if sizer is not None else None
+        t0 = time.perf_counter()
+        yield
+        dur = time.perf_counter() - t0
+        if sizer is None or sizer() > before:
+            self.note(graph, key, dur)
+
+    # -- the guard authority -----------------------------------------------
+
+    def total_builds(self) -> int:
+        with self._lock:
+            return self._total
+
+    def builds(self, graph: Optional[str] = None) -> int:
+        with self._lock:
+            if graph is None:
+                return self._total
+            return sum(c for (g, _k), c in self._counts.items()
+                       if g == graph)
+
+    def shapes(self, graph: str) -> dict:
+        """key -> build count for one family (the mesh drill pins its
+        exactly-2-graphs invariant on len() of this)."""
+        with self._lock:
+            return {k: c for (g, k), c in self._counts.items()
+                    if g == graph}
+
+    def recent(self, n: int = 8) -> list:
+        with self._lock:
+            return list(self._recent)[-n:]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            fams: dict[str, dict] = {}
+            for (g, k), c in self._counts.items():
+                f = fams.setdefault(g, {"builds": 0, "shapes": 0})
+                f["builds"] += c
+                f["shapes"] += 1
+            return {
+                "total_builds": self._total,
+                "storms": self._storms,
+                "graphs": dict(sorted(fams.items())),
+                "recent": list(self._recent)[-8:],
+            }
+
+
+@contextlib.contextmanager
+def assert_no_new_compiles(*sizers, observatory=None):
+    """The shared warm-rig compile guard (replaces the per-test
+    `_cache_size()` tuple snapshots of PR 9/10/12/14): no watched jit
+    cache may grow and the process CompileObservatory must record
+    zero new builds across the body.  A violation names the graphs
+    that built — the observatory is the authority, so the assertion
+    message is the diagnosis."""
+    if observatory is None:
+        from syzkaller_tpu import telemetry
+
+        observatory = telemetry.COMPILES
+    before = [s() for s in sizers]
+    builds0 = observatory.total_builds()
+    yield
+    after = [s() for s in sizers]
+    new_builds = observatory.total_builds() - builds0
+    problems = []
+    for i, (b, a) in enumerate(zip(before, after)):
+        if a != b:
+            problems.append(f"watched jit cache #{i} grew {b} -> {a}")
+    if new_builds:
+        problems.append(
+            f"{new_builds} new build(s): "
+            f"{observatory.recent(new_builds)}")
+    if problems:
+        raise AssertionError(
+            "new jit compiles on a warm rig: " + "; ".join(problems))

@@ -87,9 +87,20 @@ class FlightRecorder:
         self._dir = os.environ.get(ENV_DIR) or None
         self.min_interval_s = 30.0
         self.dumps = 0
+        self._context: dict[str, object] = {}
 
     def attach_registry(self, registry) -> None:
         self._registry = registry
+
+    def add_context(self, key: str, fn) -> None:
+        """Register a payload provider folded into EVERY incident
+        snapshot under `key` (ISSUE 17: the HBM residency table and
+        the compile ledger ride every wedge/SIGTERM/slo-burn dump
+        this way).  Providers run at snapshot time, best-effort — a
+        provider that raises contributes nothing, never a failed
+        dump."""
+        with self._lock:
+            self._context[key] = fn
 
     # -- recording ---------------------------------------------------------
 
@@ -128,6 +139,7 @@ class FlightRecorder:
         with self._lock:
             spans = list(self._spans)
             gauges = list(self._gauges)
+            context = dict(self._context)
         reg_snap = self._registry.snapshot() if self._registry else {}
         events = reg_snap.get("events") or []
         payload = {
@@ -146,6 +158,11 @@ class FlightRecorder:
             "registry": {k: reg_snap.get(k) for k in
                          ("counters", "gauges", "histograms")},
         }
+        for key, fn in context.items():
+            try:
+                payload[key] = fn()
+            except Exception:
+                pass
         if extra:
             payload.update(extra)
         return payload

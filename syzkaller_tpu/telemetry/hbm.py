@@ -1,0 +1,453 @@
+"""Device-residency ledger: every long-lived HBM buffer, accounted.
+
+Every observability layer before this one accounts for *time* —
+spans (ISSUE 2), lineage waits (ISSUE 6), device-ms chargeback
+(ISSUE 14) — but the scarcest resource on the chip is HBM, and until
+now nothing could answer "what is resident right now, who owns it,
+and how much headroom is left for the flagship batch?".  This module
+is that answer (ISSUE 17): a process-wide ledger of long-lived
+buffers — the signal plane and its mesh shards, the mutant and
+speculation planes, sim table stacks, per-tenant planes, the pipeline
+corpus/flag/prio tables, and the StagingArena's pinned host staging —
+each registered under `{owner, device, kind}` labels.
+
+Exports:
+  - `tz_hbm_live_bytes{owner=,device=,kind=}` — current resident bytes
+  - `tz_hbm_peak_bytes{owner=}`               — per-owner high-water
+  - `tz_hbm_transient_bytes`                  — per-batch working-set
+    estimate at the CURRENT batch shape (fed by the pipeline drain)
+  - `tz_hbm_headroom_bytes`                   — capacity − resident −
+    transient: the projected free bytes at the flagship batch shape,
+    the direct sizing input for the ROADMAP's HBM corpus arena
+
+Registration is handle-based: an owner registers once and updates the
+handle when its buffer is rebuilt (plane invalidation, half-open ring
+rebuild, mesh re-shard), so a rebuilt buffer REPLACES its ledger entry
+instead of double-counting.  Handles hold weakrefs to the registered
+arrays — never strong refs, so the ledger can never extend a buffer's
+lifetime — and those weakrefs are what `reconcile()` checks against
+the backend's live-buffer report (`jax.live_arrays()`): tracked bytes
+must equal the backend-reported bytes for exactly those buffers, or an
+`hbm.drift` flight incident fires (leaks and orphaned shards become
+visible, not latent).  The triage engine runs reconcile at its
+analytics cadence; nothing here ever runs inside jitted code.
+
+Knobs (flight.py-style envsafe degradation — malformed values keep
+the default; names live in health.envsafe.KNOWN_TZ_VARS):
+  - TZ_HBM_CAPACITY_BYTES: HBM capacity for the headroom forecast.
+    0 (default) probes the backend's memory_stats and falls back to
+    16 GiB on backends that report none (CPU tests).
+  - TZ_HBM_DRIFT_TOLERANCE_BYTES: reconcile mismatch tolerance
+    (default 0 — conservation is exact).
+  - TZ_HBM_RECONCILE: 0 disarms the cadence reconcile (default 1).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import Optional
+
+ENV_CAPACITY = "TZ_HBM_CAPACITY_BYTES"
+ENV_TOLERANCE = "TZ_HBM_DRIFT_TOLERANCE_BYTES"
+ENV_RECONCILE = "TZ_HBM_RECONCILE"
+
+#: Headroom fallback when the backend reports no memory_stats (CPU
+#: tests, older plugins).  Deliberately conservative — a v4 chip has
+#: 32 GiB/core and a v5p 95 GiB; the knob restores any real value.
+DEFAULT_CAPACITY_BYTES = 16 << 30
+
+#: The closed set of ledger owners.  tools/lint_metrics.py cross-checks
+#: every `HBM.register(...)`/`ledger.register(...)` call site against
+#: this table — an owner string outside it (or an entry with no call
+#: site) is a lint failure, so a new subsystem holding persistent
+#: device state must declare itself here.
+OWNERS = ("mesh", "pipeline", "serve", "sim", "staging", "triage")
+
+#: Buffers living in host memory (pinned staging arenas, host
+#: mirrors, per-tenant planes) register under device="host": they are
+#: accounted and surfaced like everything else but excluded from the
+#: headroom forecast and the backend reconcile — the live-buffer
+#: report covers device allocations only.
+DEVICE_HOST = "host"
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    try:
+        return int(raw, 0) if raw else default
+    except (TypeError, ValueError):
+        return default
+
+
+def _nbytes_and_refs(buffers) -> tuple[int, list]:
+    """Total bytes + weakrefs for one registration payload: a single
+    array, a list/tuple of arrays, a dict of arrays, or a plain byte
+    count (no refs — excluded from identity reconcile)."""
+    if buffers is None:
+        return 0, []
+    if isinstance(buffers, int):
+        return buffers, []
+    if isinstance(buffers, dict):
+        buffers = list(buffers.values())
+    elif not isinstance(buffers, (list, tuple)):
+        buffers = [buffers]
+    total, refs = 0, []
+    for a in buffers:
+        total += int(a.nbytes)
+        refs.append(weakref.ref(a))
+    return total, refs
+
+
+def _device_label(buffers) -> str:
+    """Device label for a payload: the owning device id, an id range
+    for sharded arrays (mesh planes), or "host" for numpy/plain-byte
+    registrations."""
+    if buffers is None or isinstance(buffers, int):
+        return DEVICE_HOST
+    if isinstance(buffers, dict):
+        buffers = list(buffers.values())
+    elif not isinstance(buffers, (list, tuple)):
+        buffers = [buffers]
+    ids: set[int] = set()
+    for a in buffers:
+        devs = getattr(a, "devices", None)
+        if devs is None:
+            continue
+        try:
+            ids.update(d.id for d in a.devices())
+        except Exception:
+            continue
+    if not ids:
+        return DEVICE_HOST
+    lo, hi = min(ids), max(ids)
+    return str(lo) if lo == hi else f"{lo}-{hi}"
+
+
+class BufferHandle:
+    """One owner's registration for one buffer (or buffer group).
+    `update()` when the buffer is rebuilt; `close()` when it is gone
+    for good.  Both are cheap — a lock, a weakref sweep over the
+    payload, and a per-label gauge refresh."""
+
+    __slots__ = ("_ledger", "owner", "kind", "device", "nbytes",
+                 "_refs", "closed")
+
+    def __init__(self, ledger, owner: str, kind: str, device: str):
+        self._ledger = ledger
+        self.owner = owner
+        self.kind = kind
+        self.device = device
+        self.nbytes = 0
+        self._refs: list = []
+        self.closed = False
+
+    def update(self, buffers, device: Optional[str] = None) -> None:
+        self._ledger._update(self, buffers, device)
+
+    def close(self) -> None:
+        self._ledger._close(self)
+
+    def _close_quiet(self) -> None:
+        """Finalizer-path close (register's bound_to): runs inside the
+        garbage collector, which can fire while ANY thread holds the
+        ledger lock (the publish sweep allocates), so it must never
+        take that lock — flag only; the next locked publish prunes
+        the entry and refreshes the gauges."""
+        self.closed = True
+        self.nbytes = 0
+        self._refs = []
+
+    def live_refs(self) -> list:
+        """The registered arrays still alive (reconcile identity)."""
+        return [a for a in (r() for r in self._refs) if a is not None]
+
+
+class DeviceBufferLedger:
+    """The process-wide {owner, device, kind} residency ledger."""
+
+    def __init__(self, registry=None, flight=None):
+        self._lock = threading.Lock()
+        self._registry = registry
+        self._flight = flight
+        self._handles: list[BufferHandle] = []
+        self._peaks: dict[str, int] = {}
+        self._transients: dict[str, int] = {}
+        self._published: set[tuple] = set()
+        self._gauges: dict = {}
+        self.last_reconcile: dict = {}
+        self._headroom_gauge = None
+        self._strikes = 0
+
+    # -- registry plumbing -------------------------------------------------
+
+    def _reg(self):
+        if self._registry is None:
+            from syzkaller_tpu import telemetry
+
+            self._registry = telemetry.REGISTRY
+        return self._registry
+
+    def _flt(self):
+        if self._flight is None:
+            from syzkaller_tpu import telemetry
+
+            self._flight = telemetry.FLIGHT
+        return self._flight
+
+    def _gauge(self, name: str, help: str, labels=None):
+        key = (name, tuple(sorted((labels or {}).items())))
+        g = self._gauges.get(key)
+        if g is None:
+            g = self._reg().gauge(name, help, labels=labels)
+            self._gauges[key] = g
+        return g
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, owner: str, kind: str, buffers=None,
+                 device: Optional[str] = None,
+                 bound_to=None) -> BufferHandle:
+        """Register one long-lived buffer (group) under
+        {owner, device, kind}; returns the handle the owner keeps for
+        rebuild updates.  `buffers`: array / list / dict of arrays, or
+        a plain byte count for opaque host allocations.  `bound_to`
+        ties the handle's lifetime to the owning engine object: when
+        that object is collected the handle closes itself, so a
+        transient engine (a re-created triage engine, a dropped sim
+        prescorer) cannot rot the ledger with orphaned entries that
+        reconcile would forever flag as drift."""
+        h = BufferHandle(self, owner, kind,
+                         device or _device_label(buffers))
+        with self._lock:
+            self._handles.append(h)
+            self._set_locked(h, buffers, device)
+        if bound_to is not None:
+            weakref.finalize(bound_to, h._close_quiet)
+        return h
+
+    def _update(self, h: BufferHandle, buffers,
+                device: Optional[str]) -> None:
+        with self._lock:
+            if h.closed:
+                return
+            self._set_locked(h, buffers, device)
+
+    def _set_locked(self, h: BufferHandle, buffers,
+                    device: Optional[str]) -> None:
+        h.nbytes, h._refs = _nbytes_and_refs(buffers)
+        if device is not None:
+            h.device = device
+        elif h._refs:
+            h.device = _device_label(buffers)
+        self._publish_locked()
+
+    def _close(self, h: BufferHandle) -> None:
+        with self._lock:
+            if h.closed:
+                return
+            h.closed = True
+            h.nbytes, h._refs = 0, []
+            try:
+                self._handles.remove(h)
+            except ValueError:
+                pass
+            self._publish_locked()
+
+    def _publish_locked(self) -> None:
+        """Refresh the labeled gauge families from the handle list.
+        The per-batch ledger tax IS this sweep — a dict sum over a
+        handful of handles (bench.py --device pins it ≤ 50 µs)."""
+        if any(h.closed for h in self._handles):
+            # Entries flag-closed lock-free by the finalizer path
+            # (bound_to engines collected since the last sweep).
+            self._handles = [h for h in self._handles if not h.closed]
+        sums: dict[tuple, int] = {}
+        owners: dict[str, int] = {}
+        for h in self._handles:
+            k = (h.owner, h.device, h.kind)
+            sums[k] = sums.get(k, 0) + h.nbytes
+            owners[h.owner] = owners.get(h.owner, 0) + h.nbytes
+        for k, v in sums.items():
+            owner, device, kind = k
+            self._gauge("tz_hbm_live_bytes",
+                        "resident bytes per registered buffer group",
+                        labels={"owner": owner, "device": device,
+                                "kind": kind}).set(v)
+        for k in self._published - set(sums):
+            owner, device, kind = k
+            self._gauge("tz_hbm_live_bytes", "",
+                        labels={"owner": owner, "device": device,
+                                "kind": kind}).set(0)
+        self._published = set(sums)
+        for owner, v in owners.items():
+            peak = max(self._peaks.get(owner, 0), v)
+            self._peaks[owner] = peak
+            self._gauge("tz_hbm_peak_bytes",
+                        "per-owner resident high-water mark",
+                        labels={"owner": owner}).set(peak)
+        if self._headroom_gauge is None:
+            self._headroom_gauge = self._reg().gauge(
+                "tz_hbm_headroom_bytes",
+                "projected free HBM at the flagship batch shape",
+                fn=self.headroom)
+            self._reg().gauge(
+                "tz_hbm_transient_bytes",
+                "per-batch transient working-set estimate",
+                fn=lambda: sum(self._transients.values()))
+
+    # -- the headroom forecast ---------------------------------------------
+
+    def note_transient(self, owner: str, nbytes: int) -> None:
+        """Per-batch transient working set at the current (flagship)
+        batch shape — the pipeline drain feeds its observed per-batch
+        bytes here, so the headroom forecast subtracts what one
+        in-flight batch needs on top of the resident set."""
+        with self._lock:
+            self._transients[owner] = int(nbytes)
+
+    def capacity_bytes(self) -> int:
+        cap = _env_int(ENV_CAPACITY, 0)
+        if cap > 0:
+            return cap
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats()
+            if stats and stats.get("bytes_limit"):
+                return int(stats["bytes_limit"])
+        except Exception:
+            pass
+        return DEFAULT_CAPACITY_BYTES
+
+    def live_bytes(self, owner: Optional[str] = None,
+                   device_only: bool = False) -> int:
+        with self._lock:
+            return sum(
+                h.nbytes for h in self._handles
+                if (owner is None or h.owner == owner)
+                and not (device_only and h.device == DEVICE_HOST))
+
+    def headroom(self) -> int:
+        """capacity − device-resident − per-batch transient: the
+        projected free bytes at the flagship batch shape (the sizing
+        input for the device-resident corpus arena)."""
+        with self._lock:
+            resident = sum(h.nbytes for h in self._handles
+                           if h.device != DEVICE_HOST)
+            transient = sum(self._transients.values())
+        return self.capacity_bytes() - resident - transient
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self, live_arrays=None,
+                  tolerance: Optional[int] = None) -> dict:
+        """Check conservation against the backend's live-buffer
+        report: the bytes this ledger tracks for device buffers must
+        equal the backend-reported bytes of exactly those buffers.  A
+        mismatch beyond TZ_HBM_DRIFT_TOLERANCE_BYTES (an entry whose
+        array died without an update — an orphaned shard — or bytes
+        the backend no longer reports — a leak upstream of a handle)
+        raises an `hbm.drift` flight incident.  Runs at the triage
+        engine's analytics cadence; never raises."""
+        t0 = time.perf_counter()
+        with self._lock:
+            # Identity-checkable device entries only: host memory
+            # is outside the backend report, and an opaque byte-count
+            # registration (no refs) has no identity to check.
+            entries = [(h, h.nbytes, list(h._refs))
+                       for h in self._handles
+                       if h.device != DEVICE_HOST and h._refs]
+        tracked, dead, tracked_ids = 0, 0, set()
+        for _h, nbytes, refs in entries:
+            live = [a for a in (r() for r in refs) if a is not None]
+            if refs and not live:
+                dead += 1
+                continue
+            tracked += nbytes
+            tracked_ids.update(id(a) for a in live)
+        if live_arrays is None:
+            try:
+                import jax
+
+                live_arrays = jax.live_arrays()
+            except Exception:
+                live_arrays = []
+        backend = sum(int(a.nbytes) for a in live_arrays
+                      if id(a) in tracked_ids)
+        drift = tracked - backend
+        if tolerance is None:
+            tolerance = _env_int(ENV_TOLERANCE, 0)
+        seconds = time.perf_counter() - t0
+        flagged = abs(drift) > tolerance or dead > 0
+        out = {
+            "tracked_bytes": tracked,
+            "backend_bytes": backend,
+            "drift_bytes": drift,
+            "dead_entries": dead,
+            "entries": len(entries),
+            "flagged": flagged,
+            "seconds": round(seconds, 6),
+        }
+        self.last_reconcile = out
+        # Two-strike incident rule: an owner legitimately replacing a
+        # buffer between the array swap and its handle update (the
+        # pipeline worker races the analytics thread) reads as drift
+        # for one pass and self-heals; a real leak or orphaned shard
+        # persists.  Only the second consecutive flagged reconcile
+        # fires the incident — and only ONCE per episode (same muting
+        # as the compile-storm detector): a persistent leak must not
+        # flood the event ring and the flight dir at every analytics
+        # pass.  A clean reconcile re-arms.
+        if flagged:
+            self._strikes += 1
+        else:
+            self._strikes = 0
+        if flagged and self._strikes == 2:
+            from syzkaller_tpu import telemetry
+
+            detail = (f"ledger drift {drift} bytes "
+                      f"({dead} orphaned entries)")
+            telemetry.counter(
+                "tz_hbm_drift_total",
+                "reconcile mismatches vs the backend report").inc()
+            telemetry.record_event("hbm.drift", detail)
+            self._flt().dump("hbm_drift", detail,
+                             extra={"hbm": self.snapshot()})
+        return out
+
+    def reconcile_armed(self) -> bool:
+        return _env_int(ENV_RECONCILE, 1) != 0
+
+    # -- exposition --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-ready residency table: per-owner totals and peaks,
+        the per-{owner, device, kind} breakdown, and the headroom
+        forecast (manager /api/device, flight incidents)."""
+        with self._lock:
+            rows = {}
+            owners: dict[str, int] = {}
+            for h in self._handles:
+                if h.closed:
+                    continue
+                k = f'{h.owner}/{h.kind}@{h.device}'
+                rows[k] = rows.get(k, 0) + h.nbytes
+                owners[h.owner] = owners.get(h.owner, 0) + h.nbytes
+            peaks = dict(self._peaks)
+            transient = sum(self._transients.values())
+            resident_dev = sum(h.nbytes for h in self._handles
+                               if h.device != DEVICE_HOST)
+        return {
+            "owners": {o: {"live_bytes": v,
+                           "peak_bytes": peaks.get(o, v)}
+                       for o, v in sorted(owners.items())},
+            "buffers": dict(sorted(rows.items())),
+            "device_resident_bytes": resident_dev,
+            "transient_bytes": transient,
+            "capacity_bytes": self.capacity_bytes(),
+            "headroom_bytes": self.headroom(),
+            "last_reconcile": dict(self.last_reconcile),
+        }

@@ -17,10 +17,25 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 
 ENV_VAR = "TZ_TRACE_FILE"
+
+#: Process-track name override for merged multi-process traces.  The
+#: default derives from argv[0], which tells manager / fuzzer / hub
+#: apart already; the knob is for launchers that exec one binary in
+#: several roles.
+ENV_PROCESS = "TZ_TRACE_PROCESS"
+
+
+def _process_name() -> str:
+    name = os.environ.get(ENV_PROCESS)
+    if name:
+        return name
+    base = os.path.basename(sys.argv[0] or "") if sys.argv else ""
+    return os.path.splitext(base)[0] or "tz"
 
 
 class TraceWriter:
@@ -48,11 +63,28 @@ class TraceWriter:
         if self._file is None and self._path is not None:
             self._file = open(self._path, "w")
             self._file.write("[\n")
+            pid = os.getpid()
             meta = {"name": "process_start", "ph": "i", "ts": 0,
-                    "pid": os.getpid(), "tid": 0, "s": "g",
+                    "pid": pid, "tid": 0, "s": "g",
                     "args": {"wallclock": time.time(),
                              "perf_counter": time.perf_counter()}}
             self._file.write(json.dumps(meta) + ",\n")
+            # Chrome metadata events ("ph": "M"): concatenated
+            # multi-process traces (manager + fuzzers + hub merged in
+            # Perfetto) render each pid as its own NAMED process
+            # track instead of interleaving anonymous ones.  The
+            # sort_index keeps track order stable by pid.
+            name = f"{_process_name()}/{pid}"
+            for ev in (
+                {"name": "process_name", "ph": "M", "pid": pid,
+                 "tid": 0, "args": {"name": name}},
+                {"name": "process_sort_index", "ph": "M", "pid": pid,
+                 "tid": 0, "args": {"sort_index": pid}},
+                {"name": "thread_name", "ph": "M", "pid": pid,
+                 "tid": threading.get_ident(),
+                 "args": {"name": threading.current_thread().name}},
+            ):
+                self._file.write(json.dumps(ev) + ",\n")
         return self._file
 
     def emit(self, name: str, t0: float, dur: float,

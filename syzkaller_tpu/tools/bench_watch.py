@@ -416,6 +416,39 @@ def wedge_report(snap: dict) -> list[str]:
                       - hub_failover)
             line += f", last failover {age:.0f}s ago"
         lines.append(line)
+    # Device residency observatory (ISSUE 17): who holds HBM and what
+    # keeps compiling — headroom collapsing toward zero across an A/B
+    # is a buffer leak, and a climbing build count on a warm rig is
+    # the compile-storm failure mode that eats the batch budget.
+    hbm_groups = {}
+    for k, v in gauges.items():
+        if k.startswith('tz_hbm_live_bytes{') and v:
+            owner = k.split('owner="', 1)[1].split('"', 1)[0]
+            dev = k.split('device="', 1)[1].split('"', 1)[0]
+            kind = k.split('kind="', 1)[1].split('"', 1)[0]
+            hbm_groups[f"{owner}/{kind}@{dev}"] = v
+    headroom = gauges.get("tz_hbm_headroom_bytes")
+    if hbm_groups:
+        line = "device residency: " + " ".join(
+            f"{g}:{v / 1e6:.1f}MB"
+            for g, v in sorted(hbm_groups.items()))
+        if headroom is not None:
+            line += f", headroom {headroom / 1e9:.2f}GB"
+        drifts = counters.get("tz_hbm_drift_total") or 0
+        if drifts:
+            line += f", {int(drifts)} reconcile DRIFTS"
+        lines.append(line)
+    builds = {}
+    for k, v in counters.items():
+        if k.startswith('tz_compile_builds_total{') and v:
+            builds[k.split('graph="', 1)[1].rstrip('"}')] = v
+    if builds:
+        line = "compiles: " + " ".join(
+            f"{g}={int(v)}" for g, v in sorted(builds.items()))
+        storms = counters.get("tz_compile_storms_total") or 0
+        if storms:
+            line += f" — {int(storms)} STORMS"
+        lines.append(line)
     attr = {}
     for k, v in counters.items():
         if k.startswith('tz_coverage_novel_edges_total{') and v:
@@ -576,6 +609,74 @@ def coverage_report(payload: dict) -> list[str]:
     return lines
 
 
+def device_report(payload: dict) -> list[str]:
+    """Render a /api/device payload (manager/html.py
+    `_device_payload`: {"hbm": ..., "compiles": ...}) into
+    diagnostic lines — the residency table, the headroom/reconcile
+    verdict, and the per-family compile ledger.  Pure function —
+    pinned by tests with no live manager."""
+    hbm = payload.get("hbm") or {}
+    comp = payload.get("compiles") or {}
+    lines: list[str] = []
+    lines.append(
+        f"residency: "
+        f"{hbm.get('device_resident_bytes', 0) / 1e6:.1f} MB "
+        f"device-resident of "
+        f"{hbm.get('capacity_bytes', 0) / 1e9:.1f} GB, headroom "
+        f"{hbm.get('headroom_bytes', 0) / 1e9:.2f} GB, transient "
+        f"{hbm.get('transient_bytes', 0) / 1e6:.1f} MB")
+    for k, v in sorted((hbm.get("buffers") or {}).items()):
+        lines.append(f"  {k}: {v / 1e6:.1f} MB")
+    rec = hbm.get("last_reconcile") or {}
+    if rec:
+        verdict = (f"DRIFT {rec.get('drift_bytes', 0)} B"
+                   if rec.get("flagged") else
+                   f"drift {rec.get('drift_bytes', 0)} B (tolerated)")
+        lines.append(
+            f"  reconcile: {verdict} over {rec.get('entries', 0)} "
+            f"entries, backend {rec.get('backend_bytes', 0) / 1e6:.1f}"
+            f" MB vs tracked "
+            f"{rec.get('tracked_bytes', 0) / 1e6:.1f} MB")
+    else:
+        lines.append("  reconcile: never ran")
+    graphs = comp.get("graphs") or {}
+    if graphs:
+        lines.append(
+            "compiles: " + " ".join(
+                f"{g}={f['builds']}({f['shapes']} shapes)"
+                for g, f in sorted(graphs.items()))
+            + (f" — {comp['storms']} STORMS"
+               if comp.get("storms") else ""))
+    for ts, graph, key, secs in (comp.get("recent") or [])[-4:]:
+        stamp = time.strftime("%H:%M:%S", time.localtime(ts))
+        lines.append(f"  {stamp} built {graph} in {secs:.2f}s")
+    return lines
+
+
+def report_device(url: str | None = None) -> None:
+    """Fetch and log the manager's /api/device residency payload (the
+    device-residency layer of diagnose_wedge).  Without a manager URL
+    the tz_hbm_*/tz_compile_* lines in wedge_report already cover the
+    local snapshot view."""
+    url = url or os.environ.get("TZ_MANAGER_HTTP", "")
+    if not url:
+        log("diagnose: no TZ_MANAGER_HTTP set — device residency "
+            "limited to the telemetry-snapshot lines above")
+        return
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/api/device", timeout=10) as r:
+            payload = json.loads(r.read().decode())
+    except Exception as e:
+        log(f"diagnose: /api/device unreachable at {url}: {e}")
+        return
+    log("diagnose: device residency (/api/device):")
+    for line in device_report(payload):
+        log(f"  {line}")
+
+
 def report_coverage(url: str | None = None) -> None:
     """Fetch and log the manager's /api/coverage rollup (the
     coverage-trajectory layer of diagnose_wedge).  The manager URL
@@ -603,7 +704,7 @@ def report_coverage(url: str | None = None) -> None:
 def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
     """On measurement timeout: capture WHAT hangs, not just that it hangs.
 
-    Four layers, logged in order:
+    Eight layers, logged in order:
     1. Python stack of the hung init (faulthandler dump while
        jax.devices() blocks) — distinguishes backend-init vs dispatch.
     2. Thread table of the hung subprocess (/proc wchan) — tells an
@@ -612,6 +713,10 @@ def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
        (PALLAS_AXON_POOL_IPS : relay port) — TCP connect/greeting
        behavior tells loopback-listener state from upstream state.
     4. Who owns the listener (ss -tlnp), so 'wedged?' has a subject.
+    5. The last attempt's telemetry snapshot (report_telemetry).
+    6. Flight-recorder incident files (report_flight).
+    7. The coverage trajectory (report_coverage).
+    8. Device residency + compile ledger (report_device).
     """
     code = ("import faulthandler\n"
             f"faulthandler.dump_traceback_later({stack_timeout_s - 5},"
@@ -689,6 +794,10 @@ def diagnose_wedge(stack_timeout_s: float = 45.0) -> None:
     # plateaued fuzzer look identical from the flagship number alone;
     # the growth curve + stall verdict separates them.
     report_coverage()
+    # Layer 8: device residency + compile ledger — a wedge with HBM
+    # headroom gone is an OOM-adjacent stall, and a storming compile
+    # family says the executable cache is being lost and rebuilt.
+    report_device()
 
 
 def flagship_entries() -> int:

@@ -25,6 +25,11 @@ tests/test_tools.py invokes it):
      appear backticked in docs/observability.md, and every backticked
      dotted name in the doc whose namespace the code uses must exist
      in code.  Spans added in PRs 3-5 previously had no drift guard.
+  5. HBM owner check (ISSUE 17): the OWNERS tuple declared in
+     telemetry/hbm.py and the owner literals at `HBM.register("...")`
+     call sites must cover each other — an unregistered owner label
+     fragments the residency rollup, and a dead OWNERS entry is a
+     subsystem that silently lost its ledger wiring.
 
 Usage: python -m syzkaller_tpu.tools.lint_metrics [repo_root]
 """
@@ -57,6 +62,13 @@ _LIT_RE = re.compile(r"""['"](tz_[a-z0-9_]+)['"]""")
 _STAT_NAME_RE = re.compile(r'Stat\.[A-Z_0-9]+:\s*"([a-z ]+)"')
 _DOC_NAME_RE = re.compile(r"`(tz_[a-z0-9_]+)`")
 _DOC_DOTTED_RE = re.compile(r"`([a-z0-9_]+\.[a-z0-9_]+)`")
+#: HBM ledger owner labels: the declared vocabulary in
+#: telemetry/hbm.py and the literals at register() call sites
+#: (HBM.register in the tree, ledger.register in bench.py).
+_OWNERS_DECL_RE = re.compile(r"^OWNERS\s*=\s*\(([^)]*)\)", re.M)
+_OWNER_CALL_RE = re.compile(
+    r"""(?:HBM|ledger)\.register\(\s*\n?\s*['"]([a-z0-9_]+)['"]""")
+_QUOTED_RE = re.compile(r"""['"]([a-z0-9_]+)['"]""")
 #: Backticked dotted names in the doc that end like file paths are
 #: prose, not span/event names.
 _FILEISH = (".py", ".md", ".go", ".json", ".jsonl", ".js", ".txt")
@@ -133,6 +145,32 @@ def scan_sources(root: str):
     return registered, literals, dotted
 
 
+def scan_owners(root: str):
+    """(declared OWNERS from telemetry/hbm.py, owner literals at
+    HBM.register call sites as (file, owner))."""
+    declared: set[str] = set()
+    hbm_path = os.path.join(root, "syzkaller_tpu", "telemetry",
+                            "hbm.py")
+    try:
+        with open(hbm_path) as f:
+            m = _OWNERS_DECL_RE.search(f.read())
+        if m:
+            declared = set(_QUOTED_RE.findall(m.group(1)))
+    except OSError:
+        pass
+    used: list[tuple[str, str]] = []
+    for path in _source_files(root):
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(path, root)
+        for m in _OWNER_CALL_RE.finditer(src):
+            used.append((rel, m.group(1)))
+    return declared, used
+
+
 def doc_names(docs_path: str) -> set[str]:
     try:
         with open(docs_path) as f:
@@ -192,6 +230,19 @@ def lint(root: str, docs_path: str | None = None) -> list[str]:
             f"{name}: span/event/stage name catalogued in "
             f"{os.path.basename(docs_path)} but not used anywhere in "
             "the source tree")
+    # HBM owner vocabulary (ISSUE 17): both directions.
+    declared_owners, owner_sites = scan_owners(root)
+    if declared_owners:
+        for rel, owner in sorted(set(owner_sites)):
+            if owner not in declared_owners:
+                problems.append(
+                    f"{rel}: HBM.register owner {owner!r} is not in "
+                    "telemetry/hbm.py OWNERS")
+        used_owners = {o for _rel, o in owner_sites}
+        for owner in sorted(declared_owners - used_owners):
+            problems.append(
+                f"{owner}: declared in telemetry/hbm.py OWNERS but no "
+                "HBM.register call site uses it")
     return problems
 
 

@@ -256,6 +256,15 @@ class TriageEngine:
         self._mirror = np.zeros(dsig.PLANE_SIZE, dtype=np.uint8)
         self._occupancy = 0
         self._plane_dev = None  # device plane; None = rebuild pending
+        # Device-residency ledger (ISSUE 17): the 64 MB signal plane
+        # and its host-mirror rebuild authority, owner="triage".  The
+        # plane handle follows every rebuild/invalidation so the
+        # reconcile pass (which rides the audit cadence below) always
+        # checks the LIVE buffer.
+        self._hbm_plane = telemetry.HBM.register(
+            "triage", "plane", bound_to=self)
+        self._hbm_mirror = telemetry.HBM.register(
+            "triage", "mirror", self._mirror, bound_to=self)
         self._compiled = False  # first diff carries the jit compile
         self._pending: list[tuple[np.ndarray, int]] = []  # merge backlog
         self._staged: list[_Entry] = []
@@ -338,6 +347,7 @@ class TriageEngine:
         of trusting verdicts from invalidated buffers."""
         self._plane_dev = None
         self._epoch += 1
+        self._hbm_plane.update(None)
 
     def _bucket(self, n: int) -> int:
         """Pow2 row-count bucket in [8, B]: small submissions ship
@@ -359,6 +369,7 @@ class TriageEngine:
             with self._merge_lock:
                 self._pending.clear()
                 self._plane_dev = jnp.asarray(self._mirror)
+            self._hbm_plane.update(self._plane_dev)
             self.stats.plane_rebuilds += 1
             _M_REBUILDS.inc()
             return
@@ -385,6 +396,9 @@ class TriageEngine:
             self._plane_dev = dsig.merge_into(
                 self._plane_dev, jnp.asarray(e), jnp.asarray(n),
                 jnp.asarray(pr), jnp.ones(b, dtype=bool))
+        # The donated merges reassigned the plane reference: re-point
+        # the ledger entry at the live buffer (reconcile identity).
+        self._hbm_plane.update(self._plane_dev)
 
     # -- plane sharing (parallel/mesh.py) ----------------------------------
 
@@ -435,6 +449,8 @@ class TriageEngine:
             self._pending.clear()
             self._plane_dev = None
             self._epoch += 1
+            self._hbm_mirror.update(self._mirror)
+            self._hbm_plane.update(None)
 
     def share_plane_sharded(self, mesh):
         """The rebuild authority uploaded cov-sharded over a mesh —
@@ -463,6 +479,7 @@ class TriageEngine:
             self._note_occupancy(int(np.count_nonzero(self._mirror)))
             self._pending.clear()
             self._plane_dev = None  # rebuilt from the merged mirror
+            self._hbm_plane.update(None)
 
     # -- coverage analytics (ISSUE 7) --------------------------------------
 
@@ -545,9 +562,13 @@ class TriageEngine:
                         o, r = dsig.coverage_stats(plane)
                         return int(o), np.asarray(r)
 
-                    occ, regions = self.watchdog.call(
-                        _fetch, "device.coverage",
-                        compile=not self._analytics_compiled)
+                    with telemetry.COMPILES.observe(
+                            "triage.analytics",
+                            {"plane_bits": dsig.FOLD_BITS},
+                            sizer=dsig.analytics_cache_size):
+                        occ, regions = self.watchdog.call(
+                            _fetch, "device.coverage",
+                            compile=not self._analytics_compiled)
                     self._analytics_compiled = True
                     if audit:
                         drift = self._audit_locked(plane)
@@ -563,6 +584,15 @@ class TriageEngine:
             log.logf(0, "coverage analytics skipped: %s", str(e)[:200])
             return {"occupancy": self._occupancy, "regions": None,
                     "drift": None}
+        if audit and telemetry.HBM.reconcile_armed():
+            # Residency reconcile rides the audit cadence (ISSUE 17):
+            # ledger-tracked bytes vs the backend live-buffer report.
+            # Advisory like the drift audit — never raises, never
+            # feeds the breaker.
+            try:
+                telemetry.HBM.reconcile()
+            except Exception as e:
+                log.logf(0, "hbm reconcile skipped: %s", str(e)[:200])
         self._note_occupancy(occ)
         telemetry.COVERAGE.sample(occ, regions, drift)
         # SLO evaluation rides the flush-leader cadence (ISSUE 14):

@@ -440,3 +440,84 @@ def test_wedge_report_hub_federation_line():
     # a snapshot without hub signals renders no line
     assert not any(ln.startswith("hub:")
                    for ln in bw.wedge_report(_wedge_snapshot()))
+
+
+def test_wedge_report_device_residency_lines():
+    """The device-residency observatory (ISSUE 17, layer 8): the
+    per-buffer residency rollup with the headroom forecast and
+    reconcile drifts, plus the per-family compile ledger with its
+    storm count, render next to the other wedge layers."""
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    reg.gauge("tz_hbm_live_bytes",
+              labels={"owner": "pipeline", "device": "0",
+                      "kind": "corpus"}).set(64e6)
+    reg.gauge("tz_hbm_live_bytes",
+              labels={"owner": "mesh", "device": "0-7",
+                      "kind": "planes"}).set(128e6)
+    reg.gauge("tz_hbm_headroom_bytes").set(15.5e9)
+    reg.counter("tz_hbm_drift_total").inc(2)
+    reg.counter("tz_compile_builds_total",
+                labels={"graph": "mesh.fused_step"}).inc(2)
+    reg.counter("tz_compile_builds_total",
+                labels={"graph": "pipeline.step"}).inc(1)
+    reg.counter("tz_compile_storms_total").inc(1)
+    lines = bw.wedge_report(reg.snapshot())
+    line = next(ln for ln in lines
+                if ln.startswith("device residency"))
+    assert "pipeline/corpus@0:64.0MB" in line
+    assert "mesh/planes@0-7:128.0MB" in line
+    assert "headroom 15.50GB" in line
+    assert "2 reconcile DRIFTS" in line
+    cline = next(ln for ln in lines if ln.startswith("compiles:"))
+    assert "mesh.fused_step=2" in cline
+    assert "pipeline.step=1" in cline
+    assert "1 STORMS" in cline
+    # a snapshot without residency gauges renders neither line
+    other = bw.wedge_report(_wedge_snapshot())
+    assert not any(ln.startswith("device residency") for ln in other)
+    assert not any(ln.startswith("compiles:") for ln in other)
+
+
+def test_device_report_renders_api_payload():
+    """device_report renders a manager /api/device payload — the
+    residency summary and per-buffer table, the reconcile verdict
+    (flagged drift shouts), and the compile ledger with recent
+    builds.  Pure function — pinned with no live manager."""
+    payload = {
+        "hbm": {
+            "owners": {"pipeline": {"live_bytes": 64_000_000,
+                                    "peak_bytes": 80_000_000}},
+            "buffers": {"pipeline/corpus@0": 64_000_000,
+                        "staging/arena@host": 2_000_000},
+            "device_resident_bytes": 64_000_000,
+            "transient_bytes": 4_000_000,
+            "capacity_bytes": 16_000_000_000,
+            "headroom_bytes": 15_932_000_000,
+            "last_reconcile": {"tracked_bytes": 64_000_000,
+                               "backend_bytes": 63_000_000,
+                               "drift_bytes": 1_000_000,
+                               "dead_entries": 1,
+                               "entries": 3, "flagged": True,
+                               "seconds": 0.001},
+        },
+        "compiles": {"total_builds": 3, "storms": 1,
+                     "graphs": {"mesh.fused_step":
+                                {"builds": 2, "shapes": 2}},
+                     "recent": [[1_700_000_000.0, "mesh.fused_step",
+                                 [["devices", "8"]], 1.25]]},
+    }
+    lines = bw.device_report(payload)
+    text = "\n".join(lines)
+    assert "64.0 MB device-resident of 16.0 GB" in text
+    assert "headroom 15.93 GB" in text
+    assert "pipeline/corpus@0: 64.0 MB" in text
+    assert "staging/arena@host: 2.0 MB" in text
+    assert "DRIFT 1000000 B" in text and "over 3 entries" in text
+    assert "mesh.fused_step=2(2 shapes)" in text
+    assert "1 STORMS" in text
+    assert "built mesh.fused_step in 1.25s" in text
+    # an empty payload still renders the summary, not a crash
+    assert any("reconcile: never ran" in ln
+               for ln in bw.device_report({}))

@@ -1,0 +1,357 @@
+"""Device residency observatory (ISSUE 17), unit level: the HBM
+buffer ledger's accounting/conservation invariants and the compile
+observatory's storm detection, pinned against private registries and
+a stub flight recorder — no warm rig, no device fixtures.  The
+integration-side invariants (conservation on the warm pipeline, the
+mesh drill re-pin) live in test_health_faults / test_mesh_faults."""
+
+from __future__ import annotations
+
+import gc
+import json
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.telemetry import Registry
+from syzkaller_tpu.telemetry.compiles import (
+    CompileObservatory,
+    assert_no_new_compiles,
+    key_diff,
+)
+from syzkaller_tpu.telemetry.hbm import (
+    DEVICE_HOST,
+    DeviceBufferLedger,
+    OWNERS,
+)
+
+
+class _Flight:
+    """Captures incident dumps the way hbm/compiles fire them."""
+
+    def __init__(self):
+        self.dumps = []
+
+    def dump(self, kind, detail="", extra=None):
+        self.dumps.append((kind, detail, extra or {}))
+        return None
+
+
+def _ledger():
+    return DeviceBufferLedger(registry=Registry(), flight=_Flight())
+
+
+# -- ledger accounting ----------------------------------------------------
+
+
+def test_ledger_register_update_close_accounting():
+    led = _ledger()
+    a = np.zeros(100, np.uint8)
+    h = led.register("pipeline", "corpus", a, device="0")
+    assert h.nbytes == 100
+    assert led.live_bytes("pipeline") == 100
+
+    # a rebuild REPLACES the entry — no double counting
+    b = np.zeros(300, np.uint8)
+    h.update(b, device="0")
+    assert led.live_bytes("pipeline") == 300
+    assert led.live_bytes() == 300
+
+    # invalidation zeroes the entry but keeps the handle reusable
+    h.update(None)
+    assert led.live_bytes("pipeline") == 0
+    g = led._reg().gauge("tz_hbm_live_bytes",
+                         labels={"owner": "pipeline", "device": "0",
+                                 "kind": "corpus"})
+    assert g.value == 0
+
+    h.update(b, device="0")
+    assert led.live_bytes("pipeline") == 300
+    h.close()
+    assert led.live_bytes() == 0
+    h.update(b, device="0")  # updates after close are inert
+    assert led.live_bytes() == 0
+
+
+def test_ledger_peak_is_monotonic_and_snapshot_shape():
+    led = _ledger()
+    h = led.register("triage", "plane",
+                     np.zeros(4096, np.uint8), device="0")
+    h.update(np.zeros(1024, np.uint8), device="0")
+    snap = led.snapshot()
+    assert snap["owners"]["triage"]["live_bytes"] == 1024
+    assert snap["owners"]["triage"]["peak_bytes"] == 4096
+    assert snap["buffers"] == {"triage/plane@0": 1024}
+    assert json.dumps(snap)  # JSON-ready for /api/device + incidents
+
+
+def test_ledger_groups_payloads_and_opaque_bytes():
+    led = _ledger()
+    led.register("mesh", "planes",
+                 [np.zeros(64, np.uint8), np.zeros(64, np.uint8)],
+                 device="0-7")
+    led.register("staging", "arena", 4096)  # opaque host byte count
+    assert led.live_bytes("mesh") == 128
+    assert led.live_bytes("staging") == 4096
+    snap = led.snapshot()
+    assert snap["buffers"]["mesh/planes@0-7"] == 128
+    # an opaque registration defaults to the host device
+    assert snap["buffers"][f"staging/arena@{DEVICE_HOST}"] == 4096
+
+
+def test_ledger_headroom_excludes_host_and_counts_transient(
+        monkeypatch):
+    monkeypatch.setenv("TZ_HBM_CAPACITY_BYTES", "1000000")
+    led = _ledger()
+    led.register("pipeline", "tables",
+                 np.zeros(2048, np.uint8), device="0")
+    led.register("staging", "arena", 500)  # host: not in the forecast
+    led.note_transient("pipeline", 100)
+    assert led.capacity_bytes() == 1_000_000
+    assert led.headroom() == 1_000_000 - 2048 - 100
+    snap = led.snapshot()
+    assert snap["device_resident_bytes"] == 2048
+    assert snap["transient_bytes"] == 100
+    assert snap["headroom_bytes"] == snap["capacity_bytes"] \
+        - snap["device_resident_bytes"] - snap["transient_bytes"]
+
+
+def test_ledger_bound_handle_closes_with_its_engine():
+    """A transient engine (re-created triage engine, dropped sim
+    prescorer) must not rot the ledger: a handle registered with
+    bound_to closes itself when the owning object is collected."""
+    led = _ledger()
+
+    class _Engine:
+        pass
+
+    eng = _Engine()
+    led.register("sim", "tables", np.zeros(256, np.uint8),
+                 device="0", bound_to=eng)
+    assert led.live_bytes("sim") == 256
+    del eng
+    gc.collect()
+    assert led.live_bytes("sim") == 0
+    assert led.reconcile(live_arrays=[])["entries"] == 0
+
+
+def test_ledger_owner_vocabulary_is_closed():
+    # the lint cross-check (tools/lint_metrics) greps call sites
+    # against this tuple; the unit suite pins it is sorted + closed
+    assert OWNERS == tuple(sorted(OWNERS))
+    assert set(OWNERS) == {"mesh", "pipeline", "serve", "sim",
+                           "staging", "triage"}
+
+
+# -- reconcile: conservation vs the backend report ------------------------
+
+
+def test_reconcile_conserves_and_two_strike_incident():
+    jnp = pytest.importorskip("jax.numpy")
+    led = _ledger()
+    arr = jnp.asarray(np.arange(2048, dtype=np.uint8))
+    h = led.register("pipeline", "corpus", arr)
+    assert h.device != DEVICE_HOST
+
+    rec = led.reconcile(live_arrays=[arr])
+    assert rec["entries"] == 1
+    assert rec["tracked_bytes"] == rec["backend_bytes"] == 2048
+    assert rec["drift_bytes"] == 0 and rec["dead_entries"] == 0
+    assert not rec["flagged"]
+    assert led.last_reconcile == rec
+
+    # the array dies without a handle update: an orphaned entry.
+    # Strike one is tolerated (a legitimate swap race self-heals);
+    # the second consecutive flagged pass fires exactly one incident.
+    del arr
+    gc.collect()
+    rec = led.reconcile(live_arrays=[])
+    assert rec["dead_entries"] == 1 and rec["flagged"]
+    assert led._flight.dumps == []
+    rec = led.reconcile(live_arrays=[])
+    assert rec["flagged"]
+    kinds = [k for k, _d, _e in led._flight.dumps]
+    assert kinds == ["hbm_drift"]
+    _k, detail, extra = led._flight.dumps[0]
+    assert "1 orphaned entries" in detail
+    assert "hbm" in extra  # the residency table rides the incident
+
+    # ... and exactly one per episode: a persistent leak must not
+    # flood the event ring / flight dir at every analytics pass
+    rec = led.reconcile(live_arrays=[])
+    assert rec["flagged"]
+    assert [k for k, _d, _e in led._flight.dumps] == ["hbm_drift"]
+
+    # a clean pass resets the strikes
+    h.update(None)
+    rec = led.reconcile(live_arrays=[])
+    assert not rec["flagged"] and led._strikes == 0
+
+
+def test_reconcile_drift_and_tolerance():
+    jnp = pytest.importorskip("jax.numpy")
+    led = _ledger()
+    a = jnp.asarray(np.arange(1024, dtype=np.uint8))
+    b = jnp.asarray(np.arange(512, dtype=np.uint8))
+    led.register("triage", "plane", [a, b])
+    # the backend stops reporting b's bytes: a leak upstream
+    rec = led.reconcile(live_arrays=[a])
+    assert rec["drift_bytes"] == 512 and rec["flagged"]
+    # ... unless the operator tolerates it (TZ_HBM_DRIFT_TOLERANCE)
+    rec = led.reconcile(live_arrays=[a], tolerance=512)
+    assert rec["drift_bytes"] == 512 and not rec["flagged"]
+
+
+def test_reconcile_skips_host_and_opaque_entries():
+    led = _ledger()
+    led.register("staging", "arena", 4096)
+    led.register("serve", "tenant_planes",
+                 np.zeros(64, np.uint8), device=DEVICE_HOST)
+    rec = led.reconcile(live_arrays=[])
+    assert rec["entries"] == 0 and not rec["flagged"]
+
+
+def test_reconcile_armed_knob(monkeypatch):
+    led = _ledger()
+    assert led.reconcile_armed()
+    monkeypatch.setenv("TZ_HBM_RECONCILE", "0")
+    assert not led.reconcile_armed()
+    monkeypatch.setenv("TZ_HBM_RECONCILE", "junk")
+    assert led.reconcile_armed()  # malformed degrades to the default
+
+
+# -- compile observatory --------------------------------------------------
+
+
+def _observatory():
+    return CompileObservatory(registry=Registry(), flight=_Flight())
+
+
+def test_observatory_counts_and_snapshot():
+    obs = _observatory()
+    obs.note("mesh.fused_step", {"devices": 8}, seconds=1.5)
+    obs.note("mesh.fused_step", {"devices": 7}, seconds=1.2)
+    obs.note("pipeline.step", {"batch": 4096}, seconds=2.0)
+    assert obs.total_builds() == 3
+    assert obs.builds("mesh.fused_step") == 2
+    assert len(obs.shapes("mesh.fused_step")) == 2
+    obs.set_cache_size("mesh.fused_step", 2)
+    snap = obs.snapshot()
+    assert snap["total_builds"] == 3 and snap["storms"] == 0
+    assert snap["graphs"]["mesh.fused_step"] == {"builds": 2,
+                                                 "shapes": 2}
+    assert len(snap["recent"]) == 3
+    _ts, graph, _key, secs = snap["recent"][-1]
+    assert graph == "pipeline.step" and secs == 2.0
+
+
+def test_observe_notes_only_on_cache_growth():
+    obs = _observatory()
+    cache = []
+
+    def sizer():
+        return len(cache)
+
+    with obs.observe("pipeline.step", {"batch": 64}, sizer=sizer):
+        cache.append(object())  # cold: the cache grew — a build
+    assert obs.builds("pipeline.step") == 1
+    with obs.observe("pipeline.step", {"batch": 64}, sizer=sizer):
+        pass  # warm: executable reused — nothing recorded
+    assert obs.builds("pipeline.step") == 1
+    # with no sizer, the body IS the build (a cache-miss branch)
+    with obs.observe("mesh.fused_step", {"devices": 8}):
+        pass
+    assert obs.builds("mesh.fused_step") == 1
+
+
+def test_storm_same_key_fires_once_with_cache_drop_diagnosis():
+    obs = _observatory()
+    obs.note("pipeline.step", {"batch": 4096})
+    obs.note("pipeline.step", {"batch": 4096})  # 2nd build: storm
+    obs.note("pipeline.step", {"batch": 4096})  # muted: same episode
+    kinds = [k for k, _d, _e in obs._flight.dumps]
+    assert kinds == ["compile_storm"], "one incident per episode"
+    _k, detail, extra = obs._flight.dumps[0]
+    storm = extra["compile_storm"]
+    assert storm["graph"] == "pipeline.step" and storm["builds"] == 2
+    # identical key -> empty diff -> the worst of the two causes
+    assert storm["key_diff"] == {}
+    assert "cache was dropped" in detail
+    assert obs.snapshot()["storms"] == 1
+
+
+def test_storm_key_churn_names_the_churning_field():
+    obs = _observatory()
+    obs.note("pipeline.step", {"batch": 4096, "rounds": 2})
+    obs.note("pipeline.step", {"batch": 8192, "rounds": 2})
+    obs.note("pipeline.step", {"batch": 8192, "rounds": 2})  # storm
+    _k, detail, extra = obs._flight.dumps[0]
+    diff = extra["compile_storm"]["key_diff"]
+    assert diff == {"batch": ["4096", "8192"]}
+    assert "key churn on ['batch']" in detail
+
+
+def test_key_diff_canonicalizes_dict_order():
+    from syzkaller_tpu.telemetry.compiles import _canon_key
+
+    ka = _canon_key({"x": 1, "y": 2})
+    kb = _canon_key({"y": 2, "x": 1})
+    assert ka == kb and key_diff(ka, kb) == {}
+    kc = _canon_key({"y": 3, "x": 1})
+    assert key_diff(ka, kc) == {"y": ["2", "3"]}
+
+
+# -- the shared warm-rig guard --------------------------------------------
+
+
+def test_assert_no_new_compiles_passes_and_diagnoses():
+    obs = _observatory()
+    cache = [object()]
+
+    with assert_no_new_compiles(lambda: len(cache), observatory=obs):
+        pass  # warm body: clean
+
+    with pytest.raises(AssertionError) as e:
+        with assert_no_new_compiles(lambda: len(cache),
+                                    observatory=obs):
+            cache.append(object())
+    assert "watched jit cache #0 grew 1 -> 2" in str(e.value)
+
+    with pytest.raises(AssertionError) as e:
+        with assert_no_new_compiles(observatory=obs):
+            obs.note("mesh.fused_step", {"devices": 8}, seconds=1.0)
+    msg = str(e.value)
+    assert "new jit compiles on a warm rig" in msg
+    assert "1 new build(s)" in msg and "mesh.fused_step" in msg
+
+
+# -- trace metadata (satellite: "ph": "M") --------------------------------
+
+
+def test_trace_process_metadata_events(tmp_path, monkeypatch):
+    """The Chrome exporter's metadata header: concatenated
+    multi-process traces render named, pid-sorted process tracks.
+    TZ_TRACE_PROCESS overrides the argv-derived name for launchers
+    that exec one binary in several roles."""
+    import os
+    import threading
+
+    from syzkaller_tpu.telemetry.trace import TraceWriter
+
+    monkeypatch.setenv("TZ_TRACE_PROCESS", "manager")
+    path = tmp_path / "trace.json"
+    tw = TraceWriter(str(path))
+    tw.instant("breaker.open")
+    tw.close()
+    events = [json.loads(ln.rstrip(","))
+              for ln in path.read_text().splitlines()[1:]]
+    meta = {e["name"]: e for e in events if e.get("ph") == "M"}
+    pid = os.getpid()
+    assert meta["process_name"]["args"]["name"] == f"manager/{pid}"
+    assert meta["process_sort_index"]["args"]["sort_index"] == pid
+    assert meta["thread_name"]["args"]["name"] \
+        == threading.current_thread().name
+    assert meta["thread_name"]["tid"] == threading.get_ident()
+    # metadata precedes the first real event in the stream
+    names = [e["name"] for e in events]
+    assert names.index("process_name") < names.index("breaker.open")

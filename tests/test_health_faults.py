@@ -705,10 +705,12 @@ def test_transfer_plane_zero_new_jits_on_warm_pipeline(device_rig):
     """ISSUE 5 compile-count guard: staging-arena growth,
     dispatch-depth changes, and depth-controller adjustments are all
     host-only — zero new jit compiles on a warm pipeline.  Pinned via
-    the jitted callables' cache sizes (the pow2 bucketing is what
-    keeps every transfer shape inside the already-compiled set)."""
+    the shared `assert_no_new_compiles` guard (ISSUE 17), which
+    watches the jitted callables' cache sizes AND the process build
+    ledger, so a violation names the graph that built."""
     import numpy as np
 
+    from syzkaller_tpu import telemetry
     from syzkaller_tpu.ops import signal as dsig
     from syzkaller_tpu.ops.staging import DepthController
     from syzkaller_tpu.telemetry.registry import Histogram
@@ -735,47 +737,38 @@ def test_transfer_plane_zero_new_jits_on_warm_pipeline(device_rig):
     saved_depth = pl._dispatch_depth
     try:
         run_chunk()  # warm novel_any + the plane upload once
-        caches0 = (pl._step._cache_size(),
-                   dsig.novel_any._cache_size(),
-                   dsig.merge_into._cache_size(),
-                   dsig.diff_batch._cache_size())
+        with telemetry.assert_no_new_compiles(
+                pl._step._cache_size, dsig.novel_any._cache_size,
+                dsig.merge_into._cache_size,
+                dsig.diff_batch._cache_size):
+            # 1) staging-arena growth: new host buckets, both arenas.
+            pl._staging.acquire(("corpus", 4),
+                                {"idx": ((4,), np.int32)})
+            eng._arena.acquire(16, {"edges": ((16, 64), np.uint32)})
 
-        # 1) staging-arena growth: new host buckets on both arenas.
-        pl._staging.acquire(("corpus", 4),
-                            {"idx": ((4,), np.int32)})
-        eng._arena.acquire(16, {"edges": ((16, 64), np.uint32)})
+            # 2) dispatch-depth changes on the live engines.
+            eng._dispatch_depth = 1
+            run_chunk()
+            eng._dispatch_depth = 2
+            run_chunk()
+            pl._dispatch_depth = 2
+            batch = pl.next_batch(timeout=300)
+            assert batch
 
-        # 2) dispatch-depth changes on the live engines.
-        eng._dispatch_depth = 1
-        run_chunk()
-        eng._dispatch_depth = 2
-        run_chunk()
-        pl._dispatch_depth = 2
-        batch = pl.next_batch(timeout=300)
-        assert batch
-
-        # 3) depth-controller adjustments (forced moves) + applying a
-        # changed assemble depth to the live worker.
-        drain, work = Histogram("d"), Histogram("w")
-        for _ in range(64):
-            drain.observe(0.1)
-            work.observe(0.01)
-        ctrl = DepthController(initial=1, interval=1, cooldown=0,
-                               drain_hist=drain, work_hist=work)
-        assert ctrl.update() == 2 and ctrl.update() == 3
-        old_depth = pl._assemble_depth
-        pl._assemble_depth = 3
-        batch = pl.next_batch(timeout=300)
-        assert batch
-        pl._assemble_depth = old_depth
-
-        caches = (pl._step._cache_size(),
-                  dsig.novel_any._cache_size(),
-                  dsig.merge_into._cache_size(),
-                  dsig.diff_batch._cache_size())
-        assert caches == caches0, \
-            f"transfer-plane knobs triggered new jits: {caches0} -> " \
-            f"{caches}"
+            # 3) depth-controller adjustments (forced moves) +
+            # applying a changed assemble depth to the live worker.
+            drain, work = Histogram("d"), Histogram("w")
+            for _ in range(64):
+                drain.observe(0.1)
+                work.observe(0.01)
+            ctrl = DepthController(initial=1, interval=1, cooldown=0,
+                                   drain_hist=drain, work_hist=work)
+            assert ctrl.update() == 2 and ctrl.update() == 3
+            old_depth = pl._assemble_depth
+            pl._assemble_depth = 3
+            batch = pl.next_batch(timeout=300)
+            assert batch
+            pl._assemble_depth = old_depth
     finally:
         pl._dispatch_depth = saved_depth
         pl.triage_engine = None
@@ -790,28 +783,28 @@ def test_fused_mutation_core_zero_new_jits_on_warm_pipeline(device_rig):
     compiles after warmup.  Steady-state drains also may not grow the
     staging arena (the flags/corpus re-pads rotate existing
     buckets)."""
+    from syzkaller_tpu import telemetry
+
     _target, pl = device_rig
     assert pl._fused, "device rig must exercise the fused drain"
     assert pl.next_batch(timeout=300)  # warm the fused step
-    caches0 = pl._step._cache_size()
     allocs0 = pl._staging.allocations
     fused0 = pl.stats.fused_batches
-    for _ in range(2):
-        assert pl.next_batch(timeout=300) is not None
-    assert pl.stats.fused_batches > fused0
-    assert pl.stats.fused_novel_rows > 0
-    assert pl._staging.allocations == allocs0, \
-        "steady-state drains grew the staging arena"
-    # The half-open rebuild drops the mutant plane (dedup history is
-    # advisory); the next launch rebuilds it lazily — same shapes, so
-    # the step executable is reused, not retraced.
-    pl._reset_device_state()
-    # No plane-is-None assert here: the worker thread may already be
-    # launching the next batch and rebuild it before we look.
-    assert pl.next_batch(timeout=300)
-    assert pl._mutant_plane is not None
-    assert pl._step._cache_size() == caches0, \
-        "fused drain retraced after warmup"
+    with telemetry.assert_no_new_compiles(pl._step._cache_size):
+        for _ in range(2):
+            assert pl.next_batch(timeout=300) is not None
+        assert pl.stats.fused_batches > fused0
+        assert pl.stats.fused_novel_rows > 0
+        assert pl._staging.allocations == allocs0, \
+            "steady-state drains grew the staging arena"
+        # The half-open rebuild drops the mutant plane (dedup history
+        # is advisory); the next launch rebuilds it lazily — same
+        # shapes, so the step executable is reused, not retraced.
+        pl._reset_device_state()
+        # No plane-is-None assert here: the worker thread may already
+        # be launching the next batch and rebuild it before we look.
+        assert pl.next_batch(timeout=300)
+        assert pl._mutant_plane is not None
 
 
 def test_sim_prescore_fault_demotes_to_passthrough_zero_loss(device_rig):
@@ -831,32 +824,33 @@ def test_sim_prescore_fault_demotes_to_passthrough_zero_loss(device_rig):
         # Warm the prescored step: drain until a prescored batch lands.
         _drain_until(pl, lambda: pl.stats.sim_batches >= 1, timeout=300)
         assert pl.stats.sim_batches >= 1, "no prescored batch arrived"
-        caches0 = (pl._step._cache_size(), pl._step_sim._cache_size())
+        from syzkaller_tpu import telemetry
 
-        batches0 = sim.batches
-        install_plan(FaultPlan.parse("device.sim:fail@1-2"))
-        batch = _drain_until(pl, sim.demoted, timeout=60)
-        assert sim.demoted(), "prescore never demoted"
-        if batch is None:
-            batch = pl.next_batch(timeout=300)
-        assert batch, "demoted prescore lost a batch"
-        # The prescore seam is the sim's OWN breaker's problem: the
-        # pipeline breaker stays closed, nothing device-demotes.
-        assert pl.breaker.state == CLOSED
+        with telemetry.assert_no_new_compiles(
+                pl._step._cache_size, pl._step_sim._cache_size):
+            batches0 = sim.batches
+            install_plan(FaultPlan.parse("device.sim:fail@1-2"))
+            batch = _drain_until(pl, sim.demoted, timeout=60)
+            assert sim.demoted(), "prescore never demoted"
+            if batch is None:
+                batch = pl.next_batch(timeout=300)
+            assert batch, "demoted prescore lost a batch"
+            # The prescore seam is the sim's OWN breaker's problem:
+            # the pipeline breaker stays closed, nothing demotes.
+            assert pl.breaker.state == CLOSED
 
-        # Heal (only occurrences 1-2 were scripted): the next
-        # prescored commit re-promotes.
-        reset_plan()
-        _drain_until(pl, lambda: sim.repromotions >= 1, timeout=120)
-        assert sim.repromotions >= 1, "prescore never re-promoted"
-        assert not sim.demoted()
-        assert sim.batches > batches0
-        snap = pl.health_snapshot()["sim"]
-        assert snap["demotions"] >= 1 and snap["repromotions"] >= 1
-        assert snap["breaker"]["state"] == CLOSED
-        assert (pl._step._cache_size(),
-                pl._step_sim._cache_size()) == caches0, \
-            "prescore demote/heal cycle triggered new jits"
+            # Heal (only occurrences 1-2 were scripted): the next
+            # prescored commit re-promotes.
+            reset_plan()
+            _drain_until(pl, lambda: sim.repromotions >= 1,
+                         timeout=120)
+            assert sim.repromotions >= 1, "prescore never re-promoted"
+            assert not sim.demoted()
+            assert sim.batches > batches0
+            snap = pl.health_snapshot()["sim"]
+            assert snap["demotions"] >= 1
+            assert snap["repromotions"] >= 1
+            assert snap["breaker"]["state"] == CLOSED
     finally:
         reset_plan()
         pl.disable_sim_prescore()
@@ -891,6 +885,10 @@ def test_mesh_reshard_topology_cache_compile_guard(monkeypatch):
         return _stub_step
 
     monkeypatch.setattr(pmesh, "make_fused_mesh_step", counting_builder)
+    from syzkaller_tpu import telemetry
+
+    b0 = telemetry.COMPILES.builds("mesh.fused_step")
+    shapes0 = set(telemetry.COMPILES.shapes("mesh.fused_step"))
     eng = fd.MeshEngine(devices=jax.devices()[:8], cov=1, rounds=1,
                         plane_size=1 << 16, mutant_bits=10,
                         breaker_threshold=1, seed=3)
@@ -923,6 +921,14 @@ def test_mesh_reshard_topology_cache_compile_guard(monkeypatch):
     eng._build()
     assert builds == [8, 7], "revisited topology retraced"
     assert len(eng._graphs) == 2
+    # ISSUE 17 re-pin through the CompileObservatory: the drill is
+    # exactly two recorded mesh.fused_step builds — one per distinct
+    # topology key — and the keys disagree only on the live width.
+    assert telemetry.COMPILES.builds("mesh.fused_step") - b0 == 2
+    new_shapes = set(
+        telemetry.COMPILES.shapes("mesh.fused_step")) - shapes0
+    assert len(new_shapes) == 2, new_shapes
+    assert {dict(k).get("devices") for k in new_shapes} == {"8", "7"}
 
 
 def test_coverage_analytics_zero_new_jits_on_warm_rig(device_rig):
@@ -966,29 +972,23 @@ def test_coverage_analytics_zero_new_jits_on_warm_rig(device_rig):
         eng.run_analytics(audit=True)  # both analytics kernels compile
         assert dsig.coverage_stats._cache_size() == 1
         assert dsig.plane_drift._cache_size() == 1
-        caches0 = (pl._step._cache_size(),
-                   dsig.novel_any._cache_size(),
-                   dsig.merge_into._cache_size(),
-                   dsig.coverage_stats._cache_size(),
-                   dsig.plane_drift._cache_size())
         occ0 = eng._occupancy
-        for _ in range(3):
-            merge_some()
+        from syzkaller_tpu import telemetry
+
+        with telemetry.assert_no_new_compiles(
+                pl._step._cache_size, dsig.novel_any._cache_size,
+                dsig.merge_into._cache_size,
+                dsig.coverage_stats._cache_size,
+                dsig.plane_drift._cache_size):
+            for _ in range(3):
+                merge_some()
+                run_chunk()
+                eng.run_analytics(audit=True)
+            assert eng._occupancy > occ0  # popcount tracked the merges
+            # a rebuild (invalidation) + re-analytics re-jits nothing
+            eng.invalidate_device_plane()
             run_chunk()
             eng.run_analytics(audit=True)
-        assert eng._occupancy > occ0  # the popcount tracked the merges
-        # a rebuild (invalidation) + re-analytics also re-jits nothing
-        eng.invalidate_device_plane()
-        run_chunk()
-        eng.run_analytics(audit=True)
-        caches = (pl._step._cache_size(),
-                  dsig.novel_any._cache_size(),
-                  dsig.merge_into._cache_size(),
-                  dsig.coverage_stats._cache_size(),
-                  dsig.plane_drift._cache_size())
-        assert caches == caches0, \
-            f"coverage analytics triggered new jits: {caches0} -> " \
-            f"{caches}"
         assert dsig.coverage_stats._cache_size() == 1, \
             "analytics kernels must compile exactly once"
     finally:
@@ -1025,30 +1025,82 @@ def test_warm_restart_zero_new_jits(device_rig):
 
     try:
         run_chunk()  # warm novel_any + the plane upload
-        caches0 = (pl._step._cache_size(),
-                   dsig.novel_any._cache_size(),
-                   dsig.merge_into._cache_size())
-        # the checkpoint/restore round trip, as recovery performs it:
-        # provider packs the mirror, restore installs it and drops
-        # the device plane
-        meta, blob = eng.durable_provider()
-        mirror = dsig.unpack_plane(blob, meta["size"])
-        rebuilds0 = eng.stats.plane_rebuilds
-        eng.restore_mirror(mirror)
-        run_chunk()  # forces the rebuild H2D through the normal path
-        assert eng.stats.plane_rebuilds == rebuilds0 + 1
-        # mutant-plane restore rides the same discipline
-        mmeta, mblob = pl.durable_mutant_plane()
-        pl.restore_mutant_plane(
-            dsig.unpack_plane(mblob, mmeta["size"]),
-            bits=mmeta["bits"])
-        caches = (pl._step._cache_size(),
-                  dsig.novel_any._cache_size(),
-                  dsig.merge_into._cache_size())
-        assert caches == caches0, \
-            f"warm restart triggered new jits: {caches0} -> {caches}"
+        from syzkaller_tpu import telemetry
+
+        with telemetry.assert_no_new_compiles(
+                pl._step._cache_size, dsig.novel_any._cache_size,
+                dsig.merge_into._cache_size):
+            # the checkpoint/restore round trip, as recovery performs
+            # it: provider packs the mirror, restore installs it and
+            # drops the device plane
+            meta, blob = eng.durable_provider()
+            mirror = dsig.unpack_plane(blob, meta["size"])
+            rebuilds0 = eng.stats.plane_rebuilds
+            eng.restore_mirror(mirror)
+            run_chunk()  # forces the rebuild H2D, normal path
+            assert eng.stats.plane_rebuilds == rebuilds0 + 1
+            # mutant-plane restore rides the same discipline
+            mmeta, mblob = pl.durable_mutant_plane()
+            pl.restore_mutant_plane(
+                dsig.unpack_plane(mblob, mmeta["size"]),
+                bits=mmeta["bits"])
     finally:
         pl.triage_engine = None  # the module-scoped rig lives on
+
+
+# -- device-residency conservation (ISSUE 17) -----------------------------
+
+
+def test_hbm_ledger_conservation_on_warm_rig(device_rig):
+    """ISSUE 17 conservation: the bytes the residency ledger tracks
+    for the warm pipeline's device buffers equal the backend's
+    live-buffer report for exactly those buffers (drift 0, no
+    orphaned entries), the invariant survives the breaker-path
+    device-state rebuild, and reconcile itself is host-only — zero
+    new jit compiles on the warm rig."""
+    from syzkaller_tpu import telemetry
+
+    import gc
+
+    _target, pl = device_rig
+    assert pl.next_batch(timeout=300)  # tables + planes resident
+    # Earlier tests dropped transient triage/sim engines; their
+    # handles close at collection (register's bound_to), so flush the
+    # finalizers before asserting conservation over the live set.
+    gc.collect()
+
+    def settled_reconcile():
+        # The worker legitimately swaps the mutant plane between the
+        # ledger snapshot and the backend report; one retry absorbs
+        # that race exactly like the production two-strike rule does.
+        for _ in range(3):
+            rec = telemetry.HBM.reconcile()
+            if not rec["flagged"]:
+                return rec
+            time.sleep(0.1)
+        return rec
+
+    with telemetry.assert_no_new_compiles(pl._step._cache_size):
+        rec = settled_reconcile()
+    assert rec["entries"] >= 1, "warm pipeline registered no buffers"
+    assert rec["dead_entries"] == 0 and rec["drift_bytes"] == 0, rec
+    assert not rec["flagged"], rec
+    assert telemetry.HBM.live_bytes("pipeline") > 0
+
+    # The breaker's half-open rebuild drops device state; every
+    # dropped buffer's handle must be updated, not orphaned —
+    # conservation holds again once the rig re-warms.
+    pl._reset_device_state()
+    assert pl.next_batch(timeout=300)
+    rec = settled_reconcile()
+    assert rec["dead_entries"] == 0 and rec["drift_bytes"] == 0, rec
+
+    snap = telemetry.HBM.snapshot()
+    assert snap["headroom_bytes"] == (
+        snap["capacity_bytes"] - snap["device_resident_bytes"]
+        - snap["transient_bytes"])
+    assert snap["owners"]["pipeline"]["peak_bytes"] \
+        >= snap["owners"]["pipeline"]["live_bytes"]
 
 
 # -- lineage + flight recorder + profiler on the warm rig (ISSUE 6) -------
@@ -1195,19 +1247,18 @@ def test_profiler_always_on_zero_new_jits(device_rig):
 
     _target, pl = device_rig
     prof = telemetry.PROFILER
-    caches0 = pl._step._cache_size()
     batches0 = prof.snapshot()["mutate"]["batches"]
     slots0 = (len(prof._ewma), len(prof._counts), len(prof._gauges))
-    batch = pl.next_batch(timeout=300)
-    assert batch
-    deadline = time.time() + 30
-    while prof.snapshot()["mutate"]["batches"] == batches0 \
-            and time.time() < deadline:
-        time.sleep(0.05)
-    snap = prof.snapshot()
-    assert snap["mutate"]["batches"] > batches0
-    assert snap["emit_compact"]["batches"] > 0
-    assert pl._step._cache_size() == caches0, "profiler caused a jit"
+    with telemetry.assert_no_new_compiles(pl._step._cache_size):
+        batch = pl.next_batch(timeout=300)
+        assert batch
+        deadline = time.time() + 30
+        while prof.snapshot()["mutate"]["batches"] == batches0 \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        snap = prof.snapshot()
+        assert snap["mutate"]["batches"] > batches0
+        assert snap["emit_compact"]["batches"] > 0
     assert (len(prof._ewma), len(prof._counts),
             len(prof._gauges)) == slots0
     assert set(prof._ewma) == set(KERNELS)

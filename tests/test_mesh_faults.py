@@ -226,6 +226,18 @@ eng.step(batch, mk(), nedges, prios)
 assert [s._cache_size() for _m, s in eng._graphs.values()] == sizes, \
     "steady-state mesh step retraced"
 
+# -- ISSUE 17: the CompileObservatory recorded exactly those two
+# builds (fresh interpreter, so absolute counts are exact), and the
+# residency ledger conserves across the demote/re-shard/re-promote
+# cycle — tracked mesh bytes match the backend report with no
+# orphaned entries.
+from syzkaller_tpu import telemetry
+assert telemetry.COMPILES.builds("mesh.fused_step") == 2
+assert len(telemetry.COMPILES.shapes("mesh.fused_step")) == 2
+assert telemetry.HBM.live_bytes("mesh", device_only=True) > 0
+rec = telemetry.HBM.reconcile()
+assert rec["drift_bytes"] == 0 and rec["dead_entries"] == 0, rec
+
 print(json.dumps({"ok": True, "graphs": len(eng._graphs),
                   "novel_total": int(out1["n_novel"].sum())}))
 """
