@@ -929,7 +929,7 @@ def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
         # profiler, whose EWMAs are reported alongside.
         for _ in range(3):
             pl._drain(pl._launch())
-        corpus, n, _tmpl, _ets = pl._flush_pending()
+        corpus, n, _tmpl, _ets, cumw, total = pl._flush_pending()
         fv, fc = pl._flags_dev
         key = random.key(123)
 
@@ -951,10 +951,11 @@ def bench_profile(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
             mplane = pl._mutant_plane if pl._mutant_plane is not None \
                 else new_mutant_plane(pl._plane_bits)
             step_ms = timed(lambda i: pl._step(
-                corpus, n, random.fold_in(key, i), fv, fc, mplane))
+                corpus, cumw, total, random.fold_in(key, i), fv, fc,
+                mplane))
         else:
             step_ms = timed(lambda i: pl._step(
-                corpus, n, random.fold_in(key, i), fv, fc))
+                corpus, cumw, total, random.fold_in(key, i), fv, fc))
 
         # The mutation core alone, on the same sampled batch, through
         # the backend the pipeline resolved (TZ_MUTATE_BACKEND):
@@ -1202,9 +1203,10 @@ def bench_sim(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
         import jax
 
         pl.stop()
-        corpus, cn, _tmpl, ets = pl._flush_pending()
+        corpus, cn, _tmpl, ets, cumw, total = pl._flush_pending()
         if corpus is None:
             corpus, cn = pl._corpus_dev, pl._n
+            cumw, total = pl.arena._cumw_dev, pl.arena._total
         sim = pl._sim
         sim_tables = sim.device_tables(ets)
         sim_plane = sim.ensure_plane()
@@ -1214,6 +1216,11 @@ def bench_sim(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
 
             plane = new_mutant_plane(pl._plane_bits)
         fv, fc = pl._flags_dev
+        heat = pl._heat_dev
+        if heat is None:
+            import jax.numpy as jnp
+
+            heat = jnp.zeros((corpus["val"].shape[0],), jnp.uint32)
         key = pl._key
         rows = None
         # One untimed iteration absorbs any residual compile.
@@ -1223,15 +1230,143 @@ def bench_sim(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
             for _ in range(iters):
                 key, sub = pl._random.split(key)
                 (rows, _pool, _n_used, _n_novel, plane, sim_plane,
-                 _n_sup) = pl._step_sim(
-                    corpus, cn, sub, fv, fc, plane, sim_plane,
-                    sim_tables, pl._runs_dev, pl._by_syscall_dev)
+                 _n_sup, heat) = pl._step_sim(
+                    corpus, cumw, total, sub, fv, fc, plane,
+                    sim_plane, sim_tables, heat, pl._runs_dev,
+                    pl._by_syscall_dev)
             jax.block_until_ready((rows, plane, sim_plane))
             loop_dt = time.time() - t0
         out["sim_loop_mutants_per_sec"] = round(
             loop_iters * batch_size / loop_dt, 1)
         out["sim_loop_batches_per_sec"] = round(
             loop_iters / loop_dt, 2)
+    finally:
+        pl.stop()
+        dump_telemetry()
+    return out
+
+
+def bench_arena(batch_size=PIPE_BATCH, capacity=PIPE_CAPACITY,
+                iters=50, seeds=64, distill_rounds=4) -> dict:
+    """Device-resident corpus arena (ISSUE 18), three measurements at
+    the flagship shape:
+
+      - arena_sample_ms_per_batch: the device sampling path — jitted
+        cumulative-weight search (`pick_rows`) + row gather against
+        the resident slabs, zero host corpus bytes per batch.
+      - host_sample_scatter_ms_per_batch: the pre-arena baseline the
+        tentpole replaces — host-side pick against host authority,
+        numpy gather, and a per-batch device_put of the sampled rows
+        (the H2D scatter the old `_pending_rows` drain amortized but
+        a host-authoritative sampler pays every batch).
+      - distill_retired_rows_per_sec: the batched Minimize lane —
+        fused suffix-truncation sim-exec rounds driven directly
+        (`_distill_round`), with retired-row and candidate-row rates.
+
+    h2d_corpus_bytes_per_batch_{host,arena} pins the steady-state
+    transfer claim: the host baseline's is the sampled-batch byte
+    volume, the arena's is the measured `upload_bytes` delta across
+    the timed device loop (zero once resident).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops.arena import pick_rows, pick_rows_host
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
+
+    target = get_target("test", "64")
+    pl = DevicePipeline(target, capacity=capacity,
+                        batch_size=batch_size, seed=0)
+    out: dict = {"pipeline_batch": batch_size,
+                 "arena_slab_bits": pl.arena.slab_bits}
+    try:
+        added, i = 0, 0
+        while added < seeds and i < seeds * 8:
+            if pl.add(_seed_programs(target, 1, seed0=42 + i)[0]):
+                added += 1
+            i += 1
+        assert added > 0, "no seed programs tensorized"
+        pl.stop()
+        corpus, cn, _tmpl, _ets, cumw, total = pl._flush_pending()
+        if corpus is None:
+            corpus, cn = pl._corpus_dev, pl._n
+            cumw, total = pl.arena._cumw_dev, pl.arena._total
+        out["arena_capacity_rows"] = pl.arena.capacity
+        out["arena_rows"] = pl.arena.n
+
+        # Shared sampling stream: the same uint32 bit batches drive
+        # both arms, so the comparison is pure mechanism.
+        rng = np.random.RandomState(7)
+        bits_np = [rng.randint(0, 1 << 31, size=batch_size)
+                   .astype(np.uint32) for _ in range(8)]
+        bits_dev = [jnp.asarray(b) for b in bits_np]
+
+        @jax.jit
+        def _sample_dev(c, cw, tot, bits):
+            idx = pick_rows(cw, tot, bits)
+            return {k: v[idx] for k, v in c.items()}
+
+        # -- device arm: on-device pick + gather ----------------------
+        jax.block_until_ready(
+            _sample_dev(corpus, cumw, total, bits_dev[0]))  # compile
+        up0 = pl.arena.upload_bytes
+        t0 = time.perf_counter()
+        last = None
+        for it in range(iters):
+            last = _sample_dev(corpus, cumw, total,
+                               bits_dev[it % len(bits_dev)])
+        jax.block_until_ready(last)
+        dev_dt = time.perf_counter() - t0
+        out["arena_sample_ms_per_batch"] = round(
+            1e3 * dev_dt / iters, 3)
+        out["h2d_corpus_bytes_per_batch_arena"] = round(
+            (pl.arena.upload_bytes - up0) / iters, 1)
+
+        # -- host arm: host pick + gather + H2D scatter ---------------
+        cumw_h = np.asarray(cumw)
+        host = pl.arena.host
+        gathered = None
+        h2d_bytes = 0
+        idx = pick_rows_host(cumw_h, total, bits_np[0])
+        jax.block_until_ready(  # warm the transfer path
+            {k: jax.device_put(v[idx]) for k, v in host.items()})
+        t0 = time.perf_counter()
+        for it in range(iters):
+            idx = pick_rows_host(cumw_h, total,
+                                 bits_np[it % len(bits_np)])
+            gathered = {k: jax.device_put(np.ascontiguousarray(v[idx]))
+                        for k, v in host.items()}
+            if it == 0:
+                h2d_bytes = sum(int(np.asarray(v[idx]).nbytes)
+                                for v in host.values())
+        jax.block_until_ready(gathered)
+        host_dt = time.perf_counter() - t0
+        out["host_sample_scatter_ms_per_batch"] = round(
+            1e3 * host_dt / iters, 3)
+        out["h2d_corpus_bytes_per_batch_host"] = h2d_bytes
+        out["arena_sample_speedup_x"] = round(
+            host_dt / max(dev_dt, 1e-9), 2)
+
+        # -- distillation lane ----------------------------------------
+        cand_rows = pl._distill.rows * (pl._distill.max_cands + 1)
+        pl._distill_round()  # warm (check-kernel compile)
+        r0 = pl._distill.retired
+        c0 = pl._distill.rounds
+        t0 = time.perf_counter()
+        for _ in range(distill_rounds):
+            pl._distill_round()
+        distill_dt = time.perf_counter() - t0
+        d_rounds = pl._distill.rounds - c0
+        out["distill_rounds"] = d_rounds
+        out["distill_retired_rows"] = pl._distill.retired - r0
+        out["distill_retired_rows_per_sec"] = round(
+            (pl._distill.retired - r0) / max(distill_dt, 1e-9), 2)
+        out["distill_candidate_rows_per_sec"] = round(
+            d_rounds * cand_rows / max(distill_dt, 1e-9), 1)
+        out["distill_ms_per_round"] = round(
+            1e3 * distill_dt / max(distill_rounds, 1), 3)
     finally:
         pl.stop()
         dump_telemetry()
@@ -1677,6 +1812,16 @@ def main() -> None:
         journal_append(res)
         print(json.dumps(res))
         return
+    if "--arena" in argv:
+        res = {"metric": "arena_sample_ms_per_batch",
+               "unit": "ms/batch", **bench_arena()}
+        res["value"] = res["arena_sample_ms_per_batch"]
+        res["vs_baseline"] = res.get("arena_sample_speedup_x")
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
     if "--device" in argv:
         res = {"metric": "device_ledger_tax_us", "unit": "us/batch",
                **bench_device()}
@@ -1737,6 +1882,15 @@ def main() -> None:
                                     loop_iters=10, seeds=32)}
     except Exception as e:
         sim_sub = {"sim_error": f"{type(e).__name__}: {e}"[:200]}
+    # Arena sub-bench (ISSUE 18): on-device sampling vs the host
+    # sample+scatter baseline plus the distillation lane rates ride
+    # the flagship journal entry; a failure never discards it.
+    try:
+        arena_sub = {"arena": bench_arena(batch_size=batch,
+                                          iters=30, seeds=32,
+                                          distill_rounds=2)}
+    except Exception as e:
+        arena_sub = {"arena_error": f"{type(e).__name__}: {e}"[:200]}
     cpu_rate = bench_cpu()
     result = {
         "metric": "exec_ready_mutants_per_sec_per_chip",
@@ -1753,6 +1907,7 @@ def main() -> None:
             **assemble_sub,
             **triage_sub,
             **sim_sub,
+            **arena_sub,
         },
         "note": ("value = integrated corpus-tensor->exec-bytes rate off "
                  "ops/pipeline.DevicePipeline (the path fuzzer/proc.py "

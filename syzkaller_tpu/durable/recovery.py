@@ -211,6 +211,15 @@ def replay(ckpt: dict, records: list) -> dict:
         meta, _blob = ckpt["slo"]
         slo = dict(meta)
 
+    # Corpus arena (ISSUE 18): checkpoint-only durable authority —
+    # serialized programs + sampling weights + epoch.  Passed through
+    # opaque (jax-free here); DevicePipeline.restore_corpus_arena
+    # re-tensorizes and re-uploads in one flush on attach.
+    arena_sec = None
+    if "corpus_arena" in ckpt:
+        meta, blob = ckpt["corpus_arena"]
+        arena_sec = {"meta": dict(meta), "blob": bytes(blob)}
+
     hub = None
     hub_mgrs: dict = {}
     if "hub" in ckpt:
@@ -445,6 +454,8 @@ def replay(ckpt: dict, records: list) -> dict:
         out["accounting"] = accounting
     if slo is not None:
         out["slo"] = slo
+    if arena_sec is not None:
+        out["corpus_arena"] = arena_sec
     return out
 
 

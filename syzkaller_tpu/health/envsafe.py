@@ -26,6 +26,10 @@ from syzkaller_tpu.utils import log
 #: a knob whose parse site runs later (bench-only budgets, the trace
 #: exporter) must not be flagged just because nothing read it yet.
 KNOWN_TZ_VARS: set[str] = {
+    "TZ_ARENA_DEVICE",
+    "TZ_ARENA_DISTILL_EVERY",
+    "TZ_ARENA_DISTILL_ROWS",
+    "TZ_ARENA_SLAB_BITS",
     "TZ_ASSEMBLE_DEPTH",
     "TZ_ASSEMBLE_WORKERS",
     "TZ_BENCH_PLATFORM",

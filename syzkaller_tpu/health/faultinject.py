@@ -44,6 +44,7 @@ SEAMS = (
     "device.compile",
     "device.triage",
     "device.sim",
+    "device.arena",
     "staging.h2d",
     "rpc.send_frame",
     "rpc.recv_frame",

@@ -1,0 +1,735 @@
+"""Device-resident HBM corpus arena (ISSUE 18).
+
+The fused drain (ISSUE 9/10/14/15) mutates, sim-executes, and triages
+on device, but until this module every batch still *started* on host:
+a uniform host-side corpus pick plus an H2D corpus-flush scatter.  The
+arena closes that loop — the serialized exec-word corpus lives in
+pow2-slab device buffers, the per-batch template pick is a weighted
+cumulative-weight search ON DEVICE, and the host keeps only the
+durable authority copy:
+
+  - SLABS: one device array per ProgTensor field (the same
+    val/len/arena/flag layout `DevicePipeline._corpus_dev` held),
+    capacity-padded to whole 2^TZ_ARENA_SLAB_BITS-row slabs and sized
+    against the HBM ledger's headroom (`slab_capacity`; the ledger
+    registers them under owner="arena" so the residency rollup and
+    the reconcile sweep see them),
+  - SAMPLING: `pick_rows` draws B uint32 words from the SAME threefry
+    substream the host sampler used and searches the cumulative
+    weight vector: with unit weights `searchsorted(cumw, u % total,
+    'right')` degenerates EXACTLY to the legacy `bits % n` pick, so
+    turning the arena on does not move a single sample — weighting is
+    free on top (`pick_rows_host` is the bit-exact numpy oracle the
+    parity tests run),
+  - EPOCHS: every device-state invalidation (breaker re-entry, mesh
+    re-shard, checkpoint restore) bumps `epoch` and marks every
+    occupied row pending — the next flush is ONE scatter from host
+    authority through the shared StagingArena slot rotation (same
+    ("corpus", bucket) keys as the pre-arena path, so the PR 5
+    allocation pins stay flat), zero new jits,
+  - DISTILLATION: a batched `Minimize`-style lane (reference:
+    prog/minimization.go, pkg/signal.Minimize) proposes suffix
+    truncations per row, sim-executes original + candidates as one
+    fused batch (sim/kernel.sim_exec_batch), and keeps the shortest
+    candidate whose predicted edge folds cover the original's —
+    the host oracle (`distill_verdicts_host`) reruns the bisection
+    through sim_exec_host + digest_covers at full FOLD_BITS
+    resolution, where digest bucket == fold, so device and host
+    verdicts are provably identical.
+
+docs/perf.md "The corpus arena" covers the slab layout, the sampling
+kernel, the distillation cost model, and the headroom sizing rule;
+docs/observability.md catalogues the tz_arena_* series.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+
+import numpy as np
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health import env_int, fault_point
+from syzkaller_tpu.ops.delta import pow2_rows
+
+_M_ROWS = telemetry.gauge(
+    "tz_arena_rows", "occupied corpus rows in the device arena")
+_M_CAPACITY = telemetry.gauge(
+    "tz_arena_capacity_rows", "device slab capacity in rows")
+_M_EPOCH = telemetry.gauge(
+    "tz_arena_epoch", "arena epoch (bumped per device invalidation)")
+_M_SLAB_BYTES = telemetry.gauge(
+    "tz_arena_slab_bytes", "resident device slab bytes")
+_M_UPLOADS = telemetry.counter(
+    "tz_arena_uploads_total",
+    "authority re-upload scatters into the device slabs")
+_M_UPLOAD_BYTES = telemetry.counter(
+    "tz_arena_upload_bytes_total",
+    "H2D corpus row bytes staged by those scatters")
+_M_RETIRED = telemetry.counter(
+    "tz_arena_retired_rows_total",
+    "arena rows superseded by a distilled truncation")
+_M_DISTILL_ROUNDS = telemetry.counter(
+    "tz_arena_distill_rounds_total",
+    "fused distillation bisection batches run")
+_M_DISTILL_CANDS = telemetry.counter(
+    "tz_arena_distill_candidates_total",
+    "candidate truncations sim-executed by the distill lane")
+_M_HEAT_FOLDS = telemetry.counter(
+    "tz_arena_heat_folds_total",
+    "device heat vectors folded into the sampling weights")
+
+#: Sentinel for invalid edge folds in the device cover check: real
+#: folds are < 2^FOLD_BITS (26), so the max uint32 never collides.
+_FOLD_SENTINEL = np.uint32(0xFFFFFFFF)
+
+
+def resolve_arena_device() -> bool:
+    """TZ_ARENA_DEVICE kill switch: 0 pins unit sampling weights and
+    disables the distill lane, reproducing the pre-arena host-staged
+    behavior bit for bit (the slabs still hold the corpus — only the
+    weighted pick and the on-device retirement are switched off)."""
+    return env_int("TZ_ARENA_DEVICE", 1) != 0
+
+
+def resolve_slab_bits() -> int:
+    """TZ_ARENA_SLAB_BITS with the plane-knob clamp discipline
+    (ops/signal.resolve_mutant_plane_bits): 2^10 = 1024-row slabs by
+    default, bounded to [4, 20] so a typo cannot demand a 2^31-row
+    allocation."""
+    bits = env_int("TZ_ARENA_SLAB_BITS", 10)
+    return min(20, max(4, bits))
+
+
+def resolve_distill_every() -> int:
+    """TZ_ARENA_DISTILL_EVERY: distill-lane cadence in drained
+    batches; 0 (default) keeps the lane off — distillation is opt-in
+    because it spends device time on corpus hygiene, not mutants."""
+    return max(0, env_int("TZ_ARENA_DISTILL_EVERY", 0))
+
+
+def resolve_distill_rows() -> int:
+    """TZ_ARENA_DISTILL_ROWS: rows bisected per distill round,
+    clamped to [1, 128] — the round's device batch is rows x
+    candidates, and the compile shape is pinned by this value."""
+    return min(128, max(1, env_int("TZ_ARENA_DISTILL_ROWS", 8)))
+
+
+def slab_capacity(requested: int, row_bytes: int,
+                  headroom_bytes: int | None = None,
+                  slab_bits: int | None = None) -> int:
+    """Device slab capacity for a `requested`-row ring: rounded UP to
+    whole 2^slab_bits-row slabs (growth inside a slab never reallocs,
+    so the jitted step's corpus shapes are fixed at construction),
+    then trimmed back toward the request when the slack alone would
+    eat more than a quarter of the ledger's current headroom
+    (`tz_hbm_headroom_bytes` — the PR 16 forecast input this rule was
+    built for).  Never below `requested`: the ring needs its slots,
+    and the breaker path would rather demote than under-allocate."""
+    if slab_bits is None:
+        slab_bits = resolve_slab_bits()
+    slab = 1 << slab_bits
+    cap = ((max(1, requested) + slab - 1) // slab) * slab
+    if headroom_bytes is None:
+        headroom_bytes = telemetry.HBM.headroom()
+    budget = max(0, int(headroom_bytes)) // 4
+    while cap - slab >= requested \
+            and (cap - requested) * max(1, row_bytes) > budget:
+        cap -= slab
+    return cap
+
+
+def cumw_from_weights(weights: np.ndarray, n: int,
+                      capacity: int) -> tuple[np.ndarray, int]:
+    """(cumulative weight vector uint32[capacity], total): occupied
+    rows [0, n) contribute their weights, the tail repeats the total
+    so a searchsorted past the corpus never lands there.  Totals are
+    bounded by n * max-weight << 2^32 (weights are small ints)."""
+    w = np.zeros(capacity, np.uint64)
+    w[:n] = weights[:n]
+    cw = np.cumsum(w)
+    total = int(cw[-1]) if capacity else 0
+    return cw.astype(np.uint32), total
+
+
+def pick_rows(cumw, total, bits_u32):
+    """The on-device weighted pick: u = bits mod total, then the
+    first row whose cumulative weight exceeds u.  With unit weights
+    cumw is [1, 2, .., n, n, ..] and total == n, so idx == u — the
+    exact legacy `bits % max(n, 1)` stream.  Traceable (called inside
+    the jitted step); `bits_u32` is the raw threefry draw."""
+    import jax.numpy as jnp
+
+    u = bits_u32 % jnp.maximum(total, 1).astype(jnp.uint32)
+    idx = jnp.searchsorted(cumw, u, side="right")
+    return jnp.clip(idx, 0, cumw.shape[0] - 1).astype(jnp.int32)
+
+
+def pick_rows_host(cumw: np.ndarray, total: int,
+                   bits_u32: np.ndarray) -> np.ndarray:
+    """Numpy oracle for pick_rows on the same uint32 draws — the
+    randomized parity tests run both on seeded streams and require
+    bit equality."""
+    u = (np.asarray(bits_u32, np.uint32) % np.uint32(max(total, 1)))
+    idx = np.searchsorted(np.asarray(cumw, np.uint32), u, side="right")
+    return np.clip(idx, 0, len(cumw) - 1).astype(np.int32)
+
+
+class CorpusArena:
+    """Epoch-versioned device corpus slabs + host authority.
+
+    Single device-writer contract: `stage`/`retire_row`/`set_weight`
+    may run from any thread (guarded by the arena lock); `flush`,
+    `invalidate`, and `fold_heat` run from the owning pipeline's
+    worker thread, same as the rest of its device attributes."""
+
+    def __init__(self, capacity: int, staging=None,
+                 slab_bits: int | None = None,
+                 headroom_bytes: int | None = None):
+        from syzkaller_tpu.ops.staging import StagingArena
+
+        self.ring_capacity = capacity
+        self.slab_bits = resolve_slab_bits() if slab_bits is None \
+            else slab_bits
+        self.device_enabled = resolve_arena_device()
+        self._headroom_hint = headroom_bytes
+        self.capacity = 0  # resolved at first stage (row bytes known)
+        self.host: dict[str, np.ndarray] | None = None
+        self.weights: np.ndarray | None = None
+        self.n = 0
+        self.epoch = 0
+        self.uploads = 0
+        self.upload_bytes = 0
+        self.retired = 0
+        self.heat_folds = 0
+        self._lock = threading.Lock()
+        self._pending: dict[int, int] = {}  # slot -> staleness tick
+        self._tick = 0
+        self._dev: dict | None = None
+        self._cumw_dev = None
+        self._total = 0
+        self._weights_dirty = True
+        self._staging = staging if staging is not None \
+            else StagingArena(slots=2)
+        self._hbm_slabs = telemetry.HBM.register(
+            "arena", "slabs", bound_to=self)
+        self._hbm_cumw = telemetry.HBM.register(
+            "arena", "cumw", bound_to=self)
+
+    # -- host authority ----------------------------------------------------
+
+    def _ensure_host(self, proto: dict) -> None:
+        if self.host is not None:
+            return
+        row_bytes = int(sum(np.asarray(v).nbytes
+                            for v in proto.values()))
+        self.capacity = slab_capacity(
+            self.ring_capacity, row_bytes,
+            headroom_bytes=self._headroom_hint,
+            slab_bits=self.slab_bits)
+        self.host = {
+            k: np.zeros((self.capacity,) + np.shape(v),
+                        dtype=np.asarray(v).dtype)
+            for k, v in proto.items()}
+        self.weights = np.zeros(self.capacity, np.uint32)
+        _M_CAPACITY.set(self.capacity)
+
+    def stage(self, i: int, arrays: dict, weight: int = 1) -> None:
+        """Copy one row into host authority and mark it pending for
+        the next flush.  `weight` seeds the sampling weight (unit by
+        default — the bit-exact legacy stream)."""
+        with self._lock:
+            self._ensure_host(arrays)
+            for k, v in arrays.items():
+                self.host[k][i] = v
+            self.weights[i] = weight
+            self._tick += 1
+            self._pending[i] = self._tick
+            self.n = max(self.n, i + 1)
+            self._weights_dirty = True
+        _M_ROWS.set(self.n)
+
+    def set_weight(self, i: int, weight: int) -> None:
+        with self._lock:
+            if self.weights is None or not 0 <= i < self.capacity:
+                return
+            self.weights[i] = weight
+            self._weights_dirty = True
+
+    def fold_heat(self, heat: np.ndarray, cap: int = 7) -> None:
+        """Fold a device-observed heat vector (per-row admitted-mutant
+        counts the prescored step scatter-adds on device) into the
+        sampling weights: weight = 1 + min(heat, cap).  This is the
+        sim-feedback loop — novelty yield observed ON DEVICE biases
+        the next epoch's picks without any per-batch host traffic
+        (the heat rides the step's outputs; this fold runs at distill
+        cadence, not per batch)."""
+        if not self.device_enabled:
+            return
+        with self._lock:
+            if self.weights is None:
+                return
+            h = np.asarray(heat[:self.n], np.uint32)
+            occupied = self.weights[:self.n] > 0
+            self.weights[:self.n] = np.where(
+                occupied, 1 + np.minimum(h, cap), 0)
+            self._weights_dirty = True
+            self.heat_folds += 1
+        _M_HEAT_FOLDS.inc()
+
+    # -- device state ------------------------------------------------------
+
+    def invalidate(self) -> None:
+        """Breaker re-entry / mesh re-shard / restore: the device
+        slabs are gone; every occupied row re-stages from host
+        authority — ONE scatter at the next flush, no new jits (the
+        scatter bucket shapes are the same pow2 set), and the epoch
+        bump makes the rebuild observable."""
+        with self._lock:
+            self._dev = None
+            self._cumw_dev = None
+            self._weights_dirty = True
+            self._tick += 1
+            self._pending = {i: self._tick for i in range(self.n)}
+            self.epoch += 1
+        self._hbm_slabs.update(None)
+        self._hbm_cumw.update(None)
+        _M_EPOCH.set(self.epoch)
+        telemetry.record_event(
+            "arena.epoch",
+            f"arena epoch {self.epoch}: {self.n} rows re-stage from "
+            "host authority")
+
+    def begin_flush(self, jnp):
+        """Phase A of a flush — call under the owning pipeline's
+        template lock, so the staged row data is atomic with the
+        template snapshot the batch's mutants decode against: lazily
+        allocate the device slabs, then memcpy the pending authority
+        rows into the shared StagingArena buffers (host work only).
+        Returns the opaque token commit_flush consumes."""
+        with self._lock:
+            n = self.n
+            if self.host is None or n == 0:
+                return ("empty", 0, None)
+            if self._dev is None:
+                self._dev = {
+                    k: jnp.zeros(v.shape, dtype=v.dtype)
+                    for k, v in self.host.items()}
+                self._tick += 1
+                self._pending = {i: self._tick for i in range(n)}
+            pending = dict(self._pending)
+            if not pending:
+                return ("clean", n, None)
+            idx_list = sorted(pending)
+            n_rows = len(idx_list)
+            bucket = pow2_rows(n_rows)
+            fields = {"idx": ((bucket,), np.int32)}
+            for k, v in self._dev.items():
+                fields["row:" + k] = ((bucket,) + v.shape[1:], v.dtype)
+            bufs = self._staging.acquire(("corpus", bucket), fields)
+            idx = bufs["idx"]
+            idx[:n_rows] = idx_list
+            idx[n_rows:] = idx_list[-1]
+            staged_bytes = 0
+            for k in self._dev:
+                rows = bufs["row:" + k]
+                rows[:n_rows] = self.host[k][idx_list]
+                rows[n_rows:] = rows[n_rows - 1]
+                staged_bytes += rows.nbytes
+            return ("staged", n, (pending, idx_list, bufs, staged_bytes))
+
+    def commit_flush(self, jnp, token):
+        """Phase B — the device work, no pipeline lock held: scatter
+        the staged rows into the slabs (one .at[].set per field) and
+        refresh the cumulative-weight vector if dirty.  Returns
+        (device slabs, n, cumw device vector, total) — the arena
+        handle the jitted step consumes.  On a device failure the
+        pending set is left intact (entries are only removed after a
+        successful scatter, and only if their staleness tick is
+        unchanged), so the worker's retry re-uploads exactly what
+        this call could not."""
+        kind, n, payload = token
+        if kind == "empty":
+            return None, 0, None, 0
+        if kind == "staged":
+            pending, idx_list, bufs, staged_bytes = payload
+            idx = bufs["idx"]
+            with telemetry.span("pipeline.h2d_wait"):
+                fault_point("staging.h2d")
+                for k in self._dev:
+                    self._dev[k] = \
+                        self._dev[k].at[idx].set(bufs["row:" + k])
+            self.uploads += 1
+            self.upload_bytes += staged_bytes
+            _M_UPLOADS.inc()
+            _M_UPLOAD_BYTES.inc(staged_bytes)
+            with self._lock:
+                for i in idx_list:
+                    if self._pending.get(i) == pending[i]:
+                        del self._pending[i]
+            self._hbm_slabs.update(self._dev)
+            _M_SLAB_BYTES.set(sum(int(v.nbytes)
+                                  for v in self._dev.values()))
+        if self._weights_dirty or self._cumw_dev is None:
+            fault_point("device.arena")
+            with self._lock:
+                if self.device_enabled:
+                    w = self.weights
+                else:
+                    # Kill switch: unit weights — the legacy uniform
+                    # stream, bit for bit.
+                    w = np.zeros(self.capacity, np.uint32)
+                    w[:n] = 1
+                cw, total = cumw_from_weights(w, n, self.capacity)
+                self._weights_dirty = False
+            self._cumw_dev = jnp.asarray(cw)
+            self._total = total
+            self._hbm_cumw.update(self._cumw_dev)
+        return self._dev, n, self._cumw_dev, self._total
+
+    def flush(self, jnp):
+        """begin_flush + commit_flush in one call (tests, the mesh
+        re-shard path; the pipeline splits the phases so its template
+        snapshot stays atomic with the staging drain)."""
+        return self.commit_flush(jnp, self.begin_flush(jnp))
+
+    def note_retired(self, k: int) -> None:
+        """Count `k` rows superseded by a distilled truncation (the
+        truncated row re-stages over the same slot, so retirement is
+        an in-place shrink, not an eviction)."""
+        if k <= 0:
+            return
+        with self._lock:
+            self.retired += k
+        _M_RETIRED.inc(k)
+
+    def restore_epoch(self, epoch: int) -> None:
+        """Continue the epoch counter across a checkpoint restore so
+        the series stays monotonic for dashboards."""
+        with self._lock:
+            self.epoch = max(self.epoch, int(epoch))
+        _M_EPOCH.set(self.epoch)
+
+    def snapshot(self) -> dict:
+        return {
+            "device_enabled": self.device_enabled,
+            "capacity": self.capacity,
+            "rows": self.n,
+            "epoch": self.epoch,
+            "slab_bits": self.slab_bits,
+            "uploads": self.uploads,
+            "upload_bytes": self.upload_bytes,
+            "retired": self.retired,
+            "heat_folds": self.heat_folds,
+            "pending": len(self._pending),
+            "total_weight": self._total,
+        }
+
+    # -- mesh sharding -----------------------------------------------------
+
+    def shard_rows(self, shard: int, n_shards: int) -> np.ndarray:
+        """Occupied row indices owned by `shard` when the arena is
+        split contiguously over the 'batch' mesh axis — the re-shard-
+        on-chip-loss path slices host authority with this and
+        device_puts per surviving shard (parallel/fault_domain)."""
+        if self.n == 0 or n_shards <= 0:
+            return np.zeros(0, np.int64)
+        per = -(-self.n // n_shards)  # ceil
+        lo = min(shard * per, self.n)
+        hi = min(lo + per, self.n)
+        return np.arange(lo, hi, dtype=np.int64)
+
+    def authority_rows(self, idx: np.ndarray) -> dict:
+        """Host-authority copies of the given rows (the mesh engine's
+        re-shard source; a copy so device_put never aliases the
+        mutable authority arrays)."""
+        with self._lock:
+            if self.host is None:
+                return {}
+            return {k: v[idx].copy() for k, v in self.host.items()}
+
+
+# -- durable authority codec (pack_plane-style; ISSUE 12 path) ------------
+
+
+def pack_arena(progs: list[bytes], weights: np.ndarray,
+               epoch: int) -> tuple[dict, bytes]:
+    """Checkpoint section codec: length-prefixed serialized programs
+    + per-row sampling weights, zlib level 1 (the corpus is text-like
+    and the cadence write must stay cheap — same bargain as
+    signal.pack_plane).  Returns (meta, blob) for a DurableStore
+    provider."""
+    parts = []
+    for p in progs:
+        b = bytes(p)
+        parts.append(len(b).to_bytes(4, "little"))
+        parts.append(b)
+    blob = zlib.compress(b"".join(parts), 1)
+    meta = {"n": len(progs), "epoch": int(epoch),
+            "weights": [int(w) for w in
+                        np.asarray(weights[:len(progs)], np.uint32)]}
+    return meta, blob
+
+
+def unpack_arena(meta: dict, blob: bytes) \
+        -> tuple[list[bytes], np.ndarray, int]:
+    """Inverse of pack_arena — numpy/zlib only, safe on the jax-free
+    recovery path.  Returns (serialized programs, weights, epoch)."""
+    raw = zlib.decompress(bytes(blob))
+    n = int(meta.get("n", 0))
+    progs: list[bytes] = []
+    off = 0
+    for _ in range(n):
+        ln = int.from_bytes(raw[off:off + 4], "little")
+        off += 4
+        progs.append(raw[off:off + ln])
+        off += ln
+    weights = np.asarray(meta.get("weights", [1] * n), np.uint32)
+    if weights.size < n:
+        weights = np.pad(weights, (0, n - weights.size),
+                         constant_values=1)
+    return progs, weights, int(meta.get("epoch", 0))
+
+
+# -- the distillation lane ------------------------------------------------
+
+
+def truncation_keep_counts(n_alive: int, max_cands: int) -> list[int]:
+    """The bisection ladder for one row: candidate alive-call keep
+    counts, shortest-first would bias the verdict scan, so they come
+    DESCENDING — n-1 (the single-suffix-drop probe) then halves
+    (n//2, n//4, .., 1).  Padded by the caller to the static
+    candidate shape with n (a no-op candidate that trivially covers
+    and never wins the min-keep pick)."""
+    ks: list[int] = []
+    if n_alive - 1 >= 1:
+        ks.append(n_alive - 1)
+    k = n_alive // 2
+    while k >= 1 and len(ks) < max_cands:
+        if k not in ks:
+            ks.append(k)
+        k //= 2
+    return ks[:max_cands]
+
+
+def truncated_alive(call_alive: np.ndarray, keep: int) -> np.ndarray:
+    """Suffix truncation: keep the first `keep` alive calls.  Suffix
+    drops can never dangle a forward result reference (results only
+    flow forward), which is what makes the candidate set safe to
+    re-encode without a typed repair pass."""
+    mask = np.zeros_like(call_alive, dtype=bool)
+    pos = np.flatnonzero(call_alive)[:keep]
+    mask[pos] = True
+    return mask
+
+
+def alive_mask_bits(call_alive: np.ndarray) -> int:
+    """bool[C] -> the uint64 alive bitmap the sim kernel consumes."""
+    bits = 0
+    for c in np.flatnonzero(call_alive):
+        bits |= 1 << int(c)
+    return bits
+
+
+def build_distill_batch(arena: CorpusArena, templates, ets,
+                        slots: list[int], max_calls: int,
+                        max_cands: int):
+    """Host staging for one distill round: per selected row, the
+    lowered sim table, the template's slot values from arena
+    authority, and the candidate alive bitmaps (slot 0 = original).
+    Returns (table_rows dict (R,C..), ncalls (R,), alive (R, M) u64,
+    vals (R, S), keeps (R, M) int; M = max_cands + 1) — all numpy;
+    the caller uploads and dispatches."""
+    from syzkaller_tpu.sim.kernel import TABLE_FIELDS
+    from syzkaller_tpu.sim.table import build_sim_table
+
+    R = len(slots)
+    M = max_cands + 1
+    tables = [build_sim_table(ets[i], max_calls) for i in slots]
+    table_rows = {
+        k: np.stack([getattr(t, k) for t in tables])
+        for k in TABLE_FIELDS}
+    ncalls = np.array([t.ncalls for t in tables], np.int32)
+    with arena._lock:
+        vals = arena.host["val"][slots].copy()
+    alive = np.zeros((R, M), np.uint64)
+    keeps = np.zeros((R, M), np.int64)
+    for r, i in enumerate(slots):
+        ca = templates[i].call_alive
+        n_alive = int(ca.sum())
+        alive[r, 0] = alive_mask_bits(ca)
+        keeps[r, 0] = n_alive
+        ks = truncation_keep_counts(n_alive, max_cands)
+        for c in range(max_cands):
+            k = ks[c] if c < len(ks) else n_alive
+            alive[r, c + 1] = alive_mask_bits(truncated_alive(ca, k))
+            keeps[r, c + 1] = k
+    return table_rows, ncalls, alive, vals, keeps
+
+
+def make_distill_check(backend: str):
+    """The fused bisection batch: sim-exec original + candidates in
+    one dispatch, fold predicted edges (ops/signal.fold_hash), and
+    per candidate test whether its valid folds COVER the original's
+    (sorted-membership — exact, no digest collisions).  One jit per
+    (R, M) shape; the lane pins both, so the warm rig compiles this
+    once."""
+    import jax
+    import jax.numpy as jnp
+
+    from syzkaller_tpu.ops.signal import fold_hash
+    from syzkaller_tpu.sim.kernel import sim_exec_batch
+
+    def check(table_rows, ncalls, alive, vals):
+        R, M = alive.shape
+        rep = lambda a: jnp.repeat(a, M, axis=0)  # noqa: E731
+        tr = {k: rep(v) for k, v in table_rows.items()}
+        edges, valid, _r, _e, _s = sim_exec_batch(
+            tr, rep(ncalls), alive.reshape(-1), rep(vals),
+            backend, interpret=True)
+        CE = edges.shape[1] * edges.shape[2]
+        folds = fold_hash(edges).reshape(R, M, CE)
+        valid = valid.reshape(R, M, CE)
+        f = jnp.where(valid, folds, _FOLD_SENTINEL)
+        orig = f[:, 0, :]                      # (R, CE)
+        cand_sorted = jnp.sort(f, axis=-1)     # (R, M, CE)
+
+        def member(cs, o):
+            p = jnp.searchsorted(cs, o)
+            return cs[jnp.clip(p, 0, cs.shape[0] - 1)] == o
+
+        hits = jax.vmap(lambda cs_row, o:
+                        jax.vmap(lambda cs: member(cs, o))(cs_row))(
+            cand_sorted, orig)                 # (R, M, CE)
+        o_real = orig != _FOLD_SENTINEL        # (R, CE)
+        covers = jnp.all(hits | ~o_real[:, None, :], axis=-1)
+        n_orig = o_real.sum(axis=-1).astype(jnp.int32)
+        return covers, n_orig
+
+    return jax.jit(check)
+
+
+def distill_verdicts_host(table_rows, ncalls, alive, vals):
+    """The host bisection oracle: rerun every (row, candidate) pair
+    through sim_exec_host and decide coverage with the existing
+    digest machinery at bits=FOLD_BITS — the digest bucket IS the
+    fold at that resolution, so `digest_covers` is exact membership
+    and the verdict matrix must equal the device check's bit for bit
+    (a row whose original has no valid edges is trivially covered on
+    both sides)."""
+    from syzkaller_tpu.ops.signal import (
+        FOLD_BITS,
+        digest_covers,
+        digest_from_folds,
+        fold_hash_np,
+    )
+    from syzkaller_tpu.sim.kernel import TABLE_FIELDS
+    from syzkaller_tpu.sim.table import SimTable, sim_exec_host
+
+    R, M = alive.shape
+    covers = np.zeros((R, M), bool)
+    for r in range(R):
+        fields = {k: table_rows[k][r] for k in TABLE_FIELDS}
+        table = SimTable(ncalls=int(ncalls[r]), **fields)
+        folds_by_cand = []
+        for m in range(M):
+            edges, valid, _ret, _err, _st = sim_exec_host(
+                table, vals=vals[r], alive_bits=int(alive[r, m]))
+            folds_by_cand.append(fold_hash_np(edges[valid]))
+        orig = folds_by_cand[0]
+        for m in range(M):
+            if orig.size == 0:
+                covers[r, m] = True
+                continue
+            digest = digest_from_folds(folds_by_cand[m], FOLD_BITS)
+            covers[r, m] = digest_covers(digest, orig)
+    return covers
+
+
+class DistillLane:
+    """Cadenced Minimize-style corpus distillation over the arena.
+
+    The lane owns its cadence clock and the jitted cover-check
+    executable (one compile at the pinned (rows, candidates) shape);
+    the pipeline drives `tick()` per drained batch and runs
+    `round()` from its worker thread when the cadence fires, under
+    the `device.arena` fault seam."""
+
+    def __init__(self, max_calls: int, backend: str = "vmap",
+                 every: int | None = None, rows: int | None = None,
+                 max_cands: int = 4):
+        self.max_calls = max_calls
+        self.backend = backend
+        self.every = resolve_distill_every() if every is None else every
+        self.rows = resolve_distill_rows() if rows is None else rows
+        self.max_cands = max_cands
+        self.rounds = 0
+        self.retired = 0
+        self.errors = 0
+        self._batches = 0
+        self._cursor = 0
+        self._check = None
+
+    def tick(self) -> bool:
+        """One drained batch; True when a distill round is due."""
+        if not self.every:
+            return False
+        self._batches += 1
+        return self._batches % self.every == 0
+
+    def select_slots(self, templates, n: int) -> list[int]:
+        """The next `rows` occupied slots with at least two alive
+        calls, cursor-walked so rounds sweep the whole ring."""
+        out: list[int] = []
+        if n == 0:
+            return out
+        for k in range(n):
+            i = (self._cursor + k) % n
+            t = templates[i]
+            if t is None or int(t.call_alive.sum()) < 2:
+                continue
+            out.append(i)
+            if len(out) >= self.rows:
+                break
+        self._cursor = (self._cursor + n) % max(n, 1) \
+            if len(out) < self.rows else (out[-1] + 1) % n
+        return out
+
+    def check(self, table_rows, ncalls, alive, vals):
+        """Dispatch the fused bisection batch; returns numpy
+        (covers (R, M) bool, n_orig (R,) int32)."""
+        import jax.numpy as jnp
+
+        if self._check is None:
+            self._check = make_distill_check(self.backend)
+        covers, n_orig = self._check(
+            {k: jnp.asarray(v) for k, v in table_rows.items()},
+            jnp.asarray(ncalls), jnp.asarray(alive),
+            jnp.asarray(vals))
+        R, M = alive.shape
+        self.rounds += 1
+        _M_DISTILL_ROUNDS.inc()
+        _M_DISTILL_CANDS.inc(R * (M - 1))
+        return np.asarray(covers), np.asarray(n_orig)
+
+    def choose(self, covers: np.ndarray, keeps: np.ndarray) \
+            -> list[int | None]:
+        """Per row: the winning candidate index (smallest keep count
+        among covering candidates strictly shorter than the
+        original), or None when nothing shorter covers."""
+        R, M = covers.shape
+        out: list[int | None] = []
+        for r in range(R):
+            best, best_k = None, int(keeps[r, 0])
+            for m in range(1, M):
+                k = int(keeps[r, m])
+                if covers[r, m] and k < best_k:
+                    best, best_k = m, k
+            out.append(best)
+        return out
+
+    def snapshot(self) -> dict:
+        return {"every": self.every, "rows": self.rows,
+                "max_cands": self.max_cands, "rounds": self.rounds,
+                "retired": self.retired, "errors": self.errors}

@@ -893,6 +893,102 @@ def splice_insert_group(et: ExecTemplate, alive_bits: np.ndarray,
     return out
 
 
+def splice_insert_group_flat(et: ExecTemplate, alive_bits: np.ndarray,
+                             donors: np.ndarray, poses: np.ndarray,
+                             dtab: DonorBankTable) -> list:
+    """splice_insert_group against the base-independent flat donor
+    bank (ISSUE 18): donor words come straight out of DonorBankTable
+    row slices and the copyout rebase happens as one ragged in-arena
+    add (`arena[at] += et.ncopyouts`, the splice_batch_table trick) —
+    no per-copyout-base bank re-stack (`build_donor_table`) ever
+    materializes.  Bit-exact with the re-stacked path: rebasing a
+    donor word before or after it lands in the arena commutes.
+    Returns memoryview slices aligned with the inputs; None where the
+    combined copyout budget would overflow."""
+    m = len(donors)
+    out: list = [None] * m
+    nc = et.ncalls
+    W = et.words.shape[0]
+    full = np.uint64((1 << nc) - 1) if nc < 64 else np.uint64(2**64 - 1)
+    ab = alive_bits & full
+    if nc:
+        calls = np.arange(nc, dtype=np.uint64)
+        alive = ((ab[:, None] >> calls[None, :]) & 1) != 0  # (m, nc)
+        rank = np.cumsum(alive, axis=1) - alive  # exclusive alive rank
+        n_alive = alive.sum(axis=1)
+    else:
+        alive = np.zeros((m, 0), bool)
+        rank = np.zeros((m, 0), np.int64)
+        n_alive = np.zeros(m, np.int64)
+    pos = np.minimum(poses.astype(np.int64), n_alive)
+
+    donors = np.asarray(donors, dtype=np.int64)
+    ok = et.ncopyouts + dtab.ncopyouts[donors] <= MAX_COPYOUT
+    rows_ok = np.flatnonzero(ok)
+    if rows_ok.size == 0:
+        return out
+
+    pos_o = pos[rows_ok]
+    dm = donors[rows_ok]
+    dl = dtab.w_len[dm]
+    dsrc0 = dtab.w_off[dm]
+    if et.seg_tiled and bool((ab[rows_ok] == full).all()):
+        cut = et.insert_cut[np.minimum(pos_o, nc)]
+        n_a = cut
+        n_c = W - cut
+        total = n_a + dl + n_c
+        ends = np.cumsum(total)
+        starts = ends - total
+        arena = np.empty(int(ends[-1]) if len(ends) else 0, np.uint64)
+        e, k = _ragged_spans(n_a)
+        arena[starts[e] + k] = et.words[k]
+        e, k = _ragged_spans(dl)
+        arena[(starts + n_a)[e] + k] = dtab.w_flat[dsrc0[e] + k]
+        e, k = _ragged_spans(n_c)
+        arena[(starts + n_a + dl)[e] + k] = et.words[cut[e] + k]
+    else:
+        alive_o = alive[rows_ok]
+        rank_o = rank[rows_ok]
+        wc = et.word_call
+        is_call = wc >= 0
+        if nc:
+            cw = np.where(is_call, wc, 0)
+            word_alive = alive_o[:, cw] & is_call[None, :]
+            word_rank = rank_o[:, cw]
+        else:
+            word_alive = np.zeros((len(rows_ok), W), bool)
+            word_rank = np.zeros((len(rows_ok), W), np.int64)
+        in_a = word_alive & (word_rank < pos_o[:, None])
+        in_c = word_alive & (word_rank >= pos_o[:, None])
+        in_c[:, wc == WORD_EOF] = True  # EOF rides the tail part
+
+        n_a = in_a.sum(axis=1, dtype=np.int64)
+        n_c = in_c.sum(axis=1, dtype=np.int64)
+        total = n_a + dl + n_c
+        ends = np.cumsum(total)
+        starts = ends - total
+        arena = np.empty(int(ends[-1]) if len(ends) else 0, np.uint64)
+        wb = np.broadcast_to(et.words, (len(rows_ok), W))
+        e, k = _ragged_spans(n_a)
+        arena[starts[e] + k] = wb[in_a]
+        e, k = _ragged_spans(dl)
+        arena[(starts + n_a)[e] + k] = dtab.w_flat[dsrc0[e] + k]
+        e, k = _ragged_spans(n_c)
+        arena[(starts + n_a + dl)[e] + k] = wb[in_c]
+    if et.ncopyouts:
+        # Rebase the spliced-in copyout indices in place: positions
+        # are unique per row, so the fancy add never collides.
+        e, k = _ragged_spans(dtab.cw_len[dm])
+        if e.size:
+            at = (starts + n_a)[e] + dtab.cw_flat[dtab.cw_off[dm][e] + k]
+            arena[at] += np.uint64(et.ncopyouts)
+
+    u8 = memoryview(arena.view(np.uint8))
+    for idx, i in enumerate(rows_ok):
+        out[int(i)] = u8[int(starts[idx]) * 8:int(ends[idx]) * 8]
+    return out
+
+
 def mutant_call_ids(et: ExecTemplate, call_alive: np.ndarray) -> list[int]:
     """Template call indices surviving in the mutant, in order — maps
     the executor's call_index back to template calls."""

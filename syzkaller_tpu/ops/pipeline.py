@@ -77,6 +77,7 @@ from syzkaller_tpu.ops.delta import (
     pool_bucket,
     pow2_rows,
 )
+from syzkaller_tpu.ops.arena import CorpusArena, DistillLane
 from syzkaller_tpu.ops.emit import (
     DonorBankTable,
     ExecTemplate,
@@ -87,7 +88,7 @@ from syzkaller_tpu.ops.emit import (
     shard_by_template,
     splice_batch_table,
     splice_insert,
-    splice_insert_group,
+    splice_insert_group_flat,
 )
 from syzkaller_tpu.ops.staging import StagingArena, resolve_assemble_depth
 from syzkaller_tpu.ops.tensor import (
@@ -452,6 +453,7 @@ def _shared_step_cached(spec, B: int, R: int, backend: str,
     from jax import random
 
     from syzkaller_tpu.ops import rng as d
+    from syzkaller_tpu.ops.arena import pick_rows
     from syzkaller_tpu.ops.mutate import _mutate_one
     from syzkaller_tpu.ops.pallas_mutate import make_pallas_mutate_pack
     from syzkaller_tpu.ops.signal import mutant_novelty
@@ -462,10 +464,15 @@ def _shared_step_cached(spec, B: int, R: int, backend: str,
     pallas_pack = make_pallas_mutate_pack(spec, R) \
         if backend == "pallas" else None
 
-    def sample_and_pack(corpus, n, key, flag_vals, flag_counts,
-                        runs, by_syscall):
+    def sample_and_pack(corpus, cumw, total, key, flag_vals,
+                        flag_counts, runs, by_syscall):
         """Template sampling + per-row class draws + the mutation
         core, shared by the fused and unfused step graphs.  The
+        template pick is the arena's on-device weighted search
+        (ops/arena.pick_rows): with unit weights it degenerates to
+        the legacy `bits % n` draw bit for bit, so the compiled
+        graph is ONE executable for weighted and uniform sampling
+        alike (TZ_ARENA_DEVICE=0 just pins unit weights).  The
         class/donor sampling stays a (tiny) vmap on both backends
         and splits each row key exactly as the pre-Pallas fused
         vmap did, so every backend/fusion combination consumes
@@ -499,8 +506,8 @@ def _shared_step_cached(spec, B: int, R: int, backend: str,
             return donor, pos.astype(jnp.uint8), ok
 
         k_idx, k_mut = random.split(key)
-        idx = (random.bits(k_idx, (B,), dtype=jnp.uint32)
-               % jnp.maximum(n, 1).astype(jnp.uint32)).astype(jnp.int32)
+        idx = pick_rows(cumw, total,
+                        random.bits(k_idx, (B,), dtype=jnp.uint32))
         batch = {k: v[idx] for k, v in corpus.items()}
         keys = random.split(k_mut, B)
 
@@ -531,13 +538,14 @@ def _shared_step_cached(spec, B: int, R: int, backend: str,
 
         return jax.vmap(one)(batch, mut_keys, idx, op, donor, pos)
 
-    def step(corpus: dict, n: int, key, flag_vals, flag_counts,
-             runs, by_syscall):
+    def step(corpus: dict, cumw, total: int, key, flag_vals,
+             flag_counts, runs, by_syscall):
         rows, payloads, needs = sample_and_pack(
-            corpus, n, key, flag_vals, flag_counts, runs, by_syscall)
+            corpus, cumw, total, key, flag_vals, flag_counts, runs,
+            by_syscall)
         return pool(rows, payloads, needs)
 
-    def fused_step(corpus: dict, n: int, key, flag_vals,
+    def fused_step(corpus: dict, cumw, total: int, key, flag_vals,
                    flag_counts, plane, runs, by_syscall):
         """mutate -> emit-compact -> novel_any as ONE dispatch
         (ISSUE 10): the mutant plane drops already-seen rows ON
@@ -546,7 +554,8 @@ def _shared_step_cached(spec, B: int, R: int, backend: str,
         Returns (rows compacted novel-first, pool prefix, n_used,
         n_novel, updated plane)."""
         rows, payloads, needs = sample_and_pack(
-            corpus, n, key, flag_vals, flag_counts, runs, by_syscall)
+            corpus, cumw, total, key, flag_vals, flag_counts, runs,
+            by_syscall)
         novel, plane = mutant_novelty(plane, rows)
         # Pool claims happen on the PRE-compaction row order, so
         # pool_idx is already embedded in each row's bytes and
@@ -555,9 +564,9 @@ def _shared_step_cached(spec, B: int, R: int, backend: str,
         rows, n_novel = compact_rows(rows, novel)
         return rows, pool_arr, n_used, n_novel, plane
 
-    def fused_prescore_step(corpus: dict, n: int, key, flag_vals,
-                            flag_counts, plane, sim_plane, sim_tables,
-                            runs, by_syscall):
+    def fused_prescore_step(corpus: dict, cumw, total: int, key,
+                            flag_vals, flag_counts, plane, sim_plane,
+                            sim_tables, heat, runs, by_syscall):
         """The fused drain with the ISSUE 15 sim-exec prescore fused
         in: mutate -> plane dedup -> SIMULATED execution of every
         plane-novel mutant (syzkaller_tpu/sim) -> predicted-edge fold
@@ -568,7 +577,12 @@ def _shared_step_cached(spec, B: int, R: int, backend: str,
         sim/prescore.py for the no-starvation argument).  Insert-class
         mutants are force-admitted: their donor splice happens host-
         side, so simulating the base template alone would mispredict
-        them wholesale."""
+        them wholesale.  The admit verdict also scatter-adds into the
+        arena's per-row `heat` vector ON DEVICE (ISSUE 18): novelty
+        yield accrues to the sampled template's slot with zero
+        per-batch host traffic, and the arena folds the accumulated
+        heat into its sampling weights at distill cadence
+        (CorpusArena.fold_heat)."""
         from syzkaller_tpu.ops.pallas_mutate import _use_interpret
         from syzkaller_tpu.sim.kernel import (
             TABLE_FIELDS,
@@ -579,7 +593,8 @@ def _shared_step_cached(spec, B: int, R: int, backend: str,
         )
 
         rows, payloads, needs = sample_and_pack(
-            corpus, n, key, flag_vals, flag_counts, runs, by_syscall)
+            corpus, cumw, total, key, flag_vals, flag_counts, runs,
+            by_syscall)
         novel, plane = mutant_novelty(plane, rows)
         # Reconstruct each mutant's value slots from its delta row
         # and gather its template's lowered sim table — the sim-exec
@@ -597,11 +612,12 @@ def _shared_step_cached(spec, B: int, R: int, backend: str,
         pred, sim_plane = predict_and_mark(edges, valid, sim_plane,
                                            bits)
         admit = novel & (pred | (op == OP_INSERT))
+        heat = heat.at[ti].add(admit.astype(jnp.uint32))
         rows, pool_arr, n_used = pool(rows, payloads, needs & admit)
         n_suppressed = (novel & ~admit).sum().astype(jnp.int32)
         rows, n_novel = compact_rows(rows, admit)
         return (rows, pool_arr, n_used, n_novel, plane, sim_plane,
-                n_suppressed)
+                n_suppressed, heat)
 
     if prescore:
         return jax.jit(fused_prescore_step)
@@ -649,8 +665,6 @@ class DevicePipeline:
         self.exec_templates: list[Optional[ExecTemplate]] = [None] * capacity
         self._n = 0  # occupied prefix length
         self._next_evict = 0
-        self._pending_rows: list[tuple[int, dict]] = []
-        self._corpus_dev: Optional[dict] = None
         self._flags_dev = None
         self._flags_len = 0
         self._key = random.key(seed)
@@ -677,8 +691,6 @@ class DevicePipeline:
         self._hbm_prio = telemetry.HBM.register(
             "pipeline", "prio",
             [self._runs_dev, self._by_syscall_dev], bound_to=self)
-        self._hbm_corpus = telemetry.HBM.register(
-            "pipeline", "corpus", bound_to=self)
         self._hbm_flags = telemetry.HBM.register(
             "pipeline", "flags", bound_to=self)
         self._hbm_plane = telemetry.HBM.register(
@@ -758,13 +770,20 @@ class DevicePipeline:
         # the corpus-flush scatter — rows re-stack into rotating pow2
         # arena slots instead of fresh np.stack allocations per flush.
         self._staging = StagingArena(slots=2)
+        # Device-resident corpus arena (ISSUE 18, ops/arena): the
+        # serialized corpus lives in pow2-bucketed device slabs, the
+        # per-batch template pick runs ON DEVICE against the arena's
+        # cumulative-weight vector, and the host keeps only the
+        # durable authority copy.  Shares this pipeline's staging
+        # rotation so the corpus-flush scatter's allocation pins
+        # (test_staging) hold across arena growth.
+        self.arena = CorpusArena(capacity, staging=self._staging)
+        # Cadenced Minimize-style distillation over the arena
+        # (TZ_ARENA_DISTILL_EVERY; off by default) + the device heat
+        # vector the prescored step accumulates novelty yield into.
+        self._distill = DistillLane(self.cfg.max_calls)
+        self._heat_dev = None
         self._seq = 0  # drain sequence: AssembledBatch.seq values
-        # Pre-rebased flat donor tables keyed by a template's copyout
-        # count (emit.build_donor_table): the insert splicer gathers
-        # donor words from these instead of rebasing per mutant.
-        # Bounded: at most MAX_COPYOUT+1 distinct bases; racing pool
-        # threads may build one twice, harmlessly.
-        self._donor_tables: dict = {}
         # Stacked template table (emit.TemplateTable) for the one-pass
         # batch assembler, cached per exec-template snapshot content
         # (adds/evictions invalidate; steady-state batches reuse), and
@@ -812,6 +831,12 @@ class DevicePipeline:
         # Typo guard: a misspelled TZ_* knob parses as "unset" and
         # silently changes nothing — flag it once at engine start.
         warn_unknown_tz_vars()
+
+    @property
+    def _corpus_dev(self):
+        """The arena's device slabs (compat alias: bench and older
+        tests read the pre-arena attribute of the same name)."""
+        return self.arena._dev
 
     # Pre-breaker tuning knobs kept as proxies: tests and deployments
     # set these to shrink recovery latency (test_pipeline.py).
@@ -876,6 +901,12 @@ class DevicePipeline:
         also attached the mesh seeds its signal authority from the
         same host mirror."""
         self._mesh_engine = engine
+        # The arena joins the mesh's fault domain (ISSUE 18): chip
+        # loss re-shards its slabs from host authority.  Guarded so
+        # fault-drill stubs without the hook still attach.
+        attach_arena = getattr(engine, "attach_arena", None)
+        if attach_arena is not None:
+            attach_arena(self.arena)
         if self.triage_engine is not None:
             engine.attach_triage(self.triage_engine)
 
@@ -905,6 +936,14 @@ class DevicePipeline:
         if mp is not None:
             self.restore_mutant_plane(mp.get("plane"),
                                       bits=mp.get("bits"))
+        # Corpus-arena authority (ISSUE 18): serialized programs +
+        # sampling weights + epoch checkpoint as one section; a warm
+        # restart re-stages every row through add() — ONE flush
+        # scatter at the next launch, zero new jits, zero re-triage.
+        store.register("corpus_arena", self.durable_corpus_arena)
+        ca = rec.get("corpus_arena")
+        if ca is not None:
+            self.restore_corpus_arena(ca)
 
     def durable_mutant_plane(self) -> tuple:
         """Checkpoint section: the fused drain's device mutant plane,
@@ -936,6 +975,69 @@ class DevicePipeline:
             return
         self._mutant_plane = self._jnp.asarray(arr)
         self._hbm_plane.update(self._mutant_plane)
+
+    def durable_corpus_arena(self) -> tuple:
+        """Checkpoint section: the arena's durable authority — every
+        occupied row's typed program serialized (models/encoding) +
+        its sampling weight + the arena epoch (ops/arena.pack_arena).
+        Host-only work: the device slabs are never read back, because
+        host authority is always current (stage() writes through)."""
+        from syzkaller_tpu.models.encoding import serialize_prog
+        from syzkaller_tpu.ops.arena import pack_arena
+
+        with self._lock:
+            n = self._n
+            progs = []
+            for i in range(n):
+                t = self.templates[i]
+                try:
+                    progs.append(serialize_prog(t.template)
+                                 if t is not None and
+                                 t.template is not None else b"")
+                except Exception:
+                    progs.append(b"")
+            if self.arena.weights is not None:
+                weights = self.arena.weights[:n].copy()
+            else:
+                weights = np.ones(n, np.uint32)
+        return pack_arena(progs, weights, self.arena.epoch)
+
+    def restore_corpus_arena(self, section: dict) -> None:
+        """Install a recovered corpus-arena section: deserialize each
+        program and re-enter it through add() — the encode path is
+        deterministic, so the rebuilt templates and exec templates
+        match what the checkpoint's rows described, and the next
+        flush is the arena's ONE re-upload scatter (no re-jit, no
+        re-triage — coverage authority restores separately).  A row
+        that no longer deserializes (syscall table drift across the
+        restart) is skipped, not fatal."""
+        from syzkaller_tpu.models.encoding import deserialize_prog
+        from syzkaller_tpu.ops.arena import unpack_arena
+
+        try:
+            progs, weights, epoch = unpack_arena(
+                section.get("meta") or {}, section.get("blob") or b"")
+        except Exception:
+            return
+        restored = 0
+        for k, raw in enumerate(progs):
+            if not raw:
+                continue
+            try:
+                p = deserialize_prog(self.target, bytes(raw))
+            except Exception:
+                continue
+            if self.add(p):
+                w = int(weights[k]) if k < len(weights) else 1
+                if w != 1:
+                    self.arena.set_weight(self._n - 1, w)
+                restored += 1
+        self.arena.restore_epoch(epoch)
+        if restored:
+            telemetry.record_event(
+                "arena.epoch",
+                f"arena restore: {restored} rows re-staged from the "
+                f"checkpoint authority (epoch {self.arena.epoch})")
 
     def _compile_key(self, prescore: bool) -> dict:
         """The static shape key of the step executable, as the
@@ -978,6 +1080,8 @@ class DevicePipeline:
             "hbm": telemetry.HBM.snapshot(),
             "compiles": telemetry.COMPILES.snapshot(),
         }
+        out["arena"] = self.arena.snapshot()
+        out["arena"]["distill"] = self._distill.snapshot()
         if self.triage_engine is not None:
             out["triage"] = self.triage_engine.snapshot()
         if self._mesh_engine is not None:
@@ -1007,7 +1111,7 @@ class DevicePipeline:
                 self.stats.evictions += 1
             self.templates[i] = t
             self.exec_templates[i] = et
-            self._pending_rows.append((i, t.arrays()))
+            self.arena.stage(i, t.arrays())
             self.stats.adds += 1
         self._have_corpus.set()
         return True
@@ -1017,85 +1121,28 @@ class DevicePipeline:
             return self._n
 
     def _flush_pending(self):
-        """Apply staged corpus rows to the device arrays (one scatter
-        per field).  Returns (device corpus, n, template snapshot,
-        exec-template snapshot) — the snapshots are taken under the
-        same lock as the pending drain, so they describe exactly the
-        state the device arrays will hold."""
+        """Apply staged corpus rows to the arena's device slabs (one
+        scatter per field, through the arena's begin/commit split).
+        Returns (device corpus, n, template snapshot, exec-template
+        snapshot, cumw device vector, total sampling weight) — the
+        snapshots are taken under the same lock as the arena's
+        staging drain (begin_flush), so they describe exactly the
+        state the device slabs will hold.  On a device failure the
+        arena keeps its pending set, so the worker's retry re-uploads
+        exactly what this call could not — the pre-arena re-queue
+        contract, now the arena's."""
         jnp = self._jnp
         with self._lock:
-            pending, self._pending_rows = self._pending_rows, []
             n = self._n
             tmpl = list(self.templates)
             ets = list(self.exec_templates)
+            token = self.arena.begin_flush(jnp)
         if n == 0:
-            return None, 0, tmpl, ets
-        corpus_was_live = self._corpus_dev is not None
-        try:
-            if self._corpus_dev is None:
-                proto = pending[0][1] if pending else tmpl[0].arrays()
-                self._corpus_dev = {
-                    k: jnp.zeros((self.capacity,) + np.shape(v),
-                                 dtype=np.asarray(v).dtype)
-                    for k, v in proto.items()}
-            if pending:
-                # Ring wrap can stage two rows for the same slot; XLA
-                # scatter order with duplicate indices is unspecified,
-                # so keep only the LAST row per index (matching the
-                # host template snapshot).
-                last = {i: r for i, r in pending}
-                idx_list = list(last.keys())
-                # Pad the scatter to a power-of-two row count so
-                # corpus growth / ring rebuilds don't re-jit the
-                # per-field scatter on every new pending-count shape
-                # (a host-snapshot rebuild stages the whole ring, and
-                # on the tunneled chip each re-jit costs more than
-                # the scatter itself).  Duplicating one index with
-                # identical row data is well-defined even under
-                # XLA's unspecified duplicate-index order.  The
-                # padded rows are staged through the persistent
-                # transfer-plane arena (ops/staging): one rotating
-                # slot per pow2 bucket instead of fresh
-                # np.array/np.stack allocations per flush.
-                n_rows = len(idx_list)
-                bucket = pow2_rows(n_rows)
-                fields = {"idx": ((bucket,), np.int32)}
-                for k, v in self._corpus_dev.items():
-                    fields["row:" + k] = ((bucket,) + v.shape[1:],
-                                          v.dtype)
-                bufs = self._staging.acquire(("corpus", bucket), fields)
-                idx = bufs["idx"]
-                idx[:n_rows] = idx_list
-                idx[n_rows:] = idx_list[-1]
-                rows_by_key = {}
-                for k in self._corpus_dev:
-                    rows = bufs["row:" + k]
-                    np.stack([np.asarray(r[k])
-                              for r in last.values()],
-                             out=rows[:n_rows])
-                    rows[n_rows:] = rows[n_rows - 1]
-                    rows_by_key[k] = rows
-                # The H2D edge: every per-field scatter uploads its
-                # staged rows (the span separates transfer cost from
-                # the host-side staging above it).
-                with telemetry.span("pipeline.h2d_wait"):
-                    fault_point("staging.h2d")
-                    for k, rows in rows_by_key.items():
-                        self._corpus_dev[k] = \
-                            self._corpus_dev[k].at[idx].set(rows)
-        except Exception:
-            # The worker survives device failures and retries
-            # (_worker_loop); consumed-but-unapplied rows must go
-            # back on the staging queue or device rows desync from
-            # the host template snapshot permanently.
-            with self._lock:
-                self._pending_rows = pending + self._pending_rows
-            raise
-        if pending or not corpus_was_live:
-            # The scatter replaced the per-field arrays (functional
-            # .at[].set), so the ledger entry re-points at the live
-            # buffers — reconcile identity follows the rebuild.
-            self._hbm_corpus.update(self._corpus_dev)
+            return None, 0, tmpl, ets, None, 0
+        corpus, _n_arena, cumw, total = \
+            self.arena.commit_flush(jnp, token)
+        if corpus is None:
+            return None, 0, tmpl, ets, None, 0
         # Flag tables grow as new sets are interned; pad the row count
         # to a power of two so growth doesn't re-jit the step, and
         # re-upload only on growth (the host link is latency-bound).
@@ -1124,13 +1171,13 @@ class DevicePipeline:
                                self._jnp.asarray(fc_np))
             self._flags_len = new_len
             self._hbm_flags.update(list(self._flags_dev))
-        return self._corpus_dev, n, tmpl, ets
+        return corpus, n, tmpl, ets, cumw, total
 
     # -- the device loop ---------------------------------------------------
 
     def _launch(self):
         with telemetry.span("pipeline.flush"):
-            corpus, n, tmpl, ets = self._flush_pending()
+            corpus, n, tmpl, ets, cumw, total = self._flush_pending()
         if corpus is None:
             return None
         # Lineage: one trace context per batch, minted at flush time
@@ -1162,13 +1209,21 @@ class DevicePipeline:
         # mutants (the plain path still ships every plane-novel row).
         sim = self._sim
         use_sim = False
-        sim_tables = sim_plane = None
+        sim_tables = sim_plane = heat = None
         if sim is not None and self._step_sim is not None \
                 and sim.breaker.allow():
             try:
                 fault_point("device.sim")
                 sim_tables = sim.device_tables(ets)
                 sim_plane = sim.ensure_plane()
+                # The arena heat vector rides the prescored step's
+                # outputs (functional update, same discipline as the
+                # planes); zeros after an invalidation.
+                heat = self._heat_dev
+                if heat is None:
+                    heat = self._jnp.zeros(
+                        (corpus["val"].shape[0],), self._jnp.uint32)
+                    self._heat_dev = heat
                 use_sim = True
             except Exception as e:
                 sim.note_failure(e)
@@ -1178,17 +1233,18 @@ class DevicePipeline:
             if use_sim:
                 try:
                     return self._step_sim(
-                        corpus, n, sub, fv, fc, plane, sim_plane,
-                        sim_tables, self._runs_dev,
+                        corpus, cumw, total, sub, fv, fc, plane,
+                        sim_plane, sim_tables, heat, self._runs_dev,
                         self._by_syscall_dev)
                 except FaultInjected:
                     raise
                 except Exception as e:
                     sim.note_failure(e)
             if self._fused:
-                return self._step(corpus, n, sub, fv, fc, plane,
-                                  self._runs_dev, self._by_syscall_dev)
-            return self._step(corpus, n, sub, fv, fc,
+                return self._step(corpus, cumw, total, sub, fv, fc,
+                                  plane, self._runs_dev,
+                                  self._by_syscall_dev)
+            return self._step(corpus, cumw, total, sub, fv, fc,
                               self._runs_dev, self._by_syscall_dev)
 
         # Spans time the host-observed dispatch (XLA returns async:
@@ -1225,13 +1281,16 @@ class DevicePipeline:
         # path (CPU tests, older plugins) falls back to the
         # synchronous drain, counted instead of swallowed silently.
         n_suppr_dev = None
-        if len(result) == 7:
+        if len(result) == 8:
             # Prescored fused drain (ISSUE 15): also carry the updated
-            # speculation plane and the suppressed-row count.
+            # speculation plane, the suppressed-row count, and the
+            # arena heat vector (ISSUE 18 — stays resident; the
+            # distill cadence folds it into the sampling weights).
             (rows_dev, pool_dev, n_used_dev, n_novel_dev, plane,
-             sim_plane_new, n_suppr_dev) = result
+             sim_plane_new, n_suppr_dev, heat_new) = result
             self._mutant_plane = plane
             sim.commit(sim_plane_new)
+            self._heat_dev = heat_new
             async_arrs = (n_used_dev, n_novel_dev, n_suppr_dev)
         elif self._fused:
             rows_dev, pool_dev, n_used_dev, n_novel_dev, plane = result
@@ -1490,16 +1549,17 @@ class DevicePipeline:
             if not sel.size:
                 continue
             rows = ins[sel]
-            table = self._donor_tables.get(et.ncopyouts)
-            if table is None:
-                from syzkaller_tpu.ops.emit import build_donor_table
-
-                table = build_donor_table(et.ncopyouts, blocks)
-                self._donor_tables[et.ncopyouts] = table
+            # The arena-flat donor path (ISSUE 18): donor words come
+            # straight out of the shared DonorBankTable flat arrays
+            # and the copyout rebase is an in-arena add — no per-base
+            # build_donor_table re-stack, so the old per-ncopyouts
+            # table cache is gone entirely.
+            if self._dbank_table is None:
+                self._dbank_table = DonorBankTable(blocks)
             try:
-                datas = splice_insert_group(
+                datas = splice_insert_group_flat(
                     et, batch.alive_bits[rows], donors[sel],
-                    batch.pos[rows], blocks, table)
+                    batch.pos[rows], self._dbank_table)
             except Exception:
                 # Degrade to the per-mutant splice so one bad row
                 # cannot sink its template group.
@@ -1530,22 +1590,23 @@ class DevicePipeline:
         authoritative corpus, so the next successful flush rebuilds
         the ring from scratch."""
         with self._lock:
-            self._corpus_dev = None
             self._flags_dev = None
             self._flags_len = 0
             # The mutant dedup plane lived in the same device session;
             # rebuild it zeroed.  Losing cross-batch dedup history is
-            # safe — previously-seen rows just ship once more.
+            # safe — previously-seen rows just ship once more.  Same
+            # for the arena heat vector: unfolded heat is advisory
+            # sampling bias, not corpus state.
             self._mutant_plane = None
-            self._pending_rows = [
-                (i, t.arrays()) for i, t in enumerate(self.templates)
-                if t is not None]
+            self._heat_dev = None
             # The ledger must drop the dead buffers with them: a
             # half-open rebuild that left stale entries would read as
             # an hbm.drift leak at the next reconcile.
-            self._hbm_corpus.update(None)
             self._hbm_flags.update(None)
             self._hbm_plane.update(None)
+        # Epoch bump: every occupied arena row re-stages from host
+        # authority — ONE scatter at the next flush, zero new jits.
+        self.arena.invalidate()
         if self.triage_engine is not None:
             # The signal plane is co-resident with the corpus ring: a
             # restarted backend invalidated its buffer too, so it must
@@ -1555,6 +1616,72 @@ class DevicePipeline:
             # Same session: the stacked sim tables and speculation
             # plane re-upload from host state on the next launch.
             self._sim.invalidate_device_state()
+
+    def _distill_round(self) -> None:
+        """One cadenced distillation round (ISSUE 18): pull the
+        device heat vector into the sampling weights, then run the
+        fused bisection batch — sim-exec original + suffix-truncation
+        candidates for the lane's next row window, keep the shortest
+        candidate whose predicted edge folds cover the original's,
+        and retire the superseded rows by truncating their templates
+        in place and re-staging the shrunken rows over the same
+        slots.  Runs from the worker thread between batches, under
+        the device.arena seam; device time books to lane=distill."""
+        from syzkaller_tpu.ops.arena import (
+            build_distill_batch,
+            truncated_alive,
+        )
+
+        lane = self._distill
+        with self._lock:
+            n = self._n
+            tmpl = list(self.templates)
+            ets = list(self.exec_templates)
+        # Heat fold first: even a round with no eligible rows turns
+        # the device-observed novelty yield into sampling weights.
+        heat = self._heat_dev
+        if heat is not None:
+            self.arena.fold_heat(np.asarray(heat))
+        slots = lane.select_slots(tmpl, n)
+        if not slots:
+            return
+        fault_point("device.arena")
+        t0 = time.perf_counter()
+        with telemetry.span("arena.distill"):
+            table_rows, ncalls, alive, vals, keeps = \
+                build_distill_batch(self.arena, tmpl, ets, slots,
+                                    self.cfg.max_calls,
+                                    lane.max_cands)
+            covers, _n_orig = lane.check(table_rows, ncalls, alive,
+                                         vals)
+        elapsed = time.perf_counter() - t0
+        wins = lane.choose(covers, keeps)
+        retired = 0
+        for r, m in enumerate(wins):
+            if m is None:
+                continue
+            i = slots[r]
+            with self._lock:
+                t = self.templates[i]
+                if t is not tmpl[i]:
+                    continue  # slot re-used mid-round: verdict stale
+                mask = truncated_alive(t.call_alive,
+                                       int(keeps[r, m]))
+                t.call_alive[:] = mask
+                self.arena.stage(i, t.arrays())
+            retired += 1
+        lane.retired += retired
+        self.arena.note_retired(retired)
+        # Accounting (ISSUE 14): the round's device residency books
+        # to lane=distill — tz_acct_device_ms_total{lane="distill"}
+        # is the composer's view of what hygiene costs.
+        telemetry.ACCOUNTING.note_batch(
+            elapsed,
+            lane_rows={"distill": len(slots) * (lane.max_cands + 1)})
+        telemetry.record_event(
+            "arena.distill",
+            f"distill round {lane.rounds}: {len(slots)} rows, "
+            f"{retired} retired")
 
     def _worker_loop(self) -> None:
         from collections import deque
@@ -1638,6 +1765,17 @@ class DevicePipeline:
             if self._stop.is_set():
                 return
             self.breaker.record_success()
+            # Cadenced arena distillation (ISSUE 18): opt-in via
+            # TZ_ARENA_DISTILL_EVERY; a failed round counts and skips
+            # — corpus hygiene must never trip the device breaker.
+            if self.arena.device_enabled and self._distill.tick():
+                try:
+                    self._distill_round()
+                except Exception as e:
+                    self._distill.errors += 1
+                    log.logf(0, "arena distill round failed "
+                                "(#%d): %s", self._distill.errors,
+                             str(e)[:200])
             # Self-tuning drain->assemble overlap: one controller tick
             # per collected batch feeds the measured pool_drain vs
             # assemble_worker percentiles back into assemble_depth

@@ -186,6 +186,14 @@ class MeshEngine:
         self._mplane_dev = None
         self._last_reshard: Optional[float] = None
         self.triage = None
+        # Corpus arena (ISSUE 18, ops/arena): when attached, every
+        # topology rebuild re-stages the arena slabs from HOST
+        # authority, row-sharded over the 'batch' mesh axis — chip
+        # loss costs device residency, never corpus rows.
+        self._arena = None
+        self._arena_dev = None
+        self._hbm_arena = telemetry.HBM.register(
+            "mesh", "arena", bound_to=self)
         # Residency ledger (ISSUE 17): the cov-sharded device planes
         # and their host-authority mirrors are the mesh's long-lived
         # footprint; updated at every re-shard / step absorb.
@@ -243,6 +251,7 @@ class MeshEngine:
         self._plane_dev = jax.device_put(jnp.asarray(self._mirror), sh)
         self._mplane_dev = jax.device_put(jnp.asarray(self._mmirror), sh)
         self._hbm_planes.update([self._plane_dev, self._mplane_dev])
+        self._reshard_arena()
         self._last_reshard = self._clock()
         _M_RESHARD.inc()
         _M_RESHARD_TS.set(time.time())
@@ -256,6 +265,41 @@ class MeshEngine:
             f"{self._mesh.shape['cov']}")
 
     # -- integration ------------------------------------------------------
+
+    def attach_arena(self, arena) -> None:
+        """Register a pipeline's corpus arena (ISSUE 18): its device
+        slabs become part of this mesh's fault domain.  At every
+        topology rebuild the occupied rows re-stage from the arena's
+        HOST authority, row-sharded over the 'batch' axis, and the
+        owning pipeline's slab copy is invalidated so its next flush
+        is the one-scatter epoch rebuild — zero lost corpus under
+        chip loss (test_mesh_faults pins the row-count conservation).
+        """
+        with self._lock:
+            self._arena = arena
+            self._reshard_arena()
+
+    def _reshard_arena(self) -> None:
+        arena = self._arena
+        if arena is None or arena.host is None:
+            return
+        # Whole-slab re-stage from host authority (a copy, so the
+        # device_put never aliases the mutable authority arrays).
+        # Slab capacity is pow2 (ops/arena slab_capacity): it divides
+        # any pow2 live width, but a demote can leave an odd width
+        # (8 -> 7), so fall back to replication there — residency
+        # costs more for the degraded interval, rows are never lost.
+        rows = arena.authority_rows(np.arange(arena.capacity))
+        width = int(self._mesh.shape["batch"])
+        spec = P("batch") if arena.capacity % width == 0 else P()
+        sh = NamedSharding(self._mesh, spec)
+        self._arena_dev = {k: jax.device_put(jnp.asarray(v), sh)
+                           for k, v in rows.items()}
+        self._hbm_arena.update(list(self._arena_dev.values()))
+        # The owning pipeline's own slab copy lived on the same
+        # (possibly shrunken) device set: epoch-bump it so the next
+        # pipeline flush re-uploads from the same host authority.
+        arena.invalidate()
 
     def attach_triage(self, engine) -> None:
         """Co-use the production TriageEngine's host mirror as this
@@ -535,6 +579,9 @@ class MeshEngine:
                 "last_reshard_age_s": (
                     None if self._last_reshard is None
                     else round(self._clock() - self._last_reshard, 3)),
+                "arena_rows": (0 if self._arena is None
+                               else self._arena.n),
+                "arena_sharded": self._arena_dev is not None,
                 "shards": [d.snapshot() for d in self.domains],
             }
 

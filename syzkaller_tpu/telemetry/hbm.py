@@ -64,7 +64,8 @@ DEFAULT_CAPACITY_BYTES = 16 << 30
 #: this table — an owner string outside it (or an entry with no call
 #: site) is a lint failure, so a new subsystem holding persistent
 #: device state must declare itself here.
-OWNERS = ("mesh", "pipeline", "serve", "sim", "staging", "triage")
+OWNERS = ("arena", "mesh", "pipeline", "serve", "sim", "staging",
+          "triage")
 
 #: Buffers living in host memory (pinned staging arenas, host
 #: mirrors, per-tenant planes) register under device="host": they are

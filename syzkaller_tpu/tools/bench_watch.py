@@ -212,6 +212,30 @@ def wedge_report(snap: dict) -> list[str]:
         if s_demos:
             line += f", {int(s_demos)} demotions"
         lines.append(line)
+    # Corpus arena (ISSUE 18): residency + upload cadence + the
+    # distillation lane's hygiene yield.  Steady rows with a flat
+    # upload count is the healthy resident state (zero H2D corpus
+    # bytes per batch); uploads climbing batch-over-batch means the
+    # slabs are thrashing (breaker churn or an invalidate loop), and
+    # an epoch that keeps bumping names the demote/re-shard cause.
+    a_rows = gauges.get("tz_arena_rows") or 0
+    a_cap = gauges.get("tz_arena_capacity_rows") or 0
+    if a_rows or a_cap:
+        slab_kib = (gauges.get("tz_arena_slab_bytes") or 0) / 1024
+        line = (f"corpus arena: {int(a_rows)}/{int(a_cap)} rows, "
+                f"epoch {int(gauges.get('tz_arena_epoch') or 0)}, "
+                f"slabs {slab_kib:.1f} KiB")
+        ups = counters.get("tz_arena_uploads_total") or 0
+        if ups:
+            up_kib = (counters.get("tz_arena_upload_bytes_total")
+                      or 0) / 1024
+            line += f", {int(ups)} uploads ({up_kib:.1f} KiB)"
+        d_rounds = counters.get("tz_arena_distill_rounds_total") or 0
+        if d_rounds:
+            retired = counters.get("tz_arena_retired_rows_total") or 0
+            line += (f", distill {int(d_rounds)} rounds "
+                     f"({int(retired)} rows retired)")
+        lines.append(line)
     # Triage plane health (ISSUE 4): pre-filter hit rate and the
     # realized device-checked call rate — next to the demotion count
     # so a CPU-path regression is visible in the same A/B snapshot.

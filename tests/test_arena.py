@@ -1,0 +1,505 @@
+"""Device-resident corpus arena tests (ISSUE 18).
+
+The load-bearing oracles:
+  - SAMPLING: `pick_rows` (the on-device weighted cumulative-weight
+    search) must equal `pick_rows_host` bit for bit on the same
+    uint32 draws, and with unit weights must degenerate EXACTLY to
+    the legacy `bits % n` row stream — turning the arena on may not
+    move a single sample.
+  - SPLICE: `splice_insert_group_flat` (flat DonorBankTable indexing,
+    no per-base donor re-stack) must be byte-identical to the staged
+    `splice_insert_group` path on the same inputs.
+  - DISTILL: the fused device bisection (`make_distill_check`) must
+    agree verdict-for-verdict with the host oracle
+    (`distill_verdicts_host` = sim_exec_host + digest_covers at
+    FOLD_BITS, where the digest bucket IS the fold).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from syzkaller_tpu.ops.arena import (  # noqa: E402
+    CorpusArena,
+    DistillLane,
+    alive_mask_bits,
+    build_distill_batch,
+    cumw_from_weights,
+    distill_verdicts_host,
+    make_distill_check,
+    pack_arena,
+    pick_rows,
+    pick_rows_host,
+    slab_capacity,
+    truncated_alive,
+    truncation_keep_counts,
+    unpack_arena,
+)
+
+
+# -- sizing ---------------------------------------------------------------
+
+
+def test_slab_capacity_rounds_up_and_trims_to_headroom():
+    # Plenty of headroom: round the ring up to whole slabs.
+    assert slab_capacity(64, 100, headroom_bytes=1 << 30,
+                         slab_bits=10) == 1024
+    assert slab_capacity(1025, 100, headroom_bytes=1 << 40,
+                         slab_bits=10) == 2048
+    # Tight headroom: trim whole slabs back toward the request, but
+    # never below it — the ring needs its slots.
+    tight = slab_capacity(64, 1 << 20, headroom_bytes=1 << 20,
+                          slab_bits=4)
+    assert 64 <= tight < 1024
+    assert tight % (1 << 4) == 0
+    assert slab_capacity(64, 1 << 20, headroom_bytes=0,
+                         slab_bits=4) == 64
+    # Degenerate request still yields one slab.
+    assert slab_capacity(1, 8, headroom_bytes=1 << 30,
+                         slab_bits=4) == 16
+
+
+# -- sampling parity ------------------------------------------------------
+
+
+def test_pick_rows_unit_weights_is_legacy_modulo_stream():
+    """Unit weights: cumw = [1..n, n, ..], total = n, so the pick is
+    bit-exactly the legacy `bits % n` — for every n and a threefry-
+    sized random draw."""
+    rng = np.random.RandomState(11)
+    for n in (1, 2, 7, 64, 100):
+        cumw, total = cumw_from_weights(np.ones(n, np.uint32), n, 128)
+        assert total == n
+        bits = rng.randint(0, 1 << 32, size=256, dtype=np.uint64) \
+            .astype(np.uint32)
+        legacy = (bits % np.uint32(n)).astype(np.int32)
+        host = pick_rows_host(cumw, total, bits)
+        dev = np.asarray(pick_rows(jnp.asarray(cumw), total,
+                                   jnp.asarray(bits)))
+        np.testing.assert_array_equal(host, legacy)
+        np.testing.assert_array_equal(dev, legacy)
+
+
+def test_pick_rows_weighted_parity_randomized():
+    """Randomized weighted parity: device and host pickers agree bit
+    for bit on arbitrary small-int weight vectors (including zero-
+    weight holes), and every pick lands on a positive-weight row."""
+    rng = np.random.RandomState(23)
+    for trial in range(10):
+        cap = int(rng.choice([16, 64, 256]))
+        n = int(rng.randint(1, cap + 1))
+        weights = rng.randint(0, 9, size=cap).astype(np.uint32)
+        weights[rng.randint(0, n)] = 1  # at least one occupied row
+        weights[n:] = 0
+        cumw, total = cumw_from_weights(weights, n, cap)
+        assert total == int(weights[:n].sum())
+        bits = rng.randint(0, 1 << 32, size=512, dtype=np.uint64) \
+            .astype(np.uint32)
+        host = pick_rows_host(cumw, total, bits)
+        dev = np.asarray(pick_rows(jnp.asarray(cumw), total,
+                                   jnp.asarray(bits)))
+        np.testing.assert_array_equal(dev, host)
+        assert host.min() >= 0 and host.max() < n
+        assert np.all(weights[host] > 0), \
+            "weighted pick landed on a zero-weight row"
+
+
+def test_pick_rows_weight_bias_observable():
+    """A heavily weighted row dominates the sample — the heat
+    feedback must actually steer the stream."""
+    weights = np.ones(8, np.uint32)
+    weights[3] = 100
+    cumw, total = cumw_from_weights(weights, 8, 16)
+    rng = np.random.RandomState(5)
+    bits = rng.randint(0, 1 << 32, size=2048, dtype=np.uint64) \
+        .astype(np.uint32)
+    picks = pick_rows_host(cumw, total, bits)
+    frac = float(np.mean(picks == 3))
+    assert frac > 0.8, f"weight-100 row drew only {frac:.2%}"
+
+
+# -- arena lifecycle ------------------------------------------------------
+
+
+def _row(i, seed=0):
+    rng = np.random.RandomState(seed + i)
+    return {"val": rng.randint(0, 1 << 31, size=6).astype(np.uint64),
+            "len": np.int32(i + 1)}
+
+
+def test_arena_stage_flush_matches_host_authority():
+    a = CorpusArena(8, slab_bits=4, headroom_bytes=1 << 30)
+    for i in range(5):
+        a.stage(i, _row(i))
+    dev, n, cumw, total = a.flush(jnp)
+    assert n == 5 and a.capacity == 16
+    assert a.uploads == 1 and a.upload_bytes > 0
+    assert len(a._pending) == 0
+    for i in range(5):
+        np.testing.assert_array_equal(
+            np.asarray(dev["val"][i]), a.host["val"][i])
+    assert total == 5  # unit weights
+    # Clean re-flush: no new upload, same device image.
+    dev2, _n2, _cw2, _t2 = a.flush(jnp)
+    assert a.uploads == 1 and dev2 is dev
+
+
+def test_arena_invalidate_bumps_epoch_and_restages_everything():
+    a = CorpusArena(8, slab_bits=4, headroom_bytes=1 << 30)
+    for i in range(4):
+        a.stage(i, _row(i))
+    a.flush(jnp)
+    assert a.epoch == 0
+    a.invalidate()
+    assert a.epoch == 1
+    assert len(a._pending) == 4  # every occupied row re-stages
+    dev, n, _cw, total = a.flush(jnp)
+    assert a.uploads == 2 and n == 4 and total == 4
+    np.testing.assert_array_equal(
+        np.asarray(dev["val"][:4]), a.host["val"][:4])
+
+
+def test_arena_flush_failure_keeps_pending_for_retry():
+    """A scripted staging.h2d fault mid-commit leaves the pending set
+    intact — the worker's retry re-uploads exactly what the failed
+    scatter did not deliver."""
+    from syzkaller_tpu.health.faultinject import (
+        FaultInjected,
+        FaultPlan,
+        install_plan,
+        reset_plan,
+    )
+
+    a = CorpusArena(8, slab_bits=4, headroom_bytes=1 << 30)
+    for i in range(3):
+        a.stage(i, _row(i))
+    try:
+        install_plan(FaultPlan.parse("staging.h2d:fail@1"))
+        with pytest.raises(FaultInjected):
+            a.flush(jnp)
+        assert len(a._pending) == 3 and a.uploads == 0
+        dev, n, _cw, _t = a.flush(jnp)  # seam fires only once
+        assert a.uploads == 1 and n == 3
+        assert len(a._pending) == 0
+        np.testing.assert_array_equal(
+            np.asarray(dev["val"][:3]), a.host["val"][:3])
+    finally:
+        reset_plan()
+
+
+def test_arena_restage_during_flush_stays_pending():
+    """The staleness-tick contract: a row re-staged between phase A
+    and phase B (its data changed after the memcpy) survives the
+    commit still pending, so the NEW data uploads next flush."""
+    a = CorpusArena(8, slab_bits=4, headroom_bytes=1 << 30)
+    a.stage(0, _row(0))
+    token = a.begin_flush(jnp)
+    assert token[0] == "staged"
+    a.stage(0, _row(0, seed=99))  # newer tick, new bytes
+    a.commit_flush(jnp, token)
+    assert 0 in a._pending, "re-staged row was dropped by the commit"
+    dev, _n, _cw, _t = a.flush(jnp)
+    np.testing.assert_array_equal(
+        np.asarray(dev["val"][0]), _row(0, seed=99)["val"])
+
+
+def test_arena_kill_switch_forces_unit_weights(monkeypatch):
+    monkeypatch.setenv("TZ_ARENA_DEVICE", "0")
+    a = CorpusArena(8, slab_bits=4, headroom_bytes=1 << 30)
+    assert not a.device_enabled
+    for i in range(4):
+        a.stage(i, _row(i), weight=7)
+    _dev, n, cumw, total = a.flush(jnp)
+    assert total == n == 4  # unit weights despite weight=7 stages
+    np.testing.assert_array_equal(
+        np.asarray(cumw[:4]), np.arange(1, 5, dtype=np.uint32))
+    # fold_heat is a no-op under the kill switch
+    a.fold_heat(np.full(16, 5, np.uint32))
+    assert a.heat_folds == 0
+
+
+def test_arena_fold_heat_updates_weights():
+    a = CorpusArena(8, slab_bits=4, headroom_bytes=1 << 30)
+    for i in range(3):
+        a.stage(i, _row(i))
+    heat = np.zeros(16, np.uint32)
+    heat[:3] = [0, 3, 40]
+    a.fold_heat(heat)
+    assert a.heat_folds == 1
+    # weight = 1 + min(heat, 7) for occupied rows
+    np.testing.assert_array_equal(a.weights[:3], [1, 4, 8])
+    _dev, _n, _cw, total = a.flush(jnp)
+    assert total == 13
+
+
+def test_arena_shard_rows_partition_is_exact():
+    a = CorpusArena(8, slab_bits=4, headroom_bytes=1 << 30)
+    for i in range(7):
+        a.stage(i, _row(i))
+    seen = np.concatenate([a.shard_rows(s, 4) for s in range(4)])
+    np.testing.assert_array_equal(np.sort(seen), np.arange(7))
+    rows = a.authority_rows(a.shard_rows(1, 4))
+    np.testing.assert_array_equal(
+        rows["val"], a.host["val"][a.shard_rows(1, 4)])
+
+
+# -- durable codec --------------------------------------------------------
+
+
+def test_pack_unpack_arena_roundtrip():
+    progs = [b"prog-one", b"", b"a longer serialized program" * 9]
+    weights = np.array([1, 3, 250], np.uint32)
+    meta, blob = pack_arena(progs, weights, epoch=7)
+    got_progs, got_w, got_epoch = unpack_arena(meta, blob)
+    assert [bytes(p) for p in got_progs] == progs
+    np.testing.assert_array_equal(got_w, weights)
+    assert got_epoch == 7
+    # meta must stay JSON-ish (ints and lists, jax-free recovery path)
+    assert isinstance(meta["n"], int)
+    assert all(isinstance(w, int) for w in meta["weights"])
+
+
+def test_corpus_arena_warm_restart_single_reupload(test_target):
+    """ISSUE 18 restart contract, on the real pipeline seam: a
+    quiesced pipeline (worker never started — exactly the recovery
+    window attach_durable restores in) re-enters a checkpoint section
+    through restore_corpus_arena, and the first flush afterwards is
+    ONE scatter covering every restored row.  No invalidate, no new
+    epoch, no step compile, weights preserved, and the rebuilt device
+    rows are byte-identical to the pre-crash authority (the encode
+    path is deterministic)."""
+    from syzkaller_tpu import telemetry
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.rand import RandGen
+    from syzkaller_tpu.ops.pipeline import DevicePipeline
+
+    target = test_target
+    pl1 = DevicePipeline(target, capacity=16, batch_size=8, seed=0,
+                         dispatch_depth=1, rounds=1)
+    pl2 = None
+    try:
+        added, i = 0, 0
+        while added < 4 and i < 60:
+            if pl1.add(generate_prog(target, RandGen(target, 5200 + i),
+                                     4)):
+                added += 1
+            i += 1
+        assert added == 4
+        pl1.arena.set_weight(1, 6)
+        pl1.arena.set_weight(3, 2)
+        pl1._flush_pending()
+        assert pl1.arena.uploads >= 1
+        meta, blob = pl1.durable_corpus_arena()
+        assert meta["n"] == 4 and meta["weights"][1] == 6
+
+        # "restart": a fresh pipeline, worker not yet started
+        pl2 = DevicePipeline(target, capacity=16, batch_size=8, seed=0,
+                             dispatch_depth=1, rounds=1)
+        with telemetry.assert_no_new_compiles(pl2._step._cache_size):
+            pl2.restore_corpus_arena({"meta": meta, "blob": blob})
+            assert pl2._n == 4              # every row deserialized
+            assert int(pl2.arena.weights[1]) == 6
+            assert int(pl2.arena.weights[3]) == 2
+            assert len(pl2.arena._pending) == 4  # staged, not shipped
+            assert pl2.arena.uploads == 0
+            _corpus, n, _t, _e, _cumw, total = pl2._flush_pending()
+        assert pl2.arena.uploads == 1       # ONE re-upload scatter
+        assert n == 4 and not pl2.arena._pending
+        assert int(total) == 1 + 6 + 1 + 2  # weighted cumw rebuilt
+        assert pl2.arena.epoch == meta["epoch"]  # continued, not bumped
+        for k, v in pl1.arena.host.items():
+            np.testing.assert_array_equal(pl2.arena.host[k][:4], v[:4])
+    finally:
+        pl1.stop()
+        if pl2 is not None:
+            pl2.stop()
+
+
+# -- truncation helpers ---------------------------------------------------
+
+
+def test_truncation_keep_counts_ladder():
+    assert truncation_keep_counts(8, 4) == [7, 4, 2, 1]
+    assert truncation_keep_counts(2, 4) == [1]
+    assert truncation_keep_counts(1, 4) == []
+    assert truncation_keep_counts(9, 2) == [8, 4]
+    for ks in (truncation_keep_counts(n, 4) for n in range(2, 20)):
+        assert ks == sorted(ks, reverse=True)
+        assert len(ks) == len(set(ks))
+
+
+def test_truncated_alive_keeps_prefix_of_alive_calls():
+    ca = np.array([True, False, True, True, False, True])
+    np.testing.assert_array_equal(
+        truncated_alive(ca, 2),
+        [True, False, True, False, False, False])
+    assert alive_mask_bits(truncated_alive(ca, 2)) == 0b101
+    assert alive_mask_bits(ca) == 0b101101
+    np.testing.assert_array_equal(truncated_alive(ca, 10), ca)
+
+
+# -- splice: flat donor-bank parity ---------------------------------------
+
+
+def test_splice_insert_group_flat_matches_staged_group(test_target):
+    """The arena's base-independent splicer: donor words straight out
+    of the shared DonorBankTable flat arrays with an in-flight rebase
+    must be byte-identical to the per-base `build_donor_table` path
+    across random alive masks, positions, and donors."""
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.prio import build_choice_table
+    from syzkaller_tpu.models.rand import RandGen
+    from syzkaller_tpu.ops.emit import (
+        DonorBankTable,
+        build_exec_template,
+        splice_insert_group,
+        splice_insert_group_flat,
+    )
+    from syzkaller_tpu.ops.insert import DonorBank
+    from syzkaller_tpu.ops.tensor import (
+        FlagTables,
+        TensorConfig,
+        encode_prog,
+    )
+
+    ct = build_choice_table(test_target)
+    bank = DonorBank(test_target, ct, seed=5)
+    assert len(bank.blocks) > 4
+    dtab = DonorBankTable(bank.blocks)
+    cfg = TensorConfig()
+    flags = FlagTables.empty()
+    rng = np.random.RandomState(91)
+    tensors, i = [], 0
+    while len(tensors) < 5 and i < 40:
+        p = generate_prog(test_target, RandGen(test_target, 700 + i), 6)
+        i += 1
+        try:
+            tensors.append(encode_prog(p, cfg, flags))
+        except Exception:
+            continue
+    assert tensors
+    checked = 0
+    for t in tensors:
+        et = build_exec_template(t)
+        m = 24
+        donors = rng.randint(0, len(bank.blocks), size=m)
+        poses = rng.randint(0, et.ncalls + 3, size=m).astype(np.uint8)
+        full = (1 << max(et.ncalls, 1)) - 1
+        alive_bits = np.where(
+            rng.rand(m) < 0.5, full,
+            rng.randint(0, full + 1, size=m)).astype(np.uint64)
+        want = splice_insert_group(et, alive_bits, donors, poses,
+                                   bank.blocks)
+        got = splice_insert_group_flat(et, alive_bits, donors, poses,
+                                       dtab)
+        assert len(want) == len(got) == m
+        for k in range(m):
+            if want[k] is None:
+                assert got[k] is None
+            else:
+                assert got[k] is not None \
+                    and bytes(got[k]) == bytes(want[k]), \
+                    f"flat splice row {k} diverged"
+            checked += 1
+    assert checked >= 24
+
+
+# -- distillation ---------------------------------------------------------
+
+
+def _distill_fixture(target, n_rows=3, max_calls=16):
+    """Templates with duplicated calls (so suffix truncation can
+    genuinely cover), their exec templates, and an arena holding
+    their rows."""
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.prog import clone_call
+    from syzkaller_tpu.models.rand import RandGen
+    from syzkaller_tpu.ops.emit import build_exec_template
+    from syzkaller_tpu.ops.tensor import (
+        FlagTables,
+        TensorConfig,
+        encode_prog,
+    )
+
+    cfg = TensorConfig(max_calls=max_calls)
+    flags = FlagTables.empty()
+    tmpl, ets = [], []
+    i = 0
+    while len(tmpl) < n_rows and i < n_rows * 20:
+        p = generate_prog(target, RandGen(target, 5100 + i), 3)
+        i += 1
+        # Duplicate the calls: [a, b, c, a, b, c] — the second half
+        # predicts no new sim edges, so the keep=n/2 suffix
+        # truncation covers the original and a verdict fires.
+        p.calls = p.calls + [clone_call(c) for c in p.calls]
+        try:
+            t = encode_prog(p, cfg, flags)
+        except Exception:
+            continue
+        tmpl.append(t)
+        ets.append(build_exec_template(t))
+    assert tmpl, "no distill fixture programs tensorized"
+    arena = CorpusArena(8, slab_bits=4, headroom_bytes=1 << 30)
+    for k, t in enumerate(tmpl):
+        arena.stage(k, t.arrays())
+    return arena, tmpl, ets
+
+
+def test_distill_device_matches_host_oracle(test_target):
+    """The fused device bisection's cover verdicts equal the host
+    sim_exec_host + digest_covers oracle bit for bit, and duplicated
+    suffixes actually retire (a non-trivial win exists)."""
+    arena, tmpl, ets = _distill_fixture(test_target)
+    lane = DistillLane(max_calls=16, every=1, rows=4, max_cands=3)
+    slots = lane.select_slots(tmpl, len(tmpl))
+    assert slots, "no distillable rows in the fixture"
+    table_rows, ncalls, alive, vals, keeps = build_distill_batch(
+        arena, tmpl, ets, slots, 16, lane.max_cands)
+    covers_dev, n_orig = lane.check(table_rows, ncalls, alive, vals)
+    covers_host = distill_verdicts_host(table_rows, ncalls, alive,
+                                        vals)
+    np.testing.assert_array_equal(covers_dev, covers_host)
+    assert covers_dev[:, 0].all(), "originals must cover themselves"
+    wins = lane.choose(covers_dev, keeps)
+    assert any(w is not None for w in wins), \
+        "duplicated-call rows produced no truncation win"
+    for r, m in enumerate(wins):
+        if m is not None:
+            assert keeps[r, m] < keeps[r, 0]
+            assert covers_dev[r, m]
+
+
+def test_distill_check_jit_compiles_once(test_target):
+    """The lane's cover check is ONE jit at the pinned (R, M) shape:
+    a second round at the same shape reuses the executable."""
+    arena, tmpl, ets = _distill_fixture(test_target)
+    lane = DistillLane(max_calls=16, every=1, rows=4, max_cands=3)
+    slots = lane.select_slots(tmpl, len(tmpl))
+    batch = build_distill_batch(arena, tmpl, ets, slots, 16,
+                                lane.max_cands)
+    lane.check(*batch[:4])
+    sizes = lane._check._cache_size()
+    lane.check(*batch[:4])
+    assert lane._check._cache_size() == sizes
+    assert lane.rounds == 2
+
+
+def test_distill_lane_cadence_and_cursor():
+    lane = DistillLane(max_calls=8, every=3, rows=2, max_cands=2)
+    fires = [lane.tick() for _ in range(9)]
+    assert fires == [False, False, True] * 3
+    assert not DistillLane(max_calls=8, every=0).tick()
+
+    class _T:
+        def __init__(self, n_alive):
+            self.call_alive = np.zeros(8, bool)
+            self.call_alive[:n_alive] = True
+
+    tmpl = [_T(4), _T(1), _T(3), _T(5), _T(2)]
+    first = lane.select_slots(tmpl, len(tmpl))
+    assert first == [0, 2]  # slot 1 has < 2 alive calls
+    second = lane.select_slots(tmpl, len(tmpl))
+    assert second == [3, 4]  # cursor advanced past the first window
+    assert lane.select_slots([], 0) == []

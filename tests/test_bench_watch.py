@@ -191,6 +191,40 @@ def test_wedge_report_sim_prescore_line():
                    for ln in bw.wedge_report(_wedge_snapshot()))
 
 
+def test_wedge_report_corpus_arena_line():
+    """The corpus-arena diagnostics (ISSUE 18): residency, epoch,
+    slab footprint, upload cadence and the distillation lane's
+    retired-row yield render as one line."""
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    reg.gauge("tz_arena_rows").set(64)
+    reg.gauge("tz_arena_capacity_rows").set(1024)
+    reg.gauge("tz_arena_epoch").set(2)
+    reg.gauge("tz_arena_slab_bytes").set(512 * 1024)
+    reg.counter("tz_arena_uploads_total").inc(3)
+    reg.counter("tz_arena_upload_bytes_total").inc(96 * 1024)
+    reg.counter("tz_arena_distill_rounds_total").inc(5)
+    reg.counter("tz_arena_retired_rows_total").inc(7)
+    lines = bw.wedge_report(reg.snapshot())
+    line = next(ln for ln in lines if ln.startswith("corpus arena"))
+    assert "64/1024 rows" in line
+    assert "epoch 2" in line
+    assert "slabs 512.0 KiB" in line
+    assert "3 uploads (96.0 KiB)" in line
+    assert "distill 5 rounds (7 rows retired)" in line
+    # zero uploads / no distill rounds: the optional clauses drop
+    reg2 = Registry()
+    reg2.gauge("tz_arena_rows").set(12)
+    reg2.gauge("tz_arena_capacity_rows").set(1024)
+    lines = bw.wedge_report(reg2.snapshot())
+    line = next(ln for ln in lines if ln.startswith("corpus arena"))
+    assert "uploads" not in line and "distill" not in line
+    # a snapshot without arena gauges renders no line
+    assert not any(ln.startswith("corpus arena")
+                   for ln in bw.wedge_report(_wedge_snapshot()))
+
+
 def test_wedge_report_control_plane_line():
     """The control-plane health line (ISSUE 9): fleet liveness,
     retry/replay volume, and the admission state render in the wedge

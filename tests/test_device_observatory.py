@@ -139,7 +139,7 @@ def test_ledger_owner_vocabulary_is_closed():
     # the lint cross-check (tools/lint_metrics) greps call sites
     # against this tuple; the unit suite pins it is sorted + closed
     assert OWNERS == tuple(sorted(OWNERS))
-    assert set(OWNERS) == {"mesh", "pipeline", "serve", "sim",
+    assert set(OWNERS) == {"arena", "mesh", "pipeline", "serve", "sim",
                            "staging", "triage"}
 
 

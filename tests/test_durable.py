@@ -166,6 +166,47 @@ def test_store_checkpoint_resets_wal_and_recovers(tmp_path):
     store2.close(final_checkpoint=False)
 
 
+def test_store_corpus_arena_section_roundtrip(tmp_path):
+    """The arena's durable authority rides checkpoints as an opaque
+    (meta, blob) section: pack_arena on the provider side, a jax-free
+    passthrough in recovery.replay, unpack_arena on restore."""
+    from syzkaller_tpu.ops.arena import pack_arena, unpack_arena
+
+    progs = [b"r0(0x1)", b"r1(0x2, 0x3)", b"r2()"]
+    weights = np.array([1, 5, 2], np.uint32)
+    d = str(tmp_path / "d")
+    store = DurableStore(d, interval_s=3600.0)
+    store.register("corpus_arena",
+                   lambda: pack_arena(progs, weights, epoch=4))
+    assert store.checkpoint_now()
+    store.close(final_checkpoint=False)
+
+    store2 = DurableStore(d, interval_s=3600.0)
+    assert store2.recovery_state == RECOVERY_WARM
+    sec = store2.recovered["corpus_arena"]
+    # recovery must not decode the section (jax-free passthrough):
+    # it hands back exactly the meta dict + compressed blob
+    assert isinstance(sec["blob"], bytes)
+    assert sec["meta"]["n"] == 3 and sec["meta"]["epoch"] == 4
+    got_progs, got_w, got_epoch = unpack_arena(sec["meta"], sec["blob"])
+    assert got_progs == progs
+    assert got_w.dtype == np.uint32
+    assert got_w.tolist() == [1, 5, 2]
+    assert got_epoch == 4
+    store2.close(final_checkpoint=False)
+
+    # a checkpoint written without the section recovers without it:
+    # older images stay readable (forward/backward compatibility)
+    d2 = str(tmp_path / "d2")
+    store3 = DurableStore(d2, interval_s=3600.0)
+    store3.register("control", lambda: ({"queue": [], "corpus": {}}, b""))
+    assert store3.checkpoint_now()
+    store3.close(final_checkpoint=False)
+    store4 = DurableStore(d2, interval_s=3600.0)
+    assert "corpus_arena" not in store4.recovered
+    store4.close(final_checkpoint=False)
+
+
 def test_store_ckpt_seam_leaves_previous_image_authoritative(tmp_path):
     d = str(tmp_path / "d")
     store = DurableStore(d, interval_s=3600.0)

@@ -807,6 +807,73 @@ def test_fused_mutation_core_zero_new_jits_on_warm_pipeline(device_rig):
         assert pl._mutant_plane is not None
 
 
+def test_corpus_arena_zero_new_jits_and_zero_steady_h2d(device_rig):
+    """ISSUE 18 compile + transfer guards on the warm rig: the
+    steady-state hot path moves ZERO host corpus bytes per batch
+    (the arena upload counters stay flat across drains with nothing
+    staged), and every arena lifecycle event — growth via new corpus
+    adds, an epoch bump (invalidate → full authority re-stage), and
+    the breaker rebuild's device-state drop — reuses the warm step
+    executable: zero new jit compiles, one scatter each."""
+    from syzkaller_tpu import telemetry
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.rand import RandGen
+
+    target, pl = device_rig
+    # Settle: drain until nothing is pending in the arena (earlier
+    # tests in the module may have staged rows).
+    _drain_until(pl, lambda: len(pl.arena._pending) == 0)
+    assert len(pl.arena._pending) == 0
+
+    with telemetry.assert_no_new_compiles(pl._step._cache_size):
+        # -- the zero-steady-state-H2D pin ---------------------------
+        up0, bytes0 = pl.arena.uploads, pl.arena.upload_bytes
+        for _ in range(3):
+            assert pl.next_batch(timeout=300)
+        assert pl.arena.uploads == up0 \
+            and pl.arena.upload_bytes == bytes0, \
+            "steady-state batches moved corpus bytes H2D"
+
+        # -- growth: new adds ride one flush scatter -----------------
+        added = 0
+        for i in range(2):
+            p = generate_prog(target, RandGen(target, 8600 + i), 5)
+            if pl.add(p):
+                added += 1
+        assert added > 0
+        _drain_until(pl, lambda: pl.arena.uploads > up0)
+        assert pl.arena.uploads > up0
+        assert pl.arena.upload_bytes > bytes0
+
+        # -- epoch bump: full re-stage from host authority -----------
+        _drain_until(pl, lambda: len(pl.arena._pending) == 0)
+        epoch0, up1 = pl.arena.epoch, pl.arena.uploads
+        pl.arena.invalidate()
+        assert pl.arena.epoch == epoch0 + 1
+        _drain_until(pl, lambda: pl.arena.uploads > up1)
+        assert pl.arena.uploads > up1
+
+        # -- the breaker rebuild's device-state drop -----------------
+        # _reset_device_state is exactly what every half-open
+        # re-entry consumes; it must invalidate the arena (another
+        # epoch) and recover with a re-upload, never a re-trace.
+        _drain_until(pl, lambda: len(pl.arena._pending) == 0)
+        epoch1, up2 = pl.arena.epoch, pl.arena.uploads
+        pl._reset_device_state()
+        assert pl.arena.epoch == epoch1 + 1
+        _drain_until(pl, lambda: pl.arena.uploads > up2)
+        assert pl.arena.uploads > up2
+        assert pl.next_batch(timeout=300)
+
+        # Back to steady state: flat again.
+        _drain_until(pl, lambda: len(pl.arena._pending) == 0)
+        up3, bytes3 = pl.arena.uploads, pl.arena.upload_bytes
+        assert pl.next_batch(timeout=300)
+        assert pl.arena.uploads == up3 \
+            and pl.arena.upload_bytes == bytes3
+    assert pl.health_snapshot()["arena"]["epoch"] == pl.arena.epoch
+
+
 def test_sim_prescore_fault_demotes_to_passthrough_zero_loss(device_rig):
     """ISSUE 15: scripted `device.sim` failures demote the prescore
     stage to PASS-THROUGH — the faulted launches still deliver their

@@ -113,6 +113,61 @@ def test_mesh_engine_pads_batch_to_live_width():
                           np.arange(B, dtype=np.int32))
 
 
+def test_mesh_arena_reshard_conserves_rows():
+    """ISSUE 18 fault-domain seam: attach_arena re-stages the corpus
+    slabs from HOST authority (row-exact copy) and invalidates the
+    owning pipeline's slab so its next flush is the one-scatter epoch
+    rebuild; a topology rebuild repeats the re-stage with zero lost
+    rows.  Uses a real 1-device mesh — the conservation contract is
+    identical at any width, and the 8->7 odd-width replicate fallback
+    runs in the slow chaos drill below."""
+    import threading
+
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp  # noqa: F401
+
+    from syzkaller_tpu import telemetry
+    from syzkaller_tpu.ops.arena import CorpusArena
+    from syzkaller_tpu.parallel import mesh as pmesh
+    from syzkaller_tpu.parallel.fault_domain import MeshEngine
+
+    eng = object.__new__(MeshEngine)
+    eng._lock = threading.RLock()
+    eng._mesh = pmesh.make_mesh(jax.devices()[:1], 1)
+    eng._arena = None
+    eng._arena_dev = None
+    eng._hbm_arena = telemetry.HBM.register("mesh", "arena",
+                                            bound_to=eng)
+
+    arena = CorpusArena(8, slab_bits=3, headroom_bytes=1 << 30)
+    for i in range(5):
+        arena.stage(i, {"val": np.full(4, 10 * i, np.uint64),
+                        "len": np.int32(i)})
+    arena.flush(jnp)
+    assert arena.uploads == 1 and arena.n == 5
+    e0 = arena.epoch
+
+    eng.attach_arena(arena)
+    assert eng._arena_dev is not None
+    # the mesh-resident copy holds every occupied row byte-exact
+    for k, v in arena.host.items():
+        np.testing.assert_array_equal(
+            np.asarray(eng._arena_dev[k])[:5], v[:5])
+    # the owner's slab was invalidated: one epoch bump, full restage
+    # pending — the pipeline's next flush is the one-scatter rebuild
+    assert arena.epoch == e0 + 1
+    assert len(arena._pending) == 5
+    arena.flush(jnp)
+
+    # chip-loss rebuild path: _reshard_arena runs again on every
+    # _build; rows conserved, another single epoch bump
+    eng._reshard_arena()
+    for k, v in arena.host.items():
+        np.testing.assert_array_equal(
+            np.asarray(eng._arena_dev[k])[:5], v[:5])
+    assert arena.epoch == e0 + 2 and arena.n == 5
+
+
 def test_mesh_engine_cov_fit_shrinks_with_live_set():
     from syzkaller_tpu.parallel.fault_domain import MeshEngine
 
@@ -170,6 +225,26 @@ eng = MeshEngine(devices=jax.devices()[:8], cov=1, rounds=1,
 for d in eng.domains:
     d.breaker.configure_backoff(initial=0.05, cap=0.05)
 
+# -- corpus arena rides the fault domain (ISSUE 18): attach a small
+# arena; every topology rebuild must re-stage it from host authority
+import jax.numpy as jnp
+from syzkaller_tpu.ops.arena import CorpusArena
+arena = CorpusArena(8, slab_bits=3, headroom_bytes=1 << 30)
+for i in range(6):
+    arena.stage(i, {"val": np.full(4, 100 + i, np.uint64),
+                    "len": np.int32(i)})
+arena.flush(jnp)
+eng.attach_arena(arena)
+arena.flush(jnp)  # the owner's one-scatter epoch rebuild
+arena_epoch0 = arena.epoch
+
+def assert_arena_conserved(tag):
+    assert arena.n == 6, (tag, arena.n)
+    for k, v in arena.host.items():
+        got = np.asarray(eng._arena_dev[k])[:6]
+        assert np.array_equal(got, v[:6]), (tag, k)
+assert_arena_conserved("attach")
+
 # -- warm step: mirror must replay the device merge exactly
 e1 = mk()
 out1 = eng.step(batch, e1, nedges, prios)
@@ -190,6 +265,15 @@ snap = eng.health_snapshot()
 assert snap["devices_live"] == 7, snap
 assert snap["devices_demoted"] == 1
 assert snap["shards"][3]["demoted"], snap["shards"][3]
+
+# chip loss costs device residency, never corpus rows: 7 does not
+# divide the pow2 slab, so the rebuild replicated the slabs — every
+# row still resident and byte-exact, and the owning pipeline's slab
+# was invalidated for its own one-scatter re-upload
+assert_arena_conserved("demote")
+assert snap["arena_rows"] == 6 and snap["arena_sharded"], snap
+assert arena.epoch == arena_epoch0 + 1, arena.epoch
+arena.flush(jnp)
 
 # zero lost corpus: the staged batch re-dispatched to survivors —
 # every program got a verdict and every shard's novel prefix is whole
@@ -212,6 +296,13 @@ snap = eng.health_snapshot()
 assert snap["devices_live"] == 8, snap
 _, rc3 = dsig.diff_batch(np.asarray(ref), e3, nedges, prios)
 assert np.array_equal(out3["new_counts"], np.asarray(rc3))
+
+# re-promote re-shards the slabs back over the full pow2 width —
+# the whole demote -> serve-from-7 -> re-promote trajectory lost
+# zero corpus rows
+assert_arena_conserved("repromote")
+assert arena.epoch == arena_epoch0 + 2, arena.epoch
+arena.flush(jnp)
 
 # -- compile-count guard: N -> N-1 -> N built exactly the two
 # expected meshes.  One more step absorbs the loop-back signature

@@ -280,3 +280,57 @@ def test_arena_flags_repad_allocs_regression():
     assert _M_ARENA_ALLOCS.value == c0 + 2
     repad(16)  # exact pow2: no padding, no staging acquire at all
     assert a.allocations == 2 and _M_ARENA_ALLOCS.value == c0 + 2
+
+
+def test_corpus_arena_flush_reuses_staging_rotation():
+    """ISSUE 18 alongside the ISSUE 5 pin above: the corpus arena's
+    flush stages through the SAME pow2 ("corpus", bucket) keys and
+    slot rotation — arena growth inside a bucket is zero allocation
+    events, `tz_staging_arena_allocs_total` advances only when the
+    pending-row count crosses into a new pow2 bucket, and a full
+    invalidate re-stage (the breaker/re-shard path) rotates the
+    existing bucket rather than allocating.  Phase A only (numpy
+    stands in for jnp): the staging contract lives entirely in
+    `begin_flush`; the device scatter never touches the arena."""
+    from syzkaller_tpu.ops.arena import CorpusArena
+    from syzkaller_tpu.ops.staging import _M_ARENA_ALLOCS
+
+    a = StagingArena(slots=2)
+    arena = CorpusArena(64, staging=a, slab_bits=6,
+                        headroom_bytes=1 << 30)
+    c0 = _M_ARENA_ALLOCS.value
+
+    def row(i):
+        return {"val": np.full(6, i, np.uint64)}
+
+    def flush_phase_a():
+        token = arena.begin_flush(np)
+        assert token[0] == "staged"
+        pending, idx_list, bufs, _nbytes = token[2]
+        # Phase B's pending bookkeeping, minus the device scatter.
+        with arena._lock:
+            for i in idx_list:
+                if arena._pending.get(i) == pending[i]:
+                    del arena._pending[i]
+        return bufs
+
+    for i in range(5):
+        arena.stage(i, row(i))
+    bufs = flush_phase_a()  # 5 pending rows -> ("corpus", 8) bucket
+    assert a.allocations == 1
+    assert bufs["row:val"].shape[0] == 8
+    # Growth inside the bucket: 6 more rows, same pow2 count ->
+    # rotation only, the counter must stay flat.
+    for i in range(5, 11):
+        arena.stage(i, row(i))
+    flush_phase_a()
+    assert a.allocations == 1 and _M_ARENA_ALLOCS.value == c0 + 1
+    # Invalidate: all 11 occupied rows re-stage -> bucket 16, exactly
+    # one more allocation event.
+    arena.invalidate()
+    flush_phase_a()
+    assert a.allocations == 2 and _M_ARENA_ALLOCS.value == c0 + 2
+    # A second full re-stage rotates the bucket-16 slot: still flat.
+    arena.invalidate()
+    flush_phase_a()
+    assert a.allocations == 2 and _M_ARENA_ALLOCS.value == c0 + 2
