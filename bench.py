@@ -499,6 +499,93 @@ def bench_triage(calls_per_check=512, edges_per_call=64, checks=80,
     }
 
 
+def bench_hints(batch=4096, maps=64, keys_per_map=24,
+                vals_per_key=4, reps=5) -> dict:
+    """The batched hints lane vs the per-program host path (ISSUE 19).
+
+    Builds `maps` random comp maps (the fleet's staged TRACE_CMP
+    tables) and `batch` candidate comparison windows spread across
+    them, then expands the same workload two ways: the per-program
+    reference (`shrink_expand` per window against its own CompMap —
+    today's smash-phase hint pass, one map at a time) and the fused
+    stacked kernel (ops/hints.stacked_shrink_expand_kernel — every
+    map's tables stacked into one padded device batch, the shape the
+    HintLane flush leader dispatches).  `hints_speedup_x` is the
+    CPU-measured ratio at the production batch shape;
+    `hint_mutants_per_sec` the fused path's replacer throughput;
+    `hints_staged_comps_bytes_per_batch` the H2D bill for the stacked
+    tables + value/map_of columns; `hints_sim_suppressed_frac` the
+    fraction of replacers the lane's speculation fold would suppress
+    on this stream (ops/hintlane.fold_suppress over a cold plane —
+    the steady-state duplicate rate across call sites)."""
+    import numpy as np
+
+    from syzkaller_tpu.models.hints import CompMap, shrink_expand
+    from syzkaller_tpu.ops.delta import pow2_rows
+    from syzkaller_tpu.ops.hintlane import fold_suppress
+    from syzkaller_tpu.ops.hints import (DeviceCompMap,
+                                         shrink_expand_batch_stacked,
+                                         stack_comp_maps)
+
+    rng = np.random.RandomState(19)
+    cms, dmaps = [], []
+    for _ in range(maps):
+        cm = CompMap()
+        for _ in range(keys_per_map):
+            k = int(rng.randint(0, 1 << 62))
+            for _ in range(rng.randint(1, vals_per_key + 1)):
+                cm.add_comp(k, int(rng.randint(0, 1 << 62)))
+        cms.append(cm)
+        dmaps.append(DeviceCompMap.from_comp_map(cm))
+    vals, map_of = [], []
+    for j in range(batch):
+        mi = j % maps
+        keys = list(cms[mi].m.keys())
+        # Half the windows hit a staged key (the productive case);
+        # half are random misses (the common case).
+        v = int(keys[int(rng.randint(len(keys)))]) \
+            if rng.rand() < 0.5 else int(rng.randint(0, 1 << 62))
+        vals.append(v)
+        map_of.append(mi)
+
+    # Per-program reference: one CompMap walk per window.
+    t0 = time.perf_counter()
+    host_out = [sorted(shrink_expand(v, cms[mi]))
+                for v, mi in zip(vals, map_of)]
+    host_s = time.perf_counter() - t0
+
+    m = pow2_rows(maps, lo=4, hi=64)
+    k = pow2_rows(max(len(d) for d in dmaps), lo=16, hi=512)
+    tables = stack_comp_maps(dmaps, m, k)
+    varr = np.array(vals, dtype=np.uint64)
+    moar = np.array(map_of, dtype=np.int32)
+    dev_out = shrink_expand_batch_stacked(varr, moar, tables)  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        dev_out = shrink_expand_batch_stacked(varr, moar, tables)
+    dev_s = (time.perf_counter() - t0) / reps
+    assert dev_out == host_out, "fused hints diverged from host oracle"
+
+    mutants = sum(len(lst) for lst in dev_out)
+    staged = (sum(tables[f].nbytes for f in
+                  ("keys", "nkeys", "vmat", "nvals"))
+              + varr.nbytes + moar.nbytes)
+    plane = np.zeros(1 << 16, dtype=np.uint8)
+    _, suppressed = fold_suppress(dev_out, plane, salt=0)
+    return {
+        "hint_mutants_per_sec": round(mutants / dev_s, 1),
+        "hints_host_mutants_per_sec": round(mutants / host_s, 1),
+        "hints_speedup_x": round(host_s / dev_s, 2),
+        "hints_batch": batch,
+        "hints_maps": maps,
+        "hints_mutants": mutants,
+        "hints_staged_comps_bytes_per_batch": staged,
+        "hints_sim_suppressed_frac": round(
+            suppressed / max(1, mutants), 4),
+        "hints_device_ms_per_batch": round(dev_s * 1e3, 3),
+    }
+
+
 def bench_coverage(seen_edges=1 << 18, reps=20, novel_checks=40,
                    edges_per_call=64) -> dict:
     """Coverage-intelligence analytics at the full plane shape
@@ -1836,6 +1923,18 @@ def main() -> None:
                **bench_triage()}
         res["value"] = res["triage_calls_per_sec"]
         res["vs_baseline"] = res.get("triage_speedup_x")
+        if platform:
+            res["platform"] = platform
+        journal_append(res)
+        print(json.dumps(res))
+        return
+    if "--hints" in argv:
+        batch = int(argv[argv.index("--batch") + 1]) \
+            if "--batch" in argv else 4096
+        res = {"metric": "hint_mutants_per_sec", "unit": "mutants/sec",
+               **bench_hints(batch=batch)}
+        res["value"] = res["hint_mutants_per_sec"]
+        res["vs_baseline"] = res.get("hints_speedup_x")
         if platform:
             res["platform"] = platform
         journal_append(res)

@@ -90,6 +90,7 @@ class FuzzerProcess:
             self._enqueue_candidate(cand)
 
         self.mutator = None
+        self.hint_lane = None
         if engine == "jax":
             # TZ_JAX_PLATFORM lets a supervisor (e.g. the demo) pin
             # fuzzer subprocesses to a working backend instead of a
@@ -121,13 +122,25 @@ class FuzzerProcess:
 
                 self.fuzzer.set_triage(
                     TriageEngine.for_pipeline(self.mutator.pipeline))
+            # Fleet-wide batched hints lane (ops/hintlane): all procs
+            # stage comparison windows into one fused device batch
+            # under the flush-leader discipline; shares the pipeline's
+            # breaker so a sick device demotes hints with it.
+            # TZ_HINTS_LANE=0 falls back to the per-program device
+            # path (mutate_with_hints_device).
+            if env_int("TZ_HINTS_LANE", 1):
+                from syzkaller_tpu.ops.hintlane import HintLane
+
+                self.hint_lane = HintLane.for_pipeline(
+                    self.mutator.pipeline)
 
         self.procs = []
         for pid in range(procs):
             env = make_env(pid, sim=sim)
             self.procs.append(Proc(self.fuzzer, pid, env,
                                    mutator=self.mutator,
-                                   device_hints=engine == "jax"))
+                                   device_hints=engine == "jax",
+                                   hint_lane=self.hint_lane))
 
     # -- manager session ---------------------------------------------------
 

@@ -64,7 +64,7 @@ _LANE_BY_STAT = {
     Stat.TRIAGE: "triage",
     Stat.MINIMIZE: "triage",
     Stat.SMASH: "smash",
-    Stat.HINT: "smash",
+    Stat.HINT: "hints",
     Stat.SEED: "smash",
 }
 
@@ -331,7 +331,8 @@ class Proc:
     def __init__(self, fuzzer: Fuzzer, pid: int, env: Env,
                  rng: Optional[RandGen] = None,
                  mutator: Optional[PipelineMutator] = None,
-                 device_hints: bool = False):
+                 device_hints: bool = False,
+                 hint_lane=None):
         self.fuzzer = fuzzer
         self.pid = pid
         self.env = env
@@ -340,6 +341,10 @@ class Proc:
         # Smash's hint pass runs the batched shrinkExpand kernel
         # (ops/hints.py) instead of the per-window CPU walk.
         self.device_hints = device_hints
+        # The shared fleet-wide lane (ops/hintlane.HintLane) wins over
+        # the per-program device path: comps staged cross-proc, one
+        # fused kernel per flush, lane="hints" accounting.
+        self.hint_lane = hint_lane
         self.exec_opts = ExecOpts(flags=ExecFlags(0))
         self.exec_opts_cover = ExecOpts(flags=ExecFlags.COLLECT_COVER
                                         | ExecFlags.DEDUP_COVER)
@@ -507,7 +512,9 @@ class Proc:
         def exec_cb(mutant: Prog) -> None:
             self.execute(self.exec_opts, mutant, Stat.HINT)
 
-        if self.device_hints:
+        if self.hint_lane is not None:
+            self.hint_lane.run(p, call_index, comps, exec_cb)
+        elif self.device_hints:
             from syzkaller_tpu.ops.hints import mutate_with_hints_device
 
             mutate_with_hints_device(p, call_index, comps, exec_cb)

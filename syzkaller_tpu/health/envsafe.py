@@ -57,6 +57,10 @@ KNOWN_TZ_VARS: set[str] = {
     "TZ_HBM_CAPACITY_BYTES",
     "TZ_HBM_DRIFT_TOLERANCE_BYTES",
     "TZ_HBM_RECONCILE",
+    "TZ_HINTS_BATCH",
+    "TZ_HINTS_KMAX",
+    "TZ_HINTS_LANE",
+    "TZ_HINTS_VMAX",
     "TZ_HUB_DIGEST_BITS",
     "TZ_HUB_LEASE_S",
     "TZ_JAX_PLATFORM",

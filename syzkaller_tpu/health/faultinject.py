@@ -45,6 +45,7 @@ SEAMS = (
     "device.triage",
     "device.sim",
     "device.arena",
+    "device.hints",
     "staging.h2d",
     "rpc.send_frame",
     "rpc.recv_frame",

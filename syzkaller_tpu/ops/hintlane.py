@@ -1,0 +1,488 @@
+"""HintLane: batched device hints as a first-class pipeline lane
+(ISSUE 19 tentpole).
+
+The per-program device hints path (ops/hints.mutate_with_hints_device)
+runs one kernel per program: make_shrink_expand closes over that
+program's comp-map arrays, so every smash-phase hint pass pays its own
+host round-trip AND its own jit compile — invisible to the composer,
+the accounting ledger, and the coverage lane attribution.  This engine
+promotes comparison-operand hints to the same shape every other hot
+path in this repo already has:
+
+  - procs collect executor TRACE_CMP maps fleet-wide and stage them
+    cross-proc; whoever reaches the device lock first becomes the
+    flush leader and expands EVERYTHING staged (its own windows and
+    every other proc's) as ONE stacked device batch — the triage
+    engine's leader/follower discipline applied to mutation,
+  - comp-map tables are stacked into padded pow2 device arrays
+    (keys[M,K] / vmat[M,K,V], ops/hints.stack_comp_maps) written IN
+    PLACE into persistent StagingArena slots; candidate values carry a
+    map_of column so one module-level jitted kernel
+    (stacked_shrink_expand_kernel) serves every flush — pow2 buckets
+    in all dims keep the compiled-shape set bounded, and nothing ever
+    re-jits in steady state (the warm-rig compile guard pins this),
+  - the kernel elapsed books to the accounting ledger as
+    `tz_acct_device_ms_total{lane="hints"}` and hint-mutant novelty
+    attributes to `tz_coverage_novel_edges_total{lane="hints"}`
+    (fuzzer/proc.py _LANE_BY_STAT), so the PR 11 composer can price
+    and schedule the lane like any tenant (compose_drain below),
+  - with the pipeline's sim prescore attached, replacer rows are
+    pre-filtered through a speculation fold of (call site, comparand)
+    — the magic-comparand edge model the PR 14 sim kernel carries,
+    evaluated at lane granularity: a fold already probed this epoch
+    is suppressed (counted, re-admitted when the sim plane decays),
+  - breaker/watchdog semantics mirror triage: device calls run under
+    the `device.hints` fault seam, any failure demotes the lane to
+    the exact per-program CPU path (models.hints.shrink_expand per
+    window) — degraded throughput, ZERO lost comparison traces — and
+    the next device success re-promotes.
+
+Bit-exactness contract: with no sim attached, the replacer set per
+window equals the per-program host path (mutate_with_hints) exactly —
+tests/test_hints_device.py drives both over randomized comp maps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health import (
+    CircuitBreaker,
+    Watchdog,
+    env_int,
+    fault_point,
+)
+from syzkaller_tpu.health.breaker import CLOSED
+from syzkaller_tpu.models.hints import CompMap, shrink_expand
+from syzkaller_tpu.models.prog import Prog
+from syzkaller_tpu.ops.delta import pow2_rows
+from syzkaller_tpu.ops.hints import (
+    DeviceCompMap,
+    apply_hint_mutants,
+    collect_hint_jobs,
+    resolve_hints_vmax,
+    shrink_expand_batch_stacked,
+    stack_comp_maps,
+    stacked_shrink_expand_kernel,
+)
+from syzkaller_tpu.ops.staging import StagingArena
+from syzkaller_tpu.utils import log
+
+# Hint-lane telemetry (docs/observability.md "The hints lane").
+_M_BATCHES = telemetry.counter(
+    "tz_hints_batches_total", "fused hint batches flushed to the device")
+_M_VALUES = telemetry.counter(
+    "tz_hints_values_total",
+    "candidate comparison windows expanded through the lane")
+_M_MUTANTS = telemetry.counter(
+    "tz_hints_mutants_total", "hint mutants produced by the lane")
+_M_STAGED_BYTES = telemetry.counter(
+    "tz_hints_staged_bytes_total",
+    "comp-map table + value bytes staged H2D by hint flushes")
+_M_SUPPRESSED = telemetry.counter(
+    "tz_hints_sim_suppressed_total",
+    "hint replacers suppressed by the sim speculation fold "
+    "(re-admitted when the sim plane decays)")
+_M_CPU_VALUES = telemetry.counter(
+    "tz_hints_cpu_fallback_values_total",
+    "windows expanded on the exact CPU path while demoted "
+    "(zero lost comparison traces)")
+_M_ERRORS = telemetry.counter(
+    "tz_hints_device_errors_total",
+    "device failures on the hint kernel (chunk expanded on CPU)")
+_M_DEMOTIONS = telemetry.counter(
+    "tz_hints_demotions_total", "device->CPU hint-lane demotions")
+_M_REPROMOTIONS = telemetry.counter(
+    "tz_hints_repromotions_total", "CPU->device hint-lane re-promotions")
+_M_BATCH_VALUES = telemetry.gauge(
+    "tz_hints_batch_values",
+    "candidate windows in the most recent fused hint batch")
+
+#: Fibonacci-hash multiplier for the speculation fold.
+_GOLDEN = 0x9E3779B97F4A7C15
+_FOLD_BITS = 16
+
+
+def fold_suppress(replacer_lists: list[list[int]], plane: np.ndarray,
+                  salt: int) -> tuple[list[list[int]], int]:
+    """The lane's speculative prescore: fold each (call-site salt,
+    replacer) pair into the plane; a fold already probed this epoch is
+    suppressed.  Returns (kept lists, suppressed count).  Pure
+    function — bench.py --hints measures its fraction standalone."""
+    mask = (1 << _FOLD_BITS) - 1
+    kept: list[list[int]] = []
+    suppressed = 0
+    for lst in replacer_lists:
+        keep = []
+        for r in lst:
+            idx = (((r ^ (r >> 31)) * _GOLDEN + salt)
+                   >> (64 - _FOLD_BITS)) & mask
+            if plane[idx]:
+                suppressed += 1
+            else:
+                plane[idx] = 1
+                keep.append(r)
+        kept.append(keep)
+    return kept, suppressed
+
+
+@dataclass
+class HintLaneStats:
+    values: int = 0  # candidate windows entering run()
+    device_batches: int = 0  # fused flushes that resolved on device
+    mutants: int = 0  # hint mutants handed to exec_cb
+    suppressed: int = 0  # replacers held back by the sim fold
+    cpu_fallback_values: int = 0  # windows expanded on CPU (demoted)
+    device_errors: int = 0  # failures on the hint kernel
+    demotions: int = 0  # device->CPU transitions
+    repromotions: int = 0  # CPU->device transitions
+    staged_bytes: int = 0  # cumulative H2D table+value bytes
+
+
+class _Entry:
+    """One proc's staged hint expansion: its candidate values, its
+    lowered comp map, and a completion event the flush leader sets
+    once replacers (or the failure verdict) are in."""
+
+    __slots__ = ("vals", "dmap", "replacers", "failed", "done")
+
+    def __init__(self, vals: np.ndarray, dmap: DeviceCompMap):
+        self.vals = vals
+        self.dmap = dmap
+        self.replacers: Optional[list[list[int]]] = None
+        self.failed = False
+        self.done = threading.Event()
+
+
+class HintLane:
+    """Shared by every proc of one fuzzer process; see module doc.
+
+    Knobs (health.envsafe; docs/health.md): TZ_HINTS_BATCH (candidate
+    windows per fused device batch), TZ_HINTS_KMAX (per-map key
+    budget; keys past it take the exact CPU supplement, counted in
+    tz_hints_comps_dropped_total), TZ_HINTS_VMAX (per-key operand
+    budget, resolved in ops/hints)."""
+
+    #: Stacked maps per flush; with B/MAPS ≈ 64 windows per map a
+    #: full batch still fits typical smash-phase call shapes.
+    MAPS = 64
+
+    def __init__(self, batch: int = 4096, kmax: int = 512,
+                 vmax: Optional[int] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 watchdog: Optional[Watchdog] = None,
+                 owns_breaker: Optional[bool] = None):
+        self.B = max(64, env_int("TZ_HINTS_BATCH", batch))
+        self.kmax = max(16, env_int("TZ_HINTS_KMAX", kmax))
+        self.vmax = resolve_hints_vmax() if vmax is None else vmax
+        self._arena = StagingArena(slots=2)
+        self.owns_breaker = (breaker is None) if owns_breaker is None \
+            else owns_breaker
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            failure_threshold=max(1, env_int("TZ_BREAKER_THRESHOLD", 4)))
+        self.watchdog = watchdog if watchdog is not None else Watchdog()
+        self.stats = HintLaneStats()
+        self._staged: list[_Entry] = []
+        self._stage_lock = threading.Lock()
+        self._device_lock = threading.Lock()  # flush-leader mutex
+        self._compiled = False
+        self._demoted = False
+        # Speculative prescore (sim/prescore.SimPrescore): the fold
+        # plane decays with the sim's re-admission epochs, so a
+        # suppressed comparand becomes probeable again exactly when
+        # the pipeline's speculation plane forgets it.
+        self._sim = None
+        self._sim_epoch = -1
+        self._plane = np.zeros(1 << _FOLD_BITS, dtype=np.uint8)
+        # Composer supply (serve/composer.attach_lane): staged
+        # (prog, call, comps) sources and the mutant outbox
+        # compose_drain fills batches from.
+        self._sources: deque = deque()
+        self._outbox: deque = deque()
+
+    @classmethod
+    def for_pipeline(cls, pipeline, **kw) -> "HintLane":
+        """Co-resident form: one health verdict for the device —
+        shares the DevicePipeline's breaker and watchdog, and rides
+        its sim prescore's epoch clock for suppression decay."""
+        lane = cls(breaker=pipeline.breaker, watchdog=pipeline.watchdog,
+                   owns_breaker=False, **kw)
+        pipeline.attach_hints(lane)
+        return lane
+
+    def attach_sim(self, sim) -> None:
+        """Enable the speculative prescore over hint replacers; `sim`
+        is the pipeline's SimPrescore (epoch clock + demotion state)."""
+        self._sim = sim
+
+    # -- the expand path ---------------------------------------------------
+
+    def run(self, p: Prog, call_index: int, comps: CompMap,
+            exec_cb: Callable[[Prog], None]) -> int:
+        """Expand one call's comparison traces into executed hint
+        mutants.  Drop-in for mutate_with_hints_device: same mutant
+        sequence (modulo sim suppression), but the device batch is
+        shared fleet-wide through the flush leader.  Returns the
+        number of mutants executed."""
+        pclone, jobs, vals = collect_hint_jobs(p, call_index)
+        if not jobs:
+            return 0
+        self.stats.values += len(vals)
+        _M_VALUES.inc(len(vals))
+        varr = np.array(vals, dtype=np.uint64)
+        if not self._gate():
+            self._note_demoted(f"circuit breaker {self.breaker.state}")
+            replacers = self._cpu_replacers(vals, comps)
+        else:
+            dmap = DeviceCompMap.from_comp_map(
+                comps, vmax=self.vmax, kmax=self.kmax)
+            entry = _Entry(varr, dmap)
+            self._flush(entry)
+            if entry.failed:
+                # Zero lost traces: the staged windows expand on the
+                # exact CPU path instead.
+                replacers = self._cpu_replacers(vals, comps)
+            else:
+                replacers = entry.replacers
+                if dmap.overflow is not None:
+                    replacers = [
+                        sorted(set(lst) | shrink_expand(v, dmap.overflow))
+                        for lst, v in zip(replacers, vals)]
+        replacers = self._prescore(p, call_index, replacers)
+        n = apply_hint_mutants(pclone, jobs, replacers, exec_cb)
+        self.stats.mutants += n
+        if n:
+            _M_MUTANTS.inc(n)
+        return n
+
+    def _cpu_replacers(self, vals: list[int],
+                       comps: CompMap) -> list[list[int]]:
+        """The demoted path: today's exact per-window CPU walk."""
+        self.stats.cpu_fallback_values += len(vals)
+        _M_CPU_VALUES.inc(len(vals))
+        return [sorted(shrink_expand(v, comps)) for v in vals]
+
+    def _prescore(self, p: Prog, call_index: int,
+                  replacers: list[list[int]]) -> list[list[int]]:
+        if self._sim is None or self._sim.demoted():
+            return replacers
+        epochs = getattr(self._sim, "epochs", 0)
+        if epochs != self._sim_epoch:
+            self._plane[:] = 0  # sim plane decayed: re-admit all
+            self._sim_epoch = epochs
+        salt = zlib.crc32(p.calls[call_index].meta.name.encode())
+        kept, suppressed = fold_suppress(replacers, self._plane, salt)
+        if suppressed:
+            self.stats.suppressed += suppressed
+            _M_SUPPRESSED.inc(suppressed)
+        return kept
+
+    def _gate(self) -> bool:
+        if self.owns_breaker:
+            return self.breaker.allow()
+        return self.breaker.state == CLOSED
+
+    # -- staging + flush ---------------------------------------------------
+
+    def _flush(self, entry: _Entry) -> None:
+        """Stage this expansion and drive flushes until it resolves:
+        the flush leader expands every staged proc's windows in one
+        stacked batch; losers wait on their entry."""
+        with self._stage_lock:
+            self._staged.append(entry)
+        while not entry.done.is_set():
+            if self._device_lock.acquire(timeout=0.01):
+                try:
+                    self._drain_staged()
+                finally:
+                    self._device_lock.release()
+            else:
+                entry.done.wait(timeout=0.02)
+
+    def _drain_staged(self) -> None:
+        """Expand staged chunks until the stage is empty (holds
+        _device_lock).  A chunk packs up to MAPS maps; its
+        concatenated values run in B-sized slices against the same
+        staged tables."""
+        while True:
+            chunk: list[_Entry] = []
+            with self._stage_lock:
+                total = 0
+                while self._staged and len(chunk) < self.MAPS:
+                    e = self._staged[0]
+                    if chunk and total + len(e.vals) > self.B:
+                        break
+                    chunk.append(self._staged.pop(0))
+                    total += len(e.vals)
+            if not chunk:
+                return
+            self._dispatch_chunk(chunk)
+
+    def _dispatch_chunk(self, chunk: list[_Entry]) -> None:
+        """One fused flush: stack the chunk's comp maps into arena
+        slots, expand the concatenated value vector on device, slice
+        replacer lists back per entry.  Any failure marks the whole
+        chunk for the exact CPU path — degraded throughput, zero lost
+        comparison traces — and feeds the breaker."""
+        try:
+            fault_point("device.hints")
+            m = pow2_rows(len(chunk), lo=4, hi=self.MAPS)
+            k = pow2_rows(max(max((len(e.dmap) for e in chunk),
+                                  default=1), 1),
+                          lo=16, hi=self.kmax)
+            vals = np.concatenate([e.vals for e in chunk])
+            map_of = np.concatenate([
+                np.full(len(e.vals), i, dtype=np.int32)
+                for i, e in enumerate(chunk)])
+            total = len(vals)
+            b = pow2_rows(min(total, self.B), lo=64, hi=self.B)
+            bufs = self._arena.acquire((b, m, k), {
+                "vals": ((b,), np.uint64),
+                "map_of": ((b,), np.int32),
+                "keys": ((m, k), np.uint64),
+                "nkeys": ((m,), np.int32),
+                "vmat": ((m, k, self.vmax), np.uint64),
+                "nvals": ((m, k), np.int32),
+            })
+            stack_comp_maps([e.dmap for e in chunk], m, k, out=bufs)
+            table_bytes = (bufs["keys"].nbytes + bufs["nkeys"].nbytes
+                           + bufs["vmat"].nbytes + bufs["nvals"].nbytes)
+            self._note_staged(table_bytes)
+            out: list[list[int]] = []
+            for start in range(0, total, b):
+                n = min(b, total - start)
+                bufs["vals"][:n] = vals[start:start + n]
+                bufs["vals"][n:] = 0
+                bufs["map_of"][:n] = map_of[start:start + n]
+                bufs["map_of"][n:] = 0
+                self._note_staged(bufs["vals"].nbytes
+                                  + bufs["map_of"].nbytes)
+                with telemetry.span("hints.device"):
+                    t0 = time.perf_counter()
+                    lists = self.watchdog.call(
+                        lambda: shrink_expand_batch_stacked(
+                            bufs["vals"], bufs["map_of"], bufs),
+                        "device.hints", compile=not self._compiled)
+                    elapsed = time.perf_counter() - t0
+                self._compiled = True
+                # Accounting ledger (ISSUE 14): the hint kernel's
+                # residency, booked to the lane so the DeviceTimeLedger
+                # and yield pricing can see what hints cost.
+                telemetry.ACCOUNTING.note_batch(
+                    elapsed, lane_rows={"hints": n})
+                telemetry.PROFILER.note("hints", elapsed)
+                out.extend(lists[:n])
+                self.stats.device_batches += 1
+                _M_BATCHES.inc()
+                _M_BATCH_VALUES.set(n)
+        except Exception as e:
+            self.stats.device_errors += 1
+            _M_ERRORS.inc()
+            self.breaker.record_failure()
+            log.logf(0, "hint lane device error (breaker %s): %s",
+                     self.breaker.state, str(e)[:200])
+            for en in chunk:
+                en.failed = True
+                en.done.set()
+            return
+        if self.owns_breaker:
+            self.breaker.record_success()
+        self._note_promoted()
+        off = 0
+        for en in chunk:
+            en.replacers = out[off:off + len(en.vals)]
+            off += len(en.vals)
+            en.done.set()
+
+    def _note_staged(self, nbytes: int) -> None:
+        self.stats.staged_bytes += nbytes
+        _M_STAGED_BYTES.inc(nbytes)
+
+    # -- composer supply (serve/composer.attach_lane) ----------------------
+
+    def stage_source(self, p: Prog, call_index: int,
+                     comps: CompMap) -> None:
+        """Queue one (prog, call, comp-map) source for composer-driven
+        expansion; compose_drain materializes its mutants on demand."""
+        self._sources.append((p, call_index, comps))
+
+    def pending_rows(self) -> int:
+        """Outstanding supply (the lane tenant's backlog hint): queued
+        mutants plus a conservative one-mutant floor per staged
+        source."""
+        return len(self._outbox) + len(self._sources)
+
+    def compose_drain(self, n_rows: int, row_bytes: int = 64):
+        """`drain_fn` form for BatchComposer.attach_lane: expand
+        staged sources through the fused batch until n_rows exec-ready
+        hint payloads (serialize_for_exec bytes) are available; excess
+        mutants stay in the outbox for the next compose.  Returns
+        (rows, payloads) — rows are the payload prefixes as the
+        novelty-verdict input, zero-padded when supply runs short."""
+        from syzkaller_tpu.models.encodingexec import serialize_for_exec
+
+        while len(self._outbox) < n_rows and self._sources:
+            p, ci, comps = self._sources.popleft()
+            self.run(p, ci, comps,
+                     lambda mp: self._outbox.append(
+                         serialize_for_exec(mp)))
+        take = min(n_rows, len(self._outbox))
+        payloads = [self._outbox.popleft() for _ in range(take)]
+        payloads += [b""] * (n_rows - take)
+        rows = np.zeros((n_rows, row_bytes), dtype=np.uint8)
+        for i, pay in enumerate(payloads):
+            pre = np.frombuffer(pay[:row_bytes], dtype=np.uint8)
+            rows[i, :len(pre)] = pre
+        return rows, payloads
+
+    # -- health ------------------------------------------------------------
+
+    def _note_demoted(self, reason: str) -> None:
+        if self._demoted:
+            return
+        self._demoted = True
+        self.stats.demotions += 1
+        _M_DEMOTIONS.inc()
+        telemetry.record_event("hints.demote", reason)
+        log.logf(0, "HINT LANE DEMOTED to per-program CPU path: %s",
+                 reason)
+
+    def _note_promoted(self) -> None:
+        if not self._demoted:
+            return
+        self._demoted = False
+        self.stats.repromotions += 1
+        _M_REPROMOTIONS.inc()
+        telemetry.record_event("hints.repromote", "device answering")
+        log.logf(0, "hint lane re-promoted to the device batch")
+
+    def demoted(self) -> bool:
+        return self._demoted
+
+    def snapshot(self) -> dict:
+        """Lane state for health_snapshot surfaces and tests."""
+        s = self.stats
+        return {
+            "demoted": self._demoted,
+            "values": s.values,
+            "device_batches": s.device_batches,
+            "mutants": s.mutants,
+            "suppressed": s.suppressed,
+            "cpu_fallback_values": s.cpu_fallback_values,
+            "device_errors": s.device_errors,
+            "demotions": s.demotions,
+            "repromotions": s.repromotions,
+            "staged_bytes": s.staged_bytes,
+            "batch_values": self.B,
+            "kmax": self.kmax,
+            "vmax": self.vmax,
+            "staging_arena_bytes": self._arena.nbytes,
+        }

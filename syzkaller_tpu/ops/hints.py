@@ -21,6 +21,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from syzkaller_tpu import telemetry
+from syzkaller_tpu.health.envsafe import env_int
 from syzkaller_tpu.models.hints import MAX_DATA_LENGTH, CompMap
 from syzkaller_tpu.models.rand import SPECIAL_INTS_SET
 from syzkaller_tpu.models.prog import Arg, ConstArg, DataArg, Prog, foreach_arg
@@ -39,10 +41,33 @@ VARIANTS: tuple[tuple[int, bool, bool], ...] = tuple(
 
 _SPECIAL_SORTED = np.array(sorted(SPECIAL_INTS_SET), dtype=np.uint64)
 
+#: Sorted-key padding: searchsorted stays sound over a padded row
+#: because the pad compares >= every real key, and any hit in the pad
+#: region is rejected by the `i < nkeys` validity guard.
+UINT64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+
 
 # Observability: how often real TRACE_CMP data overflows the per-key
 # operand budget (drives the vmax choice; VERDICT r3 item #9).
 FALLBACK_STATS = {"maps": 0, "keys": 0, "overflow_keys": 0}
+
+#: Comparands routed OFF the device arrays by the vmax/kmax budgets
+#: (ISSUE 19 satellite: the old silent-truncation surface, now
+#: counted).  These operands are not lost — they take the exact CPU
+#: shrink_expand supplement — but every increment is device batching
+#: the budget refused, so a climbing rate says "raise TZ_HINTS_VMAX".
+_M_COMPS_DROPPED = telemetry.counter(
+    "tz_hints_comps_dropped_total",
+    "comparison operands over the vmax/kmax device budget, routed to "
+    "the exact CPU supplement instead of the batched kernel")
+
+
+def resolve_hints_vmax() -> int:
+    """TZ_HINTS_VMAX with the repo's clamp discipline: the per-key
+    operand budget of the device comp-map tables (docs/health.md).
+    Bounded to [1, 1024] so a typo cannot allocate a table whose vmax
+    dimension dwarfs the comparison data it carries."""
+    return min(1024, max(1, env_int("TZ_HINTS_VMAX", 16)))
 
 
 class DeviceCompMap:
@@ -67,13 +92,25 @@ class DeviceCompMap:
         self.overflow = overflow  # None = no overflowing keys
 
     @classmethod
-    def from_comp_map(cls, cm: CompMap, vmax: int = 16) -> "DeviceCompMap":
+    def from_comp_map(cls, cm: CompMap, vmax: Optional[int] = None,
+                      kmax: Optional[int] = None) -> "DeviceCompMap":
+        """Lower a CompMap to device arrays.  `vmax` defaults to the
+        TZ_HINTS_VMAX knob (resolve_hints_vmax); `kmax`, when given,
+        additionally routes keys past the per-map key budget into the
+        overflow CompMap (the stacked lane tables have a fixed K
+        dimension).  Every operand either side of the budget split is
+        counted — off-device routing increments
+        tz_hints_comps_dropped_total — and none is lost: overflow
+        keys take the exact CPU shrink_expand supplement."""
+        if vmax is None:
+            vmax = resolve_hints_vmax()
         all_keys = sorted(cm.m.keys())
         dev_keys = []
         overflow: Optional[CompMap] = None
         overflow_operands = 0
         for k in all_keys:
-            if len(cm.m[k]) > vmax:
+            if len(cm.m[k]) > vmax or \
+                    (kmax is not None and len(dev_keys) >= kmax):
                 if overflow is None:
                     overflow = CompMap()
                 overflow.m[k] = set(cm.m[k])
@@ -84,6 +121,8 @@ class DeviceCompMap:
         FALLBACK_STATS["keys"] += len(all_keys)
         FALLBACK_STATS["overflow_keys"] += \
             0 if overflow is None else len(overflow.m)
+        if overflow_operands:
+            _M_COMPS_DROPPED.inc(overflow_operands)
         keys = np.array(dev_keys, dtype=np.uint64)
         n = len(keys)
         vals = np.zeros((max(n, 1), vmax), dtype=np.uint64)
@@ -188,25 +227,143 @@ def shrink_expand_batch(vals: np.ndarray,
     return out
 
 
-def mutate_with_hints_device(p: Prog, call_index: int, comps: CompMap,
-                             exec_cb: Callable[[Prog], None],
-                             vmax: int = 16) -> None:
-    """Device-batched equivalent of models.hints.mutate_with_hints:
-    collect every candidate window of the call into one value vector,
-    run shrink_expand as one vmap'd kernel, then apply replacements in
-    the CPU path's exact order (reference: prog/hints.go:66-132).
+# -- stacked multi-map tables (ISSUE 19: the fused hint lane) -----------
 
-    Per-key exactness: keys whose operand sets overflow the device
-    budget are supplemented by the CPU shrink_expand for those keys
-    only — the rest of the map stays on device, and the merged
-    replacer set equals the full CPU result exactly."""
-    dmap = DeviceCompMap.from_comp_map(comps, vmax=vmax)
+def stack_comp_maps(dmaps: list[DeviceCompMap], m_rows: int,
+                    k_cols: int, out: Optional[dict] = None) -> dict:
+    """Stack several programs' DeviceCompMaps into one padded device
+    table set: keys[M, K] (pad UINT64_MAX so per-row searchsorted
+    order survives), nkeys[M], vmat[M, K, V], nvals[M, K].  `out`
+    buffers (StagingArena slots) are written in place; only the rows
+    actually used are touched beyond the key-row pad — the kernel's
+    nkeys/nvals validity guards mask everything else, so stale arena
+    bytes in unused map rows are harmless."""
+    if not dmaps:
+        raise ValueError("stack_comp_maps needs at least one map")
+    vmax = dmaps[0].vals.shape[1]
+    if out is None:
+        out = {
+            "keys": np.empty((m_rows, k_cols), dtype=np.uint64),
+            "nkeys": np.zeros(m_rows, dtype=np.int32),
+            "vmat": np.zeros((m_rows, k_cols, vmax), dtype=np.uint64),
+            "nvals": np.zeros((m_rows, k_cols), dtype=np.int32),
+        }
+    nkeys = out["nkeys"]
+    for i, d in enumerate(dmaps):
+        if d.vals.shape[1] != vmax:
+            raise ValueError("stacked maps must share vmax")
+        nk = len(d)
+        out["keys"][i, :nk] = d.keys
+        out["keys"][i, nk:] = UINT64_MAX  # keep the row sorted
+        nkeys[i] = nk
+        out["vmat"][i, :nk] = d.vals[:nk]
+        out["nvals"][i, :nk] = d.nvals[:nk]
+    nkeys[len(dmaps):] = 0  # unused rows: every lookup misses
+    return out
 
+
+_STACKED_KERNEL = None
+
+
+def stacked_shrink_expand_kernel():
+    """The fused hint kernel, built ONCE per process (module-level
+    jit: distinct (B, M, K, V) pow2 buckets each compile exactly one
+    executable, and same-bucket flushes re-hit the cache — unlike
+    make_shrink_expand, which closes over one map's arrays and
+    recompiles per map):
+
+        (vals[B], map_of[B], keys[M,K], nkeys[M],
+         vmat[M,K,V], nvals[M,K]) -> (reps[B,NV,V], oks[B,NV,V])
+
+    Row b expands value vals[b] against map map_of[b]'s tables —
+    thousands of (prog, call, comparand) sites in one device batch."""
+    global _STACKED_KERNEL
+    if _STACKED_KERNEL is None:
+        import jax
+        import jax.numpy as jnp
+
+        U64 = jnp.uint64
+        MASK64 = U64(0xFFFFFFFFFFFFFFFF)
+        special = jnp.asarray(_SPECIAL_SORTED)
+
+        def is_special(x):
+            i = jnp.searchsorted(special, x)
+            i = jnp.minimum(i, len(_SPECIAL_SORTED) - 1)
+            return special[i] == x
+
+        def one(v, m, keys, nkeys, vmat, nvals):
+            M, K = keys.shape
+            V = vmat.shape[2]
+            m = jnp.clip(m, 0, M - 1)  # padded rows point at map 0
+            krow = keys[m]
+            nk = nkeys[m]
+            reps = []
+            oks = []
+            for width, sext, be in VARIANTS:
+                size = width * 8
+                mask = U64((1 << size) - 1) if size < 64 else MASK64
+                inv = (~mask) & MASK64
+                if sext:
+                    mutant = (v | inv) & MASK64
+                else:
+                    mutant = v & mask
+                if be:
+                    mutant = _swap_const(mutant, width)
+                i = jnp.minimum(jnp.searchsorted(krow, mutant), K - 1)
+                found = (krow[i] == mutant) & (i < nk)
+                row = vmat[m, i]
+                row_ok = (jnp.arange(V) < nvals[m, i]) & found
+                new_hi = row & inv
+                ok_hi = (new_hi == U64(0)) | (new_hi == inv)
+                nv = row & mask
+                if be:
+                    nv = jax.vmap(lambda x: _swap_const(x, width))(nv)
+                ok = row_ok & ok_hi & ~jax.vmap(is_special)(nv)
+                reps.append(((v & inv) | nv) & MASK64)
+                oks.append(ok)
+            return jnp.stack(reps), jnp.stack(oks)
+
+        _STACKED_KERNEL = jax.jit(
+            jax.vmap(one, in_axes=(0, 0, None, None, None, None)))
+    return _STACKED_KERNEL
+
+
+def shrink_expand_batch_stacked(vals: np.ndarray, map_of: np.ndarray,
+                                tables: dict) -> list[list[int]]:
+    """Fleet-batched shrink_expand: per-value sorted deduped replacer
+    lists, each value expanded against its own map (tables from
+    stack_comp_maps).  Per map, the result equals shrink_expand_batch
+    — and therefore models.hints.shrink_expand — exactly."""
+    if len(vals) == 0:
+        return []
+    import jax.numpy as jnp
+
+    kernel = stacked_shrink_expand_kernel()
+    reps, oks = kernel(
+        jnp.asarray(vals.astype(np.uint64)),
+        jnp.asarray(map_of.astype(np.int32)),
+        jnp.asarray(tables["keys"]), jnp.asarray(tables["nkeys"]),
+        jnp.asarray(tables["vmat"]), jnp.asarray(tables["nvals"]))
+    reps = np.asarray(reps).reshape(len(vals), -1)
+    oks = np.asarray(oks).reshape(len(vals), -1)
+    out = []
+    for j in range(len(vals)):
+        out.append(sorted(set(reps[j][oks[j]].tolist())))
+    return out
+
+
+# -- the two host passes, shared by the per-program and lane paths ------
+
+def collect_hint_jobs(p: Prog, call_index: int
+                      ) -> tuple[Prog, list[tuple[Arg, int, int]],
+                                 list[int]]:
+    """Pass 1: clone the program and collect every candidate window
+    of the call in traversal order (reference: prog/hints.go:82-103).
+    Returns (clone, jobs, vals); jobs are (arg, window_off, window)
+    with window_off = -1 marking a ConstArg."""
     p = p.clone()
     c = p.calls[call_index]
-
-    # Pass 1: collect candidate windows in traversal order.
-    jobs: list[tuple[Arg, int, int]] = []  # (arg, window_off, window)
+    jobs: list[tuple[Arg, int, int]] = []
     vals: list[int] = []
 
     def collect(arg: Arg, ctx) -> None:
@@ -228,6 +385,58 @@ def mutate_with_hints_device(p: Prog, call_index: int, comps: CompMap,
                 vals.append(load_int(buf, 0, 8))
 
     foreach_arg(c, collect)
+    return p, jobs, vals
+
+
+def apply_hint_mutants(p: Prog, jobs: list[tuple[Arg, int, int]],
+                       replacer_lists: list[list[int]],
+                       exec_cb: Callable[[Prog], None]) -> int:
+    """Pass 2: apply each window's replacers in CPU order — one exec
+    per replacer, original bytes restored after each window
+    (reference: prog/hints.go:66-132).  Returns mutants executed."""
+    from syzkaller_tpu.models import validation
+
+    n = 0
+
+    def run() -> None:
+        if validation.debug:
+            validation.validate_prog(p)
+        exec_cb(p)
+
+    for (arg, off, window), replacers in zip(jobs, replacer_lists):
+        if isinstance(arg, ConstArg):
+            original = arg.val
+            for r in replacers:
+                arg.val = r
+                run()
+                n += 1
+            arg.val = original
+        else:
+            data = arg.data
+            original = bytes(data[off:off + 8]).ljust(8, b"\x00")
+            for r in replacers:
+                store_int(data, off, r, window)
+                run()
+                n += 1
+            data[off:off + window] = original[:window]
+    return n
+
+
+def mutate_with_hints_device(p: Prog, call_index: int, comps: CompMap,
+                             exec_cb: Callable[[Prog], None],
+                             vmax: Optional[int] = None) -> None:
+    """Device-batched equivalent of models.hints.mutate_with_hints:
+    collect every candidate window of the call into one value vector,
+    run shrink_expand as one vmap'd kernel, then apply replacements in
+    the CPU path's exact order (reference: prog/hints.go:66-132).
+
+    Per-key exactness: keys whose operand sets overflow the device
+    budget are supplemented by the CPU shrink_expand for those keys
+    only — the rest of the map stays on device, and the merged
+    replacer set equals the full CPU result exactly."""
+    dmap = DeviceCompMap.from_comp_map(comps, vmax=vmax)
+
+    p, jobs, vals = collect_hint_jobs(p, call_index)
     if not jobs:
         return
 
@@ -242,25 +451,4 @@ def mutate_with_hints_device(p: Prog, call_index: int, comps: CompMap,
             sorted(set(lst) | shrink_expand(v, dmap.overflow))
             for lst, v in zip(replacer_lists, vals)]
 
-    # Pass 2: apply mutants in CPU order (one exec per replacer).
-    from syzkaller_tpu.models import validation
-
-    def run() -> None:
-        if validation.debug:
-            validation.validate_prog(p)
-        exec_cb(p)
-
-    for (arg, off, window), replacers in zip(jobs, replacer_lists):
-        if isinstance(arg, ConstArg):
-            original = arg.val
-            for r in replacers:
-                arg.val = r
-                run()
-            arg.val = original
-        else:
-            data = arg.data
-            original = bytes(data[off:off + 8]).ljust(8, b"\x00")
-            for r in replacers:
-                store_int(data, off, r, window)
-                run()
-            data[off:off + window] = original[:window]
+    apply_hint_mutants(p, jobs, replacer_lists, exec_cb)

@@ -817,6 +817,9 @@ class DevicePipeline:
         # a half-open ring rebuild must also invalidate the signal
         # plane (attach_triage wires it).
         self.triage_engine = None
+        # Batched hints lane (ops/hintlane): shares this pipeline's
+        # breaker/watchdog; attach_hints wires it.
+        self._hint_lane = None
         # Fault-domain mesh engine (parallel/fault_domain): when
         # attached, health_snapshot carries the per-shard breaker
         # states so bench_watch's wedge diagnostics see chip loss.
@@ -862,6 +865,20 @@ class DevicePipeline:
         self.triage_engine = engine
         if self._sim is not None:
             engine.attach_sim(self._sim)
+        if self._hint_lane is not None:
+            engine.attach_hints(self._hint_lane)
+
+    def attach_hints(self, lane) -> None:
+        """Register the co-resident batched hints lane
+        (ops/hintlane.HintLane): it shares this pipeline's breaker and
+        watchdog (one health verdict for the device) and, when the sim
+        prescore is on, rides its epoch clock for replacer-suppression
+        decay."""
+        self._hint_lane = lane
+        if self._sim is not None:
+            lane.attach_sim(self._sim)
+        if self.triage_engine is not None:
+            self.triage_engine.attach_hints(lane)
 
     def enable_sim_prescore(self, backend=None) -> None:
         """Turn on the speculative sim-exec prescore stage (ISSUE 15).
@@ -886,6 +903,8 @@ class DevicePipeline:
             True, self._sim.backend)
         if self.triage_engine is not None:
             self.triage_engine.attach_sim(self._sim)
+        if self._hint_lane is not None:
+            self._hint_lane.attach_sim(self._sim)
 
     def disable_sim_prescore(self) -> None:
         """Back to the plain fused drain (kill switch / test
@@ -1084,6 +1103,8 @@ class DevicePipeline:
         out["arena"]["distill"] = self._distill.snapshot()
         if self.triage_engine is not None:
             out["triage"] = self.triage_engine.snapshot()
+        if self._hint_lane is not None:
+            out["hints"] = self._hint_lane.snapshot()
         if self._mesh_engine is not None:
             out["mesh"] = self._mesh_engine.health_snapshot()
         if self._sim is not None:

@@ -104,8 +104,27 @@ class BatchComposer:
                                 ("novelty", "yield"))
         self._clock = clock
         self._last_rebalance = clock()
+        # Lane tenants (attach_lane): tenants whose rows come from
+        # their own drain (e.g. the batched hints lane) instead of
+        # the default drain_fn, with the lane label their rows book
+        # under in the accounting ledger.
+        self._lane_drains: dict[str, Callable] = {}
+        self._lane_names: dict[str, str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def attach_lane(self, tenant: str, drain_fn: Callable,
+                    lane: Optional[str] = None) -> None:
+        """Register `tenant` as a lane tenant: its allocated rows are
+        produced by its own `drain_fn(n) -> (rows, payloads)` (e.g.
+        HintLane.compose_drain) instead of the shared default drain,
+        and book to `tz_acct_device_ms_total{lane=...}` under `lane`
+        (default: the tenant name).  QoS credits, plateau latches and
+        largest-remainder allocation treat it exactly like any other
+        tenant — a plateaued random-mutation tenant's rows rebalance
+        toward hints through the ordinary credit formula."""
+        self._lane_drains[tenant] = drain_fn
+        self._lane_names[tenant] = lane or tenant
 
     # -- QoS credits -------------------------------------------------------
 
@@ -233,16 +252,60 @@ class BatchComposer:
             tenant_col = np.concatenate([
                 np.full(n, i, np.int32)
                 for i, (_t, n) in enumerate(alloc)])
+        default_total = sum(
+            n for t, n in alloc if t not in self._lane_drains)
+        lane_rows_acct: dict[str, int] = {}
         with telemetry.span("serve.dispatch"):
             t_drain = time.perf_counter()
-            rows, payloads = self.drain_fn(total)
+            if not self._lane_drains:
+                rows, payloads = self.drain_fn(total)
+            else:
+                # Segment the batch: default tenants share one
+                # drain_fn call; each lane tenant produces its own
+                # rows.  Segments stitch back in alloc order so the
+                # tenant_col offsets stay aligned.
+                d_rows = d_payloads = None
+                if default_total:
+                    d_rows, d_payloads = self.drain_fn(default_total)
+                    d_rows = np.atleast_2d(
+                        np.asarray(d_rows, dtype=np.uint8))
+                part_rows: list = []
+                payloads = []
+                off_d = 0
+                for t, n in alloc:
+                    fn = self._lane_drains.get(t)
+                    if fn is None:
+                        part_rows.append(d_rows[off_d:off_d + n])
+                        payloads.extend(d_payloads[off_d:off_d + n])
+                        off_d += n
+                    else:
+                        r, p = fn(n)
+                        part_rows.append(np.atleast_2d(
+                            np.asarray(r, dtype=np.uint8)))
+                        payloads.extend(p)
+                        lane = self._lane_names[t]
+                        lane_rows_acct[lane] = \
+                            lane_rows_acct.get(lane, 0) + n
+                w = max(p.shape[1] for p in part_rows)
+                rows = np.zeros((total, w), dtype=np.uint8)
+                off = 0
+                for p in part_rows:
+                    rows[off:off + p.shape[0], :p.shape[1]] = p
+                    off += p.shape[0]
             drain_s = time.perf_counter() - t_drain
         # Accounting ledger (ISSUE 14): the drain's host-observed
         # residency is the batch's device time, row-weighted over the
         # allocation — including rows allotted to a tenant reaped
-        # mid-compose (it consumed them; conservation holds).
+        # mid-compose (it consumed them; conservation holds).  Lane
+        # tenants additionally book their share under their lane
+        # label (tz_acct_device_ms_total{lane="hints"}); the default
+        # drain's rows book to "exploration" so the lane split
+        # conserves the batch.
+        if lane_rows_acct and default_total:
+            lane_rows_acct["exploration"] = default_total
         telemetry.ACCOUNTING.note_batch(
-            drain_s, tenant_rows={t: n for t, n in alloc})
+            drain_s, tenant_rows={t: n for t, n in alloc},
+            lane_rows=lane_rows_acct or None)
         rows = np.atleast_2d(np.asarray(rows, dtype=np.uint8))
         report: dict = {"rows": total, "tenants": {},
                         "tenant_col": tenant_col,

@@ -32,8 +32,9 @@ and consumed by `TZ_SERVE_PRICE=yield` credit pricing
 (telemetry/slo.py).
 
 Label cardinality is bounded: at most MAX_KEYS live keys per
-dimension; later keys fold into "overflow" (lanes are a fixed set of
-five; tenants are capped by TZ_SERVE_MAX_TENANTS; shards by the
+dimension; later keys fold into "overflow" (lanes are a fixed small
+set — the workqueue bands plus distill and hints; tenants are capped
+by TZ_SERVE_MAX_TENANTS; shards by the
 mesh width — the cap is a leak backstop, not a working limit).
 
 Import-cycle note: like coverage.py, this module is constructed at

@@ -54,7 +54,7 @@ from typing import Callable, Optional
 #: labeled family renders completely (all-zero series included) on
 #: the first /metrics scrape.
 SOURCES = ("triage_candidate", "candidate", "triage", "smash",
-           "exploration", "distill")
+           "exploration", "distill", "hints")
 
 DEFAULT_STALL_WINDOW_S = 300.0
 DEFAULT_STALL_EDGES = 1

@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import threading
 
-KERNELS = ("mutate", "emit_compact", "novel_any")
+KERNELS = ("mutate", "emit_compact", "novel_any", "hints")
 
 #: EWMA weight for the always-on path: heavy enough to settle within
 #: tens of batches, light enough to ride out a single straggler.

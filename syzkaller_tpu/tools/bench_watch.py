@@ -236,6 +236,36 @@ def wedge_report(snap: dict) -> list[str]:
             line += (f", distill {int(d_rounds)} rounds "
                      f"({int(retired)} rows retired)")
         lines.append(line)
+    # Hints lane (ISSUE 19): fused comparison-operand expansion
+    # throughput and fallback posture.  Values climbing with zero
+    # batches means every window is taking the per-program CPU path
+    # (lane demoted — check the breaker); a high suppressed fraction
+    # is healthy steady state (the speculation fold deduplicating
+    # repeat comparands), but suppression at 100% with mutants at 0
+    # means the sim plane stopped decaying.
+    h_batches = counters.get("tz_hints_batches_total") or 0
+    h_cpu = counters.get("tz_hints_cpu_fallback_values_total") or 0
+    if h_batches or h_cpu:
+        h_vals = counters.get("tz_hints_values_total") or 0
+        h_mut = counters.get("tz_hints_mutants_total") or 0
+        line = (f"hints lane: {int(h_batches)} batches, "
+                f"{int(h_vals)} windows -> {int(h_mut)} mutants")
+        h_kib = (counters.get("tz_hints_staged_bytes_total") or 0) \
+            / 1024
+        if h_kib:
+            line += f", staged {h_kib:.1f} KiB"
+        h_sup = counters.get("tz_hints_sim_suppressed_total") or 0
+        if h_sup:
+            line += f", suppressed {h_sup / max(1, h_sup + h_mut):.1%}"
+        h_drop = counters.get("tz_hints_comps_dropped_total") or 0
+        if h_drop:
+            line += f", {int(h_drop)} comps off-device"
+        if h_cpu:
+            line += f", {int(h_cpu)} windows on CPU"
+        h_demos = counters.get("tz_hints_demotions_total") or 0
+        if h_demos:
+            line += f", {int(h_demos)} demotions"
+        lines.append(line)
     # Triage plane health (ISSUE 4): pre-filter hit rate and the
     # realized device-checked call rate — next to the demotion count
     # so a CPU-path regression is visible in the same A/B snapshot.

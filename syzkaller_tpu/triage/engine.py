@@ -279,6 +279,7 @@ class TriageEngine:
         # snapshot() carries the prescore verdict-path state so the
         # triage surface shows what the filter upstream of it did.
         self._sim_prescore = None
+        self._hint_lane = None
         # Durability (syzkaller_tpu/durable): when attached, merges
         # journal their folded indices and the mirror becomes a
         # checkpoint section (durable_provider / restore_mirror).
@@ -526,6 +527,13 @@ class TriageEngine:
         prescore breaker — so the triage surface reports the filter
         that decides which mutants ever reach its verdict path."""
         self._sim_prescore = sim
+
+    def attach_hints(self, lane) -> None:
+        """Register the batched hints lane (ops/hintlane.HintLane):
+        snapshot() gains a "hint_lane" key so the triage surface
+        reports the mutation source whose rows it triages alongside
+        the prescore that filters them."""
+        self._hint_lane = lane
 
     def run_analytics(self, audit: bool = False) -> dict:
         """Force one analytics pass (bench.py --coverage, tests);
@@ -952,6 +960,8 @@ class TriageEngine:
             out["tenants"] = self._tenant_planes.analytics()
         if self._sim_prescore is not None:
             out["sim_prescore"] = self._sim_prescore.snapshot()
+        if self._hint_lane is not None:
+            out["hint_lane"] = self._hint_lane.snapshot()
         return out
 
     def _snapshot_base(self) -> dict:

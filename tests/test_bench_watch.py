@@ -555,3 +555,38 @@ def test_device_report_renders_api_payload():
     # an empty payload still renders the summary, not a crash
     assert any("reconcile: never ran" in ln
                for ln in bw.device_report({}))
+
+
+def test_wedge_report_hints_lane_line():
+    """ISSUE 19: the hints lane renders its fused-batch throughput,
+    staging bill, suppression fraction, off-device comparand count,
+    and fallback posture as one wedge line."""
+    from syzkaller_tpu.telemetry import Registry
+
+    reg = Registry()
+    reg.counter("tz_hints_batches_total").inc(12)
+    reg.counter("tz_hints_values_total").inc(700)
+    reg.counter("tz_hints_mutants_total").inc(150)
+    reg.counter("tz_hints_staged_bytes_total").inc(262144)
+    reg.counter("tz_hints_sim_suppressed_total").inc(50)
+    reg.counter("tz_hints_comps_dropped_total").inc(9)
+    reg.counter("tz_hints_cpu_fallback_values_total").inc(30)
+    reg.counter("tz_hints_demotions_total").inc(1)
+    lines = bw.wedge_report(reg.snapshot())
+    line = next(ln for ln in lines if ln.startswith("hints lane:"))
+    assert "12 batches" in line
+    assert "700 windows -> 150 mutants" in line
+    assert "staged 256.0 KiB" in line
+    assert "suppressed 25.0%" in line  # 50 / (50 + 150)
+    assert "9 comps off-device" in line
+    assert "30 windows on CPU" in line
+    assert "1 demotions" in line
+    # CPU-only posture (demoted lane, zero device batches) still
+    # renders, so a wedged device is visible from the hints line.
+    cpu = Registry()
+    cpu.counter("tz_hints_cpu_fallback_values_total").inc(5)
+    lines = bw.wedge_report(cpu.snapshot())
+    assert any(ln.startswith("hints lane:") for ln in lines)
+    # a snapshot without hints counters renders no line
+    assert not any(ln.startswith("hints lane:")
+                   for ln in bw.wedge_report(_wedge_snapshot()))

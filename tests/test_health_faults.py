@@ -1388,3 +1388,80 @@ def test_rpc_recv_seam_surfaces_connection_error():
     finally:
         cli.close()
         srv.close()
+
+
+def test_hints_fault_plan_demote_cpu_zero_loss_then_repromote():
+    """ISSUE 19: scripted failures on the `device.hints` seam trip the
+    lane's breaker open (hints demote to the exact per-program CPU
+    path), every run's mutant sequence stays byte-identical to the
+    mutate_with_hints host reference (zero lost comparison traces —
+    a failed chunk expands exactly on CPU), and once the seam heals a
+    half-open probe re-promotes the fused device batch."""
+    import numpy as np
+
+    from syzkaller_tpu.health import SEAMS
+    from syzkaller_tpu.models.encoding import serialize_prog
+    from syzkaller_tpu.models.generation import generate_prog
+    from syzkaller_tpu.models.hints import CompMap, mutate_with_hints
+    from syzkaller_tpu.models.prog import ConstArg, foreach_arg
+    from syzkaller_tpu.models.rand import RandGen
+    from syzkaller_tpu.models.target import get_target
+    from syzkaller_tpu.ops.hintlane import HintLane
+
+    assert "device.hints" in SEAMS
+    target = get_target("test", "64")
+    br = CircuitBreaker(failure_threshold=2, backoff_initial=0.05,
+                        backoff_cap=0.1, jitter=0.0, seed=1)
+    lane = HintLane(breaker=br, watchdog=Watchdog(deadline_s=0),
+                    owns_breaker=True)
+    rs = np.random.RandomState(5)
+
+    def case(seed):
+        p = generate_prog(target, RandGen(target, seed), 3)
+        cm = CompMap()
+
+        def harvest(arg, ctx):
+            if isinstance(arg, ConstArg) and arg.typ is not None:
+                cm.add_comp(arg.val, int(rs.randint(1, 1 << 32)))
+
+        for c in p.calls:
+            foreach_arg(c, harvest)
+        return p, cm
+
+    def run_both(seed):
+        p, cm = case(seed)
+        cpu_out: list[bytes] = []
+        dev_out: list[bytes] = []
+        mutate_with_hints(p, 0, cm,
+                          lambda m: cpu_out.append(serialize_prog(m)))
+        lane.run(p, 0, cm, lambda m: dev_out.append(serialize_prog(m)))
+        assert dev_out == cpu_out, f"seed {seed}: lane diverged"
+
+    run_both(100)  # warm the kernel with the seam clean
+    assert lane.stats.device_batches > 0
+
+    # Dispatches 1-2 trip the threshold-2 breaker open; while open,
+    # runs take the CPU path without touching the seam; the half-open
+    # probe after the 0.05s backoff hits a healed seam and re-closes.
+    install_plan(FaultPlan.parse("device.hints:fail@1-2"))
+    saw_open = False
+    seed = 200
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        run_both(seed)
+        seed += 1
+        saw_open = saw_open or br.is_open()
+        if br.state == CLOSED and lane.stats.repromotions >= 1:
+            break
+        time.sleep(0.02)
+    assert saw_open, "breaker never opened on the scripted streak"
+    assert lane.stats.device_errors >= 2
+    assert lane.stats.demotions >= 1, "lane never demoted to CPU"
+    assert lane.stats.cpu_fallback_values > 0, \
+        "demoted runs did not expand on the CPU path"
+    assert lane.stats.repromotions >= 1, "lane never re-promoted"
+    assert br.state == CLOSED and not lane.demoted()
+    # Post-heal: flushes resolve on device again.
+    batches0 = lane.stats.device_batches
+    run_both(seed + 1)
+    assert lane.stats.device_batches > batches0

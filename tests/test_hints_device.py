@@ -220,3 +220,264 @@ def test_fallback_rate_on_sim_trace_cmp(test_target):
     rate = overflow / keys
     # the budget must cover the overwhelming majority of real keys
     assert rate < 0.05, f"per-key overflow rate {rate:.1%} on sim comps"
+
+
+# -- the batched hints lane (ISSUE 19) -------------------------------------
+
+from syzkaller_tpu import telemetry  # noqa: E402
+from syzkaller_tpu.ops.hints import (  # noqa: E402
+    resolve_hints_vmax,
+    shrink_expand_batch_stacked,
+    stack_comp_maps,
+)
+
+
+def _stacked_run(cms, vals, map_of, vmax=16):
+    """Expand `vals` against stacked `cms` at the lane's smallest
+    warm-shape bucket (b=64, m=4, k=16) so every stacked test in this
+    module shares ONE kernel compile with the HintLane fixtures."""
+    dmaps = [DeviceCompMap.from_comp_map(cm, vmax=vmax) for cm in cms]
+    assert all(d.overflow is None for d in dmaps)
+    assert all(len(d) <= 16 for d in dmaps) and len(dmaps) <= 4
+    tables = stack_comp_maps(dmaps, 4, 16)
+    n = len(vals)
+    assert n <= 64
+    varr = np.zeros(64, dtype=np.uint64)
+    varr[:n] = np.array(vals, dtype=np.uint64)
+    moar = np.zeros(64, dtype=np.int32)
+    moar[:n] = np.array(map_of, dtype=np.int32)
+    return shrink_expand_batch_stacked(varr, moar, tables)[:n]
+
+
+def test_stacked_kernel_parity_random():
+    """Fleet-shape parity: several comp maps stacked into one padded
+    table set, windows routed by a map_of column — every window's
+    replacer list must equal its own map's CPU shrink_expand."""
+    rs = np.random.RandomState(23)
+    for it in range(4):
+        cms = [_random_comp_map(rs, nkeys=rs.randint(1, 5),
+                                vals_per_key=3)
+               for _ in range(1 + rs.randint(4))]
+        cms = [cm for cm in cms
+               if len(DeviceCompMap.from_comp_map(cm)) <= 16][:4]
+        if not cms:
+            continue
+        vals, map_of = [], []
+        for mi, cm in enumerate(cms):
+            keys = list(cm.m.keys())
+            for _ in range(6):
+                v = int(keys[rs.randint(len(keys))]) \
+                    if rs.rand() < 0.4 else int(rs.randint(0, 1 << 62))
+                vals.append(v)
+                map_of.append(mi)
+        got = _stacked_run(cms, vals, map_of)
+        for v, mi, g in zip(vals, map_of, got):
+            want = sorted(shrink_expand(v, cms[mi]))
+            assert g == want, f"iter {it} map {mi} value 0x{v:x}"
+
+
+def test_stacked_kernel_swap_and_width_edges():
+    """_swap_const width/endianness edges across DIFFERENT stacked
+    maps: byte-swapped keys, sign-extended keys, and the wide-hi
+    filter must each resolve against the right map's tables (a map_of
+    routing bug would cross-contaminate the replacer sets)."""
+    cm_a = CompMap()
+    cm_a.add_comp((1 << 64) - 1, 0x1234)       # sext 8-bit -1 key
+    cm_a.add_comp(0xBEEF, 0xC0DE)              # 16-bit truncation
+    cm_a.add_comp(0x42, 0xFFFF_FFFF_FFFF_FF80)  # wide-hi filter
+    cm_b = CompMap()
+    cm_b.add_comp(0xEFBE, 0xAAAA)              # byteswap16 of 0xBEEF
+    cm_b.add_comp(0x78563412, 0x5555)          # byteswap32 key
+    cm_b.add_comp(0xFF, 0x9999)                # 8-bit key, no be var
+    vals = [0xFF, 0xABCD_BEEF, 0x42, (1 << 64) - 1,
+            0xBEEF, 0x1234_5678, 0xFF, 0xEFBE]
+    map_of = [0, 0, 0, 0, 1, 1, 1, 1]
+    got = _stacked_run([cm_a, cm_b], vals, map_of)
+    for v, mi, g in zip(vals, map_of, got):
+        want = sorted(shrink_expand(v, [cm_a, cm_b][mi]))
+        assert g == want, f"map {mi} value 0x{v:x}"
+
+
+def test_hints_vmax_knob_and_dropped_counter(monkeypatch):
+    """Satellite: the vmax=16 truncation is no longer silent — capped
+    comparands are counted (tz_hints_comps_dropped_total) and the cap
+    is the TZ_HINTS_VMAX envsafe knob."""
+    dropped = telemetry.counter(
+        "tz_hints_comps_dropped_total", "").value
+    cm = CompMap()
+    for i in range(40):
+        cm.add_comp(0x1234, 0x1000 + i)
+    dmap = DeviceCompMap.from_comp_map(cm)
+    assert dmap.overflow is not None and dmap.overflow_operands == 40
+    assert telemetry.counter(
+        "tz_hints_comps_dropped_total", "").value == dropped + 40
+    # Raising the knob keeps the same map fully on device.
+    monkeypatch.setenv("TZ_HINTS_VMAX", "64")
+    assert resolve_hints_vmax() == 64
+    wide = DeviceCompMap.from_comp_map(cm)
+    assert wide.overflow is None and wide.vals.shape[1] == 64
+    # kmax budget: keys past it also route to the supplement, counted.
+    monkeypatch.delenv("TZ_HINTS_VMAX")
+    many = CompMap()
+    for i in range(8):
+        many.add_comp(0x9000 + 16 * i, 0x1 + i)
+    capped = DeviceCompMap.from_comp_map(many, kmax=4)
+    assert capped.overflow is not None and len(capped) == 4
+    # Malformed/extreme values clamp instead of exploding.
+    monkeypatch.setenv("TZ_HINTS_VMAX", "0")
+    assert resolve_hints_vmax() == 1
+    monkeypatch.setenv("TZ_HINTS_VMAX", "99999")
+    assert resolve_hints_vmax() == 1024
+
+
+@pytest.fixture(scope="module")
+def hint_rig():
+    """One shared HintLane for the lane tests: the parity test warms
+    its pow2 shape buckets, and the zero-new-jits test replays the
+    SAME cases so every steady-state flush hits a warm bucket."""
+    from syzkaller_tpu.ops.hintlane import HintLane
+
+    return HintLane()
+
+
+@pytest.fixture(scope="module")
+def test_target_module():
+    from syzkaller_tpu.models.target import get_target
+
+    return get_target("test", "64")
+
+
+def _lane_case(target, rs, seed):
+    p = generate_prog(target, RandGen(target, seed), 3)
+    cm = _random_comp_map(rs, nkeys=4, vals_per_key=2)
+    from syzkaller_tpu.models.prog import ConstArg, foreach_arg
+
+    def harvest(arg, ctx):
+        if isinstance(arg, ConstArg) and arg.typ is not None:
+            cm.add_comp(arg.val, int(rs.randint(1, 1 << 32)))
+
+    for c in p.calls:
+        foreach_arg(c, harvest)
+    return p, cm
+
+
+def test_hint_lane_parity_and_acct_lane(hint_rig, test_target_module):
+    """Lane-level bit-exactness (the tentpole oracle): HintLane.run
+    produces the identical mutant sequence to the per-program host
+    path, and its kernel time books to
+    tz_acct_device_ms_total{lane="hints"}."""
+    rs = np.random.RandomState(31)
+    acct0 = telemetry.counter("tz_acct_device_ms_total", "",
+                              labels={"lane": "hints"}).value
+    checked = 0
+    for seed in range(3):
+        p, cm = _lane_case(test_target_module, rs, 700 + seed)
+        for ci in range(len(p.calls)):
+            cpu_out: list[bytes] = []
+            dev_out: list[bytes] = []
+            mutate_with_hints(p, ci, cm,
+                              lambda m: cpu_out.append(serialize_prog(m)))
+            hint_rig.run(p, ci, cm,
+                         lambda m: dev_out.append(serialize_prog(m)))
+            assert dev_out == cpu_out, f"seed {seed} call {ci}"
+            checked += len(cpu_out)
+    assert checked > 20, "lane parity never exercised a real mutant"
+    assert hint_rig.stats.device_batches > 0
+    assert telemetry.counter(
+        "tz_acct_device_ms_total", "",
+        labels={"lane": "hints"}).value > acct0, \
+        "fused hint kernel time never booked to the hints lane"
+
+
+def test_hint_lane_warm_rig_zero_new_jits(hint_rig, test_target_module):
+    """Acceptance: once the lane's pow2 buckets are warm (the parity
+    test above), further flushes at steady-state shapes compile
+    NOTHING — the stacked tables and value columns reuse the same
+    module-level kernel."""
+    from syzkaller_tpu.telemetry import assert_no_new_compiles
+
+    # Replay the parity test's exact case stream: identical window
+    # counts and table dims land in identical (already-compiled) pow2
+    # buckets.
+    rs = np.random.RandomState(31)
+    assert hint_rig.stats.device_batches > 0, "rig not warm"
+    batches0 = hint_rig.stats.device_batches
+    with assert_no_new_compiles():
+        for seed in range(3):
+            p, cm = _lane_case(test_target_module, rs, 700 + seed)
+            for ci in range(len(p.calls)):
+                hint_rig.run(p, ci, cm, lambda m: None)
+    assert hint_rig.stats.device_batches > batches0
+
+
+def test_hint_lane_sim_fold_suppression(hint_rig, test_target_module):
+    """With a sim prescore attached, repeat (call site, comparand)
+    replacers are suppressed and re-admitted when the sim plane's
+    epoch advances."""
+
+    class _Sim:
+        epochs = 0
+
+        def demoted(self):
+            return False
+
+    sim = _Sim()
+    hint_rig.attach_sim(sim)
+    try:
+        rs = np.random.RandomState(47)
+        first, p, cm = 0, None, None
+        for seed in range(900, 910):  # find a case with real mutants
+            sim.epochs += 1  # fresh fold plane per candidate
+            p, cm = _lane_case(test_target_module, rs, seed)
+            first = hint_rig.run(p, 0, cm, lambda m: None)
+            if first > 0:
+                break
+        assert first > 0, "no case produced hint mutants"
+        sup0 = hint_rig.stats.suppressed
+        again = hint_rig.run(p, 0, cm, lambda m: None)
+        assert hint_rig.stats.suppressed > sup0, \
+            "repeat comparands were not suppressed"
+        assert again < first
+        sim.epochs += 1  # the sim plane decayed: re-admit everything
+        readmitted = hint_rig.run(p, 0, cm, lambda m: None)
+        assert readmitted == first, \
+            "epoch decay did not re-admit suppressed replacers"
+    finally:
+        hint_rig._sim = None
+
+
+def test_hint_lane_e2e_proc_coverage_attribution(test_target):
+    """End-to-end acceptance: a Proc wired to the lane executes fused
+    hint mutants, and their novel edges attribute to
+    tz_coverage_novel_edges_total{lane="hints"}."""
+    from syzkaller_tpu.fuzzer import Fuzzer, FuzzerConfig, WorkQueue
+    from syzkaller_tpu.fuzzer.fuzzer import Stat
+    from syzkaller_tpu.fuzzer.proc import Proc
+    from syzkaller_tpu.ipc.env import make_env
+    from syzkaller_tpu.ops.hintlane import HintLane
+
+    cov0 = telemetry.counter("tz_coverage_novel_edges_total", "",
+                             labels={"lane": "hints"}).value
+    lane = HintLane()
+    env = make_env(pid=0, sim=True, signal=True)
+    try:
+        fuzzer = Fuzzer(test_target, wq=WorkQueue(),
+                        cfg=FuzzerConfig(minimize_attempts=1))
+        proc = Proc(fuzzer, pid=0, env=env, device_hints=True,
+                    hint_lane=lane)
+        ran = 0
+        for seed in range(30):
+            p = generate_prog(test_target, RandGen(test_target, seed), 4)
+            for ci in range(len(p.calls)):
+                proc.execute_hint_seed(p, ci)
+            if fuzzer.stats[Stat.HINT] > 0:
+                ran = fuzzer.stats[Stat.HINT]
+                break
+        assert ran > 0, "no hint mutants executed via the lane"
+        assert lane.stats.mutants > 0 and lane.stats.device_batches > 0
+        assert telemetry.counter(
+            "tz_coverage_novel_edges_total", "",
+            labels={"lane": "hints"}).value > cov0, \
+            "hint-mutant novelty not attributed to the hints lane"
+    finally:
+        env.close()

@@ -698,3 +698,68 @@ def test_multi_tenant_conservation_under_churn():
             got_idx = np.flatnonzero(
                 solo.verdict(vm.name, chunk)).tolist()
             assert got_idx == novel_idx
+
+
+def test_compose_lane_tenant_drains_through_its_own_fn():
+    """The ISSUE 19 composer satellite: a tenant registered via
+    attach_lane draws its rows from its OWN drain (the hints lane's
+    compose_drain) while default tenants share drain_fn, the segments
+    stitch back in alloc order so tenant_col stays aligned, and the
+    lane's row share books to tz_acct_device_ms_total{lane="hints"}
+    (with the default rows conserved under lane="exploration")."""
+    clock = _Clock()
+    counter = [0]
+
+    def default_drain(n):
+        vals = list(range(counter[0], counter[0] + n))
+        counter[0] += n
+        rows = _rows(vals)
+        return rows, [row.tobytes() for row in rows]
+
+    broker, _planes, comp = _mk_serving(clock, batch_rows=100,
+                                        drain=default_drain)
+    lane_calls: list[int] = []
+
+    def hints_drain(n):
+        lane_calls.append(n)
+        rows = _rows(list(range(1 << 20, (1 << 20) + n)))
+        return rows, [row.tobytes() for row in rows]
+
+    comp.attach_lane("hints", hints_drain, lane="hints")
+    for name in ("fleet", "hints"):
+        broker.Connect({"name": name})
+    broker.Poll({"name": "fleet", "epoch": broker.epoch, "seq": 1,
+                 "ack_seq": 0, "demand": {"backlog": 60}})
+    broker.Poll({"name": "hints", "epoch": broker.epoch, "seq": 1,
+                 "ack_seq": 0, "demand": {"backlog": 25}})
+    acct0 = telemetry.counter("tz_acct_device_ms_total", "",
+                              labels={"lane": "hints"}).value
+    expl0 = telemetry.counter("tz_acct_device_ms_total", "",
+                              labels={"lane": "exploration"}).value
+    report = comp.compose_once()
+    # QoS credits honoured: both tenants got their demand-bound share
+    # and the hints tenant's rows came from hints_drain, exactly once.
+    assert report["rows"] == 85
+    assert report["order"] == ["fleet", "hints"]
+    assert report["tenants"]["fleet"]["rows"] == 60
+    assert report["tenants"]["hints"]["rows"] == 25
+    assert lane_calls == [25]
+    assert counter[0] == 60  # default drain produced only its segment
+    # tenant_col alignment survives the segmented stitch.
+    col = report["tenant_col"]
+    assert col[:60].tolist() == [0] * 60
+    assert col[60:].tolist() == [1] * 25
+    # Supply landed in the right queues; the hints queue holds the
+    # lane drain's rows, not the default drain's.
+    assert broker.tenants["fleet"].queued() == 60
+    assert broker.tenants["hints"].queued() == 25
+    hint_rows = _rows(list(range(1 << 20, (1 << 20) + 25)))
+    pending = list(broker.tenants["hints"].pending)[:3]
+    assert [p for _rid, p in pending] == \
+        [row.tobytes() for row in hint_rows[:3]]
+    # The ledger booked the lane split: hints ms grew, and the default
+    # segment's share landed under "exploration" (conservation).
+    assert telemetry.counter("tz_acct_device_ms_total", "",
+                             labels={"lane": "hints"}).value > acct0
+    assert telemetry.counter("tz_acct_device_ms_total", "",
+                             labels={"lane": "exploration"}).value > expl0
